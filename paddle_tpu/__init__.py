@@ -1,0 +1,56 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capabilities of the PaddlePaddle reference
+(see SURVEY.md): dual-mode (eager tape + traced/compiled) execution, a
+YAML-style op registry lowering to XLA, tape-based autograd over JAX VJPs,
+nn/optimizer/amp/io user APIs, jit-to-static compilation, and a full
+hybrid-parallel distributed stack (dp/tp/pp/sharding/sep/ep) built on
+jax.sharding meshes + XLA collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtypes as _dtypes_mod
+from .core.dtypes import (bfloat16, float16, float32, float64, int8, int16,
+                          int32, int64, uint8, bool_, complex64, complex128,
+                          get_default_dtype, set_default_dtype)
+from .core.tensor import Tensor, to_tensor
+from .core.flags import get_flags, set_flags
+from .core.device import (CPUPlace, TPUPlace, CustomPlace, set_device,
+                          get_device, device_count, is_compiled_with_tpu)
+
+# op namespace (attaches Tensor methods as a side effect)
+from . import ops as _ops_pkg
+from .ops import *          # noqa: F401,F403 — paddle.<op> surface
+from .ops.random import (seed, get_rng_state, set_rng_state,
+                         default_generator, Generator)
+
+from . import autograd
+from .autograd import no_grad, enable_grad, grad, set_grad_enabled, \
+    is_grad_enabled
+
+bool = bool_  # paddle.bool
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+# Submodule imports below are added as subsystems land; keep them guarded so
+# a partially-built tree still imports during bring-up.
+import importlib as _importlib
+
+_OPTIONAL_SUBMODULES = ["nn", "optimizer", "amp", "io", "jit", "static",
+                        "distributed", "vision", "metric", "incubate",
+                        "profiler", "device", "framework", "sparse",
+                        "linalg_ns", "fft", "models", "text", "audio"]
+
+nn = None
+for _m in list(_OPTIONAL_SUBMODULES):
+    try:
+        globals()[_m] = _importlib.import_module(f".{_m}", __name__)
+    except ModuleNotFoundError:
+        _OPTIONAL_SUBMODULES.remove(_m)
+
+from .framework_io import save, load  # noqa: E402  (added with io subsystem)

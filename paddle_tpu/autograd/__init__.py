@@ -1,0 +1,48 @@
+"""Autograd public API.
+
+Parity: python/paddle/autograd/ (reference) — backward, grad, no_grad,
+PyLayer, saved-tensor hooks.
+
+Note: ``py_layer``/``functional`` are loaded lazily (module __getattr__) so
+that core.tensor can import ``tape`` without a cycle.
+"""
+from .tape import (GradNode, run_backward, grad, no_grad, enable_grad,
+                   is_grad_enabled, set_grad_enabled)
+
+_LAZY = {
+    "PyLayer": ("py_layer", "PyLayer"),
+    "PyLayerContext": ("py_layer", "PyLayerContext"),
+    "LegacyPyLayer": ("py_layer", "LegacyPyLayer"),
+    "jacobian": ("functional", "jacobian"),
+    "hessian": ("functional", "hessian"),
+    "vjp": ("functional", "vjp"),
+    "jvp": ("functional", "jvp"),
+    "saved_tensors_hooks": ("saved_hooks", "saved_tensors_hooks"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod, attr = _LAZY[name]
+        m = importlib.import_module(f".{mod}", __name__)
+        val = getattr(m, attr)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity
+    (python/paddle/autograd/backward_mode.py:23)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors,
+                                                   (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "jacobian",
+           "hessian", "vjp", "jvp", "GradNode"]

@@ -1,0 +1,67 @@
+"""Functional higher-order autograd.
+
+Parity: python/paddle/incubate/autograd/functional.py (reference) — here
+delegated to JAX transforms over the functional core, which is strictly more
+capable (arbitrary-order, forward+reverse composition).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _fnize(func):
+    def f(*vals):
+        ts = [Tensor._from_value(v) for v in vals]
+        out = func(*ts)
+        return out._value if isinstance(out, Tensor) else out
+    return f
+
+
+def _vals(xs):
+    if isinstance(xs, (list, tuple)):
+        return [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+    return [xs._value if isinstance(xs, Tensor) else jnp.asarray(xs)]
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    vals = _vals(xs)
+    jac = jax.jacrev(_fnize(func), argnums=tuple(range(len(vals))))(*vals)
+    if len(vals) == 1:
+        return Tensor._from_value(jac[0])
+    return tuple(Tensor._from_value(j) for j in jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    vals = _vals(xs)
+    hes = jax.hessian(_fnize(func), argnums=tuple(range(len(vals))))(*vals)
+    if len(vals) == 1:
+        return Tensor._from_value(hes[0][0])
+    return hes
+
+
+def vjp(func, xs, v=None):
+    vals = _vals(xs)
+    out, vjp_fn = jax.vjp(_fnize(func), *vals)
+    if v is None:
+        v = jnp.ones_like(out)
+    else:
+        v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+    grads = vjp_fn(v)
+    grads = tuple(Tensor._from_value(g) for g in grads)
+    return Tensor._from_value(out), grads if len(grads) > 1 else grads[0]
+
+
+def jvp(func, xs, v=None):
+    vals = _vals(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in vs)
+    out, tangent_out = jax.jvp(_fnize(func), tuple(vals), tangents)
+    return Tensor._from_value(out), Tensor._from_value(tangent_out)

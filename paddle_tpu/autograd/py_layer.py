@@ -1,0 +1,95 @@
+"""User-defined autograd ops.
+
+Parity: paddle.autograd.PyLayer (reference:
+python/paddle/autograd/py_layer.py:29, C++ side
+paddle/fluid/eager/pylayer/).  The user supplies forward/backward static
+methods; forward runs eagerly, backward is spliced into the tape as a
+GradNode whose "vjp" calls the user function.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import tape as _tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Any] = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single = isinstance(outs, Tensor)
+        out_list = [outs] if single else list(outs)
+
+        # edges for ALL tensor args, in forward-argument order — the user's
+        # backward returns one grad per forward tensor input (parity:
+        # python/paddle/autograd/py_layer.py); the engine prunes
+        # stop_gradient edges itself.
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        any_grad = any(not t.stop_gradient for t in in_tensors)
+        if _tape.is_grad_enabled() and any_grad:
+            tensor_outs = [o for o in out_list if isinstance(o, Tensor)]
+            out_meta = [(tuple(o._value.shape), o._value.dtype)
+                        for o in tensor_outs]
+
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                cot_tensors = [Tensor._from_value(c) for c in cots]
+                grads = cls.backward(ctx, *cot_tensors)
+                if isinstance(grads, Tensor) or grads is None:
+                    grads = (grads,)
+                vals = tuple(
+                    g._value if isinstance(g, Tensor) else g for g in grads)
+                if len(vals) < len(in_tensors):
+                    vals = vals + (None,) * (len(in_tensors) - len(vals))
+                return vals[: len(in_tensors)]
+
+            node = _tape.GradNode(cls.__name__, vjp_fn, in_tensors, out_meta,
+                                  out_is_tuple=len(out_meta) > 1)
+            i = 0
+            for o in out_list:
+                if isinstance(o, Tensor):
+                    o._grad_node = node
+                    o._out_index = i
+                    o.stop_gradient = False
+                    i += 1
+        return outs if not single else out_list[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,31 @@
+"""Saved-tensor hooks (parity: python/paddle/autograd/saved_tensors_hooks.py).
+
+The reference lets users intercept forward activations saved for backward
+(e.g. to offload them to host).  Our residuals live inside JAX vjp closures,
+so the hook surface is narrower: we expose the context manager for API
+compatibility and apply pack/unpack to tensors explicitly saved through
+PyLayerContext.save_for_backward.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_HOOKS = []
+
+
+class saved_tensors_hooks(contextlib.AbstractContextManager):
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _HOOKS.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _HOOKS.pop()
+        return False
+
+
+def current_hooks():
+    return _HOOKS[-1] if _HOOKS else None

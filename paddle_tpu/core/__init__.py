@@ -1,0 +1,8 @@
+from . import dtypes
+from . import flags
+from . import device
+from .tensor import Tensor, to_tensor
+from .flags import get_flags, set_flags, define_flag
+from .device import (Place, CPUPlace, TPUPlace, CustomPlace, set_device,
+                     get_device, device_guard, device_count,
+                     is_compiled_with_tpu, synchronize)

@@ -1,0 +1,138 @@
+"""Device / place management.
+
+Capability parity with the reference's Place + DeviceManager
+(reference: paddle/phi/common/place.h, paddle/phi/backends/device_manager.h:134,
+context pool paddle/phi/backends/context_pool.h).  On TPU the device runtime is
+PJRT, surfaced through JAX; a "place" is a thin handle to a jax.Device.
+
+The reference's hardware-plugin C ABI (paddle/phi/backends/device_ext.h) maps
+to the PJRT C API plugin mechanism — selecting a platform here selects a PJRT
+client underneath.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+
+class Place:
+    """Base device handle (reference: paddle/phi/common/place.h)."""
+
+    device_type: str = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            # Fall back to whatever the default backend exposes.
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    """The TPU place — the whole point of this framework.
+
+    Replaces the reference's GPUPlace/CUDAPlace (paddle/phi/common/place.h)."""
+    device_type = "tpu"
+
+
+class CustomPlace(Place):
+    """Third-party accelerator place (reference: custom device plugin,
+    paddle/phi/backends/custom/custom_device.cc:1059). Under PJRT a custom
+    platform is just another client name."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+def _platform_of(d: jax.Device) -> str:
+    p = d.platform
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+_CURRENT_DEVICE: list[Optional[Place]] = [None]
+
+
+def _default_place() -> Place:
+    d = jax.devices()[0]
+    plat = _platform_of(d)
+    if plat == "tpu":
+        return TPUPlace(0)
+    if plat == "cpu":
+        return CPUPlace(0)
+    return CustomPlace(plat, 0)
+
+
+def get_device() -> str:
+    """Current device string, e.g. 'tpu:0' (parity:
+    python/paddle/device/__init__.py get_device)."""
+    p = _CURRENT_DEVICE[0] or _default_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_place() -> Place:
+    return _CURRENT_DEVICE[0] or _default_place()
+
+
+def set_device(device: str) -> Place:
+    """Select the device new tensors land on, e.g. set_device('tpu')
+    (parity: python/paddle/device/__init__.py set_device)."""
+    if ":" in device:
+        dev_type, idx = device.split(":")
+        idx = int(idx)
+    else:
+        dev_type, idx = device, 0
+    dev_type = {"gpu": "tpu"}.get(dev_type, dev_type)  # be forgiving
+    if dev_type == "cpu":
+        place: Place = CPUPlace(idx)
+    elif dev_type == "tpu":
+        place = TPUPlace(idx)
+    else:
+        place = CustomPlace(dev_type, idx)
+    _CURRENT_DEVICE[0] = place
+    return place
+
+
+@contextlib.contextmanager
+def device_guard(device: str):
+    old = _CURRENT_DEVICE[0]
+    set_device(device)
+    try:
+        yield
+    finally:
+        _CURRENT_DEVICE[0] = old
+
+
+def device_count(device_type: str = "tpu") -> int:
+    return len([d for d in jax.devices() if _platform_of(d) == device_type])
+
+
+def is_compiled_with_tpu() -> bool:
+    return device_count("tpu") > 0
+
+
+def synchronize():
+    """Block until all outstanding device work is done (parity:
+    paddle.device.synchronize)."""
+    jax.effects_barrier()

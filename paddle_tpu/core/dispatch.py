@@ -1,0 +1,164 @@
+"""Eager op dispatch.
+
+Capability parity with the reference's eager dispatch chain
+(reference: generated <op>_ad_func in dygraph_functions.cc from
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py →
+paddle::experimental::<op> in paddle/phi/api/lib/api.cc →
+KernelFactory::SelectKernelOrThrowError paddle/phi/core/kernel_factory.h:324).
+
+TPU-native design: there is no per-backend kernel registry to search — every
+op is a pure JAX function lowered to XLA.  ``apply_op`` is the single choke
+point that (1) applies AMP auto-cast (analog of
+paddle/fluid/eager/amp_utils.h), (2) computes the forward — capturing the VJP
+on the same pass when grads are required (replacing the generated GradNode
+classes), (3) wraps outputs and records the tape node, (4) optionally checks
+NaN/Inf (analog of paddle/fluid/eager/nan_inf_utils.h).
+
+Inside a trace (jax.jit / to_static / value_and_grad) the tape is skipped and
+ops execute as plain traced JAX calls, so whole training steps compile into a
+single XLA module — the dispatch cache IS jit's executable cache.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dt
+from .flags import get_flag
+from ..autograd import tape as _tape
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# AMP hook (filled in by paddle_tpu.amp to avoid an import cycle)
+# ---------------------------------------------------------------------------
+_amp_state = {"enabled": False, "dtype": None, "level": "O1",
+              "white": frozenset(), "black": frozenset()}
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+def _check_nan_inf(name: str, vals: Sequence[Array]):
+    for v in vals:
+        if isinstance(v, Array) and not _is_tracer(v) \
+                and jnp.issubdtype(v.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(v))):
+                msg = f"Operator {name} output contains NaN/Inf"
+                if get_flag("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print("WARNING:", msg)
+
+
+def _amp_cast_inputs(name: str, vals: List[Any]) -> List[Any]:
+    """O1 auto-cast per white/black list (reference:
+    python/paddle/amp/amp_lists.py:30,105 and eager_amp_auto_cast.h)."""
+    st = _amp_state
+    if not st["enabled"]:
+        return vals
+    target = st["dtype"]
+    if name in st["black"]:
+        cast_to = jnp.float32
+    elif name in st["white"] or st["level"] == "O2":
+        cast_to = target
+    else:
+        return vals
+    out = []
+    for v in vals:
+        if isinstance(v, Array) and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != cast_to and v.dtype != jnp.float64:
+            out.append(v.astype(cast_to))
+        else:
+            out.append(v)
+    return out
+
+
+def apply_op(name: str, fn: Callable, tensor_args: Sequence,
+             kwargs: Optional[Dict[str, Any]] = None,
+             multi_output: bool = False):
+    """Execute op ``fn(*values, **kwargs)`` over Tensor/array ``tensor_args``.
+
+    ``fn`` must be a pure jax-traceable function.  Non-Tensor entries in
+    ``tensor_args`` are passed through untouched (they are non-differentiable
+    leaves such as python scalars).  Returns Tensor or tuple of Tensors.
+    """
+    from .tensor import Tensor
+
+    kwargs = kwargs or {}
+    tensors: List[Optional[Tensor]] = []
+    vals: List[Any] = []
+    for a in tensor_args:
+        if isinstance(a, Tensor):
+            tensors.append(a)
+            vals.append(a._value)
+        else:
+            tensors.append(None)
+            vals.append(a)
+
+    vals = _amp_cast_inputs(name, vals)
+
+    tracing = any(_is_tracer(v) for v in vals)
+    need_grad = (not tracing) and _tape.is_grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in tensors)
+
+    if need_grad:
+        # Differentiate only w.r.t. inexact-dtype inputs that require grad.
+        diff_idx = [
+            i for i, (t, v) in enumerate(zip(tensors, vals))
+            if t is not None and not t.stop_gradient
+            and isinstance(v, (Array, np.ndarray))
+            and jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+        ]
+        if not diff_idx:
+            need_grad = False
+
+    if not need_grad:
+        out_vals = fn(*vals, **kwargs)
+        outs = _wrap_outputs(name, out_vals, multi_output, node=None)
+    else:
+        def closed(*diff_vals):
+            full = list(vals)
+            for i, dv in zip(diff_idx, diff_vals):
+                full[i] = dv
+            return fn(*full, **kwargs)
+
+        primals = [vals[i] for i in diff_idx]
+        out_vals, vjp_fn = jax.vjp(closed, *primals)
+        out_is_tuple = isinstance(out_vals, tuple)
+        flat_outs = out_vals if out_is_tuple else (out_vals,)
+        out_meta = [(tuple(o.shape), o.dtype) for o in flat_outs]
+        node = _tape.GradNode(name, vjp_fn, [tensors[i] for i in diff_idx],
+                              out_meta, out_is_tuple=out_is_tuple)
+        outs = _wrap_outputs(name, out_vals, multi_output, node=node)
+
+    if get_flag("check_nan_inf"):
+        flat = out_vals if isinstance(out_vals, tuple) else (out_vals,)
+        _check_nan_inf(name, flat)
+    return outs
+
+
+def _wrap_outputs(name, out_vals, multi_output, node):
+    from .tensor import Tensor
+
+    if isinstance(out_vals, tuple):
+        outs = []
+        for i, v in enumerate(out_vals):
+            t = Tensor._from_value(v)
+            if node is not None:
+                # Only float outputs participate in the autograd graph.
+                t._grad_node = node
+                t._out_index = i
+                t.stop_gradient = False
+            outs.append(t)
+        return tuple(outs)
+    t = Tensor._from_value(out_vals)
+    if node is not None:
+        t._grad_node = node
+        t._out_index = 0
+        t.stop_gradient = False
+    return (t,) if multi_output else t

@@ -1,0 +1,115 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's DataType enum
+(reference: paddle/phi/common/data_type.h) — here dtypes are thin wrappers
+over numpy/jax dtypes with paddle-style string names. bfloat16 is first-class
+(it is the TPU MXU's native reduced precision).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import dtypes as _jax_dtypes
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "int": int32,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-supplied dtype (str / np.dtype / jnp dtype) to a
+    canonical numpy dtype object (with bfloat16 extended-dtype support)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            dtype = _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical paddle-style name of a dtype."""
+    d = np.dtype(dtype)
+    if d == _jax_dtypes.bfloat16:
+        return "bfloat16"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_inexact(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.inexact)
+
+
+_DEFAULT_DTYPE = [np.dtype("float32")]
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if not is_floating_point(d):
+        raise TypeError("default dtype must be floating point, got %s" % d)
+    _DEFAULT_DTYPE[0] = d

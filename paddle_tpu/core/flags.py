@@ -1,0 +1,105 @@
+"""Runtime flag registry.
+
+Capability parity with the reference's FLAGS system
+(reference: paddle/phi/core/flags.cc — 126 PHI_DEFINE_EXPORTED_* definitions;
+paddle/utils/flags.h:24 gflags wrapper with a self-contained native fallback).
+
+Flags are process-global knobs, settable three ways (same precedence as the
+reference): definition default < environment variable ``FLAGS_<name>`` <
+explicit ``set_flags``.  A native C++ registry can be slotted behind this
+module later; the Python registry is authoritative for now.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_lock = threading.RLock()
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _FlagDef] = {}
+
+
+def _parse(raw: str, ty: type):
+    if ty is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def define_flag(name: str, default, help: str = "", type: type | None = None,
+                on_change=None):
+    """Define a flag. Environment ``FLAGS_<name>`` overrides the default."""
+    ty = type if type is not None else default.__class__
+    with _lock:
+        env = os.environ.get("FLAGS_" + name)
+        value = _parse(env, ty) if env is not None else default
+        _REGISTRY[name] = _FlagDef(name, default, ty, help, on_change, value)
+    return value
+
+
+def get_flags(flags=None) -> Dict[str, Any]:
+    """Query flag values. ``flags`` may be a name, list of names, or None (all)."""
+    with _lock:
+        if flags is None:
+            return {k: d.value for k, d in _REGISTRY.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for k in flags:
+            if k not in _REGISTRY:
+                raise ValueError(f"Flag {k!r} is not defined")
+            out[k] = _REGISTRY[k].value
+        return out
+
+
+def get_flag(name: str):
+    return get_flags([name])[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Set flag values (same surface as paddle.set_flags)."""
+    with _lock:
+        for k, v in flags.items():
+            if k not in _REGISTRY:
+                raise ValueError(f"Flag {k!r} is not defined")
+            d = _REGISTRY[k]
+            if isinstance(v, str) and d.type is not str:
+                v = _parse(v, d.type)
+            d.value = d.type(v) if not isinstance(v, d.type) else v
+            if d.on_change is not None:
+                d.on_change(d.value)
+
+
+# ---------------------------------------------------------------------------
+# Core flag definitions (subset of reference paddle/phi/core/flags.cc that is
+# meaningful on TPU; more are defined next to their subsystems).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "Check outputs of every op for NaN/Inf (numerical sanitizer; "
+            "reference: paddle/phi/core/flags.cc:62)")
+define_flag("check_nan_inf_level", 0,
+            "0: error on nan/inf; >0: warn levels "
+            "(reference: paddle/phi/core/flags.cc:88)")
+define_flag("benchmark", False, "Sync after every op for timing")
+define_flag("eager_compile_ops", True,
+            "Route eager op dispatch through the jit executable cache "
+            "(the TPU analog of the reference's per-op kernel dispatch)")
+define_flag("use_pallas_kernels", True,
+            "Use hand-written Pallas kernels for fused ops when on TPU")
+define_flag("allocator_strategy", "auto_growth",
+            "Kept for API parity; PJRT owns device memory on TPU "
+            "(reference: paddle/fluid/memory/allocation/allocator_strategy.cc:31)")
+define_flag("tpu_deterministic", False, "Request deterministic XLA reductions")
+define_flag("log_level", 0, "Verbose logging level (GLOG_v analog)")

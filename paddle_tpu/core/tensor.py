@@ -1,0 +1,352 @@
+"""The eager Tensor.
+
+Capability parity with the reference's paddle::Tensor + eager AutogradMeta
+(reference: paddle/phi/api/include/tensor.h:82, autograd meta
+paddle/fluid/eager/autograd_meta.h, Python surface
+paddle/fluid/pybind/eager_method.cc / eager_properties.cc).
+
+TPU-native design: a Tensor owns a ``jax.Array`` (a PJRT buffer — possibly
+sharded across a device mesh, which is how DistTensor parity is achieved; see
+paddle_tpu.distributed) plus autograd metadata (tape node + accumulated
+``.grad``).  Most math methods are attached from the op library at import
+time (the analog of the generated Python method table in
+paddle/fluid/pybind/eager_op_function.cc).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dt
+from .device import get_place
+from ..autograd import tape as _tape
+
+
+def _default_cast(data):
+    """Numpy conversion with paddle-style defaults: python floats -> default
+    float dtype, python ints -> int64."""
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(_dt.get_default_dtype())
+    return arr
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_grad_node",
+                 "_out_index", "name", "persistable", "_hooks",
+                 "trainable", "__weakref__", "__dict__")
+
+    _next_id = [0]
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if data is None:
+            value = jnp.zeros((), _dt.get_default_dtype())
+        elif isinstance(data, Tensor):
+            value = data._value
+        elif isinstance(data, jax.Array) or isinstance(data, jax.core.Tracer):
+            value = data
+        else:
+            value = jnp.asarray(_default_cast(data))
+        if dtype is not None:
+            d = _dt.convert_dtype(dtype)
+            if value.dtype != d:
+                value = value.astype(d)
+        if place is not None and not isinstance(value, jax.core.Tracer):
+            value = jax.device_put(value, place.jax_device)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None           # raw jax.Array accumulator
+        self._grad_node = None      # producing GradNode
+        self._out_index = 0
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = []
+        if name is None:
+            Tensor._next_id[0] += 1
+            name = f"generated_tensor_{Tensor._next_id[0]}"
+        self.name = name
+
+    # -- construction helpers ------------------------------------------------
+    @classmethod
+    def _from_value(cls, value) -> "Tensor":
+        t = cls.__new__(cls)
+        t._value = value
+        t.stop_gradient = True
+        t._grad = None
+        t._grad_node = None
+        t._out_index = 0
+        t.persistable = False
+        t.trainable = False
+        t._hooks = []
+        Tensor._next_id[0] += 1
+        t.name = f"generated_tensor_{Tensor._next_id[0]}"
+        return t
+
+    # -- basic metadata ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        return get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._value.dtype).itemsize
+
+    # -- value access --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd ------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad is None:
+            return None
+        return Tensor._from_value(self._grad)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad = None
+        else:
+            self._grad = value._value if isinstance(value, Tensor) \
+                else jnp.asarray(value)
+
+    def _accumulate_grad(self, g):
+        # hooks apply to each incoming contribution (parity: Tensor hooks in
+        # GradNodeAccumulation, paddle/fluid/eager/accumulation/)
+        for h in self._hooks:
+            out = h(Tensor._from_value(g))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else out
+        if self._grad is None:
+            self._grad = g
+        else:
+            self._grad = self._grad + g
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """Reverse-mode from this tensor (parity: Tensor.backward →
+        egr::Backward, paddle/fluid/pybind/eager_functions.cc:1363)."""
+        _tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    def zero_grad(self):
+        self.clear_grad()
+
+    def register_hook(self, hook):
+        """Hook on gradient accumulation for leaf tensors, or on the tape node
+        cotangent for non-leaves (parity: Tensor.register_hook)."""
+        if self._grad_node is not None:
+            idx = self._out_index
+
+            def node_hook(cots, _idx=idx, _hook=hook):
+                cots = list(cots)
+                res = _hook(Tensor._from_value(cots[_idx]))
+                if res is not None:
+                    cots[_idx] = res._value if isinstance(res, Tensor) else res
+                return tuple(cots)
+
+            self._grad_node._hooks.append(node_hook)
+        else:
+            self._hooks.append(hook)
+        return hook
+
+    def detach(self) -> "Tensor":
+        t = Tensor._from_value(self._value)
+        t.stop_gradient = True
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import creation  # late import
+        from .dispatch import apply_op
+        return apply_op("clone", lambda x: x + 0, (self,))
+
+    # -- dtype / shape sugar (heavy math methods are attached by the op lib) -
+    def astype(self, dtype) -> "Tensor":
+        from .dispatch import apply_op
+        d = _dt.convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(d), (self,))
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def tpu(self):
+        return self
+
+    def cuda(self, *a, **k):  # compatibility shim
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- in-place rebind with tape continuity --------------------------------
+    def _inplace_assign(self, out: "Tensor"):
+        """Rebind this tensor to ``out``'s value/node (paddle inplace-op
+        semantics with version-counter-style tape continuity).
+
+        ``out``'s GradNode may hold *self* as an input edge; replace it with a
+        shadow tensor frozen at the pre-assignment autograd state so the tape
+        has no self-loop."""
+        node = out._grad_node
+        if node is not None:
+            shadow = None
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    if shadow is None:
+                        shadow = Tensor._from_value(self._value)
+                        shadow._grad_node = self._grad_node
+                        shadow._out_index = self._out_index
+                        shadow.stop_gradient = self.stop_gradient
+                        shadow._hooks = self._hooks
+                        if self._grad_node is None and not self.stop_gradient:
+                            # leaf: grads of the pre-assignment value still
+                            # accumulate on this tensor's .grad
+                            shadow._accumulate_grad = \
+                                self._accumulate_grad  # type: ignore
+                    node.inputs[i] = shadow
+        self._value = out._value
+        self._grad_node = node
+        self._out_index = out._out_index
+        if not out.stop_gradient:
+            self.stop_gradient = False
+        return self
+
+    # -- in-place value update (used by optimizers / load) -------------------
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else \
+            jnp.asarray(_default_cast(value))
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._value.shape}")
+        if v.dtype != self._value.dtype:
+            v = v.astype(self._value.dtype)
+        self._value = v
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    # -- distributed metadata (DistTensor parity; set by shard_tensor) -------
+    @property
+    def process_mesh(self):
+        return getattr(self, "_process_mesh", None)
+
+    @property
+    def placements(self):
+        return getattr(self, "_placements", None)
+
+    def is_dist(self) -> bool:
+        return getattr(self, "_process_mesh", None) is not None
+
+    # -- printing ------------------------------------------------------------
+    def __repr__(self):
+        try:
+            val = np.asarray(self._value)
+            body = np.array2string(val, precision=6, threshold=40)
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    __str__ = __repr__
+
+    # -- python protocol -----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

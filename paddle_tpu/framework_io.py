@@ -1,0 +1,78 @@
+"""Serialization: paddle.save / paddle.load.
+
+Parity: python/paddle/framework/io.py:721,960 (reference) — pickled nested
+state structures with tensors serialized as numpy arrays (bfloat16 kept via
+ml_dtypes view round-trip).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tensor container (bfloat16-safe)."""
+
+    def __init__(self, array: np.ndarray, stop_gradient: bool = True):
+        self.dtype_name = array.dtype.name if array.dtype.names is None \
+            else str(array.dtype)
+        if array.dtype == jnp.bfloat16:
+            self.dtype_name = "bfloat16"
+            self.data = array.view(np.uint16)
+        else:
+            self.data = array
+        self.stop_gradient = stop_gradient
+
+    def to_tensor(self) -> Tensor:
+        arr = self.data
+        if self.dtype_name == "bfloat16":
+            arr = arr.view(jnp.bfloat16)
+        t = Tensor(arr)
+        t.stop_gradient = self.stop_gradient
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.to_tensor()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        un = [_unpack(v, return_numpy) for v in obj]
+        return un if isinstance(obj, list) else tuple(un)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save parity (reference python/paddle/framework/io.py:721)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """paddle.load parity (reference python/paddle/framework/io.py:960)."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,175 @@
+"""Op library assembly.
+
+Imports every op family, attaches Tensor methods and python operator
+protocol (the analog of the generated method table + math-op patch in
+paddle/fluid/pybind/eager_op_function.cc and eager_math_op_patch.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from . import registry
+from ._helpers import as_value, wrap
+
+from . import math as math          # noqa: E402
+from . import creation as creation  # noqa: E402
+from . import manipulation as manipulation  # noqa: E402
+from . import reduction as reduction        # noqa: E402
+from . import linalg as linalg      # noqa: E402
+from . import logic as logic        # noqa: E402
+from . import random as random      # noqa: E402
+
+from .registry import registered_ops, get_op  # noqa: F401
+
+# Re-export every registered op at package level.
+for _name, _opdef in registry.registered_ops().items():
+    globals().setdefault(_name, _opdef.fn)
+
+
+# ---------------------------------------------------------------------------
+# Tensor indexing (__getitem__ / __setitem__), incl. Tensor indices.
+# Parity: paddle Tensor indexing (python/paddle/base/variable_index.py).
+# ---------------------------------------------------------------------------
+def _norm_index(item):
+    """Split an index spec into a static template + dynamic tensor operands."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    template = []
+    tensor_args = []
+    for it in item:
+        if isinstance(it, Tensor):
+            if it.ndim == 0:
+                template.append(("static", int(it.item())))
+            elif np.issubdtype(np.asarray(it._value).dtype, np.bool_):
+                template.append(("static", np.asarray(it._value)))
+            else:
+                template.append(("tensor", len(tensor_args)))
+                tensor_args.append(it)
+        elif isinstance(it, (list, np.ndarray)) and not isinstance(it, bool):
+            arr = np.asarray(it)
+            template.append(("static", arr))
+        else:
+            template.append(("static", it))
+    return template, tensor_args
+
+
+def _build_index(template, vals):
+    out = []
+    for kind, payload in template:
+        if kind == "tensor":
+            out.append(vals[payload])
+        else:
+            out.append(payload)
+    return tuple(out)
+
+
+def _getitem(self, item):
+    template, tensor_args = _norm_index(item)
+    has_bool = _index_has_bool(template)
+    if has_bool:
+        # boolean masks produce dynamic shapes: eager host-side path
+        idx = _build_index(template, [np.asarray(t._value)
+                                      for t in tensor_args])
+        return wrap(jnp.asarray(np.asarray(self._value)[idx]))
+
+    def fn(v, *ts):
+        return v[_build_index(template, ts)]
+    return apply_op("getitem", fn, (self, *tensor_args))
+
+
+def _index_has_bool(template):
+    for kind, payload in template:
+        if kind == "static" and isinstance(payload, np.ndarray) \
+                and payload.dtype == np.bool_:
+            return True
+    return False
+
+
+def _setitem(self, item, value):
+    template, tensor_args = _norm_index(item)
+    if _index_has_bool(template):
+        v = np.asarray(self._value).copy()
+        idx = _build_index(template, [np.asarray(t._value)
+                                      for t in tensor_args])
+        v[idx] = np.asarray(as_value(value))
+        self._value = jnp.asarray(v)
+        return
+
+    def fn(v, val, *ts):
+        return v.at[_build_index(template, ts)].set(val)
+    value = value if isinstance(value, Tensor) else as_value(value)
+    out = apply_op("setitem", fn, (self, value, *tensor_args))
+    # in-place rebind with tape continuity (paddle inplace-op semantics)
+    self._inplace_assign(out)
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# ---------------------------------------------------------------------------
+# Python operator protocol.
+# ---------------------------------------------------------------------------
+def _binop(opfn, swap=False):
+    def method(self, other):
+        if swap:
+            return opfn(Tensor(other) if not isinstance(other, Tensor)
+                        else other, self)
+        return opfn(self, other)
+    return method
+
+
+Tensor.__add__ = _binop(math.add)
+Tensor.__radd__ = _binop(math.add, swap=True)
+Tensor.__sub__ = _binop(math.subtract)
+Tensor.__rsub__ = _binop(math.subtract, swap=True)
+Tensor.__mul__ = _binop(math.multiply)
+Tensor.__rmul__ = _binop(math.multiply, swap=True)
+Tensor.__truediv__ = _binop(math.divide)
+Tensor.__rtruediv__ = _binop(math.divide, swap=True)
+Tensor.__floordiv__ = _binop(math.floor_divide)
+Tensor.__rfloordiv__ = _binop(math.floor_divide, swap=True)
+Tensor.__mod__ = _binop(math.mod)
+Tensor.__rmod__ = _binop(math.mod, swap=True)
+Tensor.__pow__ = _binop(math.pow)
+Tensor.__rpow__ = _binop(math.pow, swap=True)
+Tensor.__matmul__ = _binop(linalg.matmul)
+Tensor.__rmatmul__ = _binop(linalg.matmul, swap=True)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__invert__ = lambda self: math.logical_not(self) \
+    if self.dtype == jnp.bool_ else math.bitwise_not(self)
+Tensor.__eq__ = lambda self, other: math.equal(self, other)
+Tensor.__ne__ = lambda self, other: math.not_equal(self, other)
+Tensor.__lt__ = _binop(math.less_than)
+Tensor.__le__ = _binop(math.less_equal)
+Tensor.__gt__ = _binop(math.greater_than)
+Tensor.__ge__ = _binop(math.greater_equal)
+Tensor.__and__ = _binop(math.bitwise_and)
+Tensor.__or__ = _binop(math.bitwise_or)
+Tensor.__xor__ = _binop(math.bitwise_xor)
+Tensor.__lshift__ = _binop(math.bitwise_left_shift)
+Tensor.__rshift__ = _binop(math.bitwise_right_shift)
+
+
+def _inplace_binop(opfn):
+    def method(self, other):
+        return self._inplace_assign(opfn(self, other))
+    return method
+
+
+Tensor.__iadd__ = _inplace_binop(math.add)
+Tensor.__isub__ = _inplace_binop(math.subtract)
+Tensor.__imul__ = _inplace_binop(math.multiply)
+Tensor.__itruediv__ = _inplace_binop(math.divide)
+
+# property-style helpers
+Tensor.T = property(lambda self: manipulation.transpose(self))
+Tensor.mT = property(lambda self: manipulation.swapaxes(self, -1, -2))
+
+registry.attach_tensor_methods(Tensor)

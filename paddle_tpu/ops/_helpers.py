@@ -1,0 +1,89 @@
+"""Shared helpers for op definitions."""
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def as_value(x):
+    """To a jax value with paddle scalar defaults."""
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return x
+    arr = np.asarray(x)
+    if arr.dtype == np.float64:
+        arr = arr.astype(_dt.get_default_dtype())
+    return jnp.asarray(arr)
+
+
+def wrap(v) -> Tensor:
+    return Tensor._from_value(v)
+
+
+def targ(x):
+    """Normalize an apply_op operand: keep Tensors (so autograd sees the
+    edge), convert scalars/lists to jax values with paddle dtype defaults."""
+    return x if isinstance(x, Tensor) else as_value(x)
+
+
+def axis_tuple(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return tuple(int(a) % ndim if ndim else int(a) for a in axis)
+
+
+def def_unary(name: str, jfn: Callable, category="math", method=True,
+              inplace=True, doc: str = ""):
+    """Define a paddle-style unary elementwise op."""
+
+    def op(x, name=None):
+        return apply_op(op.__op_name__, jfn, (x,))
+
+    op.__op_name__ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} (XLA-lowered)."
+    register(name, op, category=category, tensor_method=method,
+             inplace_alias=inplace)
+    return op
+
+
+def def_binary(name: str, jfn: Callable, category="math", method=True,
+               inplace=True, doc: str = ""):
+    """Define a paddle-style binary (broadcasting) op."""
+
+    def op(x, y, name=None):
+        return apply_op(op.__op_name__, jfn, (x, targ(y)))
+
+    op.__op_name__ = name
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting."
+    register(name, op, category=category, tensor_method=method,
+             inplace_alias=inplace)
+    return op
+
+
+def export(module_name: str, names_fns):
+    """Inject generated ops into a module namespace."""
+    mod = sys.modules[module_name]
+    for n, f in names_fns.items():
+        setattr(mod, n, f)

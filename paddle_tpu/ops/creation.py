@@ -1,0 +1,243 @@
+"""Tensor creation ops.
+
+Parity: python/paddle/tensor/creation.py (reference), backed by phi full/...
+kernels.  Here creation is jnp array construction placed via the current
+Place (PJRT device).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor, to_tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap, unwrap, targ
+
+
+def _dtype_or_default(dtype):
+    return _dt.convert_dtype(dtype) if dtype is not None \
+        else _dt.get_default_dtype()
+
+
+@register_op("zeros", category="creation")
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dtype_or_default(dtype)))
+
+
+@register_op("ones", category="creation")
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), _dtype_or_default(dtype)))
+
+
+@register_op("full", category="creation")
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        val = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+        if isinstance(val, bool):
+            d = np.dtype(bool)
+        elif isinstance(val, int):
+            d = np.dtype(np.int64)
+        else:
+            d = _dt.get_default_dtype()
+    else:
+        d = _dt.convert_dtype(dtype)
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return wrap(jnp.full(_shape(shape), fv, d))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item())
+                 for s in shape)
+
+
+@register_op("zeros_like", category="creation", tensor_method=True)
+def zeros_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return wrap(jnp.zeros_like(as_value(x), dtype=d))
+
+
+@register_op("ones_like", category="creation", tensor_method=True)
+def ones_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return wrap(jnp.ones_like(as_value(x), dtype=d))
+
+
+@register_op("full_like", category="creation", tensor_method=True)
+def full_like(x, fill_value, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return wrap(jnp.full_like(as_value(x), fill_value, dtype=d))
+
+
+@register_op("empty", category="creation")
+def empty(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dtype_or_default(dtype)))
+
+
+@register_op("empty_like", category="creation")
+def empty_like(x, dtype=None, name=None):
+    return wrap(jnp.zeros_like(as_value(x), dtype=_dt.convert_dtype(dtype)))
+
+
+@register_op("arange", category="creation")
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    s = start.item() if isinstance(start, Tensor) else start
+    e = end.item() if isinstance(end, Tensor) else end
+    st = step.item() if isinstance(step, Tensor) else step
+    if e is None:
+        s, e = 0, s
+    if dtype is None:
+        dtype = np.int64 if all(
+            isinstance(v, (int, np.integer)) for v in (s, e, st)) \
+            else _dt.get_default_dtype()
+    return wrap(jnp.arange(s, e, st, _dt.convert_dtype(dtype)))
+
+
+@register_op("linspace", category="creation")
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(num),
+                             dtype=_dt.convert_dtype(dtype)))
+
+
+@register_op("logspace", category="creation")
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(num),
+                             base=base, dtype=_dt.convert_dtype(dtype)))
+
+
+@register_op("eye", category="creation")
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(num_rows, num_columns,
+                        dtype=_dtype_or_default(dtype)))
+
+
+@register_op("meshgrid", category="creation")
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply_op("meshgrid",
+                    lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                    args)
+    return list(outs)
+
+
+@register_op("diag", category="creation", tensor_method=True)
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, v.dtype)
+            return out + jnp.diag(v, offset) - jnp.diag(
+                jnp.full((v.shape[0],), padding_value, v.dtype), offset)
+        return jnp.diag(v, offset)
+    return apply_op("diag", fn, (x,))
+
+
+@register_op("diagflat", category="creation", tensor_method=True)
+def diagflat(x, offset=0, name=None):
+    return apply_op("diagflat", lambda v: jnp.diagflat(v, offset), (x,))
+
+
+@register_op("diag_embed", category="creation")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        base = base.at[..., r, c].set(v)
+        perm_needed = (dim1, dim2) != (-2, -1)
+        if perm_needed:
+            base = jnp.moveaxis(base, (-2, -1), (dim1, dim2))
+        return base
+    return apply_op("diag_embed", fn, (x,))
+
+
+@register_op("diagonal", category="creation", tensor_method=True)
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal",
+                    lambda v: jnp.diagonal(v, offset, axis1, axis2), (x,))
+
+
+@register_op("tril", category="creation", tensor_method=True)
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", lambda v: jnp.tril(v, diagonal), (x,))
+
+
+@register_op("triu", category="creation", tensor_method=True)
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", lambda v: jnp.triu(v, diagonal), (x,))
+
+
+@register_op("tril_indices", category="creation")
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), _dt.convert_dtype(dtype)))
+
+
+@register_op("triu_indices", category="creation")
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return wrap(jnp.asarray(np.stack([r, c]), _dt.convert_dtype(dtype)))
+
+
+@register_op("assign", category="creation")
+def assign(x, output=None, name=None):
+    val = as_value(x)
+    if output is not None:
+        output.set_value(val)
+        return output
+    return apply_op("assign", lambda v: v + 0 if jnp.issubdtype(
+        v.dtype, jnp.inexact) else v, (x,)) if isinstance(x, Tensor) \
+        else wrap(val)
+
+
+@register_op("numel", category="creation", tensor_method=False)
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(as_value(x).shape)), jnp.int64))
+
+
+@register_op("one_hot", category="creation")
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        "one_hot",
+        lambda v: jax.nn.one_hot(v, num_classes,
+                                 dtype=_dt.get_default_dtype()), (x,))
+
+
+@register_op("complex", category="creation")
+def complex(real, imag, name=None):
+    return apply_op("complex", jax.lax.complex, (real, targ(imag)))
+
+
+@register_op("as_complex", category="creation", tensor_method=True)
+def as_complex(x, name=None):
+    return apply_op("as_complex",
+                    lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,))
+
+
+@register_op("as_real", category="creation", tensor_method=True)
+def as_real(x, name=None):
+    return apply_op("as_real",
+                    lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1), (x,))
+
+
+@register_op("clone", category="creation", tensor_method=True)
+def clone(x, name=None):
+    return apply_op("clone", lambda v: v + 0 if jnp.issubdtype(
+        v.dtype, jnp.inexact) else v, (x,))
+
+
+@register_op("cast", category="creation")
+def cast(x, dtype, name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op("cast", lambda v: v.astype(d), (x,))

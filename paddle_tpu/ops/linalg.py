@@ -1,0 +1,341 @@
+"""Linear algebra ops.
+
+Parity: python/paddle/tensor/linalg.py (reference), phi matmul/blas kernels
+(paddle/phi/kernels/funcs/blas/).  matmul is THE MXU op — kept big, batched
+and bf16-friendly; decompositions fall back to XLA's LAPACK-style custom
+calls (CPU) / approximations where XLA lacks a TPU lowering.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap, targ
+
+
+@register_op("matmul", category="linalg", tensor_method=True)
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Parity: paddle.matmul (reference call stack SURVEY §3.1;
+    phi::MatmulKernel). Lowered to a single XLA dot_general on the MXU."""
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op("matmul", fn, (x, targ(y)))
+
+
+register("mm", matmul, category="linalg", tensor_method=True,
+         method_name="mm")
+
+
+@register_op("dot", category="linalg", tensor_method=True)
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply_op("dot", fn, (x, targ(y)))
+
+
+@register_op("bmm", category="linalg", tensor_method=True)
+def bmm(x, y, name=None):
+    return apply_op("bmm", jnp.matmul, (x, targ(y)))
+
+
+@register_op("mv", category="linalg", tensor_method=True)
+def mv(x, vec, name=None):
+    return apply_op("mv", jnp.matmul, (x, targ(vec)))
+
+
+@register_op("t", category="linalg", tensor_method=True)
+def t(input, name=None):
+    def fn(v):
+        return v if v.ndim < 2 else jnp.swapaxes(v, 0, 1)
+    return apply_op("t", fn, (input,))
+
+
+@register_op("addmm", category="linalg", tensor_method=True)
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                    (input, targ(x), targ(y)))
+
+
+@register_op("outer", category="linalg", tensor_method=True)
+def outer(x, y, name=None):
+    return apply_op("outer",
+                    lambda a, b: jnp.outer(a, b), (x, targ(y)))
+
+
+@register_op("inner", category="linalg", tensor_method=True)
+def inner(x, y, name=None):
+    return apply_op("inner", jnp.inner, (x, targ(y)))
+
+
+@register_op("cross", category="linalg", tensor_method=True)
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op("cross", fn, (x, targ(y)))
+
+
+@register_op("trace", category="linalg", tensor_method=True)
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace",
+                    lambda v: jnp.trace(v, offset, axis1, axis2), (x,))
+
+
+@register_op("norm", category="linalg", tensor_method=True)
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        pp = p
+        if pp is None:
+            pp = "fro" if axis is None or isinstance(axis, (list, tuple)) \
+                else 2
+        if axis is None:
+            flat = v.reshape(-1)
+            if pp == "fro" or pp == 2:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(flat)))).reshape(
+                    () if not keepdim else (1,) * v.ndim)
+            if pp == np.inf or pp == float("inf"):
+                return jnp.max(jnp.abs(flat))
+            if pp == -np.inf or pp == float("-inf"):
+                return jnp.min(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), pp)), 1.0 / pp)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(v)), axis=ax,
+                                    keepdims=keepdim))
+        if pp in (np.inf, float("inf")):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp in (-np.inf, float("-inf")):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax,
+                           keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), pp), axis=ax,
+                                 keepdims=keepdim), 1.0 / pp)
+    return apply_op("norm", fn, (x,))
+
+
+@register_op("dist", category="linalg", tensor_method=True)
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p in (np.inf, float("inf")):
+            return jnp.max(d)
+        if p in (-np.inf, float("-inf")):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return apply_op("dist", fn, (x, targ(y)))
+
+
+@register_op("einsum", category="linalg")
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op("einsum",
+                    lambda *vs: jnp.einsum(equation, *vs), operands)
+
+
+@register_op("multi_dot", category="linalg")
+def multi_dot(x, name=None):
+    return apply_op("multi_dot",
+                    lambda *vs: jnp.linalg.multi_dot(list(vs)), tuple(x))
+
+
+@register_op("cholesky", category="linalg", tensor_method=True)
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op("cholesky", fn, (x,))
+
+
+@register_op("cholesky_solve", category="linalg", tensor_method=True)
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply_op("cholesky_solve", fn, (x, targ(y)))
+
+
+@register_op("inverse", category="linalg", tensor_method=True)
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, (x,))
+
+
+@register_op("det", category="linalg", tensor_method=True)
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, (x,))
+
+
+@register_op("slogdet", category="linalg", tensor_method=True)
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply_op("slogdet", fn, (x,))
+
+
+@register_op("svd", category="linalg", tensor_method=True)
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        "svd",
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+        (x,))
+
+
+@register_op("qr", category="linalg", tensor_method=True)
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (x,))
+
+
+@register_op("eig", category="linalg", tensor_method=True)
+def eig(x, name=None):
+    v = np.asarray(as_value(x))
+    w, vecs = np.linalg.eig(v)
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(vecs))
+
+
+@register_op("eigh", category="linalg", tensor_method=True)
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh",
+                    lambda v: tuple(jnp.linalg.eigh(v,
+                                                    symmetrize_input=True)),
+                    (x,))
+
+
+@register_op("eigvals", category="linalg", tensor_method=True)
+def eigvals(x, name=None):
+    v = np.asarray(as_value(x))
+    return wrap(jnp.asarray(np.linalg.eigvals(v)))
+
+
+@register_op("eigvalsh", category="linalg", tensor_method=True)
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", jnp.linalg.eigvalsh, (x,))
+
+
+@register_op("matrix_power", category="linalg", tensor_method=True)
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power",
+                    lambda v: jnp.linalg.matrix_power(v, n), (x,))
+
+
+@register_op("matrix_rank", category="linalg", tensor_method=True)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        "matrix_rank",
+        lambda v: jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64),
+        (x,))
+
+
+@register_op("pinv", category="linalg", tensor_method=True)
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv",
+                    lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                              hermitian=hermitian), (x,))
+
+
+@register_op("solve", category="linalg", tensor_method=True)
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, (x, targ(y)))
+
+
+@register_op("triangular_solve", category="linalg", tensor_method=True)
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", fn, (x, targ(y)))
+
+
+@register_op("lstsq", category="linalg", tensor_method=True)
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a = np.asarray(as_value(x))
+    b = np.asarray(as_value(y))
+    sol, res, rank, sv = np.linalg.lstsq(a, b, rcond=rcond)
+    return (wrap(jnp.asarray(sol)), wrap(jnp.asarray(res)),
+            wrap(jnp.asarray(rank)), wrap(jnp.asarray(sv)))
+
+
+@register_op("lu", category="linalg", tensor_method=True)
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle uses 1-based pivots
+    outs = apply_op("lu", fn, (x,))
+    if get_infos:
+        info = wrap(jnp.zeros((), jnp.int32))
+        return outs[0], outs[1], info
+    return outs
+
+
+@register_op("cond", category="linalg", tensor_method=True)
+def cond(x, p=None, name=None):
+    return apply_op("cond_number",
+                    lambda v: jnp.linalg.cond(v, p=p), (x,))
+
+
+@register_op("cov", category="linalg", tensor_method=True)
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = as_value(fweights) if fweights is not None else None
+    aw = as_value(aweights) if aweights is not None else None
+    return apply_op(
+        "cov",
+        lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw), (x,))
+
+
+@register_op("corrcoef", category="linalg", tensor_method=True)
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar),
+                    (x,))
+
+
+@register_op("matrix_exp", category="linalg", tensor_method=True)
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, (x,))
+
+
+@register_op("householder_product", category="linalg")
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[i].set(1.0)
+            h = eye - t[..., i] * jnp.outer(v, v)
+            return q @ h
+        q = eye
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+    return apply_op("householder_product", fn, (x, targ(tau)))
+
+
+@register_op("pca_lowrank", category="linalg")
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    v = np.asarray(as_value(x)).astype(np.float64)
+    qq = q if q is not None else min(6, *v.shape[-2:])
+    if center:
+        v = v - v.mean(axis=-2, keepdims=True)
+    u, s, vt = np.linalg.svd(v, full_matrices=False)
+    return (wrap(jnp.asarray(u[..., :qq].astype(np.float32))),
+            wrap(jnp.asarray(s[..., :qq].astype(np.float32))),
+            wrap(jnp.asarray(np.swapaxes(vt, -1, -2)[..., :qq].astype(
+                np.float32))))

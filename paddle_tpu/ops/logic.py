@@ -1,0 +1,54 @@
+"""Comparison / logic helper ops (beyond the elementwise tables in math.py).
+
+Parity: python/paddle/tensor/logic.py (reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .registry import register_op
+from ._helpers import as_value, wrap, targ
+
+
+@register_op("allclose", category="logic", tensor_method=True)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan),
+        (x, targ(y)))
+
+
+@register_op("isclose", category="logic", tensor_method=True)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan),
+        (x, targ(y)))
+
+
+@register_op("equal_all", category="logic", tensor_method=True)
+def equal_all(x, y, name=None):
+    return apply_op("equal_all",
+                    lambda a, b: jnp.array_equal(a, b), (x, targ(y)))
+
+
+@register_op("is_empty", category="logic", tensor_method=True)
+def is_empty(x, name=None):
+    return wrap(jnp.asarray(as_value(x).size == 0))
+
+
+@register_op("is_tensor", category="logic")
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@register_op("in_dynamic_mode", category="logic")
+def in_dynamic_mode():
+    """Eager is the default mode (parity: paddle.in_dynamic_mode)."""
+    return True

@@ -1,0 +1,588 @@
+"""Shape/layout manipulation + indexing ops.
+
+Parity: python/paddle/tensor/manipulation.py (reference), phi kernels
+reshape/transpose/concat/gather/scatter/....  All lower to XLA reshape /
+transpose / gather / scatter HLO — static shapes keep the MXU tiling happy.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap, unwrap, targ
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+@register_op("reshape", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def reshape(x, shape, name=None):
+    shp = _static_shape(shape)
+    return apply_op("reshape", lambda v: jnp.reshape(v, shp), (x,))
+
+
+view = reshape
+register("view", reshape, category="manipulation", tensor_method=True,
+         method_name="view")
+
+
+@register_op("transpose", category="manipulation", tensor_method=True)
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        perm = list(range(as_value(x).ndim))[::-1]
+    perm = tuple(int(p) for p in perm)
+    return apply_op("transpose", lambda v: jnp.transpose(v, perm), (x,))
+
+
+@register_op("moveaxis", category="manipulation", tensor_method=True)
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis",
+                    lambda v: jnp.moveaxis(v, source, destination), (x,))
+
+
+@register_op("swapaxes", category="manipulation", tensor_method=True)
+def swapaxes(x, axis1, axis2, name=None):
+    return apply_op("swapaxes",
+                    lambda v: jnp.swapaxes(v, axis1, axis2), (x,))
+
+
+register("swapdims", swapaxes, tensor_method=True, method_name="swapdims")
+
+
+@register_op("flatten", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        if nd == 0:
+            return v.reshape(1)
+        s = start_axis % nd
+        e = stop_axis % nd
+        shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return v.reshape(shape)
+    return apply_op("flatten", fn, (x,))
+
+
+@register_op("squeeze", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % v.ndim for a in ax if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, ax) if ax else v
+    return apply_op("squeeze", fn, (x,))
+
+
+@register_op("unsqueeze", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def unsqueeze(x, axis, name=None):
+    def fn(v):
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = v
+        for a in sorted(int(unwrap(a)) if isinstance(a, Tensor) else int(a)
+                        for a in ax):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply_op("unsqueeze", fn, (x,))
+
+
+@register_op("concat", category="manipulation")
+def concat(x, axis=0, name=None):
+    ts = tuple(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda *vs: jnp.concatenate(vs, ax), ts)
+
+
+register("concatenate", concat)
+
+
+@register_op("stack", category="manipulation")
+def stack(x, axis=0, name=None):
+    ts = tuple(x)
+    return apply_op("stack", lambda *vs: jnp.stack(vs, int(axis)), ts)
+
+
+@register_op("split", category="manipulation", tensor_method=True)
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    v = as_value(x)
+    n = v.shape[ax]
+    if isinstance(num_or_sections, int):
+        if n % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis dim {n} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = n - sum(s for s in sizes if s >= 0)
+    idx = np.cumsum(sizes)[:-1].tolist()
+    outs = apply_op("split",
+                    lambda v: tuple(jnp.split(v, idx, ax)), (x,))
+    return list(outs)
+
+
+@register_op("chunk", category="manipulation", tensor_method=True)
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@register_op("unbind", category="manipulation", tensor_method=True)
+def unbind(x, axis=0, name=None):
+    v = as_value(x)
+    n = v.shape[axis]
+    outs = apply_op(
+        "unbind",
+        lambda v: tuple(jnp.squeeze(s, axis)
+                        for s in jnp.split(v, n, axis)), (x,))
+    return list(outs)
+
+
+register("unstack", unbind)
+
+
+@register_op("tile", category="manipulation", tensor_method=True)
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply_op("tile", lambda v: jnp.tile(v, reps), (x,))
+
+
+@register_op("expand", category="manipulation", tensor_method=True)
+def expand(x, shape, name=None):
+    shp = _static_shape(shape)
+    def fn(v):
+        tgt = tuple(v.shape[i - (len(shp) - v.ndim)] if s == -1 else s
+                    for i, s in enumerate(shp))
+        return jnp.broadcast_to(v, tgt)
+    return apply_op("expand", fn, (x,))
+
+
+@register_op("expand_as", category="manipulation", tensor_method=True)
+def expand_as(x, y, name=None):
+    tgt = tuple(as_value(y).shape)
+    return apply_op("expand_as", lambda v: jnp.broadcast_to(v, tgt), (x,))
+
+
+@register_op("broadcast_to", category="manipulation", tensor_method=True)
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("broadcast_tensors", category="manipulation")
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op("broadcast_tensors",
+                    lambda *vs: tuple(jnp.broadcast_arrays(*vs)),
+                    tuple(inputs))
+    return list(outs)
+
+
+@register_op("flip", category="manipulation", tensor_method=True)
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("flip", lambda v: jnp.flip(v, ax), (x,))
+
+
+@register_op("rot90", category="manipulation", tensor_method=True)
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda v: jnp.rot90(v, k, axes), (x,))
+
+
+@register_op("roll", category="manipulation", tensor_method=True)
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda v: jnp.roll(v, shifts, axis), (x,))
+
+
+@register_op("gather", category="manipulation", tensor_method=True)
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    def fn(v, idx):
+        idx = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(v, idx, axis=ax)
+    return apply_op("gather", fn, (x, targ(index)))
+
+
+@register_op("gather_nd", category="manipulation", tensor_method=True)
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op("gather_nd", fn, (x, targ(index)))
+
+
+@register_op("scatter", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return v.at[idx].set(upd)
+        # paddle semantics: zero the rows then accumulate
+        zeroed = v.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply_op("scatter", fn, (x, targ(index), targ(updates)))
+
+
+@register_op("scatter_nd_add", category="manipulation", tensor_method=True)
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, idx, upd):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_op("scatter_nd_add", fn,
+                    (x, targ(index), targ(updates)))
+
+
+@register_op("scatter_nd", category="manipulation")
+def scatter_nd(index, updates, shape, name=None):
+    shp = _static_shape(shape)
+    def fn(idx, upd):
+        return jnp.zeros(shp, upd.dtype).at[
+            tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_op("scatter_nd", fn, (targ(index), targ(updates)))
+
+
+@register_op("index_select", category="manipulation", tensor_method=True)
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select",
+                    lambda v, i: jnp.take(v, i, axis=int(axis)),
+                    (x, targ(index)))
+
+
+@register_op("index_sample", category="manipulation", tensor_method=True)
+def index_sample(x, index, name=None):
+    return apply_op("index_sample",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=1),
+                    (x, targ(index)))
+
+
+@register_op("index_add", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def index_add(x, index, axis, value, name=None):
+    def fn(v, idx, val):
+        moved = jnp.moveaxis(v, axis, 0)
+        val_m = jnp.moveaxis(val, axis, 0)
+        out = moved.at[idx].add(val_m)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_add", fn, (x, targ(index), targ(value)))
+
+
+@register_op("index_put", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(targ(i) for i in indices)
+    def fn(v, val, *idx):
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(val)
+    return apply_op("index_put", fn, (x, targ(value), *idxs))
+
+
+@register_op("take_along_axis", category="manipulation", tensor_method=True)
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return apply_op("take_along_axis",
+                    lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    (x, targ(indices)))
+
+
+@register_op("put_along_axis", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def fn(v, idx, val):
+        val = jnp.broadcast_to(val, idx.shape) if broadcast else val
+        if reduce == "assign":
+            return jnp.put_along_axis(v, idx, val, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amin": "min", "amax": "max"}[reduce]
+        moved_idx = [jnp.arange(s).reshape(
+            [-1 if i == d else 1 for i in range(v.ndim)])
+            for d, s in enumerate(idx.shape)]
+        moved_idx[axis] = idx
+        at = v.at[tuple(moved_idx)]
+        return {"add": at.add, "multiply": at.multiply,
+                "min": at.min, "max": at.max}[mode](val)
+    return apply_op("put_along_axis", fn,
+                    (x, targ(indices), targ(values)))
+
+
+@register_op("take", category="manipulation", tensor_method=True)
+def take(x, index, mode="raise", name=None):
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply_op("take",
+                    lambda v, i: jnp.take(v.reshape(-1), i.reshape(-1),
+                                          mode=m).reshape(i.shape),
+                    (x, targ(index)))
+
+
+@register_op("masked_select", category="manipulation", tensor_method=True)
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: the mask is concretized on host (eager only), but
+    # the gather itself stays on the tape so gradients flow.
+    m = np.broadcast_to(np.asarray(as_value(mask)),
+                        tuple(as_value(x).shape))
+    idx = tuple(jnp.asarray(i) for i in np.nonzero(m))
+    return apply_op("masked_select", lambda v, *ii: v[ii], (x, *idx))
+
+
+@register_op("masked_fill", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def masked_fill(x, mask, value, name=None):
+    return apply_op("masked_fill",
+                    lambda v, m, val: jnp.where(m, val, v),
+                    (x, targ(mask), targ(value)))
+
+
+@register_op("masked_scatter", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def masked_scatter(x, mask, value, name=None):
+    v = np.asarray(as_value(x)).copy()
+    m = np.asarray(as_value(mask))
+    m = np.broadcast_to(m, v.shape)
+    vals = np.asarray(as_value(value)).reshape(-1)
+    v[m] = vals[: int(m.sum())]
+    return wrap(jnp.asarray(v))
+
+
+@register_op("where", category="manipulation", tensor_method=True)
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", jnp.where,
+                    (targ(condition), targ(x), targ(y)))
+
+
+@register_op("nonzero", category="manipulation", tensor_method=True)
+def nonzero(x, as_tuple=False, name=None):
+    v = np.asarray(as_value(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i[:, None], jnp.int64)) for i in nz)
+    return wrap(jnp.asarray(np.stack(nz, -1), jnp.int64))
+
+
+@register_op("sort", category="manipulation", tensor_method=True)
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable or True)
+        return jnp.flip(out, axis) if descending else out
+    return apply_op("sort", fn, (x,))
+
+
+@register_op("argsort", category="manipulation", tensor_method=True)
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.argsort(v, axis=axis, stable=True)
+        return jnp.flip(out, axis) if descending else out
+    return apply_op("argsort", fn, (x,))
+
+
+@register_op("topk", category="manipulation", tensor_method=True)
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    def fn(v):
+        ax = axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op("topk", fn, (x,))
+
+
+@register_op("searchsorted", category="manipulation")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    return apply_op(
+        "searchsorted",
+        lambda s, v: jnp.searchsorted(s, v, side=side).astype(d),
+        (targ(sorted_sequence), targ(values)))
+
+
+@register_op("bucketize", category="manipulation", tensor_method=True)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+@register_op("unique", category="manipulation", tensor_method=True)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(as_value(x))
+    res = np.unique(v, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return wrap(jnp.asarray(res))
+    outs = [wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+@register_op("unique_consecutive", category="manipulation", tensor_method=True)
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    v = np.asarray(as_value(x))
+    if axis is None:
+        v = v.reshape(-1)
+        change = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = v[change]
+    rets = [wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        rets.append(wrap(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        counts = np.diff(np.concatenate([idx, [len(v)]]))
+        rets.append(wrap(jnp.asarray(counts, np.int64)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+@register_op("repeat_interleave", category="manipulation", tensor_method=True)
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        r = np.asarray(repeats.numpy())
+        v = np.asarray(as_value(x))
+        return wrap(jnp.asarray(np.repeat(v, r, axis=axis)))
+    return apply_op("repeat_interleave",
+                    lambda v: jnp.repeat(v, repeats, axis=axis), (x,))
+
+
+@register_op("pad", category="manipulation")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
+    pad_list = _static_shape(pad) if not isinstance(pad, (list, tuple)) \
+        else [int(p.item()) if isinstance(p, Tensor) else int(p) for p in pad]
+
+    def fn(v):
+        nd = v.ndim
+        if len(pad_list) == 2 * nd:
+            # paddle "every dim" form: [d0_l, d0_r, d1_l, d1_r, ...]
+            pairs = [(pad_list[2 * i], pad_list[2 * i + 1])
+                     for i in range(nd)]
+        else:
+            # NCHW/NCDHW spatial form: pads applied to trailing spatial dims,
+            # ordered last-dim-first like the reference.
+            k = len(pad_list) // 2
+            pairs = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial = list(range(2, 2 + k))
+            else:
+                spatial = list(range(1, 1 + k))
+            for i, d in enumerate(reversed(spatial)):
+                pairs[d] = (pad_list[2 * i], pad_list[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode=jmode, constant_values=value)
+        return jnp.pad(v, pairs, mode=jmode)
+    return apply_op("pad", fn, (x,))
+
+
+@register_op("slice", category="manipulation")
+def slice(input, axes, starts, ends, name=None):
+    def fn(v):
+        sl = [np.s_[:]] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            sl[ax] = np.s_[s:e]
+        return v[tuple(sl)]
+    return apply_op("slice", fn, (input,))
+
+
+@register_op("strided_slice", category="manipulation")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        sl = [np.s_[:]] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = np.s_[int(s):int(e):int(st)]
+        return v[tuple(sl)]
+    return apply_op("strided_slice", fn, (x,))
+
+
+@register_op("crop", category="manipulation")
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _static_shape(shape)
+    offs = _static_shape(offsets) if offsets is not None else (0,) * len(shp)
+    def fn(v):
+        sl = tuple(np.s_[o:o + (s if s != -1 else v.shape[i] - o)]
+                   for i, (o, s) in enumerate(zip(offs, shp)))
+        return v[sl]
+    return apply_op("crop", fn, (x,))
+
+
+@register_op("shard_index", category="manipulation")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Parity: paddle.shard_index (used by distributed embedding)."""
+    size = (index_num + nshards - 1) // nshards
+    def fn(v):
+        shard = v // size
+        local = v % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return apply_op("shard_index", fn, (input,))
+
+
+@register_op("kron", category="manipulation", tensor_method=True)
+def kron(x, y, name=None):
+    return apply_op("kron", jnp.kron, (x, targ(y)))
+
+
+@register_op("view_as", category="manipulation", tensor_method=True)
+def view_as(x, other, name=None):
+    shp = tuple(as_value(other).shape)
+    return apply_op("view_as", lambda v: v.reshape(shp), (x,))
+
+
+@register_op("as_strided", category="manipulation", tensor_method=True)
+def as_strided(x, shape, stride, offset=0, name=None):
+    v = np.asarray(as_value(x))
+    itemsize = v.itemsize
+    out = np.lib.stride_tricks.as_strided(
+        v.reshape(-1)[offset:], shape,
+        [s * itemsize for s in stride])
+    return wrap(jnp.asarray(out.copy()))
+
+
+@register_op("tensordot", category="manipulation")
+def tensordot(x, y, axes=2, name=None):
+    return apply_op("tensordot",
+                    lambda a, b: jnp.tensordot(a, b, axes),
+                    (x, targ(y)))
+
+
+@register_op("atleast_1d", category="manipulation")
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_2d", category="manipulation")
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("atleast_3d", category="manipulation")
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, (t,)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs

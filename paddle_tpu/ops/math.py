@@ -1,0 +1,273 @@
+"""Elementwise math ops.
+
+Parity: reference kernels in paddle/phi/kernels/ (activation_kernel.cc,
+elementwise_*_kernel.cc), Python surface python/paddle/tensor/math.py and
+python/paddle/tensor/ops.py.  Every op is a pure function over jax arrays,
+lowered/fused by XLA — on TPU these fuse into neighboring matmuls instead of
+being standalone CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jspecial
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register, register_op
+from ._helpers import def_unary, def_binary, as_value, unwrap, wrap, targ
+
+# ---------------------------------------------------------------------------
+# unary table
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "asin": jnp.arcsin,
+    "atan": jnp.arctan,
+    "acosh": jnp.arccosh,
+    "asinh": jnp.arcsinh,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "tan": jnp.tan,
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "square": jnp.square,
+    "reciprocal": jnp.reciprocal,
+    "sign": jnp.sign,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jspecial.erfinv,
+    "sigmoid": jax.nn.sigmoid,
+    "lgamma": jspecial.gammaln,
+    "digamma": jspecial.digamma,
+    "i0": lambda x: jspecial.i0(x),
+    "i0e": lambda x: jspecial.i0e(x),
+    "i1": lambda x: jspecial.i1(x),
+    "i1e": lambda x: jspecial.i1e(x),
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "neg": jnp.negative,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+}
+for _n, _f in _UNARY.items():
+    globals()[_n] = def_unary(_n, _f)
+
+# non-differentiable predicates (no tape: bool outputs)
+_UNARY_PRED = {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "isneginf": jnp.isneginf,
+    "isposinf": jnp.isposinf,
+    "isreal": jnp.isreal,
+    "signbit": jnp.signbit,
+}
+for _n, _f in _UNARY_PRED.items():
+    globals()[_n] = def_unary(_n, _f, category="logic", inplace=False)
+
+
+@register_op("round", category="math", tensor_method=True, inplace_alias=True)
+def round(x, decimals=0, name=None):
+    return apply_op("round", lambda v: jnp.round(v, decimals), (x,))
+
+
+# ---------------------------------------------------------------------------
+# binary table
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "remainder": jnp.remainder,
+    "floor_mod": jnp.mod,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp,
+    "hypot": jnp.hypot,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "heaviside": jnp.heaviside,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "polygamma": lambda x, n: jspecial.polygamma(n, x),
+}
+for _n, _f in _BINARY.items():
+    globals()[_n] = def_binary(_n, _f)
+
+_BINARY_PRED = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_left_shift": jnp.left_shift,
+    "bitwise_right_shift": jnp.right_shift,
+}
+for _n, _f in _BINARY_PRED.items():
+    globals()[_n] = def_binary(_n, _f, category="logic", inplace=False)
+
+globals()["logical_not"] = def_unary("logical_not", jnp.logical_not,
+                                     category="logic", inplace=False)
+globals()["bitwise_not"] = def_unary("bitwise_not", jnp.bitwise_not,
+                                     category="logic", inplace=False)
+
+
+@register_op("pow", category="math", tensor_method=True, inplace_alias=True)
+def pow(x, y, name=None):
+    return apply_op("pow", jnp.power, (x, targ(y)))
+
+
+@register_op("scale", category="math", tensor_method=True, inplace_alias=True)
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Parity: paddle.scale (phi scale kernel)."""
+    def fn(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    return apply_op("scale", fn, (x, targ(scale), targ(bias)))
+
+
+@register_op("clip", category="math", tensor_method=True, inplace_alias=True)
+def clip(x, min=None, max=None, name=None):
+    def fn(v, lo, hi):
+        return jnp.clip(v, lo, hi)
+    lo = as_value(min) if min is not None else None
+    hi = as_value(max) if max is not None else None
+    return apply_op("clip", lambda v: jnp.clip(v, lo, hi), (x,))
+
+
+clamp = clip
+register("clamp", clip, category="math", tensor_method=True,
+         method_name="clamp")
+
+
+@register_op("stanh", category="math", tensor_method=True)
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh",
+                    lambda v: scale_b * jnp.tanh(scale_a * v), (x,))
+
+
+@register_op("multiplex", category="math")
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+            axis=0)[0]
+    return apply_op("multiplex", fn, (index.flatten(), *inputs))
+
+
+@register_op("add_n", category="math")
+def add_n(inputs, name=None):
+    """Parity: paddle.add_n (sum_op)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    def fn(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+    return apply_op("add_n", fn, tuple(inputs))
+
+
+@register_op("nan_to_num", category="math", tensor_method=True,
+             inplace_alias=True)
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num",
+                    lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                             neginf=neginf), (x,))
+
+
+@register_op("lerp", category="math", tensor_method=True, inplace_alias=True)
+def lerp(x, y, weight, name=None):
+    return apply_op("lerp", lambda a, b, w: a + w * (b - a),
+                    (x, targ(y), targ(weight)))
+
+
+@register_op("logit", category="math", tensor_method=True)
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return apply_op("logit", fn, (x,))
+
+
+@register_op("log_normalize", category="math")
+def log_normalize(x, axis=-1, name=None):
+    return apply_op("log_normalize",
+                    lambda v: v - jspecial.logsumexp(v, axis=axis,
+                                                     keepdims=True), (x,))
+
+
+@register_op("real", category="math", tensor_method=True)
+def real(x, name=None):
+    return apply_op("real", jnp.real, (x,))
+
+
+@register_op("imag", category="math", tensor_method=True)
+def imag(x, name=None):
+    return apply_op("imag", jnp.imag, (x,))
+
+
+@register_op("diff", category="math", tensor_method=True)
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def fn(v, *extra):
+        i = 0
+        pre = post = None
+        if prepend is not None:
+            pre = extra[i]; i += 1
+        if append is not None:
+            post = extra[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=post)
+    return apply_op("diff", fn, tuple(args))
+
+
+@register_op("trapezoid", category="math")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op("trapezoid",
+                        lambda yy, xx: jax.scipy.integrate.trapezoid(
+                            yy, xx, axis=axis), (y, targ(x)))
+    d = 1.0 if dx is None else dx
+    return apply_op("trapezoid",
+                    lambda yy: jax.scipy.integrate.trapezoid(
+                        yy, dx=d, axis=axis), (y,))

@@ -1,0 +1,256 @@
+"""Random ops + generator state.
+
+Parity: reference per-device Philox generator (paddle/phi/core/generator.h)
+and python/paddle/tensor/random.py.  TPU-native design: JAX threefry keys.
+A process-global Generator holds the current key and splits per call (eager).
+Inside a trace, randomness must be functional: `trace_rng_scope` installs a
+traced base key (to_static threads a fresh seed in as a step input, so each
+compiled step gets new randomness without retracing — the analog of the
+reference feeding a seed/offset into each curand kernel launch).
+
+Parallel RNG (per-mesh-rank seeds, reference
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py)
+is built on fold_in over mesh coordinates in paddle_tpu.distributed.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap
+
+
+class Generator:
+    """Splittable RNG state (reference: paddle/phi/core/generator.h)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return wrap(self._key)
+
+    def set_state(self, state):
+        self._key = as_value(state)
+
+
+_GLOBAL_GENERATOR = Generator(0)
+
+# Trace-scope key stack: when non-empty, random ops consume splits of the
+# traced key instead of the global generator.
+class _TraceRng(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_trace_rng = _TraceRng()
+
+
+@contextlib.contextmanager
+def trace_rng_scope(base_key):
+    """Install a (possibly traced) base key for functional randomness."""
+    state = {"key": base_key}
+    _trace_rng.stack.append(state)
+    try:
+        yield
+    finally:
+        _trace_rng.stack.pop()
+
+
+def default_generator() -> Generator:
+    return _GLOBAL_GENERATOR
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed parity."""
+    return _GLOBAL_GENERATOR.manual_seed(value)
+
+
+def get_rng_state():
+    return [_GLOBAL_GENERATOR.get_state()]
+
+
+def set_rng_state(state_list):
+    _GLOBAL_GENERATOR.set_state(state_list[0])
+
+
+def next_key():
+    """Next RNG key — trace-aware."""
+    if _trace_rng.stack:
+        st = _trace_rng.stack[-1]
+        st["key"], sub = jax.random.split(st["key"])
+        return sub
+    return _GLOBAL_GENERATOR.next_key()
+
+
+def _float_dtype(dtype):
+    return _dt.convert_dtype(dtype) if dtype is not None \
+        else _dt.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+@register_op("rand", category="random")
+def rand(shape, dtype=None, name=None):
+    return wrap(jax.random.uniform(next_key(), _shape(shape),
+                                   _float_dtype(dtype)))
+
+
+@register_op("randn", category="random")
+def randn(shape, dtype=None, name=None):
+    return wrap(jax.random.normal(next_key(), _shape(shape),
+                                  _float_dtype(dtype)))
+
+
+@register_op("standard_normal", category="random")
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@register_op("normal", category="random")
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_value(mean)
+        s = as_value(std)
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, "shape") else (),
+            s.shape if hasattr(s, "shape") else ())
+        return wrap(jax.random.normal(next_key(), shp,
+                                      _dt.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return wrap(jax.random.normal(next_key(), shp,
+                                  _dt.get_default_dtype()) * std + mean)
+
+
+@register_op("uniform", category="random")
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), _float_dtype(dtype),
+                                   minval=min, maxval=max))
+
+
+@register_op("randint", category="random")
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(next_key(), _shape(shape), low, high,
+                                   _dt.convert_dtype(dtype)))
+
+
+@register_op("randint_like", category="random")
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = as_value(x)
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype) if dtype else v.dtype
+    return wrap(jax.random.randint(next_key(), v.shape, low, high, d))
+
+
+@register_op("randperm", category="random")
+def randperm(n, dtype="int64", name=None):
+    return wrap(jax.random.permutation(next_key(), n).astype(
+        _dt.convert_dtype(dtype)))
+
+
+@register_op("bernoulli", category="random", tensor_method=True)
+def bernoulli(x, name=None):
+    v = as_value(x)
+    return wrap(jax.random.bernoulli(next_key(), v).astype(v.dtype))
+
+
+@register_op("bernoulli_", category="random")
+def bernoulli_(x, p=0.5, name=None):
+    v = as_value(x)
+    x._value = jax.random.bernoulli(next_key(), p, v.shape).astype(v.dtype)
+    return x
+
+
+@register_op("poisson", category="random", tensor_method=True)
+def poisson(x, name=None):
+    v = as_value(x)
+    return wrap(jax.random.poisson(next_key(), v).astype(v.dtype))
+
+
+@register_op("multinomial", category="random", tensor_method=True)
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = as_value(x)
+    p = v / jnp.sum(v, axis=-1, keepdims=True)
+    if v.ndim == 1:
+        out = jax.random.choice(next_key(), v.shape[0], (num_samples,),
+                                replace=replacement, p=p)
+    else:
+        keys = jax.random.split(next_key(), v.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, v.shape[-1], (num_samples,),
+                              replace=replacement, p=p[i])
+            for i, k in enumerate(keys)])
+    return wrap(out.astype(jnp.int64))
+
+
+@register_op("exponential_", category="random")
+def exponential_(x, lam=1.0, name=None):
+    v = as_value(x)
+    x._value = (jax.random.exponential(next_key(), v.shape, v.dtype) /
+                lam).astype(v.dtype)
+    return x
+
+
+@register_op("normal_", category="random")
+def normal_(x, mean=0.0, std=1.0, name=None):
+    v = as_value(x)
+    x._value = (jax.random.normal(next_key(), v.shape, v.dtype) * std +
+                mean).astype(v.dtype)
+    return x
+
+
+@register_op("uniform_", category="random")
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    v = as_value(x)
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    x._value = jax.random.uniform(key, v.shape, v.dtype, min, max)
+    return x
+
+
+@register_op("rand_like", category="random")
+def rand_like(x, dtype=None, name=None):
+    v = as_value(x)
+    d = _dt.convert_dtype(dtype) if dtype else v.dtype
+    return wrap(jax.random.uniform(next_key(), v.shape, d))
+
+
+@register_op("randn_like", category="random")
+def randn_like(x, dtype=None, name=None):
+    v = as_value(x)
+    d = _dt.convert_dtype(dtype) if dtype else v.dtype
+    return wrap(jax.random.normal(next_key(), v.shape, d))

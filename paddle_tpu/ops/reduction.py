@@ -1,0 +1,306 @@
+"""Reduction / scan / statistics ops.
+
+Parity: python/paddle/tensor/math.py + stat.py (reference), phi reduce
+kernels.  XLA lowers these to tiled tree-reductions on the VPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jspecial
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap, targ
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _def_reduce(name, jfn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        d = _dt.convert_dtype(dtype) if dtype else None
+
+        def fn(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            if d is not None:
+                out = out.astype(d)
+            elif int_promote and jnp.issubdtype(v.dtype, jnp.integer):
+                out = out.astype(jnp.int64)
+            return out
+        return apply_op(op.__op_name__, fn, (x,))
+
+    op.__op_name__ = name
+    op.__name__ = name
+    register(name, op, category="reduction", tensor_method=True)
+    return op
+
+
+sum = _def_reduce("sum", jnp.sum, int_promote=True)
+mean = _def_reduce("mean", jnp.mean)
+prod = _def_reduce("prod", jnp.prod, int_promote=True)
+nansum = _def_reduce("nansum", jnp.nansum, int_promote=True)
+nanmean = _def_reduce("nanmean", jnp.nanmean)
+amax = _def_reduce("amax", jnp.amax)
+amin = _def_reduce("amin", jnp.amin)
+
+
+def _def_minmax(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _norm_axis(axis)
+        return apply_op(op.__op_name__,
+                        lambda v: jfn(v, axis=ax, keepdims=keepdim), (x,))
+    op.__op_name__ = name
+    op.__name__ = name
+    register(name, op, category="reduction", tensor_method=True)
+    return op
+
+
+max = _def_minmax("max", jnp.max)
+min = _def_minmax("min", jnp.min)
+
+
+@register_op("all", category="reduction", tensor_method=True)
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("all", lambda v: jnp.all(v, axis=ax, keepdims=keepdim),
+                    (x,))
+
+
+@register_op("any", category="reduction", tensor_method=True)
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op("any", lambda v: jnp.any(v, axis=ax, keepdims=keepdim),
+                    (x,))
+
+
+@register_op("argmax", category="reduction", tensor_method=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(
+        "argmax",
+        lambda v: jnp.argmax(v, axis=axis, keepdims=keepdim).astype(d), (x,))
+
+
+@register_op("argmin", category="reduction", tensor_method=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(
+        "argmin",
+        lambda v: jnp.argmin(v, axis=axis, keepdims=keepdim).astype(d), (x,))
+
+
+@register_op("cumsum", category="reduction", tensor_method=True)
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            out = jnp.cumsum(v)
+        else:
+            out = jnp.cumsum(v, axis=axis)
+        return out.astype(d) if d else out
+    return apply_op("cumsum", fn, (x,))
+
+
+@register_op("cumprod", category="reduction", tensor_method=True)
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    def fn(v):
+        out = jnp.cumprod(v.reshape(-1) if dim is None else v,
+                          axis=None if dim is None else dim)
+        return out.astype(d) if d else out
+    return apply_op("cumprod", fn, (x,))
+
+
+@register_op("cummax", category="reduction", tensor_method=True)
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        idx = jnp.where(vv == vals, jnp.arange(vv.shape[ax]).reshape(
+            [-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)]), 0)
+        idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+    return apply_op("cummax", fn, (x,))
+
+
+@register_op("cummin", category="reduction", tensor_method=True)
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        idx = jnp.where(vv == vals, jnp.arange(vv.shape[ax]).reshape(
+            [-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)]), 0)
+        idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+    return apply_op("cummin", fn, (x,))
+
+
+@register_op("logsumexp", category="reduction", tensor_method=True)
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        "logsumexp",
+        lambda v: jspecial.logsumexp(v, axis=ax, keepdims=keepdim), (x,))
+
+
+@register_op("logcumsumexp", category="reduction", tensor_method=True)
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        return jax.lax.cumlogsumexp(vv, axis=ax)
+    return apply_op("logcumsumexp", fn, (x,))
+
+
+@register_op("std", category="reduction", tensor_method=True)
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        "std", lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim),
+        (x,))
+
+
+@register_op("var", category="reduction", tensor_method=True)
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(
+        "var", lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim),
+        (x,))
+
+
+@register_op("median", category="reduction", tensor_method=True)
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=axis, keepdims=keepdim)
+        # min mode: lower median + its index
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        n = vv.shape[ax]
+        k = (n - 1) // 2
+        srt = jnp.sort(vv, axis=ax)
+        arg = jnp.argsort(vv, axis=ax)
+        vals = jnp.take(srt, k, axis=ax)
+        idxs = jnp.take(arg, k, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idxs = jnp.expand_dims(idxs, ax)
+        return vals, idxs.astype(jnp.int64)
+    return apply_op("median", fn, (x,))
+
+
+@register_op("nanmedian", category="reduction", tensor_method=True)
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian",
+                    lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                    (x,))
+
+
+@register_op("quantile", category="reduction", tensor_method=True)
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return apply_op(
+        "quantile",
+        lambda v: jnp.quantile(v, jnp.asarray(q), axis=axis,
+                               keepdims=keepdim, method=interpolation), (x,))
+
+
+@register_op("nanquantile", category="reduction", tensor_method=True)
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        "nanquantile",
+        lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=axis,
+                                  keepdims=keepdim), (x,))
+
+
+@register_op("kthvalue", category="reduction", tensor_method=True)
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        srt = jnp.sort(v, axis=ax)
+        arg = jnp.argsort(v, axis=ax)
+        vals = jnp.take(srt, k - 1, axis=ax)
+        idxs = jnp.take(arg, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idxs = jnp.expand_dims(idxs, ax)
+        return vals, idxs.astype(jnp.int64)
+    return apply_op("kthvalue", fn, (x,))
+
+
+@register_op("mode", category="reduction", tensor_method=True)
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(as_value(x))
+    ax = axis % v.ndim
+    moved = np.moveaxis(v, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = moved.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(idxs))
+
+
+@register_op("count_nonzero", category="reduction", tensor_method=True)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply_op(
+        "count_nonzero",
+        lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim).astype(
+            jnp.int64), (x,))
+
+
+@register_op("histogram", category="reduction", tensor_method=True)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    v = np.asarray(as_value(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+    w = np.asarray(as_value(weight)) if weight is not None else None
+    hist, _ = np.histogram(v, bins=bins, range=(lo, hi), weights=w,
+                           density=density)
+    return wrap(jnp.asarray(hist if density else hist.astype(np.int64)))
+
+
+@register_op("histogramdd", category="reduction")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    v = np.asarray(as_value(x))
+    w = np.asarray(as_value(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return wrap(jnp.asarray(hist)), [wrap(jnp.asarray(e)) for e in edges]
+
+
+@register_op("bincount", category="reduction", tensor_method=True)
+def bincount(x, weights=None, minlength=0, name=None):
+    v = np.asarray(as_value(x))
+    w = np.asarray(as_value(weights)) if weights is not None else None
+    return wrap(jnp.asarray(np.bincount(v, w, minlength)))

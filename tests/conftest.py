@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference strategy of running distributed tests multi-process on
+localhost without real accelerators (SURVEY.md §4, test/legacy_test/
+test_dist_base.py) — here a single process with 8 virtual XLA CPU devices.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,150 @@
+"""Autograd engine tests (reference analog: test/legacy_test/ backward tests,
+paddle/fluid/eager/backward.cc semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_chain_and_shared_input():
+    w = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    b = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    out = (w * b + b).sum()
+    out.backward()
+    np.testing.assert_allclose(w.grad.numpy(), [1.0, 1.0])
+    np.testing.assert_allclose(b.grad.numpy(), [3.0, 4.0])
+
+
+def test_matmul_grad():
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.to_tensor(np.random.rand(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    z = paddle.matmul(x, y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.ones((3, 5)) @ y.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(y.grad.numpy(),
+                               x.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), y.numpy())
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), d.numpy())
+
+
+def test_grad_api():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (g,) = [paddle.grad(y, x)] if not isinstance(paddle.grad(
+        (x ** 3).sum(), x), list) else paddle.grad((x ** 3).sum(), x)
+    # paddle.grad returns single tensor for single input
+    g = paddle.grad((x ** 3).sum(), x)
+    np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2, rtol=1e-5)
+    assert x.grad is None  # .grad not polluted
+
+
+def test_grad_intermediate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    z = (y * y).sum()
+    gy = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), 2 * 3 * x.numpy())
+
+
+def test_accumulation_and_clear():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    assert x.grad.item() == 5.0
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_retain_graph_error():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x
+    loss = y.sum()
+    loss.backward()
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_backward_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 5).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0, 5.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_multi_output_grad():
+    x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.ones([2]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_functional_jacobian():
+    from paddle_tpu.autograd import jacobian
+    x = paddle.to_tensor([1.0, 2.0])
+    J = jacobian(lambda v: (v ** 2).sum(), x)
+    np.testing.assert_allclose(J.numpy(), 2 * x.numpy())

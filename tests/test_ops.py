@@ -1,0 +1,192 @@
+"""Op correctness vs numpy reference, eager + jit (reference analog:
+test/legacy_test/test_*_op.py via the OpTest harness)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_output_jit, check_grad
+
+RNG = np.random.RandomState(42)
+
+
+UNARY_CASES = [
+    ("tanh", np.tanh), ("exp", np.exp), ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("sqrt", lambda x: np.sqrt(np.abs(x) + 1)), ("abs", np.abs),
+    ("log", lambda x: np.log(np.abs(x) + 1)),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary(name, ref):
+    x = RNG.randn(3, 4).astype(np.float32)
+    if name == "sqrt":
+        op = lambda x: paddle.sqrt(paddle.abs(x) + 1)
+    elif name == "log":
+        op = lambda x: paddle.log(paddle.abs(x) + 1)
+    else:
+        op = getattr(paddle, name)
+    check_output(op, lambda x: ref(x), {"x": x})
+    check_output_jit(op, lambda x: ref(x), {"x": x})
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("maximum", np.maximum), ("minimum", np.minimum),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary(name, ref):
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(3, 4).astype(np.float32)
+    check_output(getattr(paddle, name), lambda x, y: ref(x, y),
+                 {"x": x, "y": y})
+
+
+def test_binary_broadcast():
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(4).astype(np.float32)
+    check_output(paddle.add, lambda x, y: np.add(x, y), {"x": x, "y": y})
+
+
+def test_matmul():
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+    y = RNG.randn(2, 4, 5).astype(np.float32)
+    check_output(paddle.matmul, lambda x, y: np.matmul(x, y), {"x": x, "y": y}, rtol=1e-4)
+    check_grad(paddle.matmul, {"x": RNG.randn(2, 3).astype(np.float32),
+                               "y": RNG.randn(3, 2).astype(np.float32)},
+               ["x", "y"])
+
+
+def test_matmul_transpose():
+    x = RNG.randn(3, 4).astype(np.float32)
+    y = RNG.randn(5, 4).astype(np.float32)
+    check_output(paddle.matmul, lambda x, y, **kw: x @ y.T,
+                 {"x": x, "y": y}, attrs={"transpose_y": True}, rtol=1e-4)
+
+
+REDUCE_CASES = [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+@pytest.mark.parametrize("name,ref", REDUCE_CASES, ids=[c[0] for c in REDUCE_CASES])
+def test_reduce(name, ref):
+    x = RNG.randn(3, 4).astype(np.float32)
+    check_output(getattr(paddle, name), lambda x: ref(x), {"x": x})
+    check_output(getattr(paddle, name),
+                 lambda x, axis, keepdim: ref(x, axis=axis, keepdims=keepdim),
+                 {"x": x}, attrs={"axis": 1, "keepdim": True})
+
+
+def test_reshape_transpose_concat():
+    x = RNG.randn(2, 6).astype(np.float32)
+    check_output(paddle.reshape, lambda x, shape: x.reshape(shape),
+                 {"x": x}, attrs={"shape": [3, 4]})
+    check_output(paddle.transpose, lambda x, perm: x.transpose(perm),
+                 {"x": x}, attrs={"perm": [1, 0]})
+    a = RNG.randn(2, 3).astype(np.float32)
+    b = RNG.randn(2, 3).astype(np.float32)
+    out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 1))
+
+
+def test_split_stack():
+    x = RNG.randn(6, 4).astype(np.float32)
+    parts = paddle.split(paddle.to_tensor(x), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    np.testing.assert_allclose(parts[1].numpy(), x[2:4])
+    parts2 = paddle.split(paddle.to_tensor(x), [1, 2, -1], axis=0)
+    assert parts2[2].shape == [3, 4]
+    st = paddle.stack([paddle.to_tensor(x), paddle.to_tensor(x)])
+    assert st.shape == [2, 6, 4]
+
+
+def test_gather_scatter():
+    x = RNG.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    check_output(paddle.gather, lambda x, index: x[index],
+                 {"x": x, "index": idx})
+    upd = np.ones((2, 3), np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor([1, 3]),
+                         paddle.to_tensor(upd))
+    ref = x.copy(); ref[[1, 3]] = 1
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_where_clip():
+    x = RNG.randn(4, 4).astype(np.float32)
+    check_output(paddle.clip, lambda x, min, max: np.clip(x, min, max),
+                 {"x": x}, attrs={"min": -0.5, "max": 0.5})
+    y = np.zeros_like(x)
+    out = paddle.where(paddle.to_tensor(x > 0), paddle.to_tensor(x),
+                       paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(x > 0, x, y))
+
+
+def test_softmax_logsumexp():
+    x = RNG.randn(3, 5).astype(np.float32)
+    ref = np.exp(x) / np.exp(x).sum(-1, keepdims=True)
+    out = paddle.exp(paddle.to_tensor(x)) / paddle.exp(
+        paddle.to_tensor(x)).sum(axis=-1, keepdim=True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    check_output(paddle.logsumexp,
+                 lambda x: np.log(np.exp(x).sum()), {"x": x}, rtol=1e-5)
+
+
+def test_cumsum_sort_argsort():
+    x = RNG.randn(3, 4).astype(np.float32)
+    check_output(paddle.cumsum, lambda x, axis: np.cumsum(x, axis),
+                 {"x": x}, attrs={"axis": 1})
+    check_output(paddle.sort, lambda x: np.sort(x, -1), {"x": x})
+    out = paddle.argsort(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.argsort(x, -1, kind="stable"))
+
+
+def test_linalg_suite():
+    a = RNG.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    check_output(paddle.inverse, lambda x: np.linalg.inv(x), {"x": spd}, rtol=1e-3)
+    check_output(paddle.det, lambda x: np.linalg.det(x), {"x": spd}, rtol=1e-3)
+    L = paddle.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose((L @ L.T).numpy(), spd, rtol=1e-3, atol=1e-3)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(spd))
+    np.testing.assert_allclose(out.numpy(), a @ spd, rtol=1e-3)
+
+
+def test_norm():
+    x = RNG.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(paddle.norm(paddle.to_tensor(x)).item(),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x), p=1, axis=1).numpy(),
+        np.abs(x).sum(1), rtol=1e-5)
+
+
+def test_grad_checks():
+    check_grad(paddle.tanh, {"x": RNG.randn(3, 3).astype(np.float32)}, ["x"])
+    check_grad(paddle.multiply, {"x": RNG.randn(2, 3).astype(np.float32),
+                                 "y": RNG.randn(2, 3).astype(np.float32)},
+               ["x", "y"])
+    check_grad(lambda x: paddle.reshape(x, [6]),
+               {"x": RNG.randn(2, 3).astype(np.float32)}, ["x"])
+
+
+def test_random_reproducible():
+    paddle.seed(123)
+    a = paddle.rand([4]).numpy()
+    paddle.seed(123)
+    b = paddle.rand([4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = paddle.randn([1000])
+    assert abs(float(c.numpy().mean())) < 0.2
+
+
+def test_one_hot_topk():
+    x = paddle.to_tensor([0, 2, 1])
+    oh = paddle.one_hot(x, 3)
+    np.testing.assert_allclose(oh.numpy(), np.eye(3)[[0, 2, 1]])
+    vals, idx = paddle.topk(paddle.to_tensor([1.0, 3.0, 2.0]), 2)
+    assert vals.numpy().tolist() == [3.0, 2.0]
+    assert idx.numpy().tolist() == [1, 2]

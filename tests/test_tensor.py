@@ -1,0 +1,107 @@
+"""Tensor basics: creation, metadata, conversion, indexing, in-place."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_defaults():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.shape == [3]
+    assert t.dtype == paddle.float32
+    assert t.stop_gradient
+
+    ti = paddle.to_tensor([1, 2, 3])
+    assert str(ti.dtype) in ("int64", "int32")
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3]).numpy().sum() == 6
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_dtype_cast():
+    t = paddle.to_tensor([1.5, 2.5])
+    ti = t.astype("int32")
+    assert ti.numpy().tolist() == [1, 2]
+    tb = t.astype(paddle.bfloat16)
+    assert str(tb.dtype) == "bfloat16"
+
+
+def test_item_and_numpy():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert np.asarray(t).shape == ()
+
+
+def test_indexing():
+    x = paddle.arange(12).reshape([3, 4])
+    assert x[0, 0].item() == 0
+    assert x[1].numpy().tolist() == [4, 5, 6, 7]
+    assert x[:, 1].numpy().tolist() == [1, 5, 9]
+    assert x[-1, -1].item() == 11
+    assert x[0:2, 1:3].shape == [2, 2]
+    # tensor index
+    idx = paddle.to_tensor([0, 2])
+    assert x[idx].shape == [2, 4]
+    # bool mask
+    m = x > 5
+    assert (x[m].numpy() > 5).all()
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1, 1] = 5.0
+    assert x.numpy()[1, 1] == 5.0
+    x[0] = paddle.ones([3])
+    assert x.numpy()[0].tolist() == [1, 1, 1]
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, -2.0])
+    x.abs_()
+    assert x.numpy().tolist() == [1.0, 2.0]
+    y = paddle.to_tensor([1.0, 1.0])
+    y += 1
+    assert y.numpy().tolist() == [2.0, 2.0]
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    assert (a + b).numpy().tolist() == [4.0, 6.0]
+    assert (b - a).numpy().tolist() == [2.0, 2.0]
+    assert (a * b).numpy().tolist() == [3.0, 8.0]
+    assert (b / a).numpy().tolist() == [3.0, 2.0]
+    assert (a ** 2).numpy().tolist() == [1.0, 4.0]
+    assert (2 + a).numpy().tolist() == [3.0, 4.0]
+    assert (-a).numpy().tolist() == [-1.0, -2.0]
+    assert (a < b).numpy().all()
+    assert (a @ b).item() == 11.0
+
+
+def test_save_load(tmp_path):
+    sd = {"w": paddle.rand([4, 4]), "step": 7,
+          "nested": {"b": paddle.ones([2], dtype="bfloat16")}}
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(sd, p)
+    back = paddle.load(p)
+    np.testing.assert_allclose(back["w"].numpy(), sd["w"].numpy())
+    assert back["step"] == 7
+    assert str(back["nested"]["b"].dtype) == "bfloat16"
+
+
+def test_set_value_and_fill():
+    x = paddle.zeros([2, 2])
+    x.set_value(np.ones((2, 2), np.float32))
+    assert x.numpy().sum() == 4
+    x.fill_(3.0)
+    assert x.numpy().sum() == 12
+    with pytest.raises(ValueError):
+        x.set_value(np.ones((3, 3), np.float32))
