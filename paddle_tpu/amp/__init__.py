@@ -1,0 +1,176 @@
+"""Automatic mixed precision.
+
+Parity: python/paddle/amp/ (reference — auto_cast :703, decorate :787,
+GradScaler grad_scaler.py:578, op lists amp_lists.py:30,105).
+
+TPU-native notes: bf16 is the native mixed-precision dtype (no loss scaling
+strictly required — the GradScaler defaults to enabled only for fp16, like
+the reference's bf16 path).  The auto-cast hook lives in the eager dispatch
+choke point (core/dispatch.py) — the analog of the generated ad_func AMP
+casts (paddle/fluid/eager/amp_utils.h).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core import dtypes as _dt
+from ..core.tensor import Tensor
+from .amp_lists import WHITE_LIST, BLACK_LIST
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "is_bfloat16_supported", "is_float16_supported"]
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Parity: paddle.amp.auto_cast (python/paddle/amp/auto_cast.py:703)."""
+    st = _dispatch._amp_state
+    old = dict(st)
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    st.update(enabled=bool(enable), dtype=_dt.convert_dtype(dtype),
+              level=level, white=frozenset(white), black=frozenset(black))
+    try:
+        yield
+    finally:
+        st.update(old)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """Parity: paddle.amp.decorate — O2 casts model params to the AMP dtype
+    and (with master_weight) keeps fp32 master copies in the optimizer."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        d = _dt.convert_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating):
+                    p._value = p._value.astype(d)
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(
+                optimizers, (list, tuple)) else list(optimizers)
+            for opt in opt_list:
+                opt._multi_precision = True if master_weight is None \
+                    else bool(master_weight)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: paddle.amp.GradScaler,
+    python/paddle/amp/grad_scaler.py:578)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops import math as _m
+        return _m.multiply(var, float(self._scale))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad is not None:
+                g = p._grad.astype(jnp.float32) * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+                p._grad = g.astype(p._grad.dtype)
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(np.float32(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)

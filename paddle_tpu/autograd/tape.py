@@ -211,9 +211,13 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         slot_grads = holders.get(node)
         if slot_grads is None:
             slot_grads = [None] * node.n_outputs
-        # Fill missing output cotangents with zeros of the right meta.
+        # Fill missing output cotangents with zeros of the right meta, and
+        # coerce dtypes to the recorded output dtype (cross-dtype edges can
+        # arise from user casts between ops).
         cots = tuple(
-            g if g is not None else _zeros_like_meta(m)
+            (g.astype(m[1]) if g is not None and hasattr(g, "dtype")
+             and g.dtype != m[1] else g) if g is not None
+            else _zeros_like_meta(m)
             for g, m in zip(slot_grads, node.out_meta)
         )
         for hook in node._hooks:

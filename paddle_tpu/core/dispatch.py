@@ -55,27 +55,26 @@ def _check_nan_inf(name: str, vals: Sequence[Array]):
                 print("WARNING:", msg)
 
 
-def _amp_cast_inputs(name: str, vals: List[Any]) -> List[Any]:
-    """O1 auto-cast per white/black list (reference:
-    python/paddle/amp/amp_lists.py:30,105 and eager_amp_auto_cast.h)."""
+def _amp_cast_dtype(name: str):
+    """O1 auto-cast target per white/black list (reference:
+    python/paddle/amp/amp_lists.py:30,105 and eager_amp_auto_cast.h).
+    Returns the dtype inputs should be cast to, or None."""
     st = _amp_state
     if not st["enabled"]:
-        return vals
-    target = st["dtype"]
+        return None
     if name in st["black"]:
-        cast_to = jnp.float32
-    elif name in st["white"] or st["level"] == "O2":
-        cast_to = target
-    else:
-        return vals
-    out = []
-    for v in vals:
-        if isinstance(v, Array) and jnp.issubdtype(v.dtype, jnp.floating) \
-                and v.dtype != cast_to and v.dtype != jnp.float64:
-            out.append(v.astype(cast_to))
-        else:
-            out.append(v)
-    return out
+        return jnp.float32
+    if name in st["white"] or st["level"] == "O2":
+        return st["dtype"]
+    return None
+
+
+def _amp_cast(v, cast_to):
+    if cast_to is not None and isinstance(v, (Array, jax.core.Tracer)) \
+            and jnp.issubdtype(v.dtype, jnp.floating) \
+            and v.dtype != cast_to and v.dtype != jnp.float64:
+        return v.astype(cast_to)
+    return v
 
 
 def apply_op(name: str, fn: Callable, tensor_args: Sequence,
@@ -100,7 +99,7 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
             tensors.append(None)
             vals.append(a)
 
-    vals = _amp_cast_inputs(name, vals)
+    cast_to = _amp_cast_dtype(name)
 
     tracing = any(_is_tracer(v) for v in vals)
     need_grad = (not tracing) and _tape.is_grad_enabled() and any(
@@ -118,6 +117,8 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
             need_grad = False
 
     if not need_grad:
+        if cast_to is not None:
+            vals = [_amp_cast(v, cast_to) for v in vals]
         out_vals = fn(*vals, **kwargs)
         outs = _wrap_outputs(name, out_vals, multi_output, node=None)
     else:
@@ -125,6 +126,10 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
             full = list(vals)
             for i, dv in zip(diff_idx, diff_vals):
                 full[i] = dv
+            if cast_to is not None:
+                # AMP cast INSIDE the differentiated closure so the VJP
+                # returns cotangents in each input's original dtype.
+                full = [_amp_cast(v, cast_to) for v in full]
             return fn(*full, **kwargs)
 
         primals = [vals[i] for i in diff_idx]
@@ -149,15 +154,17 @@ def _wrap_outputs(name, out_vals, multi_output, node):
         outs = []
         for i, v in enumerate(out_vals):
             t = Tensor._from_value(v)
-            if node is not None:
-                # Only float outputs participate in the autograd graph.
+            if node is not None and jnp.issubdtype(v.dtype, jnp.inexact):
+                # only inexact outputs participate in the autograd graph;
+                # integer outputs (topk indices, argsort, ...) stay
+                # stop_gradient leaves
                 t._grad_node = node
                 t._out_index = i
                 t.stop_gradient = False
             outs.append(t)
         return tuple(outs)
     t = Tensor._from_value(out_vals)
-    if node is not None:
+    if node is not None and jnp.issubdtype(out_vals.dtype, jnp.inexact):
         t._grad_node = node
         t._out_index = 0
         t.stop_gradient = False

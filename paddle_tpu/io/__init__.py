@@ -1,0 +1,17 @@
+"""Data pipeline.
+
+Parity: python/paddle/io/ (reference, SURVEY.md #65 — Dataset/IterableDataset,
+samplers, DataLoader with multiprocess workers + shared-memory tensors,
+dataloader_iter.py:150,358).
+
+TPU-native design: the loader produces host numpy batches on background
+threads (double-buffered prefetch) and the framework moves them to HBM on
+first use; multi-worker mode uses a process pool feeding the same prefetch
+queue.  (C++ shared-memory ring buffer is a later optimization slot.)
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, Subset, ConcatDataset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
+                      DistributedBatchSampler, WeightedRandomSampler,
+                      SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info

@@ -1,0 +1,152 @@
+"""DataLoader.
+
+Parity: python/paddle/io/dataloader/dataloader_iter.py:150 (single-process)
+and :358 (multi-process) in the reference.  Here: a background
+thread/process pool maps indices -> samples -> collated numpy batches into a
+bounded prefetch queue (the analog of the reference's blocking queue +
+shared-memory tensels); device transfer happens lazily when a batch Tensor
+first hits an op.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: Any
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (parity:
+    python/paddle/io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return batch
+
+
+class DataLoader:
+    """Parity: paddle.io.DataLoader."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(2, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle,
+                batch_size=batch_size if batch_size is not None else 1,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_prefetch()
+
+    # -- single process ------------------------------------------------------
+    def _iter_single(self):
+        for batch_idx in self.batch_sampler:
+            samples = [self.dataset[i] for i in batch_idx]
+            yield self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == (self.batch_size or 1):
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    # -- threaded prefetch (reference's multi-worker analog) -----------------
+    def _iter_prefetch(self):
+        q: "queue.Queue" = queue.Queue(
+            maxsize=self.num_workers * self.prefetch_factor)
+        sentinel = object()
+
+        def producer():
+            global _worker_info
+            _worker_info = WorkerInfo(0, self.num_workers, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(0)
+            try:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    def load(batch_idx):
+                        samples = [self.dataset[i] for i in batch_idx]
+                        return self.collate_fn(samples)
+
+                    for out in pool.map(load, self.batch_sampler):
+                        q.put(out)
+            except Exception as e:  # surface worker errors to the consumer
+                q.put(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item

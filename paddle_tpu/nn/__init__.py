@@ -1,0 +1,29 @@
+"""paddle_tpu.nn — layers + functional.
+
+Parity: python/paddle/nn/ (reference, SURVEY.md #62).
+"""
+from .layer_base import Layer, Parameter
+from . import functional
+from . import initializer
+from .layers import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Flatten, Identity, Upsample,
+    PixelShuffle,
+    Sequential, LayerList, ParameterList,
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose,
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm2D, LocalResponseNorm,
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool2D,
+    AdaptiveMaxPool2D,
+    ReLU, ReLU6, GELU, SiLU, Swish, Sigmoid, Tanh, Softmax, LogSoftmax,
+    Softplus, Softsign, LeakyReLU, ELU, CELU, SELU, Mish, Hardtanh,
+    Hardsigmoid, Hardswish, Hardshrink, Softshrink, Tanhshrink, LogSigmoid,
+    ThresholdedReLU, Maxout, GLU, PReLU,
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, CTCLoss, MarginRankingLoss,
+    Pad2D, ZeroPad2D,
+)
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)
+from .rnn import SimpleRNN, LSTM, GRU, SimpleRNNCell
+from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm

@@ -1,0 +1,85 @@
+"""Gradient clipping.
+
+Parity: python/paddle/nn/clip.py (reference — incl. the hybrid-parallel-aware
+global-norm clip used by fleet).  The distributed engine extends
+ClipGradByGlobalNorm to reduce the norm across mesh axes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._from_value(
+                jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            v = g._value
+            norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor._from_value((v * scale).astype(v.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Parity: paddle.nn.ClipGradByGlobalNorm.  In distributed runs the
+    squared-norm partial sums are all-reduced over the relevant mesh axes by
+    the hybrid optimizer wrapper before scaling."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+              for g in grads]
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        gnorm = self._global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._from_value(
+                (g._value * scale).astype(g._value.dtype))))
+        return out

@@ -1,0 +1,25 @@
+from .activation import (relu, relu6, relu_, gelu, silu, swish, softmax,
+                         log_softmax, softplus, softsign, sigmoid, tanh,
+                         hardtanh, hardsigmoid, hardswish, leaky_relu, elu,
+                         celu, selu, mish, tanhshrink, softshrink, hardshrink,
+                         prelu, glu, maxout, log_sigmoid, thresholded_relu,
+                         rrelu, swiglu)
+from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,
+                     embedding, one_hot, pad, interpolate, upsample,
+                     unfold, fold, pixel_shuffle, pixel_unshuffle,
+                     label_smooth, cosine_similarity, normalize, bilinear,
+                     flash_attention, scaled_dot_product_attention)
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+                   conv3d_transpose)
+from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
+                      max_pool2d, max_pool3d, adaptive_avg_pool1d,
+                      adaptive_avg_pool2d, adaptive_avg_pool3d,
+                      adaptive_max_pool2d)
+from .norm import (batch_norm, layer_norm, instance_norm, group_norm,
+                   local_response_norm, rms_norm)
+from .loss import (cross_entropy, softmax_with_cross_entropy,
+                   binary_cross_entropy, binary_cross_entropy_with_logits,
+                   mse_loss, l1_loss, nll_loss, kl_div, smooth_l1_loss,
+                   margin_ranking_loss, cosine_embedding_loss, ctc_loss,
+                   hinge_embedding_loss, triplet_margin_loss, log_loss,
+                   square_error_cost, sigmoid_focal_loss)

@@ -1,0 +1,174 @@
+"""Activation functions.
+
+Parity: python/paddle/nn/functional/activation.py (reference; phi
+activation kernels).  All fuse into adjacent ops under XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...ops.registry import register
+from ...ops._helpers import targ
+from ...ops import math as _m
+
+tanh = _m.tanh
+sigmoid = _m.sigmoid
+
+
+def _act(name, jfn):
+    def op(x, name=None):
+        return apply_op(op.__op_name__, jfn, (x,))
+    op.__op_name__ = name
+    op.__name__ = name
+    register(name, op, category="activation")
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+silu = _act("silu", jax.nn.silu)
+softsign = _act("softsign", jax.nn.soft_sign)
+log_sigmoid = _act("log_sigmoid", jax.nn.log_sigmoid)
+tanhshrink = _act("tanhshrink", lambda x: x - jnp.tanh(x))
+mish = _act("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+def relu_(x, name=None):
+    return x._inplace_assign(relu(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu",
+                    lambda v: jax.nn.gelu(v, approximate=approximate), (x,))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...core import dtypes as _dt
+            v = v.astype(_dt.convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply_op("softmax", fn, (x,))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...core import dtypes as _dt
+            v = v.astype(_dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply_op("log_softmax", fn, (x,))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    def fn(v):
+        scaled = beta * v
+        return jnp.where(scaled > threshold, v,
+                         jnp.logaddexp(scaled, 0.0) / beta)
+    return apply_op("softplus", fn, (x,))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda v: jnp.clip(v, min, max), (x,))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), (x,))
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", jax.nn.hard_swish, (x,))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu",
+                    lambda v: jax.nn.leaky_relu(v, negative_slope), (x,))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda v: jax.nn.elu(v, alpha), (x,))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda v: jax.nn.celu(v, alpha), (x,))
+
+
+def selu(x,
+         scale=1.0507009873554805,
+         alpha=1.6732632423543772, name=None):
+    return apply_op(
+        "selu",
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), (x,))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "softshrink",
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)),
+        (x,))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        "hardshrink",
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), (x,))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda v: jnp.where(v > threshold, v, value), (x,))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size > 1:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v > 0, v, w * v)
+    return apply_op("prelu", fn, (x, targ(weight)))
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    slope = (lower + upper) / 2.0
+    return leaky_relu(x, slope)
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op("glu", fn, (x,))
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (parity: paddle.incubate.nn.functional.swiglu) — the
+    Llama MLP gate; XLA fuses this into the surrounding matmuls."""
+    if y is not None:
+        return apply_op("swiglu", lambda a, b: jax.nn.silu(a) * b,
+                        (x, targ(y)))
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    return apply_op("swiglu", fn, (x,))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = (v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:])
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", fn, (x,))

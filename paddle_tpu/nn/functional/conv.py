@@ -1,0 +1,185 @@
+"""Convolutions.
+
+Parity: python/paddle/nn/functional/conv.py (reference; phi conv kernels +
+cuDNN).  TPU-native: a single lax.conv_general_dilated per call — XLA maps
+it onto the MXU; layouts are handled by dimension_numbers instead of
+NCHW/NHWC kernel variants.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import targ
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v) if len(v) == n else tuple(v) * n
+    return (int(v),) * n
+
+
+def _padding(padding, nd, data_format):
+    """Normalize paddle padding spec -> lax padding list of (lo, hi)."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    # full per-dim [[0,0],[0,0],[lo,hi],...] form
+    if len(padding) == nd + 2:
+        spatial = padding[2:] if data_format.startswith("NC") \
+            else padding[1:-1]
+        return [tuple(p) if isinstance(p, (list, tuple)) else (p, p)
+                for p in spatial]
+    raise ValueError(f"bad padding spec {padding}")
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last \
+            else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last \
+        else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(name, nd, x, weight, bias, stride, padding, dilation, groups,
+          data_format):
+    channel_last = not data_format.startswith("NC")
+    strides = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    pad = _padding(padding, nd, data_format)
+    dn = _dim_numbers(nd, channel_last)
+
+    def fn(v, w, *b):
+        # paddle weights are [out, in/groups, *k] (OIHW); lax wants per dn.
+        if channel_last:
+            # OIHW -> HWIO
+            w = jnp.moveaxis(w, (0, 1), (-1, -2))
+        out = lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if v.dtype == jnp.bfloat16 else None)
+        if v.dtype == jnp.bfloat16:
+            out = out.astype(jnp.bfloat16)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = (x, targ(weight)) + ((targ(bias),) if bias is not None else ())
+    return apply_op(name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv("conv1d", 1, x, weight, bias, stride, padding, dilation,
+                 groups, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv("conv2d", 2, x, weight, bias, stride, padding, dilation,
+                 groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv("conv3d", 3, x, weight, bias, stride, padding, dilation,
+                 groups, data_format)
+
+
+def _conv_transpose(name, nd, x, weight, bias, stride, padding,
+                    output_padding, dilation, groups, data_format,
+                    output_size=None):
+    channel_last = not data_format.startswith("NC")
+    strides = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    pad = _padding(padding, nd, data_format)
+    dn = _dim_numbers(nd, channel_last)
+    opad = _pair(output_padding, nd)
+
+    def fn(v, w, *b):
+        # paddle transpose-conv weight: [in, out/groups, *k]
+        if groups > 1:
+            # grouped transposed conv via per-group slicing
+            vin = jnp.split(v, groups, axis=-1 if channel_last else 1)
+            win = jnp.split(w, groups, axis=0)
+            outs = [
+                _single_transpose(vv, ww) for vv, ww in zip(vin, win)]
+            out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+        else:
+            out = _single_transpose(v, w)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    def _single_transpose(v, w):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # convert conv padding to conv_transpose padding
+            k = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(nd)] \
+                if not channel_last else \
+                [(w.shape[i] - 1) * dil[i] + 1 for i in range(nd)]
+            padding_cfg = [
+                (k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad[i])
+                for i in range(nd)]
+        # IO(HW) -> lax transpose kernel layout
+        if channel_last:
+            wt = jnp.moveaxis(w, (0, 1), (-2, -1))  # I,O trailing
+            kernel_spec = dn[1]
+        else:
+            wt = jnp.swapaxes(w, 0, 1)  # OI -> paddle in/out swap
+            kernel_spec = dn[1]
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd))) if not channel_last \
+            else jnp.flip(wt, axis=tuple(range(nd)))
+        return lax.conv_general_dilated(
+            v, wt, window_strides=(1,) * nd, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+
+    args = (x, targ(weight)) + ((targ(bias),) if bias is not None else ())
+    return apply_op(name, fn, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose("conv1d_transpose", 1, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups, df)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose("conv2d_transpose", 2, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose("conv3d_transpose", 3, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format)

@@ -1,0 +1,321 @@
+"""Loss functions.
+
+Parity: python/paddle/nn/functional/loss.py (reference; phi cross_entropy
+kernels paddle/phi/kernels/funcs/cross_entropy.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import targ
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Parity: F.cross_entropy (softmax+ce fused like the reference's
+    softmax_with_cross_entropy kernel)."""
+    def fn(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-10, 1.0))
+        C = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim
+                          and lab.shape[axis] == C
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / C
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            li = lab
+            if li.ndim == logits.ndim:
+                li = jnp.squeeze(li, axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, safe[..., None], axis=axis).squeeze(axis)
+            if label_smoothing > 0:
+                smooth_term = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked \
+                    + label_smoothing * smooth_term
+            loss = jnp.where(valid, -picked, 0.0)
+            if w:
+                wt = jnp.take(w[0].astype(jnp.float32), safe)
+                loss = loss * jnp.where(valid, wt, 0.0)
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, wt, 0.0))
+                    return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                    1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = (input, targ(label)) + ((targ(weight),) if weight is not None
+                                   else ())
+    return apply_op("cross_entropy", fn, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1, name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # reference returns loss with a trailing 1-dim
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from ..functional.activation import softmax as _softmax
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(p, lab, *w):
+        p = jnp.clip(p.astype(jnp.float32), 1e-7, 1 - 1e-7)
+        loss = -(lab * jnp.log(p) + (1 - lab) * jnp.log1p(-p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, targ(label)) + ((targ(weight),) if weight is not None
+                                   else ())
+    return apply_op("binary_cross_entropy", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(z, lab, *extra):
+        z = z.astype(jnp.float32)
+        lab = lab.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), pos_weight variant
+        if pw is not None:
+            log_w = (pw - 1) * lab + 1
+            loss = (1 - lab) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z))
+                                            + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0) - z * lab + jnp.logaddexp(
+                0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, targ(label)]
+    if weight is not None:
+        args.append(targ(weight))
+    if pos_weight is not None:
+        args.append(targ(pos_weight))
+    return apply_op("bce_with_logits", fn, tuple(args))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        return _reduce(jnp.square(a - b), reduction)
+    return apply_op("mse_loss", fn, (input, targ(label)))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def fn(a, b):
+        return _reduce(jnp.abs(a - b), reduction)
+    return apply_op("l1_loss", fn, (input, targ(label)))
+
+
+def square_error_cost(input, label, name=None):
+    def fn(a, b):
+        return jnp.square(a - b)
+    return apply_op("square_error_cost", fn, (input, targ(label)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, lab):
+        return -lab * jnp.log(p + epsilon) \
+            - (1 - lab) * jnp.log(1 - p + epsilon)
+    return apply_op("log_loss", fn, (input, targ(label)))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(logp, lab, *w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1).squeeze(1)
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe)
+            loss = loss * jnp.where(valid, wt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    args = (input, targ(label)) + ((targ(weight),) if weight is not None
+                                   else ())
+    return apply_op("nll_loss", fn, args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            safe_t = jnp.clip(t, 1e-10, None)
+            loss = t * (jnp.log(safe_t) - logp)
+            loss = jnp.where(t > 0, loss, 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op("kl_div", fn, (input, targ(label)))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op("smooth_l1_loss", fn, (input, targ(label)))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, lab):
+        return _reduce(jnp.maximum(0.0, -lab * (a - b) + margin), reduction)
+    return apply_op("margin_ranking_loss", fn,
+                    (input, targ(other), targ(label)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, lab):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(lab == 1, 1 - cos,
+                         jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op("cosine_embedding_loss", fn,
+                    (input1, targ(input2), targ(label)))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, lab):
+        loss = jnp.where(lab == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op("hinge_embedding_loss", fn, (input, targ(label)))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p),
+                               -1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p),
+                               -1), 1 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon,
+                                              p), -1), 1 / p)
+            dn = jnp.minimum(dn, dpn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin_loss", fn,
+                    (input, targ(positive), targ(negative)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, lab, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * lab + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = (logit, targ(label)) + ((targ(normalizer),)
+                                   if normalizer is not None else ())
+    return apply_op("sigmoid_focal_loss", fn, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC via the classic alpha recursion in log space (lax.scan)."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-probs (paddle layout)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended labels with blanks
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = -1e30
+
+        def get_probs(t_lp):
+            return jnp.take_along_axis(
+                t_lp[:, None, :].repeat(S, 1), ext[..., None],
+                axis=-1).squeeze(-1)  # [B, S]
+
+        init = jnp.full((B, S), neg_inf)
+        init = init.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=-1)[:, 0]
+        init = init.at[:, 1].set(first_lab)
+
+        same = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t_lp):
+            a0 = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf),
+                                  alpha[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf),
+                                  alpha[:, :-2]], 1)
+            a2 = jnp.where(same | (ext == blank), neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(a0, a1), a2)
+            new = merged + get_probs(t_lp)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, init, lp[1:])
+        alphas = jnp.concatenate([init[None], alphas], 0)  # [T,B,S]
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        final = alphas[t_idx, jnp.arange(B)]  # [B,S]
+        s_last = 2 * lab_len.astype(jnp.int32)
+        ll_blank = jnp.take_along_axis(final, s_last[:, None], 1)[:, 0]
+        ll_label = jnp.take_along_axis(
+            final, jnp.maximum(s_last - 1, 0)[:, None], 1)[:, 0]
+        ll = jnp.logaddexp(ll_blank, ll_label)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply_op("ctc_loss", fn,
+                    (log_probs, targ(labels), targ(input_lengths),
+                     targ(label_lengths)))

@@ -1,0 +1,192 @@
+"""Normalization ops.
+
+Parity: python/paddle/nn/functional/norm.py (reference), fused rms_norm from
+paddle/phi/kernels/fusion/ (reference #17).  XLA fuses these; a Pallas
+rms_norm kernel is wired in via FLAGS_use_pallas_kernels for the hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...core.tensor import Tensor
+from ...ops._helpers import targ
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Parity: F.batch_norm. In training mode the running stats are updated
+    in place on the provided tensors (host-side, eager) like the reference's
+    mean/variance out params."""
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None \
+        else use_global_stats
+
+    def fn(v, mean, var, *wb):
+        axes = tuple(i for i in range(v.ndim)
+                     if i != (channel_axis % v.ndim))
+        if use_stats:
+            m, s2 = mean, var
+        else:
+            m = jnp.mean(v, axis=axes)
+            s2 = jnp.var(v, axis=axes)
+        shape = [1] * v.ndim
+        shape[channel_axis % v.ndim] = v.shape[channel_axis % v.ndim]
+        out = (v - m.reshape(shape)) * jax.lax.rsqrt(
+            s2.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    wb = tuple(targ(t) for t in (weight, bias) if t is not None)
+    out = apply_op("batch_norm", fn,
+                   (x, targ(running_mean), targ(running_var)) + wb)
+
+    if training and not use_stats and isinstance(running_mean, Tensor) \
+            and not isinstance(x._value, jax.core.Tracer):
+        axes = tuple(i for i in range(x._value.ndim)
+                     if i != (channel_axis % x._value.ndim))
+        m = jnp.mean(x._value, axis=axes)
+        v2 = jnp.var(x._value, axis=axes)
+        n = float(np.prod([x._value.shape[a] for a in axes]))
+        unbiased = v2 * (n / max(n - 1.0, 1.0))
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * m).astype(
+                                   running_mean._value.dtype)
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * unbiased).astype(
+                                  running_var._value.dtype)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        m = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    wb = tuple(targ(t) for t in (weight, bias) if t is not None)
+    return apply_op("layer_norm", fn, (x,) + wb)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Fused RMSNorm (parity: reference fused_rms_norm,
+    paddle/phi/kernels/fusion/ #17).  Stats in fp32 for bf16 inputs."""
+    def fn(v, *w):
+        compute = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16,
+                                                       jnp.float16) else v
+        ms = jnp.mean(jnp.square(compute), axis=-1, keepdims=True)
+        out = compute * jax.lax.rsqrt(ms + epsilon)
+        out = out.astype(v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    wb = (targ(weight),) if weight is not None else ()
+    return apply_op("rms_norm", fn, (x,) + wb)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def fn(v, *extra):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        i = 0
+        if not use_input_stats:
+            if running_mean is None or running_var is None:
+                raise ValueError(
+                    "use_input_stats=False requires running_mean/var")
+            m = extra[i].reshape(1, -1, *([1] * (v.ndim - 2))); i += 1
+            var = extra[i].reshape(1, -1, *([1] * (v.ndim - 2))); i += 1
+        else:
+            if running_mean is not None:
+                i += 2  # skip running stats operands
+            axes = tuple(range(2, v.ndim))
+            m = jnp.mean(v, axis=axes, keepdims=True)
+            var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - m) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        if weight is not None:
+            out = out * extra[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + extra[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    operands = []
+    if running_mean is not None:
+        operands += [targ(running_mean), targ(running_var)]
+    operands += [targ(t) for t in (weight, bias) if t is not None]
+    return apply_op("instance_norm", fn, (x, *operands))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def fn(v, *wb):
+        if channel_last:
+            v = jnp.moveaxis(v, -1, 1)
+        N, C = v.shape[0], v.shape[1]
+        g = v.reshape((N, num_groups, C // num_groups) + v.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, C] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    wb = tuple(targ(t) for t in (weight, bias) if t is not None)
+    return apply_op("group_norm", fn, (x,) + wb)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        c = v.shape[ch_axis]
+        acc = jnp.zeros_like(v)
+        for offset in range(-half, size - half):
+            sl_src = [np.s_[:]] * v.ndim
+            lo = max(0, -offset)
+            hi = min(c, c - offset)
+            sl_src[ch_axis] = np.s_[lo + offset:hi + offset]
+            sl_dst = [np.s_[:]] * v.ndim
+            sl_dst[ch_axis] = np.s_[lo:hi]
+            pad_cfg = [(0, 0)] * v.ndim
+            pad_cfg[ch_axis] = (lo, c - hi)
+            acc = acc + jnp.pad(sq[tuple(sl_src)], pad_cfg)
+        return v / jnp.power(k + alpha * acc / size, beta)
+    return apply_op("local_response_norm", fn, (x,))

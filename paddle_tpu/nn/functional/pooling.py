@@ -1,0 +1,163 @@
+"""Pooling ops via lax.reduce_window.
+
+Parity: python/paddle/nn/functional/pooling.py (reference; phi pool
+kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply_op
+from .conv import _pair, _padding
+
+
+def _window(nd, k, s, pad, channel_last, v_ndim):
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + list(pad)
+    return dims, strides, pads
+
+
+def _pool(name, nd, x, kernel_size, stride, padding, mode, data_format,
+          ceil_mode=False, exclusive=True):
+    channel_last = not data_format.startswith("NC")
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    pad = _padding(padding, nd, data_format)
+
+    def fn(v):
+        if isinstance(pad, str):
+            # lax.reduce_window accepts 'SAME'/'VALID' directly
+            dims, strides, _ = _window(nd, k, s, [(0, 0)] * nd,
+                                       channel_last, v.ndim)
+            pads = pad
+        else:
+            dims, strides, pads = _window(nd, k, s, pad, channel_last,
+                                          v.ndim)
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+                else jnp.iinfo(v.dtype).min
+            return lax.reduce_window(v, init, lax.max, dims, strides, pads)
+        # avg
+        summed = lax.reduce_window(v, 0.0, lax.add, dims, strides, pads)
+        padded = pads == "SAME" if isinstance(pads, str) \
+            else any(p != (0, 0) for p in pads)
+        if exclusive and padded:
+            ones = jnp.ones_like(v)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                       pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return apply_op(name, fn, (x,))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max", df)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
+                data_format)
+    if return_mask:
+        # indices not natively produced by reduce_window; compute via argmax
+        # over extracted patches (rarely used on TPU; correctness path).
+        raise NotImplementedError("return_mask=True not supported yet")
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max_pool3d", 3, x, kernel_size, stride, padding, "max",
+                 data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool("avg_pool1d", 1, x, kernel_size, stride, padding, "avg", df,
+                 exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg_pool2d", 2, x, kernel_size, stride, padding, "avg",
+                 data_format, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", 3, x, kernel_size, stride, padding, "avg",
+                 data_format, exclusive=exclusive)
+
+
+def _adaptive_pool(name, nd, x, output_size, mode, data_format):
+    channel_last = not data_format.startswith("NC")
+    out_sz = _pair(output_size, nd)
+
+    def fn(v):
+        spatial_axes = list(range(2, 2 + nd)) if not channel_last \
+            else list(range(1, 1 + nd))
+        out = v
+        for i, ax in enumerate(spatial_axes):
+            if out_sz[i] is None:
+                continue
+            in_s = out.shape[ax]
+            o = out_sz[i]
+            if in_s % o == 0:
+                # even split: reshape + reduce
+                k = in_s // o
+                new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = r.max(axis=ax + 1) if mode == "max" \
+                    else r.mean(axis=ax + 1)
+            else:
+                # uneven: gather per output bin
+                pieces = []
+                for j in range(o):
+                    lo = (j * in_s) // o
+                    hi = -(-((j + 1) * in_s) // o)
+                    sl = [np.s_[:]] * out.ndim
+                    sl[ax] = np.s_[lo:hi]
+                    piece = out[tuple(sl)]
+                    red = piece.max(axis=ax, keepdims=True) if mode == "max" \
+                        else piece.mean(axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply_op(name, fn, (x,))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool("adaptive_avg_pool1d", 1, x, output_size, "avg",
+                          "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool2d", 2, x, output_size, "avg",
+                          data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool3d", 3, x, output_size, "avg",
+                          data_format)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool2d", 2, x, output_size, "max",
+                          "NCHW")

@@ -1,0 +1,357 @@
+"""nn.Layer base class.
+
+Parity: python/paddle/nn/layer/layers.py (reference Layer: parameter/buffer
+registration, sublayers, hooks, state_dict, train/eval).  TPU-native
+addition: ``functional_state`` / ``functional_call`` let a Layer be used as a
+pure function over a params pytree — the seam jit/pjit tracing and the
+distributed engine use to compile whole training steps into one XLA module.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: paddle EagerParamBase,
+    python/paddle/base/framework.py)."""
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.is_distributed = False
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+
+
+class Layer:
+    """Base building block (parity: paddle.nn.Layer)."""
+
+    _param_counter = 0
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = _dt.convert_dtype(dtype)
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute routing ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                else:
+                    params[name] = value
+                return
+            if layers is not None and name in layers:
+                if value is None:
+                    del layers[name]
+                else:
+                    layers[name] = value
+                return
+            buffers = self.__dict__.get("_buffers")
+            if buffers is not None and name in buffers:
+                buffers[name] = value
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- registration --------------------------------------------------------
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         attr=None, is_bias=False) -> Parameter:
+        """Parity: Layer.create_parameter — initializer-driven creation."""
+        from . import initializer as I
+        dtype = _dt.convert_dtype(dtype) if dtype else self._dtype
+        init = None
+        name = None
+        if attr is not None and attr is not False:
+            init = getattr(attr, "initializer", None)
+            name = getattr(attr, "name", None)
+        if init is None:
+            init = default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, dtype)
+        if name is None:
+            # paddle-style structured names (linear_0.w_0) so
+            # apply_decay_param_fun / exclude_from_weight_decay_fn
+            # conventions keyed on ".b_"/".w_" work
+            Layer._param_counter += 1
+            name = (f"{self._name_scope}_{Layer._param_counter}."
+                    f"{'b' if is_bias else 'w'}_0")
+        p = Parameter(value, name=name)
+        return p
+
+    # -- traversal -----------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix, False)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- mode ----------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.children():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.children():
+            l.eval()
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+    class _HookHandle:
+        def __init__(self, store, hid):
+            self._store, self._hid = store, hid
+
+        def remove(self):
+            self._store.pop(self._hid, None)
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return Layer._HookHandle(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return Layer._HookHandle(self._forward_post_hooks, self._hook_id)
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            last = name.rsplit(".", 1)[-1]
+            if last not in self._non_persistable_buffer_names:
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Parity: Layer.set_state_dict / set_dict."""
+        missing, unexpected = [], []
+        own = dict(self.named_parameters())
+        own.update(dict(self.named_buffers()))
+        for k, v in state_dict.items():
+            if k in own:
+                tgt = own[k]
+                val = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                tgt.set_value(val.astype(tgt.numpy().dtype)
+                              if val.dtype != np.asarray(tgt._value).dtype
+                              else val)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype / conversion --------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = _dt.convert_dtype(dtype)
+            for p in self.parameters():
+                p._value = p._value.astype(d)
+            for b in self.buffers():
+                if b is not None and jnp.issubdtype(b._value.dtype,
+                                                    jnp.floating):
+                    b._value = b._value.astype(d)
+            self._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- functionalization (the jit/pjit seam) -------------------------------
+    def functional_state(self) -> Dict[str, jax.Array]:
+        """Raw param+buffer values keyed by structured name."""
+        return {k: v._value for k, v in self.state_dict().items()}
+
+    @contextlib.contextmanager
+    def bind_state(self, state: Dict[str, Any]):
+        """Temporarily swap parameter/buffer values (possibly tracers) —
+        functional_call support for tracing whole steps under jax.jit."""
+        sd = self.state_dict()
+        old = {k: sd[k]._value for k in state if k in sd}
+        try:
+            for k, v in state.items():
+                if k in sd:
+                    sd[k]._value = v
+            yield self
+        finally:
+            for k, v in old.items():
+                sd[k]._value = v
+
+    def functional_call(self, state: Dict[str, Any], *args, **kwargs):
+        with self.bind_state(state):
+            return self(*args, **kwargs)
+
+    # -- misc ----------------------------------------------------------------
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "("]
+        extra = self.extra_repr()
+        if extra:
+            lines[0] += extra
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {rep}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else lines[0] + ")"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()

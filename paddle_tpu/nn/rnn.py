@@ -1,0 +1,228 @@
+"""Recurrent layers over lax.scan.
+
+Parity: python/paddle/nn/layer/rnn.py (reference SimpleRNN/LSTM/GRU +
+cuDNN-fused paths).  TPU-native: the time loop is a lax.scan so the whole
+unrolled recurrence compiles into one XLA while-loop; no cuDNN analog
+needed.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from .layer_base import Layer, Parameter
+from . import initializer as I
+
+
+class _RNNBase(Layer):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        std = 1.0 / math.sqrt(hidden_size)
+        g = self.GATES
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    "weight_ih" + sfx,
+                    self.create_parameter(
+                        [g * hidden_size, in_sz],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "weight_hh" + sfx,
+                    self.create_parameter(
+                        [g * hidden_size, hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "bias_ih" + sfx,
+                    self.create_parameter(
+                        [g * hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "bias_hh" + sfx,
+                    self.create_parameter(
+                        [g * hidden_size],
+                        default_initializer=I.Uniform(-std, std)))
+
+    # cell step: subclass implements (x_t, state, params) -> (state, out)
+    def _cell(self, x_t, state, wih, whh, bih, bhh):
+        raise NotImplementedError
+
+    def _init_state(self, batch, dtype):
+        raise NotImplementedError
+
+    def _run_direction(self, x, layer, reverse, init_state):
+        sfx = f"_l{layer}" + ("_reverse" if reverse else "")
+        wih = getattr(self, "weight_ih" + sfx)
+        whh = getattr(self, "weight_hh" + sfx)
+        bih = getattr(self, "bias_ih" + sfx)
+        bhh = getattr(self, "bias_hh" + sfx)
+
+        def fn(xv, wihv, whhv, bihv, bhhv, *init):
+            seq = xv if self.time_major else jnp.swapaxes(xv, 0, 1)
+            if reverse:
+                seq = jnp.flip(seq, 0)
+
+            def step(carry, x_t):
+                new = self._cell_val(x_t, carry, wihv, whhv, bihv, bhhv)
+                out = new[0] if isinstance(new, tuple) else new
+                return new, out
+
+            carry0 = init if len(init) > 1 else init[0]
+            carry, outs = jax.lax.scan(step, carry0, seq)
+            if reverse:
+                outs = jnp.flip(outs, 0)
+            if not self.time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            if isinstance(carry, tuple):
+                return (outs,) + tuple(carry)
+            return outs, carry
+
+        out = apply_op("rnn" + sfx, fn,
+                       (x, wih, whh, bih, bhh, *init_state))
+        return out
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length masking is not supported yet; pad-free "
+                "batches or mask outputs externally")
+        x = inputs
+        batch = x.shape[1] if self.time_major else x.shape[0]
+        ndir = self.num_directions
+        n_state = len(self._init_state(1, jnp.float32))
+
+        states_out = []
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(ndir):
+                if initial_states is None:
+                    init = tuple(
+                        Tensor(np.zeros((batch, self.hidden_size),
+                                        np.float32))
+                        for _ in range(n_state))
+                else:
+                    st = initial_states if n_state > 1 \
+                        else (initial_states,)
+                    idx = layer * ndir + d
+                    init = tuple(s[idx] for s in st)
+                res = self._run_direction(x, layer, d == 1, init)
+                outs = res[0]
+                states_out.append(tuple(res[1:]))
+                dir_outs.append(outs)
+            if ndir == 2:
+                from ..ops.manipulation import concat
+                x = concat(dir_outs, axis=-1)
+            else:
+                x = dir_outs[0]
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from . import functional as F
+                x = F.dropout(x, self.dropout, training=self.training)
+
+        from ..ops.manipulation import stack
+        final = []
+        for i in range(n_state):
+            final.append(stack([s[i] for s in states_out], axis=0))
+        if n_state == 1:
+            return x, final[0]
+        return x, tuple(final)
+
+
+class SimpleRNN(_RNNBase):
+    GATES = 1
+
+    def __init__(self, *args, activation="tanh", **kwargs):
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        super().__init__(*args, **kwargs)
+
+    def _init_state(self, batch, dtype):
+        return (jnp.zeros((batch, self.hidden_size), dtype),)
+
+    def _cell_val(self, x_t, h, wih, whh, bih, bhh):
+        if isinstance(h, tuple):
+            h = h[0]
+        return self._act(x_t @ wih.T + bih + h @ whh.T + bhh)
+
+
+class LSTM(_RNNBase):
+    GATES = 4
+
+    def _init_state(self, batch, dtype):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def _cell_val(self, x_t, carry, wih, whh, bih, bhh):
+        h, c = carry
+        gates = x_t @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new)
+
+
+class GRU(_RNNBase):
+    GATES = 3
+
+    def _init_state(self, batch, dtype):
+        return (jnp.zeros((batch, self.hidden_size), dtype),)
+
+    def _cell_val(self, x_t, carry, wih, whh, bih, bhh):
+        h = carry[0] if isinstance(carry, tuple) else carry
+        gi = x_t @ wih.T + bih
+        gh = h @ whh.T + bhh
+        ir, iz, inew = jnp.split(gi, 3, axis=-1)
+        hr, hz, hnew = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inew + r * hnew)
+        return (1 - z) * n + z * h
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ..ops import linalg as L
+        if states is None:
+            states = Tensor(np.zeros((inputs.shape[0], self.hidden_size),
+                                     np.float32))
+        pre = L.matmul(inputs, self.weight_ih, transpose_y=True) \
+            + self.bias_ih \
+            + L.matmul(states, self.weight_hh, transpose_y=True) \
+            + self.bias_hh
+        out = apply_op("rnn_cell_act", self._act, (pre,))
+        return out, out

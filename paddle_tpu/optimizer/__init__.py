@@ -1,0 +1,22 @@
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
+
+Parity: python/paddle/optimizer/ (reference, SURVEY.md #63).
+"""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
+                        RMSProp, Adadelta, Adamax, Lamb, Rprop)
+
+
+class L2Decay:
+    """Weight-decay coefficient holder (parity: paddle.regularizer.L2Decay)."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __float__(self):
+        return self._coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

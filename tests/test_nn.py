@@ -1,0 +1,246 @@
+"""nn layers + functional tests (reference analog: test/legacy_test layer
+tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_grad():
+    paddle.seed(0)
+    layer = nn.Linear(8, 4)
+    x = paddle.rand([2, 8])
+    y = layer(x)
+    assert y.shape == [2, 4]
+    y.sum().backward()
+    assert layer.weight.grad.shape == [8, 4]
+    assert layer.bias.grad.shape == [4]
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(3, 2)
+    x = paddle.rand([5, 3])
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(layer(x).numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_lax():
+    paddle.seed(1)
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.rand([1, 2, 5, 5])
+    y = conv(x)
+    assert y.shape == [1, 3, 5, 5]
+    # identity kernel check: 1x1 conv with known weights
+    c1 = nn.Conv2D(1, 1, 1, bias_attr=False)
+    c1.weight.set_value(np.ones((1, 1, 1, 1), np.float32) * 2)
+    xin = paddle.ones([1, 1, 2, 2])
+    np.testing.assert_allclose(c1(xin).numpy(), 2 * np.ones((1, 1, 2, 2)))
+
+
+def test_depthwise_conv():
+    conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+    y = conv(paddle.rand([2, 4, 6, 6]))
+    assert y.shape == [2, 4, 6, 6]
+
+
+def test_conv2d_transpose():
+    convt = nn.Conv2DTranspose(3, 2, 2, stride=2)
+    y = convt(paddle.rand([1, 3, 4, 4]))
+    assert y.shape == [1, 2, 8, 8]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.rand([4, 3, 2, 2]) * 5 + 3
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm_and_rmsnorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.rand([2, 4, 8]) * 3 + 1
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), np.zeros((2, 4)),
+                               atol=1e-5)
+    rn = nn.RMSNorm(8)
+    y2 = rn(x)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True)
+                              + 1e-6)
+    np.testing.assert_allclose(y2.numpy(), ref, rtol=1e-4)
+
+
+def test_pools():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                  [10.5, 12.5]])
+    aap = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(aap.numpy()[0, 0], [[7.5]])
+
+
+def test_embedding_and_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor([0, 3, 5])
+    out = emb(ids)
+    assert out.shape == [3, 4]
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    d = nn.Dropout(0.5)
+    d.train()
+    y = d(x)
+    frac = float((y.numpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    kept = y.numpy()[y.numpy() != 0]
+    np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept))
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.rand([4, 5])
+    labels = paddle.to_tensor([1, 0, 3, 2])
+    loss = F.cross_entropy(logits, labels)
+    logp = np.log(np.exp(logits.numpy()) /
+                  np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -logp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = paddle.rand([4, 5])
+    labels = paddle.to_tensor([1, -100, 3, -100])
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    logp = np.log(np.exp(logits.numpy()) /
+                  np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -(logp[0, 1] + logp[2, 3]) / 2
+    np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+    soft = paddle.nn.functional.softmax(paddle.rand([4, 5]))
+    l2 = F.cross_entropy(logits, soft, soft_label=True)
+    assert l2.item() > 0
+
+
+def test_losses():
+    a = paddle.rand([3, 4])
+    b = paddle.rand([3, 4])
+    np.testing.assert_allclose(F.mse_loss(a, b).item(),
+                               ((a.numpy() - b.numpy()) ** 2).mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(F.l1_loss(a, b).item(),
+                               np.abs(a.numpy() - b.numpy()).mean(),
+                               rtol=1e-5)
+    p = paddle.nn.functional.sigmoid(a)
+    lab = paddle.to_tensor((np.random.rand(3, 4) > 0.5).astype(np.float32))
+    bce = F.binary_cross_entropy(p, lab)
+    bcel = F.binary_cross_entropy_with_logits(a, lab)
+    np.testing.assert_allclose(bce.item(), bcel.item(), rtol=1e-4)
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(
+        F.leaky_relu(x, 0.1).numpy(), [-0.2, -0.05, 0, 0.5, 2], rtol=1e-6)
+    g = F.gelu(x).numpy()
+    assert g[0] < 0 and g[-1] > 1.9
+    sm = F.softmax(paddle.rand([3, 5]))
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(3), rtol=1e-6)
+
+
+def test_sequential_and_layerlist():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(m) == 3
+    assert len(m.parameters()) == 4
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(sd, path)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(paddle.load(path))
+    x = paddle.rand([2, 4])
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_multihead_attention_and_transformer():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.rand([2, 6, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 6, 16]
+    enc = nn.TransformerEncoder(nn.TransformerEncoderLayer(16, 4, 32), 2)
+    z = enc(x)
+    assert z.shape == [2, 6, 16]
+    z.sum().backward()
+    assert mha.q_proj.weight.grad is None  # mha not in enc
+    assert any(p.grad is not None for p in enc.parameters())
+
+
+def test_causal_attention_mask():
+    q = paddle.rand([1, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+
+
+def test_rnn_family():
+    for cls, states in [(nn.SimpleRNN, 1), (nn.GRU, 1), (nn.LSTM, 2)]:
+        m = cls(4, 8, num_layers=2)
+        out, st = m(paddle.rand([3, 5, 4]))
+        assert out.shape == [3, 5, 8]
+        if states == 2:
+            assert st[0].shape == [2, 3, 8]
+        loss = out.sum()
+        loss.backward()
+        assert m.weight_ih_l0.grad is not None
+
+
+def test_bidirectional_lstm():
+    m = nn.LSTM(4, 8, direction="bidirect")
+    out, (h, c) = m(paddle.rand([2, 5, 4]))
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 8]
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(
+        lambda l, i, o: calls.append(o.shape))
+    layer(paddle.rand([1, 2]))
+    assert calls == [[1, 2]]
+    h.remove()
+    layer(paddle.rand([1, 2]))
+    assert len(calls) == 1
+
+
+def test_grad_clip():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    layer = nn.Linear(4, 4)
+    x = paddle.rand([8, 4]) * 100
+    (layer(x) ** 2).sum().backward()
+    opt = paddle.optimizer.SGD(0.1, parameters=layer.parameters(),
+                               grad_clip=clip)
+    pg = [(p, p.grad) for p in layer.parameters()]
+    clipped = clip(pg)
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in clipped))
+    assert total <= 1.0 + 1e-4

@@ -1,0 +1,188 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Momentum, Adam, AdamW, Adagrad, \
+    RMSProp, Adamax, Lamb
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _quadratic_problem():
+    paddle.seed(0)
+    w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                         stop_gradient=False)
+    w.name = "w0"
+    return w
+
+
+@pytest.mark.parametrize("opt_cls,kw,olr", [
+    (SGD, {}, 0.1), (Momentum, {}, 0.05), (Adam, {}, 0.1), (AdamW, {}, 0.1),
+    (Adagrad, {}, 1.0), (RMSProp, {}, 0.1), (Adamax, {}, 0.1),
+    (Lamb, {}, 0.05),
+], ids=lambda v: getattr(v, "__name__", ""))
+def test_optimizer_converges(opt_cls, kw, olr):
+    w = _quadratic_problem()
+    opt = opt_cls(learning_rate=olr, parameters=[w], **kw)
+    for _ in range(200):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((w * w).sum().item()) < 1.0, opt_cls.__name__
+
+
+def test_sgd_exact_update():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = SGD(learning_rate=0.5, parameters=[w])
+    (2 * w).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.0])  # 1 - 0.5*2
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[w])
+    (0.0 * w).sum().backward()  # zero grad; only decay acts
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)],
+                               rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = _quadratic_problem()
+    opt = Adam(learning_rate=0.1, parameters=[w])
+    for _ in range(3):
+        (w * w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    w2 = paddle.to_tensor(w.numpy(), stop_gradient=False)
+    w2.name = "w0"
+    opt2 = Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)
+    (w * w).sum().backward(); opt.step(); opt.clear_grad()
+    (w2 * w2).sum().backward(); opt2.step(); opt2.clear_grad()
+    np.testing.assert_allclose(w.numpy(), w2.numpy(), rtol=1e-6)
+
+
+def test_lr_schedulers():
+    s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025],
+                               rtol=1e-6)
+
+    c = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(c() - 1.0) < 1e-6
+    for _ in range(10):
+        c.step()
+    assert c() < 1e-6
+
+    wu = lr_mod.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(wu())
+        wu.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075],
+                               rtol=1e-5)
+
+
+def test_scheduler_drives_optimizer():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    sched = lr_mod.StepDecay(0.5, step_size=1, gamma=0.1)
+    opt = SGD(learning_rate=sched, parameters=[w])
+    (w * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.5])
+    sched.step()
+    opt.clear_grad()
+    (w * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.45], rtol=1e-6)
+
+
+def test_amp_auto_cast():
+    import jax.numpy as jnp
+    x = paddle.rand([4, 4])
+    y = paddle.rand([4, 4])
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        z = paddle.matmul(x, y)
+        assert z.dtype == jnp.bfloat16
+        s = z.sum()           # black list -> fp32
+        assert s.dtype == jnp.float32
+    z2 = paddle.matmul(x, y)
+    assert z2.dtype == jnp.float32
+
+
+def test_grad_scaler_skips_inf():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    loss = (w * np.inf).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+    assert scaler._scale < 2.0  # backed off
+
+
+def test_amp_o2_decorate():
+    import jax.numpy as jnp
+    model = nn.Linear(4, 4)
+    opt = Adam(learning_rate=0.01, parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2")
+    assert model.weight.dtype == jnp.bfloat16
+    x = paddle.rand([2, 4]).astype("bfloat16")
+    with paddle.amp.auto_cast(level="O2"):
+        out = model(x)
+    loss = out.astype("float32").sum()
+    loss.backward()
+    opt.step()
+    # master weights stayed fp32 internally
+    st = opt._state[id(model.weight)]
+    assert st["master"].dtype == jnp.float32
+
+
+def test_dataloader():
+    from paddle_tpu.io import TensorDataset, DataLoader
+    X = paddle.rand([20, 3])
+    y = paddle.arange(20)
+    ds = TensorDataset([X, y])
+    dl = DataLoader(ds, batch_size=6, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == [6, 3]
+    assert batches[-1][0].shape == [2, 3]
+    # multi-worker prefetch path
+    dl2 = DataLoader(ds, batch_size=5, num_workers=2)
+    seen = sum(b[1].shape[0] for b in dl2)
+    assert seen == 20
+
+
+def test_distributed_batch_sampler():
+    from paddle_tpu.io import TensorDataset, DistributedBatchSampler
+    ds = TensorDataset([paddle.arange(10)])
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 5
+    assert set(i0) | set(i1) == set(range(10))
+
+
+def test_metric_accuracy():
+    from paddle_tpu.metric import Accuracy, accuracy
+    m = Accuracy()
+    pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    lab = paddle.to_tensor(np.array([[1], [1]], np.int64))
+    correct = m.compute(pred, lab)
+    m.update(correct)
+    assert abs(m.accumulate() - 0.5) < 1e-6
+    a = accuracy(pred, lab)
+    assert abs(a.item() - 0.5) < 1e-6
