@@ -1,0 +1,22 @@
+"""paddle_tpu.jit — dynamic-to-static compilation.
+
+Parity: python/paddle/jit/ (reference — @to_static api.py:171, AST
+transformer pipeline dy2static/, partial_program run_program op
+paddle/fluid/eager/to_static/run_program_op_node.h, jit.save/load +
+TranslatedLayer translated_layer.py).
+
+TPU-native design (SURVEY.md §7): the trace front-end is JAX itself — a
+to_static function traces the Python callable once per input signature into
+a jaxpr → StableHLO executable (the CINN/PIR lowering collapses into XLA).
+The compiled region participates in the eager tape as ONE GradNode whose VJP
+is the XLA-compiled backward (exactly the reference's run_program-op-as-
+GradNode design, §3.4) — so eager and compiled code mix freely.
+jit.save serializes the StableHLO executable + params; jit.load returns a
+TranslatedLayer.
+"""
+from .api import to_static, StaticFunction, not_to_static, ignore_module
+from .save_load import save, load, TranslatedLayer
+from .api import enable_to_static
+
+__all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
+           "not_to_static", "enable_to_static"]

@@ -1,0 +1,257 @@
+"""to_static implementation.
+
+See package docstring.  Key pieces:
+
+- ``StaticFunction``: wraps a python callable (or Layer method/Layer).  On
+  call it (1) gathers the state of every Layer reachable from the callable
+  (bound instance + closure scan), (2) traces a functionalized version under
+  ``jax.jit`` keyed on the input signature — the analog of the reference's
+  ProgramCache keyed on input spec (dy2static/program_translator.py), and
+  (3) dispatches through the eager tape via apply_op so ``backward()`` runs
+  the XLA-compiled VJP.
+- RNG: a fresh fold-in key is passed as a real input each call, so dropout
+  differs per step without retracing (reference analog: seed/offset fed to
+  curand per launch).
+- Guards/graph breaks (the SOT path, reference eval_frame.c) are not needed
+  for full-graph mode; data-dependent Python control flow raises a tracing
+  error like the reference's AST mode does for unsupported constructs.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ..nn.layer_base import Layer
+from ..ops import random as _random
+
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag: bool):
+    """Parity: paddle.jit.enable_to_static."""
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
+
+def not_to_static(fn=None):
+    """Parity: paddle.jit.not_to_static — marker, fn runs eagerly."""
+    if fn is None:
+        return not_to_static
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    """Parity shim: paddle.jit.ignore_module."""
+    return None
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _find_layers(fn) -> List[Layer]:
+    """Find Layer objects the callable closes over (bound self, closure
+    cells, defaults) — the analog of the reference's parameter collection in
+    partial_program."""
+    layers = []
+    seen = set()
+
+    def add(obj):
+        if isinstance(obj, Layer) and id(obj) not in seen:
+            seen.add(id(obj))
+            layers.append(obj)
+
+    if isinstance(fn, Layer):
+        add(fn)
+        return layers
+    add(getattr(fn, "__self__", None))
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                add(cell.cell_contents)
+            except ValueError:
+                pass
+    for v in (getattr(fn, "__defaults__", None) or ()):
+        add(v)
+    return layers
+
+
+def _leaf_sig(a):
+    """Signature of one flattened leaf.  Tensors key on shape/dtype;
+    python scalars key on value (they are baked into the trace); anything
+    else keys on repr so a changed value cannot hit a stale trace."""
+    if isinstance(a, Tensor):
+        return ("T", tuple(a._value.shape), str(a._value.dtype))
+    if isinstance(a, (int, float, str, bool, type(None))):
+        return ("P", a)
+    return ("P", repr(a))
+
+
+class StaticFunction:
+    """Compiled callable (parity: dy2static StaticFunction /
+    program_translator.py:776)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 full_graph=True):
+        self._fn = function
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Callable] = {}
+        self._layers: Optional[List[Layer]] = None
+        self.__name__ = getattr(function, "__name__", "static_fn")
+        functools.update_wrapper(self, function,
+                                 assigned=("__doc__", "__module__"),
+                                 updated=())
+
+    # -- introspection parity ------------------------------------------------
+    @property
+    def code(self):
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    def rollback(self):
+        return self._fn
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0] or getattr(self._fn, "_not_to_static",
+                                                False):
+            call = self._fn if not isinstance(self._fn, Layer) \
+                else self._fn.forward
+            return call(*args, **kwargs)
+
+        if self._layers is None:
+            self._layers = _find_layers(self._fn)
+
+        # gather state (params + buffers) of involved layers
+        state_items: List[Tuple[Layer, str, Tensor]] = []
+        for li, layer in enumerate(self._layers):
+            for k, t in layer.state_dict().items():
+                state_items.append((layer, k, t))
+
+        state_tensors = [t for _, _, t in state_items]
+        flat_args, arg_tree = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        # numpy-array leaves behave as tensor inputs (not baked constants)
+        flat_args = [Tensor(a) if isinstance(a, np.ndarray) else a
+                     for a in flat_args]
+        tensor_mask = [isinstance(a, Tensor) for a in flat_args]
+        tensor_args = [a for a in flat_args if isinstance(a, Tensor)]
+        static_args = [None if m else a
+                       for a, m in zip(flat_args, tensor_mask)]
+
+        # train/eval flags of every (sub)layer are part of the program key
+        modes = tuple(l.training for layer in self._layers
+                      for _, l in layer.named_sublayers(include_self=True))
+        sig = (str(arg_tree), tuple(_leaf_sig(a) for a in flat_args),
+               tuple((tuple(t._value.shape), str(t._value.dtype))
+                     for t in state_tensors), modes)
+
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            compiled = self._build(arg_tree, tensor_mask, static_args,
+                                   state_items)
+            self._cache[sig] = compiled
+
+        key = _random.next_key()
+        jit_fn, box = compiled
+        outs = apply_op(f"static_fn::{self.__name__}", jit_fn,
+                        (key, *state_tensors, *tensor_args))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        n_updates = len(box.get("updated_buffers", ()))
+        if n_updates:
+            # write mutated buffer values (BN running stats) back to their
+            # host tensors — the compiled-region analog of the reference's
+            # in-place running-stat outputs
+            buf_tensors = box["updated_buffers"]
+            for t, new in zip(buf_tensors, outs[len(outs) - n_updates:]):
+                t._value = new._value
+            outs = outs[: len(outs) - n_updates]
+        return jax.tree_util.tree_unflatten(box["tree"], list(outs))
+
+    def _build(self, arg_tree, tensor_mask, static_args, state_items):
+        fn = self._fn
+        layers = self._layers
+        n_state = len(state_items)
+        call = fn.forward if isinstance(fn, Layer) else fn
+        box: Dict[str, Any] = {}
+
+        def traced(key, *vals):
+            state_vals = vals[:n_state]
+            arg_vals = list(vals[n_state:])
+            # rebuild args structure
+            flat = []
+            it = iter(arg_vals)
+            for m, s in zip(tensor_mask, static_args):
+                flat.append(Tensor._from_value(next(it)) if m else s)
+            args, kwargs = jax.tree_util.tree_unflatten(arg_tree, flat)
+
+            # bind traced state into the layers
+            import contextlib
+            from ..nn.layer_base import Parameter
+            with contextlib.ExitStack() as stack:
+                offset = 0
+                bound = []
+                for layer in layers:
+                    sd = layer.state_dict()
+                    n = len(sd)
+                    sub = {k: v for (_, k, _), v in zip(
+                        state_items[offset:offset + n],
+                        state_vals[offset:offset + n])}
+                    stack.enter_context(layer.bind_state(sub))
+                    bound.append((layer, sd, sub))
+                    offset += n
+                stack.enter_context(_random.trace_rng_scope(key))
+                out = call(*args, **kwargs)
+
+                # collect buffer mutations made during the traced call
+                # (e.g. batch-norm running stats) before bind_state restores
+                upd_tensors, upd_vals = [], []
+                for layer, sd, sub in bound:
+                    for k, t in sd.items():
+                        if isinstance(t, Parameter):
+                            continue
+                        if k in sub and t._value is not sub[k]:
+                            upd_tensors.append(t)
+                            upd_vals.append(t._value)
+                box["updated_buffers"] = upd_tensors
+
+            flat, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            box["tree"] = tree
+            outs = tuple(t._value if isinstance(t, Tensor)
+                         else jnp.asarray(t) for t in flat)
+            return outs + tuple(upd_vals)
+
+        return jax.jit(traced), box
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Parity: @paddle.jit.to_static (python/paddle/jit/api.py:171)."""
+    def decorate(fn):
+        return StaticFunction(fn, input_spec, build_strategy, full_graph)
+
+    if function is None:
+        return decorate
+    return decorate(function)

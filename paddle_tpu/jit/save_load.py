@@ -1,0 +1,152 @@
+"""jit.save / jit.load.
+
+Parity: python/paddle/jit/api.py save/load + TranslatedLayer
+(python/paddle/jit/translated_layer.py) in the reference — a saved model is
+the serialized compiled program + parameters, loadable without the original
+Python class.
+
+TPU-native: the "program" is a serialized StableHLO executable
+(jax.export) — portable across processes and accelerators that XLA
+supports; params are saved with paddle_tpu.save.  Inference-only (the
+reference's jit.save also primarily targets deployment).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..framework_io import save as _save, load as _load
+from ..nn.layer_base import Layer
+from .api import StaticFunction, InputSpec
+
+
+def save(layer, path: str, input_spec=None, **configs):
+    """Parity: paddle.jit.save.  Produces path.json (meta), path.pdexec
+    (StableHLO), path.pdparams (state)."""
+    if isinstance(layer, StaticFunction):
+        static = layer
+        base_layer = static._fn if isinstance(static._fn, Layer) else None
+    elif isinstance(layer, Layer):
+        base_layer = layer
+        static = StaticFunction(layer)
+    else:
+        base_layer = None
+        static = StaticFunction(layer)
+
+    if input_spec is None:
+        raise ValueError(
+            "jit.save requires input_spec (list of InputSpec or example "
+            "Tensors) to trace the program")
+
+    from ..core import dtypes as _dt
+    examples = []      # ShapeDtypeStruct (possibly symbolic) per input
+    sym_count = [0]
+
+    def _sym_dim():
+        sym_count[0] += 1
+        return jax_export.symbolic_shape(f"d{sym_count[0]}")[0]
+
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(jax.ShapeDtypeStruct(tuple(spec._value.shape),
+                                                 spec._value.dtype))
+        elif isinstance(spec, InputSpec):
+            shape = tuple(_sym_dim() if (s is None or (isinstance(s, int)
+                                                       and s < 0)) else s
+                          for s in spec.shape)
+            examples.append(jax.ShapeDtypeStruct(
+                shape, _dt.convert_dtype(spec.dtype)))
+        else:
+            raise TypeError(f"bad input_spec entry {spec!r}")
+
+    # collect state — keys prefixed per layer so two closure layers with
+    # identical structured names cannot collide
+    if static._layers is None:
+        from .api import _find_layers
+        static._layers = _find_layers(static._fn)
+    state_items = []
+    for li, layer_ in enumerate(static._layers):
+        for k, t in layer_.state_dict().items():
+            state_items.append((f"l{li}.{k}", t))
+    for layer_ in static._layers:
+        layer_.eval()
+
+    call = static._fn.forward if isinstance(static._fn, Layer) else static._fn
+
+    def infer_fn(state_vals, arg_vals):
+        import contextlib
+        from ..ops import random as _random
+        with contextlib.ExitStack() as stack:
+            offset = 0
+            for layer_ in static._layers:
+                sd = layer_.state_dict()
+                n = len(sd)
+                sub = {k: v for k, v in zip(
+                    sd.keys(), state_vals[offset:offset + n])}
+                stack.enter_context(layer_.bind_state(sub))
+                offset += n
+            stack.enter_context(
+                _random.trace_rng_scope(jax.random.PRNGKey(0)))
+            out = call(*[Tensor._from_value(v) for v in arg_vals])
+        flat, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        return tuple(t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in flat)
+
+    state_vals = [t._value for _, t in state_items]
+    exported = jax_export.export(jax.jit(infer_fn))(state_vals, examples)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdexec", "wb") as f:
+        f.write(blob)
+    _save({k: t for k, t in state_items}, path + ".pdparams")
+    meta = {
+        "format": "paddle_tpu.jit.v1",
+        "state_keys": [k for k, _ in state_items],
+        "input_shapes": [[str(s) for s in t.shape] for t in examples],
+        "input_dtypes": [str(t.dtype) for t in examples],
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+class TranslatedLayer(Layer):
+    """Loaded compiled model (parity: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, exported, state: Dict[str, Tensor], meta: dict):
+        super().__init__()
+        self._exported = exported
+        self._meta = meta
+        self._state_keys = meta["state_keys"]
+        self._state = state
+        for k, t in state.items():
+            self.register_buffer(k.replace(".", "__"), t)
+
+    def forward(self, *inputs):
+        state_vals = [self._state[k]._value for k in self._state_keys]
+        arg_vals = [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in inputs]
+        outs = self._exported.call(state_vals, arg_vals)
+        outs = tuple(Tensor._from_value(o) for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    """Parity: paddle.jit.load."""
+    with open(path + ".pdexec", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    state = _load(path + ".pdparams")
+    with open(path + ".json") as f:
+        meta = json.load(f)
+    return TranslatedLayer(exported, state, meta)

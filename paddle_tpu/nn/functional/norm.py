@@ -49,8 +49,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     out = apply_op("batch_norm", fn,
                    (x, targ(running_mean), targ(running_var)) + wb)
 
+    # Under jit tracing the assigned values are tracers; StaticFunction
+    # collects them as extra outputs and writes them back after the step.
     if training and not use_stats and isinstance(running_mean, Tensor) \
-            and not isinstance(x._value, jax.core.Tracer):
+            and isinstance(x, Tensor):
         axes = tuple(i for i in range(x._value.ndim)
                      if i != (channel_axis % x._value.ndim))
         m = jnp.mean(x._value, axis=axes)

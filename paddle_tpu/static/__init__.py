@@ -1,0 +1,152 @@
+"""paddle_tpu.static — static-graph facade.
+
+Parity: python/paddle/static/ (reference Program/Executor surface,
+python/paddle/base/executor.py:1152) and the new executor's Plan-of-Jobs
+(paddle/fluid/framework/new_executor/interpreter/plan.h:31, SURVEY.md #29).
+
+TPU-native design: a "Program" is a compiled (jitted/exported) function; an
+Executor runs a Plan = typed Job list with a micro-batch count — the same
+host-side scheduling seam the reference uses for pipeline schedules
+(FThenB / 1F1B job lists, python/paddle/distributed/passes/
+pipeline_scheduler_pass.py), which paddle_tpu.distributed.pipeline builds
+on.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..jit.api import InputSpec, to_static, StaticFunction
+from ..core.tensor import Tensor
+
+__all__ = ["InputSpec", "Program", "Executor", "Job", "Plan",
+           "default_main_program", "program_guard", "name_scope", "amp"]
+
+
+class Job:
+    """One schedulable unit (parity: interpreter/job.h) — a compiled
+    callable plus its type tag (forward/backward/optimizer/send/recv...) and
+    micro-batch id."""
+
+    def __init__(self, type: str, fn: Callable = None, micro_batch_id: int = 0):
+        self.type = type
+        self.fn = fn
+        self.micro_batch_id = micro_batch_id
+
+    def run(self, *args, **kwargs):
+        if self.fn is None:
+            return None
+        return self.fn(*args, **kwargs)
+
+
+class Plan:
+    """Ordered job list (parity: interpreter/plan.h:31)."""
+
+    def __init__(self, jobs: List[Job], micro_batch_num: int = 1):
+        self.jobs = list(jobs)
+        self.micro_batch_num = micro_batch_num
+
+
+class Program:
+    """Thin program record (parity surface of paddle.static.Program).
+
+    Holds a traced callable; real compilation happens via jit/to_static.
+    Exists so code written against the reference's Program API has a home.
+    """
+
+    _counter = 0
+
+    def __init__(self, fn: Optional[Callable] = None, name: str = None):
+        Program._counter += 1
+        self.name = name or f"program_{Program._counter}"
+        self.fn = fn
+        self._is_start_up = False
+
+    def clone(self, for_test: bool = False):
+        return Program(self.fn, self.name + "_clone")
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return f"Program({self.name})"
+
+
+_MAIN_PROGRAM = Program(name="main")
+_STARTUP_PROGRAM = Program(name="startup")
+
+
+def default_main_program():
+    return _MAIN_PROGRAM
+
+
+def default_startup_program():
+    return _STARTUP_PROGRAM
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _MAIN_PROGRAM, _STARTUP_PROGRAM
+    old_m, old_s = _MAIN_PROGRAM, _STARTUP_PROGRAM
+    _MAIN_PROGRAM = main_program
+    if startup_program is not None:
+        _STARTUP_PROGRAM = startup_program
+    try:
+        yield
+    finally:
+        _MAIN_PROGRAM, _STARTUP_PROGRAM = old_m, old_s
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class Executor:
+    """Plan runner (parity: StandaloneExecutor,
+    paddle/fluid/framework/new_executor/standalone_executor.h:34).
+
+    run(program_or_plan, feed, fetch_list) executes either a single compiled
+    program or a Plan of Jobs over micro-batches.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        feed = feed or {}
+        if isinstance(program, Plan):
+            results = []
+            for job in program.jobs:
+                out = job.run(feed)
+                if out is not None:
+                    results.append(out)
+            return results
+        if isinstance(program, Program):
+            fn = program.fn
+        else:
+            fn = program
+        if fn is None:
+            return []
+        out = fn(**feed) if feed else fn()
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else o for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+# AMP sub-namespace parity (python/paddle/static/amp/)
+class _StaticAmp:
+    @staticmethod
+    def decorate(optimizer, **kw):
+        return optimizer
+
+
+amp = _StaticAmp()

@@ -1,0 +1,119 @@
+"""to_static + jit.save/load tests (reference analog: test/dygraph_to_static/
+end-to-end model tests compiled vs eager)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static, save as jit_save, load as jit_load
+from paddle_tpu.jit.api import InputSpec
+
+
+def _mlp():
+    paddle.seed(42)
+    return nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+def test_to_static_matches_eager():
+    m = _mlp()
+    x = paddle.rand([3, 4])
+    eager = m(x)
+    static = to_static(m)
+    out = static(x)
+    np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-5)
+
+
+def test_to_static_backward_through_compiled():
+    m = _mlp()
+    static = to_static(m)
+    x = paddle.rand([3, 4])
+    out = static(x)
+    out.sum().backward()
+    # grads landed on the ORIGINAL parameters (run_program-op semantics)
+    for p in m.parameters():
+        assert p.grad is not None
+    # compare with eager grads
+    g_static = [p.grad.numpy().copy() for p in m.parameters()]
+    m.clear_gradients()
+    m(x).sum().backward()
+    g_eager = [p.grad.numpy() for p in m.parameters()]
+    for a, b in zip(g_static, g_eager):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_training_loop():
+    m = _mlp()
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    step = to_static(lambda x, y: ((m(x) - y) ** 2).mean())
+    x = paddle.rand([8, 4])
+    y = paddle.rand([8, 2])
+    losses = []
+    for _ in range(10):
+        loss = step(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+    # one trace only (same signature)
+    assert len(step._cache) == 1
+
+
+def test_to_static_retraces_on_new_shape():
+    m = _mlp()
+    static = to_static(m)
+    static(paddle.rand([2, 4]))
+    static(paddle.rand([5, 4]))
+    assert len(static._cache) == 2
+
+
+def test_to_static_decorator_and_function():
+    lin = nn.Linear(3, 3)
+
+    @to_static
+    def f(a, b):
+        return paddle.matmul(lin(a), b) + 1
+
+    a = paddle.rand([2, 3])
+    b = paddle.rand([3, 2])
+    ref = paddle.matmul(lin(a), b) + 1
+    np.testing.assert_allclose(f(a, b).numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_to_static_dropout_differs_per_call():
+    drop = nn.Dropout(0.5)
+    static = to_static(drop)
+    x = paddle.ones([100])
+    a = static(x).numpy()
+    b = static(x).numpy()
+    assert not np.array_equal(a, b)
+    assert len(static._cache) == 1  # no retrace for new randomness
+
+
+def test_enable_to_static_off():
+    from paddle_tpu.jit import enable_to_static
+    m = _mlp()
+    static = to_static(m)
+    enable_to_static(False)
+    try:
+        out = static(paddle.rand([2, 4]))
+        assert len(static._cache) == 0
+    finally:
+        enable_to_static(True)
+
+
+def test_jit_save_load(tmp_path):
+    m = _mlp()
+    m.eval()
+    x = paddle.rand([3, 4])
+    ref = m(x)
+    path = str(tmp_path / "model")
+    jit_save(m, path, input_spec=[InputSpec([None, 4])])
+    loaded = jit_load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_jit_save_requires_spec(tmp_path):
+    with pytest.raises(ValueError):
+        jit_save(_mlp(), str(tmp_path / "m"))
