@@ -77,6 +77,32 @@ def _amp_cast(v, cast_to):
     return v
 
 
+def _harmonize_devices(vals: List[Any]) -> List[Any]:
+    """When one operand lives on a multi-device mesh and another on a single
+    device, replicate the single-device operand onto the mesh (the eager
+    analog of the reference's data-transform copy-in,
+    paddle/phi/api/lib/data_transform.cc)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = None
+    for v in vals:
+        if isinstance(v, Array) and not _is_tracer(v) \
+                and isinstance(v.sharding, NamedSharding) \
+                and v.sharding.mesh.devices.size > 1:
+            mesh = v.sharding.mesh
+            break
+    if mesh is None:
+        return vals
+    out = []
+    replicated = NamedSharding(mesh, PartitionSpec())
+    for v in vals:
+        if isinstance(v, Array) and not _is_tracer(v) \
+                and len(v.devices()) == 1:
+            v = jax.device_put(v, replicated)
+        out.append(v)
+    return out
+
+
 def apply_op(name: str, fn: Callable, tensor_args: Sequence,
              kwargs: Optional[Dict[str, Any]] = None,
              multi_output: bool = False):
@@ -100,6 +126,7 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
             vals.append(a)
 
     cast_to = _amp_cast_dtype(name)
+    vals = _harmonize_devices(vals)
 
     tracing = any(_is_tracer(v) for v in vals)
     need_grad = (not tracing) and _tape.is_grad_enabled() and any(

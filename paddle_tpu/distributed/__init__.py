@@ -1,0 +1,41 @@
+"""paddle_tpu.distributed — the hybrid-parallel stack.
+
+Parity: python/paddle/distributed/ (reference, SURVEY.md #35-54):
+collectives, ProcessMesh/DistTensor semi-auto API, fleet hybrid engine
+(dp/tp/pp/sharding/sep), recompute, distributed checkpoint, launch.
+
+TPU-native execution model: single-controller SPMD over jax.sharding
+meshes; collectives are XLA collectives over ICI/DCN; reshard =
+sharding transition; grad sync falls out of GSPMD.
+"""
+from .env import (init_parallel_env, get_rank, get_world_size, ParallelEnv,
+                  device_count)
+from .process_mesh import (ProcessMesh, Shard, Replicate, Partial, Placement,
+                           get_mesh, set_mesh)
+from .api import (shard_tensor, dtensor_from_fn, reshard, shard_layer,
+                  shard_optimizer, unshard_dtensor)
+from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
+                         all_gather, all_gather_object, broadcast, reduce,
+                         reduce_scatter, all_to_all, alltoall,
+                         all_to_all_single, scatter, gather, send, recv,
+                         isend, irecv, barrier, wait, ppermute,
+                         is_initialized, destroy_process_group)
+from .parallel import DataParallel, spawn
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group)
+from . import fleet
+from . import checkpoint
+from .fleet.meta_parallel.sharding_api import group_sharded_parallel, \
+    save_group_sharded_model
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+    "shard_optimizer", "unshard_dtensor",
+    "ReduceOp", "new_group", "all_reduce", "all_gather", "broadcast",
+    "reduce", "reduce_scatter", "all_to_all", "scatter", "gather",
+    "send", "recv", "barrier", "wait",
+    "DataParallel", "spawn", "fleet", "checkpoint",
+    "group_sharded_parallel",
+]

@@ -1,0 +1,177 @@
+"""Semi-auto parallel DistTensor API.
+
+Parity: python/paddle/distributed/auto_parallel/api.py (reference —
+shard_tensor :118, dtensor_from_fn :262, reshard :296, shard_layer :395,
+shard_optimizer, dist to_static :1366) and the C++ DistTensor (#24).
+
+TPU-native: a DistTensor IS a Tensor whose jax.Array carries a
+NamedSharding; per-op SPMD propagation + reshard-on-demand (reference
+§3.6) is GSPMD's job — both eager (jax computes on sharded arrays and
+inserts collectives) and under jit (sharding propagation in one HLO
+module).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+from .process_mesh import (ProcessMesh, Placement, Shard, Replicate, Partial,
+                           placements_to_spec, spec_to_placements, get_mesh)
+
+
+def _to_named_sharding(mesh: ProcessMesh, placements, ndim):
+    spec = placements_to_spec(mesh, placements, ndim)
+    return NamedSharding(mesh.jax_mesh, spec)
+
+
+def _place_value(val, mesh, placements, ndim):
+    sharding = _to_named_sharding(mesh, placements, ndim)
+    if isinstance(val, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(val, sharding)
+    return jax.device_put(val, sharding)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Parity: paddle.distributed.shard_tensor (api.py:118).  Returns a NEW
+    dist tensor (the input is left untouched, like the reference)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    placements = list(placements)
+    val = _place_value(t._value, mesh, placements, t._value.ndim)
+    out = Tensor._from_value(val)
+    out.stop_gradient = t.stop_gradient if stop_gradient is None \
+        else stop_gradient
+    out._grad_node = t._grad_node
+    out._out_index = t._out_index
+    out._process_mesh = mesh
+    out._placements = placements
+    return out
+
+
+def shard_param_(param: Tensor, mesh: ProcessMesh,
+                 placements: Sequence[Placement]) -> Tensor:
+    """In-place variant used by parallel layers to annotate their own
+    parameters (keeps the Parameter object identity that optimizers and
+    state_dicts hold)."""
+    placements = list(placements)
+    param._value = _place_value(param._value, mesh, placements,
+                                param._value.ndim)
+    param._process_mesh = mesh
+    param._placements = placements
+    return param
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements, *args,
+                    **kwargs) -> Tensor:
+    """Parity: dtensor_from_fn (api.py:262)."""
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """Parity: paddle.distributed.reshard (api.py:296).  XLA emits the
+    all-gather/all-to-all/slice the placement transition implies — the
+    whole pairwise reshard-function registry of the reference collapses
+    into this one device_put."""
+    placements = list(placements)
+    ndim = x._value.ndim
+
+    val = x._value
+    src_placements = getattr(x, "_placements", None)
+    # materialize pending partial-reductions first (reference p->r / p->s)
+    if src_placements is not None:
+        for mesh_dim, p in enumerate(src_placements):
+            if isinstance(p, Partial):
+                axis = mesh.dim_names[mesh_dim]
+                val = _reduce_partial_axis(val, mesh, mesh_dim,
+                                           p.reduce_type)
+
+    sharding = _to_named_sharding(mesh, placements, ndim)
+    if isinstance(val, jax.core.Tracer):
+        val = jax.lax.with_sharding_constraint(val, sharding)
+    else:
+        val = jax.device_put(val, sharding)
+    out = Tensor._from_value(val)
+    out.stop_gradient = x.stop_gradient
+    out._grad_node = x._grad_node
+    out._out_index = x._out_index
+    out._process_mesh = mesh
+    out._placements = placements
+    return out
+
+
+def _reduce_partial_axis(val, mesh, mesh_dim, reduce_type):
+    """Reduce partial values over one mesh axis.  The partial halves live
+    concatenated along a synthetic leading layout; for the eager tensor
+    model we store partials as fully-materialized per-device values, so a
+    reduction is a psum under shard_map."""
+    from jax import shard_map
+    axis = mesh.dim_names[mesh_dim]
+    spec = PartitionSpec(*([None] * val.ndim))
+    red = {"sum": jax.lax.psum, "avg": jax.lax.pmean,
+           "max": jax.lax.pmax, "min": jax.lax.pmin}[reduce_type]
+
+    def f(v):
+        return red(v, axis)
+
+    return shard_map(f, mesh=mesh.jax_mesh, in_specs=spec,
+                     out_specs=spec)(val)
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None) -> Layer:
+    """Parity: paddle.distributed.shard_layer (api.py:395).  Applies
+    shard_fn(name, layer, mesh) to every sublayer; default replicates all
+    params onto the mesh."""
+    def default_shard_fn(name, sublayer, mesh):
+        for pname, p in sublayer._parameters.items():
+            if p is not None and p.placements is None:
+                shard_tensor(p, mesh, [Replicate()
+                                       for _ in mesh.dim_names])
+
+    fn = shard_fn or default_shard_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Parity: paddle.distributed.shard_optimizer — optimizer states are
+    created sharded like their parameters (weight-update sharding falls out
+    of GSPMD; see PAPERS.md automatic cross-replica sharding)."""
+    orig_ensure = optimizer._ensure_state
+
+    def ensure(p):
+        st = orig_ensure(p)
+        mesh = getattr(p, "_process_mesh", None)
+        if mesh is not None:
+            for k, v in st.items():
+                if hasattr(v, "ndim") and v.ndim == p._value.ndim:
+                    st[k] = jax.device_put(v, p._value.sharding)
+        return st
+
+    optimizer._ensure_state = ensure
+    return optimizer
+
+
+def unshard_dtensor(x: Tensor) -> Tensor:
+    """Parity: paddle.distributed.unshard_dtensor — gather to replicated."""
+    mesh = getattr(x, "_process_mesh", None)
+    if mesh is None:
+        return x
+    return reshard(x, mesh, [Replicate() for _ in mesh.dim_names])

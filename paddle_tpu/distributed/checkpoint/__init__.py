@@ -1,0 +1,3 @@
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+from .metadata import Metadata, LocalTensorMetadata, LocalTensorIndex

@@ -1,0 +1,68 @@
+"""Sharded checkpoint load with resharding.
+
+Parity: python/paddle/distributed/checkpoint/load_state_dict.py (reference)
+— assemble each tensor from its saved shards per the Metadata index, then
+reshard onto the target tensor's current placement (possibly a different
+mesh/strategy than at save time).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .metadata import Metadata
+
+
+def load_state_dict(state_dict: Dict[str, Tensor], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None, offload=False):
+    """Parity: paddle.distributed.checkpoint.load_state_dict — fills the
+    given ``state_dict`` tensors in place."""
+    meta_files = glob.glob(os.path.join(path, "*.metadata"))
+    if not meta_files:
+        raise FileNotFoundError(f"no .metadata file under {path}")
+    with open(meta_files[0], "rb") as f:
+        meta: Metadata = pickle.load(f)
+
+    shards: Dict = {}
+    for fname in glob.glob(os.path.join(path, "*.distcp")):
+        with open(fname, "rb") as f:
+            shards.update(pickle.load(f))
+
+    for key, target in state_dict.items():
+        if key not in meta.state_dict_metadata:
+            raise KeyError(f"tensor {key!r} not present in checkpoint")
+        metas = meta.state_dict_metadata[key]
+        # reconstruct the global array from shards
+        global_shape = tuple(
+            max(m.global_offset[d] + m.local_shape[d] for m in metas)
+            for d in range(len(metas[0].local_shape)))
+        dtype_name = metas[0].dtype
+        np_dtype = np.uint16 if dtype_name == "bfloat16" else \
+            np.dtype(dtype_name)
+        full = np.zeros(global_shape, np_dtype)
+        for m in metas:
+            arr, _ = shards[(key, m.global_offset)]
+            sl = tuple(slice(o, o + s)
+                       for o, s in zip(m.global_offset, m.local_shape))
+            full[sl] = arr
+        if dtype_name == "bfloat16":
+            full = full.view(jnp.bfloat16)
+        val = jnp.asarray(full)
+        if isinstance(target, Tensor):
+            # reshard onto the target's current sharding
+            if hasattr(target._value, "sharding") and \
+                    not isinstance(target._value, jax.core.Tracer):
+                val = jax.device_put(val, target._value.sharding)
+            target._value = val.astype(target._value.dtype)
+        else:
+            state_dict[key] = Tensor._from_value(val)
+    return state_dict

@@ -1,0 +1,337 @@
+"""Collective communication API.
+
+Parity: python/paddle/distributed/communication/ (reference — all_reduce.py:19
+et al.) over the ProcessGroup family (#35, process_group.h:47 — AllGather/
+AllReduce/AllToAll/Barrier/Broadcast/Reduce/ReduceScatter/Scatter/Gather/
+Send/Recv).
+
+TPU-native (ProcessGroupXLA): collectives are XLA collectives over ICI/DCN.
+Two execution contexts:
+- inside a shard_map/pjit trace with a named mesh axis: lax.psum /
+  all_gather / all_to_all / ppermute are emitted into the module;
+- eager on sharded global arrays: expressed as resharding (device_put /
+  with_sharding_constraint) — XLA inserts the transfer collectives.
+
+A Group names a mesh axis (the analog of an NCCL communicator over the
+ranks of that axis).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from .process_mesh import ProcessMesh, Replicate, Shard, Partial
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_GROUP_COUNTER = [0]
+_GROUPS = {}
+
+
+class Group:
+    """Communicator handle (parity: paddle.distributed.communication.group.
+    Group).  Over a mesh axis when available; otherwise a plain rank list."""
+
+    def __init__(self, ranks: Sequence[int], mesh: Optional[ProcessMesh] = None,
+                 axis_name: Optional[str] = None, gid: int = 0):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.id = gid
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def rank(self):
+        from .env import get_rank
+        return self.get_group_rank(get_rank())
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+_DEFAULT_GROUP: Optional[Group] = None
+
+
+def _world_group() -> Group:
+    global _DEFAULT_GROUP
+    if _DEFAULT_GROUP is None:
+        n = jax.device_count()
+        mesh = ProcessMesh(shape=[n], dim_names=["world"])
+        _DEFAULT_GROUP = Group(list(range(n)), mesh, "world", 0)
+    return _DEFAULT_GROUP
+
+
+def new_group(ranks=None, backend=None, timeout=None,
+              mesh: Optional[ProcessMesh] = None,
+              axis_name: Optional[str] = None) -> Group:
+    """Parity: paddle.distributed.new_group."""
+    _GROUP_COUNTER[0] += 1
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    g = Group(list(ranks), mesh, axis_name, _GROUP_COUNTER[0])
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Group:
+    return _GROUPS.get(gid, _world_group())
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group: Optional[Group]):
+    g = group or _world_group()
+    return g.axis_name or "world"
+
+
+def is_initialized():
+    return True
+
+
+def destroy_process_group(group=None):
+    global _DEFAULT_GROUP
+    _DEFAULT_GROUP = None
+    _GROUPS.clear()
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def _reduce_fn(op):
+    return {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+            ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean,
+            "sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+            "avg": lax.pmean}[op]
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
+               sync_op: bool = True):
+    """Parity: paddle.distributed.all_reduce (in place on `tensor`).
+
+    - traced value with a live mesh axis -> lax.psum over the axis
+    - eager DistTensor with Partial placement -> materialize reduction
+    - eager replicated / single-rank -> identity (values already equal)
+    """
+    val = tensor._value
+    if _in_trace(val):
+        axis = _axis(group)
+        out = apply_op("all_reduce",
+                       lambda v: _reduce_fn(op)(v, axis), (tensor,))
+        tensor._inplace_assign(out)
+        return tensor
+    placements = getattr(tensor, "_placements", None)
+    if placements is not None and any(p.is_partial() for p in placements):
+        from .api import reshard
+        mesh = tensor._process_mesh
+        new_pl = [Replicate() if p.is_partial() else p for p in placements]
+        out = reshard(tensor, mesh, new_pl)
+        tensor._inplace_assign(out)
+        tensor._placements = new_pl
+        return tensor
+    return tensor  # replicated global array: already reduced by GSPMD
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
+               sync_op: bool = True, axis: int = 0):
+    """Parity: paddle.distributed.all_gather (fills tensor_list)."""
+    g = group or _world_group()
+    val = tensor._value
+    if _in_trace(val):
+        gathered = apply_op(
+            "all_gather",
+            lambda v: lax.all_gather(v, _axis(g), tiled=False), (tensor,))
+        for i in range(g.nranks):
+            tensor_list.append(gathered[i])
+        return tensor_list
+    placements = getattr(tensor, "_placements", None)
+    if placements is not None:
+        from .api import reshard
+        mesh = tensor._process_mesh
+        rep = reshard(tensor, mesh, [Replicate() for _ in mesh.dim_names])
+        # each "rank" slice along the sharded dim
+        shard_dims = [p.dim for p in placements if isinstance(p, Shard)]
+        if shard_dims:
+            from ..ops.manipulation import split
+            parts = split(rep, g.nranks, axis=shard_dims[0])
+            tensor_list.extend(parts)
+        else:
+            tensor_list.extend([rep] * g.nranks)
+        return tensor_list
+    tensor_list.extend([tensor] * g.nranks)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _world_group()
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
+              sync_op: bool = True):
+    """Parity: paddle.distributed.broadcast.  Single-controller global
+    arrays are already consistent; sharded tensors get replicated."""
+    placements = getattr(tensor, "_placements", None)
+    if placements is not None and not all(p.is_replicate()
+                                          for p in placements):
+        from .api import reshard
+        mesh = tensor._process_mesh
+        out = reshard(tensor, mesh, [Replicate() for _ in mesh.dim_names])
+        tensor._inplace_assign(out)
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group: Group = None, sync_op=True):
+    """Parity: paddle.distributed.reduce_scatter."""
+    g = group or _world_group()
+    inp = tensor_or_tensor_list
+    if isinstance(inp, (list, tuple)):
+        from ..ops.manipulation import concat
+        inp = concat(list(inp), axis=0)
+    val = inp._value
+    if _in_trace(val):
+        out = apply_op(
+            "reduce_scatter",
+            lambda v: lax.psum_scatter(v, _axis(g), scatter_dimension=0,
+                                       tiled=True), (inp,))
+        tensor._inplace_assign(out)
+        return tensor
+    # eager: sum partials then take this logical shard = sharded layout
+    from .api import reshard, shard_tensor
+    mesh = getattr(inp, "_process_mesh", None)
+    if mesh is not None:
+        out = reshard(inp, mesh, [Shard(0)])
+        tensor._inplace_assign(out)
+        tensor._process_mesh = mesh
+        tensor._placements = [Shard(0)]
+        return tensor
+    tensor._inplace_assign(inp)
+    return tensor
+
+
+def all_to_all(out_tensor_list: List, in_tensor_list: List,
+               group: Group = None, sync_op=True):
+    """Parity: paddle.distributed.alltoall."""
+    g = group or _world_group()
+    from ..ops.manipulation import stack, unbind
+    stacked = stack(list(in_tensor_list), axis=0)
+    val = stacked._value
+    if _in_trace(val):
+        out = apply_op(
+            "all_to_all",
+            lambda v: lax.all_to_all(v, _axis(g), split_axis=0,
+                                     concat_axis=0, tiled=False),
+            (stacked,))
+        out_tensor_list.extend(unbind(out, axis=0))
+        return out_tensor_list
+    # eager single-controller: the permutation is an identity re-grouping
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+alltoall = all_to_all
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    g = group or _world_group()
+    val = in_tensor._value
+    if _in_trace(val):
+        out = apply_op(
+            "all_to_all_single",
+            lambda v: lax.all_to_all(
+                v.reshape((g.nranks, -1) + v.shape[1:]), _axis(g),
+                split_axis=0, concat_axis=0,
+                tiled=False).reshape(v.shape), (in_tensor,))
+        out_tensor._inplace_assign(out)
+        return out_tensor
+    out_tensor._inplace_assign(in_tensor)
+    return out_tensor
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
+            sync_op=True):
+    g = group or _world_group()
+    if tensor_list:
+        from .env import get_rank
+        tensor._inplace_assign(tensor_list[g.get_group_rank(get_rank())
+                                           if g.get_group_rank(
+                                               get_rank()) >= 0 else 0])
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        all_gather(gather_list, tensor, group)
+    return gather_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — on TPU p2p inside compiled code is collective-permute;
+    host-side eager p2p between stages is handled by the pipeline engine.
+    Single-controller eager send is a no-op marker."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+isend = send
+irecv = recv
+
+
+def ppermute(tensor: Tensor, perm: List, group: Group = None):
+    """collective_permute (TPU-native extra; rides ICI neighbors)."""
+    g = group or _world_group()
+    val = tensor._value
+    if _in_trace(val):
+        return apply_op(
+            "ppermute", lambda v: lax.ppermute(v, _axis(g), perm), (tensor,))
+    return tensor
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _in_trace(tensor._value):
+        jax.block_until_ready(tensor._value)
+    return tensor
+
+
+def stream_all_reduce(*a, **k):
+    return all_reduce(*a, **k)

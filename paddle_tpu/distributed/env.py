@@ -1,0 +1,89 @@
+"""Distributed environment / bootstrap.
+
+Parity: python/paddle/distributed/parallel.py init_parallel_env +
+paddle/phi/core/distributed/store/tcp_store.h rendezvous (reference #25).
+
+TPU-native: bootstrap is JAX's coordination service
+(jax.distributed.initialize) — the TCPStore analog.  Under the
+single-controller SPMD model one process drives many devices; "rank" maps
+to process_index and "world size" to process_count for multi-host, while
+device-level parallelism is expressed through meshes, not ranks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_INITIALIZED = [False]
+
+
+def init_parallel_env():
+    """Parity: paddle.distributed.init_parallel_env."""
+    if _INITIALIZED[0]:
+        return
+    # Multi-host: honour the reference's env-var contract
+    # (PADDLE_TRAINER_ENDPOINTS etc.) mapped to the coordination service.
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ENDPOINT")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if coord and nnodes > 1 and jax.process_count() == 1:
+        # fail fast — a silent fallback would train nnodes independent
+        # un-synchronized replicas
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nnodes,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _INITIALIZED[0] = True
+
+
+def get_rank(group=None) -> int:
+    """Process rank (parity: paddle.distributed.get_rank)."""
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    """Parity: paddle.distributed.get_world_size — number of processes
+    (device-level parallel degrees live in the mesh)."""
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

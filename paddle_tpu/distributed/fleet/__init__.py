@@ -1,0 +1,16 @@
+"""Fleet — the hybrid-parallel engine.
+
+Parity: python/paddle/distributed/fleet/ (reference — fleet.init,
+distributed_model fleet/model.py:32,141-160, distributed_optimizer,
+DistributedStrategy fleet/base/distributed_strategy.py).
+"""
+from .base import (init, DistributedStrategy, distributed_model,
+                   distributed_optimizer, get_hybrid_communicate_group,
+                   worker_index, worker_num, is_first_worker)
+from ..topology import HybridCommunicateGroup, CommunicateTopology
+from .recompute import recompute, recompute_sequential
+from . import meta_parallel
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "recompute", "meta_parallel"]

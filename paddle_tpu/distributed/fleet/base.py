@@ -1,0 +1,161 @@
+"""fleet.init / strategy / wrappers.
+
+Parity: python/paddle/distributed/fleet/fleet.py + base/distributed_strategy.py
+(reference; strategy proto paddle/fluid/framework/distributed_strategy.proto
+with hybrid degrees at :97-103 and feature toggles at :362-414).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ..topology import (CommunicateTopology, HybridCommunicateGroup, AXES,
+                        create_hybrid_group, get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+from ..env import init_parallel_env, get_rank, get_world_size
+
+
+class DistributedStrategy:
+    """Parity: fleet DistributedStrategy (protobuf-backed in the reference;
+    a plain config object here with the same field names)."""
+
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {}
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1,
+                                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.without_graph_optimization = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        """Parity: fleet.init."""
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        self._hcg = create_hybrid_group(
+            dp=hc.get("dp_degree", 1), pp=hc.get("pp_degree", 1),
+            sharding=hc.get("sharding_degree", 1),
+            sep=hc.get("sep_degree", 1), mp=hc.get("mp_degree", 1))
+        self._is_initialized = True
+        return self
+
+    @property
+    def strategy(self):
+        return self._strategy
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        jax.effects_barrier()
+
+    def distributed_model(self, model: Layer):
+        """Parity: fleet.distributed_model (fleet/model.py:32,141-160) —
+        dispatch to the wrapper matching the parallel degrees."""
+        if not self._is_initialized:
+            self.init()
+        hcg = self._hcg
+        from .meta_parallel import (TensorParallel, PipelineParallel,
+                                    SegmentParallel)
+        from ..parallel import DataParallel
+        if hcg.get_pipe_parallel_world_size() > 1 and \
+                isinstance(model, _pipeline_layer_cls()):
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sep_parallel_world_size() > 1:
+            return SegmentParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1 or \
+                hcg.get_sharding_parallel_world_size() > 1:
+            return DataParallel(model, hcg=hcg)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Parity: fleet.distributed_optimizer → HybridParallelOptimizer /
+        DygraphShardingOptimizer."""
+        if not self._is_initialized:
+            self.init(strategy=strategy)
+        hcg = self._hcg
+        from .meta_optimizers import (HybridParallelOptimizer,
+                                      DygraphShardingOptimizer)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return DygraphShardingOptimizer(optimizer, hcg)
+        return HybridParallelOptimizer(optimizer, hcg,
+                                       self._strategy)
+
+
+def _pipeline_layer_cls():
+    from .meta_parallel.pp_layers import PipelineLayer
+    return PipelineLayer
+
+
+fleet = _Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, **kw):
+    return fleet.init(role_maker, is_collective, strategy, **kw)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group() or \
+        __import__("paddle_tpu.distributed.topology",
+                   fromlist=["get_hybrid_communicate_group"]
+                   ).get_hybrid_communicate_group()
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()

@@ -1,0 +1,126 @@
+"""Pipeline layer partitioning.
+
+Parity: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py (reference — PipelineLayer :56,237 partitioning a LayerDesc
+list, SharedLayerDesc :76 for tied weights).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ....nn.layer_base import Layer
+from ....nn.layers import LayerList, Sequential
+
+
+class LayerDesc:
+    """Deferred layer construction record (reference pp_layers.py:37)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer across stages (reference pp_layers.py:76) —
+    under single-controller SPMD the shared module is literally the same
+    object, so tying is free."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Parity: PipelineLayer (reference pp_layers.py:56).
+
+    Accepts a list of LayerDesc / Layer / callables, partitions them into
+    ``num_stages`` segments (uniform by count, or by seg_method), builds
+    each stage as a Sequential.  The PipelineParallel engine schedules the
+    stages; shared descs resolve to one instance.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._recompute_interval = recompute_interval
+        descs = list(layers)
+        self._shared: dict = {}
+
+        built: List[Any] = []
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer) or callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline layer entry {d!r}")
+
+        self._items = built
+        # uniform partition by layer count (reference's seg_method default)
+        bounds = np.linspace(0, len(built), self._num_stages + 1
+                             ).astype(int).tolist()
+        self._stage_bounds = bounds
+        self._stages: List[List] = [
+            built[bounds[i]:bounds[i + 1]] for i in range(self._num_stages)]
+
+        # register modules so parameters are discoverable
+        mods = LayerList()
+        for m, _ in built:
+            if isinstance(m, Layer):
+                mods.append(m)
+        self.layers = mods
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id):
+        return self._stages[stage_id]
+
+    def stage_parameters(self, stage_id):
+        params = []
+        for m, _ in self._stages[stage_id]:
+            if isinstance(m, Layer):
+                params.extend(m.parameters())
+        return params
+
+    def _run_items(self, items, x):
+        for m, ffn in items:
+            if ffn is not None:
+                x = ffn(m, x)
+            elif isinstance(m, Layer) or callable(m):
+                x = m(x)
+        return x
+
+    def forward_stage(self, stage_id, x):
+        return self._run_items(self._stages[stage_id], x)
+
+    def forward(self, x):
+        """Full sequential forward (used off-pipeline and for parity
+        tests)."""
+        return self._run_items(self._items, x)
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(output, label)

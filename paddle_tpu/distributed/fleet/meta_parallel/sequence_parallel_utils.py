@@ -1,0 +1,124 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(reference — ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers
+:85-144, Column/RowSequenceParallelLinear :230,:340, SP-param allreduce
+hooks :192).
+
+TPU-native: the scatter/gather pairs are sharding transitions of the
+sequence dim over the model axis — XLA emits reduce-scatter/all-gather; the
+hand-written PyLayer grads of the reference are exactly what GSPMD derives
+automatically for these transitions.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+from ....nn.layer_base import Layer
+from ....nn import functional as F
+from ....nn import initializer as I
+from ...process_mesh import Shard, Replicate
+from ...api import shard_tensor, shard_param_, reshard
+from ...topology import get_hybrid_communicate_group
+from .mp_layers import _mp_mesh, _mesh_placements
+
+
+_SEQ_DIM = 1  # [b, s, h] paddle layout; reference scatters dim 0 of [s,b,h]
+
+
+def scatter(x, axis=_SEQ_DIM):
+    """ScatterOp: split the sequence dim across the model axis."""
+    mesh, maxis = _mp_mesh()
+    return reshard(x, mesh, _mesh_placements(mesh, maxis, Shard(axis)))
+
+
+def all_gather(x, axis=_SEQ_DIM):
+    """GatherOp/AllGatherOp: restore the full sequence."""
+    mesh, maxis = _mp_mesh()
+    return reshard(x, mesh, _mesh_placements(mesh, maxis, Replicate()))
+
+
+def reduce_scatter(x, axis=_SEQ_DIM):
+    """ReduceScatterOp: sum partials and shard the sequence dim."""
+    mesh, maxis = _mp_mesh()
+    return reshard(x, mesh, _mesh_placements(mesh, maxis, Shard(axis)))
+
+
+ScatterOp = type("ScatterOp", (), {"apply": staticmethod(scatter)})
+GatherOp = type("GatherOp", (), {"apply": staticmethod(all_gather)})
+AllGatherOp = type("AllGatherOp", (), {"apply": staticmethod(all_gather)})
+ReduceScatterOp = type("ReduceScatterOp", (),
+                       {"apply": staticmethod(reduce_scatter)})
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Reference :192 — grads of SP params need an extra mp-axis allreduce.
+    Under GSPMD the grad of a replicated param used by sharded activations
+    is already fully reduced, so this is a no-op kept for API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Reference :230 — all-gather sequence shards, then column-parallel
+    matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        mesh, axis = _mp_mesh()
+        self._mesh, self._axis = mesh, axis
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        shard_param_(self.weight, mesh,
+                     _mesh_placements(mesh, axis, Shard(1)))
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            shard_param_(self.bias, mesh,
+                         _mesh_placements(mesh, axis, Shard(0)))
+
+    def forward(self, x):
+        x = all_gather(x)  # [b, s/mp, h] -> [b, s, h]
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = reshard(out, self._mesh,
+                          _mesh_placements(self._mesh, self._axis,
+                                           Replicate()))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Reference :340 — row-parallel matmul, then reduce-scatter over the
+    sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        mesh, axis = _mp_mesh()
+        self._mesh, self._axis = mesh, axis
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        shard_param_(self.weight, mesh,
+                     _mesh_placements(mesh, axis, Shard(0)))
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = reduce_scatter(out)  # sum partials + shard seq dim
+        if self.bias is not None:
+            out = out + self.bias
+        return out

@@ -1,0 +1,53 @@
+"""group_sharded_parallel entry.
+
+Parity: python/paddle/distributed/sharding/group_sharded.py (reference —
+paddle.distributed.sharding.group_sharded_parallel dispatching to
+stage2/stage3 wrappers, SURVEY.md #45).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....nn.layer_base import Layer
+from .sharding import (GroupShardedStage2, GroupShardedStage3,
+                       GroupShardedOptimizerStage2)
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str,
+                           scaler=None, group=None, offload=False,
+                           sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """Parity: paddle.distributed.sharding.group_sharded_parallel.
+
+    level: 'os' (stage1), 'os_g' (stage2), 'p_g_os' (stage3).
+    Returns (model, optimizer, scaler) like the reference.
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"bad sharding level {level!r}")
+    if level in ("os", "os_g"):
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          group=group, offload=offload)
+        model = GroupShardedStage2(model, opt, group=group,
+                                   sync_buffers=sync_buffers,
+                                   buffer_max_size=buffer_max_size)
+        return model, opt, scaler
+    model = GroupShardedStage3(model, optimizer=optimizer, group=group,
+                               sync_buffers=sync_buffers,
+                               segment_size=segment_size, offload=offload)
+    opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                      group=group, offload=offload)
+    return model, opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Parity: paddle.distributed.sharding.save_group_sharded_model."""
+    import os
+    from ....framework_io import save
+    from ...api import unshard_dtensor
+    os.makedirs(output, exist_ok=True)
+    inner = model._layers if hasattr(model, "_layers") else model
+    sd = {k: unshard_dtensor(v) for k, v in inner.state_dict().items()}
+    save(sd, os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

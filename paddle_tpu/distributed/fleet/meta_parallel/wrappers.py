@@ -1,0 +1,80 @@
+"""Meta-parallel model wrappers.
+
+Parity: python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py and
+segment_parallel.py:26 (reference).  In the reference these wrappers
+broadcast parameters/inputs across the relevant comm groups at init; under
+single-controller SPMD global arrays are born consistent, so the wrappers
+(1) annotate shardings and (2) keep the API surface.
+"""
+from __future__ import annotations
+
+from ....nn.layer_base import Layer
+from ...process_mesh import Shard, Replicate
+from ...api import shard_tensor
+from .mp_layers import _mesh_placements
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers", {}).get(
+                "_layers"), name)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Parity: meta_parallel/tensor_parallel.py — in the reference this
+    broadcasts non-distributed params across the mp group; here those params
+    are replicated global arrays already.  Params the mp layers marked
+    is_distributed keep their model-axis shardings."""
+
+
+class SegmentParallel(_MetaParallelBase):
+    """Parity: segment_parallel.py:26 — shards the sequence dim of inputs
+    over the 'sep' axis; attention must be seq-shard-friendly (the flash /
+    ring kernels are)."""
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._hcg.mesh
+        sep_axis = mesh.dim_names.index("sep")
+        new_inputs = []
+        for x in inputs:
+            if hasattr(x, "_value") and x._value.ndim >= 2:
+                x = shard_tensor(x, mesh,
+                                 _mesh_placements(mesh, sep_axis, Shard(1)))
+            new_inputs.append(x)
+        return self._layers(*new_inputs, **kwargs)

@@ -1,0 +1,133 @@
+"""Activation recomputation.
+
+Parity: python/paddle/distributed/fleet/recompute/recompute.py (reference —
+RecomputeFunction PyLayer :108, API :404; hybrid variant with RNG-state
+tracking recompute_hybrid.py).
+
+TPU-native: two tiers.
+- Eager: a PyLayer that runs forward under no_grad (drops residuals) and
+  re-executes it with grads enabled during backward — true rematerialization
+  with RNG-state capture/replay, like the reference.
+- Traced (inside to_static/jit): jax.checkpoint — XLA rematerializes inside
+  the compiled module, which is the idiomatic TPU form (trades FLOPs for
+  HBM).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ...core.tensor import Tensor
+from ...autograd.tape import no_grad, is_grad_enabled, GradNode
+from ...autograd import tape as _tape
+from ...ops import random as _random
+
+
+def _is_tracer(t):
+    return isinstance(t, Tensor) and isinstance(t._value, jax.core.Tracer)
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: paddle.distributed.fleet.recompute / paddle.distributed
+    .fleet.utils.recompute."""
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    if any(_is_tracer(a) for a in args if isinstance(a, Tensor)):
+        # traced: XLA-level rematerialization
+        ckpt_fn = jax.checkpoint(
+            lambda *vals: _call_with_values(function, args, kwargs, vals),
+            static_argnums=())
+        vals = tuple(a._value for a in args if isinstance(a, Tensor))
+        out_vals = ckpt_fn(*vals)
+        return _rewrap(out_vals)
+
+    if not is_grad_enabled():
+        return function(*args, **kwargs)
+
+    # eager rematerialization
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    rng_state = _random.get_rng_state() if preserve_rng else None
+
+    with no_grad():
+        outputs = function(*args, **kwargs)
+
+    single = isinstance(outputs, Tensor)
+    out_list = [outputs] if single else [o for o in outputs
+                                         if isinstance(o, Tensor)]
+    out_meta = [(tuple(o._value.shape), o._value.dtype) for o in out_list]
+
+    def vjp_fn(cots):
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        # replay forward with grads on (+ restored RNG), then backward
+        if rng_state is not None:
+            saved = _random.get_rng_state()
+            _random.set_rng_state(rng_state)
+        detached = [a.detach() if isinstance(a, Tensor) else a for a in args]
+        for d, a in zip(detached, args):
+            if isinstance(a, Tensor):
+                d.stop_gradient = a.stop_gradient
+        try:
+            replay = function(*detached, **kwargs)
+        finally:
+            if rng_state is not None:
+                _random.set_rng_state(saved)
+        replay_list = [replay] if isinstance(replay, Tensor) else \
+            [o for o in replay if isinstance(o, Tensor)]
+        # Leaf grads (the layer's parameters, closed over by ``function``)
+        # accumulate normally during the replay; the detached inputs'
+        # cotangents are captured and returned as this node's input grads.
+        capture = {id(d): None for d in detached if isinstance(d, Tensor)
+                   and not d.stop_gradient}
+        _tape.run_backward(replay_list, list(cots), capture=capture,
+                           write_leaf_grad=True)
+        return tuple(capture.get(id(d))
+                     for d in detached if isinstance(d, Tensor))
+
+    diff_inputs = [a for a in tensor_args]
+    if any(not t.stop_gradient for t in diff_inputs):
+        node = GradNode("recompute", vjp_fn, diff_inputs, out_meta,
+                        out_is_tuple=len(out_meta) > 1)
+        for i, o in enumerate(out_list):
+            o._grad_node = node
+            o._out_index = i
+            o.stop_gradient = False
+    return outputs
+
+
+def _call_with_values(function, args, kwargs, vals):
+    it = iter(vals)
+    new_args = [Tensor._from_value(next(it)) if isinstance(a, Tensor) else a
+                for a in args]
+    out = function(*new_args, **kwargs)
+    if isinstance(out, Tensor):
+        return out._value
+    return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+
+
+def _rewrap(out_vals):
+    if isinstance(out_vals, tuple):
+        return tuple(Tensor._from_value(v) for v in out_vals)
+    return Tensor._from_value(out_vals)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Parity: recompute_sequential — chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    bounds = [int(i * n / segments) for i in range(segments + 1)]
+    out = args[0] if len(args) == 1 else args
+
+    def seg_fn(lo, hi):
+        def run(x):
+            for l in layers[lo:hi]:
+                x = l(x)
+            return x
+        return run
+
+    for i in range(segments):
+        out = recompute(seg_fn(bounds[i], bounds[i + 1]), out, **kwargs)
+    return out

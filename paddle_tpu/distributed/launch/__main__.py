@@ -1,0 +1,4 @@
+from .main import launch
+import sys
+
+sys.exit(launch())

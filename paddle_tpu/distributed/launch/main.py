@@ -1,0 +1,95 @@
+"""Distributed launcher.
+
+Parity: python -m paddle.distributed.launch (reference — launch/main.py:20,
+controllers/collective.py, rendezvous master.py:35 HTTP/etcd).
+
+TPU-native: under single-controller SPMD, ONE process per host drives all
+local chips, so the per-GPU process fan-out of the reference collapses to
+one worker per node.  Multi-node rendezvous uses JAX's coordination service
+(the TCPStore analog): node 0 is the coordinator; workers get
+PADDLE_MASTER / PADDLE_NNODES / PADDLE_TRAINER_ID env (same contract as the
+reference) which init_parallel_env consumes.
+
+Usage:
+    python -m paddle_tpu.distributed.launch [--nnodes N] [--node_rank R]
+        [--master host:port] train.py [args...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import signal
+import subprocess
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default=os.environ.get(
+        "PADDLE_NNODES", "1"),
+        help="node count or elastic range 'min:max'")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for reference-CLI parity; one SPMD proc "
+                        "drives all local chips")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_RESTARTS", "3")))
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _min_nodes(nnodes: str) -> int:
+    return int(str(nnodes).split(":")[0])
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    nnodes = _min_nodes(args.nnodes)
+
+    env = dict(os.environ)
+    env["PADDLE_NNODES"] = str(nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+
+    restarts = 0
+    while True:
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            logf = open(os.path.join(
+                args.log_dir, f"workerlog.{args.node_rank}"), "ab")
+        else:
+            logf = None
+        proc = subprocess.Popen(cmd, env=env, stdout=logf or None,
+                                stderr=subprocess.STDOUT if logf else None)
+        try:
+            ret = proc.wait()
+        except KeyboardInterrupt:
+            proc.send_signal(signal.SIGINT)
+            ret = proc.wait()
+            raise
+        finally:
+            if logf:
+                logf.close()
+        if ret == 0:
+            return 0
+        # fault tolerance: relaunch up to max_restarts (elastic parity:
+        # reference ElasticManager restart path, manager.py:126)
+        restarts += 1
+        if restarts > args.max_restarts:
+            return ret
+        time.sleep(3)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

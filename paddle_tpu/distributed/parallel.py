@@ -1,0 +1,82 @@
+"""Data parallelism.
+
+Parity: python/paddle/distributed/parallel.py (reference — paddle.DataParallel
+:202 with the EagerReducer grad-bucket machinery :464, reducer.h:88).
+
+TPU-native: DP = batch-dim sharding over the 'data' mesh axis.  The
+reference's bucketed allreduce overlap is what XLA emits for the grads of
+replicated params when the loss is computed from batch-sharded activations
+— fused, scheduled, and overlapped by the compiler, no reducer needed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .env import init_parallel_env, get_rank, get_world_size
+from .process_mesh import ProcessMesh, Shard, Replicate
+from .api import shard_tensor
+from .topology import get_hybrid_communicate_group, create_hybrid_group
+
+
+class DataParallel(Layer):
+    """Parity: paddle.DataParallel."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, hcg=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hybrid_communicate_group()
+        if self._hcg is None:
+            n = jax.device_count()
+            self._hcg = create_hybrid_group(dp=n)
+        self._mesh = self._hcg.mesh
+        self._data_axis = self._mesh.dim_names.index("data") \
+            if "data" in self._mesh.dim_names else 0
+
+    def forward(self, *inputs, **kwargs):
+        mesh = self._mesh
+        new_inputs = []
+        for x in inputs:
+            if isinstance(x, Tensor) and x._value.ndim >= 1 \
+                    and x.placements is None:
+                pl = [Replicate() for _ in mesh.dim_names]
+                pl[self._data_axis] = Shard(0)
+                x = shard_tensor(x, mesh, pl)
+            new_inputs.append(x)
+        return self._layers(*new_inputs, **kwargs)
+
+    # pass-throughs
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # grads are emitted reduced by GSPMD
+
+    @property
+    def _layers_inner(self):
+        return self._layers
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Parity: paddle.distributed.spawn.  Under the single-controller model
+    one process drives all local devices, so spawn degenerates to a direct
+    call (multi-host launch is paddle_tpu.distributed.launch's job)."""
+    func(*args)
+    return None

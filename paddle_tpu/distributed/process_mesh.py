@@ -1,0 +1,221 @@
+"""ProcessMesh + placements.
+
+Parity: python/paddle/distributed/auto_parallel/process_mesh.py and
+paddle/phi/core/distributed/auto_parallel/placement_types.h (reference #24).
+
+TPU-native: a ProcessMesh maps directly onto a jax.sharding.Mesh over real
+devices; placements map onto PartitionSpec entries.  Reshard = device_put
+with a new NamedSharding (XLA emits the collective), exactly the GSPMD
+collapse of the reference's reshard-function registry
+(paddle/phi/core/distributed/auto_parallel/reshard/).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# Placements (parity: placement_types.h Shard/Replicate/Partial)
+# ---------------------------------------------------------------------------
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  jax.Array has no native 'partial'
+    state; we keep the local partial values sharded and materialize the
+    reduction on reshard-to-Replicate (matching reference p->r/p->s
+    reshard functions)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("P", self.reduce_type))
+
+
+# ---------------------------------------------------------------------------
+# ProcessMesh
+# ---------------------------------------------------------------------------
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-D logical device mesh (parity: paddle.distributed.ProcessMesh)."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.arange(int(np.prod(shape))).reshape(shape)
+        self._ids = arr
+        self._shape = tuple(arr.shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+
+        devices = jax.devices()
+        flat = [devices[i % len(devices)] for i in arr.reshape(-1)]
+        dev_arr = np.array(flat, dtype=object).reshape(self._shape)
+        self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+
+    # -- parity surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        axis = self._dim_names.index(dim) if isinstance(dim, str) else dim
+        coords = np.argwhere(self._ids == process_id)
+        return int(coords[0][axis]) if len(coords) else -1
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+    def __enter__(self):
+        global _GLOBAL_MESH
+        self._prev = _GLOBAL_MESH
+        _GLOBAL_MESH = self
+        return self
+
+    def __exit__(self, *exc):
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = self._prev
+        return False
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def auto_parallel_mesh(shape, dim_names):
+    return ProcessMesh(shape=shape, dim_names=dim_names)
+
+
+# ---------------------------------------------------------------------------
+# placement <-> PartitionSpec
+# ---------------------------------------------------------------------------
+def placements_to_spec(mesh: ProcessMesh, placements: Sequence[Placement],
+                       ndim: int) -> PartitionSpec:
+    """Build the PartitionSpec for a tensor of rank ``ndim`` from per-mesh-
+    dim placements (reference: dist_attr dims_mapping semantics)."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim % ndim
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh: ProcessMesh, spec: PartitionSpec,
+                       ndim: int) -> List[Placement]:
+    placements: List[Placement] = [Replicate()
+                                   for _ in range(len(mesh.dim_names))]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(tensor_dim)
+    return placements

@@ -1,0 +1,184 @@
+"""Hybrid-parallel topology.
+
+Parity: python/paddle/distributed/fleet/base/topology.py (reference —
+CommunicateTopology :61, HybridCommunicateGroup :174) with the same axis
+order ["data", "pipe", "sharding", "sep", "model"] and fused dp+sep group
+for gradient sync (topology.py:244).
+
+TPU-native: the cartesian rank topology IS a jax Mesh; each axis group is a
+mesh axis name, so "creating a communicator per axis" becomes free — XLA
+collectives reference the axis by name.  Axis order is chosen so the
+innermost (fastest-varying) axis "model" lands on adjacent devices =
+shortest ICI hops for TP traffic, mirroring the reference's NCCL ring
+nesting.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from .process_mesh import ProcessMesh
+from .collective import Group, new_group
+
+_HCG: Optional["HybridCommunicateGroup"] = None
+
+AXES = ["data", "pipe", "sharding", "sep", "model"]
+
+
+class CommunicateTopology:
+    """Parity: fleet/base/topology.py:61."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or AXES)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = None
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._world[coords])
+
+    def get_coord(self, rank):
+        coords = np.argwhere(self._world == rank)[0]
+        return dict(zip(self._parallel_names, (int(c) for c in coords)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return self._world[tuple(sl)].reshape(-1).tolist()
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along ``axis_name`` (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1)
+        return moved.reshape(-1, self._dims[axis]).tolist()
+
+
+class HybridCommunicateGroup:
+    """Parity: fleet/base/topology.py:174."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = 0
+        self._dims = {n: topology.get_dim(n)
+                      for n in topology.get_hybrid_group_names()}
+        names = topology.get_hybrid_group_names()
+        # the mesh: one axis per parallel dim (including degenerate size-1)
+        self._mesh = ProcessMesh(shape=[self._dims[n] for n in names],
+                                 dim_names=names)
+        self._groups: Dict[str, Group] = {}
+        for n in names:
+            self._groups[n] = new_group(
+                list(range(self._dims[n])), mesh=self._mesh, axis_name=n)
+        # fused dp+sep group for grad allreduce (reference topology.py:244)
+        dp_sep = self._dims.get("data", 1) * self._dims.get("sep", 1)
+        self._dp_sep_group = new_group(list(range(dp_sep)), mesh=self._mesh,
+                                       axis_name="data")
+
+    @property
+    def topology(self):
+        return self._topo
+
+    @property
+    def mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    def get_parallel_mode(self):
+        if self._dims.get("model", 1) > 1 and self._dims.get("pipe", 1) > 1:
+            return "hybrid"
+        if self._dims.get("model", 1) > 1:
+            return "tensor"
+        if self._dims.get("pipe", 1) > 1:
+            return "pipeline"
+        if self._dims.get("sharding", 1) > 1:
+            return "sharding"
+        return "data"
+
+    # -- per-axis parity accessors ------------------------------------------
+    def _axis_info(self, name):
+        return self._dims.get(name, 1), 0
+
+    def get_data_parallel_world_size(self):
+        return self._dims.get("data", 1)
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._dims.get("model", 1)
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._dims.get("pipe", 1)
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._dims.get("sharding", 1)
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._dims.get("sep", 1)
+
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["data"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return self._dp_sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["model"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(
+            data=0, pipe=stage_id, sharding=0, sep=0, model=0)
+
+
+def create_hybrid_group(dp=1, pp=1, sharding=1, sep=1, mp=1
+                        ) -> HybridCommunicateGroup:
+    topo = CommunicateTopology(AXES, [dp, pp, sharding, sep, mp])
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    return hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate — experimental APIs (parity: python/paddle/incubate)."""
+from . import distributed
+from . import nn

@@ -1,0 +1,2 @@
+from .moe_layer import MoELayer
+from .gate import GShardGate, SwitchGate, NaiveGate

@@ -1,0 +1,83 @@
+"""MoE gates.
+
+Parity: python/paddle/incubate/distributed/models/moe/gate/ (reference —
+GShard, Switch, naive gates).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....core.dispatch import apply_op
+from .....nn.layer_base import Layer
+from .....nn import initializer as I
+
+
+class NaiveGate(Layer):
+    """Top-k softmax gate (reference gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.num_expert = num_expert
+        self.topk = topk
+        self.weight = self.create_parameter(
+            [d_model, num_expert], default_initializer=I.XavierUniform())
+
+    def forward(self, x):
+        """Returns (combine_weights [N, k], expert_idx [N, k], aux_loss)."""
+        def fn(v, w):
+            logits = v @ w
+            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+            top_w, top_i = jax.lax.top_k(probs, self.topk)
+            top_w = top_w / jnp.sum(top_w, -1, keepdims=True)
+            return top_w.astype(v.dtype), top_i.astype(jnp.int32)
+        w, i = apply_op("naive_gate", fn, (x, self.weight))
+        return w, i, None
+
+
+class GShardGate(NaiveGate):
+    """GShard top-2 gate with load-balancing aux loss (reference
+    gate/gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+
+    def forward(self, x):
+        def fn(v, w):
+            logits = v @ w
+            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+            top_w, top_i = jax.lax.top_k(probs, self.topk)
+            top_w = top_w / jnp.sum(top_w, -1, keepdims=True)
+            # aux loss: mean_prob * fraction_routed per expert (GShard eq.)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(
+                jax.nn.one_hot(top_i[:, 0], self.num_expert), axis=0)
+            aux = jnp.sum(me * ce) * self.num_expert
+            return top_w.astype(v.dtype), top_i.astype(jnp.int32), aux
+        w, i, aux = apply_op("gshard_gate", fn, (x, self.weight))
+        return w, i, aux
+
+
+class SwitchGate(NaiveGate):
+    """Switch (top-1) gate (reference gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, 1)
+
+    def forward(self, x):
+        def fn(v, w):
+            logits = v @ w
+            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+            top_w, top_i = jax.lax.top_k(probs, 1)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(
+                jax.nn.one_hot(top_i[:, 0], self.num_expert), axis=0)
+            aux = jnp.sum(me * ce) * self.num_expert
+            return top_w.astype(v.dtype), top_i.astype(jnp.int32), aux
+        w, i, aux = apply_op("switch_gate", fn, (x, self.weight))
+        return w, i, aux

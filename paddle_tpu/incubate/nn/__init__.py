@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate.nn — fused-op surfaces (parity:
+python/paddle/incubate/nn — the Python face of the reference's fused
+kernels #17)."""
+from . import functional

@@ -1,0 +1,141 @@
+"""Fused functional ops.
+
+Parity: python/paddle/incubate/nn/functional/ (reference — the wrappers over
+paddle/phi/kernels/fusion/: fused_rms_norm, fused_rotary_position_embedding,
+fused_layer_norm, fused_matmul_bias, swiglu, masked/block attention).
+
+TPU-native: "fused" means one XLA fusion / one Pallas kernel.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply_op
+from ....core.tensor import Tensor
+from ....ops._helpers import targ
+from ....nn.functional.norm import rms_norm as _rms_norm
+from ....nn.functional.norm import layer_norm as _layer_norm
+from ....nn.functional.activation import swiglu  # noqa: F401
+from ....nn.functional.common import (scaled_dot_product_attention,
+                                      flash_attention)  # noqa: F401
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """Parity: fused_rms_norm (reference fused op #17)."""
+    out = _rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=1, **kw):
+    shape = [int(s) for s in x.shape[begin_norm_axis:]]
+    return _layer_norm(x, shape, norm_weight, norm_bias, epsilon), None, None
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    def fn(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    args = (x, targ(y)) + ((targ(bias),) if bias is not None else ())
+    return apply_op("fused_matmul_bias", fn, args)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style
+                                    =True, time_major=False, rotary_emb_base
+                                    =10000.0):
+    """Parity: fused_rotary_position_embedding (reference #17).
+    q/k/v: [batch, seq, heads, head_dim]."""
+    def rope_one(t, sin_v, cos_v):
+        if use_neox_rotary_style:
+            half = t.shape[-1] // 2
+            t1, t2 = t[..., :half], t[..., half:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., ::2]
+            t2 = t[..., 1::2]
+            rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_v + rot * sin_v
+
+    def fn(*vals):
+        i = 0
+        qq = vals[i]; i += 1
+        kk = vals[i] if k is not None else None
+        i += 1 if k is not None else 0
+        vv = vals[i] if v is not None else None
+        i += 1 if v is not None else 0
+        seq = qq.shape[1]
+        dim = qq.shape[-1]
+        if sin is None:
+            pos = jnp.arange(seq)[:, None]
+            inv = 1.0 / (rotary_emb_base **
+                         (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+            freqs = pos * inv[None, :]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            sin_v = jnp.sin(emb)[None, :, None, :]
+            cos_v = jnp.cos(emb)[None, :, None, :]
+        else:
+            sin_v = vals[i]; i += 1
+            cos_v = vals[i]; i += 1
+            if sin_v.ndim == 2:
+                sin_v = sin_v[None, :, None, :]
+                cos_v = cos_v[None, :, None, :]
+        sin_v = sin_v.astype(jnp.float32)
+        cos_v = cos_v.astype(jnp.float32)
+        outs = [rope_one(qq.astype(jnp.float32), sin_v,
+                         cos_v).astype(qq.dtype)]
+        if kk is not None:
+            outs.append(rope_one(kk.astype(jnp.float32), sin_v,
+                                 cos_v).astype(kk.dtype))
+        if vv is not None:
+            outs.append(vv)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [q]
+    if k is not None:
+        args.append(targ(k))
+    if v is not None:
+        args.append(targ(v))
+    if sin is not None:
+        args += [targ(sin), targ(cos)]
+    out = apply_op("fused_rope", fn, tuple(args))
+    if k is None and v is None:
+        return out, None, None
+    outs = list(out) if isinstance(out, tuple) else [out]
+    while len(outs) < 3:
+        outs.append(None)
+    return tuple(outs[:3])
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn.functional.common import dropout
+    return dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, **kw):
+    from ....nn.functional.common import dropout
+    out = x if bias is None else x + bias
+    out = dropout(out, dropout_rate, training=training) + residual
+    shape = [int(out.shape[-1])]
+    return _layer_norm(out, shape, ln_scale, ln_bias, ln_epsilon)

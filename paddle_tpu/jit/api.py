@@ -64,16 +64,23 @@ class InputSpec:
 
 
 def _find_layers(fn) -> List[Layer]:
-    """Find Layer objects the callable closes over (bound self, closure
-    cells, defaults) — the analog of the reference's parameter collection in
+    """Find Layer objects the callable references — bound self, closure
+    cells, defaults, and module globals the code names (into containers one
+    level deep).  The analog of the reference's parameter collection in
     partial_program."""
     layers = []
     seen = set()
 
-    def add(obj):
+    def add(obj, depth=0):
         if isinstance(obj, Layer) and id(obj) not in seen:
             seen.add(id(obj))
             layers.append(obj)
+        elif depth < 2 and isinstance(obj, (list, tuple)):
+            for v in obj:
+                add(v, depth + 1)
+        elif depth < 2 and isinstance(obj, dict):
+            for v in obj.values():
+                add(v, depth + 1)
 
     if isinstance(fn, Layer):
         add(fn)
@@ -88,6 +95,13 @@ def _find_layers(fn) -> List[Layer]:
                 pass
     for v in (getattr(fn, "__defaults__", None) or ()):
         add(v)
+    # module-scope layers referenced by name in the code object
+    code = getattr(fn, "__code__", None)
+    glb = getattr(fn, "__globals__", None)
+    if code is not None and glb is not None:
+        for name in code.co_names:
+            if name in glb:
+                add(glb[name])
     return layers
 
 

@@ -47,20 +47,23 @@ def save(layer, path: str, input_spec=None, **configs):
             "Tensors) to trace the program")
 
     from ..core import dtypes as _dt
+    # all symbolic dims must share one scope -> create them in one call
+    n_dyn = sum(
+        sum(1 for s in spec.shape if s is None or (isinstance(s, int)
+                                                   and s < 0))
+        for spec in input_spec if isinstance(spec, InputSpec))
+    sym_dims = list(jax_export.symbolic_shape(
+        ", ".join(f"d{i}" for i in range(n_dyn)))) if n_dyn else []
+    sym_it = iter(sym_dims)
+
     examples = []      # ShapeDtypeStruct (possibly symbolic) per input
-    sym_count = [0]
-
-    def _sym_dim():
-        sym_count[0] += 1
-        return jax_export.symbolic_shape(f"d{sym_count[0]}")[0]
-
     for spec in input_spec:
         if isinstance(spec, Tensor):
             examples.append(jax.ShapeDtypeStruct(tuple(spec._value.shape),
                                                  spec._value.dtype))
         elif isinstance(spec, InputSpec):
-            shape = tuple(_sym_dim() if (s is None or (isinstance(s, int)
-                                                       and s < 0)) else s
+            shape = tuple(next(sym_it) if (s is None or (isinstance(s, int)
+                                                         and s < 0)) else s
                           for s in spec.shape)
             examples.append(jax.ShapeDtypeStruct(
                 shape, _dt.convert_dtype(spec.dtype)))
@@ -76,6 +79,10 @@ def save(layer, path: str, input_spec=None, **configs):
     for li, layer_ in enumerate(static._layers):
         for k, t in layer_.state_dict().items():
             state_items.append((f"l{li}.{k}", t))
+    # trace in eval mode, restoring the caller's train flags afterwards
+    saved_modes = [(l, l.training)
+                   for layer_ in static._layers
+                   for _, l in layer_.named_sublayers(include_self=True)]
     for layer_ in static._layers:
         layer_.eval()
 
@@ -102,7 +109,11 @@ def save(layer, path: str, input_spec=None, **configs):
                      for t in flat)
 
     state_vals = [t._value for _, t in state_items]
-    exported = jax_export.export(jax.jit(infer_fn))(state_vals, examples)
+    try:
+        exported = jax_export.export(jax.jit(infer_fn))(state_vals, examples)
+    finally:
+        for l, mode in saved_modes:
+            l.training = mode
     blob = exported.serialize()
 
     d = os.path.dirname(path)

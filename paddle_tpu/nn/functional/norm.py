@@ -49,10 +49,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     out = apply_op("batch_norm", fn,
                    (x, targ(running_mean), targ(running_var)) + wb)
 
-    # Under jit tracing the assigned values are tracers; StaticFunction
-    # collects them as extra outputs and writes them back after the step.
+    # Running-stat update rules:
+    # - eager input: concrete update, as before.
+    # - traced input with the buffer BOUND to a tracer (StaticFunction's
+    #   bind_state): assign the traced update; StaticFunction collects it as
+    #   an extra output and writes it back after the step.
+    # - traced input with a CONCRETE buffer (layer unknown to the trace):
+    #   skip — assigning a tracer to a host tensor would leak it.
+    x_traced = isinstance(x, Tensor) and \
+        isinstance(x._value, jax.core.Tracer)
+    buf_traced = isinstance(running_mean, Tensor) and \
+        isinstance(running_mean._value, jax.core.Tracer)
     if training and not use_stats and isinstance(running_mean, Tensor) \
-            and isinstance(x, Tensor):
+            and isinstance(x, Tensor) and (not x_traced or buf_traced):
         axes = tuple(i for i in range(x._value.ndim)
                      if i != (channel_axis % x._value.ndim))
         m = jnp.mean(x._value, axis=axes)

@@ -31,11 +31,15 @@ from ._helpers import as_value, wrap
 
 
 class Generator:
-    """Splittable RNG state (reference: paddle/phi/core/generator.h)."""
+    """Splittable RNG state (reference: paddle/phi/core/generator.h).
+
+    Key creation is lazy so that merely importing the framework does not
+    initialize the JAX backend (important for launcher/controller
+    processes that never touch devices)."""
 
     def __init__(self, seed: int = 0):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int):
@@ -48,11 +52,16 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return wrap(self._key)
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
+            return wrap(self._key)
 
     def set_state(self, state):
         self._key = as_value(state)
