@@ -1,0 +1,94 @@
+"""Benchmark: Llama pretrain step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training tokens/sec/chip for a ~350M-param Llama (bf16, fused
+single-XLA-module train step, flash-attention Pallas kernel).  The
+reference publishes no numbers (BASELINE.md), so vs_baseline reports
+progress against the north-star 50% MFU target: vs_baseline = MFU / 0.5.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models import LlamaForCausalLM, LlamaConfig, \
+        LlamaPretrainingCriterion
+    from paddle_tpu.models.llama import param_count, llama_flops_per_token
+    from paddle_tpu.jit.train_step import TrainStep
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=24, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+        batch, seq, steps, warmup = 8, 2048, 10, 3
+        peak_flops = 197e12  # v5e bf16 peak / chip
+    else:  # CI-runnable config
+        cfg = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=704,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=512,
+            dtype="float32")
+        batch, seq, steps, warmup = 4, 256, 3, 1
+        peak_flops = 1e12
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.bfloat16()
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                     clip_norm=1.0)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    flops_per_token = llama_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_per_token / peak_flops
+
+    print(json.dumps({
+        "metric": "llama_%dM_train_tokens_per_sec_per_chip"
+                  % (param_count(cfg) // 1_000_000),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.5, 4),
+    }))
+    print(f"# loss={float(np.asarray(loss._value)):.4f} "
+          f"params={param_count(cfg)/1e6:.0f}M mfu={mfu:.3f} "
+          f"platform={platform} step_time={dt/steps*1000:.1f}ms",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

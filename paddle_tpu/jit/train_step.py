@@ -1,0 +1,111 @@
+"""Fully-fused compiled training step.
+
+The TPU-native execution form of SURVEY.md §3.5: forward + backward +
+optimizer update traced into ONE XLA module (loss scaling / grad clip
+included), with buffer donation so parameters update in place in HBM.
+This is what bench.py and __graft_entry__ run; the eager tape remains the
+flexible path.
+
+Usage:
+    step = TrainStep(model, criterion, optimizer)
+    loss = step(batch_inputs, labels)        # one fused XLA call
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+from ..optimizer.optimizer import Optimizer
+from ..ops import random as _random
+
+
+class TrainStep:
+    """Compile model+criterion+optimizer into one donated-buffer XLA step."""
+
+    def __init__(self, model: Layer, criterion: Callable,
+                 optimizer: Optimizer, clip_norm: Optional[float] = None):
+        self.model = model
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.clip_norm = clip_norm
+
+        sd = model.state_dict()
+        self._keys = list(sd.keys())
+        self._trainable = [k for k in self._keys
+                           if isinstance(sd[k], Parameter)
+                           and not sd[k].stop_gradient]
+        self._frozen = [k for k in self._keys if k not in self._trainable]
+        # optimizer state pytree per trainable param
+        self._opt_states = {k: optimizer._ensure_state(sd[k])
+                            for k in self._trainable}
+        self._step_fn = None
+
+    def _build(self):
+        model = self.model
+        criterion = self.criterion
+        opt = self.optimizer
+        trainable = self._trainable
+        frozen = self._frozen
+        clip_norm = self.clip_norm
+
+        def step(params, frozen_vals, opt_states, lr, key, *batch):
+            def loss_fn(p):
+                state = dict(p)
+                state.update(frozen_vals)
+                with model.bind_state(state):
+                    with _random.trace_rng_scope(key):
+                        out = model(*[Tensor._from_value(b)
+                                      for b in batch[:-1]])
+                        loss = criterion(out,
+                                         Tensor._from_value(batch[-1]))
+                return loss._value.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+
+            if clip_norm is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads.values()))
+                scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                grads = {k: (g * scale).astype(g.dtype)
+                         for k, g in grads.items()}
+
+            hyper = {"lr": lr}
+            new_params = {}
+            new_states = {}
+            for k in trainable:
+                np_, nst = opt._update_rule(params[k], grads[k],
+                                            opt_states[k], hyper)
+                new_params[k] = np_
+                new_states[k] = nst
+            return loss, new_params, new_states
+
+        # donate params + opt states: in-place HBM update
+        self._step_fn = jax.jit(step, donate_argnums=(0, 2))
+
+    def __call__(self, *batch):
+        if self._step_fn is None:
+            self._build()
+        sd = self.model.state_dict()
+        params = {k: sd[k]._value for k in self._trainable}
+        frozen_vals = {k: sd[k]._value for k in self._frozen}
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.next_key()
+        batch_vals = tuple(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b) for b in batch)
+        loss, new_params, new_states = self._step_fn(
+            params, frozen_vals, self._opt_states, lr, key, *batch_vals)
+        for k, v in new_params.items():
+            sd[k]._value = v
+        self._opt_states = new_states
+        if isinstance(self.optimizer._learning_rate, object) and \
+                hasattr(self.optimizer._learning_rate, "step"):
+            pass  # caller drives the scheduler
+        self.optimizer._global_step += 1
+        return Tensor._from_value(loss)

@@ -1,0 +1,14 @@
+"""Model zoo.
+
+Parity intent: the reference ecosystem's model families (PaddleNLP llama/
+ernie, PaddleClas resnet, BASELINE.json configs) — here implemented
+natively on paddle_tpu layers with mesh-shardable parameters.
+"""
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
+                    LlamaPretrainingCriterion, llama_tiny_config,
+                    llama_7b_config)
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, \
+    resnet152
+from .bert import BertConfig, BertModel, BertForPretraining, \
+    BertForSequenceClassification
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM

@@ -1,0 +1,113 @@
+"""BERT/ERNIE-class encoder (BASELINE.json configs[1]: BERT-base
+fine-tune under data parallelism)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers import (Embedding, Linear, LayerNorm, Dropout, Tanh)
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        from ..ops.creation import arange, zeros_like
+        from ..ops.manipulation import unsqueeze, expand
+        S = input_ids.shape[1]
+        pos = unsqueeze(arange(S, dtype="int64"), 0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        h = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos) \
+            + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size)
+        self.activation = Tanh()
+
+    def forward(self, hidden):
+        return self.activation(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            from ..ops.manipulation import unsqueeze
+            m = unsqueeze(unsqueeze(attention_mask, 1), 1)
+            mask = (1.0 - m.astype("float32")) * -1e9
+        else:
+            mask = None
+        h = self.encoder(h, mask)
+        return h, self.pooler(h)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_head = Linear(config.hidden_size, config.vocab_size)
+        self.nsp_head = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.mlm_head(seq), self.nsp_head(pooled)

@@ -1,0 +1,301 @@
+"""Llama model family — the flagship pretraining model.
+
+Parity intent: PaddleNLP's llama modeling on the reference stack
+(BASELINE.json configs[3]/[4]: Llama-2-7B/13B pretrain with sharding
+stage-3 + tensor parallel; north star >50% MFU on v5p).
+
+TPU-native design:
+- bf16 parameters/activations by default; fp32 RMSNorm statistics and
+  softmax logits.
+- attention via scaled_dot_product_attention -> Pallas flash kernel on TPU.
+- rotary embeddings via the fused rope op.
+- mesh-shardable: ``shard_llama`` annotates params for tp/fsdp axes
+  (megatron layout: qkv/gate/up column-sharded, o/down row-sharded,
+  embeddings vocab-sharded, everything FSDP-sharded on the remaining axis)
+  — GSPMD turns these into the Megatron collective pattern over ICI.
+- sequence parallelism: the "sep" mesh axis shards the sequence dim of
+  activations (long-context path; ring attention kernel in
+  ops/pallas_kernels.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer, Parameter
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layers import Linear, Embedding, RMSNorm, LayerList
+from ..incubate.nn.functional import fused_rotary_position_embedding
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    use_flash_attention: bool = True
+    recompute: bool = False
+    sequence_parallel: bool = False
+
+
+def llama_7b_config(**kw) -> LlamaConfig:
+    return LlamaConfig(**kw)
+
+
+def llama_tiny_config(**kw) -> LlamaConfig:
+    cfg = dict(vocab_size=1024, hidden_size=128, intermediate_size=352,
+               num_hidden_layers=2, num_attention_heads=4,
+               num_key_value_heads=2, max_position_embeddings=256)
+    cfg.update(kw)
+    return LlamaConfig(**cfg)
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP (gate/up column-parallel, down row-parallel under TP)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.gate_proj = Linear(config.hidden_size,
+                                config.intermediate_size,
+                                weight_attr=_attr(init), bias_attr=False)
+        self.up_proj = Linear(config.hidden_size, config.intermediate_size,
+                              weight_attr=_attr(init), bias_attr=False)
+        self.down_proj = Linear(config.intermediate_size,
+                                config.hidden_size,
+                                weight_attr=_attr(init), bias_attr=False)
+
+    def forward(self, x):
+        from ..nn.functional.activation import swiglu
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class _Attr:
+    def __init__(self, initializer):
+        self.initializer = initializer
+        self.name = None
+
+
+def _attr(init):
+    return _Attr(init)
+
+
+class LlamaAttention(Layer):
+    """GQA attention with rotary embeddings and flash attention."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        init = I.Normal(0.0, config.initializer_range)
+        h = config.hidden_size
+        self.q_proj = Linear(h, self.num_heads * self.head_dim,
+                             weight_attr=_attr(init), bias_attr=False)
+        self.k_proj = Linear(h, self.num_kv_heads * self.head_dim,
+                             weight_attr=_attr(init), bias_attr=False)
+        self.v_proj = Linear(h, self.num_kv_heads * self.head_dim,
+                             weight_attr=_attr(init), bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, h,
+                             weight_attr=_attr(init), bias_attr=False)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        B, S = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
+
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, rotary_emb_base=self.config.rope_theta)
+
+        if cache is not None:
+            from ..ops.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+
+        # GQA: repeat kv heads
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            from ..ops.manipulation import repeat_interleave
+            k = repeat_interleave(k, rep, axis=2)
+            v = repeat_interleave(v, rep, axis=2)
+
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            is_causal=(attn_mask is None and cache is None))
+        out = out.reshape([B, S, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+        self._recompute = config.recompute
+
+    def _block(self, x, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward(self, x, attn_mask=None):
+        if self._recompute and self.training:
+            from ..distributed.fleet.recompute import recompute
+            return recompute(self._block, x, attn_mask)
+        return self._block(x, attn_mask)
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=_attr(I.Normal(0.0, config.initializer_range)))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        if self.config.dtype == "bfloat16":
+            h = h.astype("bfloat16")
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=_attr(I.Normal(0.0, config.initializer_range)),
+                bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.lm_head is None:
+            from ..ops.linalg import matmul
+            logits = matmul(h, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shift-by-one LM loss with fp32 softmax (PaddleNLP parity)."""
+
+    def __init__(self, config: Optional[LlamaConfig] = None,
+                 ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        # logits [B, S, V]; labels [B, S] — predict token t+1
+        from ..ops.manipulation import reshape
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        V = shift_logits.shape[-1]
+        return F.cross_entropy(
+            reshape(shift_logits, [-1, V]),
+            reshape(shift_labels, [-1]),
+            ignore_index=self.ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# sharding recipe (tp/fsdp/dp/sep axes)
+# ---------------------------------------------------------------------------
+def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="model",
+                fsdp_axis="sharding"):
+    """Annotate parameters with the Megatron/FSDP layout over ``mesh``:
+
+    - qkv/gate/up: column-sharded on tp (out dim), fsdp on in dim
+    - o/down: row-sharded on tp (in dim), fsdp on out dim
+    - embeddings + lm_head: vocab-sharded on tp
+    - norms: replicated
+    GSPMD derives the collective pattern; on a pod the tp axis should map
+    to the innermost ICI dim.
+    """
+    from ..distributed.api import shard_param_
+    from ..distributed.process_mesh import Shard, Replicate
+
+    names = mesh.dim_names
+    has_tp = tp_axis in names and mesh.get_dim_size(tp_axis) > 1
+    has_fsdp = fsdp_axis in names and mesh.get_dim_size(fsdp_axis) > 1
+
+    def placements(tp_dim=None, fsdp_dim=None):
+        pl = [Replicate() for _ in names]
+        if has_tp and tp_dim is not None:
+            pl[names.index(tp_axis)] = Shard(tp_dim)
+        if has_fsdp and fsdp_dim is not None:
+            pl[names.index(fsdp_axis)] = Shard(fsdp_dim)
+        return pl
+
+    emb = model.llama.embed_tokens.weight
+    shard_param_(emb, mesh, placements(tp_dim=0, fsdp_dim=1))
+    if model.lm_head is not None:
+        shard_param_(model.lm_head.weight, mesh,
+                     placements(tp_dim=1, fsdp_dim=0))
+    for layer in model.llama.layers:
+        a = layer.self_attn
+        for lin in (a.q_proj, a.k_proj, a.v_proj):
+            shard_param_(lin.weight, mesh, placements(tp_dim=1, fsdp_dim=0))
+        shard_param_(a.o_proj.weight, mesh, placements(tp_dim=0,
+                                                       fsdp_dim=1))
+        m = layer.mlp
+        shard_param_(m.gate_proj.weight, mesh,
+                     placements(tp_dim=1, fsdp_dim=0))
+        shard_param_(m.up_proj.weight, mesh,
+                     placements(tp_dim=1, fsdp_dim=0))
+        shard_param_(m.down_proj.weight, mesh,
+                     placements(tp_dim=0, fsdp_dim=1))
+    return model
+
+
+def llama_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """6*N + attention correction (BASELINE.md convention)."""
+    n_params = param_count(config)
+    attn = 12 * config.num_hidden_layers * config.hidden_size * seq_len
+    return 6.0 * n_params + attn
+
+
+def param_count(config: LlamaConfig) -> int:
+    h, i, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    L = config.num_hidden_layers
+    kv = config.num_key_value_heads * (h // config.num_attention_heads)
+    per_layer = h * h + 2 * h * kv + h * h + 3 * h * i + 2 * h
+    emb = v * h * (1 if config.tie_word_embeddings else 2)
+    return L * per_layer + emb + h

@@ -1,0 +1,126 @@
+"""ResNet family (BASELINE.json configs[0]: ResNet-50 ImageNet).
+
+Parity: python/paddle/vision/models/resnet.py (reference).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Type
+
+from ..nn.layer_base import Layer
+from ..nn.layers import (Conv2D, BatchNorm2D, ReLU, MaxPool2D,
+                         AdaptiveAvgPool2D, Linear, Sequential)
+from ..nn import functional as F
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.downsample = downsample
+        self.relu = ReLU()
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(planes)
+        self.conv3 = Conv2D(planes, planes * 4, 1, bias_attr=False)
+        self.bn3 = BatchNorm2D(planes * 4)
+        self.downsample = downsample
+        self.relu = ReLU()
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(Layer):
+    """Parity: paddle.vision.models.ResNet."""
+
+    def __init__(self, block, depth_cfg: List[int], num_classes=1000,
+                 with_pool=True, in_channels=3):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.relu = ReLU()
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0])
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+        self.num_classes = num_classes
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.inplanes, planes * block.expansion, 1,
+                       stride=stride, bias_attr=False),
+                BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def resnet18(pretrained=False, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet34(pretrained=False, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet50(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], **kw)
+
+
+def resnet101(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+def resnet152(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)

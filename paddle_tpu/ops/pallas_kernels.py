@@ -1,0 +1,267 @@
+"""Hand-written Pallas TPU kernels for the fused-op set.
+
+Parity: the reference's fused CUDA kernel library
+(paddle/phi/kernels/fusion/ — flash attention #18, fused_rms_norm #17).
+These are the only hand-written kernels in the framework; everything else
+is XLA.  Each kernel has an XLA fallback (the callers catch exceptions), so
+CPU tests exercise the same API.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- flash attention: one (batch*heads, q_block) grid cell holds a q tile in
+  VMEM and streams k/v tiles, keeping the running max/denominator in fp32
+  (online softmax).  Causal masking skips fully-masked k tiles.
+- rms_norm: row-tiled, stats in fp32.
+- custom VJPs delegate to the XLA reference implementation — flash forward
+  + XLA backward keeps memory bounded while staying correct.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ._helpers import targ
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, scale: float, q_offset_blocks: int):
+    """One grid cell: q tile [block_q, d] vs all k/v tiles.
+
+    Online softmax with fp32 running (max, denom, acc)."""
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    bq = q.shape[0]
+    d = q.shape[1]
+    kv_len = k_ref.shape[1]
+    n_kb = kv_len // block_k
+    qi = pl.program_id(1)
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    q_start = (qi + q_offset_blocks) * bq
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
+        s = q @ k.T                                    # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + p @ v
+        return m_new, l_new, acc_new
+
+    if causal:
+        # skip k blocks strictly after this q tile
+        last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kb)
+    else:
+        last_kb = n_kb
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+_INTERPRET = [False]  # set True in CPU tests to run kernels interpreted
+
+
+def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
+    """q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError("flash kernel needs seq divisible by block size")
+    scale = 1.0 / math.sqrt(D)
+
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale,
+                               q_offset_blocks=0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=_INTERPRET[0],
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D)
+
+
+def _sdpa_reference(q, k, v, causal):
+    """XLA reference (also the VJP path)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_sdpa(q, k, v, causal):
+    return _flash_attention_value(q, k, v, causal)
+
+
+def _flash_sdpa_fwd(q, k, v, causal):
+    return _flash_attention_value(q, k, v, causal), (q, k, v)
+
+
+def _flash_sdpa_bwd(causal, res, g):
+    q, k, v = res
+    # backward via XLA of the reference formulation (compiler fuses it);
+    # a pallas backward kernel is a later optimization slot.
+    _, vjp = jax.vjp(lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)
+
+
+def flash_attention_tpu(query, key, value, attn_mask=None, is_causal=False):
+    """Flash attention on TPU via Pallas.  Layout [B, S, H, D] (paddle
+    convention).  Raises on unsupported configs so callers fall back."""
+    if not (_HAS_PLTPU and _on_tpu()):
+        raise RuntimeError("pallas flash attention requires a TPU backend")
+    if attn_mask is not None:
+        raise RuntimeError("mask path handled by XLA fallback")
+
+    def fn(q, k, v):
+        q_ = jnp.swapaxes(q, 1, 2)
+        k_ = jnp.swapaxes(k, 1, 2)
+        v_ = jnp.swapaxes(v, 1, 2)
+        out = _flash_sdpa(q_, k_, v_, is_causal)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_op("flash_attention_pallas", fn,
+                    (query, targ(key), targ(value)))
+
+
+# ---------------------------------------------------------------------------
+# rms_norm
+# ---------------------------------------------------------------------------
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(ms + eps) *
+                w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def rms_norm_tpu(x, weight, eps=1e-6, block_rows=512):
+    """Row-tiled Pallas RMSNorm (used by the bench path on TPU)."""
+    if not (_HAS_PLTPU and _on_tpu()):
+        raise RuntimeError("requires TPU")
+
+    def fn(xv, wv):
+        shape = xv.shape
+        d = shape[-1]
+        rows = int(np.prod(shape[:-1]))
+        xr = xv.reshape(rows, d)
+        br = min(block_rows, rows)
+        if rows % br:
+            br = rows
+        out = pl.pallas_call(
+            functools.partial(_rms_kernel, eps=eps),
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
+                      pl.BlockSpec((d,), lambda i: (0,))],
+            out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, d), xv.dtype),
+        )(xr, wv)
+        return out.reshape(shape)
+
+    return apply_op("rms_norm_pallas", fn, (x, targ(weight)))
+
+
+# ---------------------------------------------------------------------------
+# ring attention (sequence/context parallelism over the mesh)
+# ---------------------------------------------------------------------------
+def ring_attention(q, k, v, axis_name: str, is_causal=False):
+    """Ring attention over a mesh axis (long-context path; SURVEY.md §5.7
+    notes the reference LACKS this — sep relied on model-side sharding).
+
+    Must run inside shard_map with the sequence dim sharded over
+    ``axis_name``: each step computes a local flash block then rotates k/v
+    one neighbor around the ring with collective-permute (rides ICI).
+    Inputs [B, S_local, H, D] (values, not Tensors)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B,H,S,D]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    B, H, S, D = qh.shape
+
+    m = jnp.full((B, H, S, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, S, 1), jnp.float32)
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+
+    kv = (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
+
+    def step(i, carry):
+        m, l, acc, (kc, vc) = carry
+        src = (idx - i) % n  # which shard's k/v we now hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh,
+                       kc.astype(jnp.float32)) * scale
+        if is_causal:
+            rows = idx * S + jax.lax.broadcasted_iota(
+                jnp.int32, (S, S), 0)
+            cols = src * S + jax.lax.broadcasted_iota(
+                jnp.int32, (S, S), 1)
+            s = jnp.where((rows >= cols)[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        kc2 = jax.lax.ppermute(kc, axis_name, perm)
+        vc2 = jax.lax.ppermute(vc, axis_name, perm)
+        return m_new, l_new, acc_new, (kc2, vc2)
+
+    m, l, acc, _ = jax.lax.fori_loop(0, n, step, (m, l, acc, kv))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
