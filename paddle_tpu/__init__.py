@@ -11,6 +11,15 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Reference dtype semantics: python ints / int64 requests are real int64
+# (python/paddle defaults ints to int64), so enable x64 before any jax
+# array is created.  Float defaults stay float32 via get_default_dtype();
+# TPU code paths use bf16/f32 explicitly — f64 only appears when a user
+# asks for it, exactly like the reference.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
 from .core import dtypes as _dtypes_mod
 from .core.dtypes import (bfloat16, float16, float32, float64, int8, int16,
                           int32, int64, uint8, bool_, complex64, complex128,

@@ -102,6 +102,13 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer unscale lifecycle (parity:
+        # python/paddle/amp/grad_scaler.py OptimizerState INIT/UNSCALED/
+        # STEPPED) — prevents silent double-unscaling in the documented
+        # AMP + grad-clip recipe (user calls unscale_ then step), and
+        # carries found_inf per optimizer so one optimizer's clean grads
+        # can't mask another's infs.
+        self._opt_states = {}  # id(opt) -> {"state": str, "found_inf": bool}
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
@@ -112,6 +119,13 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        rec = self._opt_states.get(id(optimizer))
+        if rec is not None and rec["state"] == "UNSCALED":
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        if rec is not None and rec["state"] == "STEPPED":
+            raise RuntimeError("unscale_() is being called after step().")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -120,7 +134,9 @@ class GradScaler:
                 if bool(jnp.any(~jnp.isfinite(g))):
                     found = True
                 p._grad = g.astype(p._grad.dtype)
-        self._found_inf = found
+        self._opt_states[id(optimizer)] = {"state": "UNSCALED",
+                                           "found_inf": found}
+        self._found_inf = self._found_inf or found
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -131,11 +147,18 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        rec = self._opt_states.get(id(optimizer))
+        if rec is not None and rec["state"] == "STEPPED":
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if rec is None or rec["state"] != "UNSCALED":
+            self.unscale_(optimizer)
+        if not self._opt_states[id(optimizer)]["found_inf"]:
             optimizer.step()
+        self._opt_states[id(optimizer)]["state"] = "STEPPED"
 
     def update(self):
+        self._opt_states.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -28,9 +28,13 @@ from ..autograd import tape as _tape
 
 def _default_cast(data):
     """Numpy conversion with paddle-style defaults: python floats -> default
-    float dtype, python ints -> int64."""
+    float dtype (float32), python ints -> int64 (x64 is enabled at package
+    import so int64 survives the jnp conversion)."""
     arr = np.asarray(data)
-    if arr.dtype == np.float64:
+    if arr.dtype == np.float64 and not isinstance(data,
+                                                  (np.ndarray, np.generic)):
+        # python floats / float lists take the configured default;
+        # an explicit np.float64 array or scalar is honored (x64 is on).
         arr = arr.astype(_dt.get_default_dtype())
     return arr
 

@@ -1,8 +1,11 @@
 """Serialization: paddle.save / paddle.load.
 
 Parity: python/paddle/framework/io.py:721,960 (reference) — pickled nested
-state structures with tensors serialized as numpy arrays (bfloat16 kept via
-ml_dtypes view round-trip).
+state structures containing only stdlib/numpy types.  A Tensor is stored as
+a small marker dict holding a plain ndarray (uint16 view for bfloat16) plus
+its stop_gradient flag, so any numpy-capable reader can open the file and
+reference-produced pickles of plain ndarrays load here unchanged (and stay
+ndarrays, like the reference's load does).
 """
 from __future__ import annotations
 
@@ -16,32 +19,34 @@ import jax.numpy as jnp
 
 from .core.tensor import Tensor
 
+_TENSOR_KEY = "__paddle_tpu_tensor__"
+
 
 class _TensorPayload:
-    """Pickle-stable tensor container (bfloat16-safe)."""
+    """Backward-compat unpickler for round-1 checkpoints only (new files
+    never contain this class)."""
 
-    def __init__(self, array: np.ndarray, stop_gradient: bool = True):
-        self.dtype_name = array.dtype.name if array.dtype.names is None \
-            else str(array.dtype)
-        if array.dtype == jnp.bfloat16:
-            self.dtype_name = "bfloat16"
-            self.data = array.view(np.uint16)
-        else:
-            self.data = array
-        self.stop_gradient = stop_gradient
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     def to_tensor(self) -> Tensor:
         arr = self.data
         if self.dtype_name == "bfloat16":
             arr = arr.view(jnp.bfloat16)
         t = Tensor(arr)
-        t.stop_gradient = self.stop_gradient
+        t.stop_gradient = getattr(self, "stop_gradient", True)
         return t
 
 
 def _pack(obj):
     if isinstance(obj, Tensor):
-        return _TensorPayload(np.asarray(obj._value), obj.stop_gradient)
+        arr = np.asarray(obj._value)
+        rec = {_TENSOR_KEY: True, "stop_gradient": bool(obj.stop_gradient),
+               "bf16": False, "data": arr}
+        if arr.dtype == jnp.bfloat16:
+            rec["bf16"] = True
+            rec["data"] = arr.view(np.uint16)
+        return rec
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -54,6 +59,15 @@ def _unpack(obj, return_numpy=False):
     if isinstance(obj, _TensorPayload):
         t = obj.to_tensor()
         return t.numpy() if return_numpy else t
+    if isinstance(obj, dict) and obj.get(_TENSOR_KEY):
+        arr = obj["data"]
+        if obj.get("bf16"):
+            arr = arr.view(jnp.bfloat16)
+        if return_numpy:
+            return arr
+        t = Tensor(arr)
+        t.stop_gradient = obj.get("stop_gradient", True)
+        return t
     if isinstance(obj, dict):
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

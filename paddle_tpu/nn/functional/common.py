@@ -293,8 +293,31 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    """Parity: F.interpolate (nearest/bilinear/bicubic via jax.image)."""
+    """Parity: F.interpolate.
+
+    nearest/linear/bilinear/trilinear/bicubic via jax.image (half-pixel);
+    ``align_corners=True`` uses explicit corner-aligned coordinate mapping
+    through jax.image.scale_and_translate; ``area`` = adaptive average
+    pooling (matching the reference's area semantics).
+    """
     channel_last = not data_format.startswith("NC")
+    if mode == "area":
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        if size is not None:
+            sz = tuple(size) if isinstance(size, (list, tuple)) else (size,)
+        else:
+            xv = x._value if hasattr(x, "_value") else x
+            spatial = xv.shape[1:-1] if channel_last else xv.shape[2:]
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            sz = tuple(int(s * f) for s, f in zip(spatial, sf))
+        if channel_last:
+            raise NotImplementedError(
+                "mode='area' supports channel-first layouts only")
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[len(sz)]
+        return pool(x, sz if len(sz) > 1 else sz[0])
 
     def fn(v):
         nd = v.ndim - 2
@@ -308,11 +331,27 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             tgt = tuple(int(s * f) for s, f in zip(spatial, sf))
         if channel_last:
             full = (v.shape[0],) + tgt + (v.shape[-1],)
+            sp_dims = tuple(range(1, 1 + nd))
         else:
             full = v.shape[:2] + tgt
+            sp_dims = tuple(range(2, 2 + nd))
         method = {"nearest": "nearest", "bilinear": "bilinear",
                   "trilinear": "trilinear", "bicubic": "bicubic",
-                  "linear": "linear", "area": "linear"}[mode]
+                  "linear": "linear"}[mode]
+        if align_corners and mode != "nearest":
+            # corner-aligned mapping: in-coord = out-coord*(in-1)/(out-1),
+            # i.e. scale s = (out-1)/(in-1) with translation 0.5*(1-s)
+            # (pixel-center convention; calibrated against the reference)
+            scales = jnp.array(
+                [(o - 1) / (i - 1) if i > 1 else 1.0
+                 for i, o in zip(spatial, tgt)], jnp.float32)
+            trans = 0.5 * (1.0 - scales)
+            out = jax.image.scale_and_translate(
+                v.astype(jnp.float32), full, sp_dims, scales, trans,
+                method="linear" if method in ("linear", "bilinear",
+                                              "trilinear") else method,
+                antialias=False)
+            return out.astype(v.dtype)
         return jax.image.resize(v, full, method=method).astype(v.dtype)
     return apply_op("interpolate", fn, (x,))
 

@@ -63,12 +63,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
 
-    q_start = (qi + q_offset_blocks) * bq
+    # all index arithmetic pinned to int32: under jax_enable_x64 python
+    # ints become int64, which mosaic cannot lower (RecursionError)
+    q_start = (qi + jnp.int32(q_offset_blocks)) * jnp.int32(bq)
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
+        k_off = kb * jnp.int32(block_k)
+        k = k_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
         s = q @ k.T                                    # [bq, bk]
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(
@@ -85,10 +88,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 
     if causal:
         # skip k blocks strictly after this q tile
-        last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kb)
+        last_kb = jnp.minimum(
+            (q_start + jnp.int32(bq - 1)) // jnp.int32(block_k)
+            + jnp.int32(1), jnp.int32(n_kb))
     else:
-        last_kb = n_kb
-    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+        last_kb = jnp.int32(n_kb)
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), last_kb, body,
+                                  (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.astype(o_ref.dtype)
 
@@ -113,18 +119,22 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
                                q_offset_blocks=0)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, Sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-        interpret=_INTERPRET[0],
-    )(qr, kr, vr)
+    # Kernel body traced with x64 off: mosaic cannot legalize the i64
+    # scalars that python-int arithmetic produces under jax_enable_x64.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(B * H, Sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            interpret=_INTERPRET[0],
+        )(qr, kr, vr)
     return out.reshape(B, H, Sq, D)
 
 
@@ -203,14 +213,15 @@ def rms_norm_tpu(x, weight, eps=1e-6, block_rows=512):
         br = min(block_rows, rows)
         if rows % br:
             br = rows
-        out = pl.pallas_call(
-            functools.partial(_rms_kernel, eps=eps),
-            grid=(rows // br,),
-            in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
-                      pl.BlockSpec((d,), lambda i: (0,))],
-            out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((rows, d), xv.dtype),
-        )(xr, wv)
+        with jax.enable_x64(False):
+            out = pl.pallas_call(
+                functools.partial(_rms_kernel, eps=eps),
+                grid=(rows // br,),
+                in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0)),
+                          pl.BlockSpec((d,), lambda i: (0,))],
+                out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct((rows, d), xv.dtype),
+            )(xr, wv)
         return out.reshape(shape)
 
     return apply_op("rms_norm_pallas", fn, (x, targ(weight)))

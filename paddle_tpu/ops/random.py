@@ -69,6 +69,18 @@ class Generator:
 
 _GLOBAL_GENERATOR = Generator(0)
 
+# Per-seed counter-advanced generators for ops called with an explicit
+# nonzero seed: successive calls with the same seed give different (but
+# run-reproducible) draws, matching reference generator semantics instead
+# of freezing every draw (ADVICE r1).
+_SEEDED_COUNTERS: dict = {}
+
+
+def _seeded_key(seed_val: int):
+    c = _SEEDED_COUNTERS.get(seed_val, 0)
+    _SEEDED_COUNTERS[seed_val] = c + 1
+    return jax.random.fold_in(jax.random.PRNGKey(seed_val), c)
+
 # Trace-scope key stack: when non-empty, random ops consume splits of the
 # traced key instead of the global generator.
 class _TraceRng(threading.local):
@@ -164,7 +176,7 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 @register_op("uniform", category="random")
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    key = jax.random.PRNGKey(seed) if seed else next_key()
+    key = _seeded_key(seed) if seed != 0 else next_key()
     return wrap(jax.random.uniform(key, _shape(shape), _float_dtype(dtype),
                                    minval=min, maxval=max))
 
@@ -246,7 +258,7 @@ def normal_(x, mean=0.0, std=1.0, name=None):
 @register_op("uniform_", category="random")
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     v = as_value(x)
-    key = jax.random.PRNGKey(seed) if seed else next_key()
+    key = _seeded_key(seed) if seed != 0 else next_key()
     x._value = jax.random.uniform(key, v.shape, v.dtype, min, max)
     return x
 
