@@ -136,3 +136,14 @@ def synchronize():
     """Block until all outstanding device work is done (parity:
     paddle.device.synchronize)."""
     jax.effects_barrier()
+
+
+def device_group_key(value):
+    """Hashable identity of the device set an array is committed to, or
+    None when unknown.  Used to group per-submesh work (pipeline stages
+    place parameters on disjoint submeshes; one jitted computation cannot
+    mix device sets)."""
+    try:
+        return frozenset(d.id for d in value.devices())
+    except Exception:
+        return None

@@ -98,6 +98,10 @@ class _Fleet:
         from ..parallel import DataParallel
         if hcg.get_pipe_parallel_world_size() > 1 and \
                 isinstance(model, _pipeline_layer_cls()):
+            if getattr(model, "num_chunks", 1) > 1:
+                from .meta_parallel import PipelineParallelWithInterleave
+                return PipelineParallelWithInterleave(
+                    model, hcg, self._strategy)
             return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_model_parallel_world_size() > 1:
             return TensorParallel(model, hcg, self._strategy)

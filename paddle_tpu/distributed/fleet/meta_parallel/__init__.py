@@ -2,7 +2,8 @@ from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         RowParallelLinear, ParallelCrossEntropy)
 from .wrappers import TensorParallel, SegmentParallel
 from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc
-from .pipeline_parallel import PipelineParallel
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave)
 from . import sequence_parallel_utils
 from .sharding import (GroupShardedStage2, GroupShardedStage3,
                        GroupShardedOptimizerStage2)

@@ -3,30 +3,110 @@
 Parity: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
 (reference — PipelineParallel :150, forward_backward_pipeline :440 1F1B,
 PipelineParallelWithInterleave :906) with p2p via
-pp_utils/p2p_communication.py.
+pp_utils/p2p_communication.py:313.
 
-TPU-native design: under a single controller there are no per-rank
-processes to interleave with explicit p2p; micro-batch scheduling is a
-host-side job list (the Plan/Job seam, paddle_tpu.static) over per-stage
-computations whose activations flow as device arrays (stage-to-stage
-transfer = device placement change, XLA handles it; on a real pod the
-stages live on submeshes and the edge is a collective-permute over ICI).
-The 1F1B ordering is preserved so activation-memory behavior matches the
-reference schedule: at most ``num_stages`` in-flight micro-batches.
+TPU-native design: under a single controller each pipeline stage owns a
+DISJOINT SUBMESH of the device mesh (the slice of the hybrid mesh at its
+``pipe`` coordinate).  Stage parameters are placed on their stage's
+submesh; activations cross the stage boundary through a differentiable
+placement-transfer op whose VJP routes the gradient back to the source
+submesh — the single-controller analog of the reference's send/recv pairs.
+Scheduling is a host-side Plan of typed Jobs (paddle_tpu.static — the
+reference's new-executor Plan/Job seam, interpreter/plan.h:31) executed by
+``static.Executor`` in 1F1B order, so at most ``num_stages`` micro-batches
+are in flight.
+
+The fully-compiled SPMD schedule (scan + collective-permute in one XLA
+module) lives in paddle_tpu.distributed.pipelining and is what the perf
+path / dryrun uses; this engine is the eager/API-parity path.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ....core.dispatch import apply_op
 from ....core.tensor import Tensor
 from ....nn.layer_base import Layer
 from ....ops.manipulation import split as _split
-from ....ops import math as _m
 from .pp_layers import PipelineLayer
 
 
+# ---------------------------------------------------------------------------
+# stage submeshes + differentiable cross-stage transfer
+# ---------------------------------------------------------------------------
+def build_stage_meshes(hcg, pipe_axis: str = "pipe") -> Optional[List[Mesh]]:
+    """Slice the hybrid mesh at each pipe coordinate: stage s's submesh is
+    mesh[..., pipe=s, ...] with the remaining axes intact.  Returns None
+    when there is no pipe axis (or it is degenerate)."""
+    from ...process_mesh import as_jax_mesh
+    jm = as_jax_mesh(hcg)
+    names = list(jm.axis_names)
+    if pipe_axis not in names:
+        return None
+    pi = names.index(pipe_axis)
+    pp = jm.devices.shape[pi]
+    if pp <= 1:
+        return None
+    rest = tuple(n for n in names if n != pipe_axis)
+    return [Mesh(np.take(jm.devices, s, axis=pi), rest) for s in range(pp)]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _move(v, dst, src):
+    return jax.device_put(v, dst)
+
+
+def _move_fwd(v, dst, src):
+    return jax.device_put(v, dst), None
+
+
+def _move_bwd(dst, src, _, g):
+    return (jax.device_put(g, src) if src is not None else g,)
+
+
+_move.defvjp(_move_fwd, _move_bwd)
+
+
+def _restrict_sharding(value, submesh: Mesh) -> NamedSharding:
+    """Re-anchor a value's sharding onto a stage submesh: keep whatever
+    PartitionSpec axes it already uses (tp on 'model', fsdp on 'sharding',
+    ...) and drop any reference to the pipe axis."""
+    old = getattr(value, "sharding", None)
+    sub_names = set(submesh.axis_names)
+    spec_entries = []
+    if isinstance(old, NamedSharding):
+        for e in old.spec:
+            if e is None:
+                spec_entries.append(None)
+            elif isinstance(e, tuple):
+                kept = tuple(n for n in e if n in sub_names)
+                spec_entries.append(kept if kept else None)
+            else:
+                spec_entries.append(e if e in sub_names else None)
+    return NamedSharding(submesh, P(*spec_entries))
+
+
+def transfer_to_stage(x: Tensor, dst_sharding) -> Tensor:
+    """Move a tensor onto a stage submesh; the gradient moves back (the
+    single-controller p2p_communication.send/recv pair)."""
+    v = x._value if isinstance(x, Tensor) else x
+    src = getattr(v, "sharding", None)
+    if src == dst_sharding:
+        return x if isinstance(x, Tensor) else Tensor._from_value(x)
+    return apply_op("pp_transfer",
+                    lambda a: _move(a, dst_sharding, src), (x,))
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
 class PipelineParallel(Layer):
     """Parity: PipelineParallel (reference pipeline_parallel.py:150)."""
 
@@ -41,9 +121,78 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
         self.num_stages = layers.num_stages
+        self.num_segments = layers.num_segments
+
+        self._stage_meshes = build_stage_meshes(hcg) if hcg is not None \
+            else None
+        self._segment_shardings = None
+        if self._stage_meshes is not None:
+            self._segment_shardings = [
+                NamedSharding(self._stage_meshes[self.segment_to_stage(j)],
+                              P())
+                for j in range(self.num_segments)]
+            self._place_segments()
+
+    # segment j -> stage (identity for plain PP; interleaved for VPP)
+    def segment_to_stage(self, seg: int) -> int:
+        return seg % self.num_stages
+
+    def _place_segments(self):
+        """Put every segment's parameters on its stage's submesh,
+        preserving any tp/fsdp PartitionSpec the param already carries
+        (minus the pipe axis) — afterwards stage parameter device sets are
+        disjoint.  A parameter shared between segments (SharedLayerDesc)
+        is placed once, on its first owning stage; the per-item transfer
+        in _run_placed routes activations to it."""
+        seen = set()
+        for j in range(self.num_segments):
+            mesh_j = self._stage_meshes[self.segment_to_stage(j)]
+            for p in self._layers.segment_parameters(j):
+                if id(p) in seen:
+                    continue
+                seen.add(id(p))
+                p._value = jax.device_put(
+                    p._value, _restrict_sharding(p._value, mesh_j))
+
+    def stage_devices(self, stage_id: int):
+        if self._stage_meshes is None:
+            return set()
+        return set(np.ravel(self._stage_meshes[stage_id].devices).tolist())
 
     def forward(self, x):
-        return self._layers(x)
+        return self._forward_all(x)
+
+    def _forward_all(self, x):
+        out = x
+        for j in range(self.num_segments):
+            if self._segment_shardings is not None:
+                out = transfer_to_stage(out, self._segment_shardings[j])
+                out = self._run_segment_placed(j, out)
+            else:
+                out = self._layers.forward_segment(j, out)
+        return out
+
+    def _run_segment_placed(self, j, x):
+        """Run one segment item-by-item, routing the activation to each
+        parameterized item's device group first — this is what makes
+        SharedLayerDesc weights (placed once, on their first owning stage)
+        usable from a later stage: the activation visits the weight."""
+        from ....core.device import device_group_key
+        out = x
+        for m, ffn in self._layers._segments[j]:
+            params = m.parameters() if isinstance(m, Layer) else []
+            if params:
+                pk = device_group_key(params[0]._value)
+                if pk is not None and \
+                        device_group_key(out._value) != pk:
+                    out = transfer_to_stage(
+                        out, NamedSharding(params[0]._value.sharding.mesh,
+                                           P()))
+            if ffn is not None:
+                out = ffn(m, out)
+            else:
+                out = m(out)
+        return out
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)
@@ -54,54 +203,56 @@ class PipelineParallel(Layer):
     def set_state_dict(self, *a, **k):
         return self._layers.set_state_dict(*a, **k)
 
-    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Parity: train_batch (reference :657) running the 1F1B schedule
-        (:440): warmup forwards, steady 1F1B, cooldown backwards.
+    # -- scheduling ----------------------------------------------------------
+    def _build_plan(self, x_micro, y_micro, in_flight, losses, scaler):
+        """1F1B job list (reference forward_backward_pipeline :440):
+        warmup forwards, steady 1F1B, cooldown backwards, optimizer."""
+        from ....static import Job, Plan
 
-        ``data`` = (inputs, labels); split into micro-batches on dim 0.
-        Gradients accumulate across micro-batches; one optimizer step.
-        Returns the mean loss (same reduction as the reference).
-        """
+        n_micro = len(x_micro)
+        warmup = min(self.num_stages - 1, n_micro)
+
+        def forward_one(i):
+            def run(_feed=None):
+                out = self._forward_all(x_micro[i])
+                lab = self._label_to_output_mesh(y_micro[i], out)
+                loss = self._layers.loss(out, lab)
+                loss_b = scaler.scale(loss) if scaler is not None else loss
+                in_flight.append(loss_b)
+                losses.append(loss)
+            return run
+
+        def backward_one(_feed=None):
+            loss_b = in_flight.pop(0)
+            (loss_b * (1.0 / n_micro)).backward()
+
+        jobs = []
+        fwd_i = 0
+        for _ in range(warmup):
+            jobs.append(Job("forward", forward_one(fwd_i), fwd_i))
+            fwd_i += 1
+        while fwd_i < n_micro:
+            jobs.append(Job("forward", forward_one(fwd_i), fwd_i))
+            jobs.append(Job("backward", backward_one, fwd_i - warmup))
+            fwd_i += 1
+        for i in range(n_micro - warmup, n_micro):
+            jobs.append(Job("backward", backward_one, i))
+        return Plan(jobs, micro_batch_num=n_micro)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Parity: train_batch (reference :657): run the 1F1B Plan through
+        static.Executor, then one optimizer step."""
+        from ....static import Executor
+
         inputs, labels = data
         n_micro = self.accumulate_steps
         x_micro = _split(inputs, n_micro, axis=0)
         y_micro = _split(labels, n_micro, axis=0)
 
-        num_stages = self.num_stages
-        warmup = min(num_stages - 1, n_micro)
-
-        # queues of in-flight (loss-tensor) per micro-batch: with a tape,
-        # "forward then backward later" = keep the loss tensor alive.
         in_flight: List = []
         losses: List = []
-
-        def forward_one(i):
-            out = x_micro[i]
-            for s in range(num_stages):
-                out = self._layers.forward_stage(s, out)
-            loss = self._layers.loss(out, y_micro[i])
-            if scaler is not None:
-                loss_b = scaler.scale(loss)
-            else:
-                loss_b = loss
-            in_flight.append(loss_b)
-            losses.append(loss)
-
-        def backward_one():
-            loss_b = in_flight.pop(0)
-            scale = 1.0 / n_micro
-            loss_b = loss_b * scale
-            loss_b.backward()
-
-        # 1F1B order (reference forward_backward_pipeline :440)
-        fwd_i = 0
-        for _ in range(warmup):               # warmup forwards
-            forward_one(fwd_i); fwd_i += 1
-        while fwd_i < n_micro:                # steady state: 1F then 1B
-            forward_one(fwd_i); fwd_i += 1
-            backward_one()
-        while in_flight:                      # cooldown backwards
-            backward_one()
+        plan = self._build_plan(x_micro, y_micro, in_flight, losses, scaler)
+        Executor().run(plan)
 
         if scaler is not None:
             scaler.step(optimizer)
@@ -112,24 +263,52 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
 
+        # all losses come off the last stage's submesh, so plain summation
         total = losses[0]
         for l in losses[1:]:
             total = total + l
         return total * (1.0 / n_micro)
 
+    def _label_to_output_mesh(self, label, out):
+        """Labels join the loss wherever the final activation actually
+        lives (a tied head may have pulled it back to an earlier stage)."""
+        if self._segment_shardings is None:
+            return label
+        sh = getattr(out._value, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return transfer_to_stage(label, NamedSharding(sh.mesh, P()))
+        return label
+
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data
-        out = self._layers(inputs)
+        out = self._forward_all(inputs)
         if compute_loss:
+            labels = self._label_to_output_mesh(labels, out)
             return self._layers.loss(out, labels)
         return out
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved/VPP schedule parity (reference :906).  The virtual-stage
-    partitioning reuses PipelineLayer segments; scheduling order follows the
-    same 1F1B skeleton with chunked stages."""
+    """Interleaved 1F1B / VPP (reference :906).
 
-    def __init__(self, layers, hcg, strategy, num_model_chunks=2):
-        super().__init__(layers, hcg, strategy)
+    The model splits into ``num_stages * num_model_chunks`` segments;
+    segment j lives on stage ``j % num_stages`` (chunk ``j // num_stages``)
+    — reference's virtual-stage layout, so each stage holds
+    ``num_model_chunks`` non-contiguous model chunks and a micro-batch
+    visits every stage ``num_model_chunks`` times.  Under the single
+    controller the defining property is this interleaved placement (and
+    the cross-stage transfers it induces); job ordering reuses the 1F1B
+    skeleton at micro-batch granularity.
+    """
+
+    def __init__(self, layers, hcg, strategy, num_model_chunks=None):
+        if num_model_chunks is None:
+            num_model_chunks = max(
+                1, layers.num_segments // max(layers.num_stages, 1))
         self.num_model_chunks = num_model_chunks
+        if layers.num_segments != layers.num_stages * num_model_chunks:
+            raise ValueError(
+                f"PipelineLayer has {layers.num_segments} segments; "
+                f"interleave needs num_stages*num_model_chunks = "
+                f"{layers.num_stages * num_model_chunks}")
+        super().__init__(layers, hcg, strategy)

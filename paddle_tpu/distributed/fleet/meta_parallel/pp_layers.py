@@ -57,6 +57,7 @@ class PipelineLayer(Layer):
         super().__init__()
         self._loss_fn = loss_fn
         self._num_stages = num_stages or 1
+        self._num_chunks = num_virtual_pipeline_stages or 1
         self._recompute_interval = recompute_interval
         descs = list(layers)
         self._shared: dict = {}
@@ -76,11 +77,20 @@ class PipelineLayer(Layer):
 
         self._items = built
         # uniform partition by layer count (reference's seg_method default)
-        bounds = np.linspace(0, len(built), self._num_stages + 1
-                             ).astype(int).tolist()
+        # into num_stages * num_chunks segments; with virtual stages
+        # (VPP), segment j lives on stage j % num_stages (chunk
+        # j // num_stages) — reference pp_layers.py:237 interleaved layout.
+        n_seg = self._num_stages * self._num_chunks
+        bounds = np.linspace(0, len(built), n_seg + 1).astype(int).tolist()
         self._stage_bounds = bounds
+        self._segments: List[List] = [
+            built[bounds[i]:bounds[i + 1]] for i in range(n_seg)]
+        # contiguous per-stage view (valid when num_chunks == 1)
         self._stages: List[List] = [
-            built[bounds[i]:bounds[i + 1]] for i in range(self._num_stages)]
+            self._segments[s] if self._num_chunks == 1 else
+            sum((self._segments[c * self._num_stages + s]
+                 for c in range(self._num_chunks)), [])
+            for s in range(self._num_stages)]
 
         # register modules so parameters are discoverable
         mods = LayerList()
@@ -94,6 +104,14 @@ class PipelineLayer(Layer):
     def num_stages(self):
         return self._num_stages
 
+    @property
+    def num_chunks(self):
+        return self._num_chunks
+
+    @property
+    def num_segments(self):
+        return len(self._segments)
+
     def get_stage_layers(self, stage_id):
         return self._stages[stage_id]
 
@@ -103,6 +121,16 @@ class PipelineLayer(Layer):
             if isinstance(m, Layer):
                 params.extend(m.parameters())
         return params
+
+    def segment_parameters(self, seg_id):
+        params = []
+        for m, _ in self._segments[seg_id]:
+            if isinstance(m, Layer):
+                params.extend(m.parameters())
+        return params
+
+    def forward_segment(self, seg_id, x):
+        return self._run_items(self._segments[seg_id], x)
 
     def _run_items(self, items, x):
         for m, ffn in items:

@@ -174,6 +174,19 @@ class ProcessMesh:
         return False
 
 
+def as_jax_mesh(mesh) -> Mesh:
+    """Unwrap ProcessMesh / HybridCommunicateGroup / jax Mesh to jax Mesh."""
+    jm = getattr(mesh, "jax_mesh", None)
+    if jm is not None:
+        return jm
+    if isinstance(mesh, Mesh):
+        return mesh
+    inner = getattr(mesh, "mesh", None)   # HCG exposes .mesh (ProcessMesh)
+    if inner is not None and inner is not mesh:
+        return as_jax_mesh(inner)
+    raise TypeError(f"cannot extract a jax Mesh from {mesh!r}")
+
+
 def get_mesh() -> Optional[ProcessMesh]:
     return _GLOBAL_MESH
 

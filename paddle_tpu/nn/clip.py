@@ -64,6 +64,15 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def _global_norm(self, grads):
         sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
               for g in grads]
+        # grads may live on disjoint stage submeshes (pipeline parallel):
+        # device-side addition across device sets is illegal, so when more
+        # than one device group is present the partial sums are combined
+        # on the host (the eager analog of the reference's hybrid clip
+        # all-reducing partial norms across pp/mp groups).
+        from ..core.device import device_group_key
+        if len({device_group_key(g._value) for g in grads}) > 1:
+            import numpy as _np
+            return float(_np.sqrt(sum(float(_np.asarray(s)) for s in sq)))
         total = sq[0]
         for s in sq[1:]:
             total = total + s
@@ -74,7 +83,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
         if not grads:
             return params_grads
         gnorm = self._global_norm(grads)
-        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        if isinstance(gnorm, float):   # cross-submesh host path
+            scale = self.clip_norm / max(gnorm, self.clip_norm)
+        else:
+            scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
         out = []
         for p, g in params_grads:
             if g is None:

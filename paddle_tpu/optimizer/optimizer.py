@@ -86,11 +86,6 @@ class Optimizer:
             return
 
         hyper = self._hyper_params()
-        ps, gs, sts = [], [], []
-        for p, g in params_grads:
-            ps.append(p._value)
-            gs.append(g._value if isinstance(g, Tensor) else g)
-            sts.append(self._ensure_state(p))
 
         if self._update_jit is None:
             rule = self._update_rule
@@ -105,10 +100,24 @@ class Optimizer:
 
             self._update_jit = jax.jit(fused)
 
-        new_ps, new_sts = self._update_jit(ps, gs, sts, hyper)
-        for (p, _), nv, nst in zip(params_grads, new_ps, new_sts):
-            p._value = nv
-            self._state[id(p)] = nst
+        # One fused jit call per device group: params may live on disjoint
+        # submeshes (pipeline stages), and a single jitted computation
+        # cannot mix arguments from different device sets.
+        from ..core.device import device_group_key
+        groups: Dict[Any, list] = {}
+        for p, g in params_grads:
+            groups.setdefault(device_group_key(p._value), []).append((p, g))
+
+        for group in groups.values():
+            ps, gs, sts = [], [], []
+            for p, g in group:
+                ps.append(p._value)
+                gs.append(g._value if isinstance(g, Tensor) else g)
+                sts.append(self._ensure_state(p))
+            new_ps, new_sts = self._update_jit(ps, gs, sts, hyper)
+            for (p, _), nv, nst in zip(group, new_ps, new_sts):
+                p._value = nv
+                self._state[id(p)] = nst
         self._finish_step()
 
     def _finish_step(self):

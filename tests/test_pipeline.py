@@ -1,0 +1,291 @@
+"""Pipeline parallelism: eager 1F1B / VPP engines (disjoint stage
+submeshes, Plan/Job scheduling) and the compiled SPMD GPipe pipeline.
+
+Mirrors the reference's pipeline tests
+(test/collective/fleet/hybrid_parallel_pp_*.py) adapted to the
+single-controller mesh model; runs on the 8-device CPU mesh (conftest).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+
+
+def _pp_env(pp=2, accumulate=4, vpp=None):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": pp, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": accumulate,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy, fleet.get_hybrid_communicate_group()
+
+
+def _mlp_descs():
+    from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+    return [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.ReLU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.ReLU),
+            LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.ReLU),
+            LayerDesc(nn.Linear, 16, 4)]
+
+
+def _serial_twin():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                         nn.Linear(16, 16), nn.ReLU(),
+                         nn.Linear(16, 16), nn.ReLU(),
+                         nn.Linear(16, 4))
+
+
+def _train_parity(model, opt, serial, opt_s, lossf, steps=3):
+    X = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    Y = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    for _ in range(steps):
+        loss_p = model.train_batch(
+            (paddle.to_tensor(X), paddle.to_tensor(Y)), opt)
+        total = 0.0
+        for xx, yy in zip(np.split(X, 4), np.split(Y, 4)):
+            l = lossf(serial(paddle.to_tensor(xx)), paddle.to_tensor(yy))
+            (l * 0.25).backward()
+            total += float(np.asarray(l._value)) * 0.25
+        opt_s.step()
+        opt_s.clear_grad()
+        np.testing.assert_allclose(float(np.asarray(loss_p._value)),
+                                   total, rtol=2e-4)
+    # final params match too
+    sd_p = {k: np.asarray(v._value) for k, v in model.state_dict().items()}
+    sd_s = {k: np.asarray(v._value)
+            for k, v in serial.state_dict().items()}
+    for (kp, vp), (ks, vs) in zip(sorted(sd_p.items()),
+                                  sorted(sd_s.items())):
+        np.testing.assert_allclose(vp, vs, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_1f1b_disjoint_stages_and_parity():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel)
+    strategy, hcg = _pp_env(pp=2)
+
+    paddle.seed(7)
+    lossf = nn.MSELoss()
+    pipe = PipelineLayer(layers=_mlp_descs(), num_stages=2, loss_fn=lossf)
+    model = PipelineParallel(pipe, hcg, strategy)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+
+    # stage parameters must live on DISJOINT device sets
+    d0, d1 = model.stage_devices(0), model.stage_devices(1)
+    assert d0 and d1 and not (d0 & d1), (d0, d1)
+    for s in range(2):
+        for p in pipe.stage_parameters(s):
+            devs = set(p._value.devices())
+            assert devs <= model.stage_devices(s), (s, devs)
+
+    paddle.seed(7)
+    serial = _serial_twin()
+    opt_s = paddle.optimizer.SGD(0.05, parameters=serial.parameters())
+    _train_parity(model, opt, serial, opt_s, lossf)
+
+
+def test_pp_interleave_vpp_placement_and_parity():
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallelWithInterleave)
+    strategy, hcg = _pp_env(pp=2)
+
+    paddle.seed(7)
+    lossf = nn.MSELoss()
+    pipe = PipelineLayer(layers=_mlp_descs(), num_stages=2, loss_fn=lossf,
+                         num_virtual_pipeline_stages=2)
+    assert pipe.num_segments == 4
+    model = PipelineParallelWithInterleave(pipe, hcg, strategy,
+                                           num_model_chunks=2)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+
+    # interleaved placement: segment j on stage j % 2, so segments 0,2 on
+    # stage 0 and 1,3 on stage 1 — stage device sets disjoint
+    d0, d1 = model.stage_devices(0), model.stage_devices(1)
+    assert d0 and d1 and not (d0 & d1)
+    for j in range(4):
+        sh = model._segment_shardings[j]
+        want = model.stage_devices(j % 2)
+        for p in pipe.segment_parameters(j):
+            assert set(p._value.devices()) <= want, j
+
+    paddle.seed(7)
+    serial = _serial_twin()
+    opt_s = paddle.optimizer.SGD(0.05, parameters=serial.parameters())
+    _train_parity(model, opt, serial, opt_s, lossf)
+
+
+def test_pp_plan_jobs_one_f_one_b_order():
+    """The Plan routed through static.Executor must be 1F1B-ordered."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel)
+    strategy, hcg = _pp_env(pp=2, accumulate=4)
+    paddle.seed(0)
+    pipe = PipelineLayer(layers=_mlp_descs(), num_stages=2,
+                         loss_fn=nn.MSELoss())
+    model = PipelineParallel(pipe, hcg, strategy)
+    plan = model._build_plan([paddle.to_tensor(
+        np.zeros((2, 8), np.float32))] * 4,
+        [paddle.to_tensor(np.zeros((2, 4), np.float32))] * 4,
+        [], [], None)
+    kinds = [j.type for j in plan.jobs]
+    # warmup=1 forward, then (F B) * 3, then 1 cooldown backward
+    assert kinds == ["forward", "forward", "backward", "forward",
+                     "backward", "forward", "backward", "backward"], kinds
+    assert plan.micro_batch_num == 4
+
+
+def test_spmd_pipeline_compiled_grad_parity():
+    """Compiled GPipe (scan + ppermute in one XLA module) matches serial
+    forward/backward."""
+    from paddle_tpu.distributed.pipelining import (spmd_pipeline,
+                                                   stack_stage_params)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("data", "pipe"))
+    rng = np.random.RandomState(0)
+    D, M = 16, 8
+    stage_params = stack_stage_params([
+        {"w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+         "b": jnp.asarray(rng.randn(D).astype(np.float32) * 0.1)}
+        for _ in range(4)])
+    stage_params = jax.device_put(stage_params,
+                                  NamedSharding(mesh, P("pipe")))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    xs = jnp.asarray(rng.randn(M, 4, D).astype(np.float32))
+
+    def pipe_loss(params):
+        ys = spmd_pipeline(stage_fn, params, xs, mesh=mesh,
+                           axis_name="pipe")
+        return jnp.sum(ys ** 2)
+
+    def serial_loss(params):
+        ys = xs
+        for s in range(4):
+            p = jax.tree.map(lambda a: a[s], params)
+            ys = jax.vmap(lambda x: stage_fn(p, x))(ys)
+        return jnp.sum(ys ** 2)
+
+    lp, gp = jax.jit(jax.value_and_grad(pipe_loss))(stage_params)
+    ls, gs = jax.value_and_grad(serial_loss)(stage_params)
+    np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+    for k in gp:
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                   atol=1e-4)
+
+
+def test_llama_pipeline_train_step_matches_serial_loss():
+    """dp2 x pp2 x tp2 compiled llama pipeline step: first-step loss equals
+    the serial eager loss; loss decreases over steps."""
+    from paddle_tpu.models import (llama_tiny_config, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.models.llama_pipeline import LlamaPipelineTrainStep
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().mesh
+
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=4,
+                            num_attention_heads=4, num_key_value_heads=4,
+                            intermediate_size=176, vocab_size=512)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    step = LlamaPipelineTrainStep(model, opt, mesh, n_microbatches=4,
+                                  clip_norm=1.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    l1 = float(np.asarray(step(paddle.to_tensor(ids),
+                               paddle.to_tensor(ids.astype(np.int64)))
+                          ._value))
+    l2 = float(np.asarray(step(paddle.to_tensor(ids),
+                               paddle.to_tensor(ids.astype(np.int64)))
+                          ._value))
+    assert l2 < l1
+
+    paddle.seed(0)
+    twin = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion()
+    l_serial = float(np.asarray(
+        crit(twin(paddle.to_tensor(ids)),
+             paddle.to_tensor(ids.astype(np.int64)))._value))
+    np.testing.assert_allclose(l1, l_serial, rtol=1e-4)
+
+
+def test_pp_shared_layer_desc_tied_weights():
+    """A SharedLayerDesc module used by segments on different stages must
+    keep ONE weight copy (tying stays exact); activations visit it."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, LayerDesc, SharedLayerDesc, PipelineParallel)
+    strategy, hcg = _pp_env(pp=2, accumulate=2)
+
+    paddle.seed(3)
+    lossf = nn.MSELoss()
+
+    def head_fwd(m, x):
+        return m(x)
+
+    pipe = PipelineLayer(
+        layers=[SharedLayerDesc("tied", nn.Linear, head_fwd, "weight", 8, 8),
+                LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 8),
+                SharedLayerDesc("tied", nn.Linear, head_fwd, "weight", 8, 8)],
+        num_stages=2, loss_fn=lossf)
+    model = PipelineParallel(pipe, hcg, strategy)
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    X = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    Y = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    loss = model.train_batch((paddle.to_tensor(X), paddle.to_tensor(Y)),
+                             opt)
+    assert np.isfinite(float(np.asarray(loss._value)))
+    # the shared module exists once: exactly one Linear(8,8) weight pair
+    shared = pipe._shared["tied"]
+    assert shared.weight._value.shape == (8, 8)
+
+
+def test_pp_placement_preserves_tp_sharding():
+    """Params pre-sharded over the 'model' axis keep that spec when placed
+    on their stage submesh (pipe axis dropped, tp spec kept)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, LayerDesc, PipelineParallel)
+    from paddle_tpu.distributed.api import shard_param_
+    from paddle_tpu.distributed.process_mesh import Shard, Replicate
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    paddle.seed(0)
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2, loss_fn=nn.MSELoss())
+    # annotate the first Linear's weight as tp-column-sharded
+    lin0 = pipe._segments[0][0][0]
+    shard_param_(lin0.weight, hcg.mesh,
+                 [Replicate(), Replicate(), Replicate(), Replicate(),
+                  Shard(1)])
+    model = PipelineParallel(pipe, hcg, strategy)
+    sh = lin0.weight._value.sharding
+    assert "model" in str(sh.spec), sh.spec
+    # and it lives only on stage-0 devices
+    assert set(lin0.weight._value.devices()) <= model.stage_devices(0)
