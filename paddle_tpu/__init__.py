@@ -53,7 +53,8 @@ import importlib as _importlib
 _OPTIONAL_SUBMODULES = ["nn", "optimizer", "amp", "io", "jit", "static",
                         "distributed", "vision", "metric", "incubate",
                         "profiler", "device", "framework", "sparse",
-                        "linalg_ns", "fft", "models", "text", "audio"]
+                        "linalg_ns", "fft", "models", "text", "audio",
+                        "signal"]
 
 nn = None
 for _m in list(_OPTIONAL_SUBMODULES):

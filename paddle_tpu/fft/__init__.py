@@ -1,0 +1,109 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+
+Parity: python/paddle/fft.py (reference; kernels
+paddle/phi/kernels/cpu/fft_*.cc, fft_c2c/fft_r2c/fft_c2r ops in
+paddle/phi/api/yaml/ops.yaml).  TPU-native: every transform is the XLA FFT
+HLO via jnp.fft, so forward and VJP both compile; norm conventions follow
+numpy exactly like the reference does.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..ops._helpers import as_value, wrap
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return norm if norm in ("backward", "ortho", "forward") else "backward"
+
+
+def _def_1d(op_name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(
+            op_name, lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)),
+            (x,))
+    op.__name__ = op_name
+    return op
+
+
+def _def_nd(op_name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(
+            op_name, lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+            (x,))
+    op.__name__ = op_name
+    return op
+
+
+fft = _def_1d("fft", jnp.fft.fft)
+ifft = _def_1d("ifft", jnp.fft.ifft)
+rfft = _def_1d("rfft", jnp.fft.rfft)
+irfft = _def_1d("irfft", jnp.fft.irfft)
+hfft = _def_1d("hfft", jnp.fft.hfft)
+ihfft = _def_1d("ihfft", jnp.fft.ihfft)
+
+fftn = _def_nd("fftn", jnp.fft.fftn)
+ifftn = _def_nd("ifftn", jnp.fft.ifftn)
+rfftn = _def_nd("rfftn", jnp.fft.rfftn)
+irfftn = _def_nd("irfftn", jnp.fft.irfftn)
+
+
+def _def_2d(op_name, ndfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return ndfn(x, s=s, axes=axes, norm=norm)
+    op.__name__ = op_name
+    return op
+
+
+fft2 = _def_2d("fft2", fftn)
+ifft2 = _def_2d("ifft2", ifftn)
+rfft2 = _def_2d("rfft2", rfftn)
+irfft2 = _def_2d("irfft2", irfftn)
+
+
+_SWAP = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    # hermitian transform = c2r of the conjugate with fwd/bwd norms
+    # swapped (hfft(a,n) == irfft(conj(a),n,norm=swapped)); same rule the
+    # reference kernels use for fft_c2r hermitian mode.
+    return apply_op("hfftn", lambda v: jnp.fft.irfftn(
+        jnp.conj(v), s=s, axes=axes, norm=_SWAP[_norm(norm)]), (x,))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("ihfftn", lambda v: jnp.conj(
+        jnp.fft.rfftn(v, s=s, axes=axes, norm=_SWAP[_norm(norm)])), (x,))
+
+
+hfft2 = _def_2d("hfft2", hfftn)
+ihfft2 = _def_2d("ihfft2", ihfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return wrap(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda v: jnp.fft.fftshift(v, axes), (x,))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda v: jnp.fft.ifftshift(v, axes), (x,))

@@ -208,6 +208,11 @@ class StaticFunction:
         layers = self._layers
         n_state = len(state_items)
         call = fn.forward if isinstance(fn, Layer) else fn
+        # AST control-flow conversion (dy2static): tensor-predicate
+        # if/while/for compile to lax.cond/while_loop instead of breaking
+        # the trace (reference program_translator.py:776 AST mode).
+        from .transformers import convert_to_static as _cvt
+        call = _cvt(call)
         box: Dict[str, Any] = {}
 
         def traced(key, *vals):

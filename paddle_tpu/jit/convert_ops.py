@@ -1,0 +1,227 @@
+"""Runtime converters for dy2static control flow.
+
+Parity: python/paddle/jit/dy2static/convert_operators.py (reference —
+convert_ifelse :403, convert_while_loop :103, convert_logical_and :226).
+The AST transformer (transformers.py here) rewrites python ``if`` /
+``while`` / ``for range`` whose predicates may be traced tensors into
+calls to these converters, which dispatch:
+
+- python value predicate  -> plain python control flow (zero overhead)
+- traced Tensor predicate -> ``lax.cond`` / ``lax.while_loop`` so the
+  construct compiles into the XLA module (no unrolling, no host sync)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+
+class _Undef:
+    """Sentinel for names not bound in the enclosing scope (the analog of
+    the reference's UndefinedVar)."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+
+
+def try_read(thunk: Callable):
+    """Evaluate ``lambda: name`` against the enclosing scope; UNDEF when
+    the name is not bound yet (used for branch-fn argument defaults)."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _is_traced(x) -> bool:
+    if isinstance(x, Tensor):
+        x = x._value
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(pred):
+    if isinstance(pred, Tensor):
+        return pred._value
+    return pred
+
+
+def _to_vals(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_like(vals, like):
+    def one(v, l):
+        return Tensor._from_value(v) if isinstance(l, Tensor) else v
+    return jax.tree_util.tree_map(one, vals, like,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable):
+    """``if`` whose predicate may be a traced tensor.
+
+    Python-value predicates run one branch eagerly; traced predicates
+    compile both branches under ``lax.cond`` (branch outputs must match in
+    structure/shape/dtype, like the reference's select_input check)."""
+    p = _pred_value(pred)
+    if not isinstance(p, (jax.Array, jax.core.Tracer)) or not _is_traced(p):
+        return true_fn() if bool(np.asarray(p)) else false_fn()
+
+    t_out = true_fn()
+    f_out = false_fn()
+    t_vals = _to_vals(t_out)
+    f_vals = _to_vals(f_out)
+    # harmonize weakly-typed leaves so cond branches typecheck
+    try:
+        out_vals = lax.cond(jnp.reshape(p, ()).astype(bool),
+                            lambda: t_vals, lambda: f_vals)
+    except TypeError as e:
+        raise TypeError(
+            "to_static: both branches of a tensor-predicate `if` must "
+            f"produce matching shapes/dtypes/structures: {e}") from e
+    return _wrap_like(out_vals, t_out)
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable,
+                       loop_vars: Tuple):
+    """``while`` whose condition may be a traced tensor.
+
+    Loop-carried variables are exactly the names the transformer passed;
+    under trace they become the ``lax.while_loop`` carry (shapes must be
+    loop-invariant)."""
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first):
+        # eager python loop (condition re-evaluated on real values)
+        while bool(np.asarray(_pred_value(first))):
+            loop_vars = body_fn(*loop_vars)
+            if not isinstance(loop_vars, tuple):
+                loop_vars = (loop_vars,)
+            first = cond_fn(*loop_vars)
+        return loop_vars
+
+    template = loop_vars
+
+    def cond(vals):
+        vars_ = _wrap_like(vals, template)
+        return jnp.reshape(_pred_value(cond_fn(*vars_)), ()).astype(bool)
+
+    def body(vals):
+        vars_ = _wrap_like(vals, template)
+        out = body_fn(*vars_)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return _to_vals(out)
+
+    out_vals = lax.while_loop(cond, body, _to_vals(loop_vars))
+    return _wrap_like(out_vals, template)
+
+
+def convert_for_range(start, stop, step, body_fn: Callable,
+                      loop_vars: Tuple):
+    """``for i in range(...)`` with possibly-traced bounds: lowered to a
+    while loop with (i, *loop_vars) carry."""
+    def cond_fn(i, *vars_):
+        s = _pred_value(step)
+        return convert_logical_cmp(i, stop, s)
+
+    def body(i, *vars_):
+        out = body_fn(i, *vars_)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return (i + step,) + out
+
+    init = (start,) + tuple(loop_vars)
+    out = convert_while_loop(cond_fn, body, init)
+    # python leaves the index at its last yielded value after the loop;
+    # the carry ends one step past it (start - step if the loop never ran)
+    return (out[0] - step,) + tuple(out[1:])
+
+
+def convert_logical_cmp(i, stop, step):
+    sv = step._value if isinstance(step, Tensor) else step
+    if _is_traced(sv) or _is_traced(i) or _is_traced(stop):
+        iv = _pred_value(i)
+        st = _pred_value(stop)
+        s = _pred_value(step)
+        return jnp.where(s > 0, iv < st, iv > st)
+    return (i < stop) if step > 0 else (i > stop)
+
+
+def convert_logical_and(x_fn: Callable, y_fn: Callable):
+    """Short-circuiting ``and`` (reference convert_logical_and :226)."""
+    x = x_fn()
+    if not _is_traced(_pred_value(x)):
+        if not bool(np.asarray(_pred_value(x))):
+            return x
+        return y_fn()
+    y = y_fn()
+    return Tensor._from_value(
+        jnp.logical_and(jnp.reshape(_pred_value(x), ()),
+                        jnp.reshape(_pred_value(y), ())))
+
+
+def convert_logical_or(x_fn: Callable, y_fn: Callable):
+    x = x_fn()
+    if not _is_traced(_pred_value(x)):
+        if bool(np.asarray(_pred_value(x))):
+            return x
+        return y_fn()
+    y = y_fn()
+    return Tensor._from_value(
+        jnp.logical_or(jnp.reshape(_pred_value(x), ()),
+                       jnp.reshape(_pred_value(y), ())))
+
+
+def convert_logical_not(x):
+    v = _pred_value(x)
+    if _is_traced(v):
+        return Tensor._from_value(jnp.logical_not(jnp.reshape(v, ())))
+    return not bool(np.asarray(v))
+
+
+_CALL_CACHE: dict = {}
+
+
+def convert_call(fn):
+    """Recursively convert called user functions (reference convert_call,
+    dy2static/convert_call_func.py:108): plain python functions defined
+    outside this framework / jax / numpy get the same AST transforms, so
+    control flow in helpers compiles too.  Everything else passes through
+    untouched."""
+    from .transformers import convert_function
+    import types
+
+    if not isinstance(fn, types.FunctionType):
+        return fn
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".")[0] in ("paddle_tpu", "jax", "jaxlib", "numpy",
+                             "builtins", "torch", "math", "functools"):
+        return fn
+    if getattr(fn, "_not_to_static", False) or \
+            getattr(fn, "__pt_converted__", False):
+        return fn
+    cached = _CALL_CACHE.get(fn)
+    if cached is None:
+        try:
+            cached = convert_function(fn)
+        except Exception:
+            cached = fn
+        _CALL_CACHE[fn] = cached
+    return cached

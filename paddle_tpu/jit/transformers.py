@@ -1,0 +1,344 @@
+"""Dy2static AST transformers.
+
+Parity: python/paddle/jit/dy2static/transformers/ (reference — the 18 AST
+transformers driven by program_translator.py:776; ifelse_transformer.py,
+loop_transformer.py, logical_transformer.py, call_transformer.py).
+
+TPU-native design: the rewritten constructs target the jax structured
+control-flow primitives through runtime converters (convert_ops.py) — a
+tensor-predicate ``if`` becomes ``lax.cond``, a tensor ``while`` becomes
+``lax.while_loop`` — so data-dependent control flow lives INSIDE the
+compiled XLA module instead of breaking the trace.  Python-value
+predicates keep exact python semantics (the converters dispatch at run
+time, like the reference's convert_* operators).
+
+Supported subset (documented, mirrors the reference's practical coverage):
+- ``if``/``elif``/``else`` with tensor predicates, where branches assign
+  variables (no ``return``/``break`` inside a transformed branch);
+- ``while`` with tensor predicates (no ``break``/``continue``); NOTE:
+  a traced-tensor ``while`` compiles to ``lax.while_loop``, which XLA
+  cannot reverse-differentiate — use it in inference/metrics paths, or a
+  python-bounded ``for`` (stays unrolled, fully differentiable) in
+  training code;
+- ``for i in range(...)``: python bounds stay a plain unrolled python
+  loop (differentiable); traced-tensor bounds lower to a while loop
+  (forward-only, same XLA constraint);
+- ``and`` / ``or`` / ``not`` over tensor operands (short-circuiting
+  preserved for python values);
+- recursive conversion of called user functions (convert_call).
+Constructs outside the subset are left as plain python: they still work
+whenever their predicates are python values, exactly like before.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import List, Optional, Set
+
+_COUNTER = [0]
+
+
+def _fresh(prefix: str) -> str:
+    _COUNTER[0] += 1
+    return f"__pt_{prefix}_{_COUNTER[0]}"
+
+
+# ---------------------------------------------------------------------------
+# name analysis
+# ---------------------------------------------------------------------------
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.stored: Set[str] = set()
+        self.loaded: Set[str] = set()
+        self.funcs: Set[str] = set()   # nested defs: not data-flow values
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.stored.add(node.id)
+        else:
+            self.loaded.add(node.id)
+
+    def visit_FunctionDef(self, node):   # don't descend into nested defs
+        self.funcs.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _analyze(stmts) -> _Names:
+    v = _Names()
+    for s in stmts:
+        v.visit(s)
+    return v
+
+
+def _contains(stmts, kinds) -> bool:
+    class F(ast.NodeVisitor):
+        found = False
+
+        def generic_visit(self, node):
+            if isinstance(node, kinds):
+                self.found = True
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                super().generic_visit(node)
+    f = F()
+    for s in stmts:
+        f.visit(s)
+    return f.found
+
+
+def _try_read_default(name: str) -> ast.expr:
+    """``_jst.try_read(lambda: name)`` — evaluated at def time, yields the
+    current outer binding or the UNDEF sentinel."""
+    return ast.Call(
+        func=ast.Attribute(ast.Name("_jst", ast.Load()), "try_read",
+                           ast.Load()),
+        args=[ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=ast.Name(name, ast.Load()))],
+        keywords=[])
+
+
+def _names_tuple(names: List[str], ctx) -> ast.expr:
+    return ast.Tuple([ast.Name(n, ctx()) for n in names], ctx())
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+def _fndef(name, args, body):
+    fd = ast.FunctionDef(name=name, args=args, body=body,
+                         decorator_list=[])
+    fd.type_params = []   # required field on py3.12 ASTs
+    return fd
+
+class Dy2StaticTransformer(ast.NodeTransformer):
+    # -- logical ops --------------------------------------------------------
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fname = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        out = node.values[-1]
+        for val in reversed(node.values[:-1]):
+            out = ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()), fname,
+                                   ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=val),
+                    ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=out)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()),
+                                   "convert_logical_not", ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+    # -- if/else ------------------------------------------------------------
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        branches = node.body + node.orelse
+        if _contains(branches, (ast.Return, ast.Break, ast.Continue,
+                                ast.Yield, ast.YieldFrom)):
+            return node   # unsupported in a branch fn: keep python
+
+    # assigned names (either branch) become the branch-fn outputs
+        t = _analyze(node.body)
+        f = _analyze(node.orelse)
+        assigned = sorted((t.stored | f.stored) - t.funcs - f.funcs
+                          - {"_", "_jst"})
+        if not assigned:
+            return node   # side-effect-only branches: keep python
+
+        tname, fname = _fresh("true_fn"), _fresh("false_fn")
+        args = ast.arguments(
+            posonlyargs=[], kwonlyargs=[], kw_defaults=[],
+            args=[ast.arg(n) for n in assigned],
+            defaults=[_try_read_default(n) for n in assigned])
+        ret = ast.Return(_names_tuple(assigned, ast.Load))
+        true_def = _fndef(tname, args, node.body + [ret])
+        false_def = _fndef(fname, args,
+                           (node.orelse or [ast.Pass()]) + [ret])
+        call = ast.Assign(
+            targets=[_names_tuple(assigned, ast.Store)],
+            value=ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()),
+                                   "convert_ifelse", ast.Load()),
+                args=[node.test, ast.Name(tname, ast.Load()),
+                      ast.Name(fname, ast.Load())],
+                keywords=[]))
+        return [true_def, false_def, call]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse or _contains(
+                node.body, (ast.Break, ast.Continue, ast.Return,
+                            ast.Yield, ast.YieldFrom)):
+            return node
+
+        body_names = _analyze(node.body)
+        # anything the body stores may be read by the condition or after
+        # the loop (unknowable locally) — carry all stored names
+        loop_vars = sorted(body_names.stored - body_names.funcs
+                           - {"_", "_jst"})
+        if not loop_vars:
+            return node
+
+        cname, bname = _fresh("while_cond"), _fresh("while_body")
+        args = ast.arguments(posonlyargs=[], kwonlyargs=[],
+                             kw_defaults=[], defaults=[],
+                             args=[ast.arg(n) for n in loop_vars])
+        cond_def = _fndef(cname, args, [ast.Return(node.test)])
+        body_def = _fndef(
+            bname, args,
+            node.body + [ast.Return(_names_tuple(loop_vars, ast.Load))])
+        call = ast.Assign(
+            targets=[_names_tuple(loop_vars, ast.Store)],
+            value=ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()),
+                                   "convert_while_loop", ast.Load()),
+                args=[ast.Name(cname, ast.Load()),
+                      ast.Name(bname, ast.Load()),
+                      ast.Tuple([_try_read_default(n)
+                                 for n in loop_vars], ast.Load())],
+                keywords=[]))
+        return [cond_def, body_def, call]
+
+    # -- for i in range(...) ------------------------------------------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        if (node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or _contains(node.body, (ast.Break, ast.Continue,
+                                         ast.Return, ast.Yield,
+                                         ast.YieldFrom))):
+            return node
+
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        else:
+            start, stop, step = rargs
+
+        ivar = node.target.id
+        body_names = _analyze(node.body)
+        loop_vars = sorted(body_names.stored - body_names.funcs
+                           - {ivar, "_", "_jst"})
+
+        bname = _fresh("for_body")
+        args = ast.arguments(
+            posonlyargs=[], kwonlyargs=[], kw_defaults=[], defaults=[],
+            args=[ast.arg(ivar)] + [ast.arg(n) for n in loop_vars])
+        body_def = _fndef(
+            bname, args,
+            node.body + [ast.Return(_names_tuple(loop_vars, ast.Load))])
+        # the index stays bound after the loop (python semantics)
+        targets = _names_tuple([ivar] + loop_vars, ast.Store)
+        call = ast.Assign(
+            targets=[targets],
+            value=ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()),
+                                   "convert_for_range", ast.Load()),
+                args=[start, stop, step, ast.Name(bname, ast.Load()),
+                      ast.Tuple([_try_read_default(n)
+                                 for n in loop_vars], ast.Load())],
+                keywords=[]))
+        return [body_def, call]
+
+    # -- nested calls -------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        # only wrap plain-name calls: attribute calls are overwhelmingly
+        # framework/methods, and wrapping them would be pure overhead
+        if isinstance(node.func, ast.Name) and node.func.id not in (
+                "range", "len", "print", "isinstance", "super", "_jst"):
+            node.func = ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()),
+                                   "convert_call", ast.Load()),
+                args=[node.func], keywords=[])
+        return node
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def convert_function(fn):
+    """AST-convert a python function for tracing; returns the original on
+    any failure (no-source builtins, exotic constructs)."""
+    from . import convert_ops as _jst_mod
+
+    if isinstance(fn, functools.partial):
+        inner = convert_function(fn.func)
+        return functools.partial(inner, *fn.args, **(fn.keywords or {}))
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []   # strip @to_static etc.
+
+    new_tree = Dy2StaticTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _jst_mod
+    # rebind closure freevars as globals (values snapshotted now)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)
+    out = ns[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__pt_converted__ = True
+    return out
+
+
+def convert_to_static(call):
+    """Entry used by StaticFunction: convert a function or bound method."""
+    if isinstance(call, types.MethodType):
+        conv = convert_function(call.__func__)
+        if conv is call.__func__:
+            return call
+        return types.MethodType(conv, call.__self__)
+    conv = convert_function(call)
+    return conv

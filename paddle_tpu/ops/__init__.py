@@ -23,12 +23,18 @@ from . import reduction as reduction        # noqa: E402
 from . import linalg as linalg      # noqa: E402
 from . import logic as logic        # noqa: E402
 from . import random as random      # noqa: E402
+from . import extras as extras      # noqa: E402
 
 from .registry import registered_ops, get_op  # noqa: F401
 
 # Re-export every registered op at package level.
 for _name, _opdef in registry.registered_ops().items():
     globals().setdefault(_name, _opdef.fn)
+
+# plain-function extras (not dispatch-registered)
+from .extras import (broadcast_shape, is_complex, is_floating_point,  # noqa
+                     is_integer, create_tensor, create_parameter,
+                     index_fill_, gammaln_, multigammaln_)
 
 
 # ---------------------------------------------------------------------------

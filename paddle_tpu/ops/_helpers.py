@@ -82,6 +82,17 @@ def def_binary(name: str, jfn: Callable, category="math", method=True,
     return op
 
 
+def sliding_windows(v, axis: int, size: int, step: int):
+    """Gather sliding windows along ``axis``: result has the window count
+    at ``axis`` and a new ``size`` dim right after it.  Shared by
+    Tensor.unfold and signal.frame."""
+    ax = axis % v.ndim
+    n = (v.shape[ax] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    out = jnp.take(v, idx.reshape(-1), axis=ax)
+    return out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+
+
 def export(module_name: str, names_fns):
     """Inject generated ops into a module namespace."""
     mod = sys.modules[module_name]

@@ -1,0 +1,335 @@
+"""Tensor-API long tail: the remaining reference paddle.tensor surface.
+
+Parity: python/paddle/tensor/__init__.py export list (reference) — the 38
+names absent after the core op families; each lowers to one or a few XLA
+ops through apply_op so forward AND vjp come from the same definition.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jspecial
+
+from ..core.dispatch import apply_op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .registry import register_op, register
+from ._helpers import as_value, wrap, targ, def_unary, def_binary
+
+
+# ---------------------------------------------------------------------------
+# shape / structure
+# ---------------------------------------------------------------------------
+def broadcast_shape(x_shape, y_shape):
+    """Parity: paddle.broadcast_shape — pure shape computation."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register_op("rank", category="manipulation")
+def rank(input, name=None):
+    return wrap(jnp.asarray(as_value(input).ndim, jnp.int32))
+
+
+@register_op("tensor_split", category="manipulation", tensor_method=True)
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    v = as_value(x)
+    if isinstance(num_or_indices, int):
+        parts = np.array_split(np.arange(v.shape[axis]), num_or_indices)
+        idx = np.cumsum([len(p) for p in parts])[:-1].tolist()
+    else:
+        idx = list(num_or_indices)
+    outs = jnp.split(v, idx, axis=axis)
+    return [wrap(o) for o in outs]
+
+
+@register_op("hsplit", category="manipulation", tensor_method=True)
+def hsplit(x, num_or_indices, name=None):
+    ax = 0 if as_value(x).ndim == 1 else 1
+    return tensor_split(x, num_or_indices, axis=ax)
+
+
+@register_op("vsplit", category="manipulation", tensor_method=True)
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+@register_op("dsplit", category="manipulation", tensor_method=True)
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@register_op("unflatten", category="manipulation", tensor_method=True)
+def unflatten(x, axis, shape, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        shp = [int(s.item()) if hasattr(s, "item") else int(s)
+               for s in (shape if isinstance(shape, (list, tuple))
+                         else list(np.asarray(as_value(shape))))]
+        return v.reshape(v.shape[:ax] + tuple(shp) + v.shape[ax + 1:])
+    return apply_op("unflatten", fn, (x,))
+
+
+@register_op("unfold", category="manipulation", tensor_method=True)
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (Tensor.unfold, reference
+    python/paddle/tensor/manipulation.py tensor_unfold)."""
+    from ._helpers import sliding_windows
+
+    def fn(v):
+        ax = axis % v.ndim
+        return jnp.moveaxis(sliding_windows(v, ax, size, step), ax + 1, -1)
+    return apply_op("unfold", fn, (x,))
+
+
+@register_op("reverse", category="manipulation", tensor_method=True)
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("reverse", lambda v: jnp.flip(v, ax), (x,))
+
+
+# -- scatter views ----------------------------------------------------------
+@register_op("diagonal_scatter", category="manipulation", tensor_method=True)
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(v, w):
+        a1, a2 = axis1 % v.ndim, axis2 % v.ndim
+        moved = jnp.moveaxis(v, (a1, a2), (-2, -1))
+        n, m = moved.shape[-2], moved.shape[-1]
+        if offset >= 0:
+            L = min(n, m - offset)
+            r, c = np.arange(L), np.arange(L) + offset
+        else:
+            L = min(n + offset, m)
+            r, c = np.arange(L) - offset, np.arange(L)
+        out = moved.at[..., r, c].set(w)   # w: diagonal shape [..., L]
+        return jnp.moveaxis(out, (-2, -1), (a1, a2))
+    return apply_op("diagonal_scatter", fn, (x, targ(y)))
+
+
+@register_op("select_scatter", category="manipulation", tensor_method=True)
+def select_scatter(x, values, axis, index, name=None):
+    def fn(v, w):
+        idx = [slice(None)] * v.ndim
+        idx[axis % v.ndim] = index
+        return v.at[tuple(idx)].set(w)
+    return apply_op("select_scatter", fn, (x, targ(values)))
+
+
+@register_op("slice_scatter", category="manipulation", tensor_method=True)
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(v, w):
+        idx = [slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax % v.ndim] = slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(w)
+    return apply_op("slice_scatter", fn, (x, targ(value)))
+
+
+@register_op("index_fill", category="manipulation", tensor_method=True,
+             inplace_alias=True)
+def index_fill(x, index, axis, value, name=None):
+    def fn(v, idx):
+        sl = [slice(None)] * v.ndim
+        sl[axis % v.ndim] = idx
+        val = value._value if isinstance(value, Tensor) else value
+        return v.at[tuple(sl)].set(val)
+    return apply_op("index_fill", fn, (x, as_value(index)))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    return x._inplace_assign(index_fill(x, index, axis, value))
+
+
+# ---------------------------------------------------------------------------
+# math long tail
+# ---------------------------------------------------------------------------
+@register_op("cdist", category="math")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+    return apply_op("cdist", fn, (x, targ(y)))
+
+
+@register_op("cumulative_trapezoid", category="math", tensor_method=True)
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None and dx is not None:
+        raise ValueError(
+            "cumulative_trapezoid: pass either x or dx, not both")
+
+    def fn(yv, *rest):
+        yl = jnp.moveaxis(yv, axis, -1)
+        avg = (yl[..., 1:] + yl[..., :-1]) / 2.0
+        if rest:
+            xs = jnp.moveaxis(rest[0], axis, -1)
+            widths = xs[..., 1:] - xs[..., :-1]
+        else:
+            widths = 1.0 if dx is None else dx
+        return jnp.moveaxis(jnp.cumsum(avg * widths, -1), -1, axis)
+    args = (y,) if x is None else (y, targ(x))
+    return apply_op("cumulative_trapezoid", fn, args)
+
+
+@register_op("frexp", category="math", tensor_method=True)
+def frexp(x, name=None):
+    v = as_value(x)
+    m, e = jnp.frexp(v)
+    return wrap(m), wrap(e.astype(v.dtype))
+
+
+@register_op("increment", category="math")
+def increment(x, value=1.0, name=None):
+    return x._inplace_assign(apply_op("increment", lambda v: v + value,
+                                      (x,)))
+
+
+@register_op("polar", category="math")
+def polar(abs, angle, name=None):
+    return apply_op(
+        "polar", lambda a, t: (a * jnp.cos(t)) + 1j * (a * jnp.sin(t)),
+        (abs, targ(angle)))
+
+
+@register_op("renorm", category="math", tensor_method=True,
+             inplace_alias=True)
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        other = tuple(i for i in range(v.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=other, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-12),
+                           1.0)
+        return v * factor
+    return apply_op("renorm", fn, (x,))
+
+
+@register_op("sgn", category="math", tensor_method=True)
+def sgn(x, name=None):
+    def fn(v):
+        if jnp.issubdtype(v.dtype, jnp.complexfloating):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0, v / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(v)
+    return apply_op("sgn", fn, (x,))
+
+
+@register_op("vander", category="math", tensor_method=True)
+def vander(x, n=None, increasing=False, name=None):
+    def fn(v):
+        cols = v.shape[0] if n is None else n
+        powers = jnp.arange(cols)
+        if not increasing:
+            powers = powers[::-1]
+        return v[:, None] ** powers[None, :]
+    return apply_op("vander", fn, (x,))
+
+
+gammaln = def_unary("gammaln", jspecial.gammaln)
+
+
+def gammaln_(x, name=None):
+    return x._inplace_assign(gammaln(x))
+
+
+@register_op("multigammaln", category="math", tensor_method=True,
+             inplace_alias=True)
+def multigammaln(x, p, name=None):
+    return apply_op("multigammaln",
+                    lambda v: jspecial.multigammaln(v, p), (x,))
+
+
+def multigammaln_(x, p, name=None):
+    return x._inplace_assign(multigammaln(x, p))
+
+
+# dtype predicates ----------------------------------------------------------
+def is_complex(x):
+    return bool(jnp.issubdtype(as_value(x).dtype, jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(as_value(x).dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(as_value(x).dtype, jnp.integer))
+
+
+# ---------------------------------------------------------------------------
+# creation / random
+# ---------------------------------------------------------------------------
+def create_tensor(dtype, name=None, persistable=False):
+    return Tensor(jnp.zeros((), _dt.convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.layer_base import Parameter
+    from ..nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierNormal())
+    d = _dt.convert_dtype(dtype)
+    t = Parameter(init(tuple(shape), d))
+    t.stop_gradient = False
+    return t
+
+
+@register_op("cauchy_", category="random")
+def cauchy_(x, loc=0, scale=1, name=None):
+    from .random import next_key
+    v = as_value(x)
+    u = jax.random.uniform(next_key(), v.shape, jnp.float32, 1e-7,
+                           1 - 1e-7)
+    x._value = (loc + scale * jnp.tan(jnp.pi * (u - 0.5))).astype(v.dtype)
+    return x
+
+
+@register_op("geometric_", category="random")
+def geometric_(x, probs, name=None):
+    from .random import next_key
+    v = as_value(x)
+    p = as_value(probs)
+    u = jax.random.uniform(next_key(), v.shape, jnp.float32, 1e-7,
+                           1 - 1e-7)
+    x._value = jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(v.dtype)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# sampling (serving path)
+# ---------------------------------------------------------------------------
+@register_op("top_p_sampling", category="random")
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (parity: paddle.tensor.top_p_sampling; reference
+    paddle/phi/kernels/gpu/top_p_sampling_kernel.cu capability).
+
+    x: [batch, vocab] probabilities; ps: [batch] cumulative-probability
+    cutoffs.  Returns (sampled probability, sampled ids), both [batch, 1].
+    """
+    from .random import next_key, _seeded_key
+    v = as_value(x)
+    p = as_value(ps).reshape(-1)
+    key = _seeded_key(seed) if seed not in (None, -1) else next_key()
+
+    order = jnp.argsort(-v, axis=-1)
+    sorted_probs = jnp.take_along_axis(v, order, -1)
+    cum = jnp.cumsum(sorted_probs, -1)
+    keep = cum - sorted_probs <= p[:, None]   # always keep the top token
+    masked = jnp.where(keep, sorted_probs, 0.0)
+    masked = masked / jnp.sum(masked, -1, keepdims=True)
+    g = jax.random.gumbel(key, masked.shape)
+    choice = jnp.argmax(jnp.where(keep, jnp.log(masked + 1e-30) + g,
+                                  -jnp.inf), -1)
+    ids = jnp.take_along_axis(order, choice[:, None], -1)
+    probs = jnp.take_along_axis(v, ids, -1)
+    return wrap(probs), wrap(ids.astype(jnp.int64))

@@ -1,0 +1,146 @@
+"""paddle_tpu.signal — frame / overlap_add / stft / istft.
+
+Parity: python/paddle/signal.py (reference; frame & overlap_add kernels
+paddle/phi/kernels/cpu/frame_kernel.cc, overlap_add_kernel.cc).  All four
+lower to gather/scatter + XLA FFT, differentiable end to end.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import apply_op
+from .core.tensor import Tensor
+from .ops._helpers import as_value, wrap, targ
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slide windows of ``frame_length`` every ``hop_length`` (reference
+    python/paddle/signal.py:30)."""
+    from .ops._helpers import sliding_windows
+
+    def fn(v):
+        ax = axis % v.ndim
+        out = sliding_windows(v, ax, frame_length, hop_length)
+        # paddle layout: frame_length before num_frames when axis=-1
+        if axis in (-1, v.ndim - 1):
+            out = jnp.swapaxes(out, ax, ax + 1)
+        return out
+    return apply_op("frame", fn, (x,))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference python/paddle/signal.py:176)."""
+    def fn(v):
+        if axis in (-1, v.ndim - 1):
+            frame_length, n = v.shape[-2], v.shape[-1]
+            frames = jnp.swapaxes(v, -1, -2)   # [..., n, frame_length]
+        else:
+            n, frame_length = v.shape[0], v.shape[1]
+            frames = jnp.moveaxis(v, (0, 1), (-2, -1))
+        out_len = (n - 1) * hop_length + frame_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), v.dtype)
+        out = out.at[..., idx.reshape(-1)].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        if axis not in (-1, v.ndim - 1):
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply_op("overlap_add", fn, (x,))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (parity: paddle.signal.stft).
+
+    x: [batch, seq] (or [seq]); returns [batch, n_fft//2+1, frames]
+    complex (onesided) like the reference.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    wv = targ(window) if window is not None else None
+
+    def fn(v, *rest):
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if rest:
+            w = rest[0]
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        else:
+            w = jnp.ones((n_fft,), v.dtype)
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n = (v.shape[-1] - n_fft) // hop_length + 1
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = v[:, idx]                       # [B, n, n_fft]
+        frames = frames * w[None, None, :]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)         # [B, freq, frames]
+        return out[0] if squeeze else out
+
+    args = (x,) if wv is None else (x, wv)
+    return apply_op("stft", fn, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-sum normalization (parity:
+    paddle.signal.istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = targ(window) if window is not None else None
+
+    def fn(v, *rest):
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        if rest:
+            w = rest[0]
+            pad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - win_length - pad))
+        else:
+            w = jnp.ones((n_fft,), jnp.float32)
+        spec = jnp.swapaxes(v, -1, -2)           # [B, frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = jnp.real(frames)
+        frames = frames * w[None, None, :]
+        n = frames.shape[1]
+        out_len = (n - 1) * hop_length + n_fft
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros((frames.shape[0], out_len), frames.dtype)
+        out = out.at[:, idx].add(frames.reshape(frames.shape[0], -1))
+        wsum = jnp.zeros((out_len,), w.dtype)
+        wsum = wsum.at[idx].add(jnp.tile(w * w, (n,)))
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            out = out[:, n_fft // 2:]
+            tail = out.shape[-1] - (n_fft // 2)
+            out = out[:, :tail] if length is None else out[:, :length]
+        elif length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    args = (x,) if wv is None else (x, wv)
+    return apply_op("istft", fn, args)

@@ -53,32 +53,47 @@ def check_output_jit(op_fn: Callable, np_ref: Callable,
 
 def check_grad(op_fn: Callable, inputs: Dict[str, np.ndarray],
                grad_vars: Sequence[str], attrs: Dict = None,
-               delta=1e-3, rtol=5e-2, atol=1e-4, reduce_fn=None):
+               delta=1e-5, rtol=1e-3, atol=1e-6, reduce_fn=None,
+               dtype=np.float64):
     """Finite-difference gradient check through the eager tape
-    (analog of reference op_test.py check_grad :2972)."""
+    (analog of reference op_test.py check_grad :2972).
+
+    Runs in float64 (x64 is enabled package-wide) so central differences
+    with a small delta are accurate — tolerances are correspondingly
+    tight, unlike the f32-era 5e-2."""
     attrs = attrs or {}
     reduce_fn = reduce_fn or (lambda t: (t * t).sum() if isinstance(t, Tensor)
                               else sum(((o * o).sum() for o in t),
                                        paddle.zeros([])))
 
-    tensors = {k: paddle.to_tensor(v.astype(np.float64).astype(np.float32),
-                                   stop_gradient=(k not in grad_vars))
-               for k, v in inputs.items()}
+    def make_tensors(vals):
+        out = {}
+        for k, v in vals.items():
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = arr.astype(dtype)
+            out[k] = paddle.to_tensor(arr,
+                                      stop_gradient=(k not in grad_vars))
+        return out
+
+    tensors = make_tensors(inputs)
     out = op_fn(**tensors, **attrs)
     loss = reduce_fn(out)
     loss.backward()
 
     for var in grad_vars:
         analytic = tensors[var].grad.numpy().astype(np.float64)
-        base = {k: v.copy().astype(np.float64) for k, v in inputs.items()}
+        base = {k: np.asarray(v).copy() for k, v in inputs.items()}
+        base[var] = base[var].astype(np.float64)
 
         def eval_loss(vals):
-            ts = {k: paddle.to_tensor(v.astype(np.float32))
-                  for k, v in vals.items()}
+            ts = make_tensors(vals)
+            for t in ts.values():
+                t.stop_gradient = True
             o = op_fn(**ts, **attrs)
             return float(reduce_fn(o).item())
 
-        numeric = np.zeros_like(base[var])
+        numeric = np.zeros_like(base[var], dtype=np.float64)
         flat = base[var].reshape(-1)
         num_flat = numeric.reshape(-1)
         for i in range(flat.size):
@@ -92,6 +107,19 @@ def check_grad(op_fn: Callable, inputs: Dict[str, np.ndarray],
         np.testing.assert_allclose(
             analytic, numeric, rtol=rtol, atol=atol,
             err_msg=f"gradient mismatch for input {var!r}")
+
+
+def run_op_suite(op_fn: Callable, np_ref: Callable,
+                 inputs: Dict[str, np.ndarray], attrs: Dict = None,
+                 grad_vars: Sequence[str] = (), rtol=1e-5, atol=1e-6,
+                 grad_kwargs: Dict = None):
+    """One-call harness: forward vs numpy (eager + jit) and, when
+    ``grad_vars`` given, finite-difference gradients."""
+    check_output(op_fn, np_ref, inputs, attrs, rtol, atol)
+    check_output_jit(op_fn, np_ref, inputs, attrs, rtol, atol)
+    if grad_vars:
+        check_grad(op_fn, inputs, list(grad_vars), attrs,
+                   **(grad_kwargs or {}))
 
 
 def _assert_tree_close(out, ref, rtol, atol, mode):

@@ -1,0 +1,174 @@
+"""Dy2static control-flow conversion: tensor-predicate if/while/for
+compile to lax.cond / lax.while_loop inside to_static traces.
+
+Mirrors the reference's dygraph_to_static tests
+(test/dygraph_to_static/test_ifelse.py, test_loop.py) — eager-vs-static
+output parity plus gradient flow through converted control flow.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import to_static
+
+
+def branchy(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x - 1
+    return y
+
+
+def elif_chain(x):
+    if x.sum() > 10:
+        y = x * 10
+    elif x.sum() > 0:
+        y = x * 2
+    else:
+        y = x * 0
+    return y
+
+
+def while_accum(x):
+    s = paddle.zeros([])
+    while s < 10.0:
+        s = s + x.sum()
+    return s
+
+
+def for_accum(x, n):
+    acc = paddle.zeros([])
+    for i in range(n):
+        acc = acc + x.sum() * (i + 1)
+    return acc
+
+
+def bool_ops(x):
+    if (x.sum() > 0) and (x.max() < 10):
+        z = x + 1
+    else:
+        z = x - 1
+    return z
+
+
+def helper_fn(x):
+    # control flow inside a CALLED helper must convert too (convert_call)
+    if x.sum() > 0:
+        r = x * 3
+    else:
+        r = x * -3
+    return r
+
+
+def calls_helper(x):
+    return helper_fn(x) + 1
+
+
+XP = np.array([1.0, 2.0], np.float32)
+XN = np.array([-1.0, -2.0], np.float32)
+
+
+@pytest.mark.parametrize("fn,args_list", [
+    (branchy, [(XP,), (XN,)]),
+    (elif_chain, [(XP,), (XN,), (np.array([8.0, 7.0], np.float32),)]),
+    (bool_ops, [(XP,), (XN,)]),
+    (calls_helper, [(XP,), (XN,)]),
+], ids=["if", "elif", "and", "convert_call"])
+def test_static_matches_eager(fn, args_list):
+    static = to_static(fn)
+    for args in args_list:
+        eager = fn(*[paddle.to_tensor(a) for a in args])
+        compiled = static(*[paddle.to_tensor(a) for a in args])
+        np.testing.assert_allclose(compiled.numpy(), eager.numpy(),
+                                   rtol=1e-6)
+
+
+def test_while_loop_compiles():
+    static = to_static(while_accum)
+    out = static(paddle.to_tensor(np.array([3.0], np.float32)))
+    assert float(np.asarray(out._value)) == 12.0
+    out = static(paddle.to_tensor(np.array([6.0], np.float32)))
+    assert float(np.asarray(out._value)) == 12.0
+
+
+def test_for_range_compiles():
+    static = to_static(for_accum)
+    out = static(paddle.to_tensor(np.array([2.0], np.float32)), 3)
+    # 2*(1+2+3) = 12
+    assert float(np.asarray(out._value)) == 12.0
+
+
+def test_grad_through_converted_cond():
+    static = to_static(branchy)
+    x = paddle.to_tensor(XP.copy(), stop_gradient=False)
+    static(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    x2 = paddle.to_tensor(XN.copy(), stop_gradient=False)
+    static(x2).sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [1.0, 1.0])
+
+
+def test_branch_model_end_to_end():
+    """A branch/loop-heavy Layer trains under to_static and matches
+    eager — the VERDICT item-4 'done' shape."""
+
+    class GatedMLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            h = self.fc1(x)
+            if h.mean() > 0:
+                h = nn.functional.relu(h)
+            else:
+                h = nn.functional.gelu(h)
+            for _i in range(2):   # python bounds: stays unrolled (differentiable)
+                h = h * 1.1
+            return self.fc2(h)
+
+    paddle.seed(0)
+    m = GatedMLP()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    eager = m(x)
+    static = to_static(m)
+    out = static(x)
+    np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    # trains: one SGD step reduces loss deterministically
+    opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+    for _ in range(3):
+        loss = (static(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float((static(x) ** 2).mean().item()) < \
+        float((eager ** 2).mean().item())
+
+
+def test_python_predicates_unchanged():
+    """Plain python control flow keeps exact semantics (converters
+    dispatch on value type)."""
+
+    def fn(x, flag):
+        if flag:           # python bool — no cond
+            y = x + 1
+        else:
+            y = x - 1
+        n = 0
+        while n < 3:       # python ints — no while_loop
+            y = y * 1.0
+            n += 1
+        return y
+
+    static = to_static(fn)
+    np.testing.assert_allclose(
+        static(paddle.to_tensor(XP), True).numpy(), XP + 1)
+    np.testing.assert_allclose(
+        static(paddle.to_tensor(XP), False).numpy(), XP - 1)

@@ -1,0 +1,423 @@
+"""Table-driven op suite: forward vs numpy in eager AND jit mode, plus
+float64 finite-difference gradient checks through the tape.
+
+Mirrors the reference's OpTest pattern (test/legacy_test/op_test.py:2016
+check_output, :2972 check_grad) — one compact case table instead of 3k
+generated files, because every op here is a single jax definition whose
+backward comes from the same code path (core/dispatch.py VJP capture).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from op_test import check_output, check_output_jit, check_grad, run_op_suite
+
+rng = np.random.RandomState(0)
+
+
+def _p(shape, lo=-1.0, hi=1.0):
+    return (rng.uniform(lo, hi, shape)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# unary math: forward + numeric grad (safe domains per op)
+# ---------------------------------------------------------------------------
+UNARY = [
+    # (name, np_ref, input, check_grad?)
+    ("abs", np.abs, _p((2, 3), 0.2, 1.0), True),
+    ("acos", np.arccos, _p((2, 3), -0.8, 0.8), True),
+    ("asin", np.arcsin, _p((2, 3), -0.8, 0.8), True),
+    ("atan", np.arctan, _p((2, 3)), True),
+    ("acosh", np.arccosh, _p((2, 3), 1.2, 3.0), True),
+    ("asinh", np.arcsinh, _p((2, 3)), True),
+    ("atanh", np.arctanh, _p((2, 3), -0.8, 0.8), True),
+    ("ceil", np.ceil, _p((2, 3), 0.1, 0.9) + 1.3, False),
+    ("floor", np.floor, _p((2, 3), 0.1, 0.9) + 1.3, False),
+    ("cos", np.cos, _p((2, 3)), True),
+    ("cosh", np.cosh, _p((2, 3)), True),
+    ("sin", np.sin, _p((2, 3)), True),
+    ("sinh", np.sinh, _p((2, 3)), True),
+    ("tan", np.tan, _p((2, 3), -0.6, 0.6), True),
+    ("tanh", np.tanh, _p((2, 3)), True),
+    ("exp", np.exp, _p((2, 3)), True),
+    ("expm1", np.expm1, _p((2, 3)), True),
+    ("log", np.log, _p((2, 3), 0.3, 2.0), True),
+    ("log2", np.log2, _p((2, 3), 0.3, 2.0), True),
+    ("log10", np.log10, _p((2, 3), 0.3, 2.0), True),
+    ("log1p", np.log1p, _p((2, 3), -0.5, 2.0), True),
+    ("sqrt", np.sqrt, _p((2, 3), 0.2, 2.0), True),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), _p((2, 3), 0.2, 2.0), True),
+    ("square", np.square, _p((2, 3)), True),
+    ("reciprocal", np.reciprocal, _p((2, 3), 0.4, 2.0), True),
+    ("sign", np.sign, _p((2, 3), 0.2, 1.0), False),
+    ("erf", None, _p((2, 3)), True),
+    ("erfinv", None, _p((2, 3), -0.7, 0.7), True),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), _p((2, 3)), True),
+    ("lgamma", None, _p((2, 3), 0.5, 3.0), True),
+    ("digamma", None, _p((2, 3), 0.8, 3.0), True),
+    ("gammaln", None, _p((2, 3), 0.5, 3.0), True),
+    ("trunc", np.trunc, _p((2, 3)) * 3, False),
+    ("frac", lambda x: x - np.trunc(x), _p((2, 3)) * 3, True),
+    ("deg2rad", np.deg2rad, _p((2, 3)) * 90, True),
+    ("rad2deg", np.rad2deg, _p((2, 3)), True),
+    ("logit", None, _p((2, 3), 0.2, 0.8), True),
+]
+
+
+@pytest.mark.parametrize("name,np_ref,x,grad", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary(name, np_ref, x, grad):
+    import scipy.special as sps
+    fn = getattr(paddle, name)
+    ref = np_ref or {
+        "erf": sps.erf, "erfinv": sps.erfinv, "lgamma": sps.gammaln,
+        "gammaln": sps.gammaln, "digamma": sps.digamma, "logit": sps.logit,
+    }[name]
+    check_output(lambda x: fn(x), lambda x: ref(x), {"x": x}, rtol=2e-5,
+                 atol=2e-6)
+    check_output_jit(lambda x: fn(x), lambda x: ref(x), {"x": x},
+                     rtol=2e-5, atol=2e-6)
+    if grad:
+        check_grad(lambda x: fn(x), {"x": x}, ["x"])
+
+
+# ---------------------------------------------------------------------------
+# binary math
+# ---------------------------------------------------------------------------
+BINARY = [
+    ("add", np.add, _p((2, 3)), _p((3,)), True),
+    ("subtract", np.subtract, _p((2, 3)), _p((3,)), True),
+    ("multiply", np.multiply, _p((2, 3)), _p((3,)), True),
+    ("divide", np.divide, _p((2, 3)), _p((3,), 0.5, 1.5), True),
+    ("maximum", np.maximum, _p((2, 3)), _p((3,)), True),
+    ("minimum", np.minimum, _p((2, 3)), _p((3,)), True),
+    ("fmax", np.fmax, _p((2, 3)), _p((3,)), True),
+    ("fmin", np.fmin, _p((2, 3)), _p((3,)), True),
+    ("atan2", np.arctan2, _p((2, 3), 0.2, 1.0), _p((3,), 0.2, 1.0), True),
+    ("logaddexp", np.logaddexp, _p((2, 3)), _p((3,)), True),
+    ("hypot", np.hypot, _p((2, 3), 0.2, 1.0), _p((3,), 0.2, 1.0), True),
+    ("copysign", np.copysign, _p((2, 3), 0.2, 1.0), _p((3,)), False),
+    ("nextafter", np.nextafter, _p((2, 3)), _p((3,)), False),
+    ("heaviside", np.heaviside, _p((2, 3)), _p((3,), 0.1, 0.9), False),
+    ("mod", np.mod, _p((2, 3), 1.0, 4.0), _p((3,), 0.5, 1.5), False),
+    ("floor_divide", np.floor_divide, _p((2, 3), 1.0, 4.0),
+     _p((3,), 0.5, 1.5), False),
+]
+
+
+@pytest.mark.parametrize("name,np_ref,x,y,grad", BINARY,
+                         ids=[b[0] for b in BINARY])
+def test_binary(name, np_ref, x, y, grad):
+    fn = getattr(paddle, name)
+    ref = lambda x, y: np_ref(x, y)
+    check_output(lambda x, y: fn(x, y), ref, {"x": x, "y": y})
+    check_output_jit(lambda x, y: fn(x, y), ref, {"x": x, "y": y})
+    if grad:
+        check_grad(lambda x, y: fn(x, y), {"x": x, "y": y}, ["x", "y"])
+
+
+# ---------------------------------------------------------------------------
+# reductions with grads
+# ---------------------------------------------------------------------------
+REDUCE = [
+    ("sum", np.sum, {}, True),
+    ("mean", np.mean, {}, True),
+    ("prod", np.prod, {}, True),
+    ("max", np.max, {}, True),
+    ("min", np.min, {}, True),
+    ("amax", np.amax, {}, True),
+    ("amin", np.amin, {}, True),
+    ("nansum", np.nansum, {}, True),
+    ("nanmean", np.nanmean, {}, True),
+    ("logsumexp", None, {}, True),
+]
+
+
+@pytest.mark.parametrize("name,np_ref,attrs,grad", REDUCE,
+                         ids=[r[0] for r in REDUCE])
+def test_reduce(name, np_ref, attrs, grad):
+    import scipy.special as sps
+    x = _p((3, 4), 0.1, 2.0)
+    fn = getattr(paddle, name)
+    ref = np_ref or (lambda x, axis=None: sps.logsumexp(x, axis=axis))
+    check_output(lambda x: fn(x), lambda x: ref(x), {"x": x})
+    check_output(lambda x: fn(x, axis=1), lambda x: ref(x, axis=1),
+                 {"x": x})
+    if grad:
+        check_grad(lambda x: fn(x), {"x": x}, ["x"])
+
+
+# ---------------------------------------------------------------------------
+# extras: the 38-name tensor-API tail
+# ---------------------------------------------------------------------------
+def test_broadcast_shape():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+def test_rank_and_dtype_predicates():
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert int(paddle.rank(t).item()) == 2
+    assert paddle.is_floating_point(t)
+    assert not paddle.is_integer(t)
+    assert not paddle.is_complex(t)
+    assert paddle.is_complex(paddle.to_tensor(np.ones(2, np.complex64)))
+
+
+def test_splits():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    outs = paddle.tensor_split(paddle.to_tensor(x), 3, axis=1)
+    refs = np.array_split(x, 3, axis=1)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r)
+    outs = paddle.vsplit(paddle.to_tensor(x), 2)
+    for o, r in zip(outs, np.vsplit(x, 2)):
+        np.testing.assert_allclose(o.numpy(), r)
+    outs = paddle.hsplit(paddle.to_tensor(x), 2)
+    for o, r in zip(outs, np.hsplit(x, 2)):
+        np.testing.assert_allclose(o.numpy(), r)
+    x3 = x.reshape(2, 2, 6)
+    outs = paddle.dsplit(paddle.to_tensor(x3), 3)
+    for o, r in zip(outs, np.dsplit(x3, 3)):
+        np.testing.assert_allclose(o.numpy(), r)
+
+
+def test_unflatten_unfold_reverse():
+    x = _p((2, 12))
+    run_op_suite(lambda x: paddle.unflatten(x, 1, [3, 4]),
+                 lambda x: x.reshape(2, 3, 4), {"x": x}, grad_vars=["x"])
+    import torch
+    xt = _p((8,))
+    got = paddle.unfold(paddle.to_tensor(xt), 0, 4, 2).numpy()
+    want = torch.tensor(xt).unfold(0, 4, 2).numpy()
+    np.testing.assert_allclose(got, want)
+    check_grad(lambda x: paddle.unfold(x, 0, 4, 2), {"x": xt}, ["x"])
+    run_op_suite(lambda x: paddle.reverse(x, 1),
+                 lambda x: x[:, ::-1], {"x": _p((2, 3))}, grad_vars=["x"])
+
+
+def test_scatter_views():
+    import torch
+    x = _p((4, 4))
+    y = _p((4,))
+    got = paddle.diagonal_scatter(paddle.to_tensor(x),
+                                  paddle.to_tensor(y)).numpy()
+    want = torch.diagonal_scatter(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want)
+    check_grad(lambda x, y: paddle.diagonal_scatter(x, y),
+               {"x": x, "y": y}, ["x", "y"])
+
+    v = _p((4,))
+    got = paddle.select_scatter(paddle.to_tensor(x), paddle.to_tensor(v),
+                                0, 2).numpy()
+    want = torch.select_scatter(torch.tensor(x), torch.tensor(v), 0,
+                                2).numpy()
+    np.testing.assert_allclose(got, want)
+
+    val = _p((2, 4))
+    got = paddle.slice_scatter(paddle.to_tensor(x), paddle.to_tensor(val),
+                               [0], [1], [3], [1]).numpy()
+    ref = x.copy()
+    ref[1:3] = val
+    np.testing.assert_allclose(got, ref)
+
+    got = paddle.index_fill(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([0, 2])), 0,
+                            -1.0).numpy()
+    ref = x.copy()
+    ref[[0, 2]] = -1.0
+    np.testing.assert_allclose(got, ref)
+
+
+def test_math_extras():
+    import torch
+    x = _p((3, 4), 0.2, 2.0)
+    y = _p((5, 4), 0.2, 2.0)
+    got = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    want = torch.cdist(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    yv = _p((2, 6))
+    got = paddle.cumulative_trapezoid(paddle.to_tensor(yv), dx=0.5).numpy()
+    want = torch.cumulative_trapezoid(torch.tensor(yv), dx=0.5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    check_grad(lambda y: paddle.cumulative_trapezoid(y, dx=0.5),
+               {"y": yv}, ["y"])
+
+    m, e = paddle.frexp(paddle.to_tensor(np.array([4.0, 0.5, 3.0],
+                                                  np.float32)))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(),
+                               [4.0, 0.5, 3.0])
+
+    t = paddle.to_tensor(np.array(1.0, np.float32))
+    paddle.increment(t, 2.0)
+    assert float(t.item()) == 3.0
+
+    a, th = _p((2, 3), 0.2, 1.0), _p((2, 3))
+    got = paddle.polar(paddle.to_tensor(a), paddle.to_tensor(th)).numpy()
+    want = torch.polar(torch.tensor(a), torch.tensor(th)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    xr = _p((3, 4), -2, 2)
+    got = paddle.renorm(paddle.to_tensor(xr), 2.0, 0, 1.0).numpy()
+    want = torch.renorm(torch.tensor(xr), 2.0, 0, 1.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    z = np.array([3 + 4j, 0j, -2j], np.complex64)
+    got = paddle.sgn(paddle.to_tensor(z)).numpy()
+    want = torch.sgn(torch.tensor(z)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    v = _p((4,), 0.5, 2.0)
+    got = paddle.vander(paddle.to_tensor(v)).numpy()
+    np.testing.assert_allclose(got, np.vander(v), rtol=1e-5)
+    got = paddle.vander(paddle.to_tensor(v), n=3, increasing=True).numpy()
+    np.testing.assert_allclose(got, np.vander(v, 3, True), rtol=1e-5)
+
+    import scipy.special as sps
+    xm = _p((2, 3), 1.5, 4.0)
+    got = paddle.multigammaln(paddle.to_tensor(xm), 2).numpy()
+    np.testing.assert_allclose(got, sps.multigammaln(xm, 2), rtol=1e-5)
+
+
+def test_random_extras_and_top_p():
+    paddle.seed(0)
+    t = paddle.to_tensor(np.zeros((1000,), np.float32))
+    paddle.ops.extras.cauchy_(t)
+    med = float(np.median(t.numpy()))
+    assert abs(med) < 0.2   # Cauchy median ~ loc=0
+
+    t2 = paddle.to_tensor(np.zeros((1000,), np.float32))
+    paddle.ops.extras.geometric_(t2, 0.5)
+    assert 1.5 < float(t2.numpy().mean()) < 2.5   # E[geom(0.5)] = 2
+
+    probs = np.array([[0.5, 0.3, 0.15, 0.05]] * 64, np.float32)
+    p, ids = paddle.top_p_sampling(paddle.to_tensor(probs),
+                                   paddle.to_tensor(
+                                       np.full((64,), 0.5, np.float32)))
+    assert ids.numpy().max() <= 1   # nucleus of 0.5 keeps tokens {0} or {0,1}
+    counts = np.bincount(ids.numpy().reshape(-1), minlength=4)
+    assert counts[0] > counts[1]
+
+
+def test_create_parameter_tensor():
+    p = paddle.create_parameter([4, 5], "float32")
+    assert not p.stop_gradient and p.shape == [4, 5]
+    t = paddle.create_tensor("int64")
+    assert t.dtype == paddle.int64
+
+
+# ---------------------------------------------------------------------------
+# fft namespace vs numpy
+# ---------------------------------------------------------------------------
+def test_fft_family_matches_numpy():
+    x = _p((4, 8))
+    xc = (x + 1j * _p((4, 8))).astype(np.complex64)
+    F = paddle.fft
+    for name, inp in [("fft", xc), ("ifft", xc), ("rfft", x),
+                      ("hfft", xc), ("ihfft", x)]:
+        got = getattr(F, name)(paddle.to_tensor(inp)).numpy()
+        want = getattr(np.fft, name)(inp, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4), name
+    got = F.irfft(paddle.to_tensor(np.fft.rfft(x))).numpy()
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-4)
+    # 2d / nd
+    got = F.fft2(paddle.to_tensor(xc)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft2(xc), rtol=1e-4, atol=1e-3)
+    got = F.ifftn(paddle.to_tensor(xc)).numpy()
+    np.testing.assert_allclose(got, np.fft.ifftn(xc), rtol=1e-4,
+                               atol=1e-4)
+    got = F.irfft2(paddle.to_tensor(np.fft.rfft2(x))).numpy()
+    np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-4)
+    # norms
+    for norm in ("backward", "ortho", "forward"):
+        got = F.fft(paddle.to_tensor(xc), norm=norm).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(xc, norm=norm),
+                                   rtol=1e-4, atol=1e-4)
+    # helpers
+    np.testing.assert_allclose(F.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5).astype(np.float32))
+    np.testing.assert_allclose(F.rfftfreq(8).numpy(),
+                               np.fft.rfftfreq(8).astype(np.float32))
+    got = F.fftshift(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fftshift(x))
+    got = F.ifftshift(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.ifftshift(x))
+
+
+def test_hfftn_matches_torch():
+    import torch
+    xc = (_p((4, 6)) + 1j * _p((4, 6))).astype(np.complex64)
+    got = paddle.fft.hfftn(paddle.to_tensor(xc)).numpy()
+    want = torch.fft.hfftn(torch.tensor(xc)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    xr = _p((4, 6))
+    got = paddle.fft.ihfftn(paddle.to_tensor(xr)).numpy()
+    want = torch.fft.ihfftn(torch.tensor(xr)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# signal: frame / overlap_add / stft / istft
+# ---------------------------------------------------------------------------
+def test_signal_stft_matches_torch():
+    import torch
+    sig = paddle.signal
+    x = _p((2, 64))
+    w = np.hanning(16).astype(np.float32)
+
+    got = sig.stft(paddle.to_tensor(x), n_fft=16, hop_length=4,
+                   window=paddle.to_tensor(w)).numpy()
+    want = torch.stft(torch.tensor(x), n_fft=16, hop_length=4,
+                      window=torch.tensor(w), center=True,
+                      pad_mode="reflect", return_complex=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    # istft roundtrip
+    back = sig.istft(paddle.to_tensor(got), n_fft=16, hop_length=4,
+                     window=paddle.to_tensor(w), length=64).numpy()
+    want_back = torch.istft(torch.tensor(want), n_fft=16, hop_length=4,
+                            window=torch.tensor(w), length=64).numpy()
+    np.testing.assert_allclose(back, want_back, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(back, x, rtol=1e-2, atol=1e-3)
+
+
+def test_signal_frame_overlap_add_roundtrip():
+    sig = paddle.signal
+    x = _p((2, 32))
+    f = sig.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)
+    assert f.shape == [2, 8, 4]
+    back = sig.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    check_grad(lambda x: sig.frame(x, 8, 4), {"x": x[0]}, ["x"])
+
+
+# ---------------------------------------------------------------------------
+# grads through linalg / manipulation staples
+# ---------------------------------------------------------------------------
+def test_linalg_grads():
+    check_grad(lambda x, y: paddle.matmul(x, y),
+               {"x": _p((3, 4)), "y": _p((4, 2))}, ["x", "y"])
+    w = paddle.to_tensor(_p((4, 2)))
+    check_grad(lambda x: paddle.einsum("ij,jk->ik", x, w),
+               {"x": _p((3, 4))}, ["x"])
+    check_grad(lambda x: paddle.trace(x), {"x": _p((4, 4))}, ["x"])
+    check_grad(lambda x: paddle.inverse(x),
+               {"x": _p((3, 3)) + 3 * np.eye(3, dtype=np.float32)}, ["x"])
+
+
+def test_manipulation_grads():
+    check_grad(lambda x: paddle.transpose(x, [1, 0]), {"x": _p((3, 4))},
+               ["x"])
+    check_grad(lambda x: paddle.concat([x, x], axis=0), {"x": _p((2, 3))},
+               ["x"])
+    check_grad(lambda x: paddle.gather(
+        x, paddle.to_tensor(np.array([0, 2]))), {"x": _p((4, 3))}, ["x"])
+    check_grad(lambda x: paddle.roll(x, 1, 0), {"x": _p((3, 3))}, ["x"])
+    check_grad(lambda x: paddle.flip(x, [0]), {"x": _p((3, 3))}, ["x"])
+    check_grad(lambda x: paddle.put_along_axis(
+        x, paddle.to_tensor(np.array([[0], [1]])),
+        paddle.to_tensor(np.array([[5.0], [6.0]], np.float32)), 1),
+        {"x": _p((2, 3))}, ["x"])
