@@ -39,6 +39,10 @@ Array = jax.Array
 _amp_state = {"enabled": False, "dtype": None, "level": "O1",
               "white": frozenset(), "black": frozenset()}
 
+# set to the profiler's record callback while a Profiler is RECORDing;
+# None otherwise so the off path costs one comparison
+_op_profile_hook = [None]
+
 
 def _is_tracer(v) -> bool:
     return isinstance(v, jax.core.Tracer)
@@ -112,6 +116,19 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
     ``tensor_args`` are passed through untouched (they are non-differentiable
     leaves such as python scalars).  Returns Tensor or tuple of Tensors.
     """
+    from .tensor import Tensor
+
+    prof = _op_profile_hook[0]
+    if prof is not None:
+        import time as _time
+        t0 = _time.perf_counter()
+        out = _apply_op_inner(name, fn, tensor_args, kwargs, multi_output)
+        prof(name, t0, _time.perf_counter(), "Operator")
+        return out
+    return _apply_op_inner(name, fn, tensor_args, kwargs, multi_output)
+
+
+def _apply_op_inner(name, fn, tensor_args, kwargs, multi_output):
     from .tensor import Tensor
 
     kwargs = kwargs or {}

@@ -43,13 +43,29 @@ def _shard_array_spec(shape, axis_name, nshards):
     return PartitionSpec()
 
 
+def _offload_sharding(sharding):
+    """Host-memory variant of a sharding (stage-2/3 ``offload=True``):
+    states live in pinned host memory and stream to HBM at update time.
+    Falls back to the device sharding when the backend has no host
+    memory space (CPU tests)."""
+    try:
+        import jax.numpy as jnp
+        host = sharding.with_memory_kind("pinned_host")
+        jax.device_put(jnp.zeros((), jnp.float32), host)  # probe support
+        return host
+    except Exception:
+        return sharding
+
+
 class GroupShardedOptimizerStage2:
     """Optimizer-state sharding (parity:
     group_sharded_optimizer_stage2.py:53).  Wraps any optimizer: every state
-    array is placed sharded over the sharding axis."""
+    array is placed sharded over the sharding axis (offload=True adds
+    host-memory placement)."""
 
     def __init__(self, params, optim, group=None, offload=False, **kw):
         self._optim = optim
+        self._offload = offload
         hcg = get_hybrid_communicate_group()
         self._mesh = hcg.mesh if hcg else None
         self._axis = _sharding_axis(self._mesh) if self._mesh else None
@@ -62,8 +78,10 @@ class GroupShardedOptimizerStage2:
                 for k, v in st.items():
                     if hasattr(v, "ndim") and v.ndim >= 1:
                         spec = _shard_array_spec(v.shape, self._axis, n)
-                        st[k] = jax.device_put(
-                            v, NamedSharding(self._mesh.jax_mesh, spec))
+                        sh = NamedSharding(self._mesh.jax_mesh, spec)
+                        if offload:
+                            sh = _offload_sharding(sh)
+                        st[k] = jax.device_put(v, sh)
                 return st
 
             optim._ensure_state = ensure
@@ -80,13 +98,46 @@ class GroupShardedOptimizerStage2:
 
 class GroupShardedStage2(Layer):
     """Grad + optimizer-state sharding wrapper (parity:
-    group_sharded_stage2.py:46)."""
+    group_sharded_stage2.py:46, whose grad hooks reduce-scatter each
+    bucket so every rank stores only its grad shard).
+
+    TPU-native: a grad accumulation hook re-places every incoming
+    gradient with a dim0 sharding over the sharding axis — the GSPMD form
+    of reduce-scatter-and-keep-my-shard.  Stored gradient memory per
+    device drops by the sharding degree between backward and step;
+    ``offload=True`` parks the stored grads in host memory."""
 
     def __init__(self, layer, sharding_optimizer, group=None,
-                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+                 sync_buffers=False, buffer_max_size=2 ** 23,
+                 offload=False, **kw):
         super().__init__()
         self._layers = layer
         self._optim = sharding_optimizer
+        hcg = get_hybrid_communicate_group()
+        self._mesh = hcg.mesh if hcg else None
+        self._axis = _sharding_axis(self._mesh) if self._mesh else None
+        if self._axis is not None:
+            n = self._mesh.get_dim_size(self._axis)
+
+            def make_hook(spec_sharding):
+                def hook(g):
+                    v = g._value if isinstance(g, Tensor) else g
+                    if isinstance(v, jax.core.Tracer):
+                        return g   # inside a trace: GSPMD handles layout
+                    return Tensor._from_value(
+                        jax.device_put(v, spec_sharding))
+                return hook
+
+            for p in layer.parameters():
+                if p.stop_gradient:
+                    continue
+                spec = _shard_array_spec(p._value.shape, self._axis, n)
+                if len(spec) == 0:
+                    continue   # non-divisible dim0: grads stay replicated
+                sh = NamedSharding(self._mesh.jax_mesh, spec)
+                if offload:
+                    sh = _offload_sharding(sh)
+                p.register_hook(make_hook(sh))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

@@ -117,6 +117,18 @@ class LlamaAttention(Layer):
         self.o_proj = Linear(self.num_heads * self.head_dim, h,
                              weight_attr=_attr(init), bias_attr=False)
 
+    def _ring_axis(self):
+        """Long-context path: when sequence_parallel is on and the hybrid
+        mesh has a sep axis > 1, attention runs as ring attention with
+        k/v rotating over that axis (collective-permute on ICI)."""
+        if not self.config.sequence_parallel:
+            return None
+        from ..distributed.topology import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+            return "sep"
+        return None
+
     def forward(self, x, attn_mask=None, cache=None):
         B, S = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
@@ -138,9 +150,18 @@ class LlamaAttention(Layer):
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
 
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            is_causal=(attn_mask is None and cache is None))
+        is_causal = attn_mask is None and cache is None
+        ring_axis = self._ring_axis() if is_causal else None
+        if ring_axis is not None:
+            from ..ops.pallas_kernels import sdpa_ring
+            from ..distributed.topology import \
+                get_hybrid_communicate_group
+            out = sdpa_ring(q, k, v,
+                            get_hybrid_communicate_group().mesh,
+                            axis_name=ring_axis, is_causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=is_causal)
         out = out.reshape([B, S, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if cache is not None:

@@ -23,6 +23,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 try:  # TPU backend only
@@ -139,7 +140,7 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
 
 
 def _sdpa_reference(q, k, v, causal):
-    """XLA reference (also the VJP path)."""
+    """Full-materialization XLA reference (tests / tiny shapes only)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
@@ -150,20 +151,92 @@ def _sdpa_reference(q, k, v, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
+def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
+    """Memory-bounded attention: lax.scan over k/v blocks with online
+    softmax; each block body is rematerialized (jax.checkpoint), so the
+    BACKWARD also runs block-by-block — activation memory stays
+    O(S·D + S) instead of the O(S²) of the naive formulation.  Handles
+    additive/bool masks and seq lengths not divisible by the block.
+
+    Layout [B, H, S, D].  This is both the flash VJP path and the
+    fallback forward for masked/ragged configs.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bk = min(block_k, Sk)
+    pad = (-Sk) % bk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    n_kb = (Sk + pad) // bk
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)
+    off = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
+
+    if mask is not None and mask.dtype != jnp.bool_:
+        mask = mask.astype(jnp.float32)
+
+    def block(carry, kb):
+        m_, l_, acc = carry
+        ks = lax.dynamic_slice_in_dim(k, kb * bk, bk, 2)
+        vs = lax.dynamic_slice_in_dim(v, kb * bk, bk, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks.astype(jnp.float32))
+        cols = kb * bk + off
+        valid = cols < Sk
+        if causal:
+            valid = valid & (rows >= cols)
+        if mask is not None:
+            mb = lax.dynamic_slice_in_dim(mask, kb * bk,
+                                          bk, mask.ndim - 1)
+            if mb.dtype == jnp.bool_:
+                valid = valid & mb
+            else:
+                s = s + mb
+        s = jnp.where(valid, s, -jnp.inf)
+        m_new = jnp.maximum(m_, jnp.max(s, -1))
+        # guard fully-masked rows (m_new = -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m_), m_ - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(m_), alpha, 0.0)
+        l_new = l_ * alpha + jnp.sum(p, -1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vs.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    init = (jnp.full((B, H, Sq), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, Sq), jnp.float32),
+            jnp.zeros((B, H, Sq, D), jnp.float32))
+    (m_, l_, acc), _ = lax.scan(jax.checkpoint(block), init,
+                                jnp.arange(n_kb, dtype=jnp.int32))
+    out = acc / jnp.maximum(l_, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def _pallas_ok(q, k, mask, block=256) -> bool:
+    return (_HAS_PLTPU and _on_tpu() and mask is None
+            and q.shape[2] % min(block, q.shape[2]) == 0
+            and k.shape[2] % min(block, k.shape[2]) == 0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_sdpa(q, k, v, causal):
-    return _flash_attention_value(q, k, v, causal)
+    if _pallas_ok(q, k, None):
+        return _flash_attention_value(q, k, v, causal)
+    return _chunked_sdpa(q, k, v, causal)
 
 
 def _flash_sdpa_fwd(q, k, v, causal):
-    return _flash_attention_value(q, k, v, causal), (q, k, v)
+    return _flash_sdpa(q, k, v, causal), (q, k, v)
 
 
 def _flash_sdpa_bwd(causal, res, g):
     q, k, v = res
-    # backward via XLA of the reference formulation (compiler fuses it);
-    # a pallas backward kernel is a later optimization slot.
-    _, vjp = jax.vjp(lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, causal),
+    # chunked backward: block recompute keeps memory bounded (replaces
+    # the r1 full-materialization VJP)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _chunked_sdpa(q_, k_, v_, causal),
                      q, k, v)
     return vjp(g)
 
@@ -172,22 +245,27 @@ _flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)
 
 
 def flash_attention_tpu(query, key, value, attn_mask=None, is_causal=False):
-    """Flash attention on TPU via Pallas.  Layout [B, S, H, D] (paddle
-    convention).  Raises on unsupported configs so callers fall back."""
-    if not (_HAS_PLTPU and _on_tpu()):
-        raise RuntimeError("pallas flash attention requires a TPU backend")
-    if attn_mask is not None:
-        raise RuntimeError("mask path handled by XLA fallback")
+    """Flash attention, paddle layout [B, S, H, D].
 
-    def fn(q, k, v):
+    Clean configs (no mask, block-divisible) hit the Pallas forward
+    kernel on TPU; masked or ragged-length configs run the chunked
+    online-softmax path — still memory-bounded, still one dispatched op.
+    The VJP is always the chunked backward."""
+
+    def fn(q, k, v, *m):
         q_ = jnp.swapaxes(q, 1, 2)
         k_ = jnp.swapaxes(k, 1, 2)
         v_ = jnp.swapaxes(v, 1, 2)
-        out = _flash_sdpa(q_, k_, v_, is_causal)
+        if m:
+            out = _chunked_sdpa(q_, k_, v_, is_causal, mask=m[0])
+        else:
+            out = _flash_sdpa(q_, k_, v_, is_causal)
         return jnp.swapaxes(out, 1, 2)
 
-    return apply_op("flash_attention_pallas", fn,
-                    (query, targ(key), targ(value)))
+    args = (query, targ(key), targ(value))
+    if attn_mask is not None:
+        args = args + (targ(attn_mask),)
+    return apply_op("flash_attention_pallas", fn, args)
 
 
 # ---------------------------------------------------------------------------
@@ -246,9 +324,13 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
     scale = 1.0 / math.sqrt(q.shape[-1])
     B, H, S, D = qh.shape
 
-    m = jnp.full((B, H, S, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, H, S, 1), jnp.float32)
-    acc = jnp.zeros((B, H, S, D), jnp.float32)
+    # carries are device-varying under shard_map vma checking
+    def vary(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    m = vary(jnp.full((B, H, S, 1), -jnp.inf, jnp.float32))
+    l = vary(jnp.zeros((B, H, S, 1), jnp.float32))
+    acc = vary(jnp.zeros((B, H, S, D), jnp.float32))
 
     kv = (jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
 
@@ -276,3 +358,31 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
     m, l, acc, _ = jax.lax.fori_loop(0, n, step, (m, l, acc, kv))
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def sdpa_ring(query, key, value, mesh, axis_name: str = "sep",
+              is_causal: bool = False):
+    """Sequence-parallel attention over a mesh axis (SURVEY.md §5.7 —
+    the beat-the-reference long-context path; the reference's snapshot
+    has NO ring attention).
+
+    q/k/v: [B, S, H, D] with S sharded over ``axis_name``.  Each rank
+    computes flash blocks against its local k/v then rotates k/v around
+    the ring with collective-permute (ICI); differentiable (the rotation
+    loop has a static trip count, so jax.grad reverses it)."""
+    from jax.sharding import PartitionSpec as P
+    from ..distributed.process_mesh import as_jax_mesh
+
+    jmesh = as_jax_mesh(mesh)
+    spec = P(None, axis_name)
+
+    def fn(q, k, v):
+        ring = jax.shard_map(
+            lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
+                                              is_causal),
+            mesh=jmesh, axis_names={axis_name},
+            in_specs=(spec, spec, spec), out_specs=spec)
+        return ring(q, k, v)
+
+    return apply_op("ring_attention", fn,
+                    (query, targ(key), targ(value)))

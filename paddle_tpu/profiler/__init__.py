@@ -1,0 +1,318 @@
+"""paddle_tpu.profiler — host + device profiling.
+
+Parity: python/paddle/profiler/profiler.py (reference — Profiler :79 with
+scheduler states CLOSED/READY/RECORD/RECORD_AND_RETURN :346, RecordEvent
+spans event_tracing.py, chrome-trace export chrometracing_logger.cc,
+summary statistics profiler_statistic.py).
+
+TPU-native design: the two-tier model is kept — host spans are recorded
+by ``RecordEvent`` (and automatically for every dispatched op while a
+profiler is recording), device activity comes from ``jax.profiler``
+(XPlane traces, TensorBoard-consumable) started/stopped by the same
+scheduler.  ``export_chrome_tracing`` writes the host timeline as a
+standard chrome://tracing JSON; ``summary()`` prints the reference-style
+aggregated table.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1          # accepted for API parity; maps to the device trace
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "event_type")
+
+    def __init__(self, name, start, end, tid, event_type="UserDefined"):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.event_type = event_type
+
+
+# active profilers (RecordEvent + op dispatch feed these)
+_ACTIVE: List["Profiler"] = []
+_LOCK = threading.Lock()
+
+
+def _record(name: str, start: float, end: float, event_type: str):
+    if not _ACTIVE:
+        return
+    ev = _HostEvent(name, start, end, threading.get_ident(), event_type)
+    with _LOCK:
+        for p in _ACTIVE:
+            p._events.append(ev)
+
+
+class RecordEvent:
+    """Host span (parity: paddle.profiler.RecordEvent,
+    python/paddle/profiler/utils.py:33).  Usable as context manager or
+    begin()/end() pair; also emits a jax named scope into the device
+    trace."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._start = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+        try:
+            import jax
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._start is None:
+            return
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        _record(self.name, self._start, time.perf_counter(),
+                self.event_type)
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def is_profiling() -> bool:
+    return bool(_ACTIVE)
+
+
+def _sync_dispatch_hook():
+    """Install/remove the per-op span recorder in the eager dispatch choke
+    point (the analog of the reference's kernel-level RecordEvent in
+    phi kernels)."""
+    from ..core import dispatch as _dispatch
+    _dispatch._op_profile_hook[0] = _record if _ACTIVE else None
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """Parity: paddle.profiler.make_scheduler (profiler.py:120) — cycle
+    CLOSED*closed -> READY*ready -> RECORD*(record-1) ->
+    RECORD_AND_RETURN, repeating ``repeat`` times (0 = forever)."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None):
+    """Parity: paddle.profiler.export_chrome_tracing — returns an
+    on_trace_ready callback writing chrome://tracing JSON."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = worker_name or f"paddle_tpu_{os.getpid()}"
+        path = os.path.join(dir_name, f"{fname}_{prof._round}.json")
+        prof._export_chrome(path)
+        return path
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Parity: paddle.profiler.Profiler (profiler.py:79)."""
+
+    def __init__(self, *, targets: Sequence[ProfilerTarget] = None,
+                 scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        if isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo,
+                                       repeat=1)
+        self._scheduler = scheduler or _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._targets = list(targets or [ProfilerTarget.CPU])
+        self._timer_only = timer_only
+        self._events: List[_HostEvent] = []
+        self._step_num = 0
+        self._round = 0
+        self._state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._trace_dir = None
+        self._step_rec: Optional[RecordEvent] = None
+        self._last_path = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self._state = self._scheduler(self._step_num)
+        self._apply_state()
+        self._begin_step_span()
+
+    def stop(self):
+        self._end_step_span()
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._finish_round()
+        self._close_recording()
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        self._end_step_span()
+        prev = self._state
+        self._step_num += 1
+        self._state = self._scheduler(self._step_num)
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._finish_round()
+        self._apply_state(prev)
+        self._begin_step_span()
+
+    # -- internals -----------------------------------------------------------
+    def _begin_step_span(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._step_rec = RecordEvent(
+                f"ProfileStep#{self._step_num}", "ProfileStep")
+            self._step_rec.begin()
+
+    def _end_step_span(self):
+        if self._step_rec is not None:
+            self._step_rec.end()
+            self._step_rec = None
+
+    def _apply_state(self, prev=None):
+        recording = self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        was = self in _ACTIVE
+        if recording and not was:
+            with _LOCK:
+                _ACTIVE.append(self)
+            _sync_dispatch_hook()
+            self._start_device_trace()
+        elif not recording and was:
+            self._close_recording()
+
+    def _close_recording(self):
+        if self in _ACTIVE:
+            with _LOCK:
+                _ACTIVE.remove(self)
+        _sync_dispatch_hook()
+        self._stop_device_trace()
+
+    def _start_device_trace(self):
+        if self._timer_only or self._device_tracing:
+            return
+        try:
+            import jax
+            self._trace_dir = self._trace_dir or \
+                os.path.join("/tmp", f"pt_prof_{os.getpid()}")
+            jax.profiler.start_trace(self._trace_dir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _stop_device_trace(self):
+        if self._device_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    def _finish_round(self):
+        self._stop_device_trace()
+        if self._on_trace_ready is not None:
+            self._last_path = self._on_trace_ready(self)
+        self._round += 1
+
+    # -- results -------------------------------------------------------------
+    @property
+    def events(self) -> List[_HostEvent]:
+        return list(self._events)
+
+    def _export_chrome(self, path: str):
+        t0 = min((e.start for e in self._events), default=0.0)
+        out = {"traceEvents": [
+            {"name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
+             "ts": (e.start - t0) * 1e6, "dur": (e.end - e.start) * 1e6,
+             "cat": e.event_type}
+            for e in self._events]}
+        with open(path, "w") as f:
+            json.dump(out, f)
+        return path
+
+    def export(self, path: str, format: str = "json"):
+        return self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregated event table (parity: profiler_statistic.py
+        summary)."""
+        agg: Dict[str, List[float]] = {}
+        for e in self._events:
+            agg.setdefault(e.name, []).append(e.end - e.start)
+        scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        rows = sorted(((n, len(d), sum(d) * scale,
+                        sum(d) / len(d) * scale, max(d) * scale)
+                       for n, d in agg.items()),
+                      key=lambda r: -r[2])
+        lines = [f"{'Name':<44} {'Calls':>6} {'Total(' + time_unit + ')':>12} "
+                 f"{'Avg':>10} {'Max':>10}",
+                 "-" * 86]
+        lines += [f"{n[:44]:<44} {c:>6} {t:>12.3f} {a:>10.3f} {m:>10.3f}"
+                  for n, c, t, a, m in rows]
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    # -- context manager -----------------------------------------------------
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
