@@ -54,13 +54,24 @@ _OPTIONAL_SUBMODULES = ["nn", "optimizer", "amp", "io", "jit", "static",
                         "distributed", "vision", "metric", "incubate",
                         "profiler", "device", "framework", "sparse",
                         "linalg_ns", "fft", "models", "text", "audio",
-                        "signal"]
+                        "signal", "hapi", "distribution", "quantization",
+                        "onnx", "inference", "utils", "sysconfig", "hub"]
 
 nn = None
 for _m in list(_OPTIONAL_SUBMODULES):
     try:
         globals()[_m] = _importlib.import_module(f".{_m}", __name__)
-    except ModuleNotFoundError:
-        _OPTIONAL_SUBMODULES.remove(_m)
+    except ModuleNotFoundError as _e:
+        # only swallow "this subsystem isn't built yet"; a missing
+        # third-party dependency (or a typo'd internal import inside a
+        # built subsystem) must surface
+        if _e.name == f"{__name__}.{_m}":
+            _OPTIONAL_SUBMODULES.remove(_m)
+        else:
+            raise
 
 from .framework_io import save, load  # noqa: E402  (added with io subsystem)
+
+if "hapi" in _OPTIONAL_SUBMODULES and globals().get("hapi") is not None:
+    from .hapi import Model, summary              # noqa: E402
+    from .hapi import callbacks                   # noqa: E402

@@ -116,8 +116,6 @@ def apply_op(name: str, fn: Callable, tensor_args: Sequence,
     ``tensor_args`` are passed through untouched (they are non-differentiable
     leaves such as python scalars).  Returns Tensor or tuple of Tensors.
     """
-    from .tensor import Tensor
-
     prof = _op_profile_hook[0]
     if prof is not None:
         import time as _time

@@ -43,16 +43,29 @@ def _shard_array_spec(shape, axis_name, nshards):
     return PartitionSpec()
 
 
+_HOST_MEMORY_OK: dict = {}    # backend platform -> bool (probe once)
+
+
 def _offload_sharding(sharding):
     """Host-memory variant of a sharding (stage-2/3 ``offload=True``):
     states live in pinned host memory and stream to HBM at update time.
     Falls back to the device sharding when the backend has no host
     memory space (CPU tests)."""
+    platform = jax.devices()[0].platform
+    ok = _HOST_MEMORY_OK.get(platform)
+    if ok is None:
+        try:
+            import jax.numpy as jnp
+            probe = sharding.with_memory_kind("pinned_host")
+            jax.device_put(jnp.zeros((), jnp.float32), probe)
+            ok = True
+        except Exception:
+            ok = False
+        _HOST_MEMORY_OK[platform] = ok
+    if not ok:
+        return sharding
     try:
-        import jax.numpy as jnp
-        host = sharding.with_memory_kind("pinned_host")
-        jax.device_put(jnp.zeros((), jnp.float32), host)  # probe support
-        return host
+        return sharding.with_memory_kind("pinned_host")
     except Exception:
         return sharding
 

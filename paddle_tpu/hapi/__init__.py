@@ -1,0 +1,6 @@
+"""hapi — high-level training API (parity: python/paddle/hapi/)."""
+from . import callbacks
+from .model import Model
+from .model_summary import summary
+
+__all__ = ["Model", "summary", "callbacks"]

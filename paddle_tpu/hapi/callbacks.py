@@ -1,0 +1,474 @@
+"""hapi callbacks (parity: python/paddle/hapi/callbacks.py — Callback,
+ProgBarLogger, ModelCheckpoint, LRScheduler, EarlyStopping, ReduceLROnPlateau,
+VisualDL/WandbCallback shims).
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import warnings
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL", "WandbCallback"]
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = callbacks or []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(k, ProgBarLogger) for k in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(k, ModelCheckpoint) for k in cbks):
+        cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(k, LRScheduler) for k in cbks):
+        cbks = list(cbks) + [LRScheduler()]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    metrics = metrics or [] if mode != "test" else []
+    params = {
+        "batch_size": batch_size,
+        "epochs": epochs,
+        "steps": steps,
+        "verbose": verbose,
+        "metrics": metrics,
+    }
+    cbk_list.set_params(params)
+    return cbk_list
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+        self.params = {}
+        self.model = None
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        self.params = params
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        self.model = model
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def _check_mode(self, mode):
+        assert mode in ("train", "eval", "predict"), (
+            "mode should be train, eval or predict")
+
+    def on_begin(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call("on_%s_begin" % mode, logs or {})
+
+    def on_end(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call("on_%s_end" % mode, logs or {})
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self._call("on_epoch_begin", epoch, logs or {})
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        self._call("on_epoch_end", epoch, logs or {})
+
+    def on_batch_begin(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call("on_%s_batch_begin" % mode, step, logs or {})
+
+    def on_batch_end(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call("on_%s_batch_end" % mode, step, logs or {})
+
+
+class Callback:
+    """Base class (parity: paddle.callbacks.Callback)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class ProgBarLogger(Callback):
+    """Logs metrics to stdout (parity: paddle.callbacks.ProgBarLogger)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def _is_print(self):
+        return self.verbose and int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.train_metrics = list(self.params.get("metrics") or [])
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        from .progressbar import ProgressBar
+        self.steps = self.params.get("steps")
+        self.epoch = epoch
+        self.train_step = 0
+        if self._is_print() and self.epochs:
+            print("Epoch %d/%d" % ((epoch or 0) + 1, self.epochs))
+        self.train_progbar = ProgressBar(num=self.steps,
+                                         verbose=self.verbose)
+
+    def _updates(self, logs, mode):
+        progbar = getattr(self, mode + "_progbar")
+        steps = getattr(self, mode + "_step")
+        metrics = getattr(self, mode + "_metrics")
+        values = [(k, logs[k]) for k in metrics if k in logs]
+        progbar.update(steps, values)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self.train_step += 1
+        if self._is_print() and self.train_step % self.log_freq == 0:
+            if self.steps is None or self.train_step < self.steps:
+                self._updates(logs, "train")
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        logs = logs or {}
+        if self._is_print():
+            self._updates(logs, "train")
+
+    def on_eval_begin(self, logs=None):
+        from .progressbar import ProgressBar
+        logs = logs or {}
+        self.eval_steps = logs.get("steps")
+        self.eval_metrics = list(logs.get("metrics") or [])
+        self.eval_step = 0
+        if self._is_print():
+            print("Eval begin...")
+        self.eval_progbar = ProgressBar(num=self.eval_steps,
+                                        verbose=self.verbose)
+
+    def on_eval_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self.eval_step += 1
+        if self._is_print() and self.eval_step % self.log_freq == 0:
+            if self.eval_steps is None or self.eval_step < self.eval_steps:
+                self._updates(logs, "eval")
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self._is_print():
+            self._updates(logs, "eval")
+            print("Eval samples: %d" % logs.get("batch_size", 0))
+
+    def on_predict_begin(self, logs=None):
+        from .progressbar import ProgressBar
+        logs = logs or {}
+        self.test_steps = logs.get("steps")
+        self.test_metrics = []
+        self.test_step = 0
+        if self._is_print():
+            print("Predict begin...")
+        self.test_progbar = ProgressBar(num=self.test_steps,
+                                        verbose=self.verbose)
+
+    def on_predict_batch_end(self, step, logs=None):
+        self.test_step += 1
+        if self._is_print() and self.test_step % self.log_freq == 0:
+            if self.test_steps is None or self.test_step < self.test_steps:
+                self._updates(logs or {}, "test")
+
+    def on_predict_end(self, logs=None):
+        if self._is_print():
+            print("Predict samples: %d" % (logs or {}).get("batch_size", 0))
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save (parity: paddle.callbacks.ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def _is_save(self):
+        return (self.model and self.save_dir
+                and int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0)
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self.epoch = epoch
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        if self._is_save() and (self.epoch % self.save_freq) == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            print("save checkpoint at %s" % os.path.abspath(path))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self._is_save():
+            path = os.path.join(self.save_dir, "final")
+            print("save checkpoint at %s" % os.path.abspath(path))
+            self.model.save(path)
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (parity: paddle.callbacks.LRScheduler)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError(
+                "by_step and by_epoch cannot both be true")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None) if self.model else None
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop training when a metric stops improving
+    (parity: paddle.callbacks.EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            warnings.warn("EarlyStopping mode %s unknown, fallback to auto"
+                          % mode)
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = np.less
+        elif mode == "max":
+            self.monitor_op = np.greater
+        else:
+            self.monitor_op = (np.greater if "acc" in self.monitor
+                               else np.less)
+        if self.monitor_op == np.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+            self.best_weights = None
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn(
+                "Monitor of EarlyStopping should be loss or metric name.")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        elif isinstance(current, np.ndarray):
+            current = float(current.reshape(-1)[0])
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None:
+                save_dir = getattr(self.model, "save_dir", None)
+                if save_dir:
+                    self.model.save(os.path.join(save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose > 0:
+                print("Epoch %d: Early stopping." % self.stopped_epoch)
+                if self.save_best_model:
+                    print("Best checkpoint has been saved.")
+        self.stopped_epoch += 1
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce lr when a metric has stopped improving
+    (parity: paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support "
+                             "a factor >= 1.0.")
+        self.factor = factor
+        self.min_lr = min_lr
+        self.min_delta = min_delta
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.cooldown_counter = 0
+        self.wait = 0
+        self.best = 0
+        self.mode = mode
+        self.epoch = 0
+        self._reset()
+
+    def _reset(self):
+        if self.mode not in ("auto", "min", "max"):
+            warnings.warn("Learning rate reduction mode %s is unknown, "
+                          "fallback to auto mode." % self.mode)
+            self.mode = "auto"
+        if self.mode == "min" or (self.mode == "auto"
+                                  and "acc" not in self.monitor):
+            self.monitor_op = lambda a, b: np.less(a, b - self.min_delta)
+            self.best = np.inf
+        else:
+            self.monitor_op = lambda a, b: np.greater(a, b + self.min_delta)
+            self.best = -np.inf
+        self.cooldown_counter = 0
+        self.wait = 0
+
+    def in_cooldown(self):
+        return self.cooldown_counter > 0
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn(
+                "Monitor of ReduceLROnPlateau should be loss or metric name.")
+            return
+        try:
+            opt = self.model._optimizer
+        except Exception:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        elif isinstance(current, np.ndarray):
+            current = float(current.reshape(-1)[0])
+        if self.in_cooldown():
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif not self.in_cooldown():
+            self.wait += 1
+            if self.wait >= self.patience:
+                from ..optimizer.lr import LRScheduler as Sched
+                lr = opt.get_lr()
+                if lr > float(self.min_lr):
+                    new_lr = max(lr * self.factor, self.min_lr)
+                    if isinstance(opt._learning_rate, Sched):
+                        opt._learning_rate.base_lr = new_lr
+                        opt._learning_rate.last_lr = new_lr
+                    else:
+                        opt.set_lr(new_lr)
+                    if self.verbose > 0:
+                        print("Epoch %d: ReduceLROnPlateau reducing learning "
+                              "rate to %s." % (self.epoch, new_lr))
+                    self.cooldown_counter = self.cooldown
+                    self.wait = 0
+        self.epoch += 1
+
+
+class VisualDL(Callback):
+    """Scalar logging to a directory as TSV (the reference logs to VisualDL,
+    which is not available here; the data layout is preserved so curves can
+    be re-plotted)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.epoch = 0
+
+    def _log(self, mode, step, logs):
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "%s.tsv" % mode)
+        metrics = self.params.get("metrics") or []
+        with open(path, "a") as f:
+            for k in metrics:
+                if k in (logs or {}):
+                    v = logs[k]
+                    if isinstance(v, (list, tuple)):
+                        v = v[0]
+                    if isinstance(v, numbers.Number):
+                        f.write("%s\t%d\t%g\n" % (k, step, v))
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", step, logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", self.epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epoch = (epoch or 0) + 1
+
+
+class WandbCallback(Callback):
+    """Inert unless wandb is importable (zero-egress environment)."""
+
+    def __init__(self, project=None, run=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+            self.wandb = wandb
+        except ImportError:
+            self.wandb = None
+            warnings.warn("wandb is not installed; WandbCallback is inert.")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.wandb is not None:
+            self.wandb.log(logs or {})

@@ -1,0 +1,391 @@
+"""hapi Model — Keras-like high-level trainer.
+
+Parity: python/paddle/hapi/model.py (reference — Model :1054, fit :1756,
+evaluate, predict, save/load, train_batch/eval_batch/predict_batch).
+
+TPU-native notes: the train loop is eager-tape by default (flexible for any
+loss/metric combination); `prepare(..., jit=True)` (an extension) swaps the
+per-batch path for a fully-fused XLA TrainStep (forward+backward+update in
+one donated-buffer module) when the loss takes (output, label).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """High-level API wrapping a Layer for training/eval/inference
+    (parity: paddle.Model)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._scaler = None
+        self._amp_level = "O0"
+        self._jit_step = None
+        self._use_jit = False
+        self.stop_training = False
+        self.save_dir = None
+
+    # -- prepare -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit=False):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError(
+                "'loss' must be sub classes of `paddle.nn.Layer` or any "
+                "callable function.")
+        self._loss = loss
+        metrics = metrics or []
+        for m in _to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(
+                    "{} is not sub class of Metric".format(
+                        m.__class__.__name__))
+        self._metrics = _to_list(metrics)
+        self._use_jit = bool(jit)
+        if amp_configs is not None:
+            from .. import amp as amp_mod
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            level = amp_configs.get("level", "O1")
+            self._amp_level = level
+            if level != "O0":
+                scaler_kw = {k: v for k, v in amp_configs.items()
+                             if k not in ("level", "dtype")}
+                self._scaler = amp_mod.GradScaler(**scaler_kw)
+
+    # -- single-batch APIs ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        assert self._optimizer is not None, (
+            "model not ready, please call `model.prepare()` first")
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+
+        if self._use_jit and self._loss is not None and len(labels) == 1:
+            if self._jit_step is None:
+                from ..jit.train_step import TrainStep
+                self._jit_step = TrainStep(self.network, self._loss,
+                                           self._optimizer)
+                if self._metrics:
+                    warnings.warn(
+                        "prepare(jit=True) fuses forward+backward+update "
+                        "into one XLA call and does not re-expose model "
+                        "outputs; metrics are skipped during fit. Use "
+                        "evaluate() for metrics.")
+            loss = self._jit_step(*[t._value for t in inputs],
+                                  labels[0]._value)
+            return self._pack_losses(float(np.asarray(loss)))
+
+        from .. import amp as amp_mod
+        if self._amp_level != "O0":
+            ctx = amp_mod.auto_cast(enable=True, level=self._amp_level)
+        else:
+            import contextlib
+            ctx = contextlib.nullcontext()
+        with ctx:
+            outputs = self.network(*inputs)
+            losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        if self._scaler is not None:
+            self._scaler.scale(total).backward()
+            if update:
+                self._scaler.step(self._optimizer)
+                self._scaler.update()
+                self._optimizer.clear_grad()
+        else:
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = self._run_metrics(outputs, labels)
+        return self._pack_losses(
+            [float(np.asarray(l._value)) for l in losses]) + metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        labels = [_to_tensor(x) for x in _to_list(labels)]
+        from ..autograd.tape import no_grad
+        with no_grad():
+            outputs = self.network(*inputs)
+            losses = (self._compute_loss(outputs, labels)
+                      if self._loss is not None else [])
+        metrics = self._run_metrics(outputs, labels)
+        # slot layout must mirror _run_eval's metric_names: a loss slot
+        # exists only when a loss fn is prepared
+        if self._loss is None:
+            return metrics
+        loss_vals = [float(np.asarray(l._value)) for l in losses]
+        return self._pack_losses(loss_vals) + metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _to_list(inputs)]
+        from ..autograd.tape import no_grad
+        with no_grad():
+            outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        return [np.asarray(o._value) for o in outs]
+
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        if self._loss is None:
+            raise RuntimeError("loss is required; pass it to prepare()")
+        try:
+            loss = self._loss(*(outs + labels))
+        except TypeError:
+            loss = self._loss(outs[0], labels[0])
+        return _to_list(loss)
+
+    def _run_metrics(self, outputs, labels):
+        vals = []
+        outs = _to_list(outputs) if outputs is not None else []
+        for metric in self._metrics:
+            if outs:
+                res = metric.compute(*(outs + labels))
+                m = metric.update(*[np.asarray(r._value)
+                                    if isinstance(r, Tensor) else r
+                                    for r in _to_list(res)])
+                vals.append(m)
+        return vals
+
+    @staticmethod
+    def _pack_losses(losses):
+        """Wrap into the reference's [loss_list, metric...] slot layout."""
+        return [losses if isinstance(losses, list) else [losses]]
+
+    # -- loops ---------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader, Dataset, IterableDataset
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not isinstance(
+                data, (Dataset, IterableDataset)):
+            # a one-shot iterator would silently yield nothing from epoch 2
+            # on — materialize it; re-iterable containers pass through
+            if hasattr(data, "__next__"):
+                return list(data)
+            return data   # list of batches
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def _split_batch(self, batch):
+        """Split a collated batch into (inputs, labels) using declared specs
+        or a trailing-label convention."""
+        batch = _to_list(batch)
+        n_in = len(self._inputs) if self._inputs else None
+        if n_in:
+            return batch[:n_in], batch[n_in:]
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given!"
+        self.save_dir = save_dir
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = (self._make_loader(eval_data, batch_size, False,
+                                         num_workers, False)
+                       if eval_data is not None else None)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        metric_names = ["loss"] + [m.name() for m in self._metrics]
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                batch_size=batch_size, steps=steps,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir, verbose=verbose,
+                                metrics=metric_names)
+        self.stop_training = False
+        cbks.on_begin("train")
+        total_iters = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            step = 0
+            for batch in loader:
+                cbks.on_batch_begin("train", step, logs)
+                inputs, labels = self._split_batch(batch)
+                update = ((step + 1) % accumulate_grad_batches == 0)
+                outs = self.train_batch(inputs, labels, update=update)
+                logs = self._make_logs(outs, metric_names)
+                logs["batch_size"] = (inputs[0].shape[0]
+                                      if inputs and inputs[0].shape else
+                                      batch_size)
+                cbks.on_batch_end("train", step, logs)
+                step += 1
+                total_iters += 1
+                if num_iters is not None and total_iters >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch % eval_freq) == 0:
+                eval_logs = self._run_eval(eval_loader, cbks, log_freq)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+        cbks.on_end("train", logs)
+        return logs
+
+    def _make_logs(self, outs, metric_names):
+        logs = {}
+        i = 0
+        for name in metric_names:
+            if i >= len(outs):
+                break
+            v = outs[i]
+            if isinstance(v, list):
+                v = v[0] if v else 0.0
+            logs[name] = v
+            i += 1
+        return logs
+
+    def _run_eval(self, loader, outer_cbks, log_freq):
+        for m in self._metrics:
+            m.reset()
+        metric_names = (["loss"] if self._loss else []) + [
+            m.name() for m in self._metrics]
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        outer_cbks.on_begin("eval",
+                            {"steps": steps, "metrics": metric_names})
+        logs = {}
+        count = 0
+        for step, batch in enumerate(loader):
+            outer_cbks.on_batch_begin("eval", step, logs)
+            inputs, labels = self._split_batch(batch)
+            outs = self.eval_batch(inputs, labels)
+            logs = self._make_logs(outs, metric_names)
+            count += (inputs[0].shape[0] if inputs and inputs[0].shape else 1)
+            logs["batch_size"] = count
+            outer_cbks.on_batch_end("eval", step, logs)
+        outer_cbks.on_end("eval", logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._make_loader(eval_data, batch_size, False,
+                                   num_workers, False)
+        metric_names = (["loss"] if self._loss else []) + [
+            m.name() for m in self._metrics]
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                log_freq=log_freq, verbose=verbose,
+                                metrics=metric_names, mode="eval")
+        return self._run_eval(loader, cbks, log_freq)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False,
+                                   num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                verbose=verbose, metrics=[], mode="test")
+        cbks.on_begin("predict", {"steps": steps})
+        outputs = []
+        count = 0
+        for step, batch in enumerate(loader):
+            inputs, _ = self._split_batch(batch)
+            cbks.on_batch_begin("predict", step, {})
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+            count += (inputs[0].shape[0] if inputs and inputs[0].shape else 1)
+            cbks.on_batch_end("predict", step, {"batch_size": count})
+        # transpose: list over batches of list over outputs -> list over outputs
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[batch[i] for batch in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        cbks.on_end("predict", {"batch_size": count})
+        return result
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        """training=True saves .pdparams/.pdopt; False exports for inference
+        via jit.save (requires declared input specs)."""
+        if not training:
+            from .. import jit as jit_mod
+            if not self._inputs:
+                raise ValueError(
+                    "'inputs' must be declared on Model(...) for inference "
+                    "export")
+            jit_mod.save(self.network, path, input_spec=self._inputs)
+            return
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        from .. import framework_io
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework_io
+        param_path = path + ".pdparams" if not path.endswith(".pdparams") \
+            else path
+        state = framework_io.load(param_path)
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(np.asarray(v).shape) ==
+                     tuple(own[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(framework_io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        if input_size is None:
+            if not self._inputs:
+                raise ValueError("input_size or declared inputs required")
+            input_size = self._inputs
+        return summary(self.network, input_size, dtypes=dtype)

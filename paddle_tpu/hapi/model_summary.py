@@ -1,0 +1,136 @@
+"""paddle.summary (parity: python/paddle/hapi/model_summary.py).
+
+Runs one forward pass with layer hooks to collect per-layer output shapes
+and parameter counts, printing the reference-style table.
+"""
+from __future__ import annotations
+
+import numbers
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["summary", "summary_string"]
+
+
+def _to_input_spec_shapes(input_size):
+    """Normalize input_size into a list of shape tuples."""
+    from ..jit.api import InputSpec
+    if isinstance(input_size, InputSpec):
+        return [tuple(input_size.shape)], [getattr(input_size, "dtype", None)]
+    if isinstance(input_size, tuple) and all(
+            isinstance(d, numbers.Number) for d in input_size):
+        return [tuple(input_size)], [None]
+    shapes, dtypes = [], []
+    for item in input_size:
+        s, d = _to_input_spec_shapes(item)
+        shapes += s
+        dtypes += d
+    return shapes, dtypes
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer summary table; returns {'total_params', 'trainable_params'}."""
+    text, params_info = summary_string(net, input_size, dtypes, input)
+    print(text)
+    return params_info
+
+
+def summary_string(net, input_size=None, dtypes=None, input=None):
+    if input is None and input_size is None:
+        raise ValueError("input_size and input cannot both be None")
+    if input is None:
+        shapes, spec_dtypes = _to_input_spec_shapes(input_size)
+        if dtypes is None:
+            dtypes = [d or "float32" for d in spec_dtypes]
+        elif isinstance(dtypes, str):
+            dtypes = [dtypes] * len(shapes)
+        inputs = []
+        for shape, dt in zip(shapes, dtypes):
+            shape = tuple(1 if (d is None or d < 0) else d for d in shape)
+            if "int" in str(dt):
+                inputs.append(Tensor(np.zeros(shape, dtype=str(dt))))
+            else:
+                inputs.append(Tensor(np.random.rand(*shape).astype(str(dt))))
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    layer_info = OrderedDict()
+    hooks = []
+
+    def register(module, prefix=""):
+        for name, sub in module.named_children():
+            full = prefix + ("." if prefix else "") + name
+            if not list(sub.named_children()):
+                hooks.append((full, sub))
+            register(sub, full)
+        if prefix == "" and not hooks:
+            hooks.append((module.__class__.__name__, module))
+
+    register(net)
+
+    handles = []
+
+    def make_hook(key, layer):
+        def hook(l, inp, out):
+            info = {}
+            o = out[0] if isinstance(out, (list, tuple)) and out else out
+            try:
+                info["output_shape"] = list(o.shape)
+            except Exception:
+                info["output_shape"] = []
+            n_params = 0
+            n_train = 0
+            for p in layer.parameters(include_sublayers=False):
+                n = int(np.prod(p.shape)) if p.shape else 1
+                n_params += n
+                if not p.stop_gradient:
+                    n_train += n
+            info["nb_params"] = n_params
+            info["trainable_params"] = n_train
+            layer_info["%s (%s)" % (key, layer.__class__.__name__)] = info
+        return hook
+
+    for key, layer in hooks:
+        handles.append(layer.register_forward_post_hook(make_hook(key, layer)))
+
+    was_training = getattr(net, "training", True)
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    header = "{:<40} {:>22} {:>15}".format("Layer (type)", "Output Shape",
+                                           "Param #")
+    lines = ["-" * 79, header, "=" * 79]
+    total_params = 0
+    trainable_params = 0
+    for key, info in layer_info.items():
+        total_params += info["nb_params"]
+        trainable_params += info["trainable_params"]
+        lines.append("{:<40} {:>22} {:>15,}".format(
+            key[:40], str(info["output_shape"])[:22], info["nb_params"]))
+    # include parameters held directly by container layers not hooked
+    seen = 0
+    for p in net.parameters():
+        seen += int(np.prod(p.shape)) if p.shape else 1
+    if seen > total_params:   # some params (e.g. on container) missed by hooks
+        total_params = seen
+        trainable_params = sum(
+            (int(np.prod(p.shape)) if p.shape else 1)
+            for p in net.parameters() if not p.stop_gradient)
+    lines.append("=" * 79)
+    lines.append("Total params: {:,}".format(total_params))
+    lines.append("Trainable params: {:,}".format(trainable_params))
+    lines.append("Non-trainable params: {:,}".format(
+        total_params - trainable_params))
+    lines.append("-" * 79)
+    return "\n".join(lines), {"total_params": total_params,
+                              "trainable_params": trainable_params}

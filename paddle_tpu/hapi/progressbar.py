@@ -1,0 +1,76 @@
+"""Terminal progress bar for hapi (parity: python/paddle/hapi/progressbar.py).
+
+Kept dependency-free: renders `step/total - metric: value` lines with a
+simple bar when the total is known, dots otherwise.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, start=True,
+                 file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self.file = file
+        self._values = {}
+        self._values_order = []
+        self._start = time.time() if start else None
+        self._last_update = 0
+
+    def _get_max_width(self):
+        return 80
+
+    def start(self):
+        self.file.flush()
+        self._start = time.time()
+
+    def update(self, current_num, values=None):
+        now = time.time()
+        if values:
+            for name, val in values:
+                if name not in self._values_order:
+                    self._values_order.append(name)
+                self._values[name] = val
+
+        if self._verbose == 0:
+            return
+
+        info = ""
+        if self._num is not None:
+            numdigits = len(str(self._num))
+            bar_chars = ("step %" + str(numdigits) + "d/%d") % (
+                current_num, self._num)
+        else:
+            bar_chars = "step %d" % current_num
+
+        for name in self._values_order:
+            val = self._values[name]
+            info += " - %s:" % name
+            val = val if isinstance(val, (list, tuple)) else [val]
+            for v in val:
+                if isinstance(v, (float, np.float32, np.float64)):
+                    if abs(v) > 1e-3:
+                        info += " %.4f" % v
+                    else:
+                        info += " %.4e" % v
+                else:
+                    info += " %s" % v
+
+        elapsed = now - self._start if self._start else 0
+        if current_num:
+            info += " - %.0fms/step" % (elapsed / current_num * 1000)
+
+        if self._verbose == 1:
+            self.file.write("\r" + bar_chars + info)
+            if self._num is not None and current_num >= self._num:
+                self.file.write("\n")
+        else:
+            self.file.write(bar_chars + info + "\n")
+        self.file.flush()
+        self._last_update = now

@@ -49,7 +49,8 @@ def _on_tpu() -> bool:
 # flash attention
 # ---------------------------------------------------------------------------
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, scale: float, q_offset_blocks: int):
+                      causal: bool, scale: float, q_offset_blocks: int,
+                      causal_off: int = 0):
     """One grid cell: q tile [block_q, d] vs all k/v tiles.
 
     Online softmax with fp32 running (max, denom, acc)."""
@@ -75,7 +76,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         v = v_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
         s = q @ k.T                                    # [bq, bk]
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
+            # bottom-right aligned: row r sees cols <= r + (Sk - Sq)
+            rows = q_start + jnp.int32(causal_off) + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -90,8 +92,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     if causal:
         # skip k blocks strictly after this q tile
         last_kb = jnp.minimum(
-            (q_start + jnp.int32(bq - 1)) // jnp.int32(block_k)
-            + jnp.int32(1), jnp.int32(n_kb))
+            (q_start + jnp.int32(bq - 1) + jnp.int32(causal_off))
+            // jnp.int32(block_k) + jnp.int32(1), jnp.int32(n_kb))
     else:
         last_kb = jnp.int32(n_kb)
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), last_kb, body,
@@ -119,7 +121,7 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
-                               q_offset_blocks=0)
+                               q_offset_blocks=0, causal_off=Sk - Sq)
     # Kernel body traced with x64 off: mosaic cannot legalize the i64
     # scalars that python-int arithmetic produces under jax_enable_x64.
     with jax.enable_x64(False):
@@ -173,9 +175,17 @@ def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
     qf = q.astype(jnp.float32) * scale
     rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 0)
     off = jax.lax.broadcasted_iota(jnp.int32, (Sq, bk), 1)
+    # bottom-right-aligned causal for Sq != Sk (decode), like _sdpa_reference
+    causal_off = Sk - Sq
 
-    if mask is not None and mask.dtype != jnp.bool_:
-        mask = mask.astype(jnp.float32)
+    if mask is not None:
+        if mask.dtype != jnp.bool_:
+            mask = mask.astype(jnp.float32)
+        if pad:
+            # pad the key axis so block slices never clamp; the padded
+            # columns are killed by the `cols < Sk` validity test anyway
+            widths = [(0, 0)] * (mask.ndim - 1) + [(0, pad)]
+            mask = jnp.pad(mask, widths)
 
     def block(carry, kb):
         m_, l_, acc = carry
@@ -185,7 +195,7 @@ def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
         cols = kb * bk + off
         valid = cols < Sk
         if causal:
-            valid = valid & (rows >= cols)
+            valid = valid & (rows + causal_off >= cols)
         if mask is not None:
             mb = lax.dynamic_slice_in_dim(mask, kb * bk,
                                           bk, mask.ndim - 1)

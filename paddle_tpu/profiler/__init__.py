@@ -178,6 +178,7 @@ class Profiler:
         self._targets = list(targets or [ProfilerTarget.CPU])
         self._timer_only = timer_only
         self._events: List[_HostEvent] = []
+        self._last_round_events: List[_HostEvent] = []
         self._step_num = 0
         self._round = 0
         self._state = ProfilerState.CLOSED
@@ -268,19 +269,24 @@ class Profiler:
         if self._on_trace_ready is not None:
             self._last_path = self._on_trace_ready(self)
         self._round += 1
+        # each scheduler round is an independent profile window; keep the
+        # finished round readable via .events / summary() after stop()
+        self._last_round_events = self._events
+        self._events = []
 
     # -- results -------------------------------------------------------------
     @property
     def events(self) -> List[_HostEvent]:
-        return list(self._events)
+        return list(self._events or self._last_round_events)
 
     def _export_chrome(self, path: str):
-        t0 = min((e.start for e in self._events), default=0.0)
+        events = self._events or self._last_round_events
+        t0 = min((e.start for e in events), default=0.0)
         out = {"traceEvents": [
             {"name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
              "ts": (e.start - t0) * 1e6, "dur": (e.end - e.start) * 1e6,
              "cat": e.event_type}
-            for e in self._events]}
+            for e in events]}
         with open(path, "w") as f:
             json.dump(out, f)
         return path
@@ -293,7 +299,7 @@ class Profiler:
         """Aggregated event table (parity: profiler_statistic.py
         summary)."""
         agg: Dict[str, List[float]] = {}
-        for e in self._events:
+        for e in (self._events or self._last_round_events):
             agg.setdefault(e.name, []).append(e.end - e.start)
         scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
         rows = sorted(((n, len(d), sum(d) * scale,

@@ -1,0 +1,36 @@
+"""paddle.vision (parity: python/paddle/vision/)."""
+from . import datasets, models, ops, transforms
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            "Expected backend are one of ['pil', 'cv2', 'tensor'], but got "
+            "{}".format(backend))
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (PIL backend; cv2 not bundled)."""
+    backend = backend or _image_backend
+    from PIL import Image
+    import numpy as np
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    arr = np.asarray(img)
+    if backend == "cv2":
+        return arr[..., ::-1] if arr.ndim == 3 else arr   # RGB->BGR
+    from ..core.tensor import Tensor
+    return Tensor(arr)
+
+
+__all__ = ["datasets", "models", "ops", "transforms", "set_image_backend",
+           "get_image_backend", "image_load"]

@@ -1,0 +1,181 @@
+"""Vision ops (parity: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, box conversion/iou helpers, DeformConv2D is not ported).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "roi_pool"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _val(boxes)
+    return Tensor._from_value(
+        (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU for [N,4] and [M,4] xyxy boxes."""
+    a, b = _val(boxes1), _val(boxes2)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor._from_value(inter / (area1[:, None] + area2[None] - inter))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard-NMS (parity: paddle.vision.ops.nms).
+
+    Host-side: NMS is a data-dependent sequential prune used in input/output
+    post-processing, not in the compiled training graph, so it runs in numpy
+    (the reference's CPU kernel is also sequential).
+    """
+    boxes_np = np.asarray(_val(boxes))
+    n = boxes_np.shape[0]
+    if scores is None:
+        order = np.arange(n)
+    else:
+        order = np.argsort(-np.asarray(_val(scores)))
+
+    def greedy(order_idx, mask_boxes):
+        keep = []
+        suppressed = np.zeros(n, dtype=bool)
+        x1, y1, x2, y2 = (mask_boxes[:, i] for i in range(4))
+        areas = (x2 - x1) * (y2 - y1)
+        for i in order_idx:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            xx1 = np.maximum(x1[i], x1)
+            yy1 = np.maximum(y1[i], y1)
+            xx2 = np.minimum(x2[i], x2)
+            yy2 = np.minimum(y2[i], y2)
+            w = np.clip(xx2 - xx1, 0, None)
+            h = np.clip(yy2 - yy1, 0, None)
+            inter = w * h
+            iou = inter / (areas[i] + areas - inter + 1e-10)
+            suppressed |= iou > iou_threshold
+        return np.asarray(keep, np.int64)
+
+    if category_idxs is None:
+        keep = greedy(order, boxes_np)
+    else:
+        cats = np.asarray(_val(category_idxs))
+        if categories is None:
+            categories = np.unique(cats)
+        keeps = []
+        for c in categories:
+            idx = np.where(cats == c)[0]
+            if idx.size == 0:
+                continue
+            sub_order = idx[np.argsort(
+                -np.asarray(_val(scores))[idx])] if scores is not None else idx
+            keeps.append(greedy(sub_order, boxes_np))
+        keep = np.concatenate(keeps) if keeps else np.empty(0, np.int64)
+        if scores is not None:
+            keep = keep[np.argsort(-np.asarray(_val(scores))[keep])]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat [C,H,W]; ys/xs flat sample coords -> [C, n]."""
+    C, H, W = feat.shape
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy1 = jnp.clip(ys - y0, 0.0, 1.0)
+    wx1 = jnp.clip(xs - x0, 0.0, 1.0)
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+    f = feat.reshape(C, -1)
+    idx = lambda yy, xx: f[:, yy * W + xx]        # noqa: E731
+    out = (idx(y0, x0) * (wy0 * wx0) + idx(y0, x1) * (wy0 * wx1)
+           + idx(y1, x0) * (wy1 * wx0) + idx(y1, x1) * (wy1 * wx1))
+    valid = (ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)
+    return out * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (parity: paddle.vision.ops.roi_align). boxes [R,4] xyxy in
+    input-image coords, boxes_num [N] rois per batch element."""
+    feat = _val(x)
+    rois = _val(boxes).astype(jnp.float32)
+    nums = np.asarray(_val(boxes_num))
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = np.repeat(np.arange(len(nums)), nums)
+    offset = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(b_idx, roi):
+        fmap = feat[b_idx]
+        x1, y1, x2, y2 = roi * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        gy = y1 + (jnp.arange(ph, dtype=jnp.float32)[:, None] * bin_h
+                   + iy[None, :] * bin_h)
+        gx = x1 + (jnp.arange(pw, dtype=jnp.float32)[:, None] * bin_w
+                   + iy[None, :] * bin_w)
+        ys = jnp.transpose(jnp.broadcast_to(
+            gy[:, :, None, None], (ph, sr, pw, sr)), (0, 2, 1, 3))
+        xs = jnp.broadcast_to(gx[None, :, None, :], (ph, pw, sr, sr))
+        samples = _bilinear_sample(fmap, ys.reshape(-1), xs.reshape(-1))
+        C = fmap.shape[0]
+        return samples.reshape(C, ph, pw, sr * sr).mean(-1)
+
+    outs = [one_roi(int(b), rois[i]) for i, b in enumerate(batch_idx)]
+    if not outs:
+        return Tensor(np.zeros((0, feat.shape[1], ph, pw), np.float32))
+    return Tensor._from_value(jnp.stack(outs))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoI max pooling (parity: paddle.vision.ops.roi_pool)."""
+    feat = np.asarray(_val(x))
+    rois = np.asarray(_val(boxes), np.float32)
+    nums = np.asarray(_val(boxes_num))
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = np.repeat(np.arange(len(nums)), nums)
+    N, C, H, W = feat.shape
+    out = np.zeros((rois.shape[0], C, ph, pw), feat.dtype)
+    for i, b in enumerate(batch_idx):
+        x1, y1, x2, y2 = np.round(rois[i] * spatial_scale).astype(np.int64)
+        x2 = max(x2 + 1, x1 + 1)
+        y2 = max(y2 + 1, y1 + 1)
+        bin_h = (y2 - y1) / ph
+        bin_w = (x2 - x1) / pw
+        for py in range(ph):
+            for px in range(pw):
+                ys = int(np.floor(y1 + py * bin_h))
+                ye = int(np.ceil(y1 + (py + 1) * bin_h))
+                xs = int(np.floor(x1 + px * bin_w))
+                xe = int(np.ceil(x1 + (px + 1) * bin_w))
+                ys, ye = np.clip([ys, ye], 0, H)
+                xs, xe = np.clip([xs, xe], 0, W)
+                patch = feat[b, :, ys:ye, xs:xe]
+                if patch.size:
+                    out[i, :, py, px] = patch.max(axis=(1, 2))
+    return Tensor(out)

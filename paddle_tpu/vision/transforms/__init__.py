@@ -1,0 +1,354 @@
+"""Composable image transforms (parity:
+python/paddle/vision/transforms/transforms.py).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+from PIL import Image
+
+from . import functional as F
+from .functional import *      # noqa: F401,F403
+
+__all__ = ["BaseTransform", "Compose", "Resize", "RandomResizedCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Normalize", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "RandomCrop", "Pad", "RandomRotation",
+           "Grayscale", "ToTensor", "RandomErasing"] + list(F.__all__)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for f in self.transforms:
+            data = f(data)
+        return data
+
+    def __repr__(self):
+        return "Compose(%s)" % ", ".join(repr(t) for t in self.transforms)
+
+
+class BaseTransform:
+    """Transform base with (optional) keys routing like the reference."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            outputs = []
+            for i, key in enumerate(self.keys):
+                if i >= len(inputs):
+                    break
+                if key == "image":
+                    outputs.append(self._apply_image(inputs[i]))
+                else:
+                    outputs.append(inputs[i])
+            outputs.extend(inputs[len(self.keys):])
+            return tuple(outputs) if len(outputs) > 1 else outputs[0]
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.__class__.__name__
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return F.resize(img, self.size, self.interpolation)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _get_param(self, img):
+        if isinstance(img, Image.Image):
+            w, h = img.size
+        else:
+            h, w = np.asarray(img).shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            aspect = np.exp(random.uniform(*log_ratio))
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = random.randint(0, h - th)
+                left = random.randint(0, w - tw)
+                return top, left, th, tw
+        # fallback: center crop
+        in_ratio = w / h
+        if in_ratio < self.ratio[0]:
+            tw, th = w, int(round(w / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            th, tw = h, int(round(h * self.ratio[1]))
+        else:
+            tw, th = w, h
+        return (h - th) // 2, (w - tw) // 2, th, tw
+
+    def _apply_image(self, img):
+        top, left, th, tw = self._get_param(img)
+        img = F.crop(img, top, left, th, tw)
+        return F.resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return F.center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return F.hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return F.vflip(img) if random.random() < self.prob else img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        return F.normalize(img, self.mean, self.std, self.data_format,
+                           self.to_rgb)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_brightness(
+            img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_contrast(
+            img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_saturation(
+            img, random.uniform(max(0, 1 - self.value), 1 + self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0.0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = F.pad(img, self.padding, self.fill, self.padding_mode)
+        if isinstance(img, Image.Image):
+            w, h = img.size
+        else:
+            h, w = np.asarray(img).shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and w < tw:
+            img = F.pad(img, (tw - w, 0), self.fill, self.padding_mode)
+            w = tw
+        if self.pad_if_needed and h < th:
+            img = F.pad(img, (0, th - h), self.fill, self.padding_mode)
+            h = th
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return F.crop(img, top, left, th, tw)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be positive if scalar")
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return F.rotate(img, angle, self.interpolation, self.expand,
+                        self.center, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            h, w = arr.shape
+        elif isinstance(img, Image.Image) or arr.shape[-1] in (1, 3, 4):
+            h, w = arr.shape[:2]
+        else:                      # CHW tensor
+            h, w = arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]),
+                                           np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / aspect)))
+            ew = int(round(np.sqrt(target * aspect)))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                return F.erase(img, top, left, eh, ew, self.value,
+                               self.inplace)
+        return img

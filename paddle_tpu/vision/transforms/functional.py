@@ -1,0 +1,287 @@
+"""Functional image transforms (parity:
+python/paddle/vision/transforms/functional.py).
+
+TPU-native stance: transforms are host-side input-pipeline work (they feed
+the device, they don't run on it), so they operate on PIL Images and numpy
+HWC arrays and stay out of the traced graph. ``to_tensor`` is the
+host→device boundary.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import List, Sequence
+
+import numpy as np
+from PIL import Image, ImageEnhance, ImageOps
+
+from ...core.tensor import Tensor
+
+__all__ = ["to_tensor", "hflip", "vflip", "resize", "pad", "crop",
+           "center_crop", "adjust_brightness", "adjust_contrast",
+           "adjust_hue", "adjust_saturation", "rotate", "to_grayscale",
+           "normalize", "erase"]
+
+_PIL_MODES = {
+    "nearest": Image.NEAREST,
+    "bilinear": Image.BILINEAR,
+    "bicubic": Image.BICUBIC,
+    "box": Image.BOX,
+    "lanczos": Image.LANCZOS,
+    "hamming": Image.HAMMING,
+}
+
+
+def _is_pil(img):
+    return isinstance(img, Image.Image)
+
+
+def _is_numpy(img):
+    return isinstance(img, np.ndarray)
+
+
+def _is_tensor(img):
+    return isinstance(img, Tensor)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """PIL/ndarray HWC uint8 → float32 Tensor scaled to [0,1] (uint8 only)."""
+    if _is_tensor(pic):
+        return pic
+    if _is_pil(pic):
+        arr = np.asarray(pic)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+    else:
+        arr = pic
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format.upper() == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def _as_numpy(img):
+    """Return (HWC array, restore_fn). Tensor inputs follow the reference's
+    functional_tensor convention: CHW — they are moved to HWC here and moved
+    back by restore_fn so all spatial code below is HWC-only."""
+    if _is_pil(img):
+        return np.asarray(img), None
+    if _is_tensor(img):
+        arr = np.asarray(img._value)
+        if arr.ndim == 3:
+            arr = np.moveaxis(arr, 0, 2)
+            return arr, lambda a: Tensor(np.ascontiguousarray(
+                np.moveaxis(a, 2, 0)))
+        return arr, lambda a: Tensor(np.ascontiguousarray(a))
+    return img, None
+
+
+def _restore(out, restore_fn):
+    return restore_fn(out) if restore_fn is not None else out
+
+
+def hflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    arr, back = _as_numpy(img)
+    return _restore(arr[:, ::-1, ...].copy(), back)
+
+
+def vflip(img):
+    if _is_pil(img):
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    arr, back = _as_numpy(img)
+    return _restore(arr[::-1, ...].copy(), back)
+
+
+def _target_size(w, h, size):
+    if isinstance(size, int):
+        if (w <= h and w == size) or (h <= w and h == size):
+            return w, h
+        if w < h:
+            return size, int(size * h / w)
+        return int(size * w / h), size
+    return size[1], size[0]   # size is (h, w)
+
+
+def resize(img, size, interpolation="bilinear"):
+    if _is_pil(img):
+        ow, oh = _target_size(img.width, img.height, size)
+        return img.resize((ow, oh), _PIL_MODES[interpolation])
+    arr, back = _as_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    h, w = arr.shape[:2]
+    ow, oh = _target_size(w, h, size)
+    if arr.dtype == np.uint8:
+        chans = [np.asarray(Image.fromarray(arr[:, :, c]).resize(
+            (ow, oh), _PIL_MODES[interpolation])) for c in range(arr.shape[2])]
+        out = np.stack(chans, axis=2)
+    else:
+        chans = [np.asarray(Image.fromarray(
+            arr[:, :, c].astype(np.float32), mode="F").resize(
+            (ow, oh), _PIL_MODES[interpolation])) for c in range(arr.shape[2])]
+        out = np.stack(chans, axis=2).astype(arr.dtype)
+    if squeeze:
+        out = out[:, :, 0]
+    return _restore(out, back)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    if _is_pil(img):
+        if padding_mode == "constant":
+            return ImageOps.expand(img, (left, top, right, bottom), fill=fill)
+        arr = np.asarray(img)
+        padded = pad(arr, padding, fill, padding_mode)
+        return Image.fromarray(padded)
+    arr, back = _as_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    np_mode = {"constant": "constant", "edge": "edge",
+               "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if padding_mode == "constant" else {}
+    out = np.pad(arr, ((top, bottom), (left, right), (0, 0)), np_mode, **kw)
+    if squeeze:
+        out = out[:, :, 0]
+    return _restore(out, back)
+
+
+def crop(img, top, left, height, width):
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    arr, back = _as_numpy(img)
+    return _restore(arr[top:top + height, left:left + width, ...].copy(),
+                    back)
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    if _is_pil(img):
+        w, h = img.size
+    else:
+        arr, _ = _as_numpy(img)
+        h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def adjust_brightness(img, brightness_factor):
+    if _is_pil(img):
+        return ImageEnhance.Brightness(img).enhance(brightness_factor)
+    arr, back = _as_numpy(img)
+    dt = arr.dtype
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0,
+                  255 if dt == np.uint8 else np.inf).astype(dt)
+    return _restore(out, back)
+
+
+def adjust_contrast(img, contrast_factor):
+    if _is_pil(img):
+        return ImageEnhance.Contrast(img).enhance(contrast_factor)
+    arr, back = _as_numpy(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = f.mean() if f.ndim == 2 else (
+        f[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)).mean()
+    out = np.clip(gray + contrast_factor * (f - gray), 0,
+                  255 if dt == np.uint8 else np.inf).astype(dt)
+    return _restore(out, back)
+
+
+def adjust_saturation(img, saturation_factor):
+    if _is_pil(img):
+        return ImageEnhance.Color(img).enhance(saturation_factor)
+    arr, back = _as_numpy(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = (f[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                  np.float32))[..., None]
+    out = np.clip(gray + saturation_factor * (f - gray), 0,
+                  255 if dt == np.uint8 else np.inf).astype(dt)
+    return _restore(out, back)
+
+
+def adjust_hue(img, hue_factor):
+    if not (-0.5 <= hue_factor <= 0.5):
+        raise ValueError("hue_factor is not in [-0.5, 0.5].")
+    arr, back = (None, None) if _is_pil(img) else _as_numpy(img)
+    pil = img if _is_pil(img) else Image.fromarray(arr)
+    h, s, v = pil.convert("HSV").split()
+    np_h = np.asarray(h, dtype=np.uint8)
+    np_h = (np_h.astype(np.int16) + int(hue_factor * 255)) % 256
+    h = Image.fromarray(np_h.astype(np.uint8), "L")
+    out = Image.merge("HSV", (h, s, v)).convert(pil.mode)
+    if _is_pil(img):
+        return out
+    return _restore(np.asarray(out), back)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr, back = (None, None) if _is_pil(img) else _as_numpy(img)
+    pil = img if _is_pil(img) else Image.fromarray(np.asarray(arr))
+    out = pil.rotate(angle, _PIL_MODES[interpolation], expand, center,
+                     fillcolor=fill)
+    if _is_pil(img):
+        return out
+    return _restore(np.asarray(out), back)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr, back = (None, None) if _is_pil(img) else _as_numpy(img)
+    pil = img if _is_pil(img) else Image.fromarray(np.asarray(arr))
+    g = pil.convert("L")
+    if num_output_channels == 3:
+        g = Image.merge("RGB", (g, g, g))
+    if _is_pil(img):
+        return g
+    out = np.asarray(g)
+    if back is not None and out.ndim == 2:
+        # grayscale of a CHW tensor: restore expects HWC
+        out = out[:, :, None] if num_output_channels == 1 else out
+    return _restore(out, back)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if _is_pil(img):
+        img = np.asarray(img).astype(np.float32)
+    tensor_in = _is_tensor(img)
+    arr = np.asarray(img._value if tensor_in else img, dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format.upper() == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if tensor_in else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    tensor_in = _is_tensor(img)
+    pil_in = _is_pil(img)
+    arr = np.asarray(img) if pil_in else (
+        np.asarray(img._value) if tensor_in else img)
+    if not inplace or pil_in or tensor_in:
+        arr = arr.copy()
+    if arr.ndim == 3 and not pil_in and arr.shape[0] in (1, 3) \
+            and tensor_in:
+        arr[..., i:i + h, j:j + w] = v
+    else:
+        arr[i:i + h, j:j + w, ...] = v
+    if pil_in:
+        return Image.fromarray(arr)
+    return Tensor(arr) if tensor_in else arr

@@ -67,6 +67,44 @@ def test_chunked_masks_bool_and_additive():
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_chunked_rectangular_causal_decode():
+    # Sq != Sk causal must be bottom-right aligned (decode: 1 query over a
+    # 16-entry KV cache sees ALL of it, not just col 0)
+    q, _, _ = _qkv(S=1)
+    _, k, v = _qkv(S=16)
+    got = _chunked_sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        True, block_k=4)
+    want = _sdpa_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # wider: Sq=5 against Sk=13 (also non-divisible)
+    q5, _, _ = _qkv(S=5)
+    _, k13, v13 = _qkv(S=13)
+    got = _chunked_sdpa(jnp.asarray(q5), jnp.asarray(k13),
+                        jnp.asarray(v13), True, block_k=4)
+    want = _sdpa_reference(jnp.asarray(q5), jnp.asarray(k13),
+                           jnp.asarray(v13), True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_mask_with_nondivisible_seq():
+    # mask on Sk=13 with block 4: the mask must be padded with the k/v,
+    # not clamp-sliced (which misaligns the final block)
+    q, k, v = _qkv(S=13)
+    bool_mask = rng.rand(2, 1, 13, 13) > 0.3
+    got = _chunked_sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        False, mask=jnp.asarray(bool_mask), block_k=4)
+    ref = jax.nn.softmax(
+        jnp.where(jnp.asarray(bool_mask),
+                  jnp.einsum("bhqd,bhkd->bhqk", jnp.asarray(q),
+                             jnp.asarray(k)) / np.sqrt(8.0),
+                  -jnp.inf), -1) @ jnp.asarray(v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_chunked_grad_matches_reference():
     q, k, v = _qkv(S=8)
 

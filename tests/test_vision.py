@@ -1,0 +1,266 @@
+"""vision namespace: transforms, datasets (file-format parsers), models, ops.
+
+Parity targets: python/paddle/vision/ (transforms/, datasets/, models/, ops.py).
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision import datasets, models, ops
+
+
+def _pil(h=32, w=24, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    arr = rng.randint(0, 255, (h, w, c), dtype=np.uint8)
+    return Image.fromarray(arr if c == 3 else arr[:, :, 0])
+
+
+# ---------------- transforms ----------------
+
+def test_to_tensor_scales_and_chw():
+    img = _pil()
+    t = T.ToTensor()(img)
+    assert t.shape == [3, 32, 24]
+    assert float(np.asarray(t._value).max()) <= 1.0
+
+
+def test_resize_int_keeps_aspect():
+    img = _pil(40, 20)
+    out = T.Resize(10)(img)        # short side -> 10
+    assert out.size == (10, 20)    # PIL size is (w, h)
+    out2 = T.Resize((8, 6))(img)   # (h, w)
+    assert out2.size == (6, 8)
+
+
+def test_resize_numpy_matches_pil():
+    img = _pil(16, 16)
+    arr = np.asarray(img)
+    a = np.asarray(T.Resize((8, 8))(img))
+    b = T.Resize((8, 8))(arr)
+    np.testing.assert_allclose(a, b, atol=1)
+
+
+def test_center_and_random_crop():
+    img = _pil(32, 32)
+    assert T.CenterCrop(16)(img).size == (16, 16)
+    assert T.RandomCrop(20)(img).size == (20, 20)
+    assert T.RandomResizedCrop(14)(img).size == (14, 14)
+
+
+def test_flips_and_pad():
+    arr = np.arange(12, dtype=np.uint8).reshape(3, 4, 1)
+    np.testing.assert_array_equal(T.hflip(arr), arr[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(arr), arr[::-1])
+    padded = T.Pad(2)(Image.fromarray(arr[:, :, 0]))
+    assert padded.size == (8, 7)
+
+
+def test_tensor_chw_flips_and_crop():
+    # Tensor inputs follow the CHW convention (reference functional_tensor)
+    arr = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(arr)
+    np.testing.assert_array_equal(np.asarray(T.hflip(t)._value),
+                                  arr[:, :, ::-1])
+    np.testing.assert_array_equal(np.asarray(T.vflip(t)._value),
+                                  arr[:, ::-1, :])
+    c = T.crop(t, 1, 2, 2, 2)
+    np.testing.assert_array_equal(np.asarray(c._value), arr[:, 1:3, 2:4])
+    r = T.resize(t, (6, 8))
+    assert list(r.shape) == [2, 6, 8]
+
+
+def test_normalize():
+    arr = np.ones((3, 4, 4), np.float32) * 2.0
+    out = T.Normalize(mean=[1, 1, 1], std=[2, 2, 2],
+                      data_format="CHW")(arr)
+    np.testing.assert_allclose(out, 0.5)
+
+
+def test_color_jitter_and_grayscale_run():
+    img = _pil()
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.4)(img)
+    assert out.size == img.size
+    g = T.Grayscale(3)(img)
+    assert np.asarray(g).shape == (32, 24, 3)
+
+
+def test_compose_pipeline():
+    tf = T.Compose([T.Resize(28), T.CenterCrop(24), T.ToTensor(),
+                    T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = tf(_pil(64, 48))
+    assert out.shape == [3, 24, 24]
+
+
+def test_random_erasing():
+    arr = np.ones((16, 16, 3), np.uint8) * 255
+    out = T.RandomErasing(prob=1.0, value=0)(arr)
+    assert (np.asarray(out) == 0).any()
+
+
+# ---------------- datasets ----------------
+
+def _write_mnist(tmp_path, n=10):
+    imgs = np.random.RandomState(0).randint(
+        0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    ip = str(tmp_path / "train-images-idx3-ubyte.gz")
+    lp = str(tmp_path / "train-labels-idx1-ubyte.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp
+
+
+def test_mnist_parser(tmp_path):
+    ip, lp = _write_mnist(tmp_path)
+    ds = datasets.MNIST(image_path=ip, label_path=lp, mode="train",
+                        transform=T.ToTensor())
+    assert len(ds) == 10
+    img, label = ds[3]
+    assert img.shape == [1, 28, 28]
+    assert int(label[0]) == 3
+
+
+def test_cifar10_parser(tmp_path):
+    n = 8
+    data = np.random.RandomState(0).randint(
+        0, 255, (n, 3072), dtype=np.uint8)
+    labels = list(range(n))
+    batch = {b"data": data, b"labels": labels}
+    payload = pickle.dumps(batch)
+    tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+    raw = str(tmp_path / "data_batch_1")
+    with open(raw, "wb") as f:
+        f.write(payload)
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(raw, arcname="cifar-10-batches-py/data_batch_1")
+    ds = datasets.Cifar10(data_file=tar_path, mode="train")
+    assert len(ds) == n
+    img, label = ds[2]
+    assert img.size == (32, 32)
+    assert int(label[0]) == 2
+
+
+def test_dataset_folder(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(
+                str(d / f"{i}.png"))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, target = ds[0]
+    assert target == 0
+    flat = datasets.ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+
+
+def test_missing_dataset_raises(tmp_path):
+    with pytest.raises(RuntimeError, match="no network access"):
+        datasets.MNIST(image_path=str(tmp_path / "nope.gz"),
+                       label_path=str(tmp_path / "nope2.gz"))
+
+
+# ---------------- models ----------------
+
+def test_lenet_forward():
+    net = models.LeNet()
+    x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+    out = net(x)
+    assert out.shape == [2, 10]
+
+
+def test_vgg_tiny_forward():
+    net = models.vgg11(num_classes=7)
+    x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype(np.float32))
+    assert net(x).shape == [1, 7]
+
+
+def test_mobilenet_v2_forward():
+    net = models.mobilenet_v2(num_classes=5, scale=0.35)
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    assert net(x).shape == [1, 5]
+
+
+def test_alexnet_forward():
+    net = models.alexnet(num_classes=4)
+    x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype(np.float32))
+    assert net(x).shape == [1, 4]
+
+
+def test_pretrained_raises():
+    with pytest.raises(ValueError, match="pretrained"):
+        models.vgg11(pretrained=True)
+
+
+# ---------------- ops ----------------
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.5,
+                              paddle.to_tensor(scores))._value)
+    assert list(keep) == [0, 2]
+
+
+def test_nms_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int64)
+    keep = np.asarray(ops.nms(paddle.to_tensor(boxes), 0.5,
+                              paddle.to_tensor(scores),
+                              category_idxs=paddle.to_tensor(cats),
+                              categories=[0, 1])._value)
+    assert sorted(keep) == [0, 1]   # different classes never suppress
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                  np.float32))
+    iou = np.asarray(ops.box_iou(a, b)._value)
+    np.testing.assert_allclose(iou[0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 25.0 / 175.0, atol=1e-6)
+
+
+def test_roi_align_constant_feature():
+    # constant feature map -> every pooled value equals the constant
+    feat = np.full((1, 2, 16, 16), 3.5, np.float32)
+    boxes = np.array([[2, 2, 10, 10]], np.float32)
+    out = ops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1], np.int32)), 4)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(np.asarray(out._value), 3.5, atol=1e-5)
+
+
+def test_roi_pool_max():
+    feat = np.zeros((1, 1, 8, 8), np.float32)
+    feat[0, 0, 2, 2] = 7.0
+    boxes = np.array([[0, 0, 7, 7]], np.float32)
+    out = ops.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1], np.int32)), 2)
+    assert np.asarray(out._value).max() == 7.0
+
+
+def test_image_backend():
+    from paddle_tpu import vision
+    assert vision.get_image_backend() == "pil"
+    vision.set_image_backend("cv2")
+    assert vision.get_image_backend() == "cv2"
+    vision.set_image_backend("pil")
+    with pytest.raises(ValueError):
+        vision.set_image_backend("bogus")
