@@ -130,6 +130,70 @@ def _reduce_fn(op):
             "avg": lax.pmean}[op]
 
 
+# --- multi-process eager path ----------------------------------------------
+# Under multi-controller SPMD (launcher-spawned processes, reference
+# test_dist_base.py style) each process holds DIFFERENT local data, so the
+# single-controller "already reduced" shortcut is wrong.  These helpers
+# build a process-spanning mesh, assemble a global array from the
+# per-process locals, and run the collective as a tiny jitted module whose
+# cross-host transfers ride the backend's collective transport
+# (ICI/DCN on TPU slices, Gloo on CPU test fixtures).
+
+def _multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _group_ranks(group: Optional[Group]):
+    """Process ranks participating in a multi-process eager collective.
+    None/world -> all processes."""
+    if group is None:
+        return tuple(range(jax.process_count()))
+    return tuple(group.ranks)
+
+
+def _proc_mesh(ranks):
+    import numpy as _np
+    # one device per participating process keeps the collective purely
+    # cross-process; like an NCCL communicator, ONLY members may call
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    per_proc = [next(d for d in devs if d.process_index == p)
+                for p in ranks]
+    return jax.sharding.Mesh(_np.array(per_proc), ("proc",))
+
+
+_XP_JIT_CACHE = {}
+
+
+def _cross_process_apply(local_np, fn, group: Optional[Group] = None,
+                         fn_key=None):
+    """Stack per-group-member locals on a leading 'proc' axis, run fn over
+    the global array, return the (replicated) result as numpy.  Every
+    member process of `group` must call this collectively."""
+    import numpy as _np
+    ranks = _group_ranks(group)
+    mesh = _proc_mesh(ranks)
+    n = len(ranks)
+    sharding = NamedSharding(mesh, PartitionSpec("proc"))
+    global_shape = (n,) + local_np.shape
+    arr = jax.make_array_from_process_local_data(
+        sharding, local_np[None, ...], global_shape)
+    cache_key = (fn_key, mesh) if fn_key is not None else None
+    jitted = _XP_JIT_CACHE.get(cache_key)
+    if jitted is None:
+        jitted = jax.jit(fn, out_shardings=NamedSharding(mesh,
+                                                         PartitionSpec()))
+        if cache_key is not None:
+            _XP_JIT_CACHE[cache_key] = jitted
+    return _np.asarray(jitted(arr))
+
+
+_NP_REDUCE = {ReduceOp.SUM: jnp.sum, "sum": jnp.sum,
+              ReduceOp.MAX: jnp.max, "max": jnp.max,
+              ReduceOp.MIN: jnp.min, "min": jnp.min,
+              ReduceOp.PROD: jnp.prod, "prod": jnp.prod,
+              ReduceOp.AVG: jnp.mean, "avg": jnp.mean}
+
+
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
                sync_op: bool = True):
     """Parity: paddle.distributed.all_reduce (in place on `tensor`).
@@ -144,6 +208,13 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Group = None,
         out = apply_op("all_reduce",
                        lambda v: _reduce_fn(op)(v, axis), (tensor,))
         tensor._inplace_assign(out)
+        return tensor
+    if _multiprocess() and getattr(tensor, "_placements", None) is None:
+        red = _NP_REDUCE[op]
+        out = _cross_process_apply(np.asarray(val),
+                                   lambda a: red(a, axis=0), group,
+                                   fn_key=("all_reduce", str(op)))
+        tensor._inplace_assign(Tensor(out))
         return tensor
     placements = getattr(tensor, "_placements", None)
     if placements is not None and any(p.is_partial() for p in placements):
@@ -168,6 +239,11 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Group = None,
             lambda v: lax.all_gather(v, _axis(g), tiled=False), (tensor,))
         for i in range(g.nranks):
             tensor_list.append(gathered[i])
+        return tensor_list
+    if _multiprocess() and getattr(tensor, "_placements", None) is None:
+        out = _cross_process_apply(np.asarray(val), lambda a: a, group,
+                                   fn_key=("all_gather",))
+        tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
         return tensor_list
     placements = getattr(tensor, "_placements", None)
     if placements is not None:
@@ -197,6 +273,15 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
               sync_op: bool = True):
     """Parity: paddle.distributed.broadcast.  Single-controller global
     arrays are already consistent; sharded tensors get replicated."""
+    if _multiprocess() and getattr(tensor, "_placements", None) is None \
+            and not _in_trace(tensor._value):
+        g = group or _world_group()
+        gsrc = g.get_group_rank(src) if g.get_group_rank(src) >= 0 else src
+        out = _cross_process_apply(np.asarray(tensor._value),
+                                   lambda a: a[gsrc], group,
+                                   fn_key=("broadcast", int(gsrc)))
+        tensor._inplace_assign(Tensor(out))
+        return tensor
     placements = getattr(tensor, "_placements", None)
     if placements is not None and not all(p.is_replicate()
                                           for p in placements):
@@ -284,8 +369,24 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
             sync_op=True):
     g = group or _world_group()
+    from .env import get_rank
+    if _multiprocess():
+        # only src's tensor_list is meaningful; ship it to everyone and
+        # let each process keep its slot
+        ranks = _group_ranks(group)
+        n = len(ranks)
+        my = ranks.index(get_rank()) if get_rank() in ranks else 0
+        gsrc = ranks.index(src) if src in ranks else 0
+        shape = (n,) + tuple(tensor.shape)
+        if get_rank() == src and tensor_list:
+            local = np.stack([np.asarray(t._value) for t in tensor_list])
+        else:
+            local = np.zeros(shape, np.asarray(tensor._value).dtype)
+        out = _cross_process_apply(local, lambda a: a[gsrc], group,
+                                   fn_key=("scatter", int(gsrc)))
+        tensor._inplace_assign(Tensor(out[my]))
+        return tensor
     if tensor_list:
-        from .env import get_rank
         tensor._inplace_assign(tensor_list[g.get_group_rank(get_rank())
                                            if g.get_group_rank(
                                                get_rank()) >= 0 else 0])
@@ -324,6 +425,12 @@ def ppermute(tensor: Tensor, perm: List, group: Group = None):
 
 
 def barrier(group=None):
+    if _multiprocess():
+        # a 1-element cross-process sum is a true rendezvous
+        _cross_process_apply(np.ones((1,), np.float32),
+                             lambda a: jnp.sum(a, axis=0), group,
+                             fn_key=("barrier",))
+        return
     jax.effects_barrier()
 
 

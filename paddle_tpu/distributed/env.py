@@ -28,13 +28,17 @@ def init_parallel_env():
     coord = os.environ.get("PADDLE_MASTER") or \
         os.environ.get("MASTER_ENDPOINT")
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1 and jax.process_count() == 1:
-        # fail fast — a silent fallback would train nnodes independent
-        # un-synchronized replicas
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=nnodes,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    if coord and nnodes > 1:
+        # NOTE: must not touch jax.devices()/process_count() first — any
+        # backend-initializing call makes jax.distributed.initialize
+        # impossible.  is_initialized() probes without initializing.
+        if not jax.distributed.is_initialized():
+            # fail fast — a silent fallback would train nnodes independent
+            # un-synchronized replicas
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nnodes,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     _INITIALIZED[0] = True
 
 

@@ -84,17 +84,42 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         seq = qq.shape[1]
         dim = qq.shape[-1]
         if sin is None:
-            pos = jnp.arange(seq)[:, None]
+            if position_ids is not None:
+                pos = vals[-1]                      # [S] or [B, S]
+                if pos.ndim == 1:
+                    pos = pos[:, None]              # [S, 1]
+                    batched = False
+                else:
+                    pos = pos[..., None]            # [B, S, 1]
+                    batched = True
+            else:
+                pos = jnp.arange(seq)[:, None]
+                batched = False
             inv = 1.0 / (rotary_emb_base **
                          (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-            freqs = pos * inv[None, :]
+            freqs = pos.astype(jnp.float32) * inv
             emb = jnp.concatenate([freqs, freqs], axis=-1)
-            sin_v = jnp.sin(emb)[None, :, None, :]
-            cos_v = jnp.cos(emb)[None, :, None, :]
+            if batched:                              # [B, S, dim]
+                sin_v = jnp.sin(emb)[:, :, None, :]
+                cos_v = jnp.cos(emb)[:, :, None, :]
+            else:
+                sin_v = jnp.sin(emb)[None, :, None, :]
+                cos_v = jnp.cos(emb)[None, :, None, :]
         else:
             sin_v = vals[i]; i += 1
             cos_v = vals[i]; i += 1
-            if sin_v.ndim == 2:
+            if position_ids is not None and sin_v.ndim == 2:
+                # [max_seq, dim] tables; position_ids selects rows
+                pos = vals[-1]
+                sin_v = jnp.take(sin_v, pos, axis=0)
+                cos_v = jnp.take(cos_v, pos, axis=0)
+                if pos.ndim == 2:        # [B, S, dim] -> [B, S, 1, dim]
+                    sin_v = sin_v[:, :, None, :]
+                    cos_v = cos_v[:, :, None, :]
+                else:                    # [S, dim] -> [1, S, 1, dim]
+                    sin_v = sin_v[None, :, None, :]
+                    cos_v = cos_v[None, :, None, :]
+            elif sin_v.ndim == 2:
                 sin_v = sin_v[None, :, None, :]
                 cos_v = cos_v[None, :, None, :]
         sin_v = sin_v.astype(jnp.float32)
@@ -115,6 +140,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         args.append(targ(v))
     if sin is not None:
         args += [targ(sin), targ(cos)]
+    if position_ids is not None:
+        args.append(targ(position_ids))
     out = apply_op("fused_rope", fn, tuple(args))
     if k is None and v is None:
         return out, None, None
@@ -139,3 +166,10 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     out = dropout(out, dropout_rate, training=training) + residual
     shape = [int(out.shape[-1])]
     return _layer_norm(out, shape, ln_scale, ln_bias, ln_epsilon)
+
+
+# serving fused set (reference phi/kernels/fusion — paged/dense decode
+# attention); implementations live with the pallas kernels
+from ....ops.paged_attention import (block_multihead_attention,  # noqa: E402,F401
+                                     masked_multihead_attention,
+                                     paged_attention)

@@ -1,0 +1,187 @@
+"""Inference deployment API.
+
+Parity: paddle_infer (reference — paddle/fluid/inference/api/
+analysis_predictor.h:100,210 AnalysisPredictor/ZeroCopyRun,
+paddle_inference_api.h Config/Tensor handles).
+
+TPU-native: the deployed artifact is the StableHLO program written by
+``jit.save`` (the PIR/ProgramDesc analog); "analysis passes" are XLA's
+job at AOT-compile time, so ``create_predictor`` loads the exported
+module, compiles it once per input signature, and ``run`` is a single
+device execution with zero-copy numpy in/out.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PredictorPool"]
+
+
+class Config:
+    """Parity: paddle_infer.Config."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # paddle passes either a dir or (model_file, params_file); here a
+        # single prefix identifies path.pdexec/.pdparams/.json
+        self._prefix = None
+        if model_path is not None:
+            self._prefix = (model_path[:-7]
+                            if model_path.endswith(".pdexec") else model_path)
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._cpu_math_threads = 1
+        self._ir_optim = True
+
+    def set_model(self, model_path, params_path=None):
+        self._prefix = (model_path[:-7]
+                        if model_path.endswith(".pdexec") else model_path)
+
+    def model_path(self):
+        return self._prefix
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accepted for API parity; device selection is JAX's
+        self._device = "gpu"
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def summary(self):
+        return json.dumps({"model": self._prefix, "device": self._device})
+
+
+class Tensor:
+    """Zero-copy handle (parity: paddle_infer.Tensor)."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self._name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def name(self):
+        return self._name
+
+    # -- input side --
+    def copy_from_cpu(self, data: np.ndarray):
+        assert self._is_input, "copy_from_cpu on an output handle"
+        self._pred._feed[self._name] = np.asarray(data)
+
+    def reshape(self, shape):
+        pass   # shapes follow the fed array; kept for API parity
+
+    # -- output side --
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input, "copy_to_cpu on an input handle"
+        return self._pred._fetch[self._name]
+
+    def shape(self):
+        if self._is_input:
+            arr = self._pred._feed.get(self._name)
+            return list(arr.shape) if arr is not None else None
+        return list(self._pred._fetch[self._name].shape)
+
+
+class Predictor:
+    """Parity: paddle_infer.Predictor over a jit.save'd StableHLO module."""
+
+    def __init__(self, config: Config):
+        if config.model_path() is None:
+            raise ValueError("Config.set_model(path_prefix) is required")
+        prefix = config.model_path()
+        meta_path = prefix + ".json"
+        if not os.path.exists(meta_path):
+            raise FileNotFoundError(
+                "no exported model at %r (expected %s)" % (prefix, meta_path))
+        with open(meta_path) as f:
+            self._meta = json.load(f)
+        from ..jit.save_load import load as jit_load
+        self._layer = jit_load(prefix)
+        self._input_names = list(
+            self._meta.get("input_names")
+            or [f"x{i}" for i in range(len(self._meta["input_shapes"]))])
+        self._feed: Dict[str, np.ndarray] = {}
+        self._fetch: Dict[str, np.ndarray] = {}
+        self._output_names: List[str] = []
+
+    # -- reference API --
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        if name not in self._input_names:
+            raise KeyError(name)
+        return Tensor(name, self, is_input=True)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """ZeroCopyRun: execute on the fed inputs (or `inputs` list)."""
+        from ..core.tensor import Tensor as PTensor
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._feed[n] = np.asarray(a)
+        missing = [n for n in self._input_names if n not in self._feed]
+        if missing:
+            raise RuntimeError("inputs not fed: %s" % missing)
+        args = [PTensor(self._feed[n]) for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._fetch = {n: np.asarray(o._value)
+                       for n, o in zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._fetch[n] for n in self._output_names]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        if not self._output_names:
+            # run not called yet: outputs unknown until execution; probe
+            # with zeros is unsafe, report the standard single slot
+            return ["out0"]
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    def clear_intermediate_tensor(self):
+        self._feed.clear()
+        self._fetch.clear()
+
+    def try_shrink_memory(self):
+        pass
+
+
+class PredictorPool:
+    """Parity: paddle_infer.PredictorPool (N predictors over one model)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -127,6 +127,9 @@ def save(layer, path: str, input_spec=None, **configs):
         "state_keys": [k for k, _ in state_items],
         "input_shapes": [[str(s) for s in t.shape] for t in examples],
         "input_dtypes": [str(t.dtype) for t in examples],
+        "input_names": [
+            (spec.name if isinstance(spec, InputSpec) and spec.name
+             else f"x{i}") for i, spec in enumerate(input_spec)],
     }
     with open(path + ".json", "w") as f:
         json.dump(meta, f, indent=1)

@@ -129,19 +129,26 @@ class LlamaAttention(Layer):
             return "sep"
         return None
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, position_offset=0):
         B, S = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([B, S, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
 
+        position_ids = None
+        if position_offset:
+            position_ids = np.arange(position_offset,
+                                     position_offset + S, dtype=np.int32)
         q, k, _ = fused_rotary_position_embedding(
-            q, k, rotary_emb_base=self.config.rope_theta)
+            q, k, position_ids=position_ids,
+            rotary_emb_base=self.config.rope_theta)
 
-        if cache is not None:
+        if cache is not None and cache[0] is not None \
+                and cache[0].shape[1] > 0:
             from ..ops.manipulation import concat
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
+        new_cache = (k, v)   # pre-GQA-repeat: Hkv heads, reusable next step
 
         # GQA: repeat kv heads
         if self.num_kv_heads != self.num_heads:
@@ -150,8 +157,11 @@ class LlamaAttention(Layer):
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
 
-        is_causal = attn_mask is None and cache is None
-        ring_axis = self._ring_axis() if is_causal else None
+        # bottom-right-aligned causal covers both prefill and decode
+        # (S==1 rows see the whole cache)
+        is_causal = attn_mask is None
+        ring_axis = self._ring_axis() if (is_causal and cache is None) \
+            else None
         if ring_axis is not None:
             from ..ops.pallas_kernels import sdpa_ring
             from ..distributed.topology import \
@@ -165,7 +175,7 @@ class LlamaAttention(Layer):
         out = out.reshape([B, S, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if cache is not None:
-            return out, (k, v)
+            return out, new_cache
         return out
 
 
@@ -183,6 +193,14 @@ class LlamaDecoderLayer(Layer):
     def _block(self, x, attn_mask=None):
         h = x + self.self_attn(self.input_layernorm(x), attn_mask)
         return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward_with_cache(self, x, cache, position_offset,
+                           attn_mask=None):
+        attn, new_cache = self.self_attn(
+            self.input_layernorm(x), attn_mask, cache=cache,
+            position_offset=position_offset)
+        h = x + attn
+        return h + self.mlp(self.post_attention_layernorm(h)), new_cache
 
     def forward(self, x, attn_mask=None):
         if self._recompute and self.training:
@@ -203,13 +221,21 @@ class LlamaModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
         h = self.embed_tokens(input_ids)
         if self.config.dtype == "bfloat16":
             h = h.astype("bfloat16")
-        for layer in self.layers:
-            h = layer(h, attn_mask)
-        return self.norm(h)
+        if caches is None:
+            for layer in self.layers:
+                h = layer(h, attn_mask)
+            return self.norm(h)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            h, c = layer.forward_with_cache(h, cache, position_offset,
+                                            attn_mask)
+            new_caches.append(c)
+        return self.norm(h), new_caches
 
 
 class LlamaForCausalLM(Layer):
@@ -225,15 +251,60 @@ class LlamaForCausalLM(Layer):
                 weight_attr=_attr(I.Normal(0.0, config.initializer_range)),
                 bias_attr=False)
 
-    def forward(self, input_ids, attn_mask=None):
-        h = self.llama(input_ids, attn_mask)
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        if caches is None:
+            h = self.llama(input_ids, attn_mask)
+        else:
+            h, caches = self.llama(input_ids, attn_mask, caches,
+                                   position_offset)
         if self.lm_head is None:
             from ..ops.linalg import matmul
             logits = matmul(h, self.llama.embed_tokens.weight,
                             transpose_y=True)
         else:
             logits = self.lm_head(h)
+        if caches is not None:
+            return logits, caches
         return logits
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
+                 top_p=0.0, eos_token_id=None, seed=0):
+        """Autoregressive decode with per-layer KV caches (the serving
+        path; parity with the reference's generation loop over
+        masked/block attention kernels).  top_p=0 -> greedy."""
+        import numpy as np_
+        from ..ops.manipulation import concat
+        from ..autograd.tape import no_grad
+        n_layers = self.config.num_hidden_layers
+        with no_grad():
+            caches = [(None, None)] * n_layers
+            logits, caches = self.forward(input_ids, caches=caches)
+            out_ids = [input_ids]
+            cur_len = input_ids.shape[1]
+            for step in range(max_new_tokens):
+                last = logits[:, -1, :]
+                if top_p and top_p > 0.0:
+                    from ..ops.extras import top_p_sampling
+                    if temperature != 1.0:
+                        last = last / temperature
+                    probs = F.softmax(last, axis=-1)
+                    ps = np_.full((probs.shape[0],), float(top_p),
+                                  np_.float32)
+                    _, nxt = top_p_sampling(probs, ps, seed=seed + step)
+                    nxt = nxt.reshape([-1, 1])
+                else:
+                    nxt = last.argmax(-1).reshape([-1, 1])
+                out_ids.append(nxt)
+                if eos_token_id is not None:
+                    if bool(np_.all(np_.asarray(nxt._value)
+                                    == eos_token_id)):
+                        break
+                if step < max_new_tokens - 1:    # last token needs no fwd
+                    logits, caches = self.forward(
+                        nxt, caches=caches, position_offset=cur_len)
+                    cur_len += 1
+            return concat(out_ids, axis=1)
 
 
 class LlamaPretrainingCriterion(Layer):

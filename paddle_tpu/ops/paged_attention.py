@@ -1,0 +1,413 @@
+"""Paged (block) attention for serving.
+
+Parity: the reference's LLM-serving fused kernels
+(paddle/phi/kernels/fusion/block_multihead_attention_kernel.cu — paged KV
+cache addressed through per-sequence block tables — and
+masked_multihead_attention for dense-cache decode).
+
+TPU-native design: the KV cache is a pool of fixed-size pages
+``[num_blocks, block_size, kv_heads, head_dim]`` living in HBM; a batch
+addresses it through ``block_tables [B, max_blocks]``.  Decode attention
+runs as a Pallas kernel — grid over (batch, kv_head), the page list is a
+scalar-prefetch operand, and pages are DMA'd HBM→VMEM with online-softmax
+accumulation — so one query token never materializes the gathered
+[L, D] cache in HBM.  An XLA gather fallback covers CPU and is the
+numerics reference in tests.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..core.tensor import Tensor
+
+__all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
+           "reconstruct_kv", "block_multihead_attention",
+           "masked_multihead_attention"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# cache pool management (host-side; the reference keeps this in the
+# serving runtime around the kernel too)
+# ---------------------------------------------------------------------------
+class PagedKVCache:
+    """A pool of KV pages plus a per-layer free-list/block-table manager.
+
+    One instance serves one transformer layer.  Arrays are jax arrays so
+    updates stay on device; the free list is host state (allocation is
+    control flow, not compute).
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, num_kv_heads: int,
+                 head_dim: int, dtype=jnp.float32):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        shape = (num_blocks, block_size, num_kv_heads, head_dim)
+        self.key_cache = jnp.zeros(shape, dtype)
+        self.value_cache = jnp.zeros(shape, dtype)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+
+    def allocate_block(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                "PagedKVCache out of blocks (%d in pool); raise num_blocks "
+                "or free finished sequences" % self.num_blocks)
+        return self._free.pop()
+
+    def free_sequence(self, block_ids):
+        for b in block_ids:
+            if b >= 0:
+                self._free.append(int(b))
+
+    def blocks_needed(self, seq_len: int) -> int:
+        return -(-seq_len // self.block_size)
+
+    def build_block_table(self, seq_lens, max_blocks=None) -> np.ndarray:
+        """Allocate pages for new sequences; returns [B, max_blocks]
+        int32 table (-1 padded)."""
+        tables = []
+        for L in seq_lens:
+            n = self.blocks_needed(max(int(L), 1))
+            tables.append([self.allocate_block() for _ in range(n)])
+        width = max_blocks or max(len(t) for t in tables)
+        out = np.full((len(tables), width), -1, np.int32)
+        for i, t in enumerate(tables):
+            out[i, :len(t)] = t
+        return out
+
+    def ensure_capacity(self, block_tables: np.ndarray,
+                        seq_lens) -> np.ndarray:
+        """Grow tables so every sequence can hold seq_len+1 tokens."""
+        bt = np.asarray(block_tables).copy()
+        for i, L in enumerate(np.asarray(seq_lens)):
+            need = self.blocks_needed(int(L) + 1)
+            have = int((bt[i] >= 0).sum())
+            while have < need:
+                if (bt[i] >= 0).sum() == bt.shape[1]:
+                    bt = np.concatenate(
+                        [bt, np.full((bt.shape[0], 1), -1, np.int32)], 1)
+                bt[i, have] = self.allocate_block()
+                have += 1
+        return bt
+
+
+# ---------------------------------------------------------------------------
+# cache write (scatter one new token per sequence)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, donate_argnums=(2, 3))
+def _write_decode(k_new, v_new, key_cache, value_cache, block_tables,
+                  seq_lens):
+    """k_new/v_new [B, Hkv, D]; writes at position seq_lens[b]."""
+    bs = key_cache.shape[1]
+    pos = seq_lens.astype(jnp.int32)
+    blk = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    off = pos % bs
+    key_cache = key_cache.at[blk, off].set(k_new)
+    value_cache = value_cache.at[blk, off].set(v_new)
+    return key_cache, value_cache
+
+
+def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
+                      seq_lens):
+    """Append one token's K/V per sequence into its page slot.
+
+    k_new/v_new: [B, Hkv, D] (decode) or [B, S, Hkv, D] (prefill).
+    Returns updated (key_cache, value_cache)."""
+    k_new, v_new = _val(k_new), _val(v_new)
+    key_cache, value_cache = _val(key_cache), _val(value_cache)
+    block_tables = jnp.asarray(np.asarray(block_tables), jnp.int32)
+    seq_lens = jnp.asarray(np.asarray(seq_lens), jnp.int32)
+    if k_new.ndim == 3:
+        return _write_decode(k_new, v_new, key_cache, value_cache,
+                             block_tables, seq_lens)
+    # prefill: write S tokens starting at seq_lens (usually 0)
+    B, S = k_new.shape[:2]
+    bs = key_cache.shape[1]
+    for s in range(S):   # python loop: prefill runs once per request
+        key_cache, value_cache = _write_decode(
+            k_new[:, s], v_new[:, s], key_cache, value_cache,
+            block_tables, seq_lens + s)
+    return key_cache, value_cache
+
+
+def reconstruct_kv(key_cache, value_cache, block_tables, max_len):
+    """Gather pages back to dense [B, max_len, Hkv, D] (XLA path)."""
+    bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    k = key_cache[bt]          # [B, max_blocks, bs, Hkv, D]
+    v = value_cache[bt]
+    B, nb, bs, H, D = k.shape
+    k = k.reshape(B, nb * bs, H, D)[:, :max_len]
+    v = v.reshape(B, nb * bs, H, D)[:, :max_len]
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# decode attention: XLA gather path (reference + CPU)
+# ---------------------------------------------------------------------------
+def _paged_attention_xla(q, key_cache, value_cache, block_tables, seq_lens,
+                         scale):
+    B, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    max_len = int(block_tables.shape[1]) * key_cache.shape[1]
+    k, v = reconstruct_kv(key_cache, value_cache, block_tables, max_len)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    cols = jnp.arange(s.shape[-1], dtype=jnp.int32)
+    valid = cols[None, None, :] < seq_lens[:, None, None]
+    s = jnp.where(valid, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhl,blhd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# decode attention: Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _paged_decode_kernel(# scalar prefetch
+                         block_tables_ref, seq_lens_ref,
+                         # operands
+                         q_ref, k_pages_ref, v_pages_ref,
+                         # output
+                         o_ref,
+                         # scratch
+                         k_vmem, v_vmem, sem,
+                         *, block_size: int, pages_per_seq: int,
+                         scale: float, groups: int):
+    """Grid cell (b, hkv): one batch row, one kv head; q carries the
+    `groups` query heads mapped to this kv head.
+
+    Pages are copied HBM->VMEM one at a time with an async DMA, with the
+    online-softmax running state in fp32 registers."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    q = q_ref[0, 0].astype(jnp.float32) * scale        # [groups, D]
+    g, d = q.shape
+
+    m0 = jnp.full((g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
+
+    n_pages = jnp.minimum(
+        (seq_len + jnp.int32(block_size - 1)) // jnp.int32(block_size),
+        jnp.int32(pages_per_seq))
+
+    def body(p_idx, carry):
+        m, l, acc = carry
+        page = block_tables_ref[b, p_idx]
+        k_copy = pltpu.make_async_copy(
+            k_pages_ref.at[h, page], k_vmem, sem)
+        k_copy.start()
+        k_copy.wait()
+        v_copy = pltpu.make_async_copy(
+            v_pages_ref.at[h, page], v_vmem, sem)
+        v_copy.start()
+        v_copy.wait()
+        k = k_vmem[...].astype(jnp.float32)            # [bs, D]
+        v = v_vmem[...].astype(jnp.float32)
+        s = q @ k.T                                    # [groups, bs]
+        base = p_idx * jnp.int32(block_size)
+        cols = base + jax.lax.broadcasted_iota(jnp.int32, (g, block_size), 1)
+        s = jnp.where(cols < seq_len, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(cols < seq_len, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + p @ v
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), n_pages, body,
+                                  (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
+                            seq_lens, scale, interpret=False):
+    B, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bs = key_cache.shape[1]
+    groups = H // Hkv
+    pages_per_seq = block_tables.shape[1]
+    # [B, H, D] -> [B, Hkv, groups, D]; pages -> [Hkv, nb, bs, D]
+    qg = q.reshape(B, Hkv, groups, D)
+    kp = jnp.moveaxis(key_cache, 2, 0)      # [Hkv, nb, bs, D]
+    vp = jnp.moveaxis(value_cache, 2, 0)
+    bt = jnp.maximum(block_tables, 0)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_size=bs, pages_per_seq=pages_per_seq,
+        scale=scale, groups=groups)
+
+    with jax.enable_x64(False):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, groups, D),
+                             lambda b, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            ],
+            out_specs=pl.BlockSpec((1, 1, groups, D),
+                                   lambda b, h, *_: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, Hkv, groups, D), q.dtype),
+            interpret=interpret,
+        )(bt.astype(jnp.int32), seq_lens.astype(jnp.int32),
+          qg, kp.astype(jnp.float32), vp.astype(jnp.float32))
+    return out.reshape(B, H, D)
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
+                    use_pallas: Optional[bool] = None, interpret=False):
+    """Decode-step attention over a paged KV cache.
+
+    q: [B, H, D] (one query token per sequence)
+    key_cache/value_cache: [num_blocks, block_size, Hkv, D]
+    block_tables: [B, max_blocks] int32, -1 padded
+    seq_lens: [B] int32 — number of valid tokens ALREADY in the cache
+    Returns [B, H, D].
+    """
+    tensor_in = isinstance(q, Tensor)
+    qv = _val(q)
+    kc, vc = _val(key_cache), _val(value_cache)
+    bt = jnp.asarray(np.asarray(block_tables), jnp.int32)
+    sl = jnp.asarray(np.asarray(seq_lens), jnp.int32)
+    scale = 1.0 / math.sqrt(qv.shape[-1])
+    if use_pallas is None:
+        use_pallas = _HAS_PLTPU and _on_tpu()
+    if use_pallas or interpret:
+        out = _paged_attention_pallas(qv, kc, vc, bt, sl, scale,
+                                      interpret=interpret)
+    else:
+        out = _paged_attention_xla(qv, kc, vc, bt, sl, scale)
+    return Tensor._from_value(out) if tensor_in else out
+
+
+# ---------------------------------------------------------------------------
+# fused serving ops (reference API parity)
+# ---------------------------------------------------------------------------
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
+                              block_tables, num_heads: int,
+                              head_dim: Optional[int] = None):
+    """Parity: paddle.incubate.nn.functional.block_multihead_attention
+    (phi/kernels/fusion/block_multihead_attention_kernel.cu), simplified to
+    the two serving phases:
+
+    - prefill (qkv [B, S, (H+2Hkv)*D], seq_lens==0): causal self-attention,
+      writes K/V pages, returns [B, S, H*D]
+    - decode (qkv [B, 1, ...], seq_lens>0): appends one token and runs
+      paged attention, returns [B, 1, H*D]
+
+    Returns (out, key_cache, value_cache, new_seq_lens).
+    """
+    qkv_v = _val(qkv)
+    kc, vc = _val(key_cache), _val(value_cache)
+    B, S = qkv_v.shape[:2]
+    Hkv = kc.shape[2]
+    D = head_dim or kc.shape[3]
+    H = num_heads
+    q, k, v = jnp.split(qkv_v.reshape(B, S, -1, D), [H, H + Hkv], axis=2)
+    sl = jnp.asarray(np.asarray(seq_lens), jnp.int32)
+
+    kc, vc = write_kv_to_cache(k, v, kc, vc, block_tables, sl)
+    new_len = sl + S
+
+    if S > 1:
+        # prefill: dense causal attention over what was just written
+        from .pallas_kernels import _chunked_sdpa
+        qh = jnp.moveaxis(q, 2, 1)        # [B, H, S, D]
+        kh = jnp.moveaxis(k, 2, 1)
+        vh = jnp.moveaxis(v, 2, 1)
+        if Hkv != H:
+            rep = H // Hkv
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
+        out = _chunked_sdpa(qh, kh, vh, True)
+        out = jnp.moveaxis(out, 1, 2).reshape(B, S, H * D)
+    else:
+        out = paged_attention(q[:, 0], kc, vc, block_tables, new_len)
+        out = out.reshape(B, 1, H * D)
+    if isinstance(qkv, Tensor):
+        out = Tensor._from_value(jnp.asarray(out))
+    return out, kc, vc, new_len
+
+
+def masked_multihead_attention(x, cache_kv, seq_lens=None,
+                               num_heads: Optional[int] = None):
+    """Parity: masked_multihead_attention (dense-cache decode step).
+
+    x: packed qkv [B, 3*H*D] for ONE new token.
+    cache_kv: [2, B, H, max_len, D]; seq_lens [B] tokens already cached.
+    Returns (out [B, H*D], updated cache_kv, new_seq_lens)."""
+    xv = _val(x)
+    cache = _val(cache_kv)
+    B = xv.shape[0]
+    H = num_heads or cache.shape[2]
+    D = cache.shape[4]
+    max_len = cache.shape[3]
+    q, k, v = jnp.split(xv.reshape(B, 3, H, D), 3, axis=1)
+    q, k, v = q[:, 0], k[:, 0], v[:, 0]
+    if seq_lens is None:
+        seq_lens = jnp.zeros((B,), jnp.int32)
+    sl = jnp.asarray(np.asarray(seq_lens), jnp.int32)
+
+    bidx = jnp.arange(B)
+    cache = cache.at[0, bidx, :, sl].set(k)
+    cache = cache.at[1, bidx, :, sl].set(v)
+    new_len = sl + 1
+
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32) * scale,
+                   cache[0].astype(jnp.float32))
+    cols = jnp.arange(max_len, dtype=jnp.int32)
+    s = jnp.where(cols[None, None, :] < new_len[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhl,bhld->bhd", p,
+                     cache[1].astype(jnp.float32)).astype(xv.dtype)
+    out = out.reshape(B, H * D)
+    if isinstance(x, Tensor):
+        out = Tensor._from_value(out)
+    return out, cache, new_len

@@ -1,0 +1,98 @@
+"""Multi-process worker script, run under
+``python -m paddle_tpu.distributed.launch`` (one process per "node").
+
+Mirrors the reference's test_dist_base.py model scripts
+(test/collective/fleet/hybrid_parallel_mp_layers.py pattern): exercise
+cross-process collectives + a data-parallel train step, assert parity,
+print a final OK marker the spawning test greps for.
+"""
+import os
+import sys
+
+# each "node" is one CPU process with one XLA device
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+
+
+def main():
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, f"expected 2 processes, got {world}"
+
+    # --- all_reduce ---
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(np.asarray(t._value), 3.0)
+
+    t = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(t._value), 2.0)
+
+    # --- all_gather ---
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(
+        np.full((3,), float(rank), np.float32)))
+    assert len(gathered) == 2
+    np.testing.assert_allclose(np.asarray(gathered[0]._value), 0.0)
+    np.testing.assert_allclose(np.asarray(gathered[1]._value), 1.0)
+
+    # --- broadcast ---
+    b = paddle.to_tensor(np.full((2,), float(rank * 7 + 1), np.float32))
+    dist.broadcast(b, src=1)
+    np.testing.assert_allclose(np.asarray(b._value), 8.0)
+
+    # --- scatter ---
+    s = paddle.to_tensor(np.zeros((2,), np.float32))
+    parts = ([paddle.to_tensor(np.full((2,), 10.0, np.float32)),
+              paddle.to_tensor(np.full((2,), 20.0, np.float32))]
+             if rank == 0 else None)
+    dist.scatter(s, parts, src=0)
+    np.testing.assert_allclose(np.asarray(s._value),
+                               10.0 if rank == 0 else 20.0)
+
+    # --- barrier ---
+    dist.barrier()
+
+    # --- data-parallel step parity: grads averaged across processes must
+    # equal the single-process full-batch gradient ---
+    rng = np.random.RandomState(0)
+    full_x = rng.randn(8, 4).astype(np.float32)
+    full_y = rng.randn(8, 2).astype(np.float32)
+    local_x = full_x[rank * 4:(rank + 1) * 4]
+    local_y = full_y[rank * 4:(rank + 1) * 4]
+
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    loss = ((m(paddle.to_tensor(local_x))
+             - paddle.to_tensor(local_y)) ** 2).mean()
+    loss.backward()
+    for p in m.parameters():
+        g = p.grad
+        dist.all_reduce(g, op=dist.ReduceOp.AVG)
+        p.grad = g
+
+    # serial reference (deterministic init: same on every process)
+    paddle.seed(0)
+    ref = nn.Linear(4, 2)
+    rloss = ((ref(paddle.to_tensor(full_x))
+              - paddle.to_tensor(full_y)) ** 2).mean()
+    rloss.backward()
+    for p, rp in zip(m.parameters(), ref.parameters()):
+        np.testing.assert_allclose(np.asarray(p.grad._value),
+                                   np.asarray(rp.grad._value),
+                                   rtol=1e-5, atol=1e-6)
+
+    print(f"DIST_WORKER_OK rank={rank} world={world}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+"""Serving slice: paged KV cache + paged/block/masked attention kernels,
+inference Predictor, llama KV-cache generation.
+
+Parity targets: paddle/phi/kernels/fusion/block_multihead_attention_kernel.cu,
+masked_multihead_attention, paddle/fluid/inference/api/analysis_predictor.h.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.paged_attention import (
+    PagedKVCache, paged_attention, write_kv_to_cache, reconstruct_kv,
+    block_multihead_attention, masked_multihead_attention,
+    _paged_attention_xla, _paged_attention_pallas)
+
+rng = np.random.RandomState(0)
+
+
+def _dense_ref(q, k, v, seq_lens):
+    """q [B,H,D], k/v [B,L,Hkv,D] padded; full softmax over valid cols."""
+    B, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+    s = np.einsum("bhd,blhd->bhl", q / np.sqrt(D), k)
+    for b, L in enumerate(seq_lens):
+        s[b, :, L:] = -np.inf
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhl,blhd->bhd", p, v)
+
+
+def _build_cache(B, lens, bs=4, Hkv=2, D=8, num_blocks=32):
+    cache = PagedKVCache(num_blocks, bs, Hkv, D)
+    bt = cache.build_block_table(lens)
+    max_len = bt.shape[1] * bs
+    k_dense = rng.randn(B, max_len, Hkv, D).astype(np.float32)
+    v_dense = rng.randn(B, max_len, Hkv, D).astype(np.float32)
+    kc, vc = cache.key_cache, cache.value_cache
+    # write token-by-token through the public scatter API
+    for s in range(max(lens)):
+        write_mask = [s < L for L in lens]
+        kc, vc = write_kv_to_cache(
+            k_dense[:, s], v_dense[:, s], kc, vc, bt,
+            np.asarray([s] * B, np.int32))
+        del write_mask   # all writes land; invalid cols masked by seq_lens
+    return cache, kc, vc, bt, k_dense, v_dense
+
+
+def test_cache_write_and_reconstruct():
+    lens = [6, 3]
+    cache, kc, vc, bt, k_dense, v_dense = _build_cache(2, lens)
+    k_back, v_back = reconstruct_kv(kc, vc, bt, max_len=8)
+    for b, L in enumerate(lens):
+        np.testing.assert_allclose(np.asarray(k_back)[b, :L],
+                                   k_dense[b, :L], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(v_back)[b, :L],
+                                   v_dense[b, :L], rtol=1e-6)
+
+
+@pytest.mark.parametrize("H,Hkv", [(2, 2), (4, 2)])
+def test_paged_attention_matches_dense(H, Hkv):
+    lens = [7, 3]
+    B, D = 2, 8
+    cache = PagedKVCache(16, 4, Hkv, D)
+    bt = cache.build_block_table(lens)
+    kc, vc = cache.key_cache, cache.value_cache
+    max_len = bt.shape[1] * 4
+    k_dense = rng.randn(B, max_len, Hkv, D).astype(np.float32)
+    v_dense = rng.randn(B, max_len, Hkv, D).astype(np.float32)
+    for s in range(max(lens)):
+        kc, vc = write_kv_to_cache(k_dense[:, s], v_dense[:, s], kc, vc,
+                                   bt, np.asarray([s] * B, np.int32))
+    q = rng.randn(B, H, D).astype(np.float32)
+    got = paged_attention(q, kc, vc, bt, np.asarray(lens, np.int32),
+                          use_pallas=False)
+    want = _dense_ref(q, k_dense, v_dense, lens)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+def test_paged_pallas_kernel_interpret_matches_xla():
+    lens = [7, 3, 12]
+    B, H, Hkv, D, bs = 3, 4, 2, 8, 4
+    cache = PagedKVCache(24, bs, Hkv, D)
+    bt = cache.build_block_table(lens)
+    kc = jnp.asarray(rng.randn(24, bs, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rng.randn(24, bs, Hkv, D).astype(np.float32))
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    sl = jnp.asarray(lens, jnp.int32)
+    btj = jnp.asarray(bt, jnp.int32)
+    want = _paged_attention_xla(q, kc, vc, btj, sl, 1.0 / np.sqrt(D))
+    got = _paged_attention_pallas(q, kc, vc, btj, sl, 1.0 / np.sqrt(D),
+                                  interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_paged_cache_alloc_free():
+    cache = PagedKVCache(8, 4, 1, 4)
+    bt = cache.build_block_table([10, 5])   # 3 + 2 blocks
+    assert (bt >= 0).sum() == 5
+    assert len(cache._free) == 3
+    cache.free_sequence(bt[1])
+    assert len(cache._free) == 5
+    bt2 = cache.ensure_capacity(bt[:1], [12])   # needs 4th block for row 0
+    assert (bt2[0] >= 0).sum() == 4
+    with pytest.raises(RuntimeError, match="out of blocks"):
+        cache.build_block_table([100])
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    B, S, H, Hkv, D, bs = 2, 6, 4, 2, 8, 4
+    cache = PagedKVCache(16, bs, Hkv, D)
+    bt = cache.build_block_table([S + 4] * B)
+    kc, vc = cache.key_cache, cache.value_cache
+
+    qkv_p = rng.randn(B, S, (H + 2 * Hkv) * D).astype(np.float32)
+    out_p, kc, vc, sl = block_multihead_attention(
+        qkv_p, kc, vc, np.zeros(B, np.int32), bt, num_heads=H, head_dim=D)
+    assert out_p.shape == (B, S, H * D)
+    assert list(np.asarray(sl)) == [S, S]
+
+    # prefill numerics: causal self-attention over the 6 tokens
+    qkv_r = qkv_p.reshape(B, S, H + 2 * Hkv, D)
+    q, k, v = np.split(qkv_r, [H, H + Hkv], axis=2)
+    qh = np.moveaxis(q, 2, 1)
+    kh = np.repeat(np.moveaxis(k, 2, 1), H // Hkv, axis=1)
+    vh = np.repeat(np.moveaxis(v, 2, 1), H // Hkv, axis=1)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+    causal = np.tril(np.ones((S, S), bool))
+    s = np.where(causal, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True)); p /= p.sum(-1, keepdims=True)
+    want_p = np.moveaxis(np.einsum("bhqk,bhkd->bhqd", p, vh),
+                         1, 2).reshape(B, S, H * D)
+    np.testing.assert_allclose(np.asarray(out_p), want_p, rtol=1e-4,
+                               atol=1e-5)
+
+    # decode one token: attends to the 6 cached + itself
+    qkv_d = rng.randn(B, 1, (H + 2 * Hkv) * D).astype(np.float32)
+    out_d, kc, vc, sl = block_multihead_attention(
+        qkv_d, kc, vc, sl, bt, num_heads=H, head_dim=D)
+    assert out_d.shape == (B, 1, H * D)
+    assert list(np.asarray(sl)) == [S + 1, S + 1]
+
+    k_all, v_all = reconstruct_kv(kc, vc, bt, max_len=S + 1)
+    qd = qkv_d.reshape(B, 1, H + 2 * Hkv, D)[:, 0, :H]
+    want_d = _dense_ref(qd, np.asarray(k_all), np.asarray(v_all),
+                        [S + 1] * B).reshape(B, H * D)
+    np.testing.assert_allclose(np.asarray(out_d)[:, 0], want_d,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_masked_multihead_attention_steps():
+    B, H, D, max_len = 2, 2, 4, 8
+    cache = np.zeros((2, B, H, max_len, D), np.float32)
+    sl = np.zeros(B, np.int32)
+    ks, vs = [], []
+    outs = []
+    for step in range(3):
+        x = rng.randn(B, 3 * H * D).astype(np.float32)
+        xr = x.reshape(B, 3, H, D)
+        ks.append(xr[:, 1]); vs.append(xr[:, 2])
+        out, cache, sl = masked_multihead_attention(x, cache, sl,
+                                                    num_heads=H)
+        outs.append((xr[:, 0], np.asarray(out)))
+    assert list(np.asarray(sl)) == [3, 3]
+    # final step must equal dense attention over all 3 cached tokens
+    q_last = outs[-1][0]
+    k_dense = np.stack(ks, axis=1)   # [B, 3, H, D]
+    v_dense = np.stack(vs, axis=1)
+    want = _dense_ref(q_last, k_dense, v_dense, [3, 3]).reshape(B, H * D)
+    np.testing.assert_allclose(outs[-1][1], want, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_roundtrip(tmp_path):
+    from paddle_tpu import nn, jit
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit.api import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    path = str(tmp_path / "deploy" / "model")
+    jit.save(net, path,
+             input_spec=[InputSpec([None, 4], "float32", name="feats")])
+
+    cfg = Config()
+    cfg.set_model(path)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["feats"]
+    x = rng.randn(5, 4).astype(np.float32)
+    h = pred.get_input_handle("feats")
+    h.copy_from_cpu(x)
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (5, 3)
+    # numerics: same as direct forward
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    # list-style run
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_llama_generate_cache_matches_full_recompute():
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.array([[5, 17, 42], [7, 99, 3]], np.int64)
+
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
+    out_np = np.asarray(out._value)
+    assert out_np.shape == (2, 8)
+    np.testing.assert_array_equal(out_np[:, :3], ids)
+
+    # full-recompute greedy reference (no cache): must match exactly
+    cur = ids.copy()
+    from paddle_tpu.autograd import no_grad
+    with no_grad():
+        for _ in range(5):
+            logits = model(paddle.to_tensor(cur))
+            nxt = np.asarray(logits._value)[:, -1, :].argmax(-1)
+            cur = np.concatenate([cur, nxt[:, None].astype(np.int64)], 1)
+    np.testing.assert_array_equal(out_np, cur)
+
+
+def test_rope_position_ids_with_and_without_tables():
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rotary_position_embedding)
+    B, S, H, D = 1, 2, 1, 8
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    # positions [5, 6] via position_ids must equal slicing a longer run
+    q_long = np.zeros((B, 7, H, D), np.float32)
+    q_long[:, 5:7] = q
+    full, _, _ = fused_rotary_position_embedding(paddle.to_tensor(q_long))
+    got, _, _ = fused_rotary_position_embedding(
+        paddle.to_tensor(q), position_ids=np.array([5, 6], np.int32))
+    np.testing.assert_allclose(np.asarray(got._value),
+                               np.asarray(full._value)[:, 5:7],
+                               rtol=1e-5, atol=1e-6)
+    # precomputed [max_seq, dim] sin/cos tables + position_ids selects rows
+    pos_all = np.arange(16)[:, None]
+    inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+    emb = np.concatenate([pos_all * inv, pos_all * inv], -1)
+    got2, _, _ = fused_rotary_position_embedding(
+        paddle.to_tensor(q), sin=np.sin(emb).astype(np.float32),
+        cos=np.cos(emb).astype(np.float32),
+        position_ids=np.array([5, 6], np.int32))
+    np.testing.assert_allclose(np.asarray(got2._value),
+                               np.asarray(got._value), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_llama_generate_top_p_runs():
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            vocab_size=64, intermediate_size=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = np.array([[1, 2]], np.int64)
+    out = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                         top_p=0.9, temperature=0.8, seed=7)
+    arr = np.asarray(out._value)
+    assert arr.shape == (1, 6)
+    assert ((arr >= 0) & (arr < 64)).all()
