@@ -1,0 +1,253 @@
+"""paddle.device — device management + memory accounting.
+
+Parity: python/paddle/device/ (reference — set_device/get_device,
+device/cuda/* memory stats backed by paddle/fluid/memory/stats.h
+DEVICE_MEMORY_STAT macros, streams/events).
+
+TPU-native: allocation is PJRT's job, so stats come from the PJRT
+``Device.memory_stats()`` counters (bytes_in_use / peak_bytes_in_use on
+TPU).  Backends without allocator telemetry (XLA CPU) fall back to
+summing live on-device arrays, with the peak tracked at query points.
+Streams/events collapse to XLA's async dispatch: synchronize =
+drain-and-block.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..core.device import (CPUPlace, TPUPlace, CustomPlace, get_device,
+                           set_device, is_compiled_with_tpu)
+
+
+def is_compiled_with_cuda() -> bool:
+    return any(d.platform == "gpu" for d in jax.devices())
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device",
+           "device_count", "synchronize", "memory_allocated",
+           "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "reset_peak_memory_stats",
+           "cuda", "CPUPlace", "TPUPlace", "CustomPlace",
+           "Stream", "Event", "current_stream", "stream_guard"]
+
+
+def _device(dev: Optional[int] = None):
+    devs = jax.local_devices()
+    return devs[dev or 0]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return jax.device_count()
+    return sum(1 for d in jax.devices() if d.platform == device_type)
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work is done."""
+    jax.effects_barrier()
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# memory stats (reference: paddle/fluid/memory/stats.h — peak/current per
+# device, surfaced as paddle.device.cuda.max_memory_allocated)
+# ---------------------------------------------------------------------------
+_PEAK_FALLBACK = {}     # device index -> peak bytes seen at query points
+
+
+def _live_bytes(dev) -> int:
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for shard in arr.addressable_shards:
+                if shard.device == dev:
+                    total += shard.data.nbytes
+        except Exception:
+            pass
+    return total
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (parity:
+    paddle.device.cuda.memory_allocated)."""
+    d = _device(device)
+    stats = d.memory_stats()
+    if stats and "bytes_in_use" in stats:
+        cur = int(stats["bytes_in_use"])
+    else:
+        cur = _live_bytes(d)
+    key = d.id
+    _PEAK_FALLBACK[key] = max(_PEAK_FALLBACK.get(key, 0), cur)
+    return cur
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes (parity: paddle.device.cuda.max_memory_allocated).
+
+    On backends without allocator counters the peak is tracked at query
+    points — call memory_allocated() at the places you care about."""
+    d = _device(device)
+    stats = d.memory_stats()
+    if stats and "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    memory_allocated(device)
+    return _PEAK_FALLBACK.get(d.id, 0)
+
+
+def memory_reserved(device=None) -> int:
+    d = _device(device)
+    stats = d.memory_stats()
+    if stats:
+        for k in ("bytes_reserved", "pool_bytes", "bytes_limit"):
+            if k in stats:
+                return int(stats[k])
+    return memory_allocated(device)
+
+
+def max_memory_reserved(device=None) -> int:
+    return max(memory_reserved(device), max_memory_allocated(device))
+
+
+def reset_peak_memory_stats(device=None):
+    d = _device(device)
+    _PEAK_FALLBACK[d.id] = 0
+
+
+def reset_max_memory_allocated(device=None):
+    reset_peak_memory_stats(device)
+
+
+def reset_max_memory_reserved(device=None):
+    reset_peak_memory_stats(device)
+
+
+# ---------------------------------------------------------------------------
+# streams/events (XLA dispatch is already async; sync points map to
+# block_until_ready)
+# ---------------------------------------------------------------------------
+class Stream:
+    """Parity: paddle.device.Stream.  XLA runs one async dispatch stream
+    per device; explicit streams are ordering no-ops kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+class Event:
+    """Parity: paddle.device.Event."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        self._recorded = False
+        import time
+        self._time = time.perf_counter
+
+    def record(self, stream=None):
+        self._recorded = True
+        self._t0 = self._time()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event) -> float:
+        return max(0.0, (getattr(end_event, "_t0", self._time())
+                         - getattr(self, "_t0", 0.0)) * 1000.0)
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    return _CURRENT_STREAM
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# paddle.device.cuda namespace (reference API surface; maps to the
+# current accelerator)
+# ---------------------------------------------------------------------------
+class _CudaNamespace:
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        n = device_count("gpu")
+        return n if n else device_count("tpu")
+
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_reserved = staticmethod(memory_reserved)
+    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)
+    reset_max_memory_reserved = staticmethod(reset_max_memory_reserved)
+    synchronize = staticmethod(synchronize)
+    current_stream = staticmethod(current_stream)
+    stream_guard = staticmethod(stream_guard)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = _device(device)
+        class _Props:
+            name = d.device_kind
+            total_memory = (d.memory_stats() or {}).get("bytes_limit", 0)
+            major, minor = 0, 0
+            multi_processor_count = 1
+        return _Props()
+
+    @staticmethod
+    def empty_cache():
+        import gc
+        gc.collect()
+
+
+cuda = _CudaNamespace()

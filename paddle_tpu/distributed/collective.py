@@ -275,8 +275,12 @@ def broadcast(tensor: Tensor, src: int = 0, group: Group = None,
     arrays are already consistent; sharded tensors get replicated."""
     if _multiprocess() and getattr(tensor, "_placements", None) is None \
             and not _in_trace(tensor._value):
-        g = group or _world_group()
-        gsrc = g.get_group_rank(src) if g.get_group_rank(src) >= 0 else src
+        gsrc = _group_ranks(group).index(src) \
+            if src in _group_ranks(group) else None
+        if gsrc is None:
+            raise ValueError(
+                f"broadcast src={src} is not a member of the group "
+                f"{_group_ranks(group)}")
         out = _cross_process_apply(np.asarray(tensor._value),
                                    lambda a: a[gsrc], group,
                                    fn_key=("broadcast", int(gsrc)))
@@ -375,8 +379,12 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
         # let each process keep its slot
         ranks = _group_ranks(group)
         n = len(ranks)
-        my = ranks.index(get_rank()) if get_rank() in ranks else 0
-        gsrc = ranks.index(src) if src in ranks else 0
+        if src not in ranks or get_rank() not in ranks:
+            raise ValueError(
+                f"scatter src={src} / caller rank={get_rank()} must both "
+                f"be members of the group {ranks}")
+        my = ranks.index(get_rank())
+        gsrc = ranks.index(src)
         shape = (n,) + tuple(tensor.shape)
         if get_rank() == src and tensor_list:
             local = np.stack([np.asarray(t._value) for t in tensor_list])

@@ -1,0 +1,224 @@
+// Shared-memory ring buffer backing the multiprocess DataLoader.
+//
+// Parity: the reference's C++ data-pipeline core — shared-memory tensor
+// transport between dataloader worker processes and the trainer
+// (python/paddle/io/dataloader/worker.py + the buffered readers in
+// paddle/fluid/operators/reader/; shm serialization in
+// python/paddle/incubate/multiprocessing/reductions.py).
+//
+// Design: one single-producer/single-consumer byte ring per worker, in a
+// mmap'd POSIX shared-memory segment.  Lock-free: the producer owns
+// `head`, the consumer owns `tail` (C11 atomics, release/acquire).  The
+// payload protocol (array headers + raw buffers) lives in Python; this
+// file only moves bytes — memcpy into and out of the ring, wrapping at
+// the end, blocking with a short adaptive sleep when full/empty.
+//
+// Built once per machine with g++ -O2 -shared -fPIC (see shm_ring.py) and
+// driven through ctypes, so the GIL is released for the whole blocking
+// read/write — the decode thread never stalls the training loop.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  std::atomic<uint64_t> head;      // bytes written (producer cursor)
+  std::atomic<uint64_t> tail;      // bytes consumed (consumer cursor)
+  std::atomic<uint32_t> closed;    // producer hung up
+  uint32_t _pad;
+  uint64_t capacity;               // data area size in bytes
+};
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* data;
+  size_t map_size;
+};
+
+void sleep_ns(long ns) {
+  struct timespec ts = {0, ns};
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a ring of `capacity` data bytes in
+// the shm segment `name`. Returns an opaque handle or null.
+void* rb_open(const char* name, uint64_t capacity, int owner) {
+  size_t map_size = sizeof(RingHeader) + capacity;
+  int flags = owner ? (O_CREAT | O_RDWR | O_EXCL) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0 && owner && errno == EEXIST) {
+    shm_unlink(name);
+    fd = shm_open(name, flags, 0600);
+  }
+  if (fd < 0) return nullptr;
+  if (owner && ftruncate(fd, (off_t)map_size) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring;
+  r->hdr = reinterpret_cast<RingHeader*>(mem);
+  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_size = map_size;
+  if (owner) {
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+    r->hdr->closed.store(0, std::memory_order_relaxed);
+    r->hdr->capacity = capacity;
+  }
+  return r;
+}
+
+// Blocking write of n bytes; returns n, or -1 if the consumer vanished
+// (ring closed from the read side is not tracked: close is producer->
+// consumer only, the parent kills workers on teardown).
+int64_t rb_write(void* handle, const uint8_t* buf, uint64_t n) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  RingHeader* h = r->hdr;
+  const uint64_t cap = h->capacity;
+  if (n > cap) return -1;
+  uint64_t written = 0;
+  long backoff = 1000;  // 1us
+  while (written < n) {
+    uint64_t head = h->head.load(std::memory_order_relaxed);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t free_bytes = cap - (head - tail);
+    if (free_bytes == 0) {
+      sleep_ns(backoff);
+      if (backoff < 200000) backoff *= 2;  // cap at 200us
+      continue;
+    }
+    backoff = 1000;
+    uint64_t chunk = n - written;
+    if (chunk > free_bytes) chunk = free_bytes;
+    uint64_t pos = head % cap;
+    uint64_t until_wrap = cap - pos;
+    uint64_t c1 = chunk < until_wrap ? chunk : until_wrap;
+    memcpy(r->data + pos, buf + written, c1);
+    if (chunk > c1) memcpy(r->data, buf + written + c1, chunk - c1);
+    h->head.store(head + chunk, std::memory_order_release);
+    written += chunk;
+  }
+  return (int64_t)n;
+}
+
+// Blocking read of exactly n bytes; returns n, 0 on clean EOF (producer
+// closed and ring drained), -1 on protocol error.
+int64_t rb_read(void* handle, uint8_t* buf, uint64_t n) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  RingHeader* h = r->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t got = 0;
+  long backoff = 1000;
+  while (got < n) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t avail = head - tail;
+    if (avail == 0) {
+      if (h->closed.load(std::memory_order_acquire)) {
+        // drained and producer gone
+        return got == 0 ? 0 : -1;
+      }
+      sleep_ns(backoff);
+      if (backoff < 200000) backoff *= 2;
+      continue;
+    }
+    backoff = 1000;
+    uint64_t chunk = n - got;
+    if (chunk > avail) chunk = avail;
+    uint64_t pos = tail % cap;
+    uint64_t until_wrap = cap - pos;
+    uint64_t c1 = chunk < until_wrap ? chunk : until_wrap;
+    memcpy(buf + got, r->data + pos, c1);
+    if (chunk > c1) memcpy(buf + got + c1, r->data, chunk - c1);
+    h->tail.store(tail + chunk, std::memory_order_release);
+    got += chunk;
+  }
+  return (int64_t)got;
+}
+
+// Like rb_read but gives up after timeout_us of no progress, returning -2.
+// Lets the consumer interleave liveness checks on the producer process
+// instead of spinning forever on a worker that died without hanging up.
+int64_t rb_read_timeout(void* handle, uint8_t* buf, uint64_t n,
+                        uint64_t timeout_us) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  RingHeader* h = r->hdr;
+  const uint64_t cap = h->capacity;
+  uint64_t got = 0;
+  long backoff = 1000;
+  uint64_t waited_ns = 0;
+  const uint64_t limit_ns = timeout_us * 1000ull;
+  while (got < n) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t avail = head - tail;
+    if (avail == 0) {
+      if (h->closed.load(std::memory_order_acquire)) {
+        return got == 0 ? 0 : -1;
+      }
+      if (waited_ns >= limit_ns) return -2;
+      sleep_ns(backoff);
+      waited_ns += (uint64_t)backoff;
+      if (backoff < 200000) backoff *= 2;
+      continue;
+    }
+    backoff = 1000;
+    waited_ns = 0;  // progress resets the clock
+    uint64_t chunk = n - got;
+    if (chunk > avail) chunk = avail;
+    uint64_t pos = tail % cap;
+    uint64_t until_wrap = cap - pos;
+    uint64_t c1 = chunk < until_wrap ? chunk : until_wrap;
+    memcpy(buf + got, r->data + pos, c1);
+    if (chunk > c1) memcpy(buf + got + c1, r->data, chunk - c1);
+    h->tail.store(tail + chunk, std::memory_order_release);
+    got += chunk;
+  }
+  return (int64_t)got;
+}
+
+// Bytes currently readable (for polling round-robin consumers).
+uint64_t rb_readable(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  return r->hdr->head.load(std::memory_order_acquire) -
+         r->hdr->tail.load(std::memory_order_relaxed);
+}
+
+int rb_is_closed(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  return (int)r->hdr->closed.load(std::memory_order_acquire);
+}
+
+// Producer hang-up: consumer sees EOF after draining.
+void rb_close_write(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  r->hdr->closed.store(1, std::memory_order_release);
+}
+
+void rb_detach(void* handle) {
+  Ring* r = reinterpret_cast<Ring*>(handle);
+  munmap(r->hdr, r->map_size);
+  delete r;
+}
+
+void rb_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -73,6 +73,7 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -95,11 +96,96 @@ class DataLoader:
         return self.__iter__()
 
     def __iter__(self):
+        if self.num_workers > 0:
+            from .shm_ring import native_available
+            if self.use_shared_memory and native_available():
+                return self._iter_multiprocess()
+            if self._iterable:
+                return self._iter_iterable()
+            return self._iter_prefetch()
         if self._iterable:
             return self._iter_iterable()
-        if self.num_workers == 0:
-            return self._iter_single()
-        return self._iter_prefetch()
+        return self._iter_single()
+
+    # -- multi-process workers over native shm rings --------------------------
+    def _iter_multiprocess(self):
+        """Parity: _DataLoaderIterMultiProcess (dataloader_iter.py:358):
+        worker processes decode samples and stream them through
+        shared-memory rings; the parent collates.  Workers are real
+        processes (GIL-free decode), rings are the C++ SPSC byte rings in
+        io/_native/ringbuf.cc."""
+        import multiprocessing as mp
+        import os as _os
+        import pickle as _pickle
+        import uuid
+
+        from .shm_ring import ShmRing, decode_batch  # noqa: F811
+
+        W = self.num_workers
+        capacity = int(_os.environ.get("FLAGS_dataloader_ring_bytes",
+                                       str(64 << 20)))
+        session = f"pdtpu-{_os.getpid()}-{uuid.uuid4().hex[:8]}"
+        rings = [ShmRing(f"/{session}-{w}", capacity, owner=True)
+                 for w in range(W)]
+        if self._iterable:
+            shards = [None] * W
+        else:
+            batches = list(self.batch_sampler)
+            shards = [batches[w::W] for w in range(W)]
+
+        ctx = mp.get_context("fork")
+        from .worker import worker_loop
+        procs = []
+        for w in range(W):
+            p = ctx.Process(
+                target=worker_loop,
+                args=(self.dataset, shards[w], session, capacity, w, W,
+                      self.worker_init_fn, self._iterable,
+                      self.batch_size if self._iterable else None,
+                      self.drop_last if self._iterable else False),
+                daemon=True)
+            p.start()
+            procs.append(p)
+
+        alive = [True] * W
+        try:
+            w = 0
+            while any(alive):
+                if not alive[w]:
+                    w = (w + 1) % W
+                    continue
+                while True:
+                    try:
+                        msg = rings[w].recv_msg(timeout_us=1_000_000)
+                        break
+                    except ShmRing.Timeout:
+                        # watchdog: a SIGKILL'd/segfaulted worker never
+                        # hangs up the ring — detect it instead of
+                        # spinning forever (reference dataloader watchdog)
+                        if not procs[w].is_alive():
+                            raise RuntimeError(
+                                "DataLoader worker %d died unexpectedly "
+                                "(exitcode=%s)" % (w, procs[w].exitcode))
+                if msg is None:            # clean EOF from this worker
+                    alive[w] = False
+                    w = (w + 1) % W
+                    continue
+                if msg[:1] == b"E":
+                    raise RuntimeError(
+                        "DataLoader worker %d failed:\n%s"
+                        % (w, _pickle.loads(msg[1:])))
+                samples = decode_batch(msg[1:])
+                yield self.collate_fn(list(samples))
+                w = (w + 1) % W
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            for r in rings:
+                r.detach()
+                r.unlink()
 
     # -- single process ------------------------------------------------------
     def _iter_single(self):

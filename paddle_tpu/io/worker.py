@@ -1,0 +1,77 @@
+"""DataLoader worker process loop (parity:
+python/paddle/io/dataloader/worker.py — _worker_loop feeding shared-memory
+batches back to the trainer process).
+
+Each worker owns one native shm ring (io/_native/ringbuf.cc) as producer;
+the parent consumes rings round-robin so map-style batch order is
+preserved.  Workers ship raw sample pytrees (numpy buffers memcpy'd, no
+pickling of array data); the parent runs collate, keeping jax strictly out
+of forked children.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+import numpy as np
+
+
+def _to_plain(x):
+    """Strip framework Tensors down to numpy before crossing the process
+    boundary.  This is the only jax touch allowed in a forked child: a
+    host fetch of a CPU-resident array the child itself created (datasets
+    should prefer returning numpy; device state from the parent is never
+    exercised here)."""
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    if isinstance(x, (list, tuple)):
+        out = [_to_plain(i) for i in x]
+        return tuple(out) if isinstance(x, tuple) else out
+    if isinstance(x, dict):
+        return {k: _to_plain(v) for k, v in x.items()}
+    return x
+
+
+def worker_loop(dataset, my_batches, session, capacity, worker_id,
+                num_workers, worker_init_fn, iterable, batch_size,
+                drop_last):
+    """Entry point of a forked worker process."""
+    from . import dataloader as dl_mod
+    from .shm_ring import ShmRing, encode_batch
+
+    ring = ShmRing(f"/{session}-{worker_id}", capacity, owner=False)
+    dl_mod._worker_info = dl_mod.WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable:
+            # reference semantics (dataloader_iter.py + worker.py): every
+            # worker iterates the WHOLE dataset; de-duplication is the
+            # dataset's job via get_worker_info() (anything else would
+            # double-shard datasets that already split themselves)
+            batch = []
+            for sample in dataset:
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    ring.send_msg(b"B" + encode_batch(_to_plain(batch)))
+                    batch = []
+            if batch and not drop_last:
+                ring.send_msg(b"B" + encode_batch(_to_plain(batch)))
+        else:
+            for batch_idx in my_batches:
+                samples = [dataset[i] for i in batch_idx]
+                ring.send_msg(b"B" + encode_batch(_to_plain(samples)))
+    except KeyboardInterrupt:
+        pass
+    except BaseException:
+        try:
+            ring.send_msg(b"E" + pickle.dumps(traceback.format_exc()))
+        except Exception:
+            pass
+    finally:
+        ring.close_write()
+        ring.detach()
+        os._exit(0)   # skip atexit: the child must not tear down jax state

@@ -96,6 +96,15 @@ class PagedKVCache:
             out[i, :len(t)] = t
         return out
 
+    def append(self, k_new, v_new, block_tables, seq_lens):
+        """Donating in-place append: updates self.key_cache/value_cache
+        (the old buffers are consumed — use this, not the functional
+        write_kv_to_cache, when the pool object owns the arrays)."""
+        self.key_cache, self.value_cache = _write_decode_donated(
+            _val(k_new), _val(v_new), self.key_cache, self.value_cache,
+            jnp.asarray(np.asarray(block_tables), jnp.int32),
+            jnp.asarray(np.asarray(seq_lens), jnp.int32))
+
     def ensure_capacity(self, block_tables: np.ndarray,
                         seq_lens) -> np.ndarray:
         """Grow tables so every sequence can hold seq_len+1 tokens."""
@@ -115,9 +124,8 @@ class PagedKVCache:
 # ---------------------------------------------------------------------------
 # cache write (scatter one new token per sequence)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.jit, donate_argnums=(2, 3))
-def _write_decode(k_new, v_new, key_cache, value_cache, block_tables,
-                  seq_lens):
+def _write_decode_impl(k_new, v_new, key_cache, value_cache, block_tables,
+                       seq_lens):
     """k_new/v_new [B, Hkv, D]; writes at position seq_lens[b]."""
     bs = key_cache.shape[1]
     pos = seq_lens.astype(jnp.int32)
@@ -129,12 +137,35 @@ def _write_decode(k_new, v_new, key_cache, value_cache, block_tables,
     return key_cache, value_cache
 
 
+# functional API: callers keep ownership of all buffers (no donation);
+# PagedKVCache.append is the donating variant that rebinds its own state
+_write_decode = jax.jit(_write_decode_impl)
+_write_decode_donated = jax.jit(_write_decode_impl, donate_argnums=(2, 3))
+
+
+@jax.jit
+def _write_prefill(k_new, v_new, key_cache, value_cache, block_tables,
+                   seq_lens):
+    """k_new/v_new [B, S, Hkv, D]: one vectorized scatter for the whole
+    prompt (not S sequential dispatches)."""
+    B, S = k_new.shape[:2]
+    bs = key_cache.shape[1]
+    pos = seq_lens[:, None].astype(jnp.int32) + jnp.arange(
+        S, dtype=jnp.int32)[None, :]                      # [B, S]
+    blk = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [B, S]
+    off = pos % bs
+    key_cache = key_cache.at[blk, off].set(k_new)
+    value_cache = value_cache.at[blk, off].set(v_new)
+    return key_cache, value_cache
+
+
 def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
                       seq_lens):
-    """Append one token's K/V per sequence into its page slot.
+    """Append K/V into page slots; returns NEW (key_cache, value_cache)
+    without consuming the inputs.
 
-    k_new/v_new: [B, Hkv, D] (decode) or [B, S, Hkv, D] (prefill).
-    Returns updated (key_cache, value_cache)."""
+    k_new/v_new: [B, Hkv, D] (decode) or [B, S, Hkv, D] (prefill,
+    written starting at seq_lens)."""
     k_new, v_new = _val(k_new), _val(v_new)
     key_cache, value_cache = _val(key_cache), _val(value_cache)
     block_tables = jnp.asarray(np.asarray(block_tables), jnp.int32)
@@ -142,14 +173,8 @@ def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
     if k_new.ndim == 3:
         return _write_decode(k_new, v_new, key_cache, value_cache,
                              block_tables, seq_lens)
-    # prefill: write S tokens starting at seq_lens (usually 0)
-    B, S = k_new.shape[:2]
-    bs = key_cache.shape[1]
-    for s in range(S):   # python loop: prefill runs once per request
-        key_cache, value_cache = _write_decode(
-            k_new[:, s], v_new[:, s], key_cache, value_cache,
-            block_tables, seq_lens + s)
-    return key_cache, value_cache
+    return _write_prefill(k_new, v_new, key_cache, value_cache,
+                          block_tables, seq_lens)
 
 
 def reconstruct_kv(key_cache, value_cache, block_tables, max_len):

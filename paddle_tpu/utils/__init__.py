@@ -1,0 +1,83 @@
+"""paddle.utils (parity: python/paddle/utils/ — cpp_extension, unique_name,
+deprecated/try_import helpers)."""
+from __future__ import annotations
+
+import importlib
+import threading
+import warnings
+
+from . import cpp_extension
+
+__all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
+           "run_check"]
+
+
+class _UniqueName:
+    """Parity: paddle.utils.unique_name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._prefix = []
+
+    def generate(self, key: str = "") -> str:
+        with self._lock:
+            c = self._counters.get(key, 0)
+            self._counters[key] = c + 1
+        prefix = "".join(self._prefix)
+        return f"{prefix}{key}_{c}"
+
+    def guard(self, new_generator=None):
+        gen = self
+        prefix = new_generator or ""
+
+        class _G:
+            def __enter__(self):
+                gen._prefix.append(prefix)
+
+            def __exit__(self, *exc):
+                gen._prefix.pop()
+                return False
+
+        return _G()
+
+    def switch(self, new_generator=None):
+        self._counters = {}
+
+
+unique_name = _UniqueName()
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def wrap(fn):
+        def inner(*a, **k):
+            warnings.warn(
+                f"API {fn.__name__} is deprecated since {since}: {reason}. "
+                f"Use {update_to} instead.", DeprecationWarning)
+            return fn(*a, **k)
+        inner.__name__ = fn.__name__
+        inner.__doc__ = fn.__doc__
+        return inner
+    return wrap
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        msg = err_msg or (
+            f"'{module_name}' is required but not installed; this "
+            f"environment has no network egress, so vendor it or gate "
+            f"the feature.")
+        raise ImportError(msg) from None
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — is the framework usable?"""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).sum()
+    assert float(np.asarray(y._value)) == 8.0
+    n = paddle.device.device_count() if paddle.device else 1
+    print(f"PaddleTPU works! devices: {n}")

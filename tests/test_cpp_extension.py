@@ -1,0 +1,109 @@
+"""Custom C++ op extension: build, bind, trace, differentiate.
+
+Parity: paddle/extension.h PD_BUILD_OP + python/paddle/utils/cpp_extension/.
+"""
+import os
+import shutil
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++")
+
+SRC = textwrap.dedent("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" void my_gelu(const float** ins, int32_t n, float* out,
+                            int64_t numel) {
+      const float* x = ins[0];
+      for (int64_t i = 0; i < numel; ++i) {
+        out[i] = 0.5f * x[i] * (1.0f + std::tanh(0.7978845608f *
+                 (x[i] + 0.044715f * x[i] * x[i] * x[i])));
+      }
+    }
+    extern "C" void my_axpy(const float** ins, int32_t n, float* out,
+                            int64_t numel) {
+      const float* a = ins[0];
+      const float* b = ins[1];
+      for (int64_t i = 0; i < numel; ++i) out[i] = 2.0f * a[i] + b[i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ops(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ext") / "my_ops.cc"
+    src.write_text(SRC)
+    return cpp_extension.load("my_ops", [str(src)],
+                              functions=["my_gelu", "my_axpy"])
+
+
+def test_custom_op_forward(ops):
+    x = np.linspace(-2, 2, 12).astype(np.float32).reshape(3, 4)
+    out = ops.my_gelu(paddle.to_tensor(x))
+    want = 0.5 * x * (1 + np.tanh(0.7978845608 * (x + 0.044715 * x ** 3)))
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=1e-5)
+
+
+def test_custom_op_two_inputs(ops):
+    a = np.ones((2, 3), np.float32)
+    b = np.full((2, 3), 5.0, np.float32)
+    out = ops.my_axpy(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(out._value), 7.0)
+
+
+def test_custom_op_grad_via_def_vjp(ops):
+    ops.my_axpy.def_vjp(lambda a, b, g: (g * 2.0, g))
+    a = paddle.to_tensor(np.ones((4,), np.float32))
+    b = paddle.to_tensor(np.ones((4,), np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    ops.my_axpy(a, b).sum().backward()
+    np.testing.assert_allclose(np.asarray(a.grad._value), 2.0)
+    np.testing.assert_allclose(np.asarray(b.grad._value), 1.0)
+
+
+def test_custom_op_no_vjp_raises(ops):
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    x.stop_gradient = False
+    with pytest.raises(RuntimeError, match="def_vjp"):
+        ops.my_gelu(x).sum().backward()
+
+
+def test_custom_op_inside_jit(ops):
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(x):
+        return ops.my_gelu(x) * 2.0
+
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    out = f(paddle.to_tensor(x))
+    want = (0.5 * x * (1 + np.tanh(0.7978845608 *
+                                   (x + 0.044715 * x ** 3)))) * 2
+    np.testing.assert_allclose(np.asarray(out._value), want, rtol=1e-5)
+
+
+def test_missing_symbol_errors(tmp_path):
+    src = tmp_path / "empty.cc"
+    src.write_text("extern \"C\" void real_op(const float** i, int n, "
+                   "float* o, long long m) {}")
+    with pytest.raises(RuntimeError, match="does not export"):
+        cpp_extension.load("empty_ops", [str(src)], functions=["nope"])
+
+
+def test_unique_name_and_run_check(capsys):
+    from paddle_tpu.utils import unique_name, run_check
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard("block0/"):
+        c = unique_name.generate("fc")
+    assert c.startswith("block0/fc_")
+    run_check()
+    assert "works" in capsys.readouterr().out

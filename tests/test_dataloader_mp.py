@@ -1,0 +1,204 @@
+"""Multiprocess DataLoader over the native shm ring.
+
+Parity: python/paddle/io/dataloader/dataloader_iter.py:358
+(_DataLoaderIterMultiProcess) + worker.py; the transport is the C++ ring
+in paddle_tpu/io/_native/ringbuf.cc.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+from paddle_tpu.io.shm_ring import (ShmRing, encode_batch, decode_batch,
+                                    native_available)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native ring unavailable (no g++)")
+
+
+class ArrDataset(Dataset):
+    def __init__(self, n=32, dim=6):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class StreamDataset(IterableDataset):
+    """Self-sharding stream (reference semantics: each worker sees the
+    whole dataset and dedups via get_worker_info)."""
+
+    def __init__(self, n=20):
+        self.n = n
+
+    def __iter__(self):
+        from paddle_tpu.io import get_worker_info
+        info = get_worker_info()
+        wid = info.id if info else 0
+        W = info.num_workers if info else 1
+        for i in range(self.n):
+            if i % W == wid:
+                yield np.full((3,), i, np.float32)
+
+
+class BrokenDataset(Dataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros(2, np.float32)
+
+    def __len__(self):
+        return 8
+
+
+def test_ring_roundtrip_unit():
+    ring = ShmRing("/pdtpu-test-unit", 1 << 20, owner=True)
+    peer = ShmRing("/pdtpu-test-unit", 1 << 20, owner=False)
+    payload = encode_batch([np.arange(10, dtype=np.float32),
+                            {"k": np.ones((2, 3), np.int64)}, "tag", 7])
+    peer.send_msg(payload)
+    peer.send_msg(b"x" * 100)
+    got = decode_batch(ring.recv_msg())
+    np.testing.assert_array_equal(got[0], np.arange(10, dtype=np.float32))
+    np.testing.assert_array_equal(got[1]["k"], np.ones((2, 3), np.int64))
+    assert got[2] == "tag" and got[3] == 7
+    assert ring.recv_msg() == b"x" * 100
+    peer.close_write()
+    assert ring.recv_msg() is None      # EOF
+    peer.detach()
+    ring.detach()
+    ring.unlink()
+
+
+def test_ring_wraparound():
+    # capacity smaller than total traffic: writes must wrap correctly
+    ring = ShmRing("/pdtpu-test-wrap", 4096, owner=True)
+    peer = ShmRing("/pdtpu-test-wrap", 4096, owner=False)
+    import threading
+    msgs = [bytes([i % 256]) * 1500 for i in range(20)]
+
+    def produce():
+        for m in msgs:
+            peer.send_msg(m)
+        peer.close_write()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    got = []
+    while True:
+        m = ring.recv_msg()
+        if m is None:
+            break
+        got.append(m)
+    t.join()
+    assert got == msgs
+    peer.detach(); ring.detach(); ring.unlink()
+
+
+def test_mp_loader_matches_single_process():
+    ds = ArrDataset()
+    single = [(np.asarray(bx._value), np.asarray(by._value))
+              for bx, by in DataLoader(ds, batch_size=4, shuffle=False)]
+    multi = [(np.asarray(bx._value), np.asarray(by._value))
+             for bx, by in DataLoader(ds, batch_size=4, shuffle=False,
+                                      num_workers=2)]
+    assert len(single) == len(multi) == 8
+    for (sx, sy), (mx, my) in zip(single, multi):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+
+
+def test_mp_loader_three_workers_uneven():
+    ds = ArrDataset(n=26)   # 7 batches of 4 (drop_last=False)
+    out = list(DataLoader(ds, batch_size=4, num_workers=3))
+    assert len(out) == 7
+    # order preserved: first element of each batch is 4*i
+    firsts = [int(np.asarray(b[1]._value)[0]) for b in out]
+    assert firsts == [0, 4, 8, 12, 16, 20, 24]
+
+
+def test_mp_iterable_dataset():
+    out = list(DataLoader(StreamDataset(20), batch_size=3, num_workers=2))
+    vals = sorted(int(np.asarray(b._value)[0, 0]) for b in out)
+    # every stream element appears exactly once across batches
+    all_vals = sorted(int(v) for b in out
+                      for v in np.asarray(b._value)[:, 0])
+    assert all_vals == list(range(20))
+
+
+def test_mp_iterable_unsharded_duplicates():
+    # a stream that does NOT consult get_worker_info is seen once per
+    # worker (reference behavior — implicit sharding would break
+    # self-sharding datasets)
+    class Naive(IterableDataset):
+        def __iter__(self):
+            yield from (np.full((1,), i, np.float32) for i in range(4))
+
+    out = list(DataLoader(Naive(), batch_size=2, num_workers=2))
+    total = sorted(int(v) for b in out for v in np.asarray(b._value)[:, 0])
+    assert total == [0, 0, 1, 1, 2, 2, 3, 3]
+
+
+def test_mp_dead_worker_detected():
+    import os as _os
+
+    class KillerDataset(Dataset):
+        def __getitem__(self, i):
+            if i == 3:
+                _os.kill(_os.getpid(), 9)   # simulate OOM-killer/segfault
+            return np.zeros(2, np.float32)
+
+        def __len__(self):
+            return 8
+
+    with pytest.raises(RuntimeError, match="died unexpectedly"):
+        list(DataLoader(KillerDataset(), batch_size=2, num_workers=2))
+
+
+def test_mp_worker_error_propagates():
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(DataLoader(BrokenDataset(), batch_size=4, num_workers=2))
+
+
+def test_mp_worker_info_and_init_fn(tmp_path):
+    marker = str(tmp_path / "init")
+
+    def init_fn(worker_id):
+        with open(marker + str(worker_id), "w") as f:
+            f.write("ok")
+
+    class InfoDataset(Dataset):
+        def __getitem__(self, i):
+            from paddle_tpu.io import get_worker_info
+            info = get_worker_info()
+            return np.asarray([i, info.id], np.int64)
+
+        def __len__(self):
+            return 8
+
+    out = list(DataLoader(InfoDataset(), batch_size=2, num_workers=2,
+                          worker_init_fn=init_fn))
+    import os
+    assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
+    # batch i was produced by worker i % 2
+    for i, b in enumerate(out):
+        assert int(np.asarray(b._value)[0, 1]) == i % 2
+
+
+def test_mp_loader_with_tensor_transform():
+    # dataset whose samples are framework Tensors (e.g. vision ToTensor):
+    # workers strip them to numpy, parent re-collates to Tensors
+    class TensorDataset(Dataset):
+        def __getitem__(self, i):
+            return paddle.to_tensor(np.full((2, 2), float(i), np.float32))
+
+        def __len__(self):
+            return 6
+
+    out = list(DataLoader(TensorDataset(), batch_size=2, num_workers=2))
+    assert len(out) == 3
+    np.testing.assert_allclose(np.asarray(out[0]._value)[1], 1.0)

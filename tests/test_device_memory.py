@@ -1,0 +1,60 @@
+"""paddle.device namespace: memory stats, streams/events, cuda shims.
+
+Parity: python/paddle/device/, paddle/fluid/memory/stats.h surface.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+
+
+def test_memory_allocated_tracks_live_arrays():
+    device.reset_peak_memory_stats()
+    base = device.memory_allocated()
+    keep = paddle.to_tensor(np.zeros((256, 1024), np.float32))  # 1 MiB
+    cur = device.memory_allocated()
+    assert cur >= base + 1024 * 1024
+    peak = device.max_memory_allocated()
+    assert peak >= cur
+    del keep
+
+
+def test_peak_survives_free():
+    device.reset_peak_memory_stats()
+    t = paddle.to_tensor(np.zeros((512, 1024), np.float32))  # 2 MiB
+    device.memory_allocated()           # sample while alive
+    peak_live = device.max_memory_allocated()
+    del t
+    assert device.max_memory_allocated() >= peak_live
+
+
+def test_device_queries():
+    assert device.device_count() >= 1
+    assert "cpu" in device.get_all_device_type() or \
+        "tpu" in device.get_all_device_type()
+    assert len(device.get_available_device()) == device.device_count()
+
+
+def test_stream_event_api():
+    s = device.current_stream()
+    e1 = s.record_event()
+    x = paddle.to_tensor(np.ones((64, 64), np.float32))
+    y = x @ x
+    s.synchronize()
+    e2 = device.Event()
+    e2.record(s)
+    assert e1.query()
+    assert e1.elapsed_time(e2) >= 0.0
+    with device.stream_guard(device.Stream()):
+        z = y + 1
+    assert z.shape == [64, 64]
+
+
+def test_cuda_namespace_shims():
+    assert device.cuda.memory_allocated() >= 0
+    assert device.cuda.max_memory_allocated() >= 0
+    device.cuda.synchronize()
+    props = device.cuda.get_device_properties()
+    assert isinstance(props.name, str)
+    device.cuda.empty_cache()
+    device.cuda.reset_max_memory_allocated()

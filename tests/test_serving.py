@@ -102,6 +102,33 @@ def test_paged_pallas_kernel_interpret_matches_xla():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_paged_cache_append_updates_owner_state():
+    B, Hkv, D, bs = 2, 2, 8, 4
+    cache = PagedKVCache(8, bs, Hkv, D)
+    bt = cache.build_block_table([1, 1])
+    k = rng.randn(B, Hkv, D).astype(np.float32)
+    v = rng.randn(B, Hkv, D).astype(np.float32)
+    cache.append(k, v, bt, np.zeros(B, np.int32))
+    k_back, _ = reconstruct_kv(cache.key_cache, cache.value_cache, bt, 1)
+    np.testing.assert_allclose(np.asarray(k_back)[:, 0], k, rtol=1e-6)
+
+
+def test_prefill_write_vectorized_matches_stepwise():
+    B, S, Hkv, D, bs = 2, 6, 2, 4, 4
+    cache = PagedKVCache(8, bs, Hkv, D)
+    bt = cache.build_block_table([S, S])
+    k = rng.randn(B, S, Hkv, D).astype(np.float32)
+    v = rng.randn(B, S, Hkv, D).astype(np.float32)
+    kc, vc = write_kv_to_cache(k, v, cache.key_cache, cache.value_cache,
+                               bt, np.zeros(B, np.int32))
+    kc2, vc2 = cache.key_cache, cache.value_cache
+    for s in range(S):
+        kc2, vc2 = write_kv_to_cache(k[:, s], v[:, s], kc2, vc2, bt,
+                                     np.asarray([s, s], np.int32))
+    np.testing.assert_array_equal(np.asarray(kc), np.asarray(kc2))
+    np.testing.assert_array_equal(np.asarray(vc), np.asarray(vc2))
+
+
 def test_paged_cache_alloc_free():
     cache = PagedKVCache(8, 4, 1, 4)
     bt = cache.build_block_table([10, 5])   # 3 + 2 blocks
