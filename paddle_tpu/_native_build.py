@@ -1,0 +1,48 @@
+"""Shared build-once helper for native (.cc -> .so) components.
+
+Used by io/shm_ring.py (dataloader ring) and utils/cpp_extension.py
+(custom ops): content-hash keyed cache under ~/.cache/paddle_tpu, atomic
+install via a pid-unique temp file so concurrent builders (multi-rank
+launch, pytest-xdist) never corrupt each other.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_shared_lib(name: str, sources: Sequence[str],
+                     extra_cflags: Optional[List[str]] = None,
+                     cache_subdir: str = "native",
+                     verbose: bool = False) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cflags or []).encode())
+    cache = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", cache_subdir)
+    os.makedirs(cache, exist_ok=True)
+    so_path = os.path.join(cache, f"{name}-{h.hexdigest()[:16]}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = f"{so_path}.tmp.{os.getpid()}"
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+           + list(extra_cflags or []) + list(sources) + ["-o", tmp])
+    if verbose:
+        print("building native lib:", " ".join(cmd))
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose,
+                       text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        msg = getattr(e, "stderr", None) or str(e)
+        raise NativeBuildError(f"building {name}.so failed: {msg}") \
+            from None
+    os.replace(tmp, so_path)      # atomic: last concurrent builder wins
+    return so_path

@@ -1,0 +1,9 @@
+"""paddle.audio (parity: python/paddle/audio/ — functional/functional.py
+mel/fbank/dct helpers, features/layers.py Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC, window functions)."""
+from . import functional, features
+from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
+                       MFCC)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,89 @@
+"""Audio feature layers (parity: python/paddle/audio/features/layers.py —
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .. import signal as _signal
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = F.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length,
+                            self.win_length, window=self.fft_window,
+                            center=self.center, pad_mode=self.pad_mode)
+        mag = jnp.abs(spec._value)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor._from_value(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        self.fbank_matrix = F.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)._value     # [..., freq, time]
+        mel = jnp.matmul(self.fbank_matrix._value, spec)
+        return Tensor._from_value(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **kw):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self._mel(x), self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 norm: str = "ortho", **kw):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr=sr, **kw)
+        n_mels = self._log_mel._mel.fbank_matrix.shape[0]
+        self.dct_matrix = F.create_dct(n_mfcc, n_mels, norm)
+
+    def forward(self, x):
+        log_mel = self._log_mel(x)._value      # [..., n_mels, time]
+        mfcc = jnp.einsum("mk,...mt->...kt", self.dct_matrix._value,
+                          log_mel)
+        return Tensor._from_value(mfcc)

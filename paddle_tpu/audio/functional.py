@@ -1,0 +1,134 @@
+"""Audio functional ops (parity:
+python/paddle/audio/functional/functional.py — hz_to_mel :24, mel_to_hz
+:80, mel_frequencies :125, fft_frequencies :165, compute_fbank_matrix
+:188, power_to_db :261, create_dct :305; window functions in window.py).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Parity: functional.py:24."""
+    scalar = not isinstance(freq, (Tensor, jnp.ndarray, np.ndarray))
+    f = jnp.asarray(_v(freq), jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(
+                            jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor._from_value(mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """Parity: functional.py:80."""
+    scalar = not isinstance(mel, (Tensor, jnp.ndarray, np.ndarray))
+    m = jnp.asarray(_v(mel), jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return float(hz) if scalar else Tensor._from_value(hz)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """Parity: functional.py:125."""
+    min_mel = hz_to_mel(f_min, htk)
+    max_mel = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(min_mel, max_mel, n_mels)
+    return mel_to_hz(Tensor._from_value(mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32") -> Tensor:
+    """Parity: functional.py:165."""
+    return Tensor._from_value(
+        jnp.linspace(0, sr / 2.0, 1 + n_fft // 2))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float32") -> Tensor:
+    """Triangular mel filterbank [n_mels, 1+n_fft//2]
+    (parity: functional.py:188)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor._from_value(weights)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Parity: functional.py:261."""
+    s = jnp.asarray(_v(spect), jnp.float32)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor._from_value(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II matrix [n_mels, n_mfcc] (parity: functional.py:305)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k) * 2.0
+    if norm is None:
+        dct = dct / 2.0
+    else:
+        assert norm == "ortho"
+        dct = dct.at[:, 0].multiply(math.sqrt(1.0 / (4 * n_mels)))
+        dct = dct.at[:, 1:].multiply(math.sqrt(1.0 / (2 * n_mels)))
+    return Tensor._from_value(dct)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32") -> Tensor:
+    """Parity: window.py get_window (hann/hamming/blackman/kaiser/
+    taylor subset over scipy)."""
+    import scipy.signal as ss
+    w = ss.get_window(window, win_length, fftbins=fftbins)
+    return Tensor(np.asarray(w, np.float32))

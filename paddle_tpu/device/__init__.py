@@ -76,6 +76,7 @@ def synchronize(device=None):
 # device, surfaced as paddle.device.cuda.max_memory_allocated)
 # ---------------------------------------------------------------------------
 _PEAK_FALLBACK = {}     # device index -> peak bytes seen at query points
+_PEAK_BASELINE = {}     # device index -> PJRT peak counter at last reset
 
 
 def _live_bytes(dev) -> int:
@@ -108,11 +109,21 @@ def max_memory_allocated(device=None) -> int:
     """Peak allocated bytes (parity: paddle.device.cuda.max_memory_allocated).
 
     On backends without allocator counters the peak is tracked at query
-    points — call memory_allocated() at the places you care about."""
+    points — call memory_allocated() at the places you care about.  PJRT
+    exposes no peak-reset, so after reset_peak_memory_stats() the device
+    counter only counts if it rises above its value at reset; otherwise
+    current usage sampled at query points is the post-reset peak."""
     d = _device(device)
     stats = d.memory_stats()
     if stats and "peak_bytes_in_use" in stats:
-        return int(stats["peak_bytes_in_use"])
+        peak = int(stats["peak_bytes_in_use"])
+        base = _PEAK_BASELINE.get(d.id)
+        if base is None:
+            return peak
+        sampled = max(_PEAK_FALLBACK.get(d.id, 0),
+                      int(stats.get("bytes_in_use", 0)))
+        _PEAK_FALLBACK[d.id] = sampled
+        return peak if peak > base else sampled
     memory_allocated(device)
     return _PEAK_FALLBACK.get(d.id, 0)
 
@@ -134,6 +145,9 @@ def max_memory_reserved(device=None) -> int:
 def reset_peak_memory_stats(device=None):
     d = _device(device)
     _PEAK_FALLBACK[d.id] = 0
+    stats = d.memory_stats()
+    if stats and "peak_bytes_in_use" in stats:
+        _PEAK_BASELINE[d.id] = int(stats["peak_bytes_in_use"])
 
 
 def reset_max_memory_allocated(device=None):

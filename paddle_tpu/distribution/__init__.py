@@ -1,0 +1,913 @@
+"""paddle.distribution (parity: python/paddle/distribution/ — Distribution
+base, Normal/Uniform/Categorical/Bernoulli/Beta/Dirichlet/Gamma/
+Exponential/Laplace/LogNormal/Gumbel/Multinomial/Geometric/Poisson,
+Transform family + TransformedDistribution, Independent,
+kl_divergence/register_kl registry).
+
+TPU-native: every method is a pure jnp function over Tensor values —
+sample goes through the framework RNG (traced fold-in keys), log_prob and
+friends compile into the surrounding XLA module.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.tensor import Tensor
+from ..ops.random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace",
+           "LogNormal", "Gumbel", "Multinomial", "Geometric", "Poisson",
+           "kl_divergence", "register_kl", "Transform", "AffineTransform",
+           "ExpTransform", "SigmoidTransform", "TanhTransform",
+           "ChainTransform", "AbsTransform", "PowerTransform",
+           "SoftmaxTransform", "StickBreakingTransform",
+           "TransformedDistribution", "Independent"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, (jnp.ndarray, jax.Array)) else x
+
+
+def _t(x):
+    return Tensor._from_value(jnp.asarray(x))
+
+
+def _shape(sample_shape, base_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(base_shape)
+
+
+class Distribution:
+    """Parity: paddle.distribution.Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        if hasattr(self, "rsample"):
+            return _t(jax.lax.stop_gradient(self.rsample(shape)._value))
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Parity: paddle.distribution.Normal."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _t(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(next_key(),
+                                _shape(shape, self.batch_shape))
+        return _t(self.loc + self.scale * eps)
+
+    def sample(self, shape=()):
+        return _t(jax.lax.stop_gradient(self.rsample(shape)._value))
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return _t(-((v - self.loc) ** 2) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def cdf(self, value):
+        return _t(0.5 * (1 + jsp.erf(
+            (_v(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        return _t(self.loc + self.scale * math.sqrt(2)
+                  * jsp.erfinv(2 * _v(value) - 1))
+
+
+class LogNormal(Normal):
+    def rsample(self, shape=()):
+        return _t(jnp.exp(super().rsample(shape)._value))
+
+    sample = rsample
+
+    @property
+    def mean(self):
+        return _t(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _t((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def log_prob(self, value):
+        v = _v(value)
+        logv = jnp.log(v)
+        return _t(super().log_prob(_t(logv))._value - logv)
+
+    def entropy(self):
+        return _t(super().entropy()._value + self.loc)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.low), jnp.shape(self.high)))
+
+    @property
+    def mean(self):
+        return _t((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _t((self.high - self.low) ** 2 / 12)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape))
+        return _t(self.low + (self.high - self.low) * u)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _t(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low)
+                  + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _t(self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape))
+        return _t((u < self.probs).astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reference parity)."""
+        g = jax.random.logistic(next_key(),
+                                _shape(shape, self.batch_shape))
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        return _t(jax.nn.sigmoid((logits + g) / temperature))
+
+    def log_prob(self, value):
+        v = _v(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _t(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _t(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, name=None):
+        # reference semantics: `logits` is UNNORMALIZED PROBABILITIES
+        # (non-negative, normalized by their sum); under a trace (where
+        # sign can't be inspected) fall back to log_softmax
+        self.logits = _v(logits)
+        try:
+            nonneg = bool(np.all(np.asarray(self.logits) >= 0))
+        except Exception:          # traced value
+            nonneg = False
+        if nonneg:
+            self._log_p = jnp.log(jnp.clip(
+                self.logits / jnp.sum(self.logits, -1, keepdims=True),
+                1e-12, 1.0))
+        else:
+            self._log_p = jax.nn.log_softmax(self.logits)
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs_normalized(self):
+        return jnp.exp(self._log_p)
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        idx = jax.random.categorical(
+            next_key(), self._log_p, shape=_shape(
+                shape, self.batch_shape))
+        return _t(idx.astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        logp = jnp.broadcast_to(self._log_p,
+                                v.shape + self._log_p.shape[-1:])
+        return _t(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return _t(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return _t(-jnp.sum(p * self._log_p, -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs)[:-1],
+                         jnp.shape(self.probs)[-1:])
+
+    @property
+    def mean(self):
+        return _t(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        logp = jnp.log(jnp.clip(self.probs, 1e-12, 1.0))
+        idx = jax.random.categorical(
+            next_key(), logp,
+            shape=_shape(shape, self.batch_shape)
+            + (self.total_count,))
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(idx, k).sum(-2)
+        return _t(counts)
+
+    def log_prob(self, value):
+        v = _v(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-12, 1.0))
+        return _t(jsp.gammaln(self.total_count + 1.0)
+                  - jnp.sum(jsp.gammaln(v + 1.0), -1)
+                  + jnp.sum(v * logp, -1))
+
+    def entropy(self):
+        # no closed form; Monte-Carlo like the reference's approximation
+        s = self.sample((128,))
+        return _t(-jnp.mean(self.log_prob(s)._value, 0))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.alpha), jnp.shape(self.beta)))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _t(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def rsample(self, shape=()):
+        return _t(jax.random.beta(next_key(), self.alpha, self.beta,
+                                  _shape(shape, self.batch_shape)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t((self.alpha - 1) * jnp.log(v)
+                  + (self.beta - 1) * jnp.log1p(-v)
+                  - _betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return _t(_betaln(a, b) - (a - 1) * jsp.digamma(a)
+                  - (b - 1) * jsp.digamma(b)
+                  + (a + b - 2) * jsp.digamma(a + b))
+
+
+def _betaln(a, b):
+    return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    @property
+    def mean(self):
+        return _t(self.concentration
+                  / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        a = self.concentration
+        return _t(a * (a0 - a) / (a0 ** 2 * (a0 + 1)))
+
+    def rsample(self, shape=()):
+        return _t(jax.random.dirichlet(
+            next_key(), self.concentration,
+            _shape(shape, self.batch_shape)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        a = self.concentration
+        return _t(jnp.sum((a - 1) * jnp.log(v), -1)
+                  + jsp.gammaln(jnp.sum(a, -1))
+                  - jnp.sum(jsp.gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        return _t(jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+                  + (a0 - k) * jsp.digamma(a0)
+                  - jnp.sum((a - 1) * jsp.digamma(a), -1))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.concentration), jnp.shape(self.rate)))
+
+    @property
+    def mean(self):
+        return _t(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _t(self.concentration / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        g = jax.random.gamma(next_key(), self.concentration,
+                             _shape(shape, self.batch_shape))
+        return _t(g / self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        a, b = self.concentration, self.rate
+        return _t(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                  - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _t(a - jnp.log(b) + jsp.gammaln(a)
+                  + (1 - a) * jsp.digamma(a))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _t(1.0 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(next_key(),
+                                   _shape(shape, self.batch_shape))
+        return _t(e / self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v,
+                            -jnp.inf))
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(2 * self.scale ** 2)
+
+    @property
+    def stddev(self):
+        return _t(math.sqrt(2) * self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape),
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return _t(self.loc - self.scale * jnp.sign(u)
+                  * jnp.log1p(-2 * jnp.abs(u)))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * self.scale)
+                  + jnp.zeros(self.batch_shape))
+
+    def cdf(self, value):
+        v = _v(value)
+        z = (v - self.loc) / self.scale
+        return _t(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        p = _v(value)
+        term = p - 0.5
+        return _t(self.loc - self.scale * jnp.sign(term)
+                  * jnp.log1p(-2 * jnp.abs(term)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return _t(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return _t(math.pi ** 2 / 6 * self.scale ** 2)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(next_key(),
+                              _shape(shape, self.batch_shape))
+        return _t(self.loc + self.scale * g)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _t(jnp.log(self.scale) + 1 + np.euler_gamma
+                  + jnp.zeros(self.batch_shape))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.probs)
+
+    @property
+    def variance(self):
+        return _t((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1.0)
+        return _t(jnp.ceil(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _v(value)
+        return _t((k - 1) * jnp.log1p(-self.probs)
+                  + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _t(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _t(self.rate)
+
+    @property
+    def variance(self):
+        return _t(self.rate)
+
+    def sample(self, shape=()):
+        return _t(jax.random.poisson(
+            next_key(), self.rate,
+            _shape(shape, self.batch_shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _v(value)
+        return _t(k * jnp.log(self.rate) - self.rate
+                  - jsp.gammaln(k + 1.0))
+
+    def entropy(self):
+        s = self.sample((128,))
+        return _t(-jnp.mean(self.log_prob(s)._value, 0))
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+class Transform:
+    """Parity: paddle.distribution.Transform."""
+
+    def forward(self, x):
+        return _t(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._fldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _t(-self._fldj(self._inverse(_v(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not bijective")
+
+
+class StickBreakingTransform(Transform):
+    def _forward(self, x):
+        # R^k -> k+1 simplex
+        z = jax.nn.sigmoid(x - jnp.log(
+            x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)))
+        zp = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)],
+                             -1)
+        rest = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype),
+             jnp.cumprod(1 - z, -1)], -1)
+        return zp * rest
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype),
+             jnp.cumsum(y[..., :-1], -1)], -1)[..., :k]
+        z = y[..., :k] / jnp.clip(1 - cum, 1e-12)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(
+            k - jnp.arange(k, dtype=y.dtype))
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(k - jnp.arange(k, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        # sum of log sigma(xo) + log(1-sigma(xo)) + cumulative stick mass
+        return jnp.sum(
+            -jax.nn.softplus(-xo) - jax.nn.softplus(xo)
+            + jnp.concatenate(
+                [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+                 jnp.cumsum(jnp.log1p(-z[..., :-1]), -1)], -1), -1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Parity: paddle.distribution.TransformedDistribution."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (transforms if isinstance(transforms, (list,
+                                                                 tuple))
+                           else [transforms])
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._value
+        for t in self.transforms:
+            x = t._forward(x)
+        return _t(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._value
+        for t in self.transforms:
+            x = t._forward(x)
+        return _t(x)
+
+    def log_prob(self, value):
+        y = _v(value)
+        ldj = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = ldj + t._fldj(x)
+            y = x
+        return _t(self.base.log_prob(_t(y))._value - ldj)
+
+
+class Independent(Distribution):
+    """Parity: paddle.distribution.Independent — reinterprets batch dims
+    as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._value
+        return _t(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = self.base.entropy()._value
+        return _t(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+# ---------------------------------------------------------------------------
+# KL registry
+# ---------------------------------------------------------------------------
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Parity: paddle.distribution.register_kl."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    matches = [((pc, qc), fn) for (pc, qc), fn in _KL_REGISTRY.items()
+               if isinstance(p, pc) and isinstance(q, qc)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    # most-derived registration wins (subclass KLs shadow base ones)
+    (pc, qc), fn = min(
+        matches, key=lambda m: (type(p).__mro__.index(m[0][0])
+                                + type(q).__mro__.index(m[0][1])))
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _t(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_p)
+    return _t(jnp.sum(pp * (p._log_p - q._log_p), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return _t(pp * (jnp.log(pp) - jnp.log(qq))
+              + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    return _t(_betaln(q.alpha, q.beta) - _betaln(p.alpha, p.beta)
+              + (p.alpha - q.alpha) * jsp.digamma(p.alpha)
+              + (p.beta - q.beta) * jsp.digamma(p.beta)
+              + (q.alpha - p.alpha + q.beta - p.beta)
+              * jsp.digamma(p.alpha + p.beta))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    return _t(jsp.gammaln(a0) - jnp.sum(jsp.gammaln(a), -1)
+              - jsp.gammaln(jnp.sum(b, -1)) + jnp.sum(jsp.gammaln(b), -1)
+              + jnp.sum((a - b) * (jsp.digamma(a)
+                                   - jsp.digamma(a0)[..., None]), -1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a, b = p.concentration, p.rate
+    c, d = q.concentration, q.rate
+    return _t((a - c) * jsp.digamma(a) - jsp.gammaln(a) + jsp.gammaln(c)
+              + c * (jnp.log(b) - jnp.log(d)) + a * (d - b) / b)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _t(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    delta = jnp.abs(p.loc - q.loc) / q.scale
+    return _t(-jnp.log(scale_ratio) + scale_ratio
+              * jnp.exp(-jnp.abs(p.loc - q.loc) / p.scale)
+              + delta - 1)

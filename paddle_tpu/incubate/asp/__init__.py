@@ -1,0 +1,218 @@
+"""Automatic SParsity (2:4 structured sparsity).
+
+Parity: python/paddle/incubate/asp/asp.py (reference — mask generation
+utils.py get_mask_1d/2d_greedy/best, prune_model, decorate, and the
+supported-layer registry supported_layer_list.py).
+
+TPU-native: masks are plain arrays multiplied into weights; the sparse
+speedup itself is future XLA/sparsity work — what this module guarantees
+(like the reference on non-Ampere hardware) is N:M PATTERN correctness:
+pruned training keeps the mask through optimizer steps.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ... import nn
+
+__all__ = ["calculate_density", "check_mask_1d", "check_mask_2d",
+           "create_mask", "get_mask_1d", "get_mask_2d_greedy",
+           "get_mask_2d_best", "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers", "ASPHelper", "MaskAlgo"]
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (parity: asp.py calculate_density)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(t: np.ndarray, n=2, m=4) -> np.ndarray:
+    """Keep the n largest of every m consecutive elements (parity:
+    utils.py get_mask_1d)."""
+    flat = t.reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, m))
+    mask = np.zeros_like(groups, dtype=bool)
+    idx = np.argsort(-groups, axis=1)[:, :n]
+    np.put_along_axis(mask, idx, True, axis=1)
+    mask = mask.reshape(-1)
+    if pad:
+        mask = mask[:-pad]
+    return mask.reshape(t.shape).astype(t.dtype)
+
+
+def check_mask_1d(t: np.ndarray, n=2, m=4) -> bool:
+    flat = np.asarray(t).reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = flat.reshape(-1, m)
+    return bool(np.all(np.count_nonzero(groups, axis=1) <= n))
+
+
+def get_mask_2d_greedy(t: np.ndarray, n=2, m=4) -> np.ndarray:
+    """Greedy 2D n:m mask over m x m patches (parity:
+    utils.py get_mask_2d_greedy)."""
+    mat = np.asarray(t)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    mask = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            patch = padded[bi:bi + m, bj:bj + m]
+            pmask = np.zeros((m, m), dtype=bool)
+            order = np.argsort(-patch, axis=None)
+            rows = np.zeros(m, np.int64)
+            cols = np.zeros(m, np.int64)
+            for flat_idx in order:
+                r, c = divmod(int(flat_idx), m)
+                if rows[r] < n and cols[c] < n:
+                    pmask[r, c] = True
+                    rows[r] += 1
+                    cols[c] += 1
+            mask[bi:bi + m, bj:bj + m] = pmask
+    return mask[:h, :w].astype(mat.dtype)
+
+
+def get_mask_2d_best(t: np.ndarray, n=2, m=4) -> np.ndarray:
+    """Exhaustive best 2D mask for small m (parity: get_mask_2d_best);
+    falls back to greedy for m > 4 (search space explodes)."""
+    if m > 4:
+        return get_mask_2d_greedy(t, n, m)
+    mat = np.asarray(t)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    # all per-row n-of-m patterns
+    patterns = [np.array(p) for p in itertools.product(
+        *[[0, 1]] * m) if sum(p) == n]
+    mask = np.zeros_like(padded, dtype=bool)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            patch = padded[bi:bi + m, bj:bj + m]
+            best, best_score = None, -1.0
+            for combo in itertools.product(range(len(patterns)), repeat=m):
+                pm = np.stack([patterns[i] for i in combo])
+                if not np.all(pm.sum(0) <= n):
+                    continue
+                score = float((patch * pm).sum())
+                if score > best_score:
+                    best, best_score = pm, score
+            mask[bi:bi + m, bj:bj + m] = best.astype(bool)
+    return mask[:h, :w].astype(mat.dtype)
+
+
+def check_mask_2d(t: np.ndarray, n=2, m=4) -> bool:
+    mat = np.asarray(t)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(mat, ((0, ph), (0, pw)))
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            patch = padded[bi:bi + m, bj:bj + m]
+            nz_r = np.count_nonzero(patch, axis=1)
+            nz_c = np.count_nonzero(patch, axis=0)
+            if np.any(nz_r > n) or np.any(nz_c > n):
+                return False
+    return True
+
+
+class MaskAlgo:
+    MASK_1D = "mask_1d"
+    MASK_2D_GREEDY = "mask_2d_greedy"
+    MASK_2D_BEST = "mask_2d_best"
+
+
+_MASK_FN = {MaskAlgo.MASK_1D: get_mask_1d,
+            MaskAlgo.MASK_2D_GREEDY: get_mask_2d_greedy,
+            MaskAlgo.MASK_2D_BEST: get_mask_2d_best}
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                     else tensor)
+    shape = arr.shape
+    mat = arr.reshape(shape[0], -1) if arr.ndim != 2 else arr
+    mask = _MASK_FN[func_name](mat, n, m)
+    return Tensor(mask.reshape(shape).astype(np.float32))
+
+
+class ASPHelper:
+    """Mask bookkeeping (parity: asp.py ASPHelper)."""
+
+    _excluded: set = set()
+    _masks: Dict[int, Tensor] = {}
+
+    @classmethod
+    def supported(cls, layer: Layer) -> bool:
+        return isinstance(layer, (nn.Linear, nn.Conv2D))
+
+    @classmethod
+    def prunable_params(cls, model: Layer):
+        out = []
+        for name, sub in model.named_sublayers(include_self=True):
+            if not cls.supported(sub):
+                continue
+            if any(name.startswith(e) for e in cls._excluded if e):
+                continue
+            w = getattr(sub, "weight", None)
+            if w is not None and w._value.ndim >= 2:
+                out.append(w)
+        return out
+
+
+def set_excluded_layers(param_names, main_program=None, model=None):
+    ASPHelper._excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded.clear()
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo=MaskAlgo.MASK_1D,
+                with_mask=True):
+    """Apply n:m masks to every supported layer's weight (parity:
+    asp.py prune_model).  Returns {param_id: mask}."""
+    masks = {}
+    for w in ASPHelper.prunable_params(model):
+        mask = create_mask(w, mask_algo, n, m)
+        w.set_value(np.asarray(w._value) * np.asarray(mask._value))
+        masks[id(w)] = mask
+        if with_mask:
+            ASPHelper._masks[id(w)] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies masks after every step (parity: asp.py decorate —
+    the reference multiplies masks into params post-update)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        for p in self._optimizer._parameter_list:
+            mask = ASPHelper._masks.get(id(p))
+            if mask is not None:
+                p.set_value(np.asarray(p._value)
+                            * np.asarray(mask._value))
+
+    def clear_grad(self, *a, **k):
+        self._optimizer.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

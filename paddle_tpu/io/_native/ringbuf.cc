@@ -157,6 +157,10 @@ int64_t rb_read(void* handle, uint8_t* buf, uint64_t n) {
 // Like rb_read but gives up after timeout_us of no progress, returning -2.
 // Lets the consumer interleave liveness checks on the producer process
 // instead of spinning forever on a worker that died without hanging up.
+//
+// The timeout ONLY fires before any byte is consumed — once mid-message,
+// returning -2 would leave the stream desynced on retry, so the wait is
+// extended (30x) and expiry is a hard protocol error (-1).
 int64_t rb_read_timeout(void* handle, uint8_t* buf, uint64_t n,
                         uint64_t timeout_us) {
   Ring* r = reinterpret_cast<Ring*>(handle);
@@ -174,7 +178,8 @@ int64_t rb_read_timeout(void* handle, uint8_t* buf, uint64_t n,
       if (h->closed.load(std::memory_order_acquire)) {
         return got == 0 ? 0 : -1;
       }
-      if (waited_ns >= limit_ns) return -2;
+      if (got == 0 && waited_ns >= limit_ns) return -2;
+      if (got > 0 && waited_ns >= 30 * limit_ns) return -1;
       sleep_ns(backoff);
       waited_ns += (uint64_t)backoff;
       if (backoff < 200000) backoff *= 2;

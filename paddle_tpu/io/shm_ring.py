@@ -27,30 +27,11 @@ _SRC = os.path.join(os.path.dirname(__file__), "_native", "ringbuf.cc")
 _LIB = [None]
 _LIB_LOCK = threading.Lock()
 
-
-class NativeBuildError(RuntimeError):
-    pass
+from .._native_build import NativeBuildError, build_shared_lib  # noqa: E402
 
 
 def _build_lib() -> str:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
-    cache = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                         "native")
-    os.makedirs(cache, exist_ok=True)
-    so_path = os.path.join(cache, f"libringbuf-{tag}.so")
-    if os.path.exists(so_path):
-        return so_path
-    tmp = so_path + f".tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
-           "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        msg = getattr(e, "stderr", str(e))
-        raise NativeBuildError(f"building ringbuf.so failed: {msg}")
-    os.replace(tmp, so_path)
-    return so_path
+    return build_shared_lib("libringbuf", [_SRC])
 
 
 def _lib():
@@ -137,12 +118,9 @@ class ShmRing:
         buf = ctypes.create_string_buffer(size)
         if size:
             rc = self._read_exact(buf, size, timeout_us)
-            if rc == -2:
-                # header consumed: a stalled payload is unrecoverable
-                raise OSError("ring read stalled mid-frame "
-                              "(producer died while writing?)")
             if rc != size:
-                raise OSError("ring read failed (truncated payload)")
+                raise OSError("ring read failed mid-frame "
+                              "(producer died while writing?)")
         return buf.raw
 
     def readable(self) -> int:
@@ -209,17 +187,22 @@ def decode_batch(payload: bytes):
     off = 12
     template = pickle.loads(payload[off:off + tpl_len])
     off += tpl_len
-    buffers = []
+    buffers = []    # (offset, nbytes) spans into payload
     for _ in range(n_buf):
         (blen,) = struct.unpack_from("<Q", payload, off)
         off += 8
-        buffers.append(payload[off:off + blen])
+        buffers.append((off, blen))
         off += blen
 
     def fill(x):
         if isinstance(x, _ArrayStub):
-            return np.frombuffer(buffers[x.idx],
-                                 dtype=np.dtype(x.dtype)).reshape(x.shape)
+            boff, blen = buffers[x.idx]
+            dt = np.dtype(x.dtype)
+            # one copy (off the shared frame) so the result is writable
+            # like the single-process path's arrays
+            return np.frombuffer(payload, dtype=dt,
+                                 count=blen // dt.itemsize,
+                                 offset=boff).reshape(x.shape).copy()
         if isinstance(x, (list, tuple)):
             out = [fill(i) for i in x]
             return tuple(out) if isinstance(x, tuple) else out

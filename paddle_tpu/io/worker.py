@@ -53,9 +53,10 @@ def worker_loop(dataset, my_batches, session, capacity, worker_id,
             # dataset's job via get_worker_info() (anything else would
             # double-shard datasets that already split themselves)
             batch = []
+            per_batch = batch_size or 1    # match _iter_iterable
             for sample in dataset:
                 batch.append(sample)
-                if len(batch) == batch_size:
+                if len(batch) == per_batch:
                     ring.send_msg(b"B" + encode_batch(_to_plain(batch)))
                     batch = []
             if batch and not drop_last:

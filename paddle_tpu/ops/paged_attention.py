@@ -143,9 +143,8 @@ _write_decode = jax.jit(_write_decode_impl)
 _write_decode_donated = jax.jit(_write_decode_impl, donate_argnums=(2, 3))
 
 
-@jax.jit
-def _write_prefill(k_new, v_new, key_cache, value_cache, block_tables,
-                   seq_lens):
+def _write_prefill_impl(k_new, v_new, key_cache, value_cache, block_tables,
+                        seq_lens):
     """k_new/v_new [B, S, Hkv, D]: one vectorized scatter for the whole
     prompt (not S sequential dispatches)."""
     B, S = k_new.shape[:2]
@@ -159,22 +158,28 @@ def _write_prefill(k_new, v_new, key_cache, value_cache, block_tables,
     return key_cache, value_cache
 
 
+_write_prefill = jax.jit(_write_prefill_impl)
+_write_prefill_donated = jax.jit(_write_prefill_impl, donate_argnums=(2, 3))
+
+
 def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
-                      seq_lens):
-    """Append K/V into page slots; returns NEW (key_cache, value_cache)
-    without consuming the inputs.
+                      seq_lens, donate: bool = False):
+    """Append K/V into page slots; returns NEW (key_cache, value_cache).
 
     k_new/v_new: [B, Hkv, D] (decode) or [B, S, Hkv, D] (prefill,
-    written starting at seq_lens)."""
+    written starting at seq_lens).  donate=True consumes the passed cache
+    buffers (in-place HBM update — the serving loop's mode); the default
+    keeps them valid for the caller."""
     k_new, v_new = _val(k_new), _val(v_new)
     key_cache, value_cache = _val(key_cache), _val(value_cache)
     block_tables = jnp.asarray(np.asarray(block_tables), jnp.int32)
     seq_lens = jnp.asarray(np.asarray(seq_lens), jnp.int32)
     if k_new.ndim == 3:
-        return _write_decode(k_new, v_new, key_cache, value_cache,
-                             block_tables, seq_lens)
-    return _write_prefill(k_new, v_new, key_cache, value_cache,
-                          block_tables, seq_lens)
+        fn = _write_decode_donated if donate else _write_decode
+    else:
+        fn = _write_prefill_donated if donate else _write_prefill
+    return fn(k_new, v_new, key_cache, value_cache, block_tables,
+              seq_lens)
 
 
 def reconstruct_kv(key_cache, value_cache, block_tables, max_len):
@@ -356,7 +361,8 @@ def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
 # ---------------------------------------------------------------------------
 def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
                               block_tables, num_heads: int,
-                              head_dim: Optional[int] = None):
+                              head_dim: Optional[int] = None,
+                              donate_cache: bool = True):
     """Parity: paddle.incubate.nn.functional.block_multihead_attention
     (phi/kernels/fusion/block_multihead_attention_kernel.cu), simplified to
     the two serving phases:
@@ -377,7 +383,10 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
     q, k, v = jnp.split(qkv_v.reshape(B, S, -1, D), [H, H + Hkv], axis=2)
     sl = jnp.asarray(np.asarray(seq_lens), jnp.int32)
 
-    kc, vc = write_kv_to_cache(k, v, kc, vc, block_tables, sl)
+    # the serving loop threads caches forward, so the old buffers are
+    # dead after this call: donate them (in-place HBM write per token)
+    kc, vc = write_kv_to_cache(k, v, kc, vc, block_tables, sl,
+                               donate=donate_cache)
     new_len = sl + S
 
     if S > 1:

@@ -1,0 +1,412 @@
+"""paddle.quantization — QAT/PTQ.
+
+Parity: python/paddle/quantization/ (reference — QuantConfig config.py:60,
+QuanterFactory factory.py, observers/, quanters/, QAT qat.py, PTQ ptq.py,
+quanter/observer wrapping in wrapper.py).
+
+TPU-native: fake-quant is a pure function with a straight-through
+estimator (x + stop_gradient(q(x) - x)), so the quantized graph traces
+and fuses under XLA like any other op; int8 simulation stays in the
+compiled module.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ..nn.layer_base import Layer
+from .. import nn
+
+__all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory",
+           "BaseObserver", "BaseQuanter", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMaxObserver", "QAT", "PTQ",
+           "QuantedLinear", "QuantedConv2D", "quanter"]
+
+
+def _fake_quant(x, scale, bit_length=8):
+    """Symmetric fake quantization with STE gradient."""
+    import jax
+    bnt = (1 << (bit_length - 1)) - 1
+
+    def fn(v, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(v / s * bnt), -bnt, bnt) * s / bnt
+        # straight-through estimator: identity gradient w.r.t. v
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply_op("fake_quant", fn, (x, _targ(scale)))
+
+
+def _targ(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# observers & quanters
+# ---------------------------------------------------------------------------
+class BaseObserver(Layer):
+    """Parity: base_observer.py — collects statistics, provides scales."""
+
+    def __init__(self):
+        super().__init__()
+
+    def scales(self):
+        raise NotImplementedError
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return -1
+
+
+class BaseQuanter(BaseObserver):
+    """Parity: base_quanter.py."""
+
+
+class AbsmaxObserver(BaseObserver):
+    """PTQ observer: running abs-max (parity: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._max = 1e-9
+
+    def forward(self, x):
+        self._max = max(self._max,
+                        float(np.max(np.abs(np.asarray(x._value)))))
+        return x
+
+    def scales(self):
+        return Tensor(np.asarray(self._max, np.float32))
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT quanter: moving-average abs-max + fake quant with STE
+    (parity: quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self._state = 0.0
+        self._accum = 0.0
+        self._scale = 1e-9
+
+    def forward(self, x):
+        if self.training:
+            cur = float(np.max(np.abs(np.asarray(x._value)))) + 1e-9
+            r = self._moving_rate
+            self._state = r * self._state + 1.0
+            self._accum = r * self._accum + cur
+            self._scale = self._accum / self._state
+        return _fake_quant(x, self._scale, self._bit_length)
+
+    def scales(self):
+        return Tensor(np.asarray(self._scale, np.float32))
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    """Per-output-channel weight quanter (parity:
+    quanters/abs_max.py channel-wise variant)."""
+
+    def __init__(self, quant_axis=0, bit_length=8, **kw):
+        super().__init__()
+        self._axis = quant_axis
+        self._bit_length = bit_length
+        self._scale = None
+
+    def forward(self, w):
+        arr = np.asarray(w._value)
+        axes = tuple(i for i in range(arr.ndim) if i != self._axis)
+        scale = np.max(np.abs(arr), axis=axes) + 1e-9
+        self._scale = scale
+        shape = [1] * arr.ndim
+        shape[self._axis] = -1
+        return _fake_quant(w, scale.reshape(shape), self._bit_length)
+
+    def scales(self):
+        return Tensor(np.asarray(self._scale, np.float32))
+
+    def bit_length(self):
+        return self._bit_length
+
+    def quant_axis(self):
+        return self._axis
+
+
+class QuanterFactory:
+    """Parity: factory.py — partial-bound quanter constructor."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self):
+        return self._cls(*self._args, **self._kwargs)
+
+
+def quanter(name):
+    """Decorator registering a quanter class + factory helper
+    (parity: factory.py quanter)."""
+    def deco(cls):
+        def factory(*a, **k):
+            return QuanterFactory(cls, *a, **k)
+        globals()[name] = factory
+        return cls
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self._activation = activation
+        self._weight = weight
+
+    @property
+    def activation(self):
+        return self._activation
+
+    @property
+    def weight(self):
+        return self._weight
+
+
+class QuantConfig:
+    """Parity: config.py:60."""
+
+    def __init__(self, activation=None, weight=None):
+        if activation is None and weight is None:
+            self._global_config = None
+        else:
+            self._global_config = SingleLayerConfig(activation, weight)
+        self._layer2config: Dict[int, SingleLayerConfig] = {}
+        self._prefix2config: Dict[str, SingleLayerConfig] = {}
+        self._type2config: Dict[type, SingleLayerConfig] = {}
+        self._qat_layer_mapping = {nn.Linear: None, nn.Conv2D: None}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer2config[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._prefix2config[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type2config[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_layer_mapping[source] = target
+
+    def _config_for(self, name: str, layer: Layer):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for prefix, cfg in self._prefix2config.items():
+            if name.startswith(prefix):
+                return cfg
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+class QuantedLinear(Layer):
+    """Linear with fake-quanted activation/weight (parity: nn/quant/qat)."""
+
+    def __init__(self, linear: nn.Linear, cfg: SingleLayerConfig):
+        super().__init__()
+        self._inner = linear
+        self.activation_quanter = (cfg.activation._instance()
+                                   if cfg and cfg.activation else None)
+        self.weight_quanter = (cfg.weight._instance()
+                               if cfg and cfg.weight else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        import paddle_tpu.nn.functional as F
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv: nn.Conv2D, cfg: SingleLayerConfig):
+        super().__init__()
+        self._inner = conv
+        self.activation_quanter = (cfg.activation._instance()
+                                   if cfg and cfg.activation else None)
+        self.weight_quanter = (cfg.weight._instance()
+                               if cfg and cfg.weight else None)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        import paddle_tpu.nn.functional as F
+        return F.conv2d(x, w, self._inner.bias, self._inner._stride,
+                        self._inner._padding, self._inner._dilation,
+                        self._inner._groups)
+
+
+class ObservedLayer(Layer):
+    """PTQ wrapper: observer on the input activation."""
+
+    def __init__(self, inner: Layer, cfg: SingleLayerConfig):
+        super().__init__()
+        self._inner = inner
+        self.activation_observer = (cfg.activation._instance()
+                                    if cfg and cfg.activation else None)
+        self.weight_observer = (cfg.weight._instance()
+                                if cfg and cfg.weight else None)
+
+    def forward(self, *args, **kw):
+        if self.activation_observer is not None and args:
+            self.activation_observer(args[0])
+        if self.weight_observer is not None and hasattr(
+                self._inner, "weight"):
+            self.weight_observer(self._inner.weight)
+        return self._inner(*args, **kw)
+
+
+def _swap_layers(model: Layer, make):
+    """Replace eligible sublayers in place; returns count."""
+    n = 0
+    for name, child in list(model.named_children()):
+        replacement = make(name, child)
+        if replacement is not None:
+            setattr(model, name, replacement)
+            n += 1
+        else:
+            n += _swap_layers(child, make)
+    return n
+
+
+class QAT:
+    """Quantization-aware training (parity: qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child):
+            cfg = self._config._config_for(name, child)
+            if cfg is None:
+                return None
+            if isinstance(child, nn.Linear):
+                custom = self._config._qat_layer_mapping.get(nn.Linear)
+                return (custom or QuantedLinear)(child, cfg)
+            if isinstance(child, nn.Conv2D):
+                custom = self._config._qat_layer_mapping.get(nn.Conv2D)
+                return (custom or QuantedConv2D)(child, cfg)
+            return None
+
+        _swap_layers(model, make)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Fold fake-quant into deploy form: weights stored int8 +
+        per-layer scale buffers (simulated dequant at run time)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                inner = child._inner
+                if child.weight_quanter is not None:
+                    wq = child.weight_quanter
+                    _ = wq(inner.weight)          # ensure scales exist
+                    scale = np.asarray(wq.scales()._value)
+                    bnt = (1 << (wq.bit_length() - 1)) - 1
+                    w = np.asarray(inner.weight._value)
+                    axis = wq.quant_axis()
+                    shape = [1] * w.ndim
+                    if scale.ndim:
+                        shape[axis] = -1
+                    s = scale.reshape(shape)
+                    int_w = np.clip(np.round(w / s * bnt), -bnt, bnt)
+                    inner.weight.set_value(
+                        (int_w * s / bnt).astype(np.float32))
+                    inner.register_buffer(
+                        "quant_scale", Tensor(scale.astype(np.float32)))
+                return inner
+            return None
+
+        _swap_layers(model, make)
+        return model
+
+
+class PTQ:
+    """Post-training quantization (parity: ptq.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child):
+            cfg = self._config._config_for(name, child)
+            if cfg is None or not isinstance(child, (nn.Linear, nn.Conv2D)):
+                return None
+            return ObservedLayer(child, cfg)
+
+        _swap_layers(model, make)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child):
+            if isinstance(child, ObservedLayer):
+                inner = child._inner
+                if child.weight_observer is not None:
+                    scale = np.asarray(child.weight_observer.scales()._value)
+                    inner.register_buffer(
+                        "quant_scale",
+                        Tensor(np.asarray(scale, np.float32)))
+                if child.activation_observer is not None:
+                    inner.register_buffer(
+                        "act_scale",
+                        Tensor(np.asarray(
+                            child.activation_observer.scales()._value,
+                            np.float32)))
+                return inner
+            return None
+
+        _swap_layers(model, make)
+        return model

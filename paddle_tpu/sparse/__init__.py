@@ -1,0 +1,352 @@
+"""paddle.sparse (parity: python/paddle/sparse/ — sparse_coo_tensor
+creation.py:72, sparse_csr_tensor :185, unary/binary ops, sparse matmul,
+nn activations; backing C++ types SparseCooTensor/SparseCsrTensor in
+paddle/phi/core/).
+
+TPU-native: SparseCooTensor wraps jax.experimental.sparse.BCOO — the XLA
+sparse format whose ops lower to gather/scatter/dot_general on the MXU;
+CSR is kept as a view-convention on top of the same BCOO data (XLA has no
+native CSR kernels; the reference's CSR kernels are CPU/cuSPARSE).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from .. import nn as _nn_mod
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
+           "add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "relu", "sin", "tanh", "abs", "sqrt",
+           "square", "log1p", "neg", "cast", "transpose", "coalesce",
+           "nn"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO (parity: phi::SparseCooTensor)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle Tensor-like surface --
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor._from_value(
+            jnp.swapaxes(self._bcoo.indices, 0, 1).astype(jnp.int64))
+
+    def values(self) -> Tensor:
+        return Tensor._from_value(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor._from_value(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(self._bcoo)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(
+            self._bcoo.sum_duplicates(remove_zeros=False))
+
+    def astype(self, dtype):
+        from ..core.dtypes import convert_dtype
+        return SparseCooTensor(jsparse.BCOO(
+            (self._bcoo.data.astype(convert_dtype(dtype)),
+             self._bcoo.indices), shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (parity: phi::SparseCsrTensor). Data is shared BCOO; the
+    crows/cols accessors materialize the CSR index arrays."""
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def crows(self) -> Tensor:
+        rows = np.asarray(self._sorted().indices[:, 0])
+        n_rows = self.shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        for r in rows:
+            crows[int(r) + 1] += 1
+        return Tensor(np.cumsum(crows))
+
+    def cols(self) -> Tensor:
+        return Tensor(np.asarray(self._sorted().indices[:, 1],
+                                 dtype=np.int64))
+
+    def values(self) -> Tensor:
+        return Tensor._from_value(self._sorted().data)
+
+    def _sorted(self):
+        idx = self._bcoo.indices
+        order = jnp.lexsort((idx[:, 1], idx[:, 0]))
+        return jsparse.BCOO((self._bcoo.data[order], idx[order]),
+                            shape=self._bcoo.shape)
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Parity: paddle.sparse.sparse_coo_tensor (creation.py:72).
+    indices: [sparse_dim, nnz]; values: [nnz, ...]."""
+    idx = np.asarray(_v(indices), np.int32)
+    vals = _v(values)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+        vals = jnp.asarray(vals, convert_dtype(dtype))
+    else:
+        vals = jnp.asarray(vals)
+    if shape is None:
+        dense_dims = (vals.ndim - 1)
+        sp_shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+        shape = sp_shape + tuple(vals.shape[1:])
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T, jnp.int32)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Parity: paddle.sparse.sparse_csr_tensor (creation.py:185)."""
+    crows = np.asarray(_v(crows), np.int64)
+    cols = np.asarray(_v(cols), np.int64)
+    vals = _v(values)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    indices = np.stack([rows, cols])
+    t = sparse_coo_tensor(indices, vals, shape, dtype)
+    return SparseCsrTensor(t._bcoo)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor) and x.is_sparse_coo()
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+# ---------------------------------------------------------------------------
+# binary ops
+# ---------------------------------------------------------------------------
+def _wrap_same(x: SparseCooTensor, bcoo):
+    return (SparseCsrTensor(bcoo) if isinstance(x, SparseCsrTensor)
+            else SparseCooTensor(bcoo))
+
+
+def _binary(x, y, op):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        dense = op(x._bcoo.todense(), y._bcoo.todense())
+        return _wrap_same(x, jsparse.BCOO.fromdense(dense))
+    if isinstance(x, SparseCooTensor):
+        return Tensor._from_value(op(x._bcoo.todense(), _v(y)))
+    return Tensor._from_value(op(_v(x), y._bcoo.todense()))
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor) \
+            and not isinstance(x, SparseCsrTensor):
+        # structural add stays sparse without densifying
+        data = jnp.concatenate([x._bcoo.data, y._bcoo.data])
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices])
+        out = jsparse.BCOO((data, idx),
+                           shape=x._bcoo.shape).sum_duplicates()
+        return SparseCooTensor(out)
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and not isinstance(
+            y, SparseCooTensor) and jnp.ndim(_v(y)) == 0:
+        return _wrap_same(x, jsparse.BCOO(
+            (x._bcoo.data * _v(y), x._bcoo.indices), shape=x._bcoo.shape))
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _binary(x, y, jnp.divide)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense / sparse @ sparse (parity: paddle.sparse.matmul).
+    BCOO dot lowers to XLA dot_general with gathers — MXU-eligible."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = x._bcoo @ y._bcoo.todense()
+        return Tensor._from_value(out)
+    if isinstance(x, SparseCooTensor):
+        return Tensor._from_value(x._bcoo @ _v(y))
+    if isinstance(y, SparseCooTensor):
+        return Tensor._from_value(_v(x) @ y._bcoo)
+    return Tensor._from_value(_v(x) @ _v(y))
+
+
+def masked_matmul(x, y, mask: SparseCooTensor, name=None):
+    """(x @ y) sampled at mask's sparsity (parity: SDDMM)."""
+    xv, yv = _v(x), _v(y)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=mask._bcoo.shape))
+
+
+# ---------------------------------------------------------------------------
+# unary ops (value-wise; zeros preserved)
+# ---------------------------------------------------------------------------
+def _unary(x, op):
+    if isinstance(x, SparseCooTensor):
+        return _wrap_same(x, jsparse.BCOO((op(x._bcoo.data),
+                                           x._bcoo.indices),
+                                          shape=x._bcoo.shape))
+    return Tensor._from_value(op(_v(x)))
+
+
+def relu(x, name=None):
+    return _unary(x, jax.nn.relu)
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin)
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh)
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs)
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt)
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square)
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p)
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative)
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if value_dtype is not None:
+        return x.astype(value_dtype)
+    return x
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        idx = x._bcoo.indices[:, jnp.asarray(perm, jnp.int32)]
+        shape = tuple(x._bcoo.shape[p] for p in perm)
+        return _wrap_same(x, jsparse.BCOO((x._bcoo.data, idx),
+                                          shape=shape))
+    return Tensor._from_value(jnp.transpose(_v(x), perm))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+# ---------------------------------------------------------------------------
+# sparse.nn (activations as layers — parity: python/paddle/sparse/nn)
+# ---------------------------------------------------------------------------
+class _SparseActLayer:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x):
+        return self._fn(x)
+
+
+class nn:
+    class ReLU(_SparseActLayer):
+        def __init__(self):
+            super().__init__(relu)
+
+    class Softmax:
+        """Row-wise softmax over CSR rows (parity: sparse/nn softmax)."""
+
+        def __init__(self, axis=-1):
+            pass
+
+        def __call__(self, x: SparseCooTensor):
+            idx = x._bcoo.indices
+            rows = idx[:, 0]
+            data = x._bcoo.data
+            n_rows = x.shape[0]
+            row_max = jnp.full((n_rows,), -jnp.inf).at[rows].max(data)
+            e = jnp.exp(data - row_max[rows])
+            denom = jnp.zeros((n_rows,)).at[rows].add(e)
+            return _wrap_same(x, jsparse.BCOO((e / denom[rows], idx),
+                                              shape=x._bcoo.shape))

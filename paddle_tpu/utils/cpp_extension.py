@@ -36,6 +36,9 @@ import jax.numpy as jnp
 __all__ = ["load", "get_build_directory", "CppExtension", "CustomOp"]
 
 
+from .._native_build import build_shared_lib
+
+
 def get_build_directory() -> str:
     d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "paddle_tpu", "extensions")
@@ -44,28 +47,8 @@ def get_build_directory() -> str:
 
 
 def _compile(name: str, sources: Sequence[str], extra_cflags, verbose):
-    h = hashlib.sha256()
-    for s in sources:
-        with open(s, "rb") as f:
-            h.update(f.read())
-    h.update(" ".join(extra_cflags or []).encode())
-    so_path = os.path.join(get_build_directory(),
-                           f"{name}-{h.hexdigest()[:16]}.so")
-    if os.path.exists(so_path):
-        return so_path
-    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
-           + list(extra_cflags or []) + list(sources)
-           + ["-o", so_path + ".tmp"])
-    if verbose:
-        print("compiling custom op:", " ".join(cmd))
-    try:
-        subprocess.run(cmd, check=True, capture_output=not verbose,
-                       text=True)
-    except subprocess.CalledProcessError as e:
-        raise RuntimeError(
-            f"custom op build failed:\n{e.stderr or e}") from None
-    os.replace(so_path + ".tmp", so_path)
-    return so_path
+    return build_shared_lib(name, sources, extra_cflags,
+                            cache_subdir="extensions", verbose=verbose)
 
 
 class CustomOp:

@@ -1,0 +1,127 @@
+"""paddle.audio + paddle.text.
+
+Parity: python/paddle/audio/functional+features, python/paddle/text/
+viterbi_decode.py.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+
+rng = np.random.RandomState(0)
+
+
+def test_hz_mel_roundtrip():
+    for htk in (False, True):
+        hz = np.array([60.0, 440.0, 4000.0], np.float32)
+        mel = audio.functional.hz_to_mel(paddle.to_tensor(hz), htk=htk)
+        back = audio.functional.mel_to_hz(mel, htk=htk)
+        np.testing.assert_allclose(np.asarray(back._value), hz, rtol=1e-4)
+    # scalar path
+    assert isinstance(audio.functional.hz_to_mel(440.0), float)
+
+
+def test_fbank_matrix_properties():
+    fb = np.asarray(audio.functional.compute_fbank_matrix(
+        sr=16000, n_fft=512, n_mels=40)._value)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # compare against librosa-style formula via scipy-free check:
+    # each filter has a single peak and covers increasing frequencies
+    peaks = fb.argmax(1)
+    assert (np.diff(peaks) >= 0).all()
+
+
+def test_power_to_db():
+    s = np.array([1.0, 0.1, 0.01], np.float32)
+    db = np.asarray(audio.functional.power_to_db(
+        paddle.to_tensor(s), top_db=None)._value)
+    np.testing.assert_allclose(db, [0.0, -10.0, -20.0], atol=1e-4)
+    db2 = np.asarray(audio.functional.power_to_db(
+        paddle.to_tensor(s), top_db=15.0)._value)
+    assert db2.min() >= -15.0
+
+
+def test_create_dct_ortho():
+    d = np.asarray(audio.functional.create_dct(8, 16)._value)
+    assert d.shape == (16, 8)
+    # orthonormal columns under DCT-II ortho norm
+    np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+
+def test_spectrogram_and_mel_shapes():
+    x = paddle.to_tensor(rng.randn(2, 2048).astype(np.float32))
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(x)
+    assert list(spec.shape)[0] == 2
+    assert list(spec.shape)[-2] == 129   # 1 + n_fft//2
+    mel = audio.MelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                               n_mels=32, f_min=0.0)(x)
+    assert list(mel.shape)[-2] == 32
+    logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, hop_length=128,
+                                     n_mels=32, f_min=0.0)(x)
+    assert np.isfinite(np.asarray(logmel._value)).all()
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, hop_length=128,
+                      n_mels=32, f_min=0.0)(x)
+    assert list(mfcc.shape)[-2] == 13
+
+
+def test_spectrogram_parseval_sine():
+    # pure tone concentrates energy at its bin
+    sr, n_fft = 8000, 256
+    t = np.arange(2048) / sr
+    x = np.sin(2 * np.pi * 1000.0 * t).astype(np.float32)
+    spec = np.asarray(audio.Spectrogram(n_fft=n_fft, hop_length=n_fft)(
+        paddle.to_tensor(x[None]))._value)[0]
+    peak_bin = spec.mean(-1).argmax()
+    assert abs(peak_bin - round(1000.0 * n_fft / sr)) <= 1
+
+
+def _brute_viterbi(e, trans, bos=None, eos=None):
+    T, N = e.shape
+    tags = range(N)
+    best, best_path = -np.inf, None
+    for path in itertools.product(tags, repeat=T):
+        s = e[0, path[0]] + (trans[bos, path[0]] if bos is not None else 0)
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + e[t, path[t]]
+        if eos is not None:
+            s += trans[path[-1], eos]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("with_tags", [True, False])
+def test_viterbi_matches_bruteforce(with_tags):
+    N = 5 if with_tags else 3
+    T = 4
+    e = rng.randn(2, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(e), paddle.to_tensor(trans),
+        include_bos_eos_tag=with_tags)
+    for b in range(2):
+        if with_tags:
+            want_s, want_p = _brute_viterbi(e[b], trans, N - 2, N - 1)
+        else:
+            want_s, want_p = _brute_viterbi(e[b], trans)
+        np.testing.assert_allclose(float(np.asarray(scores._value)[b]),
+                                   want_s, rtol=1e-5)
+        assert list(np.asarray(paths._value)[b]) == want_p
+
+
+def test_viterbi_decoder_layer_and_lengths():
+    N, T = 4, 5
+    e = rng.randn(2, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(e),
+                        paddle.to_tensor(np.array([3, 5], np.int64)))
+    # row 0 decoded over only its first 3 steps
+    want_s, want_p = _brute_viterbi(e[0, :3], trans)
+    np.testing.assert_allclose(float(np.asarray(scores._value)[0]),
+                               want_s, rtol=1e-5)
+    assert list(np.asarray(paths._value)[0, :3]) == want_p

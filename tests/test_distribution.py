@@ -1,0 +1,207 @@
+"""paddle.distribution: samples, log_prob (vs scipy), entropy, KL,
+transforms, TransformedDistribution, Independent.
+
+Parity: python/paddle/distribution/.
+"""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+rng = np.random.RandomState(0)
+paddle.seed(0)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_normal_moments_logprob_entropy():
+    d = D.Normal(1.5, 2.0)
+    x = np.array([0.0, 1.5, 4.0], np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(x)),
+                               st.norm.logpdf(x, 1.5, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.norm.entropy(1.5, 2.0), rtol=1e-5)
+    np.testing.assert_allclose(_np(d.cdf(x)), st.norm.cdf(x, 1.5, 2.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(d.icdf(np.array([0.1, 0.5, 0.9], np.float32))),
+        st.norm.ppf([0.1, 0.5, 0.9], 1.5, 2.0), rtol=1e-4)
+    s = _np(d.sample((20000,)))
+    assert abs(s.mean() - 1.5) < 0.1 and abs(s.std() - 2.0) < 0.1
+
+
+def test_normal_rsample_differentiable():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import random as prandom
+
+    def f(mu):
+        d = D.Normal(mu, 1.0)
+        with prandom.trace_rng_scope(jax.random.PRNGKey(0)):
+            return jnp.mean(d.rsample((64,))._value)
+
+    g = jax.grad(f)(0.0)
+    np.testing.assert_allclose(g, 1.0, atol=1e-5)   # d/dmu E[mu+eps] = 1
+
+
+@pytest.mark.parametrize("cls,args,sp", [
+    (D.Uniform, (1.0, 3.0), st.uniform(1.0, 2.0)),
+    (D.Exponential, (2.0,), st.expon(scale=0.5)),
+    (D.Laplace, (0.5, 1.5), st.laplace(0.5, 1.5)),
+    (D.Gumbel, (1.0, 2.0), st.gumbel_r(1.0, 2.0)),
+    (D.Beta, (2.0, 3.0), st.beta(2.0, 3.0)),
+    (D.Gamma, (2.0, 3.0), st.gamma(2.0, scale=1 / 3.0)),
+    (D.LogNormal, (0.2, 0.7), st.lognorm(0.7, scale=np.exp(0.2))),
+])
+def test_logprob_matches_scipy(cls, args, sp):
+    d = cls(*args)
+    x = np.asarray(sp.rvs(size=8, random_state=1), np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(x)), sp.logpdf(x),
+                               rtol=2e-4, atol=1e-5)
+    if hasattr(d, "entropy"):
+        np.testing.assert_allclose(float(np.mean(_np(d.entropy()))),
+                                   sp.entropy(), rtol=1e-4)
+    s = _np(d.sample((30000,)))
+    np.testing.assert_allclose(s.mean(), sp.mean(), rtol=0.08, atol=0.05)
+
+
+def test_bernoulli_categorical():
+    b = D.Bernoulli(np.array([0.3, 0.8], np.float32))
+    lp = _np(b.log_prob(np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(lp, [np.log(0.3), np.log(0.2)], rtol=1e-5)
+    s = _np(b.sample((5000,)))
+    np.testing.assert_allclose(s.mean(0), [0.3, 0.8], atol=0.03)
+
+    c = D.Categorical(np.array([1.0, 2.0, 7.0], np.float32))
+    np.testing.assert_allclose(_np(c.entropy()),
+                               st.entropy([0.1, 0.2, 0.7]), rtol=1e-5)
+    s = _np(c.sample((8000,)))
+    freq = np.bincount(s.astype(int), minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+    np.testing.assert_allclose(
+        _np(c.log_prob(np.array([2], np.int64))), [np.log(0.7)],
+        rtol=1e-5)
+
+
+def test_dirichlet_multinomial():
+    d = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(float(_np(d.log_prob(x))),
+                               st.dirichlet.logpdf(x, [2, 3, 5]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(d.mean), [0.2, 0.3, 0.5], rtol=1e-6)
+
+    m = D.Multinomial(10, np.array([0.2, 0.3, 0.5], np.float32))
+    x = np.array([2.0, 3.0, 5.0], np.float32)
+    np.testing.assert_allclose(
+        float(_np(m.log_prob(x))),
+        st.multinomial.logpmf([2, 3, 5], 10, [0.2, 0.3, 0.5]), rtol=1e-5)
+    s = _np(m.sample((2000,)))
+    assert s.shape == (2000, 3)
+    np.testing.assert_allclose(s.sum(-1), 10.0)
+    np.testing.assert_allclose(s.mean(0), [2.0, 3.0, 5.0], atol=0.2)
+
+
+def test_poisson_geometric():
+    p = D.Poisson(3.0)
+    np.testing.assert_allclose(
+        _np(p.log_prob(np.array([0.0, 2.0, 5.0], np.float32))),
+        st.poisson.logpmf([0, 2, 5], 3.0), rtol=1e-5)
+    g = D.Geometric(0.25)
+    np.testing.assert_allclose(
+        _np(g.log_prob(np.array([1.0, 3.0], np.float32))),
+        st.geom.logpmf([1, 3], 0.25), rtol=1e-5)
+
+
+def test_kl_divergences():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    want = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), want,
+                               rtol=1e-5)
+    # KL >= 0 and zero on identical distributions across families
+    for d in (D.Beta(2.0, 3.0), D.Gamma(2.0, 1.0), D.Exponential(1.5),
+              D.Laplace(0.0, 1.0),
+              D.Categorical(np.array([0.2, 0.8], np.float32)),
+              D.Bernoulli(0.4)):
+        z = float(np.max(_np(D.kl_divergence(d, d))))
+        np.testing.assert_allclose(z, 0.0, atol=1e-6)
+    # MC cross-check for Beta KL
+    p, q = D.Beta(2.0, 5.0), D.Beta(3.0, 3.0)
+    s = _np(p.sample((100000,)))
+    mc = np.mean(st.beta.logpdf(s, 2, 5) - st.beta.logpdf(s, 3, 3))
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))), mc,
+                               rtol=0.05)
+
+
+def test_register_kl_custom():
+    class MyDist(D.Normal):
+        pass
+
+    @D.register_kl(MyDist, MyDist)
+    def _kl(p, q):
+        return paddle.to_tensor(np.float32(42.0))
+
+    assert float(_np(D.kl_divergence(MyDist(0, 1), MyDist(0, 1)))) == 42.0
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Gumbel(0, 1), D.Beta(1.0, 1.0))
+
+
+def test_transforms_roundtrip_and_jacobian():
+    x = np.linspace(-2, 2, 9).astype(np.float32)
+    for t in (D.AffineTransform(1.0, 3.0), D.ExpTransform(),
+              D.SigmoidTransform(), D.TanhTransform()):
+        y = t.forward(x)
+        back = _np(t.inverse(y))
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+        # numeric jacobian check
+        eps = 1e-3
+        num = (np.asarray(_np(t.forward(x + eps)))
+               - np.asarray(_np(t.forward(x - eps)))) / (2 * eps)
+        np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)),
+                                   np.log(np.abs(num)), atol=1e-2)
+
+
+def test_chain_and_stickbreaking():
+    chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+    x = np.array([0.1, 0.5], np.float32)
+    np.testing.assert_allclose(_np(chain.forward(x)), np.exp(2 * x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        _np(chain.inverse(chain.forward(x))), x, rtol=1e-5)
+
+    sb = D.StickBreakingTransform()
+    z = np.array([0.4, -0.3, 0.8], np.float32)
+    simplex = _np(sb.forward(z))
+    assert simplex.shape == (4,)
+    np.testing.assert_allclose(simplex.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(_np(sb.inverse(simplex)), z, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    base = D.Normal(0.3, 0.6)
+    ln = D.TransformedDistribution(base, [D.ExpTransform()])
+    ref = D.LogNormal(0.3, 0.6)
+    x = np.array([0.5, 1.0, 2.5], np.float32)
+    np.testing.assert_allclose(_np(ln.log_prob(x)), _np(ref.log_prob(x)),
+                               rtol=1e-5)
+    s = _np(ln.sample((20000,)))
+    np.testing.assert_allclose(s.mean(), float(_np(ref.mean)), rtol=0.1)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,)
+    assert ind.event_shape == (4,)
+    x = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(_np(ind.log_prob(x)),
+                               _np(base.log_prob(x)).sum(-1), rtol=1e-5)
+    np.testing.assert_allclose(_np(ind.entropy()),
+                               _np(base.entropy()).sum(-1), rtol=1e-5)

