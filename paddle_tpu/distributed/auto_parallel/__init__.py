@@ -1,0 +1,8 @@
+"""Auto-parallel (parity: python/paddle/distributed/auto_parallel/ —
+semi-auto api.py lives in ..api; this package adds the STATIC side:
+Strategy strategy.py, Engine static/engine.py:59).
+"""
+from .strategy import Strategy
+from .engine import Engine
+
+__all__ = ["Strategy", "Engine"]

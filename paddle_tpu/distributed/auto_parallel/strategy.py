@@ -1,0 +1,40 @@
+"""Auto-parallel Strategy (parity:
+python/paddle/distributed/auto_parallel/strategy.py — nested config
+objects with enable flags: amp, sharding, recompute, pipeline,
+mp_optimization, dataset)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.enable = False
+        for k, v in defaults.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        return repr({k: v for k, v in self.__dict__.items()})
+
+
+class Strategy:
+    """Parity: auto_parallel.Strategy."""
+
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Config(dtype="float16", level="O1",
+                           init_loss_scaling=32768.0,
+                           use_master_weights=False)
+        self.sharding = _Config(stage=1, degree=-1)
+        self.recompute = _Config(refined_ops=None)
+        self.pipeline = _Config(schedule_mode="1F1B",
+                                micro_batch_size=1,
+                                accumulate_steps=1)
+        self.gradient_merge = _Config(k_steps=1, avg=True)
+        self.fused_passes = _Config(fused_opt=True)
+        self.dataset = _Config(use_dist_loader=False)
+        self.mp_degree = 1
+        self.dp_degree = -1        # -1: infer from device count
+        self.pp_degree = 1
+        if config:
+            for k, v in dict(config).items():
+                setattr(self, k, v)

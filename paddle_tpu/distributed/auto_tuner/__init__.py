@@ -1,0 +1,200 @@
+"""Auto-tuner: black-box search over parallel configs.
+
+Parity: python/paddle/distributed/auto_tuner/ (reference — AutoTuner
+tuner.py:21, candidate generation + prune rules prune.py, history
+recorder.py, memory/cost models cost_model.py; the launch-record-compare
+loop lives in launch/main.py --auto_tuner_json).
+
+TPU-native: the searchable axes are the mesh degrees (dp/mp/pp/sharding
+stage + micro-batch); trials run a user-supplied callable (launch a step,
+return throughput or OOM), so the tuner composes with any runner — the
+tests drive it with an analytical model, real use drives it with a
+jitted train step.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AutoTuner", "Recorder", "default_candidates", "prune_by_mp",
+           "prune_by_memory"]
+
+
+def default_candidates(tuner_cfg: Dict) -> List[Dict]:
+    """Cartesian candidates from the tuner config (parity:
+    prune.py/tuner.py candidate generation).
+
+    tuner_cfg keys: num_gpus (devices), model_cfg (for memory model),
+    dp_degree/mp_degree/pp_degree/sharding_degree/sharding_stage/
+    micro_batch_size: each 'auto' or a list of ints."""
+    n = int(tuner_cfg.get("num_gpus") or tuner_cfg.get("num_devices", 8))
+
+    def axis(name, auto_vals):
+        v = tuner_cfg.get(name, "auto")
+        if v == "auto" or v is None:
+            return auto_vals
+        return [int(i) for i in (v if isinstance(v, (list, tuple))
+                                 else [v])]
+
+    divisors = [d for d in range(1, n + 1) if n % d == 0]
+    cands = []
+    for dp, mp, pp in itertools.product(
+            axis("dp_degree", divisors), axis("mp_degree", divisors),
+            axis("pp_degree", divisors)):
+        if dp * mp * pp != n:
+            continue
+        for stage in axis("sharding_stage", [1, 2, 3]):
+            for sharding in axis("sharding_degree", [1, dp]):
+                if sharding > dp or dp % max(sharding, 1):
+                    continue
+                for mbs in axis("micro_batch_size", [1, 2, 4, 8]):
+                    cands.append({
+                        "dp_degree": dp, "mp_degree": mp,
+                        "pp_degree": pp, "sharding_degree": sharding,
+                        "sharding_stage": stage,
+                        "micro_batch_size": mbs,
+                    })
+    return cands
+
+
+def prune_by_mp(candidates: List[Dict], tuner_cfg: Dict) -> List[Dict]:
+    """mp must divide both attention heads and vocab (parity:
+    prune.py prune_by_mp)."""
+    model = tuner_cfg.get("model_cfg", {})
+    heads = model.get("num_attention_heads")
+    vocab = model.get("vocab_size")
+    out = []
+    for c in candidates:
+        mp = c["mp_degree"]
+        if heads and heads % mp:
+            continue
+        if vocab and vocab % mp:
+            continue
+        out.append(c)
+    return out
+
+
+def estimate_memory_bytes(cfg: Dict, model_cfg: Dict) -> float:
+    """Per-device training memory model (parity: memory_cost_model.py):
+    params/grads sharded by mp*pp, optimizer moments further by the
+    sharding degree; activations scale with micro_batch_size."""
+    n_params = float(model_cfg.get("n_params", 1e9))
+    hidden = float(model_cfg.get("hidden_size", 4096))
+    seq = float(model_cfg.get("seq_length", 2048))
+    layers = float(model_cfg.get("num_layers", 32))
+    mp, pp = cfg["mp_degree"], cfg["pp_degree"]
+    shard = max(cfg["sharding_degree"], 1)
+    stage = cfg.get("sharding_stage", 1)
+    shard_p = shard if stage >= 3 else 1
+    shard_g = shard if stage >= 2 else 1
+    shard_o = shard
+    per = n_params / (mp * pp)
+    mem = per * (2.0 / shard_p + 2.0 / shard_g + 8.0 / shard_o)
+    act = (cfg["micro_batch_size"] * seq * hidden * layers / pp / mp) * 2.0
+    return mem + act
+
+
+def prune_by_memory(candidates: List[Dict], tuner_cfg: Dict) -> List[Dict]:
+    limit = float(tuner_cfg.get("max_mem_usage", 0.9)) * float(
+        tuner_cfg.get("memory_per_device", 16e9))
+    model = tuner_cfg.get("model_cfg", {})
+    return [c for c in candidates
+            if estimate_memory_bytes(c, model) <= limit]
+
+
+class Recorder:
+    """History store + best query (parity: recorder.py)."""
+
+    def __init__(self, metric="throughput", maximize=True):
+        self.metric = metric
+        self.maximize = maximize
+        self.history: List[Dict] = []
+
+    def add(self, cfg: Dict, result: Dict):
+        rec = dict(cfg)
+        rec.update(result)
+        rec["ts"] = time.time()
+        self.history.append(rec)
+
+    def get_best(self) -> Optional[Dict]:
+        ok = [h for h in self.history
+              if h.get(self.metric) is not None and not h.get("error")]
+        if not ok:
+            return None
+        return (max if self.maximize else min)(
+            ok, key=lambda h: h[self.metric])
+
+    def store_history(self, path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            for h in self.history:
+                f.write(json.dumps(h) + "\n")
+
+    def load_history(self, path):
+        with open(path) as f:
+            self.history = [json.loads(l) for l in f if l.strip()]
+
+
+class AutoTuner:
+    """Parity: tuner.py:21 — candidate queue + prune + record loop."""
+
+    PRUNE_FNS = [prune_by_mp, prune_by_memory]
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.recorder = Recorder(
+            metric=tuner_cfg.get("metric", "throughput"),
+            maximize=tuner_cfg.get("maximize", True))
+        cands = default_candidates(self.tuner_cfg)
+        for fn in self.PRUNE_FNS:
+            cands = fn(cands, self.tuner_cfg)
+        # memory-ascending order: cheap configs first (reference sorts
+        # by estimated cost so OOM trials cluster at the end)
+        model = self.tuner_cfg.get("model_cfg", {})
+        cands.sort(key=lambda c: estimate_memory_bytes(c, model))
+        self.candidates = cands
+        self._idx = 0
+
+    @property
+    def search_space_size(self):
+        return len(self.candidates)
+
+    def search_once(self) -> Optional[Dict]:
+        """Next un-tried candidate, or None when exhausted."""
+        if self._idx >= len(self.candidates):
+            return None
+        cfg = self.candidates[self._idx]
+        self._idx += 1
+        return cfg
+
+    def tune(self, trial_fn: Callable[[Dict], Dict],
+             max_trials: Optional[int] = None,
+             history_path: Optional[str] = None) -> Optional[Dict]:
+        """Run trials until exhausted/max_trials; returns the best config.
+
+        trial_fn(cfg) -> {"throughput": float} or {"error": str} (OOM)."""
+        trials = 0
+        while True:
+            if max_trials is not None and trials >= max_trials:
+                break
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            try:
+                result = trial_fn(cfg)
+            except MemoryError as e:
+                result = {"error": f"OOM: {e}"}
+            except Exception as e:        # a failed trial must not kill the search
+                result = {"error": repr(e)}
+            self.recorder.add(cfg, result)
+            trials += 1
+        if history_path:
+            self.recorder.store_history(history_path)
+        return self.recorder.get_best()

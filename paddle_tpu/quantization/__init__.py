@@ -222,15 +222,24 @@ class QuantConfig:
         self._qat_layer_mapping[source] = target
 
     def _config_for(self, name: str, layer: Layer):
+        """`name` is the FULL dotted path from the model root."""
         if id(layer) in self._layer2config:
             return self._layer2config[id(layer)]
         for prefix, cfg in self._prefix2config.items():
-            if name.startswith(prefix):
+            if name == prefix or name.startswith(prefix + "."):
                 return cfg
         for t, cfg in self._type2config.items():
             if isinstance(layer, t):
                 return cfg
         return self._global_config
+
+    def _resolve_identities(self, model: Layer):
+        """Pin layer-object configs to dotted names BEFORE the model is
+        deepcopied (id()s don't survive the copy)."""
+        for name, sub in model.named_sublayers(include_self=False):
+            if id(sub) in self._layer2config:
+                self._prefix2config.setdefault(
+                    name, self._layer2config[id(sub)])
 
 
 # ---------------------------------------------------------------------------
@@ -298,16 +307,18 @@ class ObservedLayer(Layer):
         return self._inner(*args, **kw)
 
 
-def _swap_layers(model: Layer, make):
-    """Replace eligible sublayers in place; returns count."""
+def _swap_layers(model: Layer, make, prefix=""):
+    """Replace eligible sublayers in place (make receives the FULL dotted
+    path from the root); returns count."""
     n = 0
     for name, child in list(model.named_children()):
-        replacement = make(name, child)
+        full = prefix + name if not prefix else f"{prefix}.{name}"
+        replacement = make(full, child)
         if replacement is not None:
             setattr(model, name, replacement)
             n += 1
         else:
-            n += _swap_layers(child, make)
+            n += _swap_layers(child, make, full)
     return n
 
 
@@ -318,6 +329,7 @@ class QAT:
         self._config = config
 
     def quantize(self, model: Layer, inplace=False):
+        self._config._resolve_identities(model)
         if not inplace:
             model = copy.deepcopy(model)
 
@@ -375,6 +387,7 @@ class PTQ:
         self._config = config
 
     def quantize(self, model: Layer, inplace=False):
+        self._config._resolve_identities(model)
         if not inplace:
             model = copy.deepcopy(model)
 

@@ -1,0 +1,196 @@
+"""Auto-parallel static Engine + auto-tuner.
+
+Parity: python/paddle/distributed/auto_parallel/static/engine.py:59,
+python/paddle/distributed/auto_tuner/tuner.py:21.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, Recorder,
+                                               default_candidates,
+                                               estimate_memory_bytes,
+                                               prune_by_mp)
+from paddle_tpu.io import Dataset
+
+rng = np.random.RandomState(0)
+
+
+class RegDataset(Dataset):
+    def __init__(self, n=64):
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8, 2).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _engine(strategy=None):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    return Engine(net, nn.MSELoss(), opt, strategy=strategy)
+
+
+def test_engine_mesh_from_strategy():
+    s = Strategy()
+    s.mp_degree = 2
+    e = _engine(s)
+    mesh = e.mesh
+    assert mesh.dim_names == ["dp", "mp"]
+    assert mesh.get_dim_size("dp") == 4 and mesh.get_dim_size("mp") == 2
+
+    bad = Strategy()
+    bad.mp_degree = 3      # 8 % 3 != 0 with dp inferred
+    with pytest.raises(ValueError):
+        _engine(bad).mesh
+
+
+def test_engine_fit_reduces_loss_dp8():
+    e = _engine()
+    hist = e.fit(RegDataset(), batch_size=16, epochs=8)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5
+
+
+def test_engine_fit_dp_x_mp():
+    s = Strategy()
+    s.mp_degree = 2
+    e = _engine(s)
+    hist = e.fit(RegDataset(), batch_size=16, epochs=4)
+    assert np.isfinite(hist["loss"]).all()
+    ev = e.evaluate(RegDataset(n=32), batch_size=16)
+    assert np.isfinite(ev["loss"])
+    preds = e.predict(RegDataset(n=16), batch_size=8)
+    assert preds[0].shape == (8, 2)
+
+
+def test_engine_dp_matches_serial():
+    # dp over 8 devices with global batch == serial run: same losses
+    ds = RegDataset()
+    e = _engine()
+    hist = e.fit(ds, batch_size=16, epochs=1)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    loss_fn = nn.MSELoss()
+    serial = []
+    for i in range(0, 64, 16):
+        xb = paddle.to_tensor(ds.x[i:i + 16])
+        yb = paddle.to_tensor(ds.y[i:i + 16])
+        loss = loss_fn(net(xb), yb)
+        loss.backward(); opt.step(); opt.clear_grad()
+        serial.append(float(np.asarray(loss._value)))
+    np.testing.assert_allclose(hist["loss"], serial, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_cost_and_save_load(tmp_path):
+    e = _engine()
+    c = e.cost()
+    assert c["n_params"] == 8 * 32 + 32 + 32 * 2 + 2
+    assert c["max_memory"] > 0
+    e.fit(RegDataset(n=16), batch_size=8, epochs=1)
+    e.save(str(tmp_path / "ckpt"))
+    e2 = _engine()
+    e2.load(str(tmp_path / "ckpt"))
+    x = rng.randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(e2._model(paddle.to_tensor(x))._value),
+        np.asarray(e._model(paddle.to_tensor(x))._value), rtol=1e-5)
+
+
+# ------------------------------- auto-tuner ---------------------------------
+
+def _tuner_cfg(**kw):
+    cfg = {
+        "num_gpus": 8,
+        "model_cfg": {"n_params": 1e8, "hidden_size": 512,
+                      "seq_length": 512, "num_layers": 8,
+                      "num_attention_heads": 8, "vocab_size": 1000},
+        "memory_per_device": 16e9,
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def test_candidates_cover_device_count():
+    cands = default_candidates(_tuner_cfg())
+    assert cands
+    for c in cands:
+        assert c["dp_degree"] * c["mp_degree"] * c["pp_degree"] == 8
+
+
+def test_prune_by_mp_respects_heads_and_vocab():
+    cands = default_candidates(_tuner_cfg())
+    pruned = prune_by_mp(cands, _tuner_cfg(
+        model_cfg={"num_attention_heads": 4, "vocab_size": 1000}))
+    assert all(c["mp_degree"] in (1, 2, 4) for c in pruned)
+
+
+def test_memory_model_monotonic():
+    m = _tuner_cfg()["model_cfg"]
+    base = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sharding_stage": 1,
+            "micro_batch_size": 1}
+    zero3 = dict(base, sharding_degree=8, sharding_stage=3)
+    assert estimate_memory_bytes(zero3, m) < estimate_memory_bytes(base, m)
+    mp2 = dict(base, mp_degree=2, dp_degree=4)
+    assert estimate_memory_bytes(mp2, m) < estimate_memory_bytes(base, m)
+
+
+def test_tuner_finds_best_and_records(tmp_path):
+    cfg = _tuner_cfg(micro_batch_size=[1, 2],
+                     sharding_stage=[1])
+
+    def synthetic_trial(c):
+        # peak throughput at mp=2, mbs=2; OOM (error) for mp=8
+        if c["mp_degree"] == 8:
+            raise MemoryError("synthetic OOM")
+        tp = 100.0 / c["mp_degree"] + 70.0 * (c["mp_degree"] == 2) \
+            + 10.0 * c["micro_batch_size"]
+        return {"throughput": tp}
+
+    tuner = AutoTuner(cfg)
+    assert tuner.search_space_size > 4
+    best = tuner.tune(synthetic_trial,
+                      history_path=str(tmp_path / "hist.jsonl"))
+    assert best["mp_degree"] == 2 and best["micro_batch_size"] == 2
+    # history written, OOM recorded as error not crash
+    lines = [json.loads(l) for l in open(tmp_path / "hist.jsonl")]
+    assert len(lines) == tuner.search_space_size
+    assert any("OOM" in (l.get("error") or "") for l in lines)
+
+
+def test_tuner_real_trials_over_engine():
+    """End-to-end: tuner drives the Engine on the 8-device CPU mesh and
+    picks a config that actually ran."""
+    cfg = _tuner_cfg(pp_degree=[1], mp_degree=[1, 2],
+                     sharding_degree=[1], sharding_stage=[1],
+                     micro_batch_size=[8])
+    ds = RegDataset(n=32)
+
+    def trial(c):
+        import time
+        s = Strategy()
+        s.mp_degree = c["mp_degree"]
+        e = _engine(s)
+        t0 = time.time()
+        hist = e.fit(ds, batch_size=8, epochs=1)
+        dt = time.time() - t0
+        if not np.isfinite(hist["loss"]).all():
+            return {"error": "diverged"}
+        return {"throughput": len(hist["loss"]) * 8 / dt}
+
+    best = AutoTuner(cfg).tune(trial)
+    assert best is not None and best["throughput"] > 0

@@ -1,0 +1,128 @@
+"""Elastic manager: registration, heartbeat, scale in/out decisions,
+launcher integration.
+
+Parity: python/paddle/distributed/fleet/elastic/manager.py:126,240,257,301.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ElasticStatus,
+                                                  FileKVStore)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mgr(tmp_path, host, np="1:3", **kw):
+    kw.setdefault("heartbeat_interval", 0.1)
+    kw.setdefault("ttl", 0.5)
+    return ElasticManager("job1", np, host, FileKVStore(str(tmp_path)),
+                          **kw)
+
+
+def test_register_and_hosts(tmp_path):
+    a = _mgr(tmp_path, "hostA")
+    b = _mgr(tmp_path, "hostB")
+    a.register()
+    b.register()
+    assert a.hosts() == ["hostA", "hostB"]
+    assert a.rank_map() == {"hostA": 0, "hostB": 1}
+    a.exit()
+    b.exit()
+    assert _mgr(tmp_path, "x").hosts() == []
+
+
+def test_heartbeat_keeps_node_alive(tmp_path):
+    a = _mgr(tmp_path, "hostA")
+    a.register()
+    time.sleep(1.0)          # > ttl: only heartbeats keep it alive
+    assert a.hosts() == ["hostA"]
+    a.exit()
+
+
+def test_scale_in_detected(tmp_path):
+    a = _mgr(tmp_path, "hostA", np="1:3")
+    b = _mgr(tmp_path, "hostB", np="1:3")
+    a.register()
+    b.register()
+    assert a.status() == ElasticStatus.OK       # baseline snapshot
+    b.exit(completed=False)                     # node B dies
+    time.sleep(0.7)                             # ttl expiry
+    assert a.status() == ElasticStatus.RESTART  # smaller viable world
+    assert a.hosts() == ["hostA"]
+    assert a.status() == ElasticStatus.OK       # stable again
+
+
+def test_scale_out_detected(tmp_path):
+    a = _mgr(tmp_path, "hostA", np="1:3")
+    a.register()
+    assert a.status() == ElasticStatus.OK
+    b = _mgr(tmp_path, "hostB", np="1:3")
+    b.register()
+    assert a.status() == ElasticStatus.RESTART
+    env = a.new_env()
+    assert env["PADDLE_NNODES"] == "2"
+    assert env["PADDLE_TRAINER_ID"] == "0"
+    assert env["PADDLE_ELASTIC_HOSTS"] == "hostA,hostB"
+    a.exit(); b.exit()
+
+
+def test_hold_below_min(tmp_path):
+    a = _mgr(tmp_path, "hostA", np="2:4")
+    a.register()
+    assert a.status() == ElasticStatus.HOLD     # 1 < min 2
+    assert not a.wait_for_np(timeout=0.5)
+    b = _mgr(tmp_path, "hostB", np="2:4")
+    b.register()
+    assert a.wait_for_np(timeout=2.0)
+    a.exit(); b.exit()
+
+
+def test_launcher_elastic_restart_on_scale_out(tmp_path):
+    """Supervisor relaunches the worker with a regenerated world when a
+    second node joins (reference watch->restart path)."""
+    store = str(tmp_path / "store")
+    script = tmp_path / "worker.py"
+    out = tmp_path / "runs.log"
+    script.write_text(
+        "import os, time, sys\n"
+        f"with open({str(out)!r}, 'a') as f:\n"
+        "    f.write(os.environ['PADDLE_NNODES'] + '\\n')\n"
+        # run long enough that the supervisor sees the scale-out, unless
+        # the world already has 2 nodes (the post-restart run: exit clean)
+        "if os.environ['PADDLE_NNODES'] == '2':\n"
+        "    sys.exit(0)\n"
+        "time.sleep(30)\n")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1:2", "--node_rank", "0", "--elastic_level", "1",
+         "--elastic_store", store, "--host", "nodeA", str(script)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        # wait for the first worker run (importing the launcher module is
+        # slow) before the second node joins
+        deadline = time.time() + 60
+        while time.time() < deadline and not out.exists():
+            time.sleep(0.5)
+        assert out.exists(), "first worker run never started"
+        time.sleep(1)
+        joiner = ElasticManager("default", "1:2", "nodeB",
+                                FileKVStore(store),
+                                heartbeat_interval=0.5, ttl=3.0)
+        joiner.register()
+        ret = proc.wait(timeout=60)
+        joiner.exit()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert ret == 0, proc.stdout.read()[-2000:]
+    runs = out.read_text().split()
+    assert runs[0] == "1" and runs[-1] == "2", runs

@@ -1,0 +1,42 @@
+"""onnx export gating, hub local source, sysconfig.
+
+Parity: python/paddle/onnx/export.py, python/paddle/hub.py,
+python/paddle/sysconfig.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_onnx_export_falls_back_to_stablehlo(tmp_path):
+    from paddle_tpu.jit.api import InputSpec
+    net = nn.Linear(4, 2)
+    path = str(tmp_path / "model")
+    with pytest.raises(RuntimeError, match="StableHLO"):
+        paddle.onnx.export(net, path,
+                           input_spec=[InputSpec([None, 4], "float32")])
+    import os
+    assert os.path.exists(path + ".pdexec")   # artifact still produced
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_mlp(width=4):\n"
+        "    '''a tiny mlp'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, 2)\n")
+    models = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in models
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    m = paddle.hub.load(str(tmp_path), "tiny_mlp", width=8)
+    x = paddle.to_tensor(np.zeros((1, 8), np.float32))
+    assert m(x).shape == [1, 2]
+    with pytest.raises(ValueError, match="local"):
+        paddle.hub.list("owner/repo", source="github")
+
+
+def test_sysconfig_paths():
+    assert paddle.sysconfig.get_include().endswith("include")
+    assert paddle.sysconfig.get_lib().endswith("libs")

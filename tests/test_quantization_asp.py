@@ -119,6 +119,38 @@ def test_quant_config_scoping():
     assert kinds.count("QuantedLinear") == 2
 
 
+def test_quant_layer_and_name_config_survive_deepcopy():
+    # layer-object config must survive the inplace=False deepcopy
+    model = _model()
+    cfg = QuantConfig()
+    cfg.add_layer_config(model[0],
+                         weight=QuanterFactory(
+                             FakeQuanterWithAbsMaxObserver))
+    q = QAT(cfg).quantize(model)          # deepcopied
+    kinds = [type(l).__name__ for l in q.sublayers()]
+    assert kinds.count("QuantedLinear") == 1
+    assert isinstance(q[0], QuantedLinear)
+    assert not isinstance(q[2], QuantedLinear)
+
+    # dotted-name config matches the full path
+    class Outer(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = _model()
+
+        def forward(self, x):
+            return self.block(x)
+
+    outer = Outer()
+    cfg2 = QuantConfig()
+    cfg2.add_name_config("block.2",
+                         weight=QuanterFactory(
+                             FakeQuanterWithAbsMaxObserver))
+    q2 = QAT(cfg2).quantize(outer)
+    assert isinstance(q2.block[2], QuantedLinear)
+    assert not isinstance(q2.block[0], QuantedLinear)
+
+
 # ----------------------------- ASP -----------------------------------------
 
 def test_mask_1d_pattern():
