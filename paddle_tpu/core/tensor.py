@@ -125,7 +125,17 @@ class Tensor:
         return np.dtype(self._value.dtype).itemsize
 
     # -- value access --------------------------------------------------------
+    def _notify_sot_materialize(self, what: str):
+        """Safety net for jit/sot recording: host materialization of a
+        tensor value (bool/int/item/numpy/print) makes the recorded trace
+        value-dependent, so the recorder marks the frame eager-only."""
+        from .dispatch import _sot_recorder
+        rec = _sot_recorder[0]
+        if rec is not None:
+            rec.poison(f"tensor value materialized on host via {what}")
+
     def numpy(self) -> np.ndarray:
+        self._notify_sot_materialize("numpy()")
         return np.asarray(self._value)
 
     def item(self, *args):
@@ -327,15 +337,19 @@ class Tensor:
         return self._value.shape[0]
 
     def __bool__(self):
+        self._notify_sot_materialize("bool()")
         return bool(self._value)
 
     def __int__(self):
+        self._notify_sot_materialize("int()")
         return int(self._value)
 
     def __float__(self):
+        self._notify_sot_materialize("float()")
         return float(self._value)
 
     def __index__(self):
+        self._notify_sot_materialize("__index__")
         return int(self._value)
 
     def __iter__(self):

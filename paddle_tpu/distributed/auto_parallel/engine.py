@@ -105,6 +105,7 @@ class Engine:
         history = {"loss": []}
         it = 0
         for epoch in range(epochs):
+            epoch_steps = 0
             for batch in loader:
                 batch = batch if isinstance(batch, (list, tuple)) \
                     else [batch]
@@ -114,10 +115,11 @@ class Engine:
                 loss = step(*arrays)
                 history["loss"].append(float(np.asarray(loss)))
                 it += 1
+                epoch_steps += 1
                 if verbose and it % log_freq == 0:
                     print(f"[AutoParallel Engine] epoch {epoch} step "
                           f"{it}: loss {history['loss'][-1]:.5f}")
-                if steps_per_epoch and it >= steps_per_epoch:
+                if steps_per_epoch and epoch_steps >= steps_per_epoch:
                     break
         self._history = history
         return history
@@ -131,6 +133,7 @@ class Engine:
                                   drop_last=False,
                                   collate_fn=collate_fn))
         losses, count = [], 0
+        was_training = self._model.training
         self._model.eval()
         try:
             with no_grad():
@@ -147,7 +150,8 @@ class Engine:
                     if steps and count >= steps:
                         break
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         return {"loss": float(np.mean(losses)) if losses else None}
 
     def predict(self, test_data, test_sample_split=None, batch_size=1,
@@ -158,6 +162,7 @@ class Engine:
                   else DataLoader(test_data, batch_size=batch_size,
                                   collate_fn=collate_fn))
         outs = []
+        was_training = self._model.training
         self._model.eval()
         try:
             with no_grad():
@@ -167,13 +172,36 @@ class Engine:
                     xs = [Tensor._from_value(self._shard_batch(
                         np.asarray(b._value) if isinstance(b, Tensor)
                         else b)) for b in batch]
-                    out = self._model(*xs[:1])
+                    # test_sample_split: how many leading components are
+                    # model inputs (reference engine.predict); default:
+                    # infer from the forward signature (datasets commonly
+                    # yield (x, label) even at predict time)
+                    n_in = test_sample_split if test_sample_split \
+                        else min(len(xs), self._n_forward_inputs())
+                    out = self._model(*xs[:n_in])
                     outs.append(np.asarray(out._value))
                     if steps and i + 1 >= steps:
                         break
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         return outs
+
+    def _n_forward_inputs(self) -> int:
+        """Positional arity of the model's forward (no varargs → cap)."""
+        import inspect
+        try:
+            sig = inspect.signature(self._model.forward)
+        except (TypeError, ValueError):
+            return 1
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind == p.VAR_POSITIONAL:
+                return 10 ** 6   # *args: accept everything
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) \
+                    and p.name != "self":
+                n += 1
+        return max(n, 1)
 
     # -- cost model (parity: static/cost/) ------------------------------------
     def cost(self, inputs_spec=None, mode="train"):

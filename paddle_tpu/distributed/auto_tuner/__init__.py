@@ -49,7 +49,7 @@ def default_candidates(tuner_cfg: Dict) -> List[Dict]:
         if dp * mp * pp != n:
             continue
         for stage in axis("sharding_stage", [1, 2, 3]):
-            for sharding in axis("sharding_degree", [1, dp]):
+            for sharding in axis("sharding_degree", sorted({1, dp})):
                 if sharding > dp or dp % max(sharding, 1):
                     continue
                 for mbs in axis("micro_batch_size", [1, 2, 4, 8]):

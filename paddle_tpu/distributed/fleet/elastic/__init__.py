@@ -87,8 +87,11 @@ class FileKVStore(KVStore):
         out = {}
         for name in os.listdir(self.root):
             if name.startswith(enc) and not name.count(".tmp."):
-                with open(os.path.join(self.root, name)) as f:
-                    out[name.replace("__", "/")] = f.read()
+                try:
+                    with open(os.path.join(self.root, name)) as f:
+                        out[name.replace("__", "/")] = f.read()
+                except FileNotFoundError:
+                    pass   # concurrently deleted by an exiting node
         return out
 
     def mtime(self, key):

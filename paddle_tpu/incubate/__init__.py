@@ -1,3 +1,5 @@
 """paddle_tpu.incubate — experimental APIs (parity: python/paddle/incubate)."""
 from . import distributed
 from . import nn
+from . import optimizer
+from .optimizer import LookAhead, ModelAverage

@@ -1,0 +1,178 @@
+"""Incubate optimizers (parity: python/paddle/incubate/optimizer/ —
+LookAhead lookahead.py, ModelAverage modelaverage.py).
+
+Both are wrappers over an inner optimizer; state lives as jax arrays so
+the slow/averaged copies stay on device (HBM) and updates are fused jit
+calls rather than per-parameter host loops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...autograd.tape import no_grad
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: slow weights track fast weights
+    (parity: paddle.incubate.LookAhead, lookahead.py).
+
+    Every ``k`` inner steps: slow += alpha * (fast - slow); fast = slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the params at wrapper creation (reference
+        # lookahead.py initializes slow_param from param on first step)
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p._value for p in self._parameter_list
+            if not p.stop_gradient}
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k != 0:
+            return
+        a = self.alpha
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p), p._value)
+            slow = slow + a * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["@lookahead_step"] = self._step_num
+        for i, p in enumerate(self._parameter_list):
+            if id(p) in self._slow:
+                out[f"{p.name}_slow"] = Tensor._from_value(
+                    self._slow[id(p)])
+        return out
+
+    def set_state_dict(self, state):
+        self._step_num = int(state.pop("@lookahead_step", 0))
+        for p in self._parameter_list:
+            key = f"{p.name}_slow"
+            if key in state:
+                v = state.pop(key)
+                self._slow[id(p)] = v._value if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+        self.inner_optimizer.set_state_dict(state)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation
+    (parity: paddle.incubate.ModelAverage, modelaverage.py).
+
+    Accumulates sums of parameter values over steps; ``apply()`` swaps the
+    averaged weights in (optionally restoring with ``restore()``).  The
+    reference's num_accumulates/old_num_accumulates windowing
+    (min_average_window/max_average_window) is preserved.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = [p for p in (parameters or [])]
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._sum_1: Dict[int, jnp.ndarray] = {}
+        self._sum_2: Dict[int, jnp.ndarray] = {}
+        self._sum_3: Dict[int, jnp.ndarray] = {}
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+
+    @no_grad()
+    def step(self):
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            pid = id(p)
+            z = jnp.zeros_like(p._value)
+            self._sum_1.setdefault(pid, z)
+            self._sum_2.setdefault(pid, z)
+            self._sum_3.setdefault(pid, z)
+            self._sum_1[pid] = self._sum_1[pid] + p._value
+        self._num_accumulates += 1
+        self._num_updates += 1
+        if self._num_accumulates >= self.max_average_window or \
+                self._num_accumulates >= self.average_window * \
+                self._num_updates:
+            for pid in self._sum_1:
+                self._sum_2[pid] = self._sum_2[pid] + self._sum_1[pid] + \
+                    self._sum_3[pid]
+                self._sum_3[pid] = jnp.zeros_like(self._sum_2[pid])
+                self._sum_1[pid] = jnp.zeros_like(self._sum_2[pid])
+            self._old_num_accumulates += self._num_accumulates
+            self._num_accumulates = 0
+
+    def _averaged(self, p):
+        pid = id(p)
+        total = self._sum_1.get(pid, 0) + self._sum_2.get(pid, 0) + \
+            self._sum_3.get(pid, 0)
+        n = self._num_accumulates + self._old_num_accumulates
+        if n == 0:
+            return p._value
+        return (total / n).astype(p._value.dtype)
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        for p in self._parameter_list:
+            self._backup[id(p)] = p._value
+            p._value = self._averaged(p)
+        self._need_restore = need_restore
+        return _ApplyGuard(self)
+
+    @no_grad()
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+class _ApplyGuard:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self._ma, "_need_restore", True):
+            self._ma.restore()
+        return False

@@ -267,8 +267,17 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, **kwargs):
-    """Parity: @paddle.jit.to_static (python/paddle/jit/api.py:171)."""
+    """Parity: @paddle.jit.to_static (python/paddle/jit/api.py:171).
+
+    full_graph=True → AST-mode StaticFunction (whole-function jax.jit
+    trace with control-flow conversion, reference dy2static).
+    full_graph=False → SOT bytecode tracer (reference jit/sot): records
+    the frame op-by-op, compiles on graph-break-free frames, falls back
+    to eager otherwise."""
     def decorate(fn):
+        if not full_graph:
+            from .sot import SOTFunction
+            return SOTFunction(fn, input_spec, build_strategy)
         return StaticFunction(fn, input_spec, build_strategy, full_graph)
 
     if function is None:

@@ -1,0 +1,41 @@
+"""SOT — bytecode-level symbolic trace for to_static.
+
+Capability parity with the reference's default to_static mode
+(reference: python/paddle/jit/sot/ — eval-frame hook paddle/fluid/pybind/
+eval_frame.c, OpcodeExecutor opcode_translator/executor/opcode_executor.py:1473,
+StatementIR symbolic/statement_ir.py, guards + graph-break fallback
+eval_frame_callback.py:52).
+
+TPU-native design — trace-by-execution over the dispatch choke point:
+
+- The first call of a traced function is interpreted bytecode-by-bytecode
+  by :class:`OpcodeExecutor` (opcode_executor.py) with *real* values: every
+  framework op executes eagerly (so the first call is exactly an eager
+  call, side effects included) while the dispatch choke point
+  (core/dispatch.py `_sot_recorder`) records each op into a
+  :class:`StatementIR`.
+- If the frame finishes without a graph break, the StatementIR is compiled
+  into one `jax.jit` program (the analog of the reference's compiled
+  partial program) and cached under input guards; subsequent calls run the
+  single XLA module through the autograd tape.
+- Graph breaks (data-dependent `if`/`while` on tensor values, host
+  materialization like `.item()`/`print`, unsupported opcodes, explicit
+  seeds) mark the frame eager-only — the honest fallback; unlike CUDA
+  eager, XLA still compiles each op, so fallback stays correct and usable.
+- Randomness: ops pass drawn PRNG keys as visible statement args; the
+  recorder replaces them with fold-ins of a fresh per-call base key, so
+  compiled dropout re-randomizes without retracing.  A key drawn but never
+  seen among statement args poisons the trace (safety net).
+
+Whole-frame fallback replaces the reference's resume-function machinery:
+under XLA there is no perf cliff between "partially compiled" and "eager",
+so correctness-preserving skip-frame is the right TPU trade.
+"""
+from .statement_ir import Statement, StatementIR, Recorder
+from .opcode_executor import OpcodeExecutor, scan_code, GraphBreakReason
+from .translate import SOTFunction, symbolic_translate
+
+__all__ = [
+    "Statement", "StatementIR", "Recorder", "OpcodeExecutor",
+    "scan_code", "GraphBreakReason", "SOTFunction", "symbolic_translate",
+]

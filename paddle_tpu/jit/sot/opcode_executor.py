@@ -1,0 +1,564 @@
+"""OpcodeExecutor: a CPython-3.12 bytecode interpreter for SOT tracing.
+
+Reference analog: python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:1473 (symbolic bytecode interpretation) and the
+eval-frame hook paddle/fluid/pybind/eval_frame.c.
+
+Design (trace-by-execution — see package docstring):
+
+- Values on the simulated stack are *real* objects.  Framework ops execute
+  eagerly (and are recorded at the dispatch choke point by the installed
+  Recorder); pure-Python data flow (containers, arithmetic on scalars,
+  calls) is interpreted opcode-by-opcode.
+- `scan_code` statically whitelists the opcode set BEFORE execution, so
+  the interpreter never aborts mid-frame (side effects run exactly once).
+  Frames using unsupported constructs (try/except, with, generators,
+  match, imports) are skipped — run eagerly, never traced.
+- Dynamic graph breaks (a jump conditioned on a Tensor, iteration over a
+  non-tensor iterator of unknown purity, etc.) do NOT stop execution: the
+  interpreter poisons the Recorder and keeps evaluating with concrete
+  values, so the call still returns the correct eager result.
+- User-defined plain Python functions reachable by CALL are *inlined*
+  (interpreted in a nested frame) when their code passes scan_code, so
+  breaks inside helpers are detected; library calls (paddle_tpu.*, jax,
+  numpy, builtins) execute natively — their tensor work is recorded at
+  dispatch, and host materialization inside them is caught by the
+  Tensor-level poison net.
+- LOAD_GLOBAL / LOAD_DEREF of scalar-like values register guards with the
+  Recorder so a changed global invalidates the cached program.
+"""
+from __future__ import annotations
+
+import dis
+import operator
+import types
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class GraphBreakReason(Exception):
+    """Raised only by scan_code users — never escapes run()."""
+
+
+class _NullType:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<NULL>"
+
+
+NULL = _NullType()
+
+_CO_GENERATOR = 0x20
+_CO_COROUTINE = 0x80
+_CO_ASYNC_GENERATOR = 0x200
+_CO_VARARGS = 0x04
+_CO_VARKEYWORDS = 0x08
+
+# opcode families the interpreter implements (CPython 3.12)
+SUPPORTED_OPS = frozenset([
+    "RESUME", "CACHE", "NOP", "EXTENDED_ARG", "PRECALL",
+    "POP_TOP", "COPY", "SWAP", "PUSH_NULL",
+    "LOAD_CONST", "RETURN_CONST", "RETURN_VALUE",
+    "LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_FAST_AND_CLEAR", "STORE_FAST",
+    "DELETE_FAST",
+    "LOAD_GLOBAL", "LOAD_NAME",
+    "LOAD_DEREF", "STORE_DEREF", "LOAD_CLOSURE", "MAKE_CELL",
+    "COPY_FREE_VARS",
+    "LOAD_ATTR", "STORE_ATTR",
+    "BINARY_OP", "UNARY_NEGATIVE", "UNARY_NOT", "UNARY_INVERT",
+    "COMPARE_OP", "IS_OP", "CONTAINS_OP",
+    "POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE", "POP_JUMP_IF_NONE",
+    "POP_JUMP_IF_NOT_NONE", "JUMP_FORWARD", "JUMP_BACKWARD",
+    "JUMP_BACKWARD_NO_INTERRUPT",
+    "GET_ITER", "FOR_ITER", "END_FOR",
+    "BUILD_TUPLE", "BUILD_LIST", "BUILD_MAP", "BUILD_SET",
+    "BUILD_CONST_KEY_MAP", "BUILD_SLICE", "BUILD_STRING",
+    "LIST_EXTEND", "LIST_APPEND", "SET_ADD", "SET_UPDATE", "MAP_ADD",
+    "DICT_MERGE", "DICT_UPDATE", "FORMAT_VALUE",
+    "BINARY_SUBSCR", "STORE_SUBSCR", "DELETE_SUBSCR",
+    "BINARY_SLICE", "STORE_SLICE",
+    "UNPACK_SEQUENCE", "UNPACK_EX",
+    "CALL", "KW_NAMES", "CALL_FUNCTION_EX", "CALL_INTRINSIC_1",
+    "MAKE_FUNCTION", "RETURN_GENERATOR",
+])
+
+_SUPPORTED_INTRINSICS = frozenset([
+    "INTRINSIC_1_INVALID", "INTRINSIC_UNARY_POSITIVE",
+    "INTRINSIC_LIST_TO_TUPLE",
+])
+
+# modules whose functions execute natively (never inlined) — the framework
+# itself plus numeric/std libraries whose internals are trace-safe
+_NATIVE_PREFIXES = (
+    "paddle_tpu", "jax", "numpy", "builtins", "math", "functools",
+    "itertools", "operator", "collections", "typing", "contextlib",
+    "threading", "copy", "abc", "enum", "warnings", "os", "re",
+)
+
+_BINARY_OPS = {
+    "+": operator.add, "-": operator.sub, "*": operator.mul,
+    "/": operator.truediv, "//": operator.floordiv, "%": operator.mod,
+    "**": operator.pow, "<<": operator.lshift, ">>": operator.rshift,
+    "&": operator.and_, "|": operator.or_, "^": operator.xor,
+    "@": operator.matmul,
+    "+=": operator.iadd, "-=": operator.isub, "*=": operator.imul,
+    "/=": operator.itruediv, "//=": operator.ifloordiv,
+    "%=": operator.imod, "**=": operator.ipow, "<<=": operator.ilshift,
+    ">>=": operator.irshift, "&=": operator.iand, "|=": operator.ior,
+    "^=": operator.ixor, "@=": operator.imatmul,
+}
+
+_COMPARE_OPS = {
+    "<": operator.lt, "<=": operator.le, "==": operator.eq,
+    "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
+}
+
+
+def scan_code(code: types.CodeType) -> Optional[str]:
+    """Return None if the interpreter fully supports this code object,
+    else a human-readable reason (→ skip frame, run eagerly)."""
+    if code.co_flags & (_CO_GENERATOR | _CO_COROUTINE | _CO_ASYNC_GENERATOR):
+        return "generator/coroutine"
+    for ins in dis.get_instructions(code):
+        if ins.opname not in SUPPORTED_OPS:
+            return f"unsupported opcode {ins.opname}"
+        if ins.opname == "CALL_INTRINSIC_1" \
+                and ins.argrepr not in _SUPPORTED_INTRINSICS:
+            return f"unsupported intrinsic {ins.argrepr}"
+        if ins.opname == "RETURN_GENERATOR":
+            return "generator"
+    return None
+
+
+def _is_tensor(v) -> bool:
+    from ...core.tensor import Tensor
+    return isinstance(v, Tensor)
+
+
+class OpcodeExecutor:
+    """Interprets one frame (and inlined user callees) with real values."""
+
+    def __init__(self, recorder, depth: int = 0):
+        self.recorder = recorder
+        self.depth = depth
+
+    # -- inlining decision ---------------------------------------------------
+    def _inlinable(self, fn) -> bool:
+        if self.depth >= 8:
+            return False
+        target = fn
+        if isinstance(target, types.MethodType):
+            target = target.__func__
+        if not isinstance(target, types.FunctionType):
+            return False
+        mod = getattr(target, "__module__", None) or ""
+        for p in _NATIVE_PREFIXES:
+            if mod == p or mod.startswith(p + "."):
+                return False
+        if getattr(target, "_not_to_static", False):
+            return False
+        return scan_code(target.__code__) is None
+
+    # -- frame entry ---------------------------------------------------------
+    def run(self, fn, args: tuple, kwargs: dict):
+        """Interpret ``fn(*args, **kwargs)`` and return its result."""
+        target = fn
+        self_arg = None
+        if isinstance(target, types.MethodType):
+            self_arg = target.__self__
+            target = target.__func__
+        code = target.__code__
+        f_locals = self._bind(target, code,
+                              (self_arg,) + tuple(args)
+                              if self_arg is not None else tuple(args),
+                              kwargs)
+        return self._run_code(code, f_locals,
+                              target.__globals__,
+                              target.__closure__ or (),
+                              getattr(target, "__builtins__", None))
+
+    def _bind(self, fn, code, args, kwargs) -> Dict[str, Any]:
+        import inspect
+        try:
+            sig = inspect.signature(fn)
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            f_locals = dict(bound.arguments)
+        except (TypeError, ValueError):
+            # let the real call raise the real error
+            raise
+        # normalize *args/**kwargs slots to their co_varnames names
+        return f_locals
+
+    # -- main loop -----------------------------------------------------------
+    def _run_code(self, code, f_locals, f_globals, closure, builtins_ns):
+        instructions = list(dis.get_instructions(code))
+        by_offset = {ins.offset: i for i, ins in enumerate(instructions)}
+        stack: List[Any] = []
+        # cells: co_cellvars are fresh cells (MAKE_CELL initializes them,
+        # possibly from a local); co_freevars come from the closure
+        cells: Dict[str, Any] = {}
+        for i, name in enumerate(code.co_freevars):
+            cells[name] = closure[i]
+        kw_names: Tuple[str, ...] = ()
+        builtins_mod = builtins_ns
+        if builtins_mod is None:
+            import builtins as _b
+            builtins_mod = _b
+        builtins_dict = builtins_mod.__dict__ \
+            if hasattr(builtins_mod, "__dict__") else builtins_mod
+
+        rec = self.recorder
+        ip = 0
+        while True:
+            ins = instructions[ip]
+            op = ins.opname
+            arg = ins.arg
+
+            if op in ("RESUME", "CACHE", "NOP", "EXTENDED_ARG", "PRECALL",
+                      "MAKE_CELL", "COPY_FREE_VARS"):
+                if op == "MAKE_CELL":
+                    name = ins.argval
+                    cells[name] = types.CellType(f_locals[name]) \
+                        if name in f_locals else types.CellType()
+                ip += 1
+                continue
+
+            if op == "POP_TOP":
+                stack.pop()
+            elif op == "COPY":
+                stack.append(stack[-arg])
+            elif op == "SWAP":
+                stack[-1], stack[-arg] = stack[-arg], stack[-1]
+            elif op == "PUSH_NULL":
+                stack.append(NULL)
+
+            elif op == "LOAD_CONST":
+                stack.append(ins.argval)
+            elif op == "RETURN_CONST":
+                return ins.argval
+            elif op == "RETURN_VALUE":
+                return stack.pop()
+
+            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
+                stack.append(f_locals[ins.argval])
+            elif op == "LOAD_FAST_AND_CLEAR":
+                stack.append(f_locals.pop(ins.argval, None))
+            elif op == "STORE_FAST":
+                f_locals[ins.argval] = stack.pop()
+            elif op == "DELETE_FAST":
+                del f_locals[ins.argval]
+
+            elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+                if op == "LOAD_GLOBAL" and arg & 1:
+                    stack.append(NULL)
+                name = ins.argval
+                if name in f_globals:
+                    val = f_globals[name]
+                    self._guard_env("global", name, val)
+                elif name in builtins_dict:
+                    val = builtins_dict[name]
+                else:
+                    raise NameError(f"name '{name}' is not defined")
+                stack.append(val)
+
+            elif op in ("LOAD_DEREF", "LOAD_CLOSURE"):
+                name = ins.argval
+                if op == "LOAD_CLOSURE":
+                    stack.append(cells[name])
+                else:
+                    val = cells[name].cell_contents
+                    self._guard_env("deref", name, val)
+                    stack.append(val)
+            elif op == "STORE_DEREF":
+                name = ins.argval
+                if name not in cells:
+                    cells[name] = types.CellType()
+                cells[name].cell_contents = stack.pop()
+
+            elif op == "LOAD_ATTR":
+                owner = stack.pop()
+                name = ins.argval
+                if arg & 1:
+                    # method form: push (unbound, self) or (NULL, attr)
+                    attr = getattr(owner, name)
+                    if isinstance(attr, types.MethodType) \
+                            and attr.__self__ is owner:
+                        stack.append(attr.__func__)
+                        stack.append(owner)
+                    else:
+                        stack.append(NULL)
+                        stack.append(attr)
+                else:
+                    stack.append(getattr(owner, name))
+            elif op == "STORE_ATTR":
+                owner = stack.pop()
+                val = stack.pop()
+                setattr(owner, ins.argval, val)
+
+            elif op == "BINARY_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                fn = _BINARY_OPS.get(ins.argrepr)
+                if fn is None:
+                    raise RuntimeError(f"BINARY_OP {ins.argrepr}")
+                stack.append(fn(lhs, rhs))
+            elif op == "UNARY_NEGATIVE":
+                stack.append(-stack.pop())
+            elif op == "UNARY_NOT":
+                v = stack.pop()
+                if _is_tensor(v):
+                    rec.poison("`not` on a tensor value")
+                stack.append(not v)
+            elif op == "UNARY_INVERT":
+                stack.append(~stack.pop())
+
+            elif op == "COMPARE_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                fn = _COMPARE_OPS.get(ins.argrepr.strip())
+                if fn is None:
+                    raise RuntimeError(f"COMPARE_OP {ins.argrepr}")
+                stack.append(fn(lhs, rhs))
+            elif op == "IS_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                stack.append((lhs is not rhs) if arg else (lhs is rhs))
+            elif op == "CONTAINS_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                if _is_tensor(rhs) or _is_tensor(lhs):
+                    rec.poison("`in` on a tensor value")
+                res = lhs in rhs
+                stack.append((not res) if arg else res)
+
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                v = stack.pop()
+                if _is_tensor(v):
+                    rec.poison("data-dependent branch on tensor value")
+                truth = bool(v)
+                want = (op == "POP_JUMP_IF_TRUE")
+                if truth == want:
+                    ip = by_offset[ins.argval]
+                    continue
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = stack.pop()
+                is_none = v is None
+                want = (op == "POP_JUMP_IF_NONE")
+                if is_none == want:
+                    ip = by_offset[ins.argval]
+                    continue
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
+                        "JUMP_BACKWARD_NO_INTERRUPT"):
+                ip = by_offset[ins.argval]
+                continue
+
+            elif op == "GET_ITER":
+                v = stack.pop()
+                stack.append(iter(v))
+            elif op == "FOR_ITER":
+                it = stack[-1]
+                try:
+                    stack.append(next(it))
+                except StopIteration:
+                    # 3.12: leave iterator; push exhaustion marker; jump
+                    # to the END_FOR at the target, which pops both
+                    stack.append(None)
+                    ip = by_offset[ins.argval]
+                    continue
+            elif op == "END_FOR":
+                stack.pop()
+                stack.pop()
+
+            elif op == "BUILD_TUPLE":
+                vals = stack[len(stack) - arg:] if arg else []
+                del stack[len(stack) - arg:]
+                stack.append(tuple(vals))
+            elif op == "BUILD_LIST":
+                vals = stack[len(stack) - arg:] if arg else []
+                del stack[len(stack) - arg:]
+                stack.append(list(vals))
+            elif op == "BUILD_SET":
+                vals = stack[len(stack) - arg:] if arg else []
+                del stack[len(stack) - arg:]
+                stack.append(set(vals))
+            elif op == "BUILD_MAP":
+                items = stack[len(stack) - 2 * arg:] if arg else []
+                del stack[len(stack) - 2 * arg:]
+                stack.append({items[i]: items[i + 1]
+                              for i in range(0, len(items), 2)})
+            elif op == "BUILD_CONST_KEY_MAP":
+                keys = stack.pop()
+                vals = stack[len(stack) - arg:]
+                del stack[len(stack) - arg:]
+                stack.append(dict(zip(keys, vals)))
+            elif op == "BUILD_SLICE":
+                if arg == 3:
+                    step = stack.pop()
+                else:
+                    step = None
+                stop = stack.pop()
+                start = stack.pop()
+                stack.append(slice(start, stop, step))
+            elif op == "BUILD_STRING":
+                parts = stack[len(stack) - arg:]
+                del stack[len(stack) - arg:]
+                stack.append("".join(parts))
+            elif op == "FORMAT_VALUE":
+                have_spec = arg & 0x04
+                spec = stack.pop() if have_spec else ""
+                v = stack.pop()
+                conv = arg & 0x03
+                if conv == 1:
+                    v = str(v)
+                elif conv == 2:
+                    v = repr(v)
+                elif conv == 3:
+                    v = ascii(v)
+                stack.append(format(v, spec))
+
+            elif op == "LIST_EXTEND":
+                seq = stack.pop()
+                stack[-arg].extend(seq)
+            elif op == "LIST_APPEND":
+                v = stack.pop()
+                stack[-arg].append(v)
+            elif op == "SET_ADD":
+                v = stack.pop()
+                stack[-arg].add(v)
+            elif op == "SET_UPDATE":
+                seq = stack.pop()
+                stack[-arg].update(seq)
+            elif op == "MAP_ADD":
+                value = stack.pop()
+                key_ = stack.pop()
+                stack[-arg][key_] = value
+            elif op in ("DICT_MERGE", "DICT_UPDATE"):
+                other = stack.pop()
+                stack[-arg].update(other)
+
+            elif op == "BINARY_SUBSCR":
+                idx = stack.pop()
+                obj = stack.pop()
+                stack.append(obj[idx])
+            elif op == "STORE_SUBSCR":
+                idx = stack.pop()
+                obj = stack.pop()
+                val = stack.pop()
+                obj[idx] = val
+            elif op == "DELETE_SUBSCR":
+                idx = stack.pop()
+                obj = stack.pop()
+                del obj[idx]
+            elif op == "BINARY_SLICE":
+                stop = stack.pop()
+                start = stack.pop()
+                obj = stack.pop()
+                stack.append(obj[start:stop])
+            elif op == "STORE_SLICE":
+                stop = stack.pop()
+                start = stack.pop()
+                obj = stack.pop()
+                val = stack.pop()
+                obj[start:stop] = val
+
+            elif op == "UNPACK_SEQUENCE":
+                seq = stack.pop()
+                vals = list(seq)
+                if len(vals) != arg:
+                    raise ValueError(
+                        f"not enough values to unpack (expected {arg})")
+                stack.extend(reversed(vals))
+            elif op == "UNPACK_EX":
+                before = arg & 0xFF
+                after = arg >> 8
+                seq = list(stack.pop())
+                rest = seq[before:len(seq) - after] \
+                    if after else seq[before:]
+                tail = seq[len(seq) - after:] if after else []
+                for v in reversed(tail):
+                    stack.append(v)
+                stack.append(rest)
+                for v in reversed(seq[:before]):
+                    stack.append(v)
+
+            elif op == "KW_NAMES":
+                kw_names = ins.argval
+            elif op == "CALL":
+                argc = arg
+                call_args = stack[len(stack) - argc:] if argc else []
+                del stack[len(stack) - argc:]
+                self_or_null = stack.pop()
+                callable_ = stack.pop()
+                if callable_ is NULL:
+                    callable_ = self_or_null
+                elif self_or_null is not NULL:
+                    call_args = [self_or_null] + call_args
+                if kw_names:
+                    n_kw = len(kw_names)
+                    kw = dict(zip(kw_names, call_args[len(call_args) - n_kw:]))
+                    call_args = call_args[:len(call_args) - n_kw]
+                    kw_names = ()
+                else:
+                    kw = {}
+                stack.append(self._call(callable_, call_args, kw))
+            elif op == "CALL_FUNCTION_EX":
+                kw = stack.pop() if arg & 1 else {}
+                pos = list(stack.pop())
+                self_or_null = stack.pop()
+                callable_ = stack.pop()
+                if callable_ is NULL:
+                    callable_ = self_or_null
+                elif self_or_null is not NULL:
+                    pos = [self_or_null] + pos
+                stack.append(self._call(callable_, pos, dict(kw)))
+            elif op == "CALL_INTRINSIC_1":
+                which = ins.argrepr
+                v = stack.pop()
+                if which == "INTRINSIC_UNARY_POSITIVE":
+                    stack.append(+v)
+                elif which == "INTRINSIC_LIST_TO_TUPLE":
+                    stack.append(tuple(v))
+                else:
+                    raise RuntimeError(f"intrinsic {which}")
+
+            elif op == "MAKE_FUNCTION":
+                fcode = stack.pop()
+                closure_t = stack.pop() if arg & 0x08 else None
+                annotations = stack.pop() if arg & 0x04 else None
+                kwdefaults = stack.pop() if arg & 0x02 else None
+                defaults = stack.pop() if arg & 0x01 else None
+                new_fn = types.FunctionType(
+                    fcode, f_globals, fcode.co_name,
+                    tuple(defaults) if defaults else None,
+                    tuple(closure_t) if closure_t else None)
+                if kwdefaults:
+                    new_fn.__kwdefaults__ = dict(kwdefaults)
+                if annotations:
+                    new_fn.__annotations__ = dict(annotations)
+                stack.append(new_fn)
+
+            else:   # pragma: no cover — scan_code should prevent this
+                raise RuntimeError(f"unhandled opcode {op}")
+
+            ip += 1
+
+    # -- calls ---------------------------------------------------------------
+    def _call(self, callable_, args: list, kwargs: dict):
+        if self._inlinable(callable_):
+            sub = OpcodeExecutor(self.recorder, self.depth + 1)
+            return sub.run(callable_, tuple(args), kwargs)
+        return callable_(*args, **kwargs)
+
+    # -- guards --------------------------------------------------------------
+    def _guard_env(self, kind: str, name: str, val):
+        if self.depth > 0:
+            return   # guard only the entry frame's environment
+        if isinstance(val, (int, float, bool, str, bytes, type(None))):
+            self.recorder.add_env_guard(kind, name, val)
+        else:
+            # objects (layers, modules, functions) guard by identity: a
+            # rebound global must invalidate the cached program
+            self.recorder.add_env_guard(kind + "_id", name, id(val))

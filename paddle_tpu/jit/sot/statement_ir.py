@@ -1,0 +1,261 @@
+"""StatementIR: the linear op-trace recorded at the dispatch choke point.
+
+Reference analog: python/paddle/jit/sot/symbolic/statement_ir.py (the IR the
+OpcodeExecutor emits) + compile_cache.py (compilation into a partial
+program).  Here a statement is the (pure jax fn, args) pair that
+core.dispatch.apply_op executed; replay chains the same pure fns inside one
+jax.jit, so the compiled artifact is a single XLA module.
+
+Symbols are keyed on id(jax.Array).  jax arrays are immutable, and the
+recorder keeps every seen array alive for the duration of the trace, so an
+id uniquely names a value.  In-place Tensor ops swap `t._value`, which
+automatically re-points the Tensor at the new symbol — aliasing is free.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class Statement:
+    """One recorded op: name, the pure fn, and how to rebuild its args.
+
+    arg_spec entries: ("s", sym) — a traced value; ("c", value) — a baked
+    constant; ("r", slot) — an RNG key slot refreshed per replay.
+    """
+
+    __slots__ = ("name", "fn", "arg_spec", "kwargs", "cast_to", "out_syms")
+
+    def __init__(self, name, fn, arg_spec, kwargs, cast_to, out_syms):
+        self.name = name
+        self.fn = fn
+        self.arg_spec = arg_spec
+        self.kwargs = kwargs
+        self.cast_to = cast_to
+        self.out_syms = out_syms
+
+    def __repr__(self):
+        args = ", ".join(
+            f"%{s}" if k == "s" else f"rng{s}" if k == "r" else repr(s)
+            for k, s in self.arg_spec)
+        outs = ", ".join(f"%{s}" for s in self.out_syms)
+        return f"{outs} = {self.name}({args})"
+
+
+class StatementIR:
+    """A finalized trace: inputs, captures, statements, outputs,
+    mutation write-backs."""
+
+    def __init__(self, input_syms, captures, statements, n_rng,
+                 out_syms, out_tree, out_consts, writebacks):
+        self.input_syms = input_syms          # syms of user tensor inputs
+        self.captures = captures              # [(Tensor ref, sym)]
+        self.statements = statements
+        self.n_rng = n_rng
+        self.out_syms = out_syms              # syms of tensor output leaves
+        self.out_tree = out_tree              # treedef of the return value
+        self.out_consts = out_consts          # non-tensor leaves (baked)
+        self.writebacks = writebacks          # [(Tensor ref, sym)]
+
+    def __repr__(self):
+        body = "\n  ".join(repr(s) for s in self.statements)
+        return (f"StatementIR(inputs={self.input_syms}, "
+                f"captures={len(self.captures)}, rng={self.n_rng}, "
+                f"writebacks={len(self.writebacks)})\n  {body}")
+
+
+class TraceInvalid(Exception):
+    """Recording cannot produce a replayable program (graph break)."""
+
+
+class Recorder:
+    """Collects statements from apply_op while a frame is interpreted.
+
+    Installed into core.dispatch._sot_recorder for the duration of the
+    recording call.  ``poisoned`` marks the trace non-replayable; execution
+    continues (the recording call is a correct eager call regardless).
+    """
+
+    def __init__(self):
+        self.statements: List[Statement] = []
+        self._sym_of: Dict[int, int] = {}       # id(array) -> sym
+        self._next_sym = 0
+        self._keepalive: List[Any] = []         # pin arrays so ids are stable
+        self._inputs: List[Tuple[Any, int, int]] = []  # (Tensor, sym, id0)
+        self._captures: Dict[int, Tuple[Any, int]] = {}  # id(arr) -> (T, sym)
+        self._rng_pending: Dict[int, Any] = {}  # id(key) -> key
+        self._rng_slots: Dict[int, int] = {}    # id(key) -> slot
+        self.poisoned = False
+        self.reason: Optional[str] = None
+        self.env_guards: List[Tuple[str, Any, Any]] = []
+
+    # -- symbols -------------------------------------------------------------
+    def _new_sym(self, arr) -> int:
+        sym = self._next_sym
+        self._next_sym += 1
+        self._sym_of[id(arr)] = sym
+        self._keepalive.append(arr)
+        return sym
+
+    def declare_input(self, tensor) -> int:
+        sym = self._new_sym(tensor._value)
+        self._inputs.append((tensor, sym, id(tensor._value)))
+        return sym
+
+    def register_rng_key(self, key):
+        self._rng_pending[id(key)] = key
+        self._keepalive.append(key)
+
+    def poison(self, reason: str):
+        if not self.poisoned:
+            self.poisoned = True
+            self.reason = reason
+
+    def add_env_guard(self, kind: str, info: Any, expected: Any):
+        self.env_guards.append((kind, info, expected))
+
+    # -- recording (called from core.dispatch) -------------------------------
+    def record(self, name, fn, tensor_args, kwargs, outs, multi_output,
+               cast_to):
+        if self.poisoned:
+            return
+        from ...core.tensor import Tensor
+        arg_spec = []
+        for a in tensor_args:
+            if isinstance(a, Tensor):
+                aid = id(a._value)
+                sym = self._sym_of.get(aid)
+                if sym is None:
+                    sym = self._capture(a)
+                arg_spec.append(("s", sym))
+            elif isinstance(a, jax.Array):
+                aid = id(a)
+                if aid in self._rng_slots:
+                    arg_spec.append(("r", self._rng_slots[aid]))
+                elif aid in self._rng_pending:
+                    slot = len(self._rng_slots)
+                    self._rng_slots[aid] = slot
+                    del self._rng_pending[aid]
+                    arg_spec.append(("r", slot))
+                elif aid in self._sym_of:
+                    arg_spec.append(("s", self._sym_of[aid]))
+                else:
+                    # unknown raw array: bake (e.g. precomputed masks)
+                    self._keepalive.append(a)
+                    arg_spec.append(("c", a))
+            elif isinstance(a, np.ndarray):
+                arg_spec.append(("c", a))
+            elif isinstance(a, (int, float, bool, str, bytes, type(None),
+                                tuple, list, np.integer, np.floating)):
+                arg_spec.append(("c", a))
+            else:
+                self.poison(f"op {name}: unrecordable arg {type(a)}")
+                return
+        for v in (kwargs or {}).values():
+            if isinstance(v, (Tensor, jax.Array)):
+                self.poison(f"op {name}: tensor-valued kwarg")
+                return
+        out_list = outs if isinstance(outs, tuple) else (outs,)
+        out_syms = [self._new_sym(t._value) for t in out_list]
+        self.statements.append(Statement(
+            name, fn, arg_spec, dict(kwargs or {}), cast_to, out_syms))
+
+    def _capture(self, tensor) -> int:
+        aid = id(tensor._value)
+        sym = self._new_sym(tensor._value)
+        self._captures[aid] = (tensor, sym)
+        return sym
+
+    # -- finalize ------------------------------------------------------------
+    def finalize(self, result) -> StatementIR:
+        from ...core.tensor import Tensor
+        if self.poisoned:
+            raise TraceInvalid(self.reason)
+        if self._rng_pending:
+            raise TraceInvalid(
+                "rng key drawn during trace but never reached a recorded "
+                "statement (op draws its key through a closure)")
+
+        flat, tree = jax.tree_util.tree_flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        out_syms, out_consts = [], []
+        for leaf in flat:
+            if isinstance(leaf, Tensor):
+                sym = self._sym_of.get(id(leaf._value))
+                if sym is None:
+                    sym = self._capture(leaf)   # returned param/constant
+                out_syms.append(sym)
+                out_consts.append(None)
+            else:
+                out_syms.append(None)
+                out_consts.append(leaf)
+
+        # mutation write-backs: inputs or captures whose _value was swapped
+        # to a traced array during the frame (BN running stats, in-place
+        # ops on args) get the new value written back at replay
+        writebacks = []
+        seen = set()
+        for tensor, sym, id0 in self._inputs:
+            cur = id(tensor._value)
+            if cur != id0 and id(tensor) not in seen:
+                new_sym = self._sym_of.get(cur)
+                if new_sym is None:
+                    raise TraceInvalid("input mutated to untraced value")
+                writebacks.append((tensor, new_sym))
+                seen.add(id(tensor))
+        for aid, (tensor, sym) in list(self._captures.items()):
+            cur = id(tensor._value)
+            if cur != aid and id(tensor) not in seen:
+                new_sym = self._sym_of.get(cur)
+                if new_sym is None:
+                    raise TraceInvalid("capture mutated to untraced value")
+                writebacks.append((tensor, new_sym))
+                seen.add(id(tensor))
+
+        captures = [(t, sym) for (t, sym) in self._captures.values()]
+        input_syms = [sym for (_, sym, _) in self._inputs]
+        return StatementIR(input_syms, captures, self.statements,
+                           len(self._rng_slots), out_syms, tree,
+                           out_consts, writebacks)
+
+
+def build_replay(ir: StatementIR) -> Callable:
+    """Compile the IR into a pure function
+    ``replay(base_key, *capture_arrays, *input_arrays) -> tuple`` suitable
+    for jax.jit + apply_op dispatch (grads flow to captures and inputs)."""
+    from ...core.dispatch import _amp_cast
+
+    n_cap = len(ir.captures)
+    cap_syms = [sym for (_, sym) in ir.captures]
+    tensor_out_syms = [s for s in ir.out_syms if s is not None]
+    wb_syms = [sym for (_, sym) in ir.writebacks]
+
+    def replay(base_key, *arrays):
+        env: Dict[int, Any] = {}
+        for sym, arr in zip(cap_syms, arrays[:n_cap]):
+            env[sym] = arr
+        for sym, arr in zip(ir.input_syms, arrays[n_cap:]):
+            env[sym] = arr
+        rng = [jax.random.fold_in(base_key, i) for i in range(ir.n_rng)]
+        for st in ir.statements:
+            vals = []
+            for kind, v in st.arg_spec:
+                if kind == "s":
+                    vals.append(env[v])
+                elif kind == "r":
+                    vals.append(rng[v])
+                else:
+                    vals.append(v)
+            if st.cast_to is not None:
+                vals = [_amp_cast(v, st.cast_to) for v in vals]
+            out = st.fn(*vals, **st.kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for sym, v in zip(st.out_syms, outs):
+                env[sym] = v
+        return tuple(env[s] for s in tensor_out_syms + wb_syms)
+
+    return replay

@@ -1,0 +1,223 @@
+"""symbolic_translate / SOTFunction: the SOT entry point.
+
+Reference analog: python/paddle/jit/sot/translate.py (installs the
+eval-frame hook) + eval_frame_callback.py:52 (guard check, compile cache,
+graph-break fallback).  Here the "frame hook" is SOTFunction.__call__:
+
+call 1 (per guard set): interpret the frame bytecode with OpcodeExecutor
+    while a Recorder logs every dispatched op → StatementIR → jax.jit
+    replay program.  The call itself IS a correct eager call (real values,
+    single side effects), so its result is returned directly.
+call 2+: guards hit → run the compiled XLA module through apply_op (one
+    tape node; backward runs the compiled VJP), apply buffer write-backs.
+poisoned / unsupported frames: cached as "skip" — run eagerly forever,
+    with the break reason kept for introspection.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from ...core.tensor import Tensor
+from ...core import dispatch as _dispatch
+from ...core.dispatch import apply_op
+from ...ops import random as _random
+from .statement_ir import Recorder, StatementIR, TraceInvalid, build_replay
+from .opcode_executor import OpcodeExecutor, scan_code
+
+
+def _leaf_sig(a):
+    if isinstance(a, Tensor):
+        return ("T", tuple(a._value.shape), str(a._value.dtype))
+    if isinstance(a, (int, float, str, bool, type(None))):
+        return ("P", a)
+    return ("P", repr(a))
+
+
+class _CompiledEntry:
+    __slots__ = ("jit_fn", "ir", "env_guards")
+
+    def __init__(self, jit_fn, ir, env_guards):
+        self.jit_fn = jit_fn
+        self.ir = ir
+        self.env_guards = env_guards
+
+
+class SOTFunction:
+    """Bytecode-traced callable (reference SymbolicStaticFunction,
+    python/paddle/jit/dy2static/program_translator.py:704)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None):
+        self._fn = function
+        self._cache: Dict[Any, Any] = {}
+        self._layers = None
+        self.graph_break_reason: Optional[str] = None
+        self.__name__ = getattr(function, "__name__", "sot_fn")
+        functools.update_wrapper(self, function,
+                                 assigned=("__doc__", "__module__"),
+                                 updated=())
+
+    # -- helpers -------------------------------------------------------------
+    def _eager_call(self):
+        from ...nn.layer_base import Layer
+        return self._fn.forward if isinstance(self._fn, Layer) else self._fn
+
+    def _target_code(self):
+        from ...nn.layer_base import Layer
+        fn = self._eager_call()
+        fn = getattr(fn, "__func__", fn)
+        return getattr(fn, "__code__", None)
+
+    def _modes(self):
+        from ..api import _find_layers
+        if self._layers is None:
+            self._layers = _find_layers(self._fn)
+        return tuple(l.training for layer in self._layers
+                     for _, l in layer.named_sublayers(include_self=True))
+
+    def _check_env_guards(self, guards) -> bool:
+        fn = self._eager_call()
+        fn = getattr(fn, "__func__", fn)
+        glb = getattr(fn, "__globals__", {})
+        code = getattr(fn, "__code__", None)
+        closure = getattr(fn, "__closure__", None) or ()
+        freevars = code.co_freevars if code is not None else ()
+        cellmap = dict(zip(freevars, closure))
+        for kind, name, expected in guards:
+            if kind == "global":
+                if glb.get(name, _MISSING) != expected:
+                    return False
+            elif kind == "global_id":
+                if id(glb.get(name, _MISSING)) != expected:
+                    return False
+            elif kind in ("deref", "deref_id"):
+                cell = cellmap.get(name)
+                if cell is None:
+                    return False
+                try:
+                    val = cell.cell_contents
+                except ValueError:
+                    return False
+                if kind == "deref":
+                    if val != expected:
+                        return False
+                elif id(val) != expected:
+                    return False
+        return True
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        from ..api import _TO_STATIC_ENABLED
+        if not _TO_STATIC_ENABLED[0] \
+                or getattr(self._fn, "_not_to_static", False) \
+                or _dispatch._sot_recorder[0] is not None:
+            # disabled, opted out, or already inside an outer SOT trace
+            # (the outer recorder sees our ops straight through dispatch)
+            return self._eager_call()(*args, **kwargs)
+
+        code = self._target_code()
+        if code is None:
+            return self._eager_call()(*args, **kwargs)
+        scan_reason = scan_code(code)
+        if scan_reason is not None:
+            self.graph_break_reason = scan_reason
+            return self._eager_call()(*args, **kwargs)
+
+        flat_args, arg_tree = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        flat_args = [Tensor(a) if isinstance(a, np.ndarray) else a
+                     for a in flat_args]
+        amp = _dispatch._amp_state
+        amp_sig = (amp["enabled"], str(amp["dtype"]), amp["level"])
+        key = (str(arg_tree), tuple(_leaf_sig(a) for a in flat_args),
+               self._modes(), amp_sig)
+
+        entry = self._cache.get(key)
+        if isinstance(entry, _CompiledEntry):
+            if self._captures_valid(entry) \
+                    and self._check_env_guards(entry.env_guards):
+                return self._run_compiled(entry, arg_tree, flat_args)
+            del self._cache[key]   # stale: re-record below
+            entry = None
+        elif entry is not None:    # ("skip", reason)
+            self.graph_break_reason = entry[1]
+            return self._eager_call()(*args, **kwargs)
+
+        return self._record(key, arg_tree, flat_args)
+
+    # -- recording path ------------------------------------------------------
+    def _record(self, key, arg_tree, flat_args):
+        args, kwargs = jax.tree_util.tree_unflatten(arg_tree, flat_args)
+        rec = Recorder()
+        for a in flat_args:
+            if isinstance(a, Tensor):
+                rec.declare_input(a)
+
+        _dispatch._sot_recorder[0] = rec
+        try:
+            executor = OpcodeExecutor(rec)
+            result = executor.run(self._eager_call(), args, kwargs)
+        finally:
+            _dispatch._sot_recorder[0] = None
+
+        try:
+            ir = rec.finalize(result)
+        except TraceInvalid as e:
+            self.graph_break_reason = str(e)
+            self._cache[key] = ("skip", str(e))
+            return result
+
+        jit_fn = jax.jit(build_replay(ir))
+        self._cache[key] = _CompiledEntry(jit_fn, ir, rec.env_guards)
+        return result
+
+    # -- compiled path -------------------------------------------------------
+    def _captures_valid(self, entry) -> bool:
+        for t, _ in entry.ir.captures:
+            if t._value is None:
+                return False
+        return True
+
+    def _run_compiled(self, entry, arg_tree, flat_args):
+        ir = entry.ir
+        base_key = _random.next_key()
+        capture_tensors = [t for t, _ in ir.captures]
+        input_tensors = [a for a in flat_args if isinstance(a, Tensor)]
+        outs = apply_op(f"sot_compiled::{self.__name__}", entry.jit_fn,
+                        (base_key, *capture_tensors, *input_tensors))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        n_wb = len(ir.writebacks)
+        if n_wb:
+            for (t, _), new in zip(ir.writebacks, outs[len(outs) - n_wb:]):
+                t._value = new._value
+            outs = outs[: len(outs) - n_wb]
+        # reassemble the return-value tree: tensor leaves from outputs,
+        # non-tensor leaves from baked constants
+        leaves = []
+        it = iter(outs)
+        for sym, const in zip(ir.out_syms, ir.out_consts):
+            leaves.append(next(it) if sym is not None else const)
+        return jax.tree_util.tree_unflatten(ir.out_tree, leaves)
+
+
+class _Missing:
+    def __eq__(self, other):
+        return False
+
+    def __repr__(self):
+        return "<missing>"
+
+
+_MISSING = _Missing()
+
+
+def symbolic_translate(fn=None, **kwargs):
+    """Parity: paddle.jit.sot.symbolic_translate (translate.py:99)."""
+    if fn is None:
+        return lambda f: SOTFunction(f, **kwargs)
+    return SOTFunction(fn, **kwargs)

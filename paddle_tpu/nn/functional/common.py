@@ -43,9 +43,9 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return apply_op("dropout_infer_scale",
                             lambda v: (v * (1.0 - p)).astype(v.dtype), (x,))
         return x if isinstance(x, Tensor) else wrap(targ(x))
-    key = next_key()
-
-    def fn(v):
+    # key passed as a visible arg (not a closure) so jit/sot recording can
+    # substitute a fresh key per replay
+    def fn(v, key):
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -55,7 +55,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
 
-    return apply_op("dropout", fn, (x,))
+    return apply_op("dropout", fn, (x, next_key()))
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -74,16 +74,14 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    key = next_key()
-
-    def fn(v):
+    def fn(v, key):
         keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
         a = (1.0 / _math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) \
             if p < 1 else 0.0
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
 
-    return apply_op("alpha_dropout", fn, (x,))
+    return apply_op("alpha_dropout", fn, (x, next_key()))
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
@@ -158,9 +156,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         except Exception:
             pass  # fall back to XLA path
 
-    drop_key = next_key() if use_dropout else None
-
     def fn(q, k, v, *m):
+        # trailing arg is the dropout key when use_dropout (visible arg so
+        # jit/sot replay re-randomizes; see dropout above)
+        drop_key = None
+        if use_dropout:
+            drop_key, m = m[-1], m[:-1]
         # BSHD -> BHSD
         q_ = jnp.swapaxes(q, 1, 2)
         k_ = jnp.swapaxes(k, 1, 2)
@@ -189,6 +190,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     args = (query, targ(key), targ(value)) + (
         (targ(attn_mask),) if attn_mask is not None else ())
+    if use_dropout:
+        args = args + (next_key(),)
     return apply_op("scaled_dot_product_attention", fn, args)
 
 

@@ -18,9 +18,10 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
         have_onnx = True
     except ImportError:
         have_onnx = False
+    # always produce the portable StableHLO artifact, onnx installed or not
+    from .. import jit as jit_mod
+    jit_mod.save(layer, path, input_spec=input_spec, **configs)
     if not have_onnx:
-        from .. import jit as jit_mod
-        jit_mod.save(layer, path, input_spec=input_spec, **configs)
         raise RuntimeError(
             "the 'onnx' package is not installed in this environment "
             "(no network egress). The model has been exported as a "
@@ -28,5 +29,6 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
             "convert it to ONNX offline, or install onnx to enable "
             "direct export.")
     raise NotImplementedError(
-        "direct ONNX serialization is not implemented; use the StableHLO "
-        "export (jit.save) as the interchange format")
+        "direct ONNX serialization is not implemented; the model has been "
+        f"exported as a portable StableHLO module at '{path}.pdexec' — "
+        "use that as the interchange format")

@@ -79,6 +79,11 @@ _SEEDED_COUNTERS: dict = {}
 def _seeded_key(seed_val: int):
     c = _SEEDED_COUNTERS.get(seed_val, 0)
     _SEEDED_COUNTERS[seed_val] = c + 1
+    from ..core.dispatch import _sot_recorder
+    rec = _sot_recorder[0]
+    if rec is not None:
+        # counter-advanced seeded draws have no functional replay form
+        rec.poison("explicit-seed random op inside traced frame")
     return jax.random.fold_in(jax.random.PRNGKey(seed_val), c)
 
 # Trace-scope key stack: when non-empty, random ops consume splits of the
@@ -125,7 +130,14 @@ def next_key():
         st = _trace_rng.stack[-1]
         st["key"], sub = jax.random.split(st["key"])
         return sub
-    return _GLOBAL_GENERATOR.next_key()
+    sub = _GLOBAL_GENERATOR.next_key()
+    from ..core.dispatch import _sot_recorder
+    rec = _sot_recorder[0]
+    if rec is not None:
+        # jit/sot is recording: register the drawn key so the replayed
+        # program substitutes a fresh fold-in instead of the baked draw
+        rec.register_rng_key(sub)
+    return sub
 
 
 def _float_dtype(dtype):
@@ -142,16 +154,27 @@ def _shape(shape):
                  for s in shape)
 
 
+def _rng_apply(name, kernel, key=None):
+    """Route a random draw through the apply_op choke point with the key
+    as a visible positional argument.  jit/sot recording recognizes
+    registered keys among statement args and substitutes fresh fold-ins at
+    replay, so compiled programs re-randomize per call instead of baking
+    the recorded draw."""
+    if key is None:
+        key = next_key()
+    return apply_op(name, kernel, (key,))
+
+
 @register_op("rand", category="random")
 def rand(shape, dtype=None, name=None):
-    return wrap(jax.random.uniform(next_key(), _shape(shape),
-                                   _float_dtype(dtype)))
+    shp, dt = _shape(shape), _float_dtype(dtype)
+    return _rng_apply("rand", lambda k: jax.random.uniform(k, shp, dt))
 
 
 @register_op("randn", category="random")
 def randn(shape, dtype=None, name=None):
-    return wrap(jax.random.normal(next_key(), _shape(shape),
-                                  _float_dtype(dtype)))
+    shp, dt = _shape(shape), _float_dtype(dtype)
+    return _rng_apply("randn", lambda k: jax.random.normal(k, shp, dt))
 
 
 @register_op("standard_normal", category="random")
@@ -161,32 +184,37 @@ def standard_normal(shape, dtype=None, name=None):
 
 @register_op("normal", category="random")
 def normal(mean=0.0, std=1.0, shape=None, name=None):
+    dt = _dt.get_default_dtype()
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
         m = as_value(mean)
         s = as_value(std)
         shp = jnp.broadcast_shapes(
             m.shape if hasattr(m, "shape") else (),
             s.shape if hasattr(s, "shape") else ())
-        return wrap(jax.random.normal(next_key(), shp,
-                                      _dt.get_default_dtype()) * s + m)
+        return _rng_apply(
+            "normal", lambda k: jax.random.normal(k, shp, dt) * s + m)
     shp = _shape(shape) if shape is not None else ()
-    return wrap(jax.random.normal(next_key(), shp,
-                                  _dt.get_default_dtype()) * std + mean)
+    return _rng_apply(
+        "normal", lambda k: jax.random.normal(k, shp, dt) * std + mean)
 
 
 @register_op("uniform", category="random")
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    key = _seeded_key(seed) if seed != 0 else next_key()
-    return wrap(jax.random.uniform(key, _shape(shape), _float_dtype(dtype),
-                                   minval=min, maxval=max))
+    key = _seeded_key(seed) if seed != 0 else None
+    shp, dt = _shape(shape), _float_dtype(dtype)
+    return _rng_apply(
+        "uniform",
+        lambda k: jax.random.uniform(k, shp, dt, minval=min, maxval=max),
+        key=key)
 
 
 @register_op("randint", category="random")
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     if high is None:
         low, high = 0, low
-    return wrap(jax.random.randint(next_key(), _shape(shape), low, high,
-                                   _dt.convert_dtype(dtype)))
+    shp, dt = _shape(shape), _dt.convert_dtype(dtype)
+    return _rng_apply(
+        "randint", lambda k: jax.random.randint(k, shp, low, high, dt))
 
 
 @register_op("randint_like", category="random")
@@ -195,71 +223,88 @@ def randint_like(x, low=0, high=None, dtype=None, name=None):
     if high is None:
         low, high = 0, low
     d = _dt.convert_dtype(dtype) if dtype else v.dtype
-    return wrap(jax.random.randint(next_key(), v.shape, low, high, d))
+    shp = v.shape
+    return _rng_apply(
+        "randint_like", lambda k: jax.random.randint(k, shp, low, high, d))
 
 
 @register_op("randperm", category="random")
 def randperm(n, dtype="int64", name=None):
-    return wrap(jax.random.permutation(next_key(), n).astype(
-        _dt.convert_dtype(dtype)))
+    d = _dt.convert_dtype(dtype)
+    return _rng_apply(
+        "randperm", lambda k: jax.random.permutation(k, n).astype(d))
 
 
 @register_op("bernoulli", category="random", tensor_method=True)
 def bernoulli(x, name=None):
     v = as_value(x)
-    return wrap(jax.random.bernoulli(next_key(), v).astype(v.dtype))
+    return _rng_apply(
+        "bernoulli", lambda k: jax.random.bernoulli(k, v).astype(v.dtype))
 
 
 @register_op("bernoulli_", category="random")
 def bernoulli_(x, p=0.5, name=None):
     v = as_value(x)
-    x._value = jax.random.bernoulli(next_key(), p, v.shape).astype(v.dtype)
+    x._value = _rng_apply(
+        "bernoulli_",
+        lambda k: jax.random.bernoulli(k, p, v.shape).astype(v.dtype))._value
     return x
 
 
 @register_op("poisson", category="random", tensor_method=True)
 def poisson(x, name=None):
     v = as_value(x)
-    return wrap(jax.random.poisson(next_key(), v).astype(v.dtype))
+    return _rng_apply(
+        "poisson", lambda k: jax.random.poisson(k, v).astype(v.dtype))
 
 
 @register_op("multinomial", category="random", tensor_method=True)
 def multinomial(x, num_samples=1, replacement=False, name=None):
     v = as_value(x)
     p = v / jnp.sum(v, axis=-1, keepdims=True)
-    if v.ndim == 1:
-        out = jax.random.choice(next_key(), v.shape[0], (num_samples,),
-                                replace=replacement, p=p)
-    else:
-        keys = jax.random.split(next_key(), v.shape[0])
-        out = jnp.stack([
-            jax.random.choice(k, v.shape[-1], (num_samples,),
+
+    def kernel(k):
+        if v.ndim == 1:
+            return jax.random.choice(k, v.shape[0], (num_samples,),
+                                     replace=replacement, p=p)
+        keys = jax.random.split(k, v.shape[0])
+        return jnp.stack([
+            jax.random.choice(ki, v.shape[-1], (num_samples,),
                               replace=replacement, p=p[i])
-            for i, k in enumerate(keys)])
-    return wrap(out.astype(jnp.int64))
+            for i, ki in enumerate(keys)])
+
+    return _rng_apply(
+        "multinomial", lambda k: kernel(k).astype(jnp.int64))
 
 
 @register_op("exponential_", category="random")
 def exponential_(x, lam=1.0, name=None):
     v = as_value(x)
-    x._value = (jax.random.exponential(next_key(), v.shape, v.dtype) /
-                lam).astype(v.dtype)
+    x._value = _rng_apply(
+        "exponential_",
+        lambda k: (jax.random.exponential(k, v.shape, v.dtype) /
+                   lam).astype(v.dtype))._value
     return x
 
 
 @register_op("normal_", category="random")
 def normal_(x, mean=0.0, std=1.0, name=None):
     v = as_value(x)
-    x._value = (jax.random.normal(next_key(), v.shape, v.dtype) * std +
-                mean).astype(v.dtype)
+    x._value = _rng_apply(
+        "normal_",
+        lambda k: (jax.random.normal(k, v.shape, v.dtype) * std +
+                   mean).astype(v.dtype))._value
     return x
 
 
 @register_op("uniform_", category="random")
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     v = as_value(x)
-    key = _seeded_key(seed) if seed != 0 else next_key()
-    x._value = jax.random.uniform(key, v.shape, v.dtype, min, max)
+    key = _seeded_key(seed) if seed != 0 else None
+    x._value = _rng_apply(
+        "uniform_",
+        lambda k: jax.random.uniform(k, v.shape, v.dtype, min, max),
+        key=key)._value
     return x
 
 
@@ -267,11 +312,13 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
 def rand_like(x, dtype=None, name=None):
     v = as_value(x)
     d = _dt.convert_dtype(dtype) if dtype else v.dtype
-    return wrap(jax.random.uniform(next_key(), v.shape, d))
+    return _rng_apply(
+        "rand_like", lambda k: jax.random.uniform(k, v.shape, d))
 
 
 @register_op("randn_like", category="random")
 def randn_like(x, dtype=None, name=None):
     v = as_value(x)
     d = _dt.convert_dtype(dtype) if dtype else v.dtype
-    return wrap(jax.random.normal(next_key(), v.shape, d))
+    return _rng_apply(
+        "randn_like", lambda k: jax.random.normal(k, v.shape, d))

@@ -5,6 +5,7 @@ Parity: python/paddle/optimizer/ (reference, SURVEY.md #63).
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
                         RMSProp, Adadelta, Adamax, Lamb, Rprop)
+from .lbfgs import LBFGS
 
 
 class L2Decay:

@@ -1,0 +1,293 @@
+"""L-BFGS optimizer (parity: paddle.optimizer.LBFGS,
+reference python/paddle/optimizer/lbfgs.py).
+
+TPU-native design: the parameter vector is flattened into one jax array so
+the two-loop recursion is a handful of fused dot/axpy kernels on device;
+only the line-search control flow (a few scalars per iteration) runs on
+host.  Like the reference, ``step(closure)`` drives re-evaluation: the
+closure recomputes the loss and gradients at trial points.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd.tape import no_grad
+from .optimizer import Optimizer
+
+
+def _flat(params: List[Tensor]) -> jnp.ndarray:
+    return jnp.concatenate([p._value.astype(jnp.float32).ravel()
+                            for p in params])
+
+
+def _unflat_assign(params: List[Tensor], vec: jnp.ndarray):
+    off = 0
+    for p in params:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        chunk = vec[off:off + n].reshape(p._value.shape)
+        p._value = chunk.astype(p._value.dtype)
+        off += n
+
+
+def _flat_grad(params: List[Tensor]) -> jnp.ndarray:
+    out = []
+    for p in params:
+        if p._grad is None:
+            out.append(jnp.zeros(p._value.size, jnp.float32))
+        else:
+            out.append(jnp.asarray(p._grad).astype(jnp.float32).ravel())
+    return jnp.concatenate(out)
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2) — the classic
+    line-search interpolation step (same formula the reference and
+    minpack use)."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) /
+                                        (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) /
+                                        (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with optional strong-Wolfe line search."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self._max_iter = max_iter
+        self._max_eval = max_eval
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._params = [p for p in self._parameter_list
+                        if not p.stop_gradient]
+        self._hist: Dict[str, list] = {"s": [], "y": [], "rho": []}
+        self._n_evals = 0
+
+    # -- closure evaluation --------------------------------------------------
+    def _evaluate(self, closure, x: jnp.ndarray):
+        _unflat_assign(self._params, x)
+        loss = closure()
+        self._n_evals += 1
+        val = float(np.asarray(
+            loss._value if isinstance(loss, Tensor) else loss))
+        return val, _flat_grad(self._params)
+
+    # -- strong Wolfe --------------------------------------------------------
+    def _strong_wolfe(self, closure, x, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        d_norm = float(jnp.max(jnp.abs(d)))
+        g_prev, f_prev, t_prev = g, f, 0.0
+        done = False
+        ls_iter = 0
+        f_new, g_new = self._evaluate(closure, x + t * d)
+        gtd_new = float(jnp.dot(g_new, d))
+
+        # bracket phase
+        bracket, bracket_f, bracket_g, bracket_gtd = None, None, None, None
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and
+                                              f_new >= f_prev):
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new]
+                bracket_gtd = [float(jnp.dot(g_prev, d)), gtd_new]
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                done = True
+                bracket, bracket_f, bracket_g = [t, t], [f_new, f_new], \
+                    [g_new, g_new]
+                break
+            if gtd_new >= 0:
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new]
+                bracket_gtd = [float(jnp.dot(g_prev, d)), gtd_new]
+                break
+            min_step = t + 0.01 * (t - t_prev)
+            max_step = t * 10
+            tmp = t
+            t = _cubic_interpolate(t_prev, f_prev,
+                                   float(jnp.dot(g_prev, d)),
+                                   t, f_new, gtd_new,
+                                   bounds=(min_step, max_step))
+            t_prev, f_prev, g_prev = tmp, f_new, g_new
+            f_new, g_new = self._evaluate(closure, x + t * d)
+            gtd_new = float(jnp.dot(g_new, d))
+            ls_iter += 1
+        if bracket is None:
+            bracket, bracket_f, bracket_g = [0, t], [f, f_new], [g, g_new]
+            bracket_gtd = [gtd, gtd_new]
+
+        # zoom phase
+        insuf_progress = False
+        low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] \
+            else (1, 0)
+        while not done and ls_iter < max_ls:
+            if abs(bracket[1] - bracket[0]) * d_norm < self._tol_change:
+                break
+            t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                                   bracket[1], bracket_f[1], bracket_gtd[1])
+            eps = 0.1 * (max(bracket) - min(bracket))
+            if min(max(bracket) - t, t - min(bracket)) < eps:
+                if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                    t = max(bracket) - eps if abs(t - max(bracket)) < \
+                        abs(t - min(bracket)) else min(bracket) + eps
+                    insuf_progress = False
+                else:
+                    insuf_progress = True
+            else:
+                insuf_progress = False
+            f_new, g_new = self._evaluate(closure, x + t * d)
+            gtd_new = float(jnp.dot(g_new, d))
+            ls_iter += 1
+            if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+                bracket[high_pos] = t
+                bracket_f[high_pos] = f_new
+                bracket_g[high_pos] = g_new
+                bracket_gtd[high_pos] = gtd_new
+                low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[1] \
+                    else (1, 0)
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    done = True
+                elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                    bracket[high_pos] = bracket[low_pos]
+                    bracket_f[high_pos] = bracket_f[low_pos]
+                    bracket_g[high_pos] = bracket_g[low_pos]
+                    bracket_gtd[high_pos] = bracket_gtd[low_pos]
+                bracket[low_pos] = t
+                bracket_f[low_pos] = f_new
+                bracket_g[low_pos] = g_new
+                bracket_gtd[low_pos] = gtd_new
+        t = bracket[low_pos]
+        return bracket_f[low_pos], bracket_g[low_pos], t
+
+    # -- step ----------------------------------------------------------------
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model (reference parity)")
+        with no_grad():
+            return self._step_impl(closure)
+
+    def _step_impl(self, closure):
+        def eval_closure():
+            # closure computes loss + backward; grads must be fresh
+            for p in self._params:
+                p.clear_gradient()
+            with _grad_enabled():
+                return closure()
+
+        self._n_evals = 0
+        x = _flat(self._params)
+        loss, g = self._evaluate(eval_closure, x)
+        orig_loss = loss
+        if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+            return Tensor(np.float32(loss))
+
+        hist = self._hist
+        lr = self.get_lr()
+        prev_g = None
+        for it in range(self._max_iter):
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in zip(reversed(hist["s"]), reversed(hist["y"]),
+                                 reversed(hist["rho"])):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if hist["s"]:
+                s, y = hist["s"][-1], hist["y"][-1]
+                gamma = jnp.dot(s, y) / jnp.dot(y, y)
+                r = q * gamma
+            else:
+                r = q
+            for (s, y, rho), a in zip(
+                    zip(hist["s"], hist["y"], hist["rho"]),
+                    reversed(alphas)):
+                b = rho * jnp.dot(y, r)
+                r = r + s * (a - b)
+            d = -r
+
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self._tol_change:
+                break
+            t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * lr \
+                if it == 0 else lr
+
+            if self._line_search_fn == "strong_wolfe":
+                new_loss, new_g, t = self._strong_wolfe(
+                    eval_closure, x, t, d, loss, g, gtd)
+                x_new = x + t * d
+            else:
+                x_new = x + t * d
+                new_loss, new_g = self._evaluate(eval_closure, x_new)
+
+            s = x_new - x
+            y = new_g - g
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(hist["s"]) >= self._history_size:
+                    hist["s"].pop(0)
+                    hist["y"].pop(0)
+                    hist["rho"].pop(0)
+                hist["s"].append(s)
+                hist["y"].append(y)
+                hist["rho"].append(1.0 / ys)
+
+            x, loss, g = x_new, new_loss, new_g
+            if self._n_evals >= self._max_eval:
+                break
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            if float(jnp.max(jnp.abs(t * d))) <= self._tol_change:
+                break
+
+        _unflat_assign(self._params, x)
+        self._finish_step()
+        return Tensor(np.float32(orig_loss))
+
+
+class _grad_enabled:
+    """Re-enable grad inside step()'s no_grad for closure evaluation."""
+
+    def __enter__(self):
+        from ..autograd import tape as _t
+        self._prev = _t._GRAD_ENABLED[0]
+        _t._GRAD_ENABLED[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        from ..autograd import tape as _t
+        _t._GRAD_ENABLED[0] = self._prev
+        return False

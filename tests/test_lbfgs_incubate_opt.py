@@ -1,0 +1,99 @@
+"""LBFGS + incubate optimizer (LookAhead/ModelAverage) tests.
+
+Reference test pattern: test/legacy_test/test_lbfgs*.py,
+test_lookahead.py, test_modelaverage.py — convergence on small convex
+problems + wrapper semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_lbfgs_converges_quadratic():
+    # min ||Ax - b||^2 — strongly convex; LBFGS should reach ~0 fast
+    rng = np.random.RandomState(0)
+    A = rng.rand(6, 6).astype(np.float32) + 6 * np.eye(6, dtype=np.float32)
+    b = rng.rand(6).astype(np.float32)
+    x = paddle.to_tensor(np.zeros(6, np.float32))
+    x.stop_gradient = False
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[x])
+
+    def closure():
+        r = paddle.matmul(At, x) - bt
+        loss = paddle.sum(r * r)
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        opt.step(closure)
+    r = A @ x.numpy() - b
+    assert float(np.sum(r * r)) < 1e-6
+
+
+def test_lbfgs_rosenbrock_descends():
+    xy = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    xy.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=50,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[xy])
+
+    def rosen():
+        a, bq = xy[0], xy[1]
+        loss = (1 - a) ** 2 + 100.0 * (bq - a * a) ** 2
+        loss.backward()
+        return loss
+
+    start = float(rosen().numpy())
+    xy.clear_gradient()
+    for _ in range(5):
+        opt.step(rosen)
+    end = float(((1 - xy.numpy()[0]) ** 2 +
+                 100 * (xy.numpy()[1] - xy.numpy()[0] ** 2) ** 2))
+    assert end < start * 1e-3
+
+
+def test_lookahead_matches_manual_slow_update():
+    p = paddle.to_tensor(np.ones(4, np.float32))
+    p.stop_gradient = False
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+
+    vals = [p.numpy().copy()]
+    for step in range(4):
+        loss = paddle.sum(p * p)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        vals.append(p.numpy().copy())
+
+    # manual replay
+    w = np.ones(4, np.float32)
+    slow = w.copy()
+    for step in range(4):
+        w = w - 0.1 * 2 * w
+        if (step + 1) % 2 == 0:
+            slow = slow + 0.5 * (w - slow)
+            w = slow.copy()
+    np.testing.assert_allclose(vals[-1], w, rtol=1e-5)
+
+
+def test_model_average_apply_restore():
+    p = paddle.to_tensor(np.zeros(3, np.float32))
+    p.stop_gradient = False
+    ma = paddle.incubate.ModelAverage(0.5, parameters=[p],
+                                      min_average_window=1,
+                                      max_average_window=100)
+    seen = []
+    for v in [1.0, 2.0, 3.0]:
+        p.set_value(paddle.to_tensor(np.full(3, v, np.float32)))
+        ma.step()
+        seen.append(v)
+    raw = p.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), np.full(3, 2.0), rtol=1e-6)
+    np.testing.assert_allclose(p.numpy(), raw)
