@@ -25,6 +25,8 @@ from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group)
 from . import fleet
 from . import checkpoint
+from . import rpc
+from . import fleet_executor
 from .fleet.meta_parallel.sharding_api import group_sharded_parallel, \
     save_group_sharded_model
 
@@ -36,6 +38,6 @@ __all__ = [
     "ReduceOp", "new_group", "all_reduce", "all_gather", "broadcast",
     "reduce", "reduce_scatter", "all_to_all", "scatter", "gather",
     "send", "recv", "barrier", "wait",
-    "DataParallel", "spawn", "fleet", "checkpoint",
-    "group_sharded_parallel",
+    "DataParallel", "spawn", "fleet", "checkpoint", "rpc",
+    "fleet_executor", "group_sharded_parallel",
 ]

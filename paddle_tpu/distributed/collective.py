@@ -170,7 +170,10 @@ def _cross_process_apply(local_np, fn, group: Optional[Group] = None,
     the global array, return the (replicated) result as numpy.  Every
     member process of `group` must call this collectively."""
     import numpy as _np
+    from .comm_watchdog import comm_task
     ranks = _group_ranks(group)
+    name = fn_key[0] if isinstance(fn_key, tuple) and fn_key else \
+        "collective"
     mesh = _proc_mesh(ranks)
     n = len(ranks)
     sharding = NamedSharding(mesh, PartitionSpec("proc"))
@@ -179,12 +182,19 @@ def _cross_process_apply(local_np, fn, group: Optional[Group] = None,
         sharding, local_np[None, ...], global_shape)
     cache_key = (fn_key, mesh) if fn_key is not None else None
     jitted = _XP_JIT_CACHE.get(cache_key)
+    warm = jitted is not None
     if jitted is None:
-        jitted = jax.jit(fn, out_shardings=NamedSharding(mesh,
-                                                         PartitionSpec()))
+        jitted = jax.jit(fn, out_shardings=NamedSharding(
+            mesh, PartitionSpec()))
         if cache_key is not None:
             _XP_JIT_CACHE[cache_key] = jitted
-    return _np.asarray(jitted(arr))
+    if not warm:
+        # first call includes XLA compile (here and possibly on peers):
+        # that time must not count against the comm deadline, so the
+        # watchdog arms from the second call of each executable on
+        return _np.asarray(jitted(arr))
+    with comm_task(name, ranks):
+        return _np.asarray(jitted(arr))
 
 
 _NP_REDUCE = {ReduceOp.SUM: jnp.sum, "sum": jnp.sum,

@@ -1,0 +1,161 @@
+"""Collective-communication watchdog.
+
+Capability parity with the reference's async-comm watchdog
+(paddle/phi/core/distributed/comm_task_manager.h:37,57 — CommTaskManager
+monitors per-task deadlines, nccl_comm_task.h:53 carries the timeout —
+catching hangs/desyncs where one rank never enters a collective).
+
+TPU-native design: eager cross-process collectives block the calling
+thread inside XLA/coordination-service code, so the watchdog is a monitor
+thread holding a registry of in-flight CommTasks with deadlines.  On
+expiry it emits a diagnostic (op name, group ranks, elapsed, all-thread
+stacks) and invokes the abort handler — by default logging loudly; set
+``FLAGS_comm_abort_on_timeout`` to kill the process like the reference's
+communicator abort so the launcher's supervision can restart the job.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.flags import define_flag, get_flag
+
+define_flag("comm_task_timeout_s", 0.0,
+            "watchdog timeout (seconds) for one collective; 0 disables",
+            type=float)
+define_flag("comm_abort_on_timeout", False,
+            "kill the process when a collective exceeds the timeout "
+            "(reference FLAGS NCCL blocking-wait abort semantics)",
+            type=bool)
+
+__all__ = ["CommTask", "CommTaskManager", "comm_task",
+            "get_comm_task_manager"]
+
+
+class CommTask:
+    """One in-flight collective (parity: nccl_comm_task.h)."""
+
+    __slots__ = ("name", "ranks", "start", "deadline", "task_id")
+
+    def __init__(self, name: str, ranks, timeout_s: float, task_id: int):
+        self.name = name
+        self.ranks = list(ranks) if ranks else []
+        self.start = time.monotonic()
+        self.deadline = self.start + timeout_s
+        self.task_id = task_id
+
+
+class CommTaskManager:
+    """Deadline registry + monitor thread (parity:
+    comm_task_manager.h:37)."""
+
+    def __init__(self):
+        self._tasks: Dict[int, CommTask] = {}
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._timed_out: List[CommTask] = []
+        # overridable for tests / custom runtimes
+        self.abort_handler: Callable[[CommTask], None] = self._default_abort
+
+    # -- task lifecycle ------------------------------------------------------
+    def start_task(self, name: str, ranks=None,
+                   timeout_s: Optional[float] = None) -> Optional[CommTask]:
+        if timeout_s is None:
+            timeout_s = float(get_flag("comm_task_timeout_s") or 0.0)
+        if timeout_s <= 0:
+            return None
+        with self._lock:
+            task = CommTask(name, ranks, timeout_s, self._next_id)
+            self._next_id += 1
+            self._tasks[task.task_id] = task
+            if self._monitor is None or not self._monitor.is_alive():
+                self._stop.clear()   # restart after shutdown()
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop, daemon=True,
+                    name="comm-watchdog")
+                self._monitor.start()
+        return task
+
+    def end_task(self, task: Optional[CommTask]):
+        if task is None:
+            return
+        with self._lock:
+            self._tasks.pop(task.task_id, None)
+
+    @property
+    def timed_out_tasks(self) -> List[CommTask]:
+        return list(self._timed_out)
+
+    # -- monitor -------------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            time.sleep(0.05)
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for tid, task in list(self._tasks.items()):
+                    if now > task.deadline:
+                        expired.append(task)
+                        del self._tasks[tid]
+            for task in expired:
+                self._timed_out.append(task)
+                self._report(task)
+                try:
+                    self.abort_handler(task)
+                except Exception:
+                    traceback.print_exc()
+
+    def _report(self, task: CommTask):
+        elapsed = time.monotonic() - task.start
+        print(f"[comm-watchdog] collective '{task.name}' on ranks "
+              f"{task.ranks or 'world'} exceeded its timeout "
+              f"({elapsed:.1f}s) — probable hang/desync (one rank never "
+              "entered the collective).", file=sys.stderr)
+        for tid, frame in sys._current_frames().items():
+            print(f"[comm-watchdog] thread {tid} stack:", file=sys.stderr)
+            traceback.print_stack(frame, file=sys.stderr)
+
+    def _default_abort(self, task: CommTask):
+        if get_flag("comm_abort_on_timeout"):
+            # the reference aborts the communicator; our analog is killing
+            # the process so the launcher's --max_restarts supervision (or
+            # the elastic manager) can relaunch a consistent world
+            os._exit(124)
+
+    def shutdown(self):
+        self._stop.set()
+
+
+_manager: List[Optional[CommTaskManager]] = [None]
+
+
+def get_comm_task_manager() -> CommTaskManager:
+    if _manager[0] is None:
+        _manager[0] = CommTaskManager()
+    return _manager[0]
+
+
+class comm_task:
+    """Context manager wrapping one collective call."""
+
+    def __init__(self, name: str, ranks=None,
+                 timeout_s: Optional[float] = None):
+        self._name = name
+        self._ranks = ranks
+        self._timeout = timeout_s
+        self._task = None
+
+    def __enter__(self):
+        self._task = get_comm_task_manager().start_task(
+            self._name, self._ranks, self._timeout)
+        return self._task
+
+    def __exit__(self, *exc):
+        get_comm_task_manager().end_task(self._task)
+        return False

@@ -86,8 +86,14 @@ def recompute(function, *args, **kwargs):
         return tuple(capture.get(id(d))
                      for d in detached if isinstance(d, Tensor))
 
+    # Record the replay node when any *input* requires grad OR the
+    # function's own state is trainable (first block: data inputs are
+    # stop_gradient but the layer's params still need grads from the
+    # replay).  Fully-frozen blocks skip the node so backward does not
+    # waste a forward+backward replay producing no grads.
     diff_inputs = [a for a in tensor_args]
-    if any(not t.stop_gradient for t in diff_inputs):
+    if any(not t.stop_gradient for t in diff_inputs) or \
+            _has_trainable_state(function):
         node = GradNode("recompute", vjp_fn, diff_inputs, out_meta,
                         out_is_tuple=len(out_meta) > 1)
         for i, o in enumerate(out_list):
@@ -95,6 +101,34 @@ def recompute(function, *args, **kwargs):
             o._out_index = i
             o.stop_gradient = False
     return outputs
+
+
+def _has_trainable_state(function) -> bool:
+    """True if `function` closes over trainable parameters — a bound
+    Layer method, a Layer itself, or closure cells holding either.
+    Unknown shapes return True (conservative: keep grads flowing)."""
+    from ...nn.layer_base import Layer
+
+    owner = getattr(function, "__self__", None)
+    if isinstance(function, Layer):
+        owner = function
+    if isinstance(owner, Layer):
+        return any(not p.stop_gradient for p in owner.parameters())
+    found_layer = False
+    for cell in (getattr(function, "__closure__", None) or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            found_layer = True
+            if any(not p.stop_gradient for p in v.parameters()):
+                return True
+        elif isinstance(v, Tensor) and not v.stop_gradient:
+            return True
+    if found_layer:
+        return False   # saw the layers; all frozen
+    return True        # opaque callable: assume trainable
 
 
 def _call_with_values(function, args, kwargs, vals):

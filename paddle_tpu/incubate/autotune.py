@@ -1,0 +1,151 @@
+"""Kernel autotune: runtime config selection + cache.
+
+Capability parity with the reference's kernel autotune layer
+(paddle/phi/kernels/autotune/ — auto_tune_base.h AutoTuneBase::Run times
+candidate kernels with a GPU timer and caches the winner keyed on the
+input signature, cache.h AlgorithmsCache, switch_autotune.cc the on/off
+switch) and the Python surface paddle.incubate.autotune.set_config.
+
+TPU-native design: candidates are (block_q, block_k) tilings of Pallas
+kernels (the analog of cuDNN algo choice).  Timing uses a warmup +
+block_until_ready median, the winner is cached in-process keyed on
+(kernel, shape-signature, dtype) and optionally persisted to a JSON file
+so later processes skip the search — the analog of the reference's
+serialized algorithm cache.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+__all__ = ["set_config", "autotune_enabled", "AlgorithmCache",
+           "autotune_select", "flash_attention_candidates"]
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": None},
+    "cache_file": None,
+}
+
+
+def set_config(config=None):
+    """Parity: paddle.incubate.autotune.set_config — accepts a dict or a
+    JSON file path with a {"kernel": {"enable": ...}} section."""
+    if config is None:
+        _config["kernel"]["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    kernel = config.get("kernel", {})
+    _config["kernel"]["enable"] = bool(kernel.get("enable", False))
+    if "tuning_range" in kernel:
+        _config["kernel"]["tuning_range"] = kernel["tuning_range"]
+    if "cache_file" in config:
+        _config["cache_file"] = config["cache_file"]
+
+
+def autotune_enabled() -> bool:
+    return bool(_config["kernel"]["enable"])
+
+
+class AlgorithmCache:
+    """Winner cache (parity: autotune/cache.h AlgorithmsCache) with
+    optional JSON persistence."""
+
+    def __init__(self):
+        self._cache: Dict[str, Any] = {}
+        self._loaded_file: Optional[str] = None
+
+    def _maybe_load(self):
+        path = _config["cache_file"]
+        if path and path != self._loaded_file and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._cache.update(json.load(f))
+            except (OSError, ValueError):
+                pass
+            self._loaded_file = path
+
+    def get(self, key: str):
+        self._maybe_load()
+        return self._cache.get(key)
+
+    def put(self, key: str, value):
+        self._cache[key] = value
+        path = _config["cache_file"]
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(self._cache, f)
+            except OSError:
+                pass
+
+    def clear(self):
+        self._cache.clear()
+
+
+_cache = AlgorithmCache()
+
+
+def _time_once(fn: Callable[[], Any]) -> float:
+    out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def autotune_select(kernel_name: str, sig: Tuple,
+                    candidates: Sequence[Any],
+                    runner: Callable[[Any], Callable[[], Any]],
+                    default: Any):
+    """Pick the fastest candidate config for (kernel_name, sig).
+
+    ``runner(cand)`` returns a zero-arg callable executing the kernel with
+    that config; invalid configs may raise and are skipped (parity:
+    AutoTuneBase::Run's per-algo try loop).  Off switch → ``default``.
+    """
+    if not autotune_enabled():
+        return default
+    key = f"{kernel_name}::{sig}"
+    hit = _cache.get(key)
+    if hit is not None:
+        return tuple(hit) if isinstance(hit, list) else hit
+    best, best_t = default, float("inf")
+    for cand in candidates:
+        try:
+            fn = runner(cand)
+            dt = min(_time_once(fn) for _ in range(2))
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = cand, dt
+    _cache.put(key, list(best) if isinstance(best, tuple) else best)
+    return best
+
+
+def autotune_lookup(kernel_name: str, sig: Tuple):
+    """Cache peek without searching — safe inside a jax trace (timing a
+    candidate needs concrete buffers)."""
+    if not autotune_enabled():
+        return None
+    hit = _cache.get(f"{kernel_name}::{sig}")
+    return tuple(hit) if isinstance(hit, list) else hit
+
+
+def flash_attention_candidates(seq_q: int, seq_k: int) -> List[Tuple[int,
+                                                                     int]]:
+    """(block_q, block_k) tilings that divide the sequence lengths —
+    multiples of the 128-lane TPU tile up to MXU-friendly 512."""
+    outs = []
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512):
+            if bq <= seq_q and bk <= seq_k and seq_q % bq == 0 \
+                    and seq_k % bk == 0:
+                outs.append((bq, bk))
+    return outs or [(min(128, seq_q), min(128, seq_k))]

@@ -1,0 +1,112 @@
+"""Single-source-of-truth op manifest (ops.yaml).
+
+Capability parity with the reference's YAML op registry
+(paddle/phi/api/yaml/ops.yaml 291 + legacy_ops.yaml 120 + op_compat.yaml):
+one declarative file lists every op with its python signature; codegen in
+the reference renders C++ APIs from it, here the live registry IS the
+implementation and the manifest is the contract — `validate_manifest`
+diffs the two in both directions (declared-but-missing = a removed op
+breaks the API; registered-but-undeclared = an op shipped without being
+inventoried) plus signature drift, and the test suite gates on an empty
+diff.  Regenerate after adding ops:
+
+    python -m paddle_tpu.ops.manifest regen
+"""
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Dict, List, Optional
+
+MANIFEST_PATH = os.path.join(os.path.dirname(__file__), "ops.yaml")
+
+
+def _signature_entry(fn) -> List[Dict[str, Any]]:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return []
+    args = []
+    for p in sig.parameters.values():
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            args.append({"name": ("*" if p.kind == p.VAR_POSITIONAL
+                                  else "**") + p.name})
+        elif p.default is inspect.Parameter.empty:
+            args.append({"name": p.name})
+        else:
+            args.append({"name": p.name, "default": repr(p.default)})
+    return args
+
+
+def build_manifest() -> List[Dict[str, Any]]:
+    """Introspect the live registry into manifest entries."""
+    from .registry import registered_ops
+    entries = []
+    for name, opdef in sorted(registered_ops().items()):
+        entries.append({
+            "op": name,
+            "category": opdef.category,
+            "tensor_method": bool(opdef.tensor_method),
+            "args": _signature_entry(opdef.fn),
+        })
+    return entries
+
+
+def write_manifest(path: str = MANIFEST_PATH):
+    import yaml
+    entries = build_manifest()
+    header = (
+        "# Op manifest — single source of truth for the op surface\n"
+        "# (capability parity: paddle/phi/api/yaml/ops.yaml).\n"
+        "# Regenerate: python -m paddle_tpu.ops.manifest regen\n"
+        f"# ops: {len(entries)}\n")
+    with open(path, "w") as f:
+        f.write(header)
+        yaml.safe_dump(entries, f, sort_keys=False, width=100)
+    return len(entries)
+
+
+def load_manifest(path: str = MANIFEST_PATH) -> List[Dict[str, Any]]:
+    import yaml
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def validate_manifest(path: str = MANIFEST_PATH) -> List[str]:
+    """Return a list of human-readable contract violations (empty = ok)."""
+    from .registry import registered_ops
+    problems = []
+    declared = {e["op"]: e for e in load_manifest(path)}
+    live = registered_ops()
+
+    for name in declared:
+        if name not in live:
+            problems.append(f"declared op '{name}' is not registered "
+                            "(API removal?)")
+    for name in live:
+        if name not in declared:
+            problems.append(f"registered op '{name}' missing from "
+                            "ops.yaml (run regen)")
+    for name, entry in declared.items():
+        opdef = live.get(name)
+        if opdef is None:
+            continue
+        current = _signature_entry(opdef.fn)
+        if current != entry.get("args", []):
+            problems.append(f"op '{name}' signature drifted: manifest "
+                            f"{entry.get('args')} vs live {current}")
+        if bool(entry.get("tensor_method")) != bool(opdef.tensor_method):
+            problems.append(f"op '{name}' tensor_method flag drifted")
+    return problems
+
+
+if __name__ == "__main__":
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "regen":
+        n = write_manifest()
+        print(f"wrote {MANIFEST_PATH} with {n} ops")
+    else:
+        probs = validate_manifest()
+        for p in probs:
+            print("PROBLEM:", p)
+        sys.exit(1 if probs else 0)

@@ -231,10 +231,34 @@ def _pallas_ok(q, k, mask, block=256) -> bool:
             and k.shape[2] % min(block, k.shape[2]) == 0)
 
 
+def _select_flash_blocks(q, k, v, causal):
+    """(block_q, block_k) via the autotune cache (parity: the reference's
+    kernel-autotune algo pick, paddle/phi/kernels/autotune/auto_tune_base.h).
+    Inside a trace only the cached winner is consulted; with concrete
+    buffers a miss triggers the timed search."""
+    from ..incubate.autotune import (autotune_enabled, autotune_lookup,
+                                     autotune_select,
+                                     flash_attention_candidates)
+    Sq, Sk = q.shape[2], k.shape[2]
+    default = (min(256, Sq), min(256, Sk))
+    if not autotune_enabled():
+        return default
+    sig = (tuple(q.shape), tuple(k.shape), str(q.dtype), bool(causal))
+    if isinstance(q, jax.core.Tracer):
+        return autotune_lookup("flash_attention", sig) or default
+    return autotune_select(
+        "flash_attention", sig,
+        flash_attention_candidates(Sq, Sk),
+        lambda cand: (lambda: _flash_attention_value(
+            q, k, v, causal, cand[0], cand[1])),
+        default)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_sdpa(q, k, v, causal):
     if _pallas_ok(q, k, None):
-        return _flash_attention_value(q, k, v, causal)
+        bq, bk = _select_flash_blocks(q, k, v, causal)
+        return _flash_attention_value(q, k, v, causal, bq, bk)
     return _chunked_sdpa(q, k, v, causal)
 
 

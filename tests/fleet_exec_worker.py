@@ -1,0 +1,59 @@
+"""Worker for the cross-process fleet-executor test.
+
+Two ranks: rank 0 hosts Source + stage0 (x @ W0), rank 1 hosts stage1
+(relu(h) @ W1) + Sink.  Interceptor messages (control + array payloads)
+travel over the TCP message bus.  Run: python fleet_exec_worker.py <rank>
+<addr0> <addr1>.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.fleet_executor import (  # noqa: E402
+    FleetExecutor, TaskNode)
+
+
+def main():
+    rank = int(sys.argv[1])
+    addrs = {0: sys.argv[2], 1: sys.argv[3]}
+    n_mb = 4
+    rng = np.random.RandomState(0)
+    W0 = rng.rand(4, 8).astype(np.float32)
+    W1 = rng.rand(8, 2).astype(np.float32)
+    feeds = [rng.rand(3, 4).astype(np.float32) for _ in range(n_mb)]
+
+    import jax.numpy as jnp
+    stage0 = jax.jit(lambda x: x @ W0)
+    stage1 = jax.jit(lambda h: jnp.maximum(h, 0) @ W1)
+
+    src = TaskNode(0, 0, node_type="Source", max_run_times=n_mb)
+    s0 = TaskNode(0, 1, program=stage0, max_run_times=n_mb)
+    s1 = TaskNode(1, 2, program=stage1, max_run_times=n_mb)
+    sink = TaskNode(1, 3, node_type="Sink", max_run_times=n_mb)
+    src.add_downstream_task(1)
+    s0.add_upstream_task(0)
+    s0.add_downstream_task(2)
+    s1.add_upstream_task(1)
+    s1.add_downstream_task(3)
+    sink.add_upstream_task(2)
+
+    exe = FleetExecutor(rank, [src, s0, s1, sink], addrs)
+    results = exe.run(feed_fn=lambda i: feeds[i], timeout=60)
+
+    if rank == 1:
+        assert len(results) == n_mb, results.keys()
+        for i in range(n_mb):
+            expect = np.maximum(feeds[i] @ W0, 0) @ W1
+            out = results[i]
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+    print(f"FLEET_EXEC_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Worker for the multi-process RPC test (reference pattern:
+test/legacy_test/test_rpc*.py model scripts).
+Run: python rpc_worker.py <rank> <world> <master>."""
+import sys
+
+import numpy as np
+
+from paddle_tpu.distributed import rpc
+
+
+def add(a, b):
+    return a + b
+
+
+def matvec(m, v):
+    return np.asarray(m) @ np.asarray(v)
+
+
+def whoami():
+    return rpc.get_worker_info().name
+
+
+def main():
+    rank, world, master = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    rpc.init_rpc(f"worker{rank}", rank, world, master)
+
+    peer = f"worker{(rank + 1) % world}"
+    assert rpc.rpc_sync(peer, add, args=(2, 3)) == 5
+    m = np.arange(6, dtype=np.float32).reshape(2, 3)
+    v = np.ones(3, np.float32)
+    np.testing.assert_allclose(rpc.rpc_sync(peer, matvec, args=(m, v)),
+                               m @ v)
+    fut = rpc.rpc_async(peer, whoami)
+    assert fut.result() == peer
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == [f"worker{i}" for i in range(world)]
+
+    rpc.shutdown()
+    print(f"RPC_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Fleet-executor tests (parity target: paddle/fluid/distributed/
+fleet_executor/ — carrier.h:50, interceptor.h:51, message_bus.h).
+
+In-process task graphs run through real actor threads + mailboxes; the
+cross-process test ships array payloads over the TCP message bus between
+two spawned Python processes (reference test pattern:
+test/cpp/fleet_executor + test_dist_base subprocess style).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet_executor import (
+    FleetExecutor, TaskNode, Carrier, InterceptorMessage)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_single_rank_pipeline_runs_all_microbatches():
+    n_mb = 5
+    feeds = [np.full((2, 2), float(i), np.float32) for i in range(n_mb)]
+
+    src = TaskNode(0, 0, node_type="Source", max_run_times=n_mb)
+    mid = TaskNode(0, 1, program=lambda x: x * 2.0, max_run_times=n_mb)
+    mid2 = TaskNode(0, 2, program=lambda x: x + 1.0, max_run_times=n_mb)
+    sink = TaskNode(0, 3, node_type="Sink", max_run_times=n_mb)
+    src.add_downstream_task(1)
+    mid.add_upstream_task(0)
+    mid.add_downstream_task(2)
+    mid2.add_upstream_task(1)
+    mid2.add_downstream_task(3)
+    sink.add_upstream_task(2)
+
+    exe = FleetExecutor(0, [src, mid, mid2, sink])
+    results = exe.run(feed_fn=lambda i: feeds[i], timeout=30)
+    assert set(results) == set(range(n_mb))
+    for i in range(n_mb):
+        out = results[i]
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        np.testing.assert_allclose(np.asarray(out), feeds[i] * 2.0 + 1.0)
+
+
+def test_cond_interceptor_routes_by_predicate():
+    n_mb = 4
+    feeds = [np.full((1,), float(i), np.float32) for i in range(n_mb)]
+
+    src = TaskNode(0, 0, node_type="Source", max_run_times=n_mb)
+    cond = TaskNode(0, 1, node_type="Cond",
+                    cond_fn=lambda p: float(np.asarray(p)[0]) < 2)
+    small = TaskNode(0, 2, program=lambda x: x * 10.0, max_run_times=n_mb)
+    big = TaskNode(0, 3, program=lambda x: x * 100.0, max_run_times=n_mb)
+    sink = TaskNode(0, 4, node_type="Sink", max_run_times=n_mb)
+    src.add_downstream_task(1)
+    cond.add_upstream_task(0)
+    cond.add_downstream_task(2)   # true branch
+    cond.add_downstream_task(3)   # false branch
+    small.add_upstream_task(1)
+    small.add_downstream_task(4)
+    big.add_upstream_task(1)
+    big.add_downstream_task(4)
+    sink.add_upstream_task(2)
+    sink.add_upstream_task(3)
+
+    exe = FleetExecutor(0, [src, cond, small, big, sink])
+    results = exe.run(feed_fn=lambda i: feeds[i], timeout=30)
+    got = {i: float(np.asarray(
+        v[0] if isinstance(v, (list, tuple)) else v)[0])
+        for i, v in results.items()}
+    assert got == {0: 0.0, 1: 10.0, 2: 200.0, 3: 300.0}
+
+
+def test_amplifier_repeats_program():
+    src = TaskNode(0, 0, node_type="Source", max_run_times=1)
+    amp = TaskNode(0, 1, program=lambda x: x * 2.0, max_run_times=1,
+                   node_type="Amplifier")
+    sink = TaskNode(0, 2, node_type="Sink", max_run_times=1)
+    src.add_downstream_task(1)
+    amp.add_upstream_task(0)
+    amp.add_downstream_task(2)
+    sink.add_upstream_task(1)
+
+    # run_per_steps configured via the interceptor class default of 1;
+    # build a carrier manually to set 3 repeats
+    carrier = Carrier(0, [src, amp, sink],
+                      feed_fn=lambda i: np.ones(2, np.float32))
+    for itc in carrier._interceptors:
+        if itc.task_id == 1:
+            itc.run_per_steps = 3
+    try:
+        carrier.start()
+        results = carrier.wait(30)
+    finally:
+        carrier.release()
+    out = results[0]
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(np.asarray(out), 8.0 * np.ones(2))
+
+
+def test_actor_error_propagates():
+    def boom(x):
+        raise ValueError("boom")
+
+    src = TaskNode(0, 0, node_type="Source", max_run_times=1)
+    bad = TaskNode(0, 1, program=boom, max_run_times=1)
+    sink = TaskNode(0, 2, node_type="Sink", max_run_times=1)
+    src.add_downstream_task(1)
+    bad.add_upstream_task(0)
+    bad.add_downstream_task(2)
+    sink.add_upstream_task(1)
+
+    exe = FleetExecutor(0, [src, bad, sink])
+    with pytest.raises(RuntimeError, match="task 1 failed"):
+        exe.run(feed_fn=lambda i: np.ones(1, np.float32), timeout=30)
+
+
+def test_cross_process_pipeline_over_tcp_bus():
+    addr0 = f"127.0.0.1:{_free_port()}"
+    addr1 = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(REPO, "tests", "fleet_exec_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(rank), addr0, addr1],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for rank in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, \
+            f"rank {rank} rc={p.returncode}:\n{out[-3000:]}"
+        assert f"FLEET_EXEC_OK rank={rank}" in out, out[-3000:]

@@ -1,0 +1,33 @@
+"""Op-manifest contract tests (reference: paddle/phi/api/yaml/ops.yaml as
+single source of truth; gate = manifest and live registry agree)."""
+import paddle_tpu  # noqa: F401  (fills the registry)
+from paddle_tpu.ops.manifest import (build_manifest, load_manifest,
+                                     validate_manifest)
+
+
+def test_manifest_matches_live_registry():
+    assert validate_manifest() == []
+
+
+def test_manifest_covers_core_categories():
+    entries = load_manifest()
+    assert len(entries) >= 300
+    cats = {e["category"] for e in entries}
+    for expected in ("creation", "math", "linalg", "manipulation",
+                     "reduction", "logic", "random"):
+        assert expected in cats, f"missing category {expected}"
+
+
+def test_manifest_detects_drift(tmp_path):
+    import yaml
+    entries = load_manifest()
+    entries[0]["args"] = [{"name": "definitely_wrong_arg"}]
+    del entries[1]
+    entries.append({"op": "no_such_op_xyz", "category": "misc",
+                    "tensor_method": False, "args": []})
+    p = tmp_path / "ops.yaml"
+    p.write_text(yaml.safe_dump(entries, sort_keys=False))
+    problems = validate_manifest(str(p))
+    assert any("drifted" in x for x in problems)
+    assert any("missing from ops.yaml" in x for x in problems)
+    assert any("not registered" in x for x in problems)

@@ -1,0 +1,66 @@
+"""paddle.distributed.rpc tests (reference: python/paddle/distributed/rpc,
+test pattern test/legacy_test/test_rpc.py — multi-process sync/async calls
++ single-process self-call)."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _double(x):
+    return x * 2
+
+
+def test_rpc_single_process_self_call():
+    # world_size=1: a worker may rpc itself (reference allows this)
+    code = f"""
+import numpy as np
+from paddle_tpu.distributed import rpc
+from tests.test_rpc import _double
+rpc.init_rpc("worker0", 0, 1, "127.0.0.1:{_free_port()}")
+assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+fut = rpc.rpc_async("worker0", _double, args=(np.ones(3),))
+np.testing.assert_allclose(fut.result(), 2 * np.ones(3))
+info = rpc.get_worker_info()
+assert info.rank == 0 and info.name == "worker0"
+rpc.shutdown()
+print("SELF_RPC_OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SELF_RPC_OK" in out.stdout
+
+
+def test_rpc_two_process_ring():
+    master = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(REPO, "tests", "rpc_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(rank), "2", master],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for rank in range(2)]
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"rank {rank}:\n{out[-3000:]}"
+        assert f"RPC_OK rank={rank}" in out
