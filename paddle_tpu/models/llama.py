@@ -316,13 +316,19 @@ class LlamaPretrainingCriterion(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, logits, labels):
-        # logits [B, S, V]; labels [B, S] — predict token t+1
-        from ..ops.manipulation import reshape
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
-        V = shift_logits.shape[-1]
+        # logits [B, S, V]; labels [B, S] — predict token t+1.
+        # Shift the LABELS (roll left, mask the last position with
+        # ignore_index) instead of slicing the logits: numerically
+        # identical, but avoids duplicating the [B, S, V] logits tensor
+        # (~1 GB at llama-7B scale) and keeps S a tile-aligned 2^n.
+        from ..ops.manipulation import reshape, concat
+        from ..ops.creation import full
+        B = labels.shape[0]
+        tail = full([B, 1], self.ignore_index, dtype=labels.dtype)
+        shift_labels = concat([labels[:, 1:], tail], axis=1)
+        V = logits.shape[-1]
         return F.cross_entropy(
-            reshape(shift_logits, [-1, V]),
+            reshape(logits, [-1, V]),
             reshape(shift_labels, [-1]),
             ignore_index=self.ignore_index)
 

@@ -11,8 +11,9 @@ Design notes (see /opt/skills/guides/pallas_guide.md):
   VMEM and streams k/v tiles, keeping the running max/denominator in fp32
   (online softmax).  Causal masking skips fully-masked k tiles.
 - rms_norm: row-tiled, stats in fp32.
-- custom VJPs delegate to the XLA reference implementation — flash forward
-  + XLA backward keeps memory bounded while staying correct.
+- flash backward: FlashAttention-2 two-kernel scheme in Pallas (dq over q
+  tiles, dk/dv over k tiles, p recomputed from the saved lse); masked or
+  ragged configs fall back to the chunked XLA backward.
 """
 from __future__ import annotations
 
@@ -48,7 +49,7 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *out_rest, block_k: int,
                       causal: bool, scale: float, q_offset_blocks: int,
                       causal_off: int = 0):
     """One grid cell: q tile [block_q, d] vs all k/v tiles.
@@ -100,13 +101,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
                                   (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.astype(o_ref.dtype)
+    if out_rest:
+        # log-sum-exp residual for the flash backward, broadcast over a
+        # 128-lane last dim to satisfy mosaic tiling (same layout as the
+        # in-tree pallas flash kernel's l/m residuals); -inf for rows
+        # that attended nothing (fully masked)
+        lse_ref = out_rest[0]
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))       # [bq, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], 128)).astype(
+            jnp.float32)
 
 
 _INTERPRET = [False]  # set True in CPU tests to run kernels interpreted
 
 
-def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
-    """q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256,
+                           with_lse: bool = False):
+    """q,k,v: [B, H, S, D] -> [B, H, S, D]
+    (+ optional lse [B*H, Sq] when with_lse — kernel-internal layout)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
@@ -122,10 +134,17 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
                                q_offset_blocks=0, causal_off=Sk - Sq)
+    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, block_q, 128),
+                                      lambda b, i: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, Sq, 128),
+                                              jnp.float32))
     # Kernel body traced with x64 off: mosaic cannot legalize the i64
     # scalars that python-int arithmetic produces under jax_enable_x64.
     with jax.enable_x64(False):
-        out = pl.pallas_call(
+        res = pl.pallas_call(
             kernel,
             grid=(B * H, Sq // block_q),
             in_specs=[
@@ -133,12 +152,171 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256):
                 pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
                 pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            out_specs=out_specs,
+            out_shape=out_shape,
             interpret=_INTERPRET[0],
         )(qr, kr, vr)
-    return out.reshape(B, H, Sq, D)
+    out = res[0].reshape(B, H, Sq, D)
+    if with_lse:
+        # compact residual [BH, Sq]: the lane broadcast is re-expanded
+        # transiently in the backward (keeping it would cost 128x the
+        # memory across every layer's saved residuals)
+        return out, res[1][..., 0]
+    return out
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         scale: float, causal_off: int):
+    """dQ for one q tile: loop k/v blocks, accumulate ds @ k.
+
+    FlashAttention-2 backward, q-parallel half: p recomputed from the
+    saved lse, delta = rowsum(dO*O) precomputed host-side in XLA."""
+    q = q_ref[0].astype(jnp.float32)                   # [bq, d]
+    do = do_ref[0].astype(jnp.float32)                 # [bq, d]
+    lse = lse_ref[0][:, 0:1].astype(jnp.float32)       # [bq, 1] (lane bcast)
+    delta = delta_ref[0][:, 0:1].astype(jnp.float32)   # [bq, 1]
+    bq, d = q.shape
+    kv_len = k_ref.shape[1]
+    n_kb = kv_len // block_k
+    qi = pl.program_id(1)
+    q_start = qi * jnp.int32(bq)
+
+    def body(kb, dq):
+        k_off = kb * jnp.int32(block_k)
+        k = k_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
+        s = (q @ k.T) * scale                          # [bq, bk]
+        if causal:
+            rows = q_start + jnp.int32(causal_off) + \
+                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        # fully-masked rows have lse = -inf; exp(-inf - -inf) would be
+        # NaN — their probabilities (and grads) are exactly zero
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        dp = do @ v.T                                  # [bq, bk]
+        ds = p * (dp - delta)
+        return dq + (ds @ k) * scale
+
+    if causal:
+        last_kb = jnp.minimum(
+            (q_start + jnp.int32(bq - 1) + jnp.int32(causal_off))
+            // jnp.int32(block_k) + jnp.int32(1), jnp.int32(n_kb))
+    else:
+        last_kb = jnp.int32(n_kb)
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq = jax.lax.fori_loop(jnp.int32(0), last_kb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, causal_off: int):
+    """dK/dV for one k/v tile: loop q blocks, accumulate ds^T q / p^T dO."""
+    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[0].astype(jnp.float32)                   # [bk, d]
+    bk, d = k.shape
+    q_len = q_ref.shape[1]
+    n_qb = q_len // block_q
+    ki = pl.program_id(1)
+    k_start = ki * jnp.int32(bk)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_off = qb * jnp.int32(block_q)
+        q = q_ref[0, pl.dslice(q_off, block_q)].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(q_off, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(q_off, block_q), 0:1].astype(
+            jnp.float32)
+        delta = delta_ref[0, pl.dslice(q_off, block_q), 0:1].astype(
+            jnp.float32)
+        s = (q @ k.T) * scale                          # [bq_blk, bk]
+        if causal:
+            rows = q_off + jnp.int32(causal_off) + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        dv_new = dv + p.T @ do                         # [bk, d]
+        dp = do @ v.T                                  # [bq_blk, bk]
+        ds = p * (dp - delta)
+        dk_new = dk + (ds.T @ q) * scale
+        return dk_new, dv_new
+
+    if causal:
+        # q rows attending this k tile start at k_start - causal_off
+        first_qb = jnp.maximum(
+            (k_start - jnp.int32(causal_off)) // jnp.int32(block_q),
+            jnp.int32(0))
+    else:
+        first_qb = jnp.int32(0)
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_qb, jnp.int32(n_qb), body,
+                               (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
+                         block_q=256, block_k=256):
+    """Pallas flash backward (FlashAttention-2 two-kernel scheme):
+    dq parallel over q tiles; dk/dv parallel over k tiles; both recompute
+    p from the forward's lse, so memory stays O(S·D + S)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    scale = 1.0 / math.sqrt(D)
+    causal_off = Sk - Sq
+
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+    dor = g.reshape(B * H, Sq, D)
+    # lane-broadcast lse/delta to the mosaic-tileable [BH, Sq, 128]
+    # layout (transient per-layer; residual stays compact [BH, Sq])
+    lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
+                            (B * H, Sq, 128))
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(B * H, Sq)
+    delta = jnp.broadcast_to(delta[..., None], (B * H, Sq, 128))
+
+    full_q = pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0))
+    full_row = pl.BlockSpec((1, Sq, 128), lambda b, i: (b, 0, 0))
+    tile_q = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))
+    tile_k = pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0))
+    tile_row = pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0))
+
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                              causal=causal, scale=scale,
+                              causal_off=causal_off),
+            grid=(B * H, Sq // block_q),
+            in_specs=[tile_q, full_k, full_k, tile_q, tile_row, tile_row],
+            out_specs=tile_q,
+            out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            interpret=_INTERPRET[0],
+        )(qr, kr, vr, dor, lser, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                              causal=causal, scale=scale,
+                              causal_off=causal_off),
+            grid=(B * H, Sk // block_k),
+            in_specs=[full_q, tile_k, tile_k, full_q, full_row, full_row],
+            out_specs=[tile_k, tile_k],
+            out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
+                       jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
+            interpret=_INTERPRET[0],
+        )(qr, kr, vr, dor, lser, delta)
+
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
 
 
 def _sdpa_reference(q, k, v, causal):
@@ -263,13 +441,22 @@ def _flash_sdpa(q, k, v, causal):
 
 
 def _flash_sdpa_fwd(q, k, v, causal):
-    return _flash_sdpa(q, k, v, causal), (q, k, v)
+    if _pallas_ok(q, k, None):
+        bq, bk = _select_flash_blocks(q, k, v, causal)
+        out, lse = _flash_attention_value(q, k, v, causal, bq, bk,
+                                          with_lse=True)
+        return out, (q, k, v, out, lse)
+    return _chunked_sdpa(q, k, v, causal), (q, k, v, None, None)
 
 
 def _flash_sdpa_bwd(causal, res, g):
-    q, k, v = res
-    # chunked backward: block recompute keeps memory bounded (replaces
-    # the r1 full-materialization VJP)
+    q, k, v, out, lse = res
+    if lse is not None:
+        # Pallas flash backward: p recomputed from lse per tile, memory
+        # stays O(S·D + S) and both halves run tiled on the MXU
+        return _flash_attention_bwd(q, k, v, out, lse, g, causal)
+    # chunked backward: block recompute keeps memory bounded (fallback
+    # for masked/ragged configs the Pallas kernel rejects)
     _, vjp = jax.vjp(lambda q_, k_, v_: _chunked_sdpa(q_, k_, v_, causal),
                      q, k, v)
     return vjp(g)
@@ -281,10 +468,10 @@ _flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)
 def flash_attention_tpu(query, key, value, attn_mask=None, is_causal=False):
     """Flash attention, paddle layout [B, S, H, D].
 
-    Clean configs (no mask, block-divisible) hit the Pallas forward
-    kernel on TPU; masked or ragged-length configs run the chunked
-    online-softmax path — still memory-bounded, still one dispatched op.
-    The VJP is always the chunked backward."""
+    Clean configs (no mask, block-divisible) hit the Pallas forward and
+    the Pallas FlashAttention-2 backward on TPU; masked or ragged-length
+    configs run the chunked online-softmax path with its block-recomputed
+    backward — still memory-bounded, still one dispatched op."""
 
     def fn(q, k, v, *m):
         q_ = jnp.swapaxes(q, 1, 2)

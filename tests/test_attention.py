@@ -201,3 +201,118 @@ def test_llama_uses_ring_under_sep():
     out_full = m2(paddle.to_tensor(ids))
     np.testing.assert_allclose(out_sep.numpy(), out_full.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_flash_backward_matches_reference():
+    """Interpret-mode check of the Pallas flash backward kernels
+    (_flash_bwd_dq_kernel/_flash_bwd_dkv_kernel) against the
+    full-materialization reference VJP."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(0)
+    B, H, S, D = 2, 2, 256, 32
+    q = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        for causal in (False, True):
+            out, lse = pk._flash_attention_value(
+                q, k, v, causal, block_q=128, block_k=128, with_lse=True)
+            ref = pk._sdpa_reference(q, k, v, causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+            dq, dk, dv = pk._flash_attention_bwd(
+                q, k, v, out, lse, g, causal, block_q=128, block_k=128)
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_: pk._sdpa_reference(q_, k_, v_, causal),
+                q, k, v)
+            rdq, rdk, rdv = vjp(g)
+            np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                       rtol=2e-3, atol=2e-3)
+            np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                       rtol=2e-3, atol=2e-3)
+            np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                       rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_pallas_flash_backward_rectangular_decode():
+    """Sq != Sk (bottom-right-aligned causal) through the Pallas bwd."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(1)
+    B, H, Sq, Sk, D = 1, 2, 128, 256, 32
+    q = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(
+            q, k, v, True, block_q=128, block_k=128, with_lse=True)
+        dq, dk, dv = pk._flash_attention_bwd(
+            q, k, v, out, lse, g, True, block_q=128, block_k=128)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: pk._sdpa_reference(q_, k_, v_, True),
+            q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_pallas_flash_backward_fully_masked_rows_finite():
+    """Sq > Sk causal (causal_off < 0): leading query rows attend nothing;
+    their lse is -inf and gradients must be exactly 0, not NaN."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(2)
+    B, H, Sq, Sk, D = 1, 1, 256, 128, 32
+    q = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(
+            q, k, v, True, block_q=128, block_k=128, with_lse=True)
+        dq, dk, dv = pk._flash_attention_bwd(
+            q, k, v, out, lse, g, True, block_q=128, block_k=128)
+        assert np.isfinite(np.asarray(dq)).all()
+        assert np.isfinite(np.asarray(dk)).all()
+        assert np.isfinite(np.asarray(dv)).all()
+        # rows that attend nothing (first Sq-Sk rows) get zero dq
+        np.testing.assert_allclose(np.asarray(dq)[:, :, :Sq - Sk], 0.0)
+        # the attending tail matches the chunked backward
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: pk._chunked_sdpa(q_, k_, v_, True), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq)[:, :, Sq - Sk:],
+                                   np.asarray(rdq)[:, :, Sq - Sk:],
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
