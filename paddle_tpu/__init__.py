@@ -75,3 +75,8 @@ from .framework_io import save, load  # noqa: E402  (added with io subsystem)
 if "hapi" in _OPTIONAL_SUBMODULES and globals().get("hapi") is not None:
     from .hapi import Model, summary              # noqa: E402
     from .hapi import callbacks                   # noqa: E402
+
+if "static" in _OPTIONAL_SUBMODULES and globals().get("static") is not None:
+    # paddle.enable_static()/disable_static() parity; in_dynamic_mode is
+    # the registered op (ops/logic.py), which consults static mode
+    from .static import enable_static, disable_static  # noqa: E402

@@ -64,18 +64,26 @@ def _check_nan_inf(name: str, vals: Sequence[Array]):
                 print("WARNING:", msg)
 
 
+def amp_policy(name: str, level: str, target, white, black):
+    """The O1/O2 white/black-list cast decision (reference:
+    python/paddle/amp/amp_lists.py:30,105 and eager_amp_auto_cast.h) —
+    single implementation shared by eager dispatch and the static-graph
+    AMP retargeting pass."""
+    base = name.split("::")[0]
+    if base in black:
+        return jnp.float32
+    if base in white or level == "O2":
+        return target
+    return None
+
+
 def _amp_cast_dtype(name: str):
-    """O1 auto-cast target per white/black list (reference:
-    python/paddle/amp/amp_lists.py:30,105 and eager_amp_auto_cast.h).
-    Returns the dtype inputs should be cast to, or None."""
+    """Cast target for the active eager auto_cast scope, or None."""
     st = _amp_state
     if not st["enabled"]:
         return None
-    if name in st["black"]:
-        return jnp.float32
-    if name in st["white"] or st["level"] == "O2":
-        return st["dtype"]
-    return None
+    return amp_policy(name, st["level"], st["dtype"], st["white"],
+                      st["black"])
 
 
 def _amp_cast(v, cast_to):

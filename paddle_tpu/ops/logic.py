@@ -50,5 +50,10 @@ def is_tensor(x):
 
 @register_op("in_dynamic_mode", category="logic")
 def in_dynamic_mode():
-    """Eager is the default mode (parity: paddle.in_dynamic_mode)."""
-    return True
+    """Parity: paddle.in_dynamic_mode — False while enable_static() is
+    active."""
+    try:
+        from ..static import in_static_mode
+        return not in_static_mode()
+    except ImportError:
+        return True

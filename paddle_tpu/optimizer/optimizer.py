@@ -176,6 +176,18 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # static-graph mode: register the train spec on the program being
+        # captured; the Executor compiles loss+grads+update into one step
+        # (parity: minimize appending backward+optimize ops to the
+        # ProgramDesc)
+        from ..core import dispatch as _dispatch
+        rec = _dispatch._sot_recorder[0]
+        if rec is not None:
+            from .. import static as _static
+            prog = _static.default_main_program()
+            if rec is prog.recorder:
+                prog.set_train_spec(loss, self)
+                return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,34 +1,53 @@
-"""paddle_tpu.static — static-graph facade.
+"""paddle_tpu.static — static-graph mode over trace-captured programs.
 
-Parity: python/paddle/static/ (reference Program/Executor surface,
-python/paddle/base/executor.py:1152) and the new executor's Plan-of-Jobs
-(paddle/fluid/framework/new_executor/interpreter/plan.h:31, SURVEY.md #29).
+Parity: python/paddle/static/ (Program/Executor surface,
+python/paddle/base/executor.py:1152; ProgramDesc #27 and the new
+executor's Plan-of-Jobs paddle/fluid/framework/new_executor/interpreter/
+plan.h:31, SURVEY.md #27/#29).
 
-TPU-native design: a "Program" is a compiled (jitted/exported) function; an
-Executor runs a Plan = typed Job list with a micro-batch count — the same
-host-side scheduling seam the reference uses for pipeline schedules
-(FThenB / 1F1B job lists, python/paddle/distributed/passes/
-pipeline_scheduler_pass.py), which paddle_tpu.distributed.pipeline builds
-on.
+TPU-native design: a Program IS a recorded StatementIR (the same linear
+op-trace jit/sot captures at the dispatch choke point).  Building the
+program executes the graph-construction code once with placeholder
+values while every dispatched op is recorded; ``Executor.run`` compiles
+the recorded statements into one ``jax.jit`` module per (feed, fetch)
+signature and replays it with the run's feed arrays — the analog of the
+reference building a ProgramDesc and the StandaloneExecutor compiling it
+per scope.  ``optimizer.minimize(loss)`` inside a program registers a
+train spec; the Executor then compiles loss + grads + update into a
+single donated-buffer XLA step (same shape as jit.train_step).
+
+The Plan/Job scheduling seam is kept for pipeline schedules
+(paddle_tpu.distributed's 1F1B/VPP builds Plans of typed Jobs).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
 
 from ..jit.api import InputSpec, to_static, StaticFunction
 from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+from ..jit.sot.statement_ir import Recorder, StatementIR, build_replay
 
-__all__ = ["InputSpec", "Program", "Executor", "Job", "Plan",
-           "default_main_program", "program_guard", "name_scope", "amp"]
+__all__ = ["InputSpec", "Program", "Executor", "Job", "Plan", "data",
+           "default_main_program", "default_startup_program",
+           "program_guard", "name_scope", "amp", "save_inference_model",
+           "load_inference_model", "enable_static", "disable_static",
+           "in_static_mode"]
 
 
 class Job:
     """One schedulable unit (parity: interpreter/job.h) — a compiled
-    callable plus its type tag (forward/backward/optimizer/send/recv...) and
-    micro-batch id."""
+    callable plus its type tag (forward/backward/optimizer/send/recv...)
+    and micro-batch id."""
 
-    def __init__(self, type: str, fn: Callable = None, micro_batch_id: int = 0):
+    def __init__(self, type: str, fn: Callable = None,
+                 micro_batch_id: int = 0):
         self.type = type
         self.fn = fn
         self.micro_batch_id = micro_batch_id
@@ -47,33 +66,68 @@ class Plan:
         self.micro_batch_num = micro_batch_num
 
 
-class Program:
-    """Thin program record (parity surface of paddle.static.Program).
+class _StaticRecorder(Recorder):
+    """Recorder variant for program capture: RNG keys drawn by parameter
+    initializers (startup work, not program ops) are tolerated instead of
+    poisoning the trace."""
 
-    Holds a traced callable; real compilation happens via jit/to_static.
-    Exists so code written against the reference's Program API has a home.
-    """
+    def drop_unused_rng(self):
+        self._rng_pending.clear()
+
+
+class Program:
+    """A trace-captured program (parity: paddle.static.Program /
+    ProgramDesc).  Ops dispatched while this program's guard is active
+    are appended to its statement list; placeholders created with
+    ``static.data`` are its feed inputs."""
 
     _counter = 0
 
     def __init__(self, fn: Optional[Callable] = None, name: str = None):
         Program._counter += 1
         self.name = name or f"program_{Program._counter}"
-        self.fn = fn
-        self._is_start_up = False
+        self.fn = fn                       # legacy callable-program path
+        self.recorder = _StaticRecorder()
+        self.feeds: List[Tuple[str, Tensor]] = []
+        self.train_spec = None             # (loss Tensor, optimizer)
+        self.amp_config = None             # (level, dtype) via static.amp
+        self._compiled: Dict[Any, Any] = {}
 
+    # -- capture-side API ----------------------------------------------------
+    def add_feed(self, name: str, tensor: Tensor):
+        if any(n == name for n, _ in self.feeds):
+            raise ValueError(f"duplicate feed name {name!r}")
+        self.feeds.append((name, tensor))
+        self.recorder.declare_input(tensor)
+
+    def set_train_spec(self, loss: Tensor, optimizer):
+        self.train_spec = (loss, optimizer)
+
+    # -- introspection parity ------------------------------------------------
     def clone(self, for_test: bool = False):
-        return Program(self.fn, self.name + "_clone")
+        cloned = Program(self.fn, self.name + "_clone")
+        cloned.recorder = self.recorder
+        cloned.feeds = list(self.feeds)
+        cloned.amp_config = self.amp_config
+        if not for_test:
+            cloned.train_spec = self.train_spec
+        return cloned
 
     def global_block(self):
         return self
 
+    @property
+    def ops(self):
+        return list(self.recorder.statements)
+
     def __repr__(self):
-        return f"Program({self.name})"
+        return (f"Program({self.name}, ops={len(self.recorder.statements)},"
+                f" feeds={[n for n, _ in self.feeds]})")
 
 
 _MAIN_PROGRAM = Program(name="main")
 _STARTUP_PROGRAM = Program(name="startup")
+_STATIC_MODE = [False]
 
 
 def default_main_program():
@@ -84,20 +138,73 @@ def default_startup_program():
     return _STARTUP_PROGRAM
 
 
-import contextlib
+def in_static_mode() -> bool:
+    return _STATIC_MODE[0]
+
+
+def _activate(program: Optional[Program]):
+    """Install/remove the program's recorder at the dispatch choke
+    point."""
+    _dispatch._sot_recorder[0] = program.recorder if program is not None \
+        else None
 
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
     global _MAIN_PROGRAM, _STARTUP_PROGRAM
     old_m, old_s = _MAIN_PROGRAM, _STARTUP_PROGRAM
+    old_rec = _dispatch._sot_recorder[0]
     _MAIN_PROGRAM = main_program
     if startup_program is not None:
         _STARTUP_PROGRAM = startup_program
+    _activate(main_program)
     try:
         yield
     finally:
+        main_program.recorder.drop_unused_rng()
         _MAIN_PROGRAM, _STARTUP_PROGRAM = old_m, old_s
+        _dispatch._sot_recorder[0] = old_rec
+
+
+def enable_static():
+    """Parity: paddle.enable_static — subsequent ops record into the
+    default main program until disable_static()."""
+    _STATIC_MODE[0] = True
+    _activate(_MAIN_PROGRAM)
+
+
+def disable_static():
+    _STATIC_MODE[0] = False
+    _MAIN_PROGRAM.recorder.drop_unused_rng()
+    _activate(None)
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Parity: paddle.static.data — a named feed placeholder.
+
+    Trace-by-execution: the placeholder carries zeros of the declared
+    shape during program construction; Executor.run substitutes the
+    run's feed array."""
+    from ..core import dtypes as _dt
+    shape = [1 if (s is None or (isinstance(s, int) and s < 0)) else int(s)
+             for s in shape]
+    rec = _dispatch._sot_recorder[0]
+    # create the placeholder value OUTSIDE recording so it enters the
+    # program as a declared input, not a recorded op
+    _dispatch._sot_recorder[0] = None
+    try:
+        t = Tensor(np.zeros(shape, _dt.convert_dtype(dtype)))
+    finally:
+        _dispatch._sot_recorder[0] = rec
+    t.name = name
+    t.stop_gradient = True
+    prog = _MAIN_PROGRAM
+    if rec is not prog.recorder:
+        raise RuntimeError(
+            "static.data must be called inside program_guard / "
+            "enable_static")
+    prog.add_feed(name, t)
+    return t
 
 
 @contextlib.contextmanager
@@ -105,20 +212,22 @@ def name_scope(prefix=None):
     yield
 
 
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
 class Executor:
-    """Plan runner (parity: StandaloneExecutor,
-    paddle/fluid/framework/new_executor/standalone_executor.h:34).
-
-    run(program_or_plan, feed, fetch_list) executes either a single compiled
-    program or a Plan of Jobs over micro-batches.
-    """
+    """Compiles and runs captured programs (parity: StandaloneExecutor,
+    standalone_executor.h:34; Plan path = pipeline schedules)."""
 
     def __init__(self, place=None):
         self.place = place
 
+    # -- public --------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
         feed = feed or {}
+        if program is None:
+            program = _MAIN_PROGRAM
         if isinstance(program, Plan):
             results = []
             for job in program.jobs:
@@ -126,26 +235,277 @@ class Executor:
                 if out is not None:
                     results.append(out)
             return results
-        if isinstance(program, Program):
-            fn = program.fn
-        else:
+        if not isinstance(program, Program):
             fn = program
-        if fn is None:
-            return []
-        out = fn(**feed) if feed else fn()
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        if return_numpy:
-            return [o.numpy() if isinstance(o, Tensor) else o for o in outs]
-        return list(outs)
+            out = fn(**feed) if feed else fn()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o.numpy() if (return_numpy and isinstance(o, Tensor))
+                    else o for o in outs]
+        if program.fn is not None:          # legacy callable-program
+            out = program.fn(**feed) if feed else program.fn()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o.numpy() if (return_numpy and isinstance(o, Tensor))
+                    else o for o in outs]
+        if not program.recorder.statements:
+            return []                        # startup program: no-op here
+        if program.recorder.poisoned:
+            raise RuntimeError(
+                "program capture is invalid: " + str(program.recorder.reason))
+        return self._run_captured(program, feed, fetch_list or [],
+                                  return_numpy)
 
     def close(self):
         pass
 
+    # -- captured-program execution -----------------------------------------
+    def _resolve_syms(self, program, tensors):
+        syms = []
+        for t in tensors:
+            sym = program.recorder._sym_of.get(id(t._value))
+            if sym is None:
+                raise ValueError(
+                    f"fetch target {getattr(t, 'name', t)} was not "
+                    "produced by this program")
+            syms.append(sym)
+        return syms
 
+    def _build_ir(self, program, fetch_syms):
+        from ..jit.sot.statement_ir import Statement
+        rec = program.recorder
+        rec.drop_unused_rng()
+        captures = [(t, sym) for (t, sym) in rec._captures.values()]
+        # clone statements: compile-time transforms (static AMP retargets
+        # cast_to) must not leak into the recorder's shared objects
+        stmts = [Statement(s.name, s.fn, s.arg_spec, s.kwargs, s.cast_to,
+                           s.out_syms) for s in rec.statements]
+        return StatementIR(
+            input_syms=[sym for (_, sym, _) in rec._inputs],
+            captures=captures,
+            statements=stmts,
+            n_rng=len(rec._rng_slots),
+            out_syms=list(fetch_syms),
+            out_tree=None, out_consts=[None] * len(fetch_syms),
+            writebacks=[])
+
+    @staticmethod
+    def _dce(ir):
+        """Backward slice: drop statements whose outputs don't reach the
+        fetch syms (parity: Program.prune / the reference executor's
+        graph pruning before run)."""
+        needed = set(ir.out_syms)
+        kept = []
+        for st in reversed(ir.statements):
+            if needed.intersection(st.out_syms):
+                kept.append(st)
+                needed.update(sym for kind, sym in st.arg_spec
+                              if kind == "s")
+        ir.statements = kept[::-1]
+        return needed
+
+    def _apply_static_amp(self, program, ir):
+        if not program.amp_config:
+            return
+        level, dtype = program.amp_config
+        from ..amp import _amp_dtype_for_op
+        for st in ir.statements:
+            st.cast_to = _amp_dtype_for_op(st.name, level, dtype)
+
+    def _run_captured(self, program, feed, fetch_list, return_numpy):
+        from ..ops import random as _random
+        fetch_syms = tuple(self._resolve_syms(program, fetch_list))
+        n_stmt = len(program.recorder.statements)
+        train = program.train_spec is not None
+        key = ("cap", fetch_syms, n_stmt, train, program.amp_config)
+        entry = program._compiled.get(key)
+        if entry is None:
+            ir = self._build_ir(program, fetch_syms)
+            self._apply_static_amp(program, ir)
+            if train:
+                entry = self._compile_train(program, ir)
+            else:
+                entry = self._compile_infer(ir)
+            program._compiled[key] = entry
+        run_fn, ir = entry
+
+        feed_vals = []
+        for name, placeholder in program.feeds:
+            if name not in feed:
+                raise ValueError(f"missing feed {name!r}")
+            v = feed[name]
+            v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            want = tuple(placeholder._value.shape)
+            if tuple(v.shape) != want:
+                raise ValueError(
+                    f"feed {name!r} has shape {tuple(v.shape)} but the "
+                    f"program was captured with shape {want} — this "
+                    "trace-specialized static mode bakes placeholder "
+                    "shapes at build time (declare the concrete shape in "
+                    "static.data; None dims are pinned to 1)")
+            feed_vals.append(v)
+        outs = run_fn(_random.next_key(), feed_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor._from_value(o) for o in outs]
+
+    def _compile_infer(self, ir):
+        replay = jax.jit(build_replay(ir))
+        caps = [t for (t, _) in ir.captures]
+
+        def run(base_key, feed_vals):
+            cap_vals = [t._value for t in caps]
+            return replay(base_key, *cap_vals, *feed_vals)
+
+        return (run, ir)
+
+    def _compile_train(self, program, ir):
+        """One fused XLA step: replay -> loss, grads wrt trainable
+        captures, optimizer update (same shape as jit/train_step)."""
+        loss_t, opt = program.train_spec
+        loss_sym = program.recorder._sym_of.get(id(loss_t._value))
+        if loss_sym is None:
+            raise ValueError("minimize() loss is not part of the program")
+        # the step's outputs = fetches + the loss (last)
+        step_ir = self._build_ir(program, tuple(ir.out_syms) + (loss_sym,))
+        self._apply_static_amp(program, step_ir)
+        replay = build_replay(step_ir)
+        caps = [t for (t, _) in step_ir.captures]
+        train_param_ids = {id(p) for p in opt._parameter_list
+                           if not p.stop_gradient}
+        train_idx = [i for i, t in enumerate(caps)
+                     if id(t) in train_param_ids]
+        opt_states = [opt._ensure_state(caps[i]) for i in train_idx]
+        update = opt._update_rule
+
+        def step(base_key, cap_vals, feed_vals, states, lr):
+            def loss_fn(train_vals):
+                full = list(cap_vals)
+                for i, v in zip(train_idx, train_vals):
+                    full[i] = v
+                outs = replay(base_key, *full, *feed_vals)
+                return outs[-1].astype(jnp.float32).sum(), outs[:-1]
+
+            (loss, fetches), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)([cap_vals[i] for i in train_idx])
+            hyper = {"lr": lr}
+            new_vals, new_states = [], []
+            for v, g, st in zip([cap_vals[i] for i in train_idx], grads,
+                                states):
+                nv, nst = update(v, g, st, hyper)
+                new_vals.append(nv)
+                new_states.append(nst)
+            return loss, fetches, new_vals, new_states
+
+        jit_step = jax.jit(step)
+
+        def run(base_key, feed_vals):
+            cap_vals = [t._value for t in caps]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            loss, fetches, new_vals, new_states = jit_step(
+                base_key, cap_vals, feed_vals, opt_states, lr)
+            for pos, (i, nv, nst) in enumerate(
+                    zip(train_idx, new_vals, new_states)):
+                caps[i]._value = nv
+                opt_states[pos].update(nst)
+            opt._global_step += 1
+            return fetches
+
+        return (run, step_ir)
+
+
+# ---------------------------------------------------------------------------
+# save/load inference model (parity: python/paddle/static/io.py)
+# ---------------------------------------------------------------------------
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export the captured inference graph as StableHLO
+    (parity: paddle.static.save_inference_model, python/paddle/static/io.py
+    — same .pdexec/.json artifact family as jit.save)."""
+    import json
+    import os
+    from jax import export as jax_export
+
+    program = program or _MAIN_PROGRAM
+    exe = executor if isinstance(executor, Executor) else Executor()
+    fetch_list = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_list = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_syms = tuple(exe._resolve_syms(program, fetch_list))
+    ir = exe._build_ir(program, fetch_syms)
+    # restrict inputs to the exported feed set, then prune the program to
+    # the fetch slice (e.g. drop loss/label statements from an inference
+    # export)
+    feed_syms = exe._resolve_syms(program, feed_list)
+    ir.input_syms = list(feed_syms)
+    needed = Executor._dce(ir)
+    missing = needed - set(feed_syms) \
+        - {sym for (_, sym) in ir.captures} \
+        - {s for st in ir.statements for s in st.out_syms}
+    if missing:
+        raise ValueError(
+            "the fetch graph depends on placeholders not listed in "
+            f"feed_vars (program syms {sorted(missing)})")
+    replay = build_replay(ir)
+    caps = [t._value for (t, _) in ir.captures]
+
+    def fn(*feed_vals):
+        return replay(jax.random.PRNGKey(0), *caps, *feed_vals)
+
+    specs = [jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+             for t in feed_list]
+    exported = jax_export.export(jax.jit(fn))(*specs)
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdexec", "wb") as f:
+        f.write(exported.serialize())
+    feed_names = [getattr(t, "name", f"feed_{i}")
+                  for i, t in enumerate(feed_list)]
+    with open(path_prefix + ".json", "w") as f:
+        json.dump({"format": "paddle_tpu.static.v1",
+                   "feed_names": feed_names,
+                   "n_fetch": len(fetch_list)}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load a saved inference program; returns (program, feed_names,
+    fetch_names) like the reference — program runnable via
+    Executor.run(program, feed=...)."""
+    import json
+    from jax import export as jax_export
+
+    with open(path_prefix + ".pdexec", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".json") as f:
+        meta = json.load(f)
+    feed_names = meta["feed_names"]
+
+    prog = Program(name="loaded")
+
+    def callable_program(**feed):
+        vals = [feed[n]._value if isinstance(feed[n], Tensor)
+                else jnp.asarray(feed[n]) for n in feed_names]
+        outs = exported.call(*vals)
+        return [Tensor._from_value(o) for o in outs]
+
+    prog.fn = callable_program
+    return prog, feed_names, [f"fetch_{i}" for i in
+                              range(meta["n_fetch"])]
+
+
+# ---------------------------------------------------------------------------
 # AMP sub-namespace parity (python/paddle/static/amp/)
+# ---------------------------------------------------------------------------
 class _StaticAmp:
     @staticmethod
-    def decorate(optimizer, **kw):
+    def decorate(optimizer, amp_lists=None, level="O1", dtype="float16",
+                 **kw):
+        """Marks the default main program for mixed-precision replay:
+        recorded statements get per-op cast dtypes from the O1/O2 lists
+        at compile time (the reference rewrites the ProgramDesc with
+        cast ops; under XLA the casts fuse into the surrounding
+        kernels)."""
+        _MAIN_PROGRAM.amp_config = (level, dtype)
         return optimizer
 
 
