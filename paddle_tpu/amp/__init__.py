@@ -32,17 +32,22 @@ def is_bfloat16_supported(device=None):
     return True
 
 
-def _amp_dtype_for_op(name: str, level: str, dtype: str):
+def _amp_dtype_for_op(name: str, level: str, dtype: str,
+                      custom_white=(), custom_black=()):
     """Per-op cast target under the O1/O2 lists — used by the static
     Executor to retarget recorded statements (parity: the static-graph
     AMP pass rewriting ProgramDesc with casts,
     python/paddle/static/amp/fp16_utils.py).  Delegates to the same
-    policy the eager dispatch uses."""
+    policy the eager dispatch uses, with user list overrides applied the
+    same way auto_cast applies them."""
     import jax.numpy as jnp
     from ..core.dispatch import amp_policy
     target = jnp.bfloat16 if "bfloat" in str(dtype) else jnp.float16
-    return amp_policy(name, level, target, frozenset(WHITE_LIST),
-                      frozenset(BLACK_LIST))
+    white = (frozenset(WHITE_LIST) | frozenset(custom_white)) \
+        - frozenset(custom_black)
+    black = (frozenset(BLACK_LIST) | frozenset(custom_black)) \
+        - frozenset(custom_white)
+    return amp_policy(name, level, target, white, black)
 
 
 def is_float16_supported(device=None):

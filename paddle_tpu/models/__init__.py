@@ -12,3 +12,5 @@ from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, \
 from .bert import BertConfig, BertModel, BertForPretraining, \
     BertForSequenceClassification
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
+from .qwen import (Qwen2Config, Qwen2Model, Qwen2ForCausalLM,
+                   Qwen2PretrainingCriterion, qwen2_tiny_config)

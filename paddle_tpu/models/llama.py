@@ -53,6 +53,10 @@ class LlamaConfig:
     use_flash_attention: bool = True
     recompute: bool = False
     sequence_parallel: bool = False
+    # "ring" (k/v rotation over ICI) or "ulysses" (all-to-all head swap)
+    seq_parallel_mode: str = "ring"
+    # qkv biases (qwen2-family architecture; llama proper has none)
+    attention_bias: bool = False
 
 
 def llama_7b_config(**kw) -> LlamaConfig:
@@ -108,12 +112,18 @@ class LlamaAttention(Layer):
         self.head_dim = config.hidden_size // config.num_attention_heads
         init = I.Normal(0.0, config.initializer_range)
         h = config.hidden_size
+        sp_mode = getattr(config, "seq_parallel_mode", "ring")
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel_mode must be 'ring' or 'ulysses', got "
+                f"{sp_mode!r}")
+        qkv_bias = bool(getattr(config, "attention_bias", False))
         self.q_proj = Linear(h, self.num_heads * self.head_dim,
-                             weight_attr=_attr(init), bias_attr=False)
+                             weight_attr=_attr(init), bias_attr=qkv_bias)
         self.k_proj = Linear(h, self.num_kv_heads * self.head_dim,
-                             weight_attr=_attr(init), bias_attr=False)
+                             weight_attr=_attr(init), bias_attr=qkv_bias)
         self.v_proj = Linear(h, self.num_kv_heads * self.head_dim,
-                             weight_attr=_attr(init), bias_attr=False)
+                             weight_attr=_attr(init), bias_attr=qkv_bias)
         self.o_proj = Linear(self.num_heads * self.head_dim, h,
                              weight_attr=_attr(init), bias_attr=False)
 
@@ -163,12 +173,15 @@ class LlamaAttention(Layer):
         ring_axis = self._ring_axis() if (is_causal and cache is None) \
             else None
         if ring_axis is not None:
-            from ..ops.pallas_kernels import sdpa_ring
+            from ..ops.pallas_kernels import sdpa_ring, sdpa_ulysses
             from ..distributed.topology import \
                 get_hybrid_communicate_group
-            out = sdpa_ring(q, k, v,
-                            get_hybrid_communicate_group().mesh,
-                            axis_name=ring_axis, is_causal=True)
+            sp_fn = sdpa_ulysses if getattr(
+                self.config, "seq_parallel_mode", "ring") == "ulysses" \
+                else sdpa_ring
+            out = sp_fn(q, k, v,
+                        get_hybrid_communicate_group().mesh,
+                        axis_name=ring_axis, is_causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=is_causal)

@@ -1,0 +1,57 @@
+"""Qwen2-family causal LM.
+
+Capability parity with the PaddleNLP Qwen2 modeling the reference
+ecosystem ships (qwen2 = llama architecture + qkv biases + optional tied
+embeddings; reference architecture family: paddlenlp/transformers/qwen2).
+TPU-native: reuses the LlamaForCausalLM stack (flash attention, ring/
+Ulysses sequence parallelism, recompute) with the qwen2 switches set —
+the same composition HF/PaddleNLP use rather than a duplicated tower.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaPretrainingCriterion, param_count)
+
+__all__ = ["Qwen2Config", "Qwen2Model", "Qwen2ForCausalLM",
+           "Qwen2PretrainingCriterion", "qwen2_tiny_config"]
+
+
+@dataclass
+class Qwen2Config(LlamaConfig):
+    vocab_size: int = 151936
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 28
+    num_key_value_heads: int = 4
+    max_position_embeddings: int = 32768
+    rope_theta: float = 1000000.0
+    attention_bias: bool = True          # the qwen2 signature difference
+    tie_word_embeddings: bool = False
+
+
+def qwen2_tiny_config(**kw) -> Qwen2Config:
+    cfg = dict(vocab_size=1024, hidden_size=128, intermediate_size=352,
+               num_hidden_layers=2, num_attention_heads=4,
+               num_key_value_heads=2, max_position_embeddings=256)
+    cfg.update(kw)
+    return Qwen2Config(**cfg)
+
+
+class Qwen2Model(LlamaModel):
+    """Decoder stack with qwen2 switches (GQA + qkv biases)."""
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    """Parity surface: Qwen2ForCausalLM — same generate/caching path as
+    the llama flagship."""
+
+    def __init__(self, config: Qwen2Config):
+        if not getattr(config, "attention_bias", False):
+            raise ValueError("Qwen2Config requires attention_bias=True")
+        super().__init__(config)
+
+
+Qwen2PretrainingCriterion = LlamaPretrainingCriterion

@@ -394,9 +394,13 @@ def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
             "bhqk,bhkd->bhqd", p, vs.astype(jnp.float32))
         return (m_new, l_new, acc_new), None
 
-    init = (jnp.full((B, H, Sq), -jnp.inf, jnp.float32),
-            jnp.zeros((B, H, Sq), jnp.float32),
-            jnp.zeros((B, H, Sq, D), jnp.float32))
+    # derive the carries from qf so they inherit its device-varying
+    # status under shard_map (a literal zeros init would mismatch the
+    # scan body's output vma when run inside ulysses/ring wrappers)
+    zero_rows = qf[..., 0] * 0.0                      # [B, H, Sq] f32
+    init = (zero_rows - jnp.inf,
+            zero_rows,
+            qf * 0.0)
     (m_, l_, acc), _ = lax.scan(jax.checkpoint(block), init,
                                 jnp.arange(n_kb, dtype=jnp.int32))
     out = acc / jnp.maximum(l_, 1e-30)[..., None]
@@ -606,4 +610,66 @@ def sdpa_ring(query, key, value, mesh, axis_name: str = "sep",
         return ring(q, k, v)
 
     return apply_op("ring_attention", fn,
+                    (query, targ(key), targ(value)))
+
+
+def ulysses_attention(q, k, v, axis_name: str, is_causal=False):
+    """DeepSpeed-Ulysses attention over a mesh axis (SURVEY.md §5.7 —
+    the all-to-all long-context modality; absent from the reference
+    snapshot like ring attention).
+
+    Must run inside shard_map with the sequence dim sharded over
+    ``axis_name``: an all-to-all trades the sequence shard for a HEAD
+    shard (each rank then holds the FULL sequence for H/n heads), local
+    full attention runs unsharded, and a second all-to-all restores the
+    sequence sharding.  Two all-to-alls ride ICI; compute is exactly the
+    dense/flash kernel, so Ulysses wins over ring when heads ≥ ranks and
+    the per-rank full sequence fits.  Inputs [B, S_local, H, D]."""
+    n = jax.lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    if H % n:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by the "
+                         f"axis size ({n})")
+
+    def seq_to_heads(x):
+        # [B, S_loc, H, D] -> all_to_all -> [B, S_full, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qf = seq_to_heads(q)
+    kf = seq_to_heads(k)
+    vf = seq_to_heads(v)
+    # local attention over the full sequence: [B, H/n, S_full, D]
+    out = _chunked_sdpa(jnp.swapaxes(qf, 1, 2), jnp.swapaxes(kf, 1, 2),
+                        jnp.swapaxes(vf, 1, 2), is_causal)
+    out = jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    return heads_to_seq(out)
+
+
+def sdpa_ulysses(query, key, value, mesh, axis_name: str = "sep",
+                 is_causal: bool = False):
+    """Sequence-parallel attention via Ulysses all-to-all (the companion
+    to sdpa_ring; pick ring for S >> heads, ulysses when heads divide
+    evenly and all-to-all bandwidth beats n-step rotation).
+
+    q/k/v: [B, S, H, D] with S sharded over ``axis_name``."""
+    from jax.sharding import PartitionSpec as P
+    from ..distributed.process_mesh import as_jax_mesh
+
+    jmesh = as_jax_mesh(mesh)
+    spec = P(None, axis_name)
+
+    def fn(q, k, v):
+        uly = jax.shard_map(
+            lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis_name,
+                                                 is_causal),
+            mesh=jmesh, axis_names={axis_name},
+            in_specs=(spec, spec, spec), out_specs=spec)
+        return uly(q, k, v)
+
+    return apply_op("ulysses_attention", fn,
                     (query, targ(key), targ(value)))

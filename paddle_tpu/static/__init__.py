@@ -168,7 +168,15 @@ def program_guard(main_program, startup_program=None):
 
 def enable_static():
     """Parity: paddle.enable_static — subsequent ops record into the
-    default main program until disable_static()."""
+    default main program until disable_static().  Starting a NEW static
+    session (the default program already holds a previous session's
+    statements) resets the default programs, so sequential
+    enable/disable cycles in one process don't accumulate stale
+    placeholders/ops."""
+    global _MAIN_PROGRAM, _STARTUP_PROGRAM
+    if not _STATIC_MODE[0] and _MAIN_PROGRAM.recorder.statements:
+        _MAIN_PROGRAM = Program(name="main")
+        _STARTUP_PROGRAM = Program(name="startup")
     _STATIC_MODE[0] = True
     _activate(_MAIN_PROGRAM)
 
@@ -305,10 +313,11 @@ class Executor:
     def _apply_static_amp(self, program, ir):
         if not program.amp_config:
             return
-        level, dtype = program.amp_config
+        level, dtype, custom_white, custom_black = program.amp_config
         from ..amp import _amp_dtype_for_op
         for st in ir.statements:
-            st.cast_to = _amp_dtype_for_op(st.name, level, dtype)
+            st.cast_to = _amp_dtype_for_op(st.name, level, dtype,
+                                           custom_white, custom_black)
 
     def _run_captured(self, program, feed, fetch_list, return_numpy):
         from ..ops import random as _random
@@ -323,12 +332,24 @@ class Executor:
             if train:
                 entry = self._compile_train(program, ir)
             else:
-                entry = self._compile_infer(ir)
+                # prune to the fetch slice (parity: executor graph
+                # pruning) and require only the feeds that slice uses
+                needed = self._dce(ir)
+                used_feeds = [(n, t) for (n, t) in program.feeds
+                              if program.recorder._sym_of.get(
+                                  id(t._value)) in needed]
+                ir.input_syms = [program.recorder._sym_of[id(t._value)]
+                                 for (_, t) in used_feeds]
+                entry = self._compile_infer(ir) + (used_feeds,)
             program._compiled[key] = entry
-        run_fn, ir = entry
+        if train:
+            run_fn, ir = entry[0], entry[1]
+            used_feeds = program.feeds
+        else:
+            run_fn, ir, used_feeds = entry
 
         feed_vals = []
-        for name, placeholder in program.feeds:
+        for name, placeholder in used_feeds:
             if name not in feed:
                 raise ValueError(f"missing feed {name!r}")
             v = feed[name]
@@ -504,8 +525,15 @@ class _StaticAmp:
         recorded statements get per-op cast dtypes from the O1/O2 lists
         at compile time (the reference rewrites the ProgramDesc with
         cast ops; under XLA the casts fuse into the surrounding
-        kernels)."""
-        _MAIN_PROGRAM.amp_config = (level, dtype)
+        kernels).  ``amp_lists`` accepts an object or dict with
+        custom_white_list / custom_black_list overrides."""
+        white, black = (), ()
+        if amp_lists is not None:
+            get = (amp_lists.get if isinstance(amp_lists, dict)
+                   else lambda k, d=None: getattr(amp_lists, k, d))
+            white = tuple(get("custom_white_list", None) or ())
+            black = tuple(get("custom_black_list", None) or ())
+        _MAIN_PROGRAM.amp_config = (level, dtype, white, black)
         return optimizer
 
 

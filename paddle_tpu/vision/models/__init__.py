@@ -230,3 +230,7 @@ class MobileNetV2(nn.Layer):
 def mobilenet_v2(pretrained=False, scale=1.0, **kw):
     _no_pretrained(pretrained)
     return MobileNetV2(scale=scale, **kw)
+
+from .vit import VisionTransformer, vit_b_16, vit_s_16, vit_tiny  # noqa: E402
+
+__all__ += ["VisionTransformer", "vit_b_16", "vit_s_16", "vit_tiny"]

@@ -1,0 +1,88 @@
+"""Vision Transformer (parity family: the reference ecosystem's ViT in
+PaddleClas / paddle.vision model-zoo style — patch embedding via conv,
+class token + learned positions, pre-norm encoder blocks, linear head).
+
+TPU-native: the encoder rides paddle_tpu.nn.TransformerEncoderLayer
+(flash-attention SDPA under the hood) so the same kernels serve NLP and
+vision; patchify is one Conv2D that XLA maps onto the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...ops.creation import zeros
+from ...ops.manipulation import concat, transpose, expand
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_s_16", "vit_tiny"]
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, image_size=224, patch_size=16, in_channels=3,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0,
+                 dropout=0.0, num_classes=1000):
+        super().__init__()
+        if image_size % patch_size:
+            raise ValueError("image_size must be divisible by patch_size")
+        self.patch_embed = nn.Conv2D(in_channels, embed_dim, patch_size,
+                                     stride=patch_size)
+        n_patches = (image_size // patch_size) ** 2
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=nn.initializer.Normal(
+                0.0, 0.02))
+        self.pos_embed = self.create_parameter(
+            [1, n_patches + 1, embed_dim],
+            default_initializer=nn.initializer.Normal(0.0, 0.02))
+        self.dropout = nn.Dropout(dropout)
+        self.blocks = nn.LayerList([
+            nn.TransformerEncoderLayer(
+                embed_dim, num_heads, int(embed_dim * mlp_ratio),
+                dropout=dropout, activation="gelu", normalize_before=True)
+            for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim)
+        self.head = nn.Linear(embed_dim, num_classes) \
+            if num_classes > 0 else None
+
+    def forward(self, x):
+        B = x.shape[0]
+        x = self.patch_embed(x)                   # [B, E, H/p, W/p]
+        x = x.flatten(2)                          # [B, E, N]
+        x = transpose(x, [0, 2, 1])               # [B, N, E]
+        cls = expand(self.cls_token, [B, 1, x.shape[-1]])
+        x = concat([cls, x], axis=1) + self.pos_embed
+        x = self.dropout(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self.head is None:
+            return x
+        return self.head(x[:, 0])
+
+
+def _no_pretrained(pretrained):
+    # vit.py is imported at the end of the package __init__, so the
+    # shared helper is already defined there — one policy, one message
+    from . import _no_pretrained as _impl
+    _impl(pretrained)
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return VisionTransformer(embed_dim=768, depth=12, num_heads=12,
+                             patch_size=16, **kwargs)
+
+
+def vit_s_16(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return VisionTransformer(embed_dim=384, depth=12, num_heads=6,
+                             patch_size=16, **kwargs)
+
+
+def vit_tiny(pretrained=False, **kwargs):
+    """Small config for tests/CPU."""
+    _no_pretrained(pretrained)
+    kwargs.setdefault("image_size", 32)
+    kwargs.setdefault("patch_size", 8)
+    kwargs.setdefault("num_classes", 10)
+    return VisionTransformer(embed_dim=64, depth=2, num_heads=2, **kwargs)

@@ -153,9 +153,48 @@ def test_static_amp_decorate_marks_program():
         opt = paddle.optimizer.SGD(learning_rate=0.1,
                                    parameters=lin.parameters())
         opt = static.amp.decorate(opt, level="O1", dtype="bfloat16")
-    assert main.amp_config == ("O1", "bfloat16")
+    assert main.amp_config == ("O1", "bfloat16", (), ())
     exe = static.Executor()
     (r,) = exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
                    fetch_list=[out])
     expect = lin(paddle.to_tensor(np.ones((4, 4), np.float32))).numpy()
     np.testing.assert_allclose(r, expect, rtol=2e-2, atol=2e-2)
+
+
+def test_clone_for_test_runs_with_inputs_only():
+    """Eval pattern: clone(for_test=True) fed only the model inputs —
+    the fetch slice must not demand the label feed (graph pruning)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 3], "float32")
+        y = static.data("y", [4, 1], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        pred = lin(x)
+        loss = paddle.nn.functional.mse_loss(pred, y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    test_prog = main.clone(for_test=True)
+    exe = static.Executor()
+    feed = np.random.RandomState(0).rand(4, 3).astype("float32")
+    (p,) = exe.run(test_prog, feed={"x": feed}, fetch_list=[pred])
+    np.testing.assert_allclose(p, lin(paddle.to_tensor(feed)).numpy(),
+                               rtol=1e-5)
+
+
+def test_enable_static_resets_previous_session():
+    paddle.enable_static()
+    try:
+        x = static.data("x", [2], "float32")
+        _ = x + 1.0
+    finally:
+        paddle.disable_static()
+    paddle.enable_static()
+    try:
+        x2 = static.data("x", [3], "float32")   # same name: fresh session
+        y2 = x2 * 2.0
+        (r,) = static.Executor().run(
+            feed={"x": np.ones(3, np.float32)}, fetch_list=[y2])
+        np.testing.assert_allclose(r, 2 * np.ones(3))
+    finally:
+        paddle.disable_static()

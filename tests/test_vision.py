@@ -264,3 +264,25 @@ def test_image_backend():
     vision.set_image_backend("pil")
     with pytest.raises(ValueError):
         vision.set_image_backend("bogus")
+
+
+def test_vit_forward_and_grads():
+    from paddle_tpu.vision.models import vit_tiny
+    import paddle_tpu as paddle
+    net = vit_tiny()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert out.shape == [2, 10]
+    loss = (out ** 2).mean()
+    loss.backward()
+    grads = [p.grad for p in net.parameters() if not p.stop_gradient]
+    assert all(g is not None for g in grads)
+    assert all(np.isfinite(g.numpy()).all() for g in grads)
+
+
+def test_vit_b16_structure():
+    from paddle_tpu.vision.models import vit_b_16
+    net = vit_b_16(num_classes=5)
+    n = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert 80e6 < n < 100e6       # ViT-B/16 ~86M params
