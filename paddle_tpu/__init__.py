@@ -55,7 +55,7 @@ _OPTIONAL_SUBMODULES = ["nn", "optimizer", "amp", "io", "jit", "static",
                         "profiler", "device", "framework", "sparse",
                         "linalg_ns", "fft", "models", "text", "audio",
                         "signal", "hapi", "distribution", "quantization",
-                        "onnx", "inference", "utils", "sysconfig", "hub"]
+                        "onnx", "inference", "utils", "sysconfig", "hub", "geometric"]
 
 nn = None
 for _m in list(_OPTIONAL_SUBMODULES):

@@ -1,0 +1,12 @@
+"""paddle_tpu.framework — framework-level types and helpers
+(parity surface: python/paddle/framework/ — dtype defaults, random seed
+re-exports, TensorArray ops)."""
+from ..core.dtypes import get_default_dtype, set_default_dtype
+from ..ops.random import seed, get_rng_state, set_rng_state
+from .tensor_array import (TensorArray, create_array, array_write,
+                           array_read, array_length, array_pop)
+
+__all__ = ["get_default_dtype", "set_default_dtype", "seed",
+           "get_rng_state", "set_rng_state", "TensorArray",
+           "create_array", "array_write", "array_read", "array_length",
+           "array_pop"]

@@ -1,0 +1,86 @@
+"""TensorArray + array_* ops.
+
+Capability parity with the reference's TensorArray type
+(paddle/phi/core/tensor_array.h — a growable vector of DenseTensors used
+by RNN-style loops) and the python surface create_array / array_write /
+array_read / array_length (python/paddle/tensor/array.py).
+
+TPU-native design: eagerly a plain python list of Tensors; under a trace
+users should prefer lax.scan-style ops (to_static's loop conversion), so
+the array ops here stay host-side bookkeeping — matching how the
+reference's eager mode treats TensorArray as a python list too.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.tensor import Tensor
+from ..ops._helpers import wrap, as_value
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length", "array_pop"]
+
+
+class TensorArray(list):
+    """Growable tensor list (parity: phi::TensorArray semantics —
+    write-past-end extends, read checks bounds)."""
+
+    def write(self, index: int, value: Tensor):
+        index = int(index)
+        if index < 0:
+            raise IndexError("TensorArray index must be >= 0")
+        while len(self) <= index:
+            self.append(None)
+        self[index] = value
+        return self
+
+    def read(self, index: int) -> Tensor:
+        index = int(index)
+        if index >= len(self) or self[index] is None:
+            raise IndexError(
+                f"TensorArray read at {index} beyond written length "
+                f"{len(self)}")
+        return self[index]
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from ..ops.manipulation import stack as _stack
+        if any(v is None for v in self):
+            raise ValueError("TensorArray has unwritten holes")
+        return _stack(list(self), axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        from ..ops.manipulation import concat as _concat
+        if any(v is None for v in self):
+            raise ValueError("TensorArray has unwritten holes")
+        return _concat(list(self), axis=axis)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Parity: paddle.tensor.create_array."""
+    arr = TensorArray()
+    for v in (initialized_list or []):
+        arr.append(v if isinstance(v, Tensor) else wrap(as_value(v)))
+    return arr
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    """Parity: paddle.tensor.array_write."""
+    if array is None:
+        array = TensorArray()
+    array.write(int(i), x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    """Parity: paddle.tensor.array_read."""
+    return array.read(int(i))
+
+
+def array_length(array: TensorArray) -> int:
+    """Parity: paddle.tensor.array_length."""
+    return len(array)
+
+
+def array_pop(array: TensorArray, i=-1) -> Tensor:
+    """Parity: paddle.tensor.array_pop."""
+    return array.pop(int(i))

@@ -38,7 +38,7 @@ __all__ = ["InputSpec", "Program", "Executor", "Job", "Plan", "data",
            "default_main_program", "default_startup_program",
            "program_guard", "name_scope", "amp", "save_inference_model",
            "load_inference_model", "enable_static", "disable_static",
-           "in_static_mode"]
+           "in_static_mode", "reset_default_programs"]
 
 
 class Job:
@@ -95,8 +95,13 @@ class Program:
 
     # -- capture-side API ----------------------------------------------------
     def add_feed(self, name: str, tensor: Tensor):
-        if any(n == name for n, _ in self.feeds):
-            raise ValueError(f"duplicate feed name {name!r}")
+        # re-declaring a name rebinds the placeholder (reference
+        # semantics: static.data with an existing name reuses the var)
+        for i, (n, _) in enumerate(self.feeds):
+            if n == name:
+                self.feeds[i] = (name, tensor)
+                self.recorder.declare_input(tensor)
+                return
         self.feeds.append((name, tensor))
         self.recorder.declare_input(tensor)
 
@@ -168,17 +173,24 @@ def program_guard(main_program, startup_program=None):
 
 def enable_static():
     """Parity: paddle.enable_static — subsequent ops record into the
-    default main program until disable_static().  Starting a NEW static
-    session (the default program already holds a previous session's
-    statements) resets the default programs, so sequential
-    enable/disable cycles in one process don't accumulate stale
-    placeholders/ops."""
-    global _MAIN_PROGRAM, _STARTUP_PROGRAM
-    if not _STATIC_MODE[0] and _MAIN_PROGRAM.recorder.statements:
-        _MAIN_PROGRAM = Program(name="main")
-        _STARTUP_PROGRAM = Program(name="startup")
+    default main program until disable_static().  Like the reference,
+    the default program persists across enable/disable cycles (build,
+    drop to eager for a metric, resume); start a genuinely fresh session
+    with ``reset_default_programs()`` or an explicit Program +
+    program_guard."""
     _STATIC_MODE[0] = True
     _activate(_MAIN_PROGRAM)
+
+
+def reset_default_programs():
+    """Replace the default main/startup programs with fresh ones (the
+    escape hatch for sequential independent static sessions in one
+    process)."""
+    global _MAIN_PROGRAM, _STARTUP_PROGRAM
+    _MAIN_PROGRAM = Program(name="main")
+    _STARTUP_PROGRAM = Program(name="startup")
+    if _STATIC_MODE[0]:
+        _activate(_MAIN_PROGRAM)
 
 
 def disable_static():
@@ -287,7 +299,10 @@ class Executor:
         stmts = [Statement(s.name, s.fn, s.arg_spec, s.kwargs, s.cast_to,
                            s.out_syms) for s in rec.statements]
         return StatementIR(
-            input_syms=[sym for (_, sym, _) in rec._inputs],
+            # inputs come from the program's CURRENT feed list (a
+            # rebound placeholder leaves a stale sym in the recorder)
+            input_syms=[rec._sym_of[id(t._value)]
+                        for (_, t) in program.feeds],
             captures=captures,
             statements=stmts,
             n_rng=len(rec._rng_slots),

@@ -8,11 +8,7 @@ vision; patchify is one Conv2D that XLA maps onto the MXU.
 """
 from __future__ import annotations
 
-import numpy as np
-
 from ... import nn
-from ...core.tensor import Tensor
-from ...ops.creation import zeros
 from ...ops.manipulation import concat, transpose, expand
 
 __all__ = ["VisionTransformer", "vit_b_16", "vit_s_16", "vit_tiny"]

@@ -61,3 +61,26 @@ def test_qwen2_forward_backward_and_generate():
     out = m.generate(ids, max_new_tokens=4)
     gen = out[0] if isinstance(out, tuple) else out
     assert gen.shape[1] >= 4
+
+
+def test_tensor_array_ops():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import (create_array, array_write,
+                                      array_read, array_length)
+    arr = create_array()
+    for i in range(3):
+        array_write(paddle.to_tensor(np.full((2,), float(i),
+                                             np.float32)), i, arr)
+    assert array_length(arr) == 3
+    np.testing.assert_allclose(array_read(arr, 1).numpy(), 1.0)
+    stacked = arr.stack()
+    assert stacked.shape == [3, 2]
+    np.testing.assert_allclose(stacked.numpy()[:, 0], [0., 1., 2.])
+    cat = arr.concat()
+    assert cat.shape == [6]
+    # write past end extends; read past end raises
+    array_write(paddle.to_tensor(np.zeros(2, np.float32)), 5, arr)
+    assert array_length(arr) == 6
+    import pytest as _pytest
+    with _pytest.raises(IndexError):
+        array_read(arr, 4)   # hole

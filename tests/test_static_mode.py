@@ -182,19 +182,28 @@ def test_clone_for_test_runs_with_inputs_only():
                                rtol=1e-5)
 
 
-def test_enable_static_resets_previous_session():
+def test_enable_static_sessions_and_reset():
+    # default programs persist across enable/disable cycles (reference
+    # semantics); redeclaring a feed name rebinds the placeholder; and
+    # reset_default_programs() starts a genuinely fresh session
+    static.reset_default_programs()
     paddle.enable_static()
     try:
         x = static.data("x", [2], "float32")
-        _ = x + 1.0
+        y = x + 1.0
     finally:
         paddle.disable_static()
-    paddle.enable_static()
+    paddle.enable_static()                 # resume: program preserved
     try:
-        x2 = static.data("x", [3], "float32")   # same name: fresh session
-        y2 = x2 * 2.0
         (r,) = static.Executor().run(
+            feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(r, np.ones(2))
+        x2 = static.data("x", [3], "float32")   # rebind the name
+        y2 = x2 * 2.0
+        (r2,) = static.Executor().run(
             feed={"x": np.ones(3, np.float32)}, fetch_list=[y2])
-        np.testing.assert_allclose(r, 2 * np.ones(3))
+        np.testing.assert_allclose(r2, 2 * np.ones(3))
     finally:
         paddle.disable_static()
+    static.reset_default_programs()
+    assert not static.default_main_program().recorder.statements
