@@ -27,6 +27,7 @@ from . import fleet
 from . import checkpoint
 from . import rpc
 from . import fleet_executor
+from .store import TCPStore
 from .fleet.meta_parallel.sharding_api import group_sharded_parallel, \
     save_group_sharded_model
 
@@ -39,5 +40,5 @@ __all__ = [
     "reduce", "reduce_scatter", "all_to_all", "scatter", "gather",
     "send", "recv", "barrier", "wait",
     "DataParallel", "spawn", "fleet", "checkpoint", "rpc",
-    "fleet_executor", "group_sharded_parallel",
+    "fleet_executor", "TCPStore", "group_sharded_parallel",
 ]

@@ -1,0 +1,272 @@
+// TCPStore: native key-value rendezvous store.
+//
+// Capability parity with the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket impl
+// tcp_utils.cc): a master rank listens; peers SET/GET/ADD/WAIT keys to
+// bootstrap collectives (the NCCL-unique-id exchange analog).  Used here
+// as the C++ transport under paddle_tpu.distributed.TCPStore, callable
+// via ctypes (no pybind dependency).
+//
+// Design: thread-per-connection blocking server; a mutex-guarded
+// unordered_map with a condition_variable supports blocking GET/WAIT
+// with deadline.  Protocol (all little-endian):
+//   request : u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: u8 status (0 ok, 1 timeout) | u32 vlen | value bytes
+// cmds: 0 SET, 1 GET(blocking, value carries timeout_ms as ascii),
+//       2 ADD(value = ascii delta; returns new counter as ascii),
+//       3 DELETE, 4 NUM_KEYS
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> data;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!read_exact(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_exact(fd, &(*out)[0], len);
+}
+
+bool write_response(int fd, uint8_t status, const std::string& val) {
+  uint32_t len = static_cast<uint32_t>(val.size());
+  if (!write_exact(fd, &status, 1)) return false;
+  if (!write_exact(fd, &len, 4)) return false;
+  return len == 0 || write_exact(fd, val.data(), len);
+}
+
+void serve_conn(Server* s, int fd) {
+  for (;;) {
+    uint8_t cmd = 0;
+    if (!read_exact(fd, &cmd, 1)) break;
+    std::string key, val;
+    if (!read_blob(fd, &key) || !read_blob(fd, &val)) break;
+    bool ok = true;
+    switch (cmd) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->data[key] = val;
+        }
+        s->cv.notify_all();
+        ok = write_response(fd, 0, "");
+        break;
+      }
+      case 1: {  // GET with timeout_ms in val
+        long timeout_ms = atol(val.c_str());
+        std::unique_lock<std::mutex> lk(s->mu);
+        auto pred = [&] { return s->data.count(key) > 0; };
+        bool have =
+            timeout_ms < 0
+                ? (s->cv.wait(lk, pred), true)
+                : s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 pred);
+        if (have) {
+          std::string v = s->data[key];
+          lk.unlock();
+          ok = write_response(fd, 0, v);
+        } else {
+          lk.unlock();
+          ok = write_response(fd, 1, "");
+        }
+        break;
+      }
+      case 2: {  // ADD
+        long delta = atol(val.c_str());
+        long now = 0;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->data.find(key);
+          long cur = it == s->data.end() ? 0 : atol(it->second.c_str());
+          now = cur + delta;
+          s->data[key] = std::to_string(now);
+        }
+        s->cv.notify_all();
+        ok = write_response(fd, 0, std::to_string(now));
+        break;
+      }
+      case 3: {  // DELETE
+        size_t n;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          n = s->data.erase(key);
+        }
+        ok = write_response(fd, 0, std::to_string(n));
+        break;
+      }
+      case 4: {  // NUM_KEYS
+        size_t n;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          n = s->data.size();
+        }
+        ok = write_response(fd, 0, std::to_string(n));
+        break;
+      }
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    sockaddr_in addr{};
+    socklen_t alen = sizeof(addr);
+    int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                      &alen);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    s->workers.emplace_back(serve_conn, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns an opaque handle (>0) or 0 on failure; binds 127.0.0.1:port
+// (port 0 = ephemeral; query with tcp_store_port)
+void* tcp_store_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int tcp_store_port(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  sockaddr_in addr{};
+  socklen_t alen = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                    &alen) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->stop.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.detach();  // blocked conns die with the process
+  delete s;
+}
+
+// ---- client ---------------------------------------------------------------
+int tcp_store_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcp_store_close(int fd) { ::close(fd); }
+
+// request + response; returns status (0 ok, 1 timeout, <0 io error).
+// out/out_len: caller buffer, receives up to out_cap bytes (result
+// truncated if longer; *out_len carries the true length).
+int tcp_store_request(int fd, int cmd, const char* key, int klen,
+                      const char* val, int vlen, char* out, int out_cap,
+                      int* out_len) {
+  uint8_t c = static_cast<uint8_t>(cmd);
+  uint32_t kl = static_cast<uint32_t>(klen);
+  uint32_t vl = static_cast<uint32_t>(vlen);
+  if (!write_exact(fd, &c, 1) || !write_exact(fd, &kl, 4) ||
+      (klen && !write_exact(fd, key, klen)) || !write_exact(fd, &vl, 4) ||
+      (vlen && !write_exact(fd, val, vlen)))
+    return -2;
+  uint8_t status;
+  uint32_t rlen;
+  if (!read_exact(fd, &status, 1) || !read_exact(fd, &rlen, 4)) return -3;
+  std::string resp(rlen, '\0');
+  if (rlen && !read_exact(fd, &resp[0], rlen)) return -4;
+  *out_len = static_cast<int>(rlen);
+  int n = rlen < static_cast<uint32_t>(out_cap)
+              ? static_cast<int>(rlen)
+              : out_cap;
+  if (n > 0) memcpy(out, resp.data(), n);
+  return status;
+}
+
+}  // extern "C"

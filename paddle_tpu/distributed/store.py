@@ -1,0 +1,124 @@
+"""TCPStore: key-value rendezvous over the native C++ server.
+
+Parity: paddle.distributed.TCPStore (reference C++ impl
+paddle/phi/core/distributed/store/tcp_store.h:121 — master rank listens,
+peers set/get/add/wait to bootstrap collectives).  The server and wire
+client are C++ (distributed/_native/tcp_store.cc) loaded via ctypes,
+matching the reference's native-runtime placement; Python only marshals
+bytes.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+from .._native_build import build_shared_lib
+
+__all__ = ["TCPStore"]
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        src = os.path.join(os.path.dirname(__file__), "_native",
+                           "tcp_store.cc")
+        path = build_shared_lib("tcp_store", [src])
+        lib = ctypes.CDLL(path)
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_port.restype = ctypes.c_int
+        lib.tcp_store_port.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_int
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tcp_store_close.argtypes = [ctypes.c_int]
+        lib.tcp_store_request.restype = ctypes.c_int
+        lib.tcp_store_request.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        _LIB = lib
+    return _LIB
+
+
+_SET, _GET, _ADD, _DELETE, _NUM_KEYS = 0, 1, 2, 3, 4
+
+
+class TCPStore:
+    """Parity: paddle.distributed.TCPStore(host, port, is_master,
+    world_size, timeout)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        lib = _lib()
+        self._lib = lib
+        self._server = None
+        self.timeout = timeout
+        if is_master:
+            self._server = lib.tcp_store_server_start(port)
+            if not self._server:
+                raise OSError(f"TCPStore: cannot bind port {port}")
+            port = lib.tcp_store_port(self._server)
+        self.host = host
+        self.port = port
+        self._fd = lib.tcp_store_connect(host.encode(), port)
+        if self._fd < 0:
+            if self._server:
+                lib.tcp_store_server_stop(self._server)
+            raise ConnectionError(
+                f"TCPStore: cannot connect {host}:{port}")
+
+    # -- protocol ------------------------------------------------------------
+    def _request(self, cmd: int, key: str, val: bytes,
+                 timeout: Optional[float] = None) -> bytes:
+        kb = key.encode()
+        cap = 1 << 20
+        out = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int(0)
+        status = self._lib.tcp_store_request(
+            self._fd, cmd, kb, len(kb), val, len(val), out, cap,
+            ctypes.byref(out_len))
+        if status == 1:
+            raise TimeoutError(f"TCPStore: wait for key {key!r} timed "
+                               f"out after {timeout}s")
+        if status < 0:
+            raise ConnectionError(f"TCPStore: io error {status}")
+        return out.raw[:out_len.value]
+
+    # -- public API (reference surface) --------------------------------------
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._request(_SET, key, bytes(value))
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        ms = -1 if t is None else int(t * 1000)
+        return self._request(_GET, key, str(ms).encode(), timeout=t)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return int(self._request(_ADD, key, str(int(amount)).encode()))
+
+    def delete_key(self, key: str) -> bool:
+        return self._request(_DELETE, key, b"") == b"1"
+
+    def num_keys(self) -> int:
+        return int(self._request(_NUM_KEYS, "", b""))
+
+    def wait(self, keys, timeout: Optional[float] = None):
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    def __del__(self):
+        try:
+            self._lib.tcp_store_close(self._fd)
+            if self._server:
+                self._lib.tcp_store_server_stop(self._server)
+        except Exception:
+            pass

@@ -36,6 +36,8 @@ class TensorArray(list):
 
     def read(self, index: int) -> Tensor:
         index = int(index)
+        if index < 0:
+            raise IndexError("TensorArray index must be >= 0")
         if index >= len(self) or self[index] is None:
             raise IndexError(
                 f"TensorArray read at {index} beyond written length "

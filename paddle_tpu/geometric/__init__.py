@@ -15,14 +15,26 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
-from ..core.tensor import Tensor
-from ..ops._helpers import as_value, wrap
+from ..ops._helpers import as_value
+
+_MESSAGE_OPS = None
+
+
+def _message_op(name: str):
+    global _MESSAGE_OPS
+    if _MESSAGE_OPS is None:
+        _MESSAGE_OPS = {"add": jnp.add, "sub": jnp.subtract,
+                        "mul": jnp.multiply, "div": jnp.divide}
+    try:
+        return _MESSAGE_OPS[name]
+    except KeyError:
+        raise ValueError(
+            f"message_op must be one of {sorted(_MESSAGE_OPS)}, got "
+            f"{name!r}") from None
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "send_uv"]
@@ -120,9 +132,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     dst_val = as_value(dst_index).astype(jnp.int32)
     n = _n_segments(dst_val, out_size) if out_size is not None \
         else as_value(x).shape[0]
-    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-           "div": jnp.divide}
-    combine = ops[message_op]
+    combine = _message_op(message_op)
 
     def fn_msg(xv, ev, src):
         return combine(jnp.take(xv, src, axis=0), ev)
@@ -136,9 +146,7 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     (parity: paddle.geometric.send_uv)."""
     src_val = as_value(src_index).astype(jnp.int32)
     dst_val = as_value(dst_index).astype(jnp.int32)
-    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-           "div": jnp.divide}
-    combine = ops[message_op]
+    combine = _message_op(message_op)
 
     def fn(xv, yv, src, dst):
         return combine(jnp.take(xv, src, axis=0),

@@ -94,14 +94,15 @@ class Program:
         self._compiled: Dict[Any, Any] = {}
 
     # -- capture-side API ----------------------------------------------------
-    def add_feed(self, name: str, tensor: Tensor):
-        # re-declaring a name rebinds the placeholder (reference
-        # semantics: static.data with an existing name reuses the var)
-        for i, (n, _) in enumerate(self.feeds):
+    def get_feed(self, name: str):
+        for n, t in self.feeds:
             if n == name:
-                self.feeds[i] = (name, tensor)
-                self.recorder.declare_input(tensor)
-                return
+                return t
+        return None
+
+    def add_feed(self, name: str, tensor: Tensor):
+        if self.get_feed(name) is not None:
+            raise ValueError(f"duplicate feed name {name!r}")
         self.feeds.append((name, tensor))
         self.recorder.declare_input(tensor)
 
@@ -223,6 +224,21 @@ def data(name: str, shape, dtype="float32", lod_level=0):
         raise RuntimeError(
             "static.data must be called inside program_guard / "
             "enable_static")
+    existing = prog.get_feed(name)
+    if existing is not None:
+        # reference semantics: re-declaring a name reuses the var — the
+        # SAME placeholder comes back so earlier statements stay bound;
+        # a different shape/dtype cannot retrofit an already-captured
+        # program
+        if tuple(existing._value.shape) == tuple(t._value.shape) \
+                and existing._value.dtype == t._value.dtype:
+            return existing
+        raise ValueError(
+            f"static.data({name!r}): name already declared with shape "
+            f"{tuple(existing._value.shape)}; redeclaring with "
+            f"{tuple(t._value.shape)} would orphan recorded ops — use "
+            "reset_default_programs() or a fresh Program for a new "
+            "session")
     prog.add_feed(name, t)
     return t
 

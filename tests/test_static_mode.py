@@ -198,7 +198,21 @@ def test_enable_static_sessions_and_reset():
         (r,) = static.Executor().run(
             feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
         np.testing.assert_allclose(r, np.ones(2))
-        x2 = static.data("x", [3], "float32")   # rebind the name
+        # same shape re-declare: the SAME var comes back (reference
+        # semantics), earlier statements stay bound
+        x_again = static.data("x", [2], "float32")
+        assert x_again is x
+        # different shape: refuse rather than orphan recorded ops
+        with pytest.raises(ValueError, match="already declared"):
+            static.data("x", [3], "float32")
+    finally:
+        paddle.disable_static()
+    static.reset_default_programs()
+    assert not static.default_main_program().recorder.statements
+    # fresh session can now declare the new shape
+    paddle.enable_static()
+    try:
+        x2 = static.data("x", [3], "float32")
         y2 = x2 * 2.0
         (r2,) = static.Executor().run(
             feed={"x": np.ones(3, np.float32)}, fetch_list=[y2])
@@ -206,4 +220,3 @@ def test_enable_static_sessions_and_reset():
     finally:
         paddle.disable_static()
     static.reset_default_programs()
-    assert not static.default_main_program().recorder.statements

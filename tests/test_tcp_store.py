@@ -1,0 +1,81 @@
+"""Native TCPStore tests (reference: paddle/phi/core/distributed/store/
+tcp_store.h:121; test pattern test/cpp/core/test_tcp_store-ish +
+python surface paddle.distributed.TCPStore)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_set_get_add_delete_numkeys():
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+
+    master.set("alpha", b"1")
+    assert client.get("alpha") == b"1"
+    client.set("alpha", "2")
+    assert master.get("alpha") == b"2"
+
+    assert client.add("ctr", 5) == 5
+    assert master.add("ctr", 3) == 8
+    assert client.get("ctr") == b"8"
+
+    assert master.num_keys() == 2
+    assert client.delete_key("alpha") is True
+    assert client.delete_key("alpha") is False
+    assert master.num_keys() == 1
+
+
+def test_blocking_get_wakes_on_set():
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    got = {}
+
+    def waiter():
+        got["v"] = client.get("late", timeout=10)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.2)
+    master.set("late", b"worth-it")
+    th.join(timeout=10)
+    assert got["v"] == b"worth-it"
+
+
+def test_get_timeout():
+    master = TCPStore(is_master=True)
+    with pytest.raises(TimeoutError):
+        master.get("never", timeout=0.2)
+
+
+def test_rendezvous_barrier_across_processes():
+    """world_size ADD-barrier: N processes each add 1 then wait for N."""
+    master = TCPStore(is_master=True)
+    code = f"""
+import sys
+from paddle_tpu.distributed.store import TCPStore
+s = TCPStore(port={master.port})
+n = s.add("barrier", 1)
+while int(s.get("barrier")) < 3:
+    pass
+s.set(f"done{{sys.argv[1]}}", b"1")
+print("STORE_OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(i)],
+                              env=env, cwd=REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    master.add("barrier", 1)   # this process is the 3rd participant
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0 and "STORE_OK" in out, out
+    master.wait(["done0", "done1"], timeout=10)
