@@ -25,6 +25,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -39,10 +40,15 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> workers;
-  std::mutex mu;
+  std::mutex mu;  // guards data, conn_fds, and cv
   std::condition_variable cv;
   std::unordered_map<std::string, std::string> data;
+  std::vector<int> conn_fds;
 };
+
+// refuse absurd frames: a malformed/hostile length must not bad_alloc
+// (an uncaught exception in a worker thread would std::terminate)
+constexpr uint32_t kMaxBlob = 64u * 1024u * 1024u;
 
 bool read_exact(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -69,6 +75,7 @@ bool write_exact(int fd, const void* buf, size_t n) {
 bool read_blob(int fd, std::string* out) {
   uint32_t len = 0;
   if (!read_exact(fd, &len, 4)) return false;
+  if (len > kMaxBlob) return false;  // drop the connection
   out->resize(len);
   return len == 0 || read_exact(fd, &(*out)[0], len);
 }
@@ -100,12 +107,15 @@ void serve_conn(Server* s, int fd) {
       case 1: {  // GET with timeout_ms in val
         long timeout_ms = atol(val.c_str());
         std::unique_lock<std::mutex> lk(s->mu);
-        auto pred = [&] { return s->data.count(key) > 0; };
-        bool have =
-            timeout_ms < 0
-                ? (s->cv.wait(lk, pred), true)
-                : s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                                 pred);
+        // stop flag is part of the predicate so shutdown wakes waiters
+        auto pred = [&] {
+          return s->stop.load() || s->data.count(key) > 0;
+        };
+        if (timeout_ms < 0)
+          s->cv.wait(lk, pred);
+        else
+          s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+        bool have = !s->stop.load() && s->data.count(key) > 0;
         if (have) {
           std::string v = s->data[key];
           lk.unlock();
@@ -153,6 +163,16 @@ void serve_conn(Server* s, int fd) {
     }
     if (!ok) break;
   }
+  {
+    // de-register BEFORE closing so stop() never shutdowns a reused fd
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 
@@ -168,7 +188,15 @@ void accept_loop(Server* s) {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    s->workers.emplace_back(serve_conn, s, fd);
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (s->stop.load()) {
+        ::close(fd);
+        return;
+      }
+      s->conn_fds.push_back(fd);
+      s->workers.emplace_back(serve_conn, s, fd);
+    }
   }
 }
 
@@ -176,9 +204,11 @@ void accept_loop(Server* s) {
 
 extern "C" {
 
-// returns an opaque handle (>0) or 0 on failure; binds 127.0.0.1:port
-// (port 0 = ephemeral; query with tcp_store_port)
-void* tcp_store_server_start(int port) {
+// returns an opaque handle (>0) or 0 on failure; binds loopback by
+// default (port 0 = ephemeral; query with tcp_store_port).  bind_all=1
+// listens on all interfaces for multi-host rendezvous — the store is
+// unauthenticated, so keep it loopback unless the network is trusted.
+void* tcp_store_server_start(int port, int bind_all) {
   auto* s = new Server();
   s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
@@ -189,7 +219,7 @@ void* tcp_store_server_start(int port) {
   ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_addr.s_addr = htonl(bind_all ? INADDR_ANY : INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
              sizeof(addr)) != 0 ||
@@ -218,8 +248,16 @@ void tcp_store_server_stop(void* handle) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  // wake cv waiters (stop is in their predicate) and unblock recv()s by
+  // shutting down every open connection, then JOIN the workers so no
+  // thread can touch the Server after it is freed
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  s->cv.notify_all();
   for (auto& t : s->workers)
-    if (t.joinable()) t.detach();  // blocked conns die with the process
+    if (t.joinable()) t.join();
   delete s;
 }
 
@@ -244,11 +282,14 @@ int tcp_store_connect(const char* host, int port) {
 void tcp_store_close(int fd) { ::close(fd); }
 
 // request + response; returns status (0 ok, 1 timeout, <0 io error).
-// out/out_len: caller buffer, receives up to out_cap bytes (result
-// truncated if longer; *out_len carries the true length).
+// *out receives a malloc'd buffer of *out_len bytes (may be null when
+// empty); the caller releases it with tcp_store_free — no fixed cap, so
+// large values are never silently truncated.
 int tcp_store_request(int fd, int cmd, const char* key, int klen,
-                      const char* val, int vlen, char* out, int out_cap,
+                      const char* val, int vlen, char** out,
                       int* out_len) {
+  *out = nullptr;
+  *out_len = 0;
   uint8_t c = static_cast<uint8_t>(cmd);
   uint32_t kl = static_cast<uint32_t>(klen);
   uint32_t vl = static_cast<uint32_t>(vlen);
@@ -259,14 +300,18 @@ int tcp_store_request(int fd, int cmd, const char* key, int klen,
   uint8_t status;
   uint32_t rlen;
   if (!read_exact(fd, &status, 1) || !read_exact(fd, &rlen, 4)) return -3;
-  std::string resp(rlen, '\0');
-  if (rlen && !read_exact(fd, &resp[0], rlen)) return -4;
+  if (rlen > kMaxBlob) return -5;
+  char* buf = rlen ? static_cast<char*>(malloc(rlen)) : nullptr;
+  if (rlen && !buf) return -6;
+  if (rlen && !read_exact(fd, buf, rlen)) {
+    free(buf);
+    return -4;
+  }
+  *out = buf;
   *out_len = static_cast<int>(rlen);
-  int n = rlen < static_cast<uint32_t>(out_cap)
-              ? static_cast<int>(rlen)
-              : out_cap;
-  if (n > 0) memcpy(out, resp.data(), n);
   return status;
 }
+
+void tcp_store_free(char* p) { free(p); }
 
 }  // extern "C"

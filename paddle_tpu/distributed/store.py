@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 from typing import Optional
 
 from .._native_build import build_shared_lib
@@ -28,7 +29,7 @@ def _lib():
         path = build_shared_lib("tcp_store", [src])
         lib = ctypes.CDLL(path)
         lib.tcp_store_server_start.restype = ctypes.c_void_p
-        lib.tcp_store_server_start.argtypes = [ctypes.c_int]
+        lib.tcp_store_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.tcp_store_port.restype = ctypes.c_int
         lib.tcp_store_port.argtypes = [ctypes.c_void_p]
         lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
@@ -38,8 +39,10 @@ def _lib():
         lib.tcp_store_request.restype = ctypes.c_int
         lib.tcp_store_request.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
             ctypes.POINTER(ctypes.c_int)]
+        lib.tcp_store_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
         _LIB = lib
     return _LIB
 
@@ -53,46 +56,81 @@ class TCPStore:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 300.0):
+                 timeout: float = 300.0, bind_all: bool = False):
         lib = _lib()
         self._lib = lib
         self._server = None
         self.timeout = timeout
+        # connection pool: one request per fd at a time, so concurrent
+        # threads never interleave frames, and a blocking GET parked on
+        # one connection doesn't stall sets on another (the reference
+        # store supports exactly this watchdog/heartbeat pattern)
+        self._mu = threading.Lock()
+        self._pool: list = []
         if is_master:
-            self._server = lib.tcp_store_server_start(port)
+            self._server = lib.tcp_store_server_start(
+                port, 1 if bind_all else 0)
             if not self._server:
                 raise OSError(f"TCPStore: cannot bind port {port}")
             port = lib.tcp_store_port(self._server)
         self.host = host
         self.port = port
-        self._fd = lib.tcp_store_connect(host.encode(), port)
-        if self._fd < 0:
+        fd = self._connect()
+        self._release_fd(fd)
+
+    def _connect(self) -> int:
+        fd = self._lib.tcp_store_connect(self.host.encode(), self.port)
+        if fd < 0:
             if self._server:
-                lib.tcp_store_server_stop(self._server)
+                self._lib.tcp_store_server_stop(self._server)
+                self._server = None
             raise ConnectionError(
-                f"TCPStore: cannot connect {host}:{port}")
+                f"TCPStore: cannot connect {self.host}:{self.port}")
+        return fd
+
+    def _acquire_fd(self) -> int:
+        with self._mu:
+            if self._pool:
+                return self._pool.pop()
+        return self._connect()
+
+    def _release_fd(self, fd: int):
+        with self._mu:
+            self._pool.append(fd)
 
     # -- protocol ------------------------------------------------------------
     def _request(self, cmd: int, key: str, val: bytes,
                  timeout: Optional[float] = None) -> bytes:
         kb = key.encode()
-        cap = 1 << 20
-        out = ctypes.create_string_buffer(cap)
+        out = ctypes.POINTER(ctypes.c_char)()
         out_len = ctypes.c_int(0)
-        status = self._lib.tcp_store_request(
-            self._fd, cmd, kb, len(kb), val, len(val), out, cap,
-            ctypes.byref(out_len))
-        if status == 1:
-            raise TimeoutError(f"TCPStore: wait for key {key!r} timed "
-                               f"out after {timeout}s")
-        if status < 0:
-            raise ConnectionError(f"TCPStore: io error {status}")
-        return out.raw[:out_len.value]
+        fd = self._acquire_fd()
+        try:
+            status = self._lib.tcp_store_request(
+                fd, cmd, kb, len(kb), val, len(val),
+                ctypes.byref(out), ctypes.byref(out_len))
+        finally:
+            self._release_fd(fd)
+        try:
+            if status == 1:
+                raise TimeoutError(f"TCPStore: wait for key {key!r} "
+                                   f"timed out after {timeout}s")
+            if status < 0:
+                raise ConnectionError(f"TCPStore: io error {status}")
+            return ctypes.string_at(out, out_len.value) if out_len.value \
+                else b""
+        finally:
+            if out:
+                self._lib.tcp_store_free(out)
 
     # -- public API (reference surface) --------------------------------------
     def set(self, key: str, value):
         if isinstance(value, str):
             value = value.encode()
+        elif not isinstance(value, (bytes, bytearray, memoryview)):
+            # ints/floats store their ascii form — bytes(4) would be
+            # four NUL bytes, silently corrupting rendezvous values
+            value = str(value).encode()
         self._request(_SET, key, bytes(value))
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
@@ -117,7 +155,10 @@ class TCPStore:
 
     def __del__(self):
         try:
-            self._lib.tcp_store_close(self._fd)
+            with self._mu:
+                for fd in self._pool:
+                    self._lib.tcp_store_close(fd)
+                self._pool.clear()
             if self._server:
                 self._lib.tcp_store_server_stop(self._server)
         except Exception:

@@ -173,3 +173,85 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
 from ....ops.paged_attention import (block_multihead_attention,  # noqa: E402,F401
                                      masked_multihead_attention,
                                      paged_attention)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, name=None):
+    """Ragged-batch attention (parity:
+    paddle.incubate.nn.functional.variable_length_memory_efficient_attention,
+    reference kernel paddle/phi/kernels/fusion/
+    variable_length_memory_efficient_attention — per-sequence q/kv
+    lengths, memory-efficient streaming softmax).
+
+    Layout [B, H, S, D] (reference layout for this op); ``seq_lens`` /
+    ``kv_seq_lens`` are [B] int tensors with each sequence's true
+    length.  TPU-native: padding positions are masked with a built
+    length mask and the chunked online-softmax path keeps memory
+    O(S·D); fully-padded query rows return 0.
+    """
+    from ....ops.pallas_kernels import _chunked_sdpa
+    from ....ops._helpers import as_value
+
+    q_lens = as_value(seq_lens).reshape(-1).astype(jnp.int32)
+    k_lens = as_value(kv_seq_lens).reshape(-1).astype(jnp.int32)
+    if scale is None:
+        scale = 1.0 / math.sqrt(int(query.shape[-1]))
+    rescale = scale * math.sqrt(int(query.shape[-1]))  # vs default 1/sqrt(d)
+
+    def fn(q, k, v, *m):
+        B, H, Sq, D = q.shape
+        Sk = k.shape[2]
+        rows_ok = jax.lax.broadcasted_iota(jnp.int32, (B, 1, Sq, 1), 2) \
+            < q_lens[:, None, None, None]
+        cols_ok = jax.lax.broadcasted_iota(jnp.int32, (B, 1, 1, Sk), 3) \
+            < k_lens[:, None, None, None]
+        length_mask = jnp.broadcast_to(rows_ok & cols_ok,
+                                       (B, 1, Sq, Sk))
+        # padded query rows attend a single dummy column so their
+        # softmax stays well-defined (no -inf row → no NaN cotangents
+        # in the backward); the rows are zeroed below regardless
+        first_col = jax.lax.broadcasted_iota(
+            jnp.int32, (B, 1, Sq, Sk), 3) == 0
+        if causal:
+            # per-sequence bottom-right alignment: row i of sequence b
+            # attends cols j <= i + (kv_len_b - q_len_b) — the padded
+            # buffer shapes must NOT define causality (decode-with-cache
+            # has q_len < kv_len inside same-size buffers)
+            rows_i = jax.lax.broadcasted_iota(jnp.int32, (B, 1, Sq, Sk),
+                                              2)
+            cols_j = jax.lax.broadcasted_iota(jnp.int32, (B, 1, Sq, Sk),
+                                              3)
+            off = (k_lens - q_lens)[:, None, None, None]
+            length_mask = length_mask & (cols_j <= rows_i + off)
+        length_mask = length_mask | (~rows_ok & first_col)
+        if m:
+            extra = m[0]
+            if extra.dtype == jnp.bool_:
+                length_mask = length_mask & extra
+                extra_add = None
+            else:
+                extra_add = extra
+        else:
+            extra_add = None
+        qv = (q * rescale).astype(q.dtype) if rescale != 1.0 else q
+        if extra_add is not None:
+            # compose additive user mask with the length mask so one
+            # chunked pass applies both
+            mask_final = jnp.where(length_mask, 0.0, -1e30) + extra_add
+        else:
+            mask_final = length_mask
+        # causality is already inside mask_final (true-length aligned);
+        # the chunked kernel's causal flag would align to buffer shapes
+        out = _chunked_sdpa(qv, k, v, False, mask=mask_final)
+        # zero out padded query rows (softmax over empty sets)
+        rows_valid = jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], 1, q.shape[2], 1), 2) \
+            < q_lens[:, None, None, None]
+        return jnp.where(rows_valid, out, 0).astype(q.dtype)
+
+    args = (query, targ(key), targ(value))
+    if mask is not None:
+        args = args + (targ(mask),)
+    return apply_op("variable_length_memory_efficient_attention", fn,
+                    args)

@@ -11,6 +11,8 @@ queue.  (C++ shared-memory ring buffer is a later optimization slot.)
 """
 from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
                       ChainDataset, Subset, ConcatDataset, random_split)
+from .bucketing import (BucketedBatchSampler, pad_to_bucket,
+                        default_buckets)
 from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
                       DistributedBatchSampler, WeightedRandomSampler,
                       SubsetRandomSampler)

@@ -1,0 +1,135 @@
+"""Bucketing sampler + variable-length attention tests (SURVEY §7
+dynamic-shape policy; reference fused op parity:
+variable_length_memory_efficient_attention)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BucketedBatchSampler, pad_to_bucket,
+                           default_buckets, DataLoader)
+import paddle_tpu.incubate.nn.functional as IF
+
+
+class TestBucketing:
+    def test_default_buckets_are_8_aligned(self):
+        bs = default_buckets(2048)
+        assert all(b % 8 == 0 for b in bs)
+        assert bs[-1] == 2048 and bs == sorted(bs)
+
+    def test_pad_to_bucket(self):
+        padded, n = pad_to_bucket(np.arange(10), [8, 16, 32])
+        assert padded.shape == (16,) and n == 10
+        assert (padded[10:] == 0).all()
+        with pytest.raises(ValueError, match="exceeds"):
+            pad_to_bucket(np.arange(100), [8, 16, 32])
+
+    def test_batches_share_bucket_and_bound_shapes(self):
+        rng = np.random.RandomState(0)
+        lengths = rng.randint(1, 65, 100).tolist()
+        buckets = [16, 32, 64]
+        sampler = BucketedBatchSampler(lengths, buckets, batch_size=8,
+                                       shuffle=True, seed=0)
+        seen_shapes = set()
+        n_samples = 0
+        for batch in sampler:
+            bucket_ids = {sampler.bucket_of(lengths[i]) for i in batch}
+            assert len(bucket_ids) == 1      # one static shape per batch
+            seen_shapes.add(bucket_ids.pop())
+            n_samples += len(batch)
+        assert n_samples == 100              # nothing dropped
+        assert seen_shapes <= set(buckets)   # compiled shapes bounded
+
+    def test_dataloader_integration(self):
+        lengths = [3, 12, 5, 30, 7, 14]
+        data = [np.arange(l, dtype=np.float32) for l in lengths]
+        buckets = [8, 16, 32]
+
+        def collate(items):
+            padded = [pad_to_bucket(x, buckets)[0] for x in items]
+            return paddle.to_tensor(np.stack(padded))
+
+        sampler = BucketedBatchSampler(lengths, buckets, batch_size=2)
+        loader = DataLoader(data, batch_sampler=sampler,
+                            collate_fn=collate)
+        shapes = sorted({tuple(b.shape) for b in loader})
+        for shape in shapes:
+            assert shape[1] in buckets
+
+
+class TestVarlenAttention:
+    def test_matches_dense_on_valid_region(self):
+        rng = np.random.RandomState(0)
+        B, H, S, D = 2, 2, 16, 8
+        q = rng.rand(B, H, S, D).astype(np.float32)
+        k = rng.rand(B, H, S, D).astype(np.float32)
+        v = rng.rand(B, H, S, D).astype(np.float32)
+        lens = np.array([10, 16], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(lens), paddle.to_tensor(lens)).numpy()
+        # reference: per-sequence dense softmax over the valid region
+        for b in range(B):
+            n = lens[b]
+            qs, ks, vs = q[b, :, :n], k[b, :, :n], v[b, :, :n]
+            s = np.einsum("hqd,hkd->hqk", qs, ks) / np.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            expect = np.einsum("hqk,hkd->hqd", p, vs)
+            np.testing.assert_allclose(out[b, :, :n], expect, rtol=2e-4,
+                                       atol=2e-4)
+            np.testing.assert_allclose(out[b, :, n:], 0.0)
+
+    def test_causal_and_custom_scale(self):
+        rng = np.random.RandomState(1)
+        B, H, S, D = 1, 1, 8, 4
+        q = rng.rand(B, H, S, D).astype(np.float32)
+        lens = np.array([8], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(lens), paddle.to_tensor(lens),
+            scale=0.25, causal=True).numpy()
+        s = np.einsum("hqd,hkd->hqk", q[0], q[0]) * 0.25
+        mask = np.tril(np.ones((S, S), bool))
+        s = np.where(mask, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        expect = np.einsum("hqk,hkd->hqd", p, q[0])
+        np.testing.assert_allclose(out[0], expect, rtol=2e-4, atol=2e-4)
+
+    def test_gradients_flow(self):
+        rng = np.random.RandomState(2)
+        q = paddle.to_tensor(rng.rand(1, 2, 8, 4).astype(np.float32))
+        q.stop_gradient = False
+        lens = paddle.to_tensor(np.array([6], np.int32))
+        out = IF.variable_length_memory_efficient_attention(
+            q, q, q, lens, lens)
+        (out ** 2).sum().backward()
+        g = q.grad.numpy()
+        assert np.isfinite(g).all()
+        np.testing.assert_allclose(g[0, :, 6:], 0.0)  # padded rows
+
+    def test_ragged_causal_aligns_to_true_lengths(self):
+        """Decode-with-cache: q_len=2, kv_len=5 in same-size buffers —
+        each new query token must see ALL cached keys plus itself."""
+        rng = np.random.RandomState(3)
+        B, H, S, D = 1, 1, 8, 4
+        q = rng.rand(B, H, S, D).astype(np.float32)
+        k = rng.rand(B, H, S, D).astype(np.float32)
+        v = rng.rand(B, H, S, D).astype(np.float32)
+        q_lens = np.array([2], np.int32)
+        kv_lens = np.array([5], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(q_lens), paddle.to_tensor(kv_lens),
+            causal=True).numpy()
+        # reference: row i (of 2) attends cols <= i + (5 - 2)
+        for i in range(2):
+            n_vis = i + 3 + 1
+            s = (q[0, :, i:i+1] @ k[0, :, :n_vis].transpose(0, 2, 1)) \
+                / np.sqrt(D)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            expect = p @ v[0, :, :n_vis]
+            np.testing.assert_allclose(out[0, :, i], expect[:, 0],
+                                       rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(out[0, :, 2:], 0.0)

@@ -79,3 +79,48 @@ print("STORE_OK")
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0 and "STORE_OK" in out, out
     master.wait(["done0", "done1"], timeout=10)
+
+
+def test_large_value_roundtrip_not_truncated():
+    master = TCPStore(is_master=True)
+    blob = os.urandom(3 * 1024 * 1024)     # > the old 1 MiB client cap
+    master.set("big", blob)
+    assert TCPStore(port=master.port).get("big") == blob
+
+
+def test_int_set_stores_ascii():
+    master = TCPStore(is_master=True)
+    master.set("world_size", 4)
+    assert int(master.get("world_size")) == 4
+
+
+def test_concurrent_client_threads():
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    errors = []
+
+    def waiter():
+        try:
+            assert client.get("release", timeout=15) == b"go"
+        except Exception as e:        # pragma: no cover
+            errors.append(e)
+
+    def setter(i):
+        try:
+            client.set(f"k{i}", str(i))
+        except Exception as e:        # pragma: no cover
+            errors.append(e)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    setters = [threading.Thread(target=setter, args=(i,))
+               for i in range(8)]
+    for t in setters:
+        t.start()
+    for t in setters:
+        t.join()
+    master.set("release", b"go")
+    th.join(timeout=20)
+    assert not errors
+    for i in range(8):
+        assert master.get(f"k{i}") == str(i).encode()
