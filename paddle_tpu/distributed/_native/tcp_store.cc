@@ -44,6 +44,7 @@ struct Server {
   std::condition_variable cv;
   std::unordered_map<std::string, std::string> data;
   std::vector<int> conn_fds;
+  std::vector<std::thread::id> finished;  // workers ready to reap
 };
 
 // refuse absurd frames: a malformed/hostile length must not bad_alloc
@@ -164,7 +165,9 @@ void serve_conn(Server* s, int fd) {
     if (!ok) break;
   }
   {
-    // de-register BEFORE closing so stop() never shutdowns a reused fd
+    // de-register BEFORE closing so stop() never shutdowns a reused fd,
+    // and mark this worker reapable so the accept loop joins it (a
+    // long-lived master must not accumulate finished thread objects)
     std::lock_guard<std::mutex> lk(s->mu);
     for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
       if (*it == fd) {
@@ -172,12 +175,41 @@ void serve_conn(Server* s, int fd) {
         break;
       }
     }
+    s->finished.push_back(std::this_thread::get_id());
   }
   ::close(fd);
 }
 
+void reap_finished(Server* s) {
+  std::vector<std::thread> done;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->finished.empty()) return;
+    for (auto it = s->workers.begin(); it != s->workers.end();) {
+      bool is_done = false;
+      for (auto fit = s->finished.begin(); fit != s->finished.end();
+           ++fit) {
+        if (*fit == it->get_id()) {
+          s->finished.erase(fit);
+          is_done = true;
+          break;
+        }
+      }
+      if (is_done) {
+        done.push_back(std::move(*it));
+        it = s->workers.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& t : done)
+    if (t.joinable()) t.join();
+}
+
 void accept_loop(Server* s) {
   for (;;) {
+    reap_finished(s);
     sockaddr_in addr{};
     socklen_t alen = sizeof(addr);
     int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),

@@ -105,12 +105,18 @@ class TCPStore:
         out = ctypes.POINTER(ctypes.c_char)()
         out_len = ctypes.c_int(0)
         fd = self._acquire_fd()
+        status = -99
         try:
             status = self._lib.tcp_store_request(
                 fd, cmd, kb, len(kb), val, len(val),
                 ctypes.byref(out), ctypes.byref(out_len))
         finally:
-            self._release_fd(fd)
+            if status in (0, 1):
+                self._release_fd(fd)
+            else:
+                # io error / desynced stream: never pool a dead fd —
+                # close it so the next call reconnects fresh
+                self._lib.tcp_store_close(fd)
         try:
             if status == 1:
                 raise TimeoutError(f"TCPStore: wait for key {key!r} "

@@ -2,3 +2,6 @@
 python/paddle/incubate/nn — the Python face of the reference's fused
 kernels #17)."""
 from . import functional
+from .layer import (FusedMultiHeadAttention, FusedFeedForward,
+                    FusedTransformerEncoderLayer, FusedLinear,
+                    FusedRMSNorm, FusedEcMoe)

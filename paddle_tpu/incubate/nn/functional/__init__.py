@@ -244,11 +244,8 @@ def variable_length_memory_efficient_attention(
         # causality is already inside mask_final (true-length aligned);
         # the chunked kernel's causal flag would align to buffer shapes
         out = _chunked_sdpa(qv, k, v, False, mask=mask_final)
-        # zero out padded query rows (softmax over empty sets)
-        rows_valid = jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], 1, q.shape[2], 1), 2) \
-            < q_lens[:, None, None, None]
-        return jnp.where(rows_valid, out, 0).astype(q.dtype)
+        # zero out padded query rows (they attended the dummy column)
+        return jnp.where(rows_ok, out, 0).astype(q.dtype)
 
     args = (query, targ(key), targ(value))
     if mask is not None:

@@ -113,4 +113,14 @@ class BucketedBatchSampler(Sampler):
         return iter(self._make_batches())
 
     def __len__(self) -> int:
-        return len(self._make_batches())
+        # O(buckets): batch count is shuffle-invariant, so no need to
+        # rebuild (and reshuffle) the batch list just to count it
+        per_bucket = {b: 0 for b in self.buckets}
+        for length in self._lengths:
+            per_bucket[self.bucket_of(length)] += 1
+        total = 0
+        for n in per_bucket.values():
+            total += n // self.batch_size
+            if n % self.batch_size and not self.drop_last:
+                total += 1
+        return total

@@ -84,3 +84,46 @@ def test_tensor_array_ops():
     import pytest as _pytest
     with _pytest.raises(IndexError):
         array_read(arr, 4)   # hole
+
+
+def test_incubate_fused_layers():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import (FusedMultiHeadAttention,
+                                        FusedFeedForward,
+                                        FusedTransformerEncoderLayer,
+                                        FusedLinear, FusedRMSNorm,
+                                        FusedEcMoe)
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 8, 16).astype("float32"))
+
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    out = attn(x)
+    assert out.shape == [2, 8, 16]
+
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+    assert ffn(x).shape == [2, 8, 16]
+
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    y = enc(x)
+    assert y.shape == [2, 8, 16]
+    y.mean().backward()   # grads flow through the fused block
+    assert attn.qkv_proj.weight.grad is None  # separate instance
+    assert enc.fused_attn.qkv_proj.weight.grad is not None
+
+    lin = FusedLinear(16, 8)
+    assert lin(x).shape == [2, 8, 8]
+
+    rms = FusedRMSNorm(16)
+    r = rms(x)
+    np.testing.assert_allclose(
+        np.mean(r.numpy() ** 2, -1), 1.0, rtol=0.05)
+
+    moe = FusedEcMoe(16, 32, num_experts=4, act_type="gelu")
+    m = moe(x)
+    assert m.shape == [2, 8, 16]
+    loss = (m ** 2).mean()
+    loss.backward()
+    assert moe.w1.grad is not None and np.isfinite(
+        moe.w1.grad.numpy()).all()
