@@ -24,7 +24,7 @@ from .core import dtypes as _dtypes_mod
 from .core.dtypes import (bfloat16, float16, float32, float64, int8, int16,
                           int32, int64, uint8, bool_, complex64, complex128,
                           get_default_dtype, set_default_dtype)
-from .core.tensor import Tensor, to_tensor
+from .core.tensor import Tensor, to_tensor, set_printoptions
 from .core.flags import get_flags, set_flags
 from .core.device import (CPUPlace, TPUPlace, CustomPlace, set_device,
                           get_device, device_count, is_compiled_with_tpu)

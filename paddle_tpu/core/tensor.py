@@ -322,7 +322,7 @@ class Tensor:
     def __repr__(self):
         try:
             val = np.asarray(self._value)
-            body = np.array2string(val, precision=6, threshold=40)
+            body = np.array2string(val, **_print_options())
         except Exception:
             body = f"<traced {self._value}>"
         return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, "
@@ -363,6 +363,35 @@ class Tensor:
         if self.ndim == 0:
             return format(self.item(), spec)
         return str(self)
+
+
+# global print options (parity: python/paddle/tensor/to_string.py
+# set_printoptions — precision/threshold/edgeitems/linewidth/sci_mode)
+_PRINT_OPTS = {"precision": 6, "threshold": 40, "edgeitems": 3,
+               "linewidth": 75, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions parity."""
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("sci_mode", sci_mode),
+                 ("linewidth", linewidth)):
+        if v is not None:
+            _PRINT_OPTS[k] = v
+
+
+def _print_options():
+    opts = dict(precision=_PRINT_OPTS["precision"],
+                threshold=_PRINT_OPTS["threshold"],
+                edgeitems=_PRINT_OPTS["edgeitems"],
+                max_line_width=_PRINT_OPTS["linewidth"])
+    if _PRINT_OPTS["sci_mode"] is not None:
+        opts["formatter"] = {"float_kind":
+                             (lambda x: f"%.{_PRINT_OPTS['precision']}e" % x)
+                             if _PRINT_OPTS["sci_mode"] else
+                             (lambda x: f"%.{_PRINT_OPTS['precision']}f" % x)}
+    return opts
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:

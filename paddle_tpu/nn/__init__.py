@@ -21,6 +21,11 @@ from .layers import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, CTCLoss, MarginRankingLoss,
     Pad2D, ZeroPad2D,
+    Dropout3D, AlphaDropout, PixelUnshuffle, ChannelShuffle, MaxUnPool2D,
+    FractionalMaxPool2D, Unfold, Fold, UpsamplingNearest2D,
+    UpsamplingBilinear2D, Bilinear, CosineSimilarity, PairwiseDistance,
+    SoftMarginLoss, MultiMarginLoss, MultiLabelSoftMarginLoss,
+    PoissonNLLLoss, GaussianNLLLoss, TripletMarginLoss,
 )
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,

@@ -172,3 +172,23 @@ def maxout(x, groups, axis=1, name=None):
         new_shape = (v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:])
         return jnp.max(v.reshape(new_shape), axis=ax + 1)
     return apply_op("maxout", fn, (x,))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Parity: reference nn/functional/activation.py:1780 (gumbel_softmax;
+    phi gumbel_softmax kernel).  Straight-through when ``hard``: the
+    one-hot forward rides the soft sample's gradient."""
+    from ...ops.random import next_key
+
+    def fn(v, key):
+        vf = v.astype(jnp.float32)
+        g = jax.random.gumbel(key, v.shape, jnp.float32)
+        soft = jax.nn.softmax((vf + g) / temperature, axis=axis)
+        if hard:
+            oh = jax.nn.one_hot(jnp.argmax(soft, axis=axis),
+                                v.shape[axis], axis=axis,
+                                dtype=soft.dtype)
+            soft = jax.lax.stop_gradient(oh - soft) + soft
+        return soft.astype(v.dtype)
+
+    return apply_op("gumbel_softmax", fn, (x, next_key()))

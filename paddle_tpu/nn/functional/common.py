@@ -364,3 +364,14 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
              name=None):
     return interpolate(x, size, scale_factor, mode, align_corners,
                        align_mode, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Parity: reference nn/functional/common.py:1802 — pad H/W with
+    zeros; ``padding`` = int | [left, right, top, bottom] | Tensor."""
+    if hasattr(padding, "numpy"):
+        padding = padding.numpy().tolist()
+    if isinstance(padding, (int, np.integer)):
+        padding = [padding] * 4
+    return pad(x, [int(p) for p in padding], mode="constant", value=0.0,
+               data_format=data_format)

@@ -372,3 +372,146 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return apply_op("ctc_loss", fn,
                     (log_probs, targ(labels), targ(input_lengths),
                      targ(label_lengths)))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Parity: reference nn/functional/loss.py:3999 —
+    log(1 + exp(-label * input)) with label in {-1, 1}."""
+    def fn(x, y):
+        return _reduce(jax.nn.softplus(-y.astype(x.dtype) * x), reduction)
+    return apply_op("soft_margin_loss", fn, (input, targ(label)))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Parity: reference nn/functional/loss.py:3868 — multi-class hinge
+    sum_j max(0, margin - x[y] + x[j])^p / C, j != y."""
+    def fn(x, y, *w):
+        C = x.shape[1]
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)       # [N, 1]
+        h = jnp.maximum(0.0, margin - xy + x)
+        if p != 1:
+            h = jnp.power(h, p)
+        if w:
+            h = h * jnp.take_along_axis(
+                w[0][None, :], y[:, None], axis=1)
+        h = h * (1.0 - jax.nn.one_hot(y, C, dtype=x.dtype))
+        return _reduce(jnp.sum(h, axis=1) / C, reduction)
+    args = (input, targ(label)) + ((targ(weight),)
+                                   if weight is not None else ())
+    return apply_op("multi_margin_loss", fn, args)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Parity: reference nn/functional/loss.py:3259 — per-class sigmoid
+    BCE averaged over classes; label in {0, 1} (or {-1,1} mapped)."""
+    def fn(x, y, *w):
+        y = y.astype(x.dtype)
+        # stable -(y*log sigma(x) + (1-y)*log sigma(-x))
+        per = y * jax.nn.softplus(-x) + (1 - y) * jax.nn.softplus(x)
+        if w:
+            per = per * w[0]
+        return _reduce(jnp.mean(per, axis=-1), reduction)
+    args = (input, targ(label)) + ((targ(weight),)
+                                   if weight is not None else ())
+    return apply_op("multi_label_soft_margin_loss", fn, args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Parity: reference nn/functional/loss.py:1488 (phi
+    poisson_nll_loss kernel)."""
+    def fn(x, y):
+        y = y.astype(x.dtype)
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(
+                2.0 * np.pi * y)
+            loss = loss + jnp.where(y > 1.0, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op("poisson_nll_loss", fn, (input, targ(label)))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Parity: reference nn/functional/loss.py:4091."""
+    def fn(mu, y, var):
+        var = jnp.maximum(var.astype(mu.dtype), epsilon)
+        loss = 0.5 * (jnp.log(var)
+                      + jnp.square(y.astype(mu.dtype) - mu) / var)
+        if full:
+            loss = loss + 0.5 * np.log(2.0 * np.pi)
+        return _reduce(loss, reduction)
+    return apply_op("gaussian_nll_loss", fn,
+                    (input, targ(label), targ(variance)))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Parity: reference nn/functional/loss.py:39 — binary/seg dice over
+    one-hot labels, reduced per-sample then averaged."""
+    def fn(x, y):
+        oh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), x.shape[-1],
+                            dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inse = jnp.sum(x * oh, axis=red)
+        denom = jnp.sum(x, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+    return apply_op("dice_loss", fn, (input, targ(label)))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Parity: reference nn/functional/loss.py:313 — similarity-matrix
+    cross entropy + L2 regularizer on the embeddings."""
+    def fn(a, p, lab):
+        n = a.shape[0]
+        lab = lab.reshape(n, 1).astype(a.dtype)
+        eq = (lab == lab.T).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(jnp.square(a), 1))
+              + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25 * l2_reg
+        sim = a @ p.T
+        xent = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=-1), axis=-1)
+        return jnp.mean(xent) + l2
+    return apply_op("npair_loss", fn,
+                    (anchor, targ(positive), targ(labels)))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax cross entropy.
+
+    Parity: reference nn/functional/loss.py:2081 (phi
+    margin_cross_entropy kernel): ``logits`` are cosine similarities;
+    the target logit becomes cos(m1*theta + m2) - m3, everything is
+    scaled by ``scale`` and fed through softmax CE.  The
+    model-parallel ``group`` path of the reference is covered by
+    ParallelCrossEntropy (mp_layers) in this framework; here the full
+    class dim is assumed local."""
+    if group is not None:
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...) — use "
+            "fleet.meta_parallel.ParallelCrossEntropy for class-sharded "
+            "logits")
+
+    def fn(x, y):
+        y = y.astype(jnp.int32)
+        xf = x.astype(jnp.float32)
+        tgt = jnp.take_along_axis(xf, y[:, None], axis=1)[:, 0]
+        if margin1 != 1.0 or margin2 != 0.0:
+            theta = jnp.arccos(jnp.clip(tgt, -1.0, 1.0))
+            tgt = jnp.cos(margin1 * theta + margin2)
+        tgt = tgt - margin3
+        mod = xf.at[jnp.arange(x.shape[0]), y].set(tgt) * scale
+        logp = jax.nn.log_softmax(mod, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1)
+        red = _reduce(loss, reduction)
+        if return_softmax:
+            return red, jnp.exp(logp).astype(x.dtype)
+        return red
+    return apply_op("margin_cross_entropy", fn, (logits, targ(label)))

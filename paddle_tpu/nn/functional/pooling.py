@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...core.dispatch import apply_op
+from ...ops._helpers import targ
 from .conv import _pair, _padding
 
 
@@ -41,8 +42,16 @@ def _pool(name, nd, x, kernel_size, stride, padding, mode, data_format,
                                        channel_last, v.ndim)
             pads = pad
         else:
-            dims, strides, pads = _window(nd, k, s, pad, channel_last,
-                                          v.ndim)
+            eff = [list(p) for p in pad]
+            if ceil_mode:
+                sp0 = 1 if channel_last else 2
+                for i in range(nd):
+                    total = v.shape[sp0 + i] + eff[i][0] + eff[i][1]
+                    out_n = -(-(total - k[i]) // s[i]) + 1
+                    eff[i][1] += max(0, (out_n - 1) * s[i] + k[i] - total)
+            dims, strides, pads = _window(nd, k, s,
+                                          [tuple(p) for p in eff],
+                                          channel_last, v.ndim)
         if mode == "max":
             init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
                 else jnp.iinfo(v.dtype).min
@@ -64,45 +73,182 @@ def _pool(name, nd, x, kernel_size, stride, padding, mode, data_format,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
-    return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max", df)
+    return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max", df,
+                 ceil_mode=ceil_mode)
+
+
+def _max_pool2d_with_mask(x, kernel_size, stride, padding, ceil_mode=False):
+    """Max pool returning (out, mask) where mask holds flat h*W+w indices
+    into the input spatial map — the reference's max_pool2d_with_index
+    contract (phi pooling kernels) consumed by max_unpool2d."""
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    pad = _padding(padding, 2, "NCHW")
+    if isinstance(pad, str):
+        raise ValueError("return_mask requires explicit int padding")
+    pad = [list(p) for p in pad]
+
+    def fn(v):
+        n, c, h, w = v.shape
+        if ceil_mode:
+            # extra right/bottom -inf padding so partial windows count
+            for i, sz in enumerate((h, w)):
+                total = sz + pad[i][0] + pad[i][1]
+                out_n = -(-(total - k[i]) // s[i]) + 1
+                pad[i][1] += max(0, (out_n - 1) * s[i] + k[i] - total)
+        neg = jnp.finfo(v.dtype).min if jnp.issubdtype(
+            v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        vp = jnp.pad(v, [(0, 0), (0, 0)] + [tuple(p) for p in pad],
+                     constant_values=neg)
+        # unroll window taps into the channel dim, then argmax over taps
+        patches = jax.lax.conv_general_dilated_patches(
+            vp, filter_shape=k, window_strides=s, padding="VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        oh, ow = patches.shape[-2:]
+        patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+        out = patches.max(axis=2)
+        tap = patches.argmax(axis=2)                     # [N,C,OH,OW]
+        dh, dw = tap // k[1], tap % k[1]
+        hh = (jnp.arange(oh) * s[0] - pad[0][0])[:, None] + dh
+        ww = (jnp.arange(ow) * s[1] - pad[1][0])[None, :] + dw
+        return out, (hh * w + ww).astype(jnp.int32)
+
+    return apply_op("max_pool2d_with_mask", fn, (x,))
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    out = _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
-                data_format)
     if return_mask:
-        # indices not natively produced by reduce_window; compute via argmax
-        # over extracted patches (rarely used on TPU; correctness path).
-        raise NotImplementedError("return_mask=True not supported yet")
-    return out
+        if data_format != "NCHW":
+            raise ValueError("return_mask requires NCHW")
+        return _max_pool2d_with_mask(x, kernel_size, stride, padding,
+                                     ceil_mode)
+    return _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
+                 data_format, ceil_mode=ceil_mode)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions.
+
+    Parity: reference nn/functional/pooling.py:872 (max_unpool2d; phi
+    unpool kernel): ``indices`` are flat h*W+w positions as produced by
+    ``max_pool2d(..., return_mask=True)``."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    p = _pair(padding, 2)
+
+    def fn(v, idx):
+        n, c, h, w = v.shape
+        if output_size is None:
+            oh = (h - 1) * s[0] - 2 * p[0] + k[0]
+            ow = (w - 1) * s[1] - 2 * p[1] + k[1]
+        else:
+            oh, ow = [int(t) for t in output_size[-2:]]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        flat = flat.at[bi, ci, idx.reshape(n, c, -1)].set(
+            v.reshape(n, c, -1))
+        return flat.reshape(n, c, oh, ow)
+
+    return apply_op("max_unpool2d", fn, (x, targ(indices)))
+
+
+def _fractional_edges(in_sz, out_sz, pool_sz, u):
+    """Per-output-cell [start, end) in input coords — mirrors the
+    reference's FractionalStartIndex/EndIndex + FractionalRationalU
+    (paddle/phi/kernels/funcs/pooling.h:106-140)."""
+    alpha = float(in_sz - pool_sz) / max(
+        out_sz - (1 if pool_sz > 0 else 0), 1)
+    if pool_sz > 0:
+        uu = u
+    else:
+        alpha = float(in_sz) / out_sz
+        base = in_sz // out_sz
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_sz + 1 - base) / alpha - (out_sz - 1)
+        uu = u * min(u_max1, u_max2)
+    edges = []
+    for i in range(out_sz):
+        start = int((i + uu) * alpha) - int(uu * alpha)
+        end = start + pool_sz if pool_sz > 0 \
+            else int((i + 1 + uu) * alpha) - int(uu * alpha)
+        edges.append((max(start, 0), min(end, in_sz)))
+    return edges
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (Graham 2015).
+
+    Parity: reference nn/functional/pooling.py:2092 (phi
+    FractionalMaxPool2dFunctor, funcs/pooling.cc): pseudo-random region
+    boundaries from a single u in (0,1), optional fixed kernel."""
+    out_sz = _pair(output_size, 2)
+    k = _pair(kernel_size, 2) if kernel_size is not None else (0, 0)
+    if random_u is None:
+        # framework RNG (paddle.seed-reproducible), not np.random; u must
+        # be a host float because region edges are static shapes
+        from ...ops.random import next_key
+        key = next_key()
+        u = float(jax.random.uniform(
+            key._value if hasattr(key, "_value") else key, ()))
+    else:
+        u = float(random_u)
+
+    def fn(v):
+        n, c, h, w = v.shape
+        h_edges = _fractional_edges(h, out_sz[0], k[0], u)
+        w_edges = _fractional_edges(w, out_sz[1], k[1], u)
+        outs, idxs = [], []
+        for hs, he in h_edges:
+            row_o, row_i = [], []
+            for ws, we in w_edges:
+                region = v[:, :, hs:he, ws:we].reshape(n, c, -1)
+                row_o.append(region.max(axis=-1))
+                if return_mask:
+                    a = region.argmax(axis=-1)
+                    row_i.append((hs + a // (we - ws)) * w
+                                 + ws + a % (we - ws))
+            outs.append(jnp.stack(row_o, axis=-1))
+            if return_mask:
+                idxs.append(jnp.stack(row_i, axis=-1))
+        out = jnp.stack(outs, axis=-2)
+        if return_mask:
+            return out, jnp.stack(idxs, axis=-2).astype(jnp.int32)
+        return out
+
+    return apply_op("fractional_max_pool2d", fn, (x,))
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     return _pool("max_pool3d", 3, x, kernel_size, stride, padding, "max",
-                 data_format)
+                 data_format, ceil_mode=ceil_mode)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
     return _pool("avg_pool1d", 1, x, kernel_size, stride, padding, "avg", df,
-                 exclusive=exclusive)
+                 ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool("avg_pool2d", 2, x, kernel_size, stride, padding, "avg",
-                 data_format, exclusive=exclusive)
+                 data_format, ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool("avg_pool3d", 3, x, kernel_size, stride, padding, "avg",
-                 data_format, exclusive=exclusive)
+                 data_format, ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def _adaptive_pool(name, nd, x, output_size, mode, data_format):

@@ -1,0 +1,197 @@
+"""Vision-oriented functional ops: affine_grid, grid_sample,
+channel_shuffle, temporal_shift.
+
+Parity: python/paddle/nn/functional/vision.py (reference:
+affine_grid:31, grid_sample:141, channel_shuffle:466,
+extension.py temporal_shift:227).  Implemented as gather/reshape
+compositions that XLA fuses; the 2^nd-corner interpolation keeps the
+batched gathers large and static-shaped for the TPU backend.
+"""
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply_op
+from ...ops._helpers import targ
+
+
+def _grid_coords(n, align_corners, dtype):
+    # normalized sample positions in [-1, 1] along one spatial dim
+    if align_corners:
+        return jnp.linspace(-1.0, 1.0, n, dtype=dtype)
+    step = 2.0 / n
+    return jnp.arange(n, dtype=dtype) * step + (step / 2 - 1.0)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a sampling grid from batched 2x3 (or 3x4) affine matrices.
+
+    Parity: reference nn/functional/vision.py:31 (affine_grid).
+    ``out_shape`` = [N, C, H, W] (or [N, C, D, H, W])."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(s) for s in out_shape.numpy().tolist()]
+    out_shape = [int(s) for s in out_shape]
+
+    def fn(th):
+        dt = th.dtype
+        if len(out_shape) == 4:
+            n, _, h, w = out_shape
+            ys = _grid_coords(h, align_corners, dt)
+            xs = _grid_coords(w, align_corners, dt)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            base = jnp.stack(
+                [gx, gy, jnp.ones_like(gx)], axis=-1)        # [H, W, 3]
+            # [N, H, W, 2] = base @ theta^T
+            return jnp.einsum("hwk,nak->nhwa", base, th)
+        n, _, d, h, w = out_shape
+        zs = _grid_coords(d, align_corners, dt)
+        ys = _grid_coords(h, align_corners, dt)
+        xs = _grid_coords(w, align_corners, dt)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        return jnp.einsum("dhwk,nak->ndhwa", base, th)
+
+    return apply_op("affine_grid", fn, (theta,))
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(x, lo, hi):
+    # reflect into [lo, hi] with period 2*(hi-lo)
+    span = hi - lo
+    x = jnp.abs(x - lo) % (2 * span)
+    return lo + jnp.where(x > span, 2 * span - x, x)
+
+
+def _resolve_coord(coord, size, padding_mode, align_corners):
+    """Map normalized [-1,1] coords to pixel space under the padding mode.
+    Returns (pixel_coord, in_bounds_mask_input)."""
+    px = _unnormalize(coord, size, align_corners)
+    if padding_mode == "reflection":
+        if align_corners:
+            px = _reflect(px, 0.0, float(size - 1)) if size > 1 \
+                else jnp.zeros_like(px)
+        else:
+            px = _reflect(px, -0.5, size - 0.5)
+            px = jnp.clip(px, 0, size - 1)
+    elif padding_mode == "border":
+        px = jnp.clip(px, 0, size - 1)
+    return px
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x`` at normalized ``grid`` locations (flow-field warp).
+
+    Parity: reference nn/functional/vision.py:141 (grid_sample; phi
+    grid_sample kernels).  4-D x [N,C,H,W] with grid [N,Ho,Wo,2] or 5-D
+    x [N,C,D,H,W] with grid [N,Do,Ho,Wo,3]; grid's last dim orders
+    coordinates fastest-varying-first (x=width, y=height, z=depth)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def fn(v, g):
+        nd = v.ndim - 2                       # spatial rank (2 or 3)
+        sizes = v.shape[2:]                   # (H, W) or (D, H, W)
+        gf = g.astype(jnp.float32)
+        # grid last-dim order is (x, y[, z]) = reversed spatial order
+        coords = [gf[..., nd - 1 - i] for i in range(nd)]   # per spatial dim
+        pix = [_resolve_coord(c, sizes[i], padding_mode, align_corners)
+               for i, c in enumerate(coords)]
+
+        def gather(idx_nd, valid):
+            # idx_nd: list of [N, *out_sp] int arrays per spatial dim
+            n = v.shape[0]
+            bidx = jnp.arange(n).reshape((n,) + (1,) * (g.ndim - 2))
+            bidx = jnp.broadcast_to(bidx, idx_nd[0].shape)
+            clipped = [jnp.clip(ix, 0, sizes[i] - 1)
+                       for i, ix in enumerate(idx_nd)]
+            # v transposed to channel-last for a single batched gather
+            vt = jnp.moveaxis(v, 1, -1)       # [N, *sp, C]
+            out = vt[(bidx,) + tuple(clipped)]            # [N, *out_sp, C]
+            if padding_mode == "zeros":
+                out = out * valid[..., None].astype(out.dtype)
+            return out
+
+        if mode == "nearest":
+            idx = [jnp.round(p).astype(jnp.int32) for p in pix]
+            valid = jnp.ones(idx[0].shape, bool)
+            if padding_mode == "zeros":
+                for i, ix in enumerate(idx):
+                    valid &= (ix >= 0) & (ix < sizes[i])
+            out = gather(idx, valid)
+        else:
+            lo = [jnp.floor(p) for p in pix]
+            out = 0.0
+            for corner in itertools.product((0, 1), repeat=nd):
+                idx = [(lo[i] + corner[i]).astype(jnp.int32)
+                       for i in range(nd)]
+                wgt = 1.0
+                for i in range(nd):
+                    frac = pix[i] - lo[i]
+                    wgt = wgt * (frac if corner[i] else 1.0 - frac)
+                valid = jnp.ones(idx[0].shape, bool)
+                if padding_mode == "zeros":
+                    for i, ix in enumerate(idx):
+                        valid &= (ix >= 0) & (ix < sizes[i])
+                out = out + gather(idx, valid) * wgt[..., None].astype(
+                    jnp.float32)
+        return jnp.moveaxis(out, -1, 1).astype(v.dtype)   # [N, C, *out_sp]
+
+    return apply_op("grid_sample", fn, (x, targ(grid)))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Parity: reference nn/functional/vision.py:466 (channel_shuffle)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w) \
+                    .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups) \
+                .swapaxes(3, 4).reshape(n, h, w, c)
+
+    return apply_op("channel_shuffle", fn, (x,))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """Shift a fraction of channels one step along the segment (time) axis.
+
+    Parity: reference nn/functional/extension.py:227 (temporal_shift; phi
+    temporal_shift kernel): the first ``C*ratio`` channels shift back
+    (t-1), the next ``C*ratio`` shift forward (t+1), the rest stay."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        zeros = jnp.zeros_like(v5[:, :1])
+        back = jnp.concatenate([v5[:, 1:], zeros], axis=1)[:, :, :c1]
+        fwd = jnp.concatenate([zeros, v5[:, :-1]], axis=1)[:, :, c1:c2]
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op("temporal_shift", fn, (x,))

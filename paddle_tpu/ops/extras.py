@@ -333,3 +333,20 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     ids = jnp.take_along_axis(order, choice[:, None], -1)
     probs = jnp.take_along_axis(v, ids, -1)
     return wrap(probs), wrap(ids.astype(jnp.int64))
+
+
+@register_op("combinations", category="math", tensor_method=True)
+def combinations(x, r=2, with_replacement=False, name=None):
+    """Parity: python/paddle/tensor/math.py:7446 — itertools-style
+    length-r combinations of a 1-D tensor, index pattern computed at
+    trace time (static shape), values gathered in one op."""
+    import itertools as _it
+
+    def fn(v):
+        n = v.shape[0]
+        gen = _it.combinations_with_replacement(range(n), r) \
+            if with_replacement else _it.combinations(range(n), r)
+        idx = np.asarray(list(gen), dtype=np.int32).reshape(-1, r)
+        return v[jnp.asarray(idx)]
+
+    return apply_op("combinations", fn, (x,))

@@ -339,3 +339,51 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
             wrap(jnp.asarray(s[..., :qq].astype(np.float32))),
             wrap(jnp.asarray(np.swapaxes(vt, -1, -2)[..., :qq].astype(
                 np.float32))))
+
+
+# paddle.linalg.inv is the reference's alias of inverse
+# (python/paddle/linalg.py: `from .tensor import inverse as inv`)
+inv = inverse
+
+
+@register_op("lu_unpack", category="linalg", tensor_method=True)
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack packed LU + 1-based pivots into (P, L, U).
+
+    Parity: python/paddle/tensor/linalg.py:2482 (lu_unpack; phi
+    lu_unpack kernel): A = P @ L @ U for (lu, piv) = paddle.linalg.lu(A).
+    Pivot application is a lax.scan of row swaps so it stays traceable."""
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        tril = jnp.tril(lu_, -1)[..., :, :k]
+        eye = jnp.eye(m, k, dtype=lu_.dtype)
+        L = tril + eye
+        U = jnp.triu(lu_)[..., :k, :]
+        piv0 = piv.astype(jnp.int32) - 1               # [..., K]
+
+        def perm_one(p1d):
+            def body(perm, ip):
+                i, p = ip
+                pi, pp = perm[i], perm[p]
+                return perm.at[i].set(pp).at[p].set(pi), None
+            perm, _ = jax.lax.scan(
+                body, jnp.arange(m),
+                (jnp.arange(p1d.shape[0]), p1d))
+            return perm
+
+        batch = piv0.shape[:-1]
+        perms = jnp.reshape(
+            jax.vmap(perm_one)(piv0.reshape((-1, piv0.shape[-1]))),
+            batch + (m,))
+        # rows of LU are A[perm]; A = P @ (L@U) with P[perm[i], i] = 1
+        P = jnp.swapaxes(
+            jax.nn.one_hot(perms, m, dtype=lu_.dtype), -1, -2)
+        return P, L, U
+
+    P, L, U = apply_op("lu_unpack", fn, (x, targ(y)))
+    # flags drop outputs at the API level only (reference returns None for
+    # skipped parts); everything is computed in one traced op either way
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)

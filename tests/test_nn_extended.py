@@ -1,0 +1,395 @@
+"""Tests for the round-2 op-coverage tail: grid_sample/affine_grid,
+channel_shuffle, temporal_shift, max-pool masks + unpool, fractional
+pooling, the extra loss family, gumbel_softmax, zeropad2d, linalg
+lu_unpack/inv, combinations, set_printoptions, and the new layer classes.
+
+Parity oracle: torch CPU where torch implements the same op (the
+reference's kernels match torch semantics for these), else closed-form.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+# -- vision ------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_matches_torch(mode, padding_mode, align_corners):
+    x = np.random.RandomState(1).randn(2, 3, 5, 7).astype(np.float32)
+    g = (np.random.RandomState(2).rand(2, 4, 6, 2).astype(np.float32)
+         * 2.4 - 1.2)
+    ours = F.grid_sample(t(x), t(g), mode=mode, padding_mode=padding_mode,
+                         align_corners=align_corners).numpy()
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(g), mode=mode,
+        padding_mode=padding_mode, align_corners=align_corners).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_grid_sample_5d():
+    x = np.random.RandomState(1).randn(2, 2, 3, 4, 5).astype(np.float32)
+    g = (np.random.RandomState(2).rand(2, 2, 3, 4, 3).astype(np.float32)
+         * 2 - 1)
+    ours = F.grid_sample(t(x), t(g)).numpy()
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(g), align_corners=True).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_affine_grid_matches_torch(align_corners):
+    th = np.random.RandomState(3).randn(2, 2, 3).astype(np.float32)
+    ours = F.affine_grid(t(th), [2, 3, 4, 5],
+                         align_corners=align_corners).numpy()
+    ref = torch.nn.functional.affine_grid(
+        torch.tensor(th), [2, 3, 4, 5], align_corners=align_corners).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_channel_shuffle():
+    x = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+    ours = F.channel_shuffle(t(x), 3).numpy()
+    ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 3).numpy()
+    np.testing.assert_array_equal(ours, ref)
+    lay = paddle.nn.ChannelShuffle(3)
+    np.testing.assert_array_equal(lay(t(x)).numpy(), ref)
+
+
+def test_temporal_shift():
+    # N=1, T=2, C=4: first C/4 channels shift back, next C/4 forward
+    x = np.arange(2 * 4, dtype=np.float32).reshape(2, 4, 1, 1)
+    out = F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25).numpy()
+    # channel 0: shifted from t+1 -> frame0 gets frame1's c0, frame1 gets 0
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0]
+    assert out[1, 0, 0, 0] == 0.0
+    # channel 1: shifted from t-1
+    assert out[0, 1, 0, 0] == 0.0
+    assert out[1, 1, 0, 0] == x[0, 1, 0, 0]
+    # channels 2,3 unshifted
+    np.testing.assert_array_equal(out[:, 2:], x[:, 2:])
+
+
+# -- pooling -----------------------------------------------------------------
+
+def test_max_pool2d_return_mask_and_unpool():
+    x = np.random.RandomState(4).randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(t(x), 2, stride=2, return_mask=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy())
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+    un = F.max_unpool2d(out, mask, 2, stride=2).numpy()
+    tun = torch.nn.functional.max_unpool2d(tout, tidx, 2, stride=2).numpy()
+    np.testing.assert_allclose(un, tun)
+    lay = paddle.nn.MaxUnPool2D(2, stride=2)
+    np.testing.assert_allclose(lay(out, mask).numpy(), tun)
+
+
+def test_max_pool2d_mask_with_padding():
+    x = np.random.RandomState(5).randn(1, 2, 7, 7).astype(np.float32)
+    out, mask = F.max_pool2d(t(x), 3, stride=2, padding=1, return_mask=True)
+    tout, tidx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy())
+    np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+def test_fractional_max_pool2d():
+    x = np.random.RandomState(6).randn(2, 3, 9, 9).astype(np.float32)
+    out, mask = F.fractional_max_pool2d(t(x), output_size=3, random_u=0.5,
+                                        return_mask=True)
+    assert tuple(out.shape) == (2, 3, 3, 3)
+    # regions tile the input: global max must be present
+    assert np.isclose(out.numpy().max(), x.max())
+    # mask indices must point at the pooled values
+    flat = x.reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1), -1)
+    np.testing.assert_allclose(picked.reshape(out.shape), out.numpy())
+    # deterministic under fixed u
+    out2 = F.fractional_max_pool2d(t(x), output_size=3, random_u=0.5)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+
+
+# -- losses ------------------------------------------------------------------
+
+def _rand_logits():
+    inp = np.random.RandomState(5).randn(6, 5).astype(np.float32)
+    lab = np.random.RandomState(6).randint(0, 5, (6,)).astype(np.int64)
+    return inp, lab
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_soft_margin_loss(reduction):
+    inp, _ = _rand_logits()
+    y = np.sign(np.random.RandomState(7).randn(6, 5)).astype(np.float32)
+    ours = F.soft_margin_loss(t(inp), t(y), reduction=reduction).numpy()
+    ref = torch.nn.functional.soft_margin_loss(
+        torch.tensor(inp), torch.tensor(y), reduction=reduction).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_multi_margin_loss():
+    inp, lab = _rand_logits()
+    w = np.random.RandomState(8).rand(5).astype(np.float32)
+    for p in (1, 2):
+        ours = F.multi_margin_loss(t(inp), t(lab), p=p, weight=t(w)).item()
+        ref = torch.nn.functional.multi_margin_loss(
+            torch.tensor(inp), torch.tensor(lab), p=p,
+            weight=torch.tensor(w)).item()
+        assert abs(ours - ref) < 1e-5
+    lay = paddle.nn.MultiMarginLoss()
+    ref = torch.nn.functional.multi_margin_loss(
+        torch.tensor(inp), torch.tensor(lab)).item()
+    assert abs(lay(t(inp), t(lab)).item() - ref) < 1e-5
+
+
+def test_multi_label_soft_margin_loss():
+    inp, _ = _rand_logits()
+    y = (np.random.RandomState(9).rand(6, 5) > 0.5).astype(np.float32)
+    ours = F.multi_label_soft_margin_loss(t(inp), t(y)).item()
+    ref = torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(inp), torch.tensor(y)).item()
+    assert abs(ours - ref) < 1e-5
+
+
+@pytest.mark.parametrize("log_input,full", [(True, False), (True, True),
+                                            (False, False)])
+def test_poisson_nll_loss(log_input, full):
+    inp, _ = _rand_logits()
+    if not log_input:
+        inp = np.abs(inp) + 0.1   # rate-space input must be positive
+    lab = np.abs(inp.T.reshape(6, 5)) + 0.1
+    ours = F.poisson_nll_loss(t(inp), t(lab), log_input=log_input,
+                              full=full).item()
+    ref = torch.nn.functional.poisson_nll_loss(
+        torch.tensor(inp), torch.tensor(lab), log_input=log_input,
+        full=full).item()
+    assert abs(ours - ref) < 1e-5
+
+
+def test_gaussian_nll_loss():
+    inp, _ = _rand_logits()
+    lab = inp + 0.3
+    var = np.abs(inp) + 0.2
+    ours = F.gaussian_nll_loss(t(inp), t(lab), t(var), full=True).item()
+    ref = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(inp), torch.tensor(lab), torch.tensor(var),
+        full=True).item()
+    assert abs(ours - ref) < 1e-5
+    lay = paddle.nn.GaussianNLLLoss(full=True)
+    assert abs(lay(t(inp), t(lab), t(var)).item() - ref) < 1e-5
+
+
+def test_dice_loss():
+    x = np.random.RandomState(10).rand(3, 4, 5).astype(np.float32)
+    lab = np.random.RandomState(11).randint(0, 5, (3, 4, 1)).astype(np.int64)
+    ours = F.dice_loss(t(x), t(lab)).item()
+    # closed form
+    oh = np.eye(5, dtype=np.float32)[lab[..., 0]]
+    inse = (x * oh).sum(axis=(1, 2))
+    den = x.sum(axis=(1, 2)) + oh.sum(axis=(1, 2))
+    ref = float(np.mean(1 - 2 * inse / (den + 1e-5)))
+    assert abs(ours - ref) < 1e-6
+
+
+def test_npair_loss():
+    a = np.random.RandomState(12).rand(4, 3).astype(np.float32)
+    p = np.random.RandomState(13).rand(4, 3).astype(np.float32)
+    lab = np.array([0, 0, 1, 2], np.int64)
+    ours = F.npair_loss(t(a), t(p), t(lab), l2_reg=0.002).item()
+    # closed form mirror of the reference composition
+    eq = (lab[:, None] == lab[None, :]).astype(np.float32)
+    tgt = eq / eq.sum(1, keepdims=True)
+    sim = a @ p.T
+    lse = np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(1,
+                 keepdims=True)) + sim.max(1, keepdims=True)
+    xent = (-(tgt * (sim - lse)).sum(1)).mean()
+    l2 = 0.25 * 0.002 * ((a ** 2).sum(1).mean() + (p ** 2).sum(1).mean())
+    assert abs(ours - (xent + l2)) < 1e-5
+
+
+def test_margin_cross_entropy():
+    inp, lab = _rand_logits()
+    # degenerate margins = plain CE
+    ours = F.margin_cross_entropy(t(inp), t(lab), margin1=1.0, margin2=0.0,
+                                  margin3=0.0, scale=1.0).item()
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(inp), torch.tensor(lab)).item()
+    assert abs(ours - ref) < 1e-5
+    # arcface margins move the target logit down -> loss increases
+    cos = np.clip(inp, -0.99, 0.99)
+    hard = F.margin_cross_entropy(t(cos), t(lab), margin1=1.0, margin2=0.5,
+                                  margin3=0.0, scale=64.0).item()
+    easy = F.margin_cross_entropy(t(cos), t(lab), margin1=1.0, margin2=0.0,
+                                  margin3=0.0, scale=64.0).item()
+    assert hard > easy
+    # return_softmax path
+    loss, sm = F.margin_cross_entropy(t(cos), t(lab), return_softmax=True)
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, atol=1e-5)
+
+
+# -- activation / padding ----------------------------------------------------
+
+def test_gumbel_softmax():
+    paddle.seed(7)
+    x = np.random.RandomState(14).randn(5, 8).astype(np.float32)
+    soft = F.gumbel_softmax(t(x), temperature=0.5).numpy()
+    np.testing.assert_allclose(soft.sum(-1), 1.0, atol=1e-5)
+    hard = F.gumbel_softmax(t(x), hard=True).numpy()
+    assert ((hard == 0) | (hard == 1)).all()
+    np.testing.assert_array_equal(hard.sum(-1), 1.0)
+    # gradients flow through the straight-through estimator
+    xt = t(x)
+    xt.stop_gradient = False
+    out = F.gumbel_softmax(xt, hard=True)
+    out.sum().backward()
+    assert xt.grad is not None and np.isfinite(xt.grad.numpy()).all()
+
+
+def test_zeropad2d():
+    x = np.random.RandomState(15).randn(2, 3, 4, 5).astype(np.float32)
+    out = F.zeropad2d(t(x), [1, 2, 3, 4]).numpy()
+    ref = torch.nn.functional.pad(torch.tensor(x), (1, 2, 3, 4)).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+# -- linalg / tensor tail ----------------------------------------------------
+
+def test_linalg_inv_alias():
+    a = np.random.RandomState(16).randn(3, 3).astype(np.float32) \
+        + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(paddle.linalg.inv(t(a)).numpy(),
+                               np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+
+def test_lu_unpack_roundtrip():
+    a = np.random.RandomState(17).randn(4, 4).astype(np.float32)
+    lu, piv = paddle.linalg.lu(t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, atol=1e-5)
+    # L unit lower-triangular, U upper-triangular
+    np.testing.assert_allclose(np.diag(L.numpy()), 1.0, atol=1e-6)
+    assert np.allclose(np.triu(L.numpy(), 1), 0)
+    assert np.allclose(np.tril(U.numpy(), -1), 0)
+
+
+def test_lu_unpack_rectangular():
+    a = np.random.RandomState(18).randn(5, 3).astype(np.float32)
+    lu, piv = paddle.linalg.lu(t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, atol=1e-5)
+
+
+def test_combinations():
+    x = paddle.to_tensor([1, 2, 3], dtype="int32")
+    np.testing.assert_array_equal(paddle.combinations(x).numpy(),
+                                  [[1, 2], [1, 3], [2, 3]])
+    np.testing.assert_array_equal(
+        paddle.combinations(x, r=2, with_replacement=True).numpy(),
+        [[1, 1], [1, 2], [1, 3], [2, 2], [2, 3], [3, 3]])
+
+
+def test_set_printoptions():
+    paddle.set_printoptions(precision=2)
+    try:
+        s = repr(paddle.to_tensor([1.234567]))
+        assert "1.23" in s and "1.2345" not in s
+    finally:
+        paddle.set_printoptions(precision=6)
+
+
+# -- new layer classes -------------------------------------------------------
+
+def test_new_layers_forward():
+    x = np.random.RandomState(19).randn(2, 4, 8, 8).astype(np.float32)
+    assert paddle.nn.PixelUnshuffle(2)(t(x)).shape == [2, 16, 4, 4]
+    assert paddle.nn.FractionalMaxPool2D(4, random_u=0.4)(t(x)).shape \
+        == [2, 4, 4, 4]
+    assert paddle.nn.UpsamplingNearest2D(scale_factor=2)(t(x)).shape \
+        == [2, 4, 16, 16]
+    assert paddle.nn.UpsamplingBilinear2D(size=[5, 5])(t(x)).shape \
+        == [2, 4, 5, 5]
+    b = paddle.nn.Bilinear(3, 4, 6)
+    out = b(t(np.random.rand(5, 3).astype(np.float32)),
+            t(np.random.rand(5, 4).astype(np.float32)))
+    assert out.shape == [5, 6]
+    cs = paddle.nn.CosineSimilarity(axis=1)
+    assert cs(t(x), t(x)).shape == [2, 8, 8]
+    pd = paddle.nn.PairwiseDistance()
+    assert pd(t(x[:, :, 0, 0]), t(x[:, :, 1, 1])).shape == [2]
+    assert paddle.nn.Dropout3D(0.5)(
+        t(np.random.rand(2, 3, 4, 5, 6).astype(np.float32))).shape \
+        == [2, 3, 4, 5, 6]
+    assert paddle.nn.AlphaDropout(0.3)(t(x)) is not None
+    sml = paddle.nn.SoftMarginLoss()
+    y = np.sign(np.random.RandomState(20).randn(2, 4, 8, 8)).astype(
+        np.float32)
+    assert sml(t(x), t(y)).shape == []
+    un = paddle.nn.Unfold(2, strides=2)
+    assert un(t(x)).shape == [2, 16, 16]
+
+
+# -- review-fix regressions --------------------------------------------------
+
+def test_max_pool2d_ceil_mode():
+    x = np.random.RandomState(21).randn(1, 1, 5, 5).astype(np.float32)
+    o, m = F.max_pool2d(t(x), 2, stride=2, ceil_mode=True, return_mask=True)
+    to_, ti = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, ceil_mode=True, return_indices=True)
+    np.testing.assert_allclose(o.numpy(), to_.numpy())
+    np.testing.assert_array_equal(m.numpy(), ti.numpy())
+    o2 = F.max_pool2d(t(x), 2, stride=2, ceil_mode=True)
+    np.testing.assert_allclose(o2.numpy(), to_.numpy())
+    oa = F.avg_pool2d(t(x), 2, stride=2, ceil_mode=True)
+    ta = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 2, stride=2, ceil_mode=True,
+        count_include_pad=False).numpy()
+    np.testing.assert_allclose(oa.numpy(), ta, rtol=1e-6)
+
+
+def test_fractional_pool_output_size_one():
+    x = np.random.RandomState(22).rand(1, 1, 7, 7).astype(np.float32)
+    out = F.fractional_max_pool2d(t(x), output_size=1, kernel_size=3,
+                                  random_u=0.5)
+    assert tuple(out.shape) == (1, 1, 1, 1)
+
+
+def test_fractional_pool_seed_reproducible():
+    x = np.random.RandomState(23).rand(1, 1, 8, 8).astype(np.float32)
+    paddle.seed(3)
+    a = F.fractional_max_pool2d(t(x), 2).numpy()
+    paddle.seed(3)
+    b = F.fractional_max_pool2d(t(x), 2).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_soft_margin_loss_large_logits_stable():
+    out = F.soft_margin_loss(t([100.0]), t([-1.0])).item()
+    assert np.isfinite(out) and abs(out - 100.0) < 1e-3
+
+
+def test_zeropad2d_int_padding():
+    x = np.random.RandomState(24).randn(1, 1, 3, 3).astype(np.float32)
+    out = F.zeropad2d(t(x), 1).numpy()
+    assert out.shape == (1, 1, 5, 5)
+    np.testing.assert_array_equal(out[:, :, 1:-1, 1:-1], x)
+
+
+def test_lu_unpack_partial_flags():
+    a = np.random.RandomState(25).rand(4, 4).astype(np.float32)
+    lu, piv = paddle.linalg.lu(t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv, unpack_ludata=False)
+    assert P is not None and L is None and U is None
+    P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
+    assert P2 is None and L2 is not None and U2 is not None
