@@ -194,8 +194,13 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
         # be a host float because region edges are static shapes
         from ...ops.random import next_key
         key = next_key()
-        u = float(jax.random.uniform(
-            key._value if hasattr(key, "_value") else key, ()))
+        key = key._value if hasattr(key, "_value") else key
+        if isinstance(key, jax.core.Tracer):
+            raise ValueError(
+                "fractional_max_pool2d(random_u=None) cannot draw its "
+                "region offset inside jit/to_static (the pooling regions "
+                "are static shapes); pass an explicit random_u")
+        u = float(jax.random.uniform(key, ()))
     else:
         u = float(random_u)
 
@@ -203,22 +208,33 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
         n, c, h, w = v.shape
         h_edges = _fractional_edges(h, out_sz[0], k[0], u)
         w_edges = _fractional_edges(w, out_sz[1], k[1], u)
-        outs, idxs = [], []
-        for hs, he in h_edges:
-            row_o, row_i = [], []
-            for ws, we in w_edges:
-                region = v[:, :, hs:he, ws:we].reshape(n, c, -1)
-                row_o.append(region.max(axis=-1))
-                if return_mask:
-                    a = region.argmax(axis=-1)
-                    row_i.append((hs + a // (we - ws)) * w
-                                 + ws + a % (we - ws))
-            outs.append(jnp.stack(row_o, axis=-1))
-            if return_mask:
-                idxs.append(jnp.stack(row_i, axis=-1))
-        out = jnp.stack(outs, axis=-2)
+        # one padded gather over precomputed flat indices (static region
+        # edges), not a per-cell python loop — O(1) ops in the trace
+        maxlen = max((he - hs) * (we - ws)
+                     for hs, he in h_edges for ws, we in w_edges)
+        idx = np.zeros((out_sz[0], out_sz[1], maxlen), np.int32)
+        valid = np.zeros((out_sz[0], out_sz[1], maxlen), bool)
+        for i, (hs, he) in enumerate(h_edges):
+            for j, (ws, we) in enumerate(w_edges):
+                cell = (np.arange(hs, he)[:, None] * w
+                        + np.arange(ws, we)[None, :]).ravel()
+                idx[i, j, :cell.size] = cell
+                valid[i, j, :cell.size] = True
+        gi = jnp.asarray(idx.reshape(-1))              # [OH*OW*maxlen]
+        gv = jnp.asarray(valid.reshape(1, 1, -1))
+        neg = jnp.finfo(v.dtype).min if jnp.issubdtype(
+            v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        flat = v.reshape(n, c, h * w)
+        g = jnp.where(gv, flat[:, :, gi], neg).reshape(
+            n, c, out_sz[0], out_sz[1], maxlen)
+        out = g.max(axis=-1)
         if return_mask:
-            return out, jnp.stack(idxs, axis=-2).astype(jnp.int32)
+            a = g.argmax(axis=-1)                      # [N,C,OH,OW]
+            gidx = jnp.asarray(idx)                    # [OH,OW,maxlen]
+            mask = jnp.take_along_axis(
+                jnp.broadcast_to(gidx, (n, c) + gidx.shape),
+                a[..., None], axis=-1)[..., 0]
+            return out, mask.astype(jnp.int32)
         return out
 
     return apply_op("fractional_max_pool2d", fn, (x,))

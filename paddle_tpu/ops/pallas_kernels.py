@@ -49,76 +49,109 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *out_rest, block_k: int,
-                      causal: bool, scale: float, q_offset_blocks: int,
+_DIMNUM_NT = (((1,), (1,)), ((), ()))    # x @ y.T
+_DIMNUM_NN = (((1,), (0,)), ((), ()))    # x @ y
+_DIMNUM_TN = (((0,), (0,)), ((), ()))    # x.T @ y
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+_MASK_THRESH = 0.5 * _MASK_VALUE      # any real score is above this
+_LANES = 128
+
+
+def _cols(x128, n):
+    """Adapt a [rows, 128] lane-broadcast stat to n columns (n may be a
+    sub-lane block size like 64, or a multiple of 128)."""
+    if n < _LANES:
+        return x128[:, :n]
+    return jnp.tile(x128, (1, n // _LANES))
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
+                      causal: bool, scale: float, kv_blocks: int,
                       causal_off: int = 0):
-    """One grid cell: q tile [block_q, d] vs all k/v tiles.
+    """Grid (BH, q_tile, k_tile): one k/v block per grid step, online
+    softmax state in VMEM scratch across the (sequential) k dimension.
 
-    Online softmax with fp32 running (max, denom, acc)."""
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-    bq = q.shape[0]
-    d = q.shape[1]
-    kv_len = k_ref.shape[1]
-    n_kb = kv_len // block_k
-    qi = pl.program_id(1)
-
-    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-
-    # all index arithmetic pinned to int32: under jax_enable_x64 python
-    # ints become int64, which mosaic cannot lower (RecursionError)
-    q_start = (qi + jnp.int32(q_offset_blocks)) * jnp.int32(bq)
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k_off = kb * jnp.int32(block_k)
-        k = k_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
-        s = q @ k.T                                    # [bq, bk]
-        if causal:
-            # bottom-right aligned: row r sees cols <= r + (Sk - Sq)
-            rows = q_start + jnp.int32(causal_off) + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + p @ v
-        return m_new, l_new, acc_new
-
-    if causal:
-        # skip k blocks strictly after this q tile
-        last_kb = jnp.minimum(
-            (q_start + jnp.int32(bq - 1) + jnp.int32(causal_off))
-            // jnp.int32(block_k) + jnp.int32(1), jnp.int32(n_kb))
+    The k axis as a grid dimension (not an in-kernel loop) lets Mosaic
+    double-buffer the k/v HBM->VMEM DMAs against compute — the same
+    pipelining structure as the in-tree pallas flash kernel.  Matmuls
+    keep bf16 operands with f32 accumulation (preferred_element_type);
+    an f32 upcast before the dot would quarter the MXU rate."""
+    save_lse = len(rest) == 4
+    if save_lse:
+        lse_ref, m_s, l_s, acc_s = rest
     else:
-        last_kb = jnp.int32(n_kb)
-    m, l, acc = jax.lax.fori_loop(jnp.int32(0), last_kb, body,
-                                  (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0] = out.astype(o_ref.dtype)
-    if out_rest:
-        # log-sum-exp residual for the flash backward, broadcast over a
-        # 128-lane last dim to satisfy mosaic tiling (same layout as the
-        # in-tree pallas flash kernel's l/m residuals); -inf for rows
-        # that attended nothing (fully masked)
-        lse_ref = out_rest[0]
-        lse = m + jnp.log(jnp.maximum(l, 1e-30))       # [bq, 1]
-        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], 128)).astype(
-            jnp.float32)
+        m_s, l_s, acc_s = rest
+        lse_ref = None
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[-1]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    # visible iff the q tile's last row reaches the k tile's first column
+    run = True
+    if causal:
+        run = (qi + 1) * bq - 1 + causal_off >= kb * block_k
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                                   # [bq, d]
+        k = k_ref[0]                                   # [bk, d]
+        v = v_ref[0]
+        s = lax.dot_general(q, k, _DIMNUM_NT,
+                            preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
+        if causal:
+            rows = qi * bq + causal_off + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, _MASK_VALUE)
+        m_prev = m_s[...]                              # [bq, 128]
+        l_prev = l_s[...]
+        m_curr = jnp.max(s, axis=1)[:, None]           # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_curr)           # [bq, 128]
+        p = jnp.exp(s - _cols(m_next, block_k))
+        if causal:
+            # rows whose every score is masked must contribute nothing
+            # (a finite mask value would otherwise give p = exp(0) = 1)
+            p = jnp.where(_cols(m_next, block_k) > _MASK_THRESH, p, 0.0)
+        alpha = jnp.exp(m_prev - m_next)               # [bq, 128]
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr  # [bq, 128]
+        m_s[...] = m_next
+        l_s[...] = l_next
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        acc_s[...] = acc_s[...] * _cols(l_corr * l_inv, d)
+        pv = lax.dot_general(p.astype(v.dtype), v, _DIMNUM_NN,
+                             preferred_element_type=jnp.float32)
+        acc_s[...] += pv * _cols(l_inv, d)
+
+    @pl.when(kb == kv_blocks - 1)
+    def _store():
+        o_ref[0] = acc_s[...].astype(o_ref.dtype)
+        if save_lse:
+            # log-sum-exp residual for the backward, lane-broadcast to
+            # the mosaic-tileable 128-lane layout; -inf marks rows that
+            # attended nothing
+            m_v = m_s[...]
+            l_v = l_s[...]
+            lse = jnp.where(l_v > 0.0, m_v + jnp.log(l_v), -jnp.inf)
+            lse_ref[0] = lse.astype(jnp.float32)
 
 
 _INTERPRET = [False]  # set True in CPU tests to run kernels interpreted
 
 
-def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256,
-                           with_lse: bool = False):
+def _flash_attention_value(q, k, v, causal: bool, block_q=512,
+                           block_k=512, with_lse: bool = False):
     """q,k,v: [B, H, S, D] -> [B, H, S, D]
-    (+ optional lse [B*H, Sq] when with_lse — kernel-internal layout)."""
+    (+ optional compact lse [B*H, Sq] when with_lse)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
@@ -126,19 +159,18 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256,
     if Sq % block_q or Sk % block_k:
         raise ValueError("flash kernel needs seq divisible by block size")
     scale = 1.0 / math.sqrt(D)
-
-    qr = q.reshape(B * H, Sq, D)
-    kr = k.reshape(B * H, Sk, D)
-    vr = v.reshape(B * H, Sk, D)
+    n_kb = Sk // block_k
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
-                               q_offset_blocks=0, causal_off=Sk - Sq)
-    out_specs = [pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))]
+                               kv_blocks=n_kb, causal_off=Sk - Sq)
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)]
     if with_lse:
         out_specs.append(pl.BlockSpec((1, block_q, 128),
-                                      lambda b, i: (b, i, 0)))
+                                      lambda b, i, j: (b, i, 0)))
         out_shape.append(jax.ShapeDtypeStruct((B * H, Sq, 128),
                                               jnp.float32))
     # Kernel body traced with x64 off: mosaic cannot legalize the i64
@@ -146,16 +178,20 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256,
     with jax.enable_x64(False):
         res = pl.pallas_call(
             kernel,
-            grid=(B * H, Sq // block_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            ],
+            grid=(B * H, Sq // block_q, n_kb),
+            in_specs=[q_spec, kv_spec, kv_spec],
             out_specs=out_specs,
             out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((block_q, 128), jnp.float32),
+                            pltpu.VMEM((block_q, 128), jnp.float32),
+                            pltpu.VMEM((block_q, D), jnp.float32)]
+            if _HAS_PLTPU else [],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+            if (_HAS_PLTPU and not _INTERPRET[0]) else None,
             interpret=_INTERPRET[0],
-        )(qr, kr, vr)
+        )(q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
+          v.reshape(B * H, Sk, D))
     out = res[0].reshape(B, H, Sq, D)
     if with_lse:
         # compact residual [BH, Sq]: the lane broadcast is re-expanded
@@ -165,155 +201,188 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=256, block_k=256,
     return out
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool,
-                         scale: float, causal_off: int):
-    """dQ for one q tile: loop k/v blocks, accumulate ds @ k.
-
-    FlashAttention-2 backward, q-parallel half: p recomputed from the
-    saved lse, delta = rowsum(dO*O) precomputed host-side in XLA."""
-    q = q_ref[0].astype(jnp.float32)                   # [bq, d]
-    do = do_ref[0].astype(jnp.float32)                 # [bq, d]
-    lse = lse_ref[0][:, 0:1].astype(jnp.float32)       # [bq, 1] (lane bcast)
-    delta = delta_ref[0][:, 0:1].astype(jnp.float32)   # [bq, 1]
-    bq, d = q.shape
-    kv_len = k_ref.shape[1]
-    n_kb = kv_len // block_k
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                         dq_ref, dq_s, delta_s, *, block_k: int,
+                         causal: bool, scale: float, kv_blocks: int,
+                         causal_off: int):
+    """dQ, grid (BH, q_tile, k_tile): k/v stream through as grid blocks,
+    dq accumulates in VMEM scratch (FlashAttention-2 q-parallel half; p
+    recomputed from the saved lse, delta = rowsum(dO*O) computed in the
+    kernel from the o/do tiles — no precomputed broadcast array)."""
     qi = pl.program_id(1)
-    q_start = qi * jnp.int32(bq)
+    kb = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[-1]
 
-    def body(kb, dq):
-        k_off = kb * jnp.int32(block_k)
-        k = k_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(k_off, block_k)].astype(jnp.float32)
-        s = (q @ k.T) * scale                          # [bq, bk]
+    @pl.when(kb == 0)
+    def _init():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+        do32 = do_ref[0].astype(jnp.float32)
+        o32 = o_ref[0].astype(jnp.float32)
+        delta_s[...] = jnp.broadcast_to(
+            jnp.sum(do32 * o32, axis=1)[:, None], delta_s.shape)
+
+    run = True
+    if causal:
+        run = (qi + 1) * bq - 1 + causal_off >= kb * block_k
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                               # [bq, 128]
+        s = lax.dot_general(q, k, _DIMNUM_NT,
+                            preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_start + jnp.int32(causal_off) + \
-                jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
+            rows = qi * bq + causal_off + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
-        # fully-masked rows have lse = -inf; exp(-inf - -inf) would be
-        # NaN — their probabilities (and grads) are exactly zero
-        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
-        dp = do @ v.T                                  # [bq, bk]
-        ds = p * (dp - delta)
-        return dq + (ds @ k) * scale
+            s = jnp.where(rows >= cols, s, _MASK_VALUE)
+        # dead rows have lse = -inf: exp(s - lse) would be inf -> 0 them
+        finite = jnp.isfinite(lse[:, :1])
+        p = jnp.where(finite, jnp.exp(s - _cols(lse, block_k)), 0.0)
+        dp = lax.dot_general(do, v, _DIMNUM_NT,
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_s[:, :1])).astype(k.dtype)
+        dq_s[...] += lax.dot_general(
+            ds, k, _DIMNUM_NN, preferred_element_type=jnp.float32) * scale
 
-    if causal:
-        last_kb = jnp.minimum(
-            (q_start + jnp.int32(bq - 1) + jnp.int32(causal_off))
-            // jnp.int32(block_k) + jnp.int32(1), jnp.int32(n_kb))
-    else:
-        last_kb = jnp.int32(n_kb)
-    dq0 = jnp.zeros((bq, d), jnp.float32)
-    dq = jax.lax.fori_loop(jnp.int32(0), last_kb, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kb == kv_blocks - 1)
+    def _store():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float, causal_off: int):
-    """dK/dV for one k/v tile: loop q blocks, accumulate ds^T q / p^T dO."""
-    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
-    v = v_ref[0].astype(jnp.float32)                   # [bk, d]
-    bk, d = k.shape
-    q_len = q_ref.shape[1]
-    n_qb = q_len // block_q
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                          dk_ref, dv_ref, dk_s, dv_s, *, block_q: int,
+                          causal: bool, scale: float, q_blocks: int,
+                          causal_off: int):
+    """dK/dV, grid (BH, k_tile, q_tile): q/do/o/lse stream through as
+    grid blocks, dk/dv accumulate in VMEM scratch."""
     ki = pl.program_id(1)
-    k_start = ki * jnp.int32(bk)
+    qb = pl.program_id(2)
+    bk, d = k_ref.shape[1], k_ref.shape[-1]
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_off = qb * jnp.int32(block_q)
-        q = q_ref[0, pl.dslice(q_off, block_q)].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(q_off, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(q_off, block_q), 0:1].astype(
-            jnp.float32)
-        delta = delta_ref[0, pl.dslice(q_off, block_q), 0:1].astype(
-            jnp.float32)
-        s = (q @ k.T) * scale                          # [bq_blk, bk]
-        if causal:
-            rows = q_off + jnp.int32(causal_off) + \
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            cols = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
-        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
-        dv_new = dv + p.T @ do                         # [bk, d]
-        dp = do @ v.T                                  # [bq_blk, bk]
-        ds = p * (dp - delta)
-        dk_new = dk + (ds.T @ q) * scale
-        return dk_new, dv_new
+    @pl.when(qb == 0)
+    def _init():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
 
+    run = True
     if causal:
-        # q rows attending this k tile start at k_start - causal_off
-        first_qb = jnp.maximum(
-            (k_start - jnp.int32(causal_off)) // jnp.int32(block_q),
-            jnp.int32(0))
-    else:
-        first_qb = jnp.int32(0)
-    zeros = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(first_qb, jnp.int32(n_qb), body,
-                               (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        run = (qb + 1) * block_q - 1 + causal_off >= ki * bk
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                               # [bq, 128]
+        do32 = do.astype(jnp.float32)
+        delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32),
+                        axis=1)[:, None]               # [bq, 1]
+        s = lax.dot_general(q, k, _DIMNUM_NT,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qb * block_q + causal_off + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(rows >= cols, s, _MASK_VALUE)
+        finite = jnp.isfinite(lse[:, :1])
+        p = jnp.where(finite, jnp.exp(s - _cols(lse, bk)), 0.0)
+        pb = p.astype(do.dtype)
+        dv_s[...] += lax.dot_general(pb, do, _DIMNUM_TN,
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, _DIMNUM_NT,
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_s[...] += lax.dot_general(
+            ds, q, _DIMNUM_TN, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qb == q_blocks - 1)
+    def _store():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
-                         block_q=256, block_k=256):
+                         block_q=512, block_k=1024):
     """Pallas flash backward (FlashAttention-2 two-kernel scheme):
-    dq parallel over q tiles; dk/dv parallel over k tiles; both recompute
-    p from the forward's lse, so memory stays O(S·D + S)."""
+    dq parallel over q tiles; dk/dv parallel over k tiles; both stream
+    the reduction axis through the grid with VMEM scratch accumulators,
+    recomputing p from the forward's lse — memory stays O(S·D + S)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
     block_k = min(block_k, Sk)
     scale = 1.0 / math.sqrt(D)
     causal_off = Sk - Sq
+    n_qb = Sq // block_q
+    n_kb = Sk // block_k
 
-    qr = q.reshape(B * H, Sq, D)
-    kr = k.reshape(B * H, Sk, D)
-    vr = v.reshape(B * H, Sk, D)
-    dor = g.reshape(B * H, Sq, D)
-    # lane-broadcast lse/delta to the mosaic-tileable [BH, Sq, 128]
-    # layout (transient per-layer; residual stays compact [BH, Sq])
+    args = (q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
+            v.reshape(B * H, Sk, D), out.reshape(B * H, Sq, D),
+            g.reshape(B * H, Sq, D))
+    # lane-broadcast lse to the mosaic-tileable [BH, Sq, 128] layout
+    # (transient per-layer; the saved residual stays compact [BH, Sq])
     lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
                             (B * H, Sq, 128))
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(B * H, Sq)
-    delta = jnp.broadcast_to(delta[..., None], (B * H, Sq, 128))
 
-    full_q = pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0))
-    full_k = pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0))
-    full_row = pl.BlockSpec((1, Sq, 128), lambda b, i: (b, 0, 0))
-    tile_q = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))
-    tile_k = pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0))
-    tile_row = pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0))
+    def qs(sel):
+        return pl.BlockSpec((1, block_q, D),
+                            lambda b, i, j: (b, sel(i, j), 0))
+
+    def ks(sel):
+        return pl.BlockSpec((1, block_k, D),
+                            lambda b, i, j: (b, sel(i, j), 0))
+
+    def rows(sel):
+        return pl.BlockSpec((1, block_q, 128),
+                            lambda b, i, j: (b, sel(i, j), 0))
+
+    by_i = lambda i, j: i
+    by_j = lambda i, j: j
+
+    params = dict(
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if (_HAS_PLTPU and not _INTERPRET[0]) else None,
+        interpret=_INTERPRET[0])
 
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                              causal=causal, scale=scale,
+                              causal=causal, scale=scale, kv_blocks=n_kb,
                               causal_off=causal_off),
-            grid=(B * H, Sq // block_q),
-            in_specs=[tile_q, full_k, full_k, tile_q, tile_row, tile_row],
-            out_specs=tile_q,
+            grid=(B * H, n_qb, n_kb),
+            in_specs=[qs(by_i), ks(by_j), ks(by_j), qs(by_i), qs(by_i),
+                      rows(by_i)],
+            out_specs=qs(by_i),
             out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            interpret=_INTERPRET[0],
-        )(qr, kr, vr, dor, lser, delta)
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
+                            pltpu.VMEM((block_q, 128), jnp.float32)]
+            if _HAS_PLTPU else [],
+            **params,
+        )(*args, lser)
 
         dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                              causal=causal, scale=scale,
+                              causal=causal, scale=scale, q_blocks=n_qb,
                               causal_off=causal_off),
-            grid=(B * H, Sk // block_k),
-            in_specs=[full_q, tile_k, tile_k, full_q, full_row, full_row],
-            out_specs=[tile_k, tile_k],
+            grid=(B * H, n_kb, n_qb),
+            in_specs=[qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
+                      rows(by_j)],
+            out_specs=[ks(by_i), ks(by_i)],
             out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
                        jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
-            interpret=_INTERPRET[0],
-        )(qr, kr, vr, dor, lser, delta)
+            scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), jnp.float32)]
+            if _HAS_PLTPU else [],
+            **params,
+        )(*args, lser)
 
     return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
             dv.reshape(B, H, Sk, D))
@@ -409,6 +478,7 @@ def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
 
 def _pallas_ok(q, k, mask, block=256) -> bool:
     return (_HAS_PLTPU and _on_tpu() and mask is None
+            and q.shape[3] <= 128                      # scratch is 128-lane
             and q.shape[2] % min(block, q.shape[2]) == 0
             and k.shape[2] % min(block, k.shape[2]) == 0)
 
@@ -422,7 +492,7 @@ def _select_flash_blocks(q, k, v, causal):
                                      autotune_select,
                                      flash_attention_candidates)
     Sq, Sk = q.shape[2], k.shape[2]
-    default = (min(256, Sq), min(256, Sk))
+    default = (min(512, Sq), min(512, Sk))
     if not autotune_enabled():
         return default
     sig = (tuple(q.shape), tuple(k.shape), str(q.dtype), bool(causal))

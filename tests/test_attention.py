@@ -361,3 +361,66 @@ def test_ulysses_attention_matches_full():
     bad = paddle.to_tensor(rng.randn(2, 32, 6, 8).astype(np.float32))
     with _pytest.raises(Exception, match="divisible"):
         sdpa_ulysses(bad, bad, bad, hcg.mesh, axis_name="sep")
+
+
+def test_pallas_flash_small_seq_sub128_blocks():
+    """Seq/block sizes below one 128-lane tile must not crash (review
+    regression: rep = block//128 == 0 made jnp.tile produce 0 columns)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(3)
+    B, H, S, D = 1, 2, 64, 32
+    q = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(q, k, v, True, block_q=64,
+                                             block_k=64, with_lse=True)
+        ref = pk._sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        g = jnp.ones_like(out)
+        dq, dk, dv = pk._flash_attention_bwd(q, k, v, out, lse, g, True,
+                                             block_q=64, block_k=64)
+        assert np.isfinite(np.asarray(dq)).all()
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_pallas_flash_dead_rows_inside_live_tile():
+    """Sq > Sk causal with block_q spanning both dead and live rows: the
+    dead rows must output 0 with lse=-inf (review regression: the finite
+    mask value made them output mean(V) with a finite lse)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(4)
+    B, H, Sq, Sk, D = 1, 1, 256, 128, 32
+    q = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        # ONE q tile covering rows 0..255: rows < 128 attend nothing
+        out, lse = pk._flash_attention_value(q, k, v, True, block_q=256,
+                                             block_k=128, with_lse=True)
+        np.testing.assert_allclose(np.asarray(out)[:, :, :Sq - Sk], 0.0)
+        assert np.all(np.isneginf(np.asarray(lse)[:, :Sq - Sk]))
+        # live tail matches the reference
+        ref = pk._sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out)[:, :, Sq - Sk:],
+                                   np.asarray(ref)[:, :, Sq - Sk:],
+                                   rtol=2e-4, atol=2e-4)
+        # backward stays zero for dead rows
+        g = jnp.ones_like(out)
+        dq, _, _ = pk._flash_attention_bwd(q, k, v, out, lse, g, True,
+                                           block_q=256, block_k=128)
+        np.testing.assert_allclose(np.asarray(dq)[:, :, :Sq - Sk], 0.0)
+    finally:
+        pk._INTERPRET[0] = old

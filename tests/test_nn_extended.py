@@ -393,3 +393,15 @@ def test_lu_unpack_partial_flags():
     assert P is not None and L is None and U is None
     P2, L2, U2 = paddle.linalg.lu_unpack(lu, piv, unpack_pivots=False)
     assert P2 is None and L2 is not None and U2 is not None
+
+
+def test_fractional_pool_rejects_traced_u():
+    import paddle_tpu.jit as pjit
+
+    @pjit.to_static
+    def f(x):
+        return F.fractional_max_pool2d(x, 2)
+
+    x = t(np.random.RandomState(26).rand(1, 1, 8, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="random_u"):
+        f(x)
