@@ -145,6 +145,24 @@ class LlamaAttention(Layer):
         k = self.k_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([B, S, self.num_kv_heads, self.head_dim])
 
+        # training fast path: neox rope fused INTO the flash kernels
+        # (no rope ops in the XLA graph; see pallas_kernels.
+        # flash_attention_rope).  Cache/mask/sequence-parallel configs
+        # take the general path below.
+        if (cache is None and attn_mask is None and not position_offset
+                and self._ring_axis() is None):
+            from ..ops.pallas_kernels import flash_attention_rope
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                from ..ops.manipulation import repeat_interleave
+                k = repeat_interleave(k, rep, axis=2)
+                v = repeat_interleave(v, rep, axis=2)
+            out = flash_attention_rope(
+                q, k, v, rotary_base=self.config.rope_theta,
+                is_causal=True)
+            out = out.reshape([B, S, self.num_heads * self.head_dim])
+            return self.o_proj(out)
+
         position_ids = None
         if position_offset:
             position_ids = np.arange(position_offset,

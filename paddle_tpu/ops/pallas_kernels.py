@@ -57,6 +57,19 @@ _MASK_THRESH = 0.5 * _MASK_VALUE      # any real score is above this
 _LANES = 128
 
 
+def _fit_block(want, total):
+    """Largest usable block <= want that divides total: multiples of 128
+    preferred (full-lane tiles); otherwise the whole axis (mosaic allows
+    a block equal to the array dim)."""
+    b = min(want, total)
+    if total % b == 0 and (b % _LANES == 0 or b == total or b <= _LANES):
+        return b
+    for c in range((b // _LANES) * _LANES, 0, -_LANES):
+        if total % c == 0:
+            return c
+    return total
+
+
 def _cols(x128, n):
     """Adapt a [rows, 128] lane-broadcast stat to n columns (n may be a
     sub-lane block size like 64, or a multiple of 128)."""
@@ -65,9 +78,25 @@ def _cols(x128, n):
     return jnp.tile(x128, (1, n // _LANES))
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
-                      causal: bool, scale: float, kv_blocks: int,
-                      causal_off: int = 0):
+def _rope_tile(t_ref, cos_ref, sin_ref, neg_sin=False):
+    """Neox-style rotary embedding applied to one [rows, d] tile in VMEM
+    (the in-kernel fusion that replaces the XLA slice/negate/concat
+    pattern — a 41 GiB/s HBM-bound fusion when done at graph level).
+    neg_sin=True applies the inverse rotation (the rope VJP)."""
+    t = t_ref if isinstance(t_ref, jnp.ndarray) else t_ref[...]
+    tf = t.astype(jnp.float32)
+    half = tf.shape[-1] // 2
+    rot = jnp.concatenate([-tf[:, half:], tf[:, :half]], axis=1)
+    c = cos_ref[...]
+    sn = sin_ref[...]
+    if neg_sin:
+        return tf * c - rot * sn
+    return tf * c + rot * sn
+
+
+def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
+                      kv_blocks: int, causal_off: int = 0,
+                      with_rope: bool = False):
     """Grid (BH, q_tile, k_tile): one k/v block per grid step, online
     softmax state in VMEM scratch across the (sequential) k dimension.
 
@@ -75,7 +104,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
     double-buffer the k/v HBM->VMEM DMAs against compute — the same
     pipelining structure as the in-tree pallas flash kernel.  Matmuls
     keep bf16 operands with f32 accumulation (preferred_element_type);
-    an f32 upcast before the dot would quarter the MXU rate."""
+    an f32 upcast before the dot would quarter the MXU rate.  With
+    with_rope, neox rotary embeddings are applied to the q/k tiles in
+    VMEM (cos/sin tiles ride the grid like k/v)."""
+    q_ref, k_ref, v_ref = refs[0:3]
+    i = 3
+    if with_rope:
+        cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[3:7]
+        i = 7
+    o_ref = refs[i]
+    rest = refs[i + 1:]
     save_lse = len(rest) == 4
     if save_lse:
         lse_ref, m_s, l_s, acc_s = rest
@@ -99,8 +137,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, block_k: int,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0]                                   # [bq, d]
-        k = k_ref[0]                                   # [bk, d]
+        if with_rope:
+            q = _rope_tile(q_ref[0], cos_i_ref, sin_i_ref).astype(
+                q_ref.dtype)
+            k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
+                k_ref.dtype)
+        else:
+            q = q_ref[0]                               # [bq, d]
+            k = k_ref[0]                               # [bk, d]
         v = v_ref[0]
         s = lax.dot_general(q, k, _DIMNUM_NT,
                             preferred_element_type=jnp.float32)
@@ -149,23 +193,39 @@ _INTERPRET = [False]  # set True in CPU tests to run kernels interpreted
 
 
 def _flash_attention_value(q, k, v, causal: bool, block_q=512,
-                           block_k=512, with_lse: bool = False):
+                           block_k=512, with_lse: bool = False,
+                           rope=None):
     """q,k,v: [B, H, S, D] -> [B, H, S, D]
-    (+ optional compact lse [B*H, Sq] when with_lse)."""
+    (+ optional compact lse [B*H, Sq] when with_lse).
+    rope=(cos, sin) with [S, D] f32 tables applies neox rotary to q/k
+    inside the kernel (requires Sq == Sk)."""
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "pallas TPU support unavailable; use the chunked path")
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
-    if Sq % block_q or Sk % block_k:
-        raise ValueError("flash kernel needs seq divisible by block size")
+    block_q = _fit_block(block_q, Sq)
+    block_k = _fit_block(block_k, Sk)
+    if rope is not None and Sq != Sk:
+        raise ValueError("in-kernel rope requires Sq == Sk")
     scale = 1.0 / math.sqrt(D)
     n_kb = Sk // block_k
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                causal=causal, scale=scale,
-                               kv_blocks=n_kb, causal_off=Sk - Sq)
+                               kv_blocks=n_kb, causal_off=Sk - Sq,
+                               with_rope=rope is not None)
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
+            v.reshape(B * H, Sk, D)]
+    if rope is not None:
+        cos, sin = rope
+        cs_i = pl.BlockSpec((block_q, D), lambda b, i, j: (i, 0))
+        cs_j = pl.BlockSpec((block_k, D), lambda b, i, j: (j, 0))
+        in_specs += [cs_i, cs_i, cs_j, cs_j]
+        args += [cos, sin, cos, sin]
     out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)]
     if with_lse:
@@ -179,19 +239,17 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
         res = pl.pallas_call(
             kernel,
             grid=(B * H, Sq // block_q, n_kb),
-            in_specs=[q_spec, kv_spec, kv_spec],
+            in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shape,
             scratch_shapes=[pltpu.VMEM((block_q, 128), jnp.float32),
                             pltpu.VMEM((block_q, 128), jnp.float32),
-                            pltpu.VMEM((block_q, D), jnp.float32)]
-            if _HAS_PLTPU else [],
+                            pltpu.VMEM((block_q, D), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"))
             if (_HAS_PLTPU and not _INTERPRET[0]) else None,
             interpret=_INTERPRET[0],
-        )(q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
-          v.reshape(B * H, Sk, D))
+        )(*args)
     out = res[0].reshape(B, H, Sq, D)
     if with_lse:
         # compact residual [BH, Sq]: the lane broadcast is re-expanded
@@ -201,14 +259,20 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
     return out
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                         dq_ref, dq_s, delta_s, *, block_k: int,
+def _flash_bwd_dq_kernel(*refs, block_k: int,
                          causal: bool, scale: float, kv_blocks: int,
-                         causal_off: int):
+                         causal_off: int, with_rope: bool = False):
     """dQ, grid (BH, q_tile, k_tile): k/v stream through as grid blocks,
     dq accumulates in VMEM scratch (FlashAttention-2 q-parallel half; p
     recomputed from the saved lse, delta = rowsum(dO*O) computed in the
     kernel from the o/do tiles — no precomputed broadcast array)."""
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = refs[0:6]
+    i = 6
+    if with_rope:
+        cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
+        i = 10
+    dq_ref = refs[i]
+    dq_s, delta_s = refs[i + 1:]
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     bq, d = q_ref.shape[1], q_ref.shape[-1]
@@ -227,8 +291,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0]
-        k = k_ref[0]
+        if with_rope:
+            q = _rope_tile(q_ref[0], cos_i_ref, sin_i_ref).astype(
+                q_ref.dtype)
+            k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
+                k_ref.dtype)
+        else:
+            q = q_ref[0]
+            k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0]                               # [bq, 128]
@@ -251,15 +321,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(kb == kv_blocks - 1)
     def _store():
-        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+        if with_rope:
+            # dq was accumulated in rope space; the rope VJP is the
+            # inverse rotation (same tables, negated sin)
+            dq_ref[0] = _rope_tile(dq_s[...], cos_i_ref, sin_i_ref,
+                                   neg_sin=True).astype(dq_ref.dtype)
+        else:
+            dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                          dk_ref, dv_ref, dk_s, dv_s, *, block_q: int,
+def _flash_bwd_dkv_kernel(*refs, block_q: int,
                           causal: bool, scale: float, q_blocks: int,
-                          causal_off: int):
+                          causal_off: int, with_rope: bool = False):
     """dK/dV, grid (BH, k_tile, q_tile): q/do/o/lse stream through as
     grid blocks, dk/dv accumulate in VMEM scratch."""
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = refs[0:6]
+    i = 6
+    if with_rope:
+        # cos/sin tiles: _i indexes the k tile (this cell), _j the
+        # streamed q tile — mirroring the dq kernel's naming by grid dim
+        cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
+        i = 10
+    dk_ref, dv_ref = refs[i:i + 2]
+    dk_s, dv_s = refs[i + 2:]
     ki = pl.program_id(1)
     qb = pl.program_id(2)
     bk, d = k_ref.shape[1], k_ref.shape[-1]
@@ -275,12 +359,22 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0]
-        k = k_ref[0]
+        if with_rope:
+            q = _rope_tile(q_ref[0], cos_j_ref, sin_j_ref).astype(
+                q_ref.dtype)
+            k = _rope_tile(k_ref[0], cos_i_ref, sin_i_ref).astype(
+                k_ref.dtype)
+        else:
+            q = q_ref[0]
+            k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0]                               # [bq, 128]
         do32 = do.astype(jnp.float32)
+        # recomputed per (k,q) cell: the o tile is DMA'd for this cell
+        # regardless (block specs fetch per grid step), so caching the
+        # reduction in scratch would save only the VPU mul-reduce on
+        # data already resident in VMEM
         delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32),
                         axis=1)[:, None]               # [bq, 1]
         s = lax.dot_general(q, k, _DIMNUM_NT,
@@ -304,20 +398,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(qb == q_blocks - 1)
     def _store():
-        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        if with_rope:
+            dk_ref[0] = _rope_tile(dk_s[...], cos_i_ref, sin_i_ref,
+                                   neg_sin=True).astype(dk_ref.dtype)
+        else:
+            dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
 def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
-                         block_q=512, block_k=1024):
+                         block_q=512, block_k=1024, rope=None):
     """Pallas flash backward (FlashAttention-2 two-kernel scheme):
     dq parallel over q tiles; dk/dv parallel over k tiles; both stream
     the reduction axis through the grid with VMEM scratch accumulators,
     recomputing p from the forward's lse — memory stays O(S·D + S)."""
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "pallas TPU support unavailable; use the chunked path")
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
+    block_q = _fit_block(block_q, Sq)
+    block_k = _fit_block(block_k, Sk)
     scale = 1.0 / math.sqrt(D)
     causal_off = Sk - Sq
     n_qb = Sq // block_q
@@ -326,6 +427,7 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
     args = (q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
             v.reshape(B * H, Sk, D), out.reshape(B * H, Sq, D),
             g.reshape(B * H, Sq, D))
+    with_rope = rope is not None
     # lane-broadcast lse to the mosaic-tileable [BH, Sq, 128] layout
     # (transient per-layer; the saved residual stays compact [BH, Sq])
     lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
@@ -346,6 +448,12 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
     by_i = lambda i, j: i
     by_j = lambda i, j: j
 
+    def cs_q(sel):
+        return pl.BlockSpec((block_q, D), lambda b, i, j: (sel(i, j), 0))
+
+    def cs_k(sel):
+        return pl.BlockSpec((block_k, D), lambda b, i, j: (sel(i, j), 0))
+
     params = dict(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
@@ -353,36 +461,46 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
         interpret=_INTERPRET[0])
 
     with jax.enable_x64(False):
+        dq_in_specs = [qs(by_i), ks(by_j), ks(by_j), qs(by_i), qs(by_i),
+                       rows(by_i)]
+        dq_args = (*args, lser)
+        if with_rope:
+            cos, sin = rope
+            dq_in_specs += [cs_q(by_i), cs_q(by_i), cs_k(by_j), cs_k(by_j)]
+            dq_args += (cos, sin, cos, sin)
         dq = pl.pallas_call(
             functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                               causal=causal, scale=scale, kv_blocks=n_kb,
-                              causal_off=causal_off),
+                              causal_off=causal_off, with_rope=with_rope),
             grid=(B * H, n_qb, n_kb),
-            in_specs=[qs(by_i), ks(by_j), ks(by_j), qs(by_i), qs(by_i),
-                      rows(by_i)],
+            in_specs=dq_in_specs,
             out_specs=qs(by_i),
             out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
-                            pltpu.VMEM((block_q, 128), jnp.float32)]
-            if _HAS_PLTPU else [],
+                            pltpu.VMEM((block_q, 128), jnp.float32)],
             **params,
-        )(*args, lser)
+        )(*dq_args)
 
+        kv_in_specs = [qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
+                       rows(by_j)]
+        kv_args = (*args, lser)
+        if with_rope:
+            cos, sin = rope
+            kv_in_specs += [cs_k(by_i), cs_k(by_i), cs_q(by_j), cs_q(by_j)]
+            kv_args += (cos, sin, cos, sin)
         dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                               causal=causal, scale=scale, q_blocks=n_qb,
-                              causal_off=causal_off),
+                              causal_off=causal_off, with_rope=with_rope),
             grid=(B * H, n_kb, n_qb),
-            in_specs=[qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
-                      rows(by_j)],
+            in_specs=kv_in_specs,
             out_specs=[ks(by_i), ks(by_i)],
             out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
                        jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
             scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
-                            pltpu.VMEM((block_k, D), jnp.float32)]
-            if _HAS_PLTPU else [],
+                            pltpu.VMEM((block_k, D), jnp.float32)],
             **params,
-        )(*args, lser)
+        )(*kv_args)
 
     return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
             dv.reshape(B, H, Sk, D))
@@ -537,6 +655,89 @@ def _flash_sdpa_bwd(causal, res, g):
 
 
 _flash_sdpa.defvjp(_flash_sdpa_fwd, _flash_sdpa_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused rope + flash attention (training fast path)
+# ---------------------------------------------------------------------------
+def rope_tables(seq_len, dim, base=10000.0, position_offset=0,
+                dtype=jnp.float32):
+    """Neox rotary cos/sin tables [S, D] (f32; fed to the fused kernel)."""
+    inv = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    pos = jnp.arange(position_offset, position_offset + seq_len,
+                     dtype=jnp.float32)
+    freqs = pos[:, None] * inv[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rope_xla(t, cos, sin):
+    """Graph-level neox rope on [B, H, S, D] (fallback path)."""
+    tf = t.astype(jnp.float32)
+    half = tf.shape[-1] // 2
+    rot = jnp.concatenate([-tf[..., half:], tf[..., :half]], axis=-1)
+    return (tf * cos[None, None] + rot * sin[None, None]).astype(t.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _flash_rope_sdpa(q, k, v, cos, sin, causal):
+    if _pallas_ok(q, k, None) and q.shape[2] == k.shape[2]:
+        bq, bk = _select_flash_blocks(q, k, v, causal)
+        return _flash_attention_value(q, k, v, causal, bq, bk,
+                                      rope=(cos, sin))
+    return _chunked_sdpa(_rope_xla(q, cos, sin), _rope_xla(k, cos, sin),
+                         v, causal)
+
+
+def _flash_rope_sdpa_fwd(q, k, v, cos, sin, causal):
+    if _pallas_ok(q, k, None) and q.shape[2] == k.shape[2]:
+        bq, bk = _select_flash_blocks(q, k, v, causal)
+        out, lse = _flash_attention_value(q, k, v, causal, bq, bk,
+                                          with_lse=True, rope=(cos, sin))
+        return out, (q, k, v, cos, sin, out, lse)
+    return (_chunked_sdpa(_rope_xla(q, cos, sin), _rope_xla(k, cos, sin),
+                          v, causal), (q, k, v, cos, sin, None, None))
+
+
+def _flash_rope_sdpa_bwd(causal, res, g):
+    q, k, v, cos, sin, out, lse = res
+    if lse is not None:
+        dq, dk, dv = _flash_attention_bwd(q, k, v, out, lse, g, causal,
+                                          rope=(cos, sin))
+        return dq, dk, dv, jnp.zeros_like(cos), jnp.zeros_like(sin)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_sdpa(
+            _rope_xla(q_, cos, sin), _rope_xla(k_, cos, sin), v_, causal),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(cos), jnp.zeros_like(sin)
+
+
+_flash_rope_sdpa.defvjp(_flash_rope_sdpa_fwd, _flash_rope_sdpa_bwd)
+
+
+def flash_attention_rope(query, key, value, rotary_base=10000.0,
+                         is_causal=True):
+    """Fused neox-rope + flash attention, paddle layout [B, S, H, D].
+
+    The rotary embedding is applied to the q/k tiles inside the Pallas
+    kernels (fwd recompute in both backward halves, inverse rotation on
+    the dq/dk stores), so the XLA graph carries NO rope ops at all —
+    replacing the reference's separate fused_rotary_position_embedding +
+    flash_attention pair (paddle/phi/kernels/fusion/) on the training
+    path.  k/v must already be head-repeated for GQA (rope commutes with
+    the repeat)."""
+    def fn(q, k, v):
+        S, D = q.shape[1], q.shape[3]
+        cos, sin = rope_tables(S, D, rotary_base)
+        out = _flash_rope_sdpa(jnp.swapaxes(q, 1, 2),
+                               jnp.swapaxes(k, 1, 2),
+                               jnp.swapaxes(v, 1, 2), cos, sin, is_causal)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_op("flash_attention_rope", fn,
+                    (query, targ(key), targ(value)))
+
 
 
 def flash_attention_tpu(query, key, value, attn_mask=None, is_causal=False):

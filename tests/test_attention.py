@@ -424,3 +424,122 @@ def test_pallas_flash_dead_rows_inside_live_tile():
         np.testing.assert_allclose(np.asarray(dq)[:, :, :Sq - Sk], 0.0)
     finally:
         pk._INTERPRET[0] = old
+
+
+def test_flash_attention_rope_matches_composed():
+    """Fused in-kernel rope+flash == fused_rotary_position_embedding
+    followed by attention (forward and grads), interpret mode."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(5)
+    B, S, H, D = 2, 256, 2, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    cos, sin = pk.rope_tables(S, D)
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        def fused(q, k, v):
+            out, lse = pk._flash_attention_value(
+                q, k, v, True, block_q=128, block_k=128, with_lse=True,
+                rope=(cos, sin))
+            return out, lse
+
+        out, lse = fused(q, k, v)
+        ref = pk._sdpa_reference(pk._rope_xla(q, cos, sin),
+                                 pk._rope_xla(k, cos, sin), v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+        g = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+        dq, dk, dv = pk._flash_attention_bwd(
+            q, k, v, out, lse, g, True, block_q=128, block_k=128,
+            rope=(cos, sin))
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: pk._sdpa_reference(
+                pk._rope_xla(q_, cos, sin), pk._rope_xla(k_, cos, sin),
+                v_, True), q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_llama_attention_fused_rope_path_matches_general():
+    """LlamaAttention training fast path (fused rope+flash) must equal
+    the general path (explicit rope + sdpa) on CPU."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaAttention, llama_tiny_config
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=1,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            intermediate_size=128, vocab_size=128)
+    attn = LlamaAttention(cfg)
+    x = paddle.to_tensor(
+        np.random.RandomState(6).randn(2, 64, 64).astype(np.float32))
+    fast = attn(x)                       # cache=None, mask=None
+    # general path: force via a None-mask equivalent (explicit zeros mask
+    # changes semantics, so instead call with position_offset=0 but
+    # cache=(None, None) to route the old branch)
+    general, _ = attn(x, cache=(None, None))
+    np.testing.assert_allclose(fast.numpy(), general.numpy(),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_pallas_flash_non_power_block_seq():
+    """Seq lengths divisible by 256 but not 512/1024 (e.g. 1536) must
+    produce correct grads — the default blocks snap to divisors (review
+    regression: floor-truncated grids silently dropped key blocks)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 1, 1536, 32
+    q = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        # defaults: fwd wants 512 (1536 % 512 == 0), bwd wants 1024
+        # (1536 % 1024 != 0 -> must snap, not truncate)
+        out, lse = pk._flash_attention_value(q, k, v, True, with_lse=True)
+        ref = pk._sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        dq, dk, dv = pk._flash_attention_bwd(q, k, v, out, lse, g, True)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: pk._sdpa_reference(q_, k_, v_, True),
+            q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+        assert np.isfinite(np.asarray(dk)).all()
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_fit_block():
+    from paddle_tpu.ops.pallas_kernels import _fit_block
+    assert _fit_block(512, 1536) == 512
+    assert _fit_block(1024, 1536) == 768
+    assert _fit_block(512, 768) == 384
+    assert _fit_block(512, 2048) == 512
+    assert _fit_block(512, 120) == 120
+    assert _fit_block(256, 64) == 64
