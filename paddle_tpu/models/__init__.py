@@ -14,3 +14,6 @@ from .bert import BertConfig, BertModel, BertForPretraining, \
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM
 from .qwen import (Qwen2Config, Qwen2Model, Qwen2ForCausalLM,
                    Qwen2PretrainingCriterion, qwen2_tiny_config)
+from .mixtral import (MixtralConfig, MixtralModel, MixtralForCausalLM,
+                      MixtralPretrainingCriterion, MixtralSparseMoeBlock,
+                      mixtral_tiny_config, shard_mixtral)

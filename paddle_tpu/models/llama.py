@@ -367,6 +367,23 @@ class LlamaPretrainingCriterion(Layer):
 # ---------------------------------------------------------------------------
 # sharding recipe (tp/fsdp/dp/sep axes)
 # ---------------------------------------------------------------------------
+def axis_placements(mesh, **axis_dims):
+    """Placement list for ``mesh`` from axis-name -> tensor-dim pairs
+    (axes absent from the mesh, size-1 axes, and None dims replicate).
+    Shared by the per-model sharding recipes (shard_llama,
+    shard_mixtral, ...)."""
+    from ..distributed.process_mesh import Shard, Replicate
+
+    names = mesh.dim_names
+    pl = [Replicate() for _ in names]
+    for axis, dim in axis_dims.items():
+        if dim is None or axis not in names \
+                or mesh.get_dim_size(axis) <= 1:
+            continue
+        pl[names.index(axis)] = Shard(dim)
+    return pl
+
+
 def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="model",
                 fsdp_axis="sharding"):
     """Annotate parameters with the Megatron/FSDP layout over ``mesh``:
@@ -379,19 +396,10 @@ def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="model",
     to the innermost ICI dim.
     """
     from ..distributed.api import shard_param_
-    from ..distributed.process_mesh import Shard, Replicate
-
-    names = mesh.dim_names
-    has_tp = tp_axis in names and mesh.get_dim_size(tp_axis) > 1
-    has_fsdp = fsdp_axis in names and mesh.get_dim_size(fsdp_axis) > 1
 
     def placements(tp_dim=None, fsdp_dim=None):
-        pl = [Replicate() for _ in names]
-        if has_tp and tp_dim is not None:
-            pl[names.index(tp_axis)] = Shard(tp_dim)
-        if has_fsdp and fsdp_dim is not None:
-            pl[names.index(fsdp_axis)] = Shard(fsdp_dim)
-        return pl
+        return axis_placements(mesh, **{tp_axis: tp_dim,
+                                        fsdp_axis: fsdp_dim})
 
     emb = model.llama.embed_tokens.weight
     shard_param_(emb, mesh, placements(tp_dim=0, fsdp_dim=1))
