@@ -1,0 +1,70 @@
+"""Block-size sweep with median-of-3 (tunnel noise mitigation)."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from paddle_tpu.ops import pallas_kernels as pk
+
+B, H, S, D = 8, 16, 2048, 64
+ITERS = 32
+rng = np.random.RandomState(0)
+q0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+k0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+v0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+fwd_flops = 4.0 * B * H * S * S * D * 0.5
+PEAK = 197e12
+
+
+def diff_time(mk, reps=3):
+    f1, f2 = mk(ITERS), mk(2 * ITERS)
+
+    def one(f):
+        o = f(q0, k0, v0)
+        np.asarray(jax.tree_util.tree_leaves(o)[0].ravel()[0:1])
+
+    one(f1); one(f2)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); one(f1); d1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); one(f2); d2 = time.perf_counter() - t0
+        ts.append((d2 - d1) / ITERS)
+    return float(np.median(ts))
+
+
+def fwd_mk(bq, bk):
+    def mk(n):
+        @jax.jit
+        def f(q, k, v):
+            def body(i, q):
+                o = pk._flash_attention_value(q, k, v, True,
+                                              block_q=bq, block_k=bk)
+                return o * jnp.bfloat16(0.01) + q * jnp.bfloat16(0.99)
+            return jax.lax.fori_loop(0, n, body, q)
+        return f
+    return mk
+
+
+def bwd_mk(fbq, fbk, bbq, bbk):
+    def mk(n):
+        @jax.jit
+        def f(q, k, v):
+            def body(i, carry):
+                q, k, v = carry
+                out, lse = pk._flash_attention_value(
+                    q, k, v, True, block_q=fbq, block_k=fbk, with_lse=True)
+                dq, dk, dv = pk._flash_attention_bwd(
+                    q, k, v, out, lse, out, True,
+                    block_q=bbq, block_k=bbk)
+                s = jnp.bfloat16(1e-4)
+                return (q + dq * s, k + dk * s, v + dv * s)
+            return jax.lax.fori_loop(0, n, body, (q, k, v))
+        return f
+    return mk
+
+
+print("== fwd+bwd (fwd fixed 512x512) ==")
+for bbq, bbk in ((512, 512), (1024, 1024), (2048, 512), (512, 2048),
+                 (1024, 512), (512, 1024)):
+    t = diff_time(bwd_mk(512, 512, bbq, bbk))
+    print(f"f+b bwd {bbq:4d}x{bbk:<4d} {t*1e3:7.3f} ms  "
+          f"eff={3.5*fwd_flops/t/PEAK:.3f}")

@@ -1,0 +1,152 @@
+"""Component timing for the bench config (real TPU, tunnel-safe sync).
+
+Times: full train step, fwd-only, fwd+bwd (no opt), attention fwd,
+attention fwd+bwd, and reports implied MFU per component.  Not part of
+the driver contract — a profiling aid for kernel work.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, n=8):
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0:1]),
+        out)
+
+    def run(m):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(m):
+            o = fn(*args)
+        leaf = jax.tree_util.tree_leaves(o)[0]
+        np.asarray(leaf.ravel()[0:1])
+        return time.perf_counter() - t0
+
+    d1 = run(n)
+    d2 = run(2 * n)
+    return (d2 - d1) / n
+
+
+def main(which="all"):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaForCausalLM, LlamaConfig,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.models.llama import param_count, llama_flops_per_token
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+        dtype="bfloat16")
+    batch, seq = 8, 2048
+    peak = 197e12
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                     clip_norm=1.0)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    tok = batch * seq
+    fpt = llama_flops_per_token(cfg, seq)
+
+    if which in ("all", "step"):
+        t_step = timeit(lambda a, b: step(a, b)._value, ids, labels, n=6)
+        print(f"train step       {t_step*1e3:8.1f} ms   "
+              f"mfu={tok*fpt/t_step/peak:.3f}")
+        if which == "step":
+            return
+    del step, opt
+
+    # fwd(+loss) only
+    state = {k: t._value for k, t in model.state_dict().items()}
+    from paddle_tpu.core.tensor import Tensor
+
+    def fwd(state, i, l):
+        with model.bind_state(state):
+            logits = model(Tensor._from_value(i))
+            loss = criterion(logits, Tensor._from_value(l))
+        return loss._value
+
+    if which in ("all", "fwd"):
+        fwd_j = jax.jit(fwd)
+        t_fwd = timeit(fwd_j, state, ids._value, labels._value, n=10)
+        print(f"fwd+loss         {t_fwd*1e3:8.1f} ms   "
+              f"(ideal ~1/3 of fwdbwd)")
+        del fwd_j
+
+    def fwdbwd(state, i, l):
+        def lf(s):
+            with model.bind_state(s):
+                logits = model(Tensor._from_value(i))
+                return criterion(logits,
+                                 Tensor._from_value(l))._value.astype(
+                    jnp.float32)
+        return jax.value_and_grad(lf)(state)
+
+    if which in ("all", "fwdbwd"):
+        fb_j = jax.jit(fwdbwd)
+        t_fb = timeit(fb_j, state, ids._value, labels._value, n=6)
+        print(f"fwd+bwd          {t_fb*1e3:8.1f} ms   "
+              f"mfu={tok*fpt/t_fb/peak:.3f}")
+        del fb_j
+    if which in ("fwd", "fwdbwd"):
+        return
+    del state, model
+
+    # attention microbench at model shape
+    B, H, S, D = batch, cfg.num_attention_heads, seq, 64
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+
+    att_flops = 4.0 * B * H * S * S * D * 0.5  # causal fwd
+    for bq, bk in ((256, 256), (512, 512), (256, 512), (512, 256),
+                   (1024, 512), (128, 128)):
+        try:
+            f = jax.jit(lambda q, k, v, bq=bq, bk=bk:
+                        pk._flash_attention_value(q, k, v, True,
+                                                  block_q=bq, block_k=bk))
+            t = timeit(f, q, k, v, n=20)
+            print(f"attn fwd {bq:4d}x{bk:<4d} {t*1e3:8.2f} ms   "
+                  f"eff={att_flops/t/peak:.3f}  (x24 layers = "
+                  f"{24*t*1e3:.1f} ms)")
+        except Exception as e:
+            print(f"attn fwd {bq}x{bk} failed: {type(e).__name__}")
+
+    def attn_fb(q, k, v):
+        def lf(q, k, v):
+            return pk._flash_sdpa(q, k, v, True).astype(
+                jnp.float32).sum()
+        l, g = jax.value_and_grad(lf, argnums=(0, 1, 2))(q, k, v)
+        return g
+
+    try:
+        fb = jax.jit(attn_fb)
+        t = timeit(fb, q, k, v, n=10)
+        print(f"attn fwd+bwd      {t*1e3:8.2f} ms   "
+              f"eff={3.5*att_flops/t/peak:.3f}  (x24 = {24*t*1e3:.1f} ms)")
+    except Exception as e:
+        print("attn fwd+bwd failed:", type(e).__name__, e)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1] if len(sys.argv) > 1 else "all")
