@@ -114,6 +114,9 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
         i = 7
     o_ref = refs[i]
     rest = refs[i + 1:]
+    if with_rope:
+        qr_s = rest[-1]
+        rest = rest[:-1]
     save_lse = len(rest) == 4
     if save_lse:
         lse_ref, m_s, l_s, acc_s = rest
@@ -129,6 +132,12 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
         m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
         l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+        if with_rope:
+            # rope(q) once per q tile — recomputing it per k block
+            # dominated the kernel (the k-block rope is structural:
+            # online softmax pins kb as the inner grid dim)
+            qr_s[...] = _rope_tile(q_ref[0], cos_i_ref,
+                                   sin_i_ref).astype(qr_s.dtype)
 
     # visible iff the q tile's last row reaches the k tile's first column
     run = True
@@ -138,8 +147,7 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
     @pl.when(run)
     def _body():
         if with_rope:
-            q = _rope_tile(q_ref[0], cos_i_ref, sin_i_ref).astype(
-                q_ref.dtype)
+            q = qr_s[...]
             k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
                 k_ref.dtype)
         else:
@@ -244,7 +252,9 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
             out_shape=out_shape,
             scratch_shapes=[pltpu.VMEM((block_q, 128), jnp.float32),
                             pltpu.VMEM((block_q, 128), jnp.float32),
-                            pltpu.VMEM((block_q, D), jnp.float32)],
+                            pltpu.VMEM((block_q, D), jnp.float32)]
+            + ([pltpu.VMEM((block_q, D), q.dtype)]
+               if rope is not None else []),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"))
             if (_HAS_PLTPU and not _INTERPRET[0]) else None,
@@ -272,7 +282,12 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
         cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
         i = 10
     dq_ref = refs[i]
-    dq_s, delta_s = refs[i + 1:]
+    rest = refs[i + 1:]
+    if with_rope:
+        dq_s, delta_s, qr_s = rest
+    else:
+        dq_s, delta_s = rest
+        qr_s = None
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     bq, d = q_ref.shape[1], q_ref.shape[-1]
@@ -284,6 +299,9 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
         o32 = o_ref[0].astype(jnp.float32)
         delta_s[...] = jnp.broadcast_to(
             jnp.sum(do32 * o32, axis=1)[:, None], delta_s.shape)
+        if with_rope:
+            qr_s[...] = _rope_tile(q_ref[0], cos_i_ref,
+                                   sin_i_ref).astype(qr_s.dtype)
 
     run = True
     if causal:
@@ -292,8 +310,7 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
     @pl.when(run)
     def _body():
         if with_rope:
-            q = _rope_tile(q_ref[0], cos_i_ref, sin_i_ref).astype(
-                q_ref.dtype)
+            q = qr_s[...]
             k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
                 k_ref.dtype)
         else:
@@ -343,7 +360,12 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int,
         cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
         i = 10
     dk_ref, dv_ref = refs[i:i + 2]
-    dk_s, dv_s = refs[i + 2:]
+    rest = refs[i + 2:]
+    if with_rope:
+        dk_s, dv_s, kr_s = rest
+    else:
+        dk_s, dv_s = rest
+        kr_s = None
     ki = pl.program_id(1)
     qb = pl.program_id(2)
     bk, d = k_ref.shape[1], k_ref.shape[-1]
@@ -352,6 +374,9 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int,
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+        if with_rope:
+            kr_s[...] = _rope_tile(k_ref[0], cos_i_ref,
+                                   sin_i_ref).astype(kr_s.dtype)
 
     run = True
     if causal:
@@ -362,8 +387,7 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int,
         if with_rope:
             q = _rope_tile(q_ref[0], cos_j_ref, sin_j_ref).astype(
                 q_ref.dtype)
-            k = _rope_tile(k_ref[0], cos_i_ref, sin_i_ref).astype(
-                k_ref.dtype)
+            k = kr_s[...]
         else:
             q = q_ref[0]
             k = k_ref[0]
@@ -477,7 +501,8 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             out_specs=qs(by_i),
             out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
-                            pltpu.VMEM((block_q, 128), jnp.float32)],
+                            pltpu.VMEM((block_q, 128), jnp.float32)]
+            + ([pltpu.VMEM((block_q, D), q.dtype)] if with_rope else []),
             **params,
         )(*dq_args)
 
@@ -498,7 +523,8 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
                        jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
             scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
-                            pltpu.VMEM((block_k, D), jnp.float32)],
+                            pltpu.VMEM((block_k, D), jnp.float32)]
+            + ([pltpu.VMEM((block_k, D), k.dtype)] if with_rope else []),
             **params,
         )(*kv_args)
 

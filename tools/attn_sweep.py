@@ -1,4 +1,10 @@
-"""Block-size sweep with median-of-3 (tunnel noise mitigation)."""
+"""Block-size sweep with median-of-3 (tunnel noise mitigation).
+
+Usage: python tools/attn_sweep.py [bare|rope]
+  bare: forward without residuals; rope: the in-situ training config
+  (in-kernel rope + lse residual).  Run from the repo root (the axon
+  TPU plugin resolves relative to cwd)."""
+import sys
 import time
 import numpy as np
 import jax
@@ -31,13 +37,19 @@ def diff_time(mk, reps=3):
     return float(np.median(ts))
 
 
+MODE = sys.argv[1] if len(sys.argv) > 1 else "bare"
+ROPE = pk.rope_tables(S, D) if MODE == "rope" else None
+
+
 def fwd_mk(bq, bk):
     def mk(n):
         @jax.jit
         def f(q, k, v):
             def body(i, q):
-                o = pk._flash_attention_value(q, k, v, True,
-                                              block_q=bq, block_k=bk)
+                r = pk._flash_attention_value(
+                    q, k, v, True, block_q=bq, block_k=bk,
+                    with_lse=ROPE is not None, rope=ROPE)
+                o = r[0] if ROPE is not None else r
                 return o * jnp.bfloat16(0.01) + q * jnp.bfloat16(0.99)
             return jax.lax.fori_loop(0, n, body, q)
         return f
