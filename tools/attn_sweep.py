@@ -38,6 +38,8 @@ def diff_time(mk, reps=3):
 
 
 MODE = sys.argv[1] if len(sys.argv) > 1 else "bare"
+if MODE not in ("bare", "rope"):
+    raise SystemExit(f"unknown mode {MODE!r}: use 'bare' or 'rope'")
 ROPE = pk.rope_tables(S, D) if MODE == "rope" else None
 
 
@@ -63,10 +65,11 @@ def bwd_mk(fbq, fbk, bbq, bbk):
             def body(i, carry):
                 q, k, v = carry
                 out, lse = pk._flash_attention_value(
-                    q, k, v, True, block_q=fbq, block_k=fbk, with_lse=True)
+                    q, k, v, True, block_q=fbq, block_k=fbk,
+                    with_lse=True, rope=ROPE)
                 dq, dk, dv = pk._flash_attention_bwd(
                     q, k, v, out, lse, out, True,
-                    block_q=bbq, block_k=bbk)
+                    block_q=bbq, block_k=bbk, rope=ROPE)
                 s = jnp.bfloat16(1e-4)
                 return (q + dq * s, k + dk * s, v + dv * s)
             return jax.lax.fori_loop(0, n, body, (q, k, v))
@@ -74,7 +77,14 @@ def bwd_mk(fbq, fbk, bbq, bbk):
     return mk
 
 
-print("== fwd+bwd (fwd fixed 512x512) ==")
+print(f"== fwd ({MODE}) ==")
+for bq, bk in ((256, 256), (512, 256), (512, 512), (1024, 512),
+               (512, 1024), (2048, 512)):
+    t = diff_time(fwd_mk(bq, bk))
+    print(f"fwd {bq:4d}x{bk:<4d} {t*1e3:7.3f} ms  "
+          f"eff={fwd_flops/t/PEAK:.3f}")
+
+print(f"== fwd+bwd ({MODE}, fwd fixed 512x512) ==")
 for bbq, bbk in ((512, 512), (1024, 1024), (2048, 512), (512, 2048),
                  (1024, 512), (512, 1024)):
     t = diff_time(bwd_mk(512, 512, bbq, bbk))
