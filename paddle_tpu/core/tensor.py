@@ -397,3 +397,46 @@ def _print_options():
 def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
     return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# -- torch/paddle convenience methods with no jax.Array analog ---------------
+def _t_ndimension(self):
+    return self.ndim
+
+
+def _t_contiguous(self):
+    """jax arrays are always dense/contiguous; identity for parity."""
+    return self
+
+
+def _t_is_contiguous(self):
+    return True
+
+
+def _t_apply_(self, func):
+    """Parity: Tensor.apply_ (python/paddle/tensor/manipulation.py) —
+    apply a python callable to the tensor in place (callable receives
+    and returns a Tensor/array)."""
+    if not self.stop_gradient:
+        raise RuntimeError(
+            "apply_ cannot be used on a tensor that requires grad")
+    out = func(self)
+    self._value = out._value if isinstance(out, Tensor) \
+        else jnp.asarray(out)
+    return self
+
+
+def _t_apply(self, func):
+    if not self.stop_gradient:
+        raise RuntimeError(
+            "apply cannot be used on a tensor that requires grad (the "
+            "callable runs outside the autograd tape)")
+    out = func(self)
+    return out if isinstance(out, Tensor) else Tensor(out)
+
+
+Tensor.ndimension = _t_ndimension
+Tensor.contiguous = _t_contiguous
+Tensor.is_contiguous = _t_is_contiguous
+Tensor.apply_ = _t_apply_
+Tensor.apply = _t_apply

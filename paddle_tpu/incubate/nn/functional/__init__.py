@@ -21,6 +21,11 @@ from ....nn.functional.norm import layer_norm as _layer_norm
 from ....nn.functional.activation import swiglu  # noqa: F401
 from ....nn.functional.common import (scaled_dot_product_attention,
                                       flash_attention)  # noqa: F401
+from ....nn.functional.common import dropout as _dropout
+from ....nn.functional.activation import (relu as _ff_relu,
+                                          gelu as _ff_gelu)
+from ....ops import add as _add
+from ....ops.linalg import matmul as _mm
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
@@ -252,3 +257,270 @@ def variable_length_memory_efficient_attention(
         args = args + (targ(mask),)
     return apply_op("variable_length_memory_efficient_attention", fn,
                     args)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """Parity: paddle.incubate.nn.functional.fused_bias_act (phi
+    fused_bias_act kernel): out = act(x + bias); the int8/smooth-quant
+    arguments are inference-dequant knobs the TPU path does not use."""
+    if dequant_scales is not None or shift is not None \
+            or smooth is not None or quant_scale != -1:
+        raise NotImplementedError(
+            "fused_bias_act quantization arguments are not supported on "
+            "the TPU path")
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "geglu": None, "swiglu": None}
+    if act_method not in acts:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+
+    def fn(v, *b):
+        h = v + b[0] if b else v
+        if act_method in ("geglu", "swiglu"):
+            a, g = jnp.split(h, 2, axis=-1)
+            inner = jax.nn.gelu(a.astype(jnp.float32)) \
+                if act_method == "geglu" \
+                else jax.nn.silu(a.astype(jnp.float32))
+            return (inner * g.astype(jnp.float32)).astype(v.dtype)
+        return acts[act_method](h.astype(jnp.float32)).astype(v.dtype)
+
+    args = (x,) + ((targ(bias),) if bias is not None else ())
+    return apply_op("fused_bias_act", fn, args)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """Parity: paddle.incubate.nn.functional.fused_linear_activation
+    (cuBLASLt epilogue fusion in the reference) — one matmul with the
+    bias+activation fused by XLA."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "none": lambda v: v, "": lambda v: v}
+    if activation not in acts:
+        raise ValueError(f"unsupported activation {activation!r}")
+
+    def fn(a, w, *b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return acts[activation](out.astype(jnp.float32)).astype(out.dtype)
+
+    args = (x, targ(y)) + ((targ(bias),) if bias is not None else ())
+    return apply_op("fused_linear_activation", fn, args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_transformer.py:36
+    — residual + (pre|post)-LN transformer FFN in one op."""
+    act = {"relu": _ff_relu, "gelu": _ff_gelu}[activation]
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = _layer_norm(out, x.shape[-1], weight=ln1_scale, bias=ln1_bias,
+                          epsilon=ln1_epsilon)
+    h = _mm(out, linear1_weight)
+    if linear1_bias is not None:
+        h = _add(h, linear1_bias)
+    h = _dropout(act(h), dropout1_rate, training=training, mode=mode)
+    h = _mm(h, linear2_weight)
+    if linear2_bias is not None:
+        h = _add(h, linear2_bias)
+    h = _dropout(h, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = _add(residual, h)
+    if not pre_layer_norm:
+        h = _layer_norm(h, h.shape[-1], weight=ln2_scale, bias=ln2_bias,
+                        epsilon=ln2_epsilon)
+    return h
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_transformer.py:514
+    — fused self-attention block (residual + LN + qkv + sdpa + out proj).
+    qkv_weight: [3, num_heads, head_dim, d_model] (or [d_model, 3*d] with
+    transpose_qkv_wb=True and num_heads given)."""
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = _layer_norm(out, x.shape[-1], weight=pre_ln_scale,
+                          bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+
+    def qkv_fn(v, w, *b):
+        B, S, D = v.shape
+        if transpose_qkv_wb:
+            if num_heads <= 0:
+                raise ValueError("num_heads required with "
+                                 "transpose_qkv_wb=True")
+            h = v @ w                                    # [B,S,3*D]
+            if b:
+                h = h + b[0]
+            h = h.reshape(B, S, 3, num_heads, D // num_heads)
+        else:
+            h = jnp.einsum("bsd,thed->bsthe", v, w)      # [B,S,3,H,hd]
+            if b:
+                h = h + b[0].reshape(1, 1, *b[0].shape)
+        return h[:, :, 0], h[:, :, 1], h[:, :, 2]        # [B,S,H,hd]
+
+    qkv_args = (out, targ(qkv_weight)) + (
+        (targ(qkv_bias),) if qkv_bias is not None else ())
+    q, k, v = apply_op("fused_mha_qkv", qkv_fn, qkv_args)
+
+    if cache_kv is not None:
+        from ....ops.manipulation import concat
+        k = concat([cache_kv[0], k], axis=1)
+        v = concat([cache_kv[1], v], axis=1)
+        cache_out = (k, v)
+    attn = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        is_causal=False, training=training)
+    B, S = x.shape[0], x.shape[1]
+    attn = attn.reshape([B, S, -1])
+    out = _mm(attn, linear_weight)
+    if linear_bias is not None:
+        out = _add(out, linear_bias)
+    out = _dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = _add(residual, out)
+    if not pre_layer_norm:
+        out = _layer_norm(out, out.shape[-1], weight=ln_scale,
+                          bias=ln_bias, epsilon=ln_epsilon)
+    if cache_kv is not None:
+        return out, cache_out
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, pre_caches=None, seq_lens=None,
+                            rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            rotary_emb_dims=0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_transformer.py:976
+    — the whole pre-LN transformer stack as one call (the reference's
+    serving-path op).  Composes the fused MHA + FFN ops per layer; KV
+    caches append per layer when given."""
+    if pre_caches is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "fused_multi_transformer: pre_caches / rotary_emb_dims are "
+            "not supported on this path (use the model-level generation "
+            "APIs for rope + prefix cache)")
+    if seq_lens is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: seq_lens / time_step (padded-batch "
+            "serving) are not supported on this path — use "
+            "variable_length_memory_efficient_attention or the "
+            "inference.Predictor generation loop")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer supports trans_qkvw=True "
+            "([3, H, head_dim, D] qkv weights) only")
+    if not pre_layer_norm:
+        raise NotImplementedError(
+            "fused_multi_transformer supports pre_layer_norm=True only "
+            "(matching the reference kernel)")
+    out = x
+    new_caches = []
+    n_layers = len(qkv_weights)
+
+    def get(lst, i):
+        return None if lst is None else lst[i]
+
+    for i in range(n_layers):
+        cache = None if cache_kvs is None else cache_kvs[i]
+        res = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=get(ln_scales, i), pre_ln_bias=get(ln_biases, i),
+            pre_ln_epsilon=epsilon, qkv_bias=get(qkv_biases, i),
+            linear_bias=get(linear_biases, i), cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training, mode=mode,
+            add_residual=True, transpose_qkv_wb=False)
+        if cache is not None:
+            out, new_cache = res
+            new_caches.append(new_cache)
+        else:
+            out = res
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=get(ffn1_biases, i),
+            linear2_bias=get(ffn2_biases, i),
+            ln1_scale=get(ffn_ln_scales, i),
+            ln1_bias=get(ffn_ln_biases, i), ln1_epsilon=epsilon,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=True,
+            training=training, mode=mode, add_residual=True)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", tokens_per_expert=None, name=None):
+    """Parity: python/paddle/incubate/nn/functional/fused_ec_moe.py (phi
+    cutlass moe_kernel.cu, which supports ec_route=True only).
+
+    Expert-choice routing: each expert picks its top-C tokens by the
+    softmax gate score (C = tokens_per_expert, default 2*S/E like the
+    EC-MoE paper's capacity factor 2), runs its FFN on them, and the
+    picks combine back weighted by the gate probability.  All experts
+    run as batched einsums on the MXU."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"unsupported act_type {act_type!r}")
+
+    if tokens_per_expert is not None and tokens_per_expert < 1:
+        raise ValueError("tokens_per_expert must be >= 1")
+
+    def fn(v, g, w0, b0, w1, b1):
+        B, S, D = v.shape
+        E = g.shape[-1]
+        C = tokens_per_expert if tokens_per_expert is not None \
+            else max(1, 2 * S // E)
+        C = min(C, S)          # an expert cannot pick more tokens than S
+        probs = jax.nn.softmax(g.astype(jnp.float32), axis=-1)  # [B,S,E]
+        # each expert picks its top-C tokens (per batch row)
+        scores = jnp.swapaxes(probs, 1, 2)                # [B,E,S]
+        top_w, top_i = jax.lax.top_k(scores, C)           # [B,E,C]
+        picked = jnp.take_along_axis(
+            v[:, None], top_i[..., None], axis=2)         # [B,E,C,D]
+        h = jnp.einsum("becd,edm->becm", picked.astype(jnp.float32),
+                       w0.astype(jnp.float32)) + b0[None, :, 0][:, :, None]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        eo = jnp.einsum("becm,emd->becd", h,
+                        w1.astype(jnp.float32)) + b1[None, :, 0][:, :, None]
+        # scatter-combine: out[token] += prob * expert_out
+        out = jnp.zeros((B, S, D), jnp.float32)
+        bidx = jnp.arange(B)[:, None, None]
+        out = out.at[bidx, top_i].add(eo * top_w[..., None])
+        return out.astype(v.dtype)
+
+    return apply_op("fused_ec_moe", fn,
+                    (x, targ(gate), targ(bmm0_weight), targ(bmm0_bias),
+                     targ(bmm1_weight), targ(bmm1_bias)))

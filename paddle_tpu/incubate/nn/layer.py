@@ -200,19 +200,9 @@ class FusedEcMoe(nn.Layer):
             default_initializer=nn.initializer.Constant(0.0))
 
     def forward(self, x, gate_weight=None):
-        import jax.numpy as jnp
-        from ...core.dispatch import apply_op
-
-        probs = F.softmax(self.gate(x), axis=-1)      # [B, S, E]
-        act = self.act_type
-
-        def fn(xv, pv, w1, b1, w2, b2):
-            h = jnp.einsum("bsd,edi->bsei", xv, w1) + b1[:, 0]
-            h = jnp.where(h > 0, h, 0) if act == "relu" else \
-                0.5 * h * (1.0 + jnp.tanh(
-                    0.7978845608 * (h + 0.044715 * h ** 3)))
-            y = jnp.einsum("bsei,eio->bseo", h, w2) + b2[:, 0]
-            return jnp.einsum("bseo,bse->bso", y, pv).astype(xv.dtype)
-
-        return apply_op("fused_ec_moe", fn,
-                        (x, probs, self.w1, self.b1, self.w2, self.b2))
+        # delegate to the functional op (reference layout: the layer
+        # wraps incubate.nn.functional.fused_ec_moe) so both surfaces
+        # share one expert-choice routing implementation
+        from .functional import fused_ec_moe
+        return fused_ec_moe(x, self.gate(x), self.w1, self.b1,
+                            self.w2, self.b2, act_type=self.act_type)

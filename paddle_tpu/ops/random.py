@@ -277,7 +277,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         "multinomial", lambda k: kernel(k).astype(jnp.int64))
 
 
-@register_op("exponential_", category="random")
+@register_op("exponential_", category="random", tensor_method=True)
 def exponential_(x, lam=1.0, name=None):
     v = as_value(x)
     x._value = _rng_apply(
@@ -287,7 +287,7 @@ def exponential_(x, lam=1.0, name=None):
     return x
 
 
-@register_op("normal_", category="random")
+@register_op("normal_", category="random", tensor_method=True)
 def normal_(x, mean=0.0, std=1.0, name=None):
     v = as_value(x)
     x._value = _rng_apply(
@@ -297,7 +297,7 @@ def normal_(x, mean=0.0, std=1.0, name=None):
     return x
 
 
-@register_op("uniform_", category="random")
+@register_op("uniform_", category="random", tensor_method=True)
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     v = as_value(x)
     key = _seeded_key(seed) if seed != 0 else None

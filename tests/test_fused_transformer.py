@@ -1,0 +1,201 @@
+"""incubate.nn.functional fused transformer family: fused_bias_act,
+fused_linear_activation, fused_feedforward, fused_multi_head_attention,
+fused_multi_transformer, fused_ec_moe — plus the in-place RNG /
+convenience tensor methods.
+
+Parity: python/paddle/incubate/nn/functional/fused_transformer.py
+(:36 feedforward, :514 MHA, :976 multi_transformer), fused_ec_moe.py
+(cutlass moe_kernel.cu, expert-choice routing).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+rs = np.random.RandomState(0)
+t = paddle.to_tensor
+
+
+def test_fused_bias_act():
+    x = rs.randn(2, 8).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    out = IF.fused_bias_act(t(x), t(b), act_method="relu").numpy()
+    np.testing.assert_allclose(out, np.maximum(x + b, 0), rtol=1e-6)
+    # geglu splits the last dim
+    x2 = rs.randn(2, 8).astype(np.float32)
+    out = IF.fused_bias_act(t(x2), act_method="swiglu").numpy()
+    a, g = x2[:, :4], x2[:, 4:]
+    ref = (a / (1 + np.exp(-a))) * g
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        IF.fused_bias_act(t(x), quant_scale=1.0)
+
+
+def test_fused_linear_activation():
+    x = rs.randn(3, 8).astype(np.float32)
+    w = rs.randn(8, 4).astype(np.float32)
+    b = rs.randn(4).astype(np.float32)
+    out = IF.fused_linear_activation(t(x), t(w), t(b),
+                                     activation="relu").numpy()
+    np.testing.assert_allclose(out, np.maximum(x @ w + b, 0), rtol=1e-5)
+    out = IF.fused_linear_activation(t(x), t(w.T), trans_y=True,
+                                     activation="none").numpy()
+    np.testing.assert_allclose(out, x @ w, rtol=1e-5)
+
+
+def test_fused_feedforward_matches_composition():
+    import paddle_tpu.nn.functional as F
+    x = rs.randn(2, 4, 8).astype(np.float32)
+    w1 = rs.randn(8, 16).astype(np.float32)
+    w2 = rs.randn(16, 8).astype(np.float32)
+    out = IF.fused_feedforward(
+        t(x), t(w1), t(w2), dropout1_rate=0.0, dropout2_rate=0.0,
+        pre_layer_norm=True, activation="relu").numpy()
+    ln = F.layer_norm(t(x), 8).numpy()
+    ref = x + np.maximum(ln @ w1, 0) @ w2
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_multi_head_attention_matches_composition():
+    import paddle_tpu.nn.functional as F
+    B, S, D, H = 2, 4, 8, 2
+    hd = D // H
+    x = rs.randn(B, S, D).astype(np.float32)
+    qkvw = rs.randn(3, H, hd, D).astype(np.float32)
+    lw = rs.randn(D, D).astype(np.float32)
+    out = IF.fused_multi_head_attention(
+        t(x), t(qkvw), t(lw), pre_layer_norm=False, dropout_rate=0.0,
+        attn_dropout_rate=0.0, add_residual=True).numpy()
+
+    qkv = np.einsum("bsd,thed->bsthe", x, qkvw)   # [B,S,3,H,hd]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ref_attn = F.scaled_dot_product_attention(
+        t(q), t(k), t(v), dropout_p=0.0).numpy().reshape(B, S, D)
+    ref = F.layer_norm(t(x + ref_attn @ lw), D).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_multi_transformer_runs_and_caches():
+    B, S, D, H = 2, 4, 8, 2
+    hd = D // H
+    x = rs.randn(B, S, D).astype(np.float32)
+    qkvw = [t(rs.randn(3, H, hd, D).astype(np.float32))]
+    lw = [t(rs.randn(D, D).astype(np.float32))]
+    w1 = [t(rs.randn(D, 16).astype(np.float32))]
+    w2 = [t(rs.randn(16, D).astype(np.float32))]
+    out = IF.fused_multi_transformer(
+        t(x), [None], [None], qkvw, [None], lw, [None],
+        [None], [None], w1, [None], w2, [None], dropout_rate=0.0)
+    assert out.shape == [B, S, D]
+    # with kv caches: returns (out, new_caches) with appended length
+    k0 = t(np.zeros((B, 0, H, hd), np.float32))
+    out2, caches = IF.fused_multi_transformer(
+        t(x), [None], [None], qkvw, [None], lw, [None],
+        [None], [None], w1, [None], w2, [None], dropout_rate=0.0,
+        cache_kvs=[(k0, k0)])
+    assert caches[0][0].shape == [B, S, H, hd]
+    np.testing.assert_allclose(out2.numpy(), out.numpy(), rtol=1e-5)
+
+
+def test_fused_ec_moe_expert_choice():
+    B, S, D, M, E = 2, 8, 4, 16, 2
+    x = rs.randn(B, S, D).astype(np.float32)
+    gate = rs.randn(B, S, E).astype(np.float32)
+    w0 = rs.randn(E, D, M).astype(np.float32)
+    b0 = np.zeros((E, 1, M), np.float32)
+    w1 = rs.randn(E, M, D).astype(np.float32)
+    b1 = np.zeros((E, 1, D), np.float32)
+    out = IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                          act_type="relu",
+                          tokens_per_expert=S).numpy()
+    # with capacity == S every expert takes every token: out equals the
+    # dense softmax-weighted mixture
+    probs = np.exp(gate) / np.exp(gate).sum(-1, keepdims=True)
+    ref = np.zeros_like(x)
+    for e in range(E):
+        h = np.maximum(x @ w0[e] + b0[e], 0)
+        ref += probs[..., e:e + 1] * (h @ w1[e] + b1[e])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # tight capacity still finite, correct shape
+    out2 = IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                           tokens_per_expert=2)
+    assert np.isfinite(out2.numpy()).all()
+
+
+def test_inplace_rng_tensor_methods():
+    paddle.seed(0)
+    a = t(np.ones((4,), np.float32))
+    a.uniform_()
+    paddle.seed(0)
+    b = t(np.ones((4,), np.float32))
+    b.uniform_()
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    a.normal_(mean=1.0, std=0.1)
+    assert np.isfinite(a.numpy()).all()
+    a.exponential_(lam=2.0)
+    assert (a.numpy() >= 0).all()
+
+
+def test_tensor_convenience_methods():
+    a = t(np.arange(6.0).reshape(2, 3).astype(np.float32))
+    assert a.ndimension() == 2
+    assert a.contiguous() is a
+    assert a.is_contiguous() is True
+    a.apply_(lambda v: v * 2)
+    np.testing.assert_allclose(a.numpy().ravel(),
+                               np.arange(6.0) * 2)
+    out = a.apply(lambda v: v + 1)
+    np.testing.assert_allclose(out.numpy(), a.numpy() + 1)
+    g = t(np.ones((2,), np.float32))
+    g.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        g.apply_(lambda v: v)
+
+
+def test_multi_transformer_rejects_unsupported():
+    x = t(rs.randn(1, 4, 8).astype(np.float32))
+    qkvw = [t(rs.randn(3, 2, 4, 8).astype(np.float32))]
+    lw = [t(rs.randn(8, 8).astype(np.float32))]
+    w1 = [t(rs.randn(8, 16).astype(np.float32))]
+    w2 = [t(rs.randn(16, 8).astype(np.float32))]
+    args = (x, [None], [None], qkvw, [None], lw, [None],
+            [None], [None], w1, [None], w2, [None])
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_transformer(*args, seq_lens=t([4]))
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_transformer(*args, time_step=t([1]))
+    with pytest.raises(NotImplementedError):
+        IF.fused_multi_transformer(*args, trans_qkvw=False)
+
+
+def test_ec_moe_capacity_clamped_and_layer_delegates():
+    B, S, D, M, E = 1, 4, 4, 8, 2
+    x = rs.randn(B, S, D).astype(np.float32)
+    gate = rs.randn(B, S, E).astype(np.float32)
+    w0 = rs.randn(E, D, M).astype(np.float32)
+    b0 = np.zeros((E, 1, M), np.float32)
+    w1 = rs.randn(E, M, D).astype(np.float32)
+    b1 = np.zeros((E, 1, D), np.float32)
+    # capacity beyond S clamps instead of crashing in top_k
+    out = IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                          tokens_per_expert=100)
+    assert np.isfinite(out.numpy()).all()
+    with pytest.raises(ValueError):
+        IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                        tokens_per_expert=0)
+    # the layer wraps the functional: same routing implementation
+    import paddle_tpu.incubate.nn as inn
+    paddle.seed(0)
+    layer = inn.FusedEcMoe(D, M, E, act_type="relu")
+    y = layer(t(x))
+    ref = IF.fused_ec_moe(t(x), layer.gate(t(x)), layer.w1, layer.b1,
+                          layer.w2, layer.b2, act_type="relu")
+    np.testing.assert_allclose(y.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_tensor_apply_requires_no_grad():
+    g = t(np.ones((2,), np.float32))
+    g.stop_gradient = False
+    with pytest.raises(RuntimeError):
+        g.apply(lambda v: v)
