@@ -26,9 +26,15 @@ from .layers import (  # noqa: F401
     UpsamplingBilinear2D, Bilinear, CosineSimilarity, PairwiseDistance,
     SoftMarginLoss, MultiMarginLoss, MultiLabelSoftMarginLoss,
     PoissonNLLLoss, GaussianNLLLoss, TripletMarginLoss,
+    AvgPool3D, MaxPool3D, AdaptiveAvgPool1D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool3D, MaxUnPool1D, MaxUnPool3D,
+    Pad1D, Pad3D, InstanceNorm1D, InstanceNorm3D, CosineEmbeddingLoss,
+    HingeEmbeddingLoss, TripletMarginWithDistanceLoss, LayerDict,
+    Unflatten, Silu, Softmax2D, RReLU,
 )
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)
-from .rnn import SimpleRNN, LSTM, GRU, SimpleRNNCell
+from .rnn import (SimpleRNN, LSTM, GRU, SimpleRNNCell,
+                  RNNCellBase, LSTMCell, GRUCell, RNN, BiRNN)
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm

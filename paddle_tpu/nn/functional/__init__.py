@@ -16,7 +16,8 @@ from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
                       max_pool2d, max_pool3d, adaptive_avg_pool1d,
                       adaptive_avg_pool2d, adaptive_avg_pool3d,
                       adaptive_max_pool2d, max_unpool2d,
-                      fractional_max_pool2d)
+                      fractional_max_pool2d, adaptive_max_pool1d,
+                      adaptive_max_pool3d, max_unpool1d, max_unpool3d)
 from .norm import (batch_norm, layer_norm, instance_norm, group_norm,
                    local_response_norm, rms_norm)
 from .loss import (cross_entropy, softmax_with_cross_entropy,

@@ -73,26 +73,37 @@ def _pool(name, nd, x, kernel_size, stride, padding, mode, data_format,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    if return_mask:
+        if df != "NCW":
+            raise ValueError("return_mask requires NCL/NCW")
+        return _max_pool_nd_with_mask(x, 1, kernel_size, stride, padding,
+                                      ceil_mode)
     return _pool("max_pool1d", 1, x, kernel_size, stride, padding, "max", df,
                  ceil_mode=ceil_mode)
 
 
-def _max_pool2d_with_mask(x, kernel_size, stride, padding, ceil_mode=False):
-    """Max pool returning (out, mask) where mask holds flat h*W+w indices
-    into the input spatial map — the reference's max_pool2d_with_index
-    contract (phi pooling kernels) consumed by max_unpool2d."""
-    k = _pair(kernel_size, 2)
-    s = _pair(stride if stride is not None else kernel_size, 2)
-    pad = _padding(padding, 2, "NCHW")
+_SPATIAL_LAYOUT = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+_FILTER_LAYOUT = {1: "OIW", 2: "OIHW", 3: "OIDHW"}
+
+
+def _max_pool_nd_with_mask(x, nd, kernel_size, stride, padding,
+                           ceil_mode=False):
+    """Max pool returning (out, mask) where mask holds flat indices into
+    the input spatial map — the reference's max_pool_with_index contract
+    (phi pooling kernels) consumed by max_unpool{1,2,3}d."""
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    pad = _padding(padding, nd, _SPATIAL_LAYOUT[nd])
     if isinstance(pad, str):
         raise ValueError("return_mask requires explicit int padding")
     pad = [list(p) for p in pad]
 
     def fn(v):
-        n, c, h, w = v.shape
+        n, c = v.shape[0], v.shape[1]
+        in_sp = v.shape[2:]
         if ceil_mode:
-            # extra right/bottom -inf padding so partial windows count
-            for i, sz in enumerate((h, w)):
+            # extra trailing -inf padding so partial windows count
+            for i, sz in enumerate(in_sp):
                 total = sz + pad[i][0] + pad[i][1]
                 out_n = -(-(total - k[i]) // s[i]) + 1
                 pad[i][1] += max(0, (out_n - 1) * s[i] + k[i] - total)
@@ -103,17 +114,29 @@ def _max_pool2d_with_mask(x, kernel_size, stride, padding, ceil_mode=False):
         # unroll window taps into the channel dim, then argmax over taps
         patches = jax.lax.conv_general_dilated_patches(
             vp, filter_shape=k, window_strides=s, padding="VALID",
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        oh, ow = patches.shape[-2:]
-        patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+            dimension_numbers=(_SPATIAL_LAYOUT[nd], _FILTER_LAYOUT[nd],
+                               _SPATIAL_LAYOUT[nd]))
+        out_sp = patches.shape[-nd:]
+        taps = int(np.prod(k))
+        patches = patches.reshape((n, c, taps) + out_sp)
         out = patches.max(axis=2)
-        tap = patches.argmax(axis=2)                     # [N,C,OH,OW]
-        dh, dw = tap // k[1], tap % k[1]
-        hh = (jnp.arange(oh) * s[0] - pad[0][0])[:, None] + dh
-        ww = (jnp.arange(ow) * s[1] - pad[1][0])[None, :] + dw
-        return out, (hh * w + ww).astype(jnp.int32)
+        tap = patches.argmax(axis=2)                  # [N,C,*out_sp]
+        # decompose the tap index into per-dim offsets, then rebuild the
+        # flat input index (row-major over the UNPADDED spatial dims)
+        flat = jnp.zeros_like(tap)
+        rem = tap
+        for i in range(nd):
+            stride_i = int(np.prod(k[i + 1:]))
+            d_i = rem // stride_i
+            rem = rem % stride_i
+            base = jnp.arange(out_sp[i]) * s[i] - pad[i][0]
+            shape = [1] * (2 + nd)
+            shape[2 + i] = out_sp[i]
+            pos = base.reshape(shape) + d_i
+            flat = flat * in_sp[i] + pos
+        return out, flat.astype(jnp.int32)
 
-    return apply_op("max_pool2d_with_mask", fn, (x,))
+    return apply_op(f"max_pool{nd}d_with_mask", fn, (x,))
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -121,8 +144,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     if return_mask:
         if data_format != "NCHW":
             raise ValueError("return_mask requires NCHW")
-        return _max_pool2d_with_mask(x, kernel_size, stride, padding,
-                                     ceil_mode)
+        return _max_pool_nd_with_mask(x, 2, kernel_size, stride, padding,
+                                      ceil_mode)
     return _pool("max_pool2d", 2, x, kernel_size, stride, padding, "max",
                  data_format, ceil_mode=ceil_mode)
 
@@ -136,25 +159,8 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     ``max_pool2d(..., return_mask=True)``."""
     if data_format != "NCHW":
         raise ValueError("max_unpool2d supports NCHW only")
-    k = _pair(kernel_size, 2)
-    s = _pair(stride if stride is not None else kernel_size, 2)
-    p = _pair(padding, 2)
-
-    def fn(v, idx):
-        n, c, h, w = v.shape
-        if output_size is None:
-            oh = (h - 1) * s[0] - 2 * p[0] + k[0]
-            ow = (w - 1) * s[1] - 2 * p[1] + k[1]
-        else:
-            oh, ow = [int(t) for t in output_size[-2:]]
-        flat = jnp.zeros((n, c, oh * ow), v.dtype)
-        bi = jnp.arange(n)[:, None, None]
-        ci = jnp.arange(c)[None, :, None]
-        flat = flat.at[bi, ci, idx.reshape(n, c, -1)].set(
-            v.reshape(n, c, -1))
-        return flat.reshape(n, c, oh, ow)
-
-    return apply_op("max_unpool2d", fn, (x, targ(indices)))
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size, "max_unpool2d")
 
 
 def _fractional_edges(in_sz, out_sz, pool_sz, u):
@@ -242,6 +248,11 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError("return_mask requires NCDHW")
+        return _max_pool_nd_with_mask(x, 3, kernel_size, stride, padding,
+                                      ceil_mode)
     return _pool("max_pool3d", 3, x, kernel_size, stride, padding, "max",
                  data_format, ceil_mode=ceil_mode)
 
@@ -321,5 +332,72 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d(return_mask=True) — use max_pool2d with "
+            "explicit kernel/stride for indices")
     return _adaptive_pool("adaptive_max_pool2d", 2, x, output_size, "max",
                           "NCHW")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True) — use max_pool1d with "
+            "explicit kernel/stride for indices")
+    return _adaptive_pool("adaptive_max_pool1d", 1, x, output_size, "max",
+                          "NCW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) — use max_pool3d with "
+            "explicit kernel/stride for indices")
+    return _adaptive_pool("adaptive_max_pool3d", 3, x, output_size, "max",
+                          "NCDHW")
+
+
+def _max_unpool_nd(x, indices, nd, kernel_size, stride, padding,
+                   output_size, op_name):
+    """Shared 1/2/3-D unpool: scatter values to flat spatial indices."""
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    p = _pair(padding, nd)
+
+    def fn(v, idx):
+        n, c = v.shape[0], v.shape[1]
+        in_sp = v.shape[2:]
+        if output_size is None:
+            out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                           for i in range(nd))
+        else:
+            out_sp = tuple(int(t) for t in output_size[-nd:])
+        total = int(np.prod(out_sp))
+        flat = jnp.zeros((n, c, total), v.dtype)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        flat = flat.at[bi, ci, idx.reshape(n, c, -1)].set(
+            v.reshape(n, c, -1))
+        return flat.reshape((n, c) + out_sp)
+
+    return apply_op(op_name, fn, (x, targ(indices)))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Parity: paddle.nn.functional.max_unpool1d (phi unpool kernel)."""
+    if data_format != "NCL":
+        raise ValueError("max_unpool1d supports NCL only")
+    return _max_unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Parity: paddle.nn.functional.max_unpool3d (phi unpool3d kernel);
+    indices are flat d*H*W + h*W + w positions."""
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW only")
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size, "max_unpool3d")

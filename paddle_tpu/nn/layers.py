@@ -921,3 +921,270 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, **self._kw)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                      divisor_override, data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, *self._args)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, return_mask, ceil_mode,
+                      data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, *self._args)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self._size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._args = (output_size, data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, *self._args)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, *self._args)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, *self._args)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self._args)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self._args)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self._args = (padding, mode, value,
+                      "NCW" if data_format == "NCL" else data_format)
+
+    def forward(self, x):
+        return F.pad(x, *self._args)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._args = (padding, mode, value, data_format)
+
+    def forward(self, x):
+        return F.pad(x, *self._args)
+
+
+class InstanceNorm1D(Layer):
+    """Parity: paddle.nn.InstanceNorm1D ([N, C, L])."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        self.scale = None if weight_attr is False else \
+            self.create_parameter([num_features], attr=weight_attr,
+                                  default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([num_features], attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._eps, data_format="NCL")
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    """Parity: paddle.nn.InstanceNorm3D ([N, C, D, H, W])."""
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._eps, data_format="NCDHW")
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self._margin,
+                                       self._reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self._margin,
+                                      self._reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """Parity: paddle.nn.TripletMarginWithDistanceLoss — triplet loss
+    with a user distance callable (default: pairwise L2)."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._dist = distance_function
+        self._margin, self._swap = margin, swap
+        self._reduction = reduction
+
+    def forward(self, input, positive, negative):
+        if self._dist is None:
+            return F.triplet_margin_loss(
+                input, positive, negative, margin=self._margin,
+                swap=self._swap, reduction=self._reduction)
+        d_pos = self._dist(input, positive)
+        d_neg = self._dist(input, negative)
+        if self._swap:
+            from ..ops import minimum
+            d_neg = minimum(d_neg, self._dist(positive, negative))
+        from ..ops import clip, mean as _mean, sum as _sum
+        loss = clip(d_pos - d_neg + self._margin, min=0.0)
+        if self._reduction == "mean":
+            return _mean(loss)
+        if self._reduction == "sum":
+            return _sum(loss)
+        return loss
+
+
+class LayerDict(Layer):
+    """Parity: paddle.nn.LayerDict — ordered dict of sublayers."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if hasattr(sublayers, "items") \
+            else sublayers
+        for key, layer in items:
+            self.add_sublayer(key, layer)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from ..ops.extras import unflatten
+        return unflatten(x, self._axis, self._shape)
+
+
+class Silu(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Parity: paddle.nn.Softmax2D — softmax over the channel dim of
+    [N, C, H, W] (or [C, H, W])."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, self.training)

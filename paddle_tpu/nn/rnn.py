@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import apply_op
+from ..ops._helpers import targ
 from ..core.tensor import Tensor
 from .layer_base import Layer, Parameter
 from . import initializer as I
@@ -226,3 +227,173 @@ class SimpleRNNCell(Layer):
             + self.bias_hh
         out = apply_op("rnn_cell_act", self._act, (pre,))
         return out, out
+
+
+class RNNCellBase(Layer):
+    """Parity: paddle.nn.RNNCellBase — base for user cells consumed by
+    the generic RNN/BiRNN wrappers."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        h = shape[-1] if shape is not None else self.hidden_size
+        d = np.dtype(dtype) if dtype is not None else np.float32
+        return Tensor(np.full((b, h), init_value, d))
+
+
+class LSTMCell(RNNCellBase):
+    """Parity: paddle.nn.LSTMCell (single-step LSTM)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        # LSTM state is an (h, c) pair
+        h = super().get_initial_states(batch_ref, shape, dtype,
+                                       init_value, batch_dim_idx)
+        c = super().get_initial_states(batch_ref, shape, dtype,
+                                       init_value, batch_dim_idx)
+        return (h, c)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = Tensor(np.zeros((inputs.shape[0], self.hidden_size),
+                                np.float32))
+            states = (z, z)
+        h, c = states
+
+        def fn(x, hv, cv, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hv @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * cv + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply_op(
+            "lstm_cell", fn,
+            (inputs, targ(h), targ(c), targ(self.weight_ih),
+             targ(self.weight_hh), targ(self.bias_ih),
+             targ(self.bias_hh)))
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    """Parity: paddle.nn.GRUCell (single-step GRU)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = Tensor(np.zeros((inputs.shape[0], self.hidden_size),
+                                     np.float32))
+
+        def fn(x, hv, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = hv @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            cand = jnp.tanh(ic + r * hc)
+            return (1.0 - z) * cand + z * hv
+
+        h_new = apply_op(
+            "gru_cell", fn,
+            (inputs, targ(states), targ(self.weight_ih),
+             targ(self.weight_hh), targ(self.bias_ih),
+             targ(self.bias_hh)))
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Parity: paddle.nn.RNN — run any cell over the time axis.
+
+    The step loop is a python loop over the (static) sequence length in
+    eager mode; under jit the whole unrolled graph compiles once (cells
+    are tiny — XLA fuses the per-step work)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack
+        from ..ops import where as _where, zeros_like
+        if sequence_length is not None and self.is_reverse:
+            raise NotImplementedError(
+                "RNN(is_reverse=True) with sequence_length requires "
+                "per-example sequence reversal; reverse the padded "
+                "batch explicitly instead")
+        x = inputs
+        time_axis = 0 if self.time_major else 1
+        steps = x.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse \
+            else range(steps)
+        state = initial_states
+        outs = [None] * steps
+
+        def blend(new, old, active):
+            # finished sequences freeze their state and emit zeros
+            if old is None:
+                return new
+            if isinstance(new, (tuple, list)):
+                return type(new)(blend(n, o, active)
+                                 for n, o in zip(new, old))
+            return _where(active, new, old)
+
+        for t in order:
+            x_t = x[t] if self.time_major else x[:, t]
+            out, new_state = self.cell(x_t, state)
+            if sequence_length is not None:
+                active = (sequence_length > t).reshape([-1, 1])
+                new_state = blend(new_state, state, active)
+                out = _where(active, out, zeros_like(out))
+            state = new_state
+            outs[t] = out
+        return stack(outs, axis=time_axis), state
+
+
+class BiRNN(Layer):
+    """Parity: paddle.nn.BiRNN — forward + backward cells, outputs
+    concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+        init_fw, init_bw = (initial_states
+                            if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, init_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, init_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

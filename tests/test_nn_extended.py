@@ -405,3 +405,171 @@ def test_fractional_pool_rejects_traced_u():
     x = t(np.random.RandomState(26).rand(1, 1, 8, 8).astype(np.float32))
     with pytest.raises(ValueError, match="random_u"):
         f(x)
+
+
+# -- nn class-surface tail ---------------------------------------------------
+
+def test_nn_class_tail_forward():
+    nn = paddle.nn
+    x5 = t(np.random.RandomState(30).randn(1, 2, 4, 6, 6).astype(
+        np.float32))
+    assert nn.AvgPool3D(2)(x5).shape == [1, 2, 2, 3, 3]
+    assert nn.MaxPool3D(2)(x5).shape == [1, 2, 2, 3, 3]
+    assert nn.AdaptiveAvgPool3D(2)(x5).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(2)(x5).shape == [1, 2, 2, 2, 2]
+    x3 = t(np.random.RandomState(31).randn(2, 3, 8).astype(np.float32))
+    assert nn.AdaptiveAvgPool1D(4)(x3).shape == [2, 3, 4]
+    assert nn.AdaptiveMaxPool1D(4)(x3).shape == [2, 3, 4]
+    assert nn.Pad1D([1, 2])(x3).shape == [2, 3, 11]
+    assert nn.Pad3D([1, 1, 1, 1, 1, 1])(x5).shape == [1, 2, 6, 8, 8]
+    assert nn.InstanceNorm1D(3)(x3).shape == [2, 3, 8]
+    assert nn.InstanceNorm3D(2)(x5).shape == [1, 2, 4, 6, 6]
+    out = nn.Softmax2D()(t(np.random.rand(1, 3, 2, 2).astype(np.float32)))
+    np.testing.assert_allclose(out.numpy().sum(axis=1), 1.0, atol=1e-5)
+    assert nn.Silu()(x3).shape == [2, 3, 8]
+    assert nn.RReLU()(x3).shape == [2, 3, 8]
+    assert nn.Unflatten(1, [1, 3])(x3).shape == [2, 1, 3, 8]
+
+
+def test_max_unpool_1d_3d_roundtrip():
+    import torch
+    x = np.random.RandomState(32).randn(1, 2, 8).astype(np.float32)
+    # indices in flat-L space: build with torch's pool then unpool parity
+    tout, tidx = torch.nn.functional.max_pool1d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    un = F.max_unpool1d(t(tout.numpy()), t(tidx.numpy().astype(np.int32)),
+                        2, stride=2).numpy()
+    tun = torch.nn.functional.max_unpool1d(tout, tidx, 2, stride=2).numpy()
+    np.testing.assert_allclose(un, tun)
+    x3 = np.random.RandomState(33).randn(1, 1, 4, 4, 4).astype(np.float32)
+    tout, tidx = torch.nn.functional.max_pool3d(
+        torch.tensor(x3), 2, stride=2, return_indices=True)
+    un = F.max_unpool3d(t(tout.numpy()), t(tidx.numpy().astype(np.int32)),
+                        2, stride=2).numpy()
+    tun = torch.nn.functional.max_unpool3d(tout, tidx, 2, stride=2).numpy()
+    np.testing.assert_allclose(un, tun)
+
+
+def test_layer_dict():
+    nn = paddle.nn
+    d = nn.LayerDict({"a": nn.Linear(4, 4), "b": nn.ReLU()})
+    assert "a" in d and len(d) == 2
+    assert set(d.keys()) == {"a", "b"}
+    x = t(np.random.rand(2, 4).astype(np.float32))
+    out = d["b"](d["a"](x))
+    assert out.shape == [2, 4]
+    # parameters are tracked through the container
+    assert any(p is d["a"].weight for p in d.parameters())
+    d.pop("b")
+    assert len(d) == 1
+
+
+def test_rnn_cells_and_generic_rnn():
+    nn = paddle.nn
+    paddle.seed(0)
+    cell = nn.LSTMCell(4, 6)
+    x = t(np.random.RandomState(34).randn(3, 4).astype(np.float32))
+    h, (h2, c2) = cell(x)
+    assert h.shape == [3, 6] and c2.shape == [3, 6]
+    gcell = nn.GRUCell(4, 6)
+    h, hs = gcell(x)
+    assert h.shape == [3, 6]
+    seq = t(np.random.RandomState(35).randn(3, 5, 4).astype(np.float32))
+    rnn = nn.RNN(nn.LSTMCell(4, 6))
+    out, state = rnn(seq)
+    assert out.shape == [3, 5, 6]
+    bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+    out, states = bi(seq)
+    assert out.shape == [3, 5, 12]
+    # gradients flow through the unrolled loop
+    seq.stop_gradient = False
+    out, _ = rnn(seq)
+    out.sum().backward()
+    assert seq.grad is not None and np.isfinite(seq.grad.numpy()).all()
+
+
+def test_triplet_margin_with_distance_loss():
+    nn = paddle.nn
+    a = t(np.random.RandomState(36).rand(4, 8).astype(np.float32))
+    p = t(np.random.RandomState(37).rand(4, 8).astype(np.float32))
+    n = t(np.random.RandomState(38).rand(4, 8).astype(np.float32))
+    default = nn.TripletMarginWithDistanceLoss()(a, p, n)
+    assert default.shape == []
+    def l1(x, y):
+        return (x - y).abs().sum(axis=-1)
+    custom = nn.TripletMarginWithDistanceLoss(distance_function=l1)(a, p, n)
+    assert np.isfinite(custom.item())
+    loss_cos = nn.CosineEmbeddingLoss()(a, p, t(np.ones(4, np.float32)))
+    assert np.isfinite(loss_cos.item())
+    loss_hinge = nn.HingeEmbeddingLoss()(a, t(np.sign(
+        np.random.RandomState(39).randn(4, 8)).astype(np.float32)))
+    assert np.isfinite(loss_hinge.item())
+
+
+def test_max_pool_1d_3d_return_mask_roundtrip():
+    """Native mask path for 1D/3D pooling feeds our own unpool (no
+    external index source needed)."""
+    import torch
+    x1 = np.random.RandomState(40).randn(2, 3, 8).astype(np.float32)
+    o, m = F.max_pool1d(t(x1), 2, stride=2, return_mask=True)
+    to_, ti = torch.nn.functional.max_pool1d(
+        torch.tensor(x1), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(o.numpy(), to_.numpy())
+    np.testing.assert_array_equal(m.numpy(), ti.numpy())
+    un = F.max_unpool1d(o, m, 2, stride=2).numpy()
+    np.testing.assert_allclose(
+        un, torch.nn.functional.max_unpool1d(to_, ti, 2, 2).numpy())
+
+    x3 = np.random.RandomState(41).randn(1, 2, 4, 4, 4).astype(np.float32)
+    o, m = F.max_pool3d(t(x3), 2, stride=2, return_mask=True)
+    to_, ti = torch.nn.functional.max_pool3d(
+        torch.tensor(x3), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(o.numpy(), to_.numpy())
+    np.testing.assert_array_equal(m.numpy(), ti.numpy())
+    un = F.max_unpool3d(o, m, 2, stride=2).numpy()
+    np.testing.assert_allclose(
+        un, torch.nn.functional.max_unpool3d(to_, ti, 2, 2).numpy())
+
+
+def test_adaptive_max_pool_mask_raises():
+    x = t(np.random.rand(1, 2, 8, 8).astype(np.float32))
+    with pytest.raises(NotImplementedError):
+        F.adaptive_max_pool2d(x, 2, return_mask=True)
+
+
+def test_instance_norm_attr_independence():
+    nn = paddle.nn
+    m = nn.InstanceNorm1D(3, bias_attr=False)
+    assert m.bias is None and m.scale is not None
+    m2 = nn.InstanceNorm3D(2, weight_attr=False)
+    assert m2.scale is None and m2.bias is not None
+
+
+def test_lstm_cell_initial_states_roundtrip():
+    nn = paddle.nn
+    paddle.seed(1)
+    cell = nn.LSTMCell(4, 6)
+    seq = t(np.random.RandomState(42).randn(3, 5, 4).astype(np.float32))
+    init = cell.get_initial_states(seq)
+    assert isinstance(init, tuple) and len(init) == 2
+    out, state = nn.RNN(cell)(seq, initial_states=init)
+    assert out.shape == [3, 5, 6]
+
+
+def test_rnn_sequence_length_masks_padding():
+    nn = paddle.nn
+    paddle.seed(2)
+    cell = nn.GRUCell(4, 6)
+    rnn = nn.RNN(cell)
+    x = np.random.RandomState(43).randn(2, 5, 4).astype(np.float32)
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    out, state = rnn(t(x), sequence_length=lens)
+    # outputs past each length are zero
+    np.testing.assert_allclose(out.numpy()[0, 3:], 0.0)
+    assert np.abs(out.numpy()[1, 3:]).sum() > 0
+    # final state for seq 0 equals the state at t=3 of an unmasked run
+    out_full, _ = rnn(t(x[0:1, :3]))
+    np.testing.assert_allclose(state.numpy()[0], out_full.numpy()[0, -1],
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(NotImplementedError):
+        nn.RNN(cell, is_reverse=True)(t(x), sequence_length=lens)
