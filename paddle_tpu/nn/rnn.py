@@ -345,17 +345,27 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None and self.is_reverse:
+            # per-example reversal: run forward over sequences reversed
+            # within their valid lengths, then un-reverse the outputs —
+            # the reverse direction thus starts at each example's last
+            # valid step, not at padding
+            rev = _reverse_sequence(inputs, sequence_length,
+                                    self.time_major)
+            out, state = self._run(rev, initial_states, sequence_length,
+                                   is_reverse=False)
+            return _reverse_sequence(out, sequence_length,
+                                     self.time_major), state
+        return self._run(inputs, initial_states, sequence_length,
+                         is_reverse=self.is_reverse)
+
+    def _run(self, inputs, initial_states, sequence_length, is_reverse):
         from ..ops.manipulation import stack
         from ..ops import where as _where, zeros_like
-        if sequence_length is not None and self.is_reverse:
-            raise NotImplementedError(
-                "RNN(is_reverse=True) with sequence_length requires "
-                "per-example sequence reversal; reverse the padded "
-                "batch explicitly instead")
         x = inputs
         time_axis = 0 if self.time_major else 1
         steps = x.shape[time_axis]
-        order = range(steps - 1, -1, -1) if self.is_reverse \
+        order = range(steps - 1, -1, -1) if is_reverse \
             else range(steps)
         state = initial_states
         outs = [None] * steps
@@ -381,9 +391,31 @@ class RNN(Layer):
         return stack(outs, axis=time_axis), state
 
 
+def _reverse_sequence(x, lengths, time_major):
+    """Reverse each example's first lengths[b] steps in place; padding
+    steps keep their positions (paddle's sequence-reverse semantics)."""
+    def fn(xv, lv):
+        lv = lv.astype(jnp.int32)
+        steps = xv.shape[0 if time_major else 1]
+        t = jnp.arange(steps, dtype=jnp.int32)
+        if time_major:
+            idx = jnp.where(t[:, None] < lv[None, :],
+                            lv[None, :] - 1 - t[:, None], t[:, None])
+            idx = idx.reshape(steps, lv.shape[0],
+                              *([1] * (xv.ndim - 2)))
+            return jnp.take_along_axis(xv, idx, axis=0)
+        idx = jnp.where(t[None, :] < lv[:, None],
+                        lv[:, None] - 1 - t[None, :], t[None, :])
+        idx = idx.reshape(lv.shape[0], steps, *([1] * (xv.ndim - 2)))
+        return jnp.take_along_axis(xv, idx, axis=1)
+    return apply_op("reverse_sequence", fn, (x, targ(lengths)))
+
+
 class BiRNN(Layer):
     """Parity: paddle.nn.BiRNN — forward + backward cells, outputs
-    concatenated on the feature axis."""
+    concatenated on the feature axis.  With sequence_length, the
+    backward direction runs over per-example-reversed inputs so it
+    starts at each example's last valid step (not at padding)."""
 
     def __init__(self, cell_fw, cell_bw, time_major=False):
         super().__init__()
@@ -394,6 +426,6 @@ class BiRNN(Layer):
         from ..ops.manipulation import concat
         init_fw, init_bw = (initial_states
                             if initial_states is not None else (None, None))
-        out_fw, st_fw = self.rnn_fw(inputs, init_fw)
-        out_bw, st_bw = self.rnn_bw(inputs, init_bw)
+        out_fw, st_fw = self.rnn_fw(inputs, init_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, init_bw, sequence_length)
         return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

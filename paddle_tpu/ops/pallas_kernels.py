@@ -58,16 +58,29 @@ _LANES = 128
 
 
 def _fit_block(want, total):
-    """Largest usable block <= want that divides total: multiples of 128
-    preferred (full-lane tiles); otherwise the whole axis (mosaic allows
-    a block equal to the array dim)."""
+    """Largest usable block <= want that divides total.  Usable means the
+    kernels' 128-lane VMEM softmax scratch can be adapted to it by _cols:
+    either a multiple of 128 (tile) or <= 128 (slice).  A block >128 that
+    is not a lane multiple (e.g. the whole axis when total=192) would
+    crash at trace time, so it is never returned; sub-axis blocks must
+    also be sublane-tileable (multiple of 16, covering f32 and bf16).
+    Returns 0 when no divisor qualifies — dispatchers must pre-check
+    shapes via _pallas_ok (which falls back to _chunked_sdpa); the
+    kernel wrappers themselves raise on a 0 block."""
     b = min(want, total)
-    if total % b == 0 and (b % _LANES == 0 or b == total or b <= _LANES):
+    if total % b == 0 and (b % _LANES == 0
+                           or (b <= _LANES
+                               and (b == total or b % 16 == 0))):
         return b
     for c in range((b // _LANES) * _LANES, 0, -_LANES):
         if total % c == 0:
             return c
-    return total
+    # sub-128 blocks smaller than the full axis must still be sublane
+    # tileable: multiples of 16 cover both f32 (8,128) and bf16 (16,128)
+    for c in range((min(b, _LANES) // 16) * 16, 0, -16):
+        if total % c == 0:
+            return c
+    return 0
 
 
 def _cols(x128, n):
@@ -214,6 +227,8 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
     Sk = k.shape[2]
     block_q = _fit_block(block_q, Sq)
     block_k = _fit_block(block_k, Sk)
+    if not block_q or not block_k:
+        raise ValueError(f"no usable pallas block for Sq={Sq}, Sk={Sk}")
     if rope is not None and Sq != Sk:
         raise ValueError("in-kernel rope requires Sq == Sk")
     scale = 1.0 / math.sqrt(D)
@@ -269,6 +284,26 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
     return out
 
 
+def _bwd_p_ds(q, k, v, do, lse, delta, *, causal, scale, row_off, col_off):
+    """Shared backward tile math (used by all backward kernels):
+    recompute p from the saved lse, then ds = p * (dp - delta).
+    delta is [bq, 1]; lse is the [bq, 128] lane-broadcast residual."""
+    bq, bk = q.shape[0], k.shape[0]
+    s = lax.dot_general(q, k, _DIMNUM_NT,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = row_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = col_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, _MASK_VALUE)
+    # dead rows have lse = -inf: exp(s - lse) would be inf -> 0 them
+    finite = jnp.isfinite(lse[:, :1])
+    p = jnp.where(finite, jnp.exp(s - _cols(lse, bk)), 0.0)
+    dp = lax.dot_general(do, v, _DIMNUM_NT,
+                         preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta)).astype(q.dtype)
+    return p, ds
+
+
 def _flash_bwd_dq_kernel(*refs, block_k: int,
                          causal: bool, scale: float, kv_blocks: int,
                          causal_off: int, with_rope: bool = False):
@@ -316,23 +351,10 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
         else:
             q = q_ref[0]
             k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]                               # [bq, 128]
-        s = lax.dot_general(q, k, _DIMNUM_NT,
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * bq + causal_off + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            cols = kb * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(rows >= cols, s, _MASK_VALUE)
-        # dead rows have lse = -inf: exp(s - lse) would be inf -> 0 them
-        finite = jnp.isfinite(lse[:, :1])
-        p = jnp.where(finite, jnp.exp(s - _cols(lse, block_k)), 0.0)
-        dp = lax.dot_general(do, v, _DIMNUM_NT,
-                             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_s[:, :1])).astype(k.dtype)
+        _, ds = _bwd_p_ds(q, k, v_ref[0], do_ref[0], lse_ref[0],
+                          delta_s[:, :1], causal=causal, scale=scale,
+                          row_off=qi * bq + causal_off,
+                          col_off=kb * block_k)
         dq_s[...] += lax.dot_general(
             ds, k, _DIMNUM_NN, preferred_element_type=jnp.float32) * scale
 
@@ -347,18 +369,27 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
             dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(*refs, block_q: int,
-                          causal: bool, scale: float, q_blocks: int,
-                          causal_off: int, with_rope: bool = False):
-    """dK/dV, grid (BH, k_tile, q_tile): q/do/o/lse stream through as
-    grid blocks, dk/dv accumulate in VMEM scratch."""
+def _flash_bwd_kv_kernel(*refs, block_q: int,
+                         causal: bool, scale: float, q_blocks: int,
+                         causal_off: int, with_rope: bool = False,
+                         emit_dq: bool = False):
+    """dK/dV (+ optional dq partials), grid (BH, k_tile, q_tile):
+    q/do/o/lse stream through as grid blocks, dk/dv accumulate in VMEM
+    scratch.  With emit_dq this is the FUSED backward: the same pass
+    also writes one f32 dq partial per (k_tile, q_tile) cell (reduced
+    over the small k-tile axis outside) — 5 matmuls and one streaming
+    pass instead of the 7 matmuls / two passes of the two-kernel
+    FlashAttention-2 split."""
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref = refs[0:6]
     i = 6
     if with_rope:
-        # cos/sin tiles: _i indexes the k tile (this cell), _j the
-        # streamed q tile — mirroring the dq kernel's naming by grid dim
-        cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
+        # cos/sin tiles: _k indexes the k tile (this cell), _q the
+        # streamed q tile
+        cos_k_ref, sin_k_ref, cos_q_ref, sin_q_ref = refs[6:10]
         i = 10
+    if emit_dq:
+        dq_ref = refs[i]
+        i += 1
     dk_ref, dv_ref = refs[i:i + 2]
     rest = refs[i + 2:]
     if with_rope:
@@ -368,15 +399,15 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int,
         kr_s = None
     ki = pl.program_id(1)
     qb = pl.program_id(2)
-    bk, d = k_ref.shape[1], k_ref.shape[-1]
+    bk = k_ref.shape[1]
 
     @pl.when(qb == 0)
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
         if with_rope:
-            kr_s[...] = _rope_tile(k_ref[0], cos_i_ref,
-                                   sin_i_ref).astype(kr_s.dtype)
+            kr_s[...] = _rope_tile(k_ref[0], cos_k_ref,
+                                   sin_k_ref).astype(kr_s.dtype)
 
     run = True
     if causal:
@@ -385,49 +416,154 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int,
     @pl.when(run)
     def _body():
         if with_rope:
-            q = _rope_tile(q_ref[0], cos_j_ref, sin_j_ref).astype(
+            q = _rope_tile(q_ref[0], cos_q_ref, sin_q_ref).astype(
                 q_ref.dtype)
             k = kr_s[...]
         else:
             q = q_ref[0]
             k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                               # [bq, 128]
-        do32 = do.astype(jnp.float32)
-        # recomputed per (k,q) cell: the o tile is DMA'd for this cell
-        # regardless (block specs fetch per grid step), so caching the
-        # reduction in scratch would save only the VPU mul-reduce on
-        # data already resident in VMEM
-        delta = jnp.sum(do32 * o_ref[0].astype(jnp.float32),
+        # delta recomputed per (k,q) cell: the o tile is DMA'd for this
+        # cell regardless (block specs fetch per grid step), so caching
+        # the reduction in scratch would save only the VPU mul-reduce
+        delta = jnp.sum(do.astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32),
                         axis=1)[:, None]               # [bq, 1]
-        s = lax.dot_general(q, k, _DIMNUM_NT,
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qb * block_q + causal_off + lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            cols = ki * bk + lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(rows >= cols, s, _MASK_VALUE)
-        finite = jnp.isfinite(lse[:, :1])
-        p = jnp.where(finite, jnp.exp(s - _cols(lse, bk)), 0.0)
-        pb = p.astype(do.dtype)
-        dv_s[...] += lax.dot_general(pb, do, _DIMNUM_TN,
+        p, ds = _bwd_p_ds(q, k, v_ref[0], do, lse_ref[0], delta,
+                          causal=causal, scale=scale,
+                          row_off=qb * block_q + causal_off,
+                          col_off=ki * bk)
+        dv_s[...] += lax.dot_general(p.astype(do.dtype), do, _DIMNUM_TN,
                                      preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v, _DIMNUM_NT,
-                             preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
         dk_s[...] += lax.dot_general(
             ds, q, _DIMNUM_TN, preferred_element_type=jnp.float32) * scale
+        if emit_dq:
+            dq = lax.dot_general(
+                ds, k, _DIMNUM_NN,
+                preferred_element_type=jnp.float32) * scale
+            if with_rope:
+                # store each partial in pre-rope space (the rotation is
+                # linear: inverse-rotating partials commutes with summing)
+                dq = _rope_tile(dq, cos_q_ref, sin_q_ref, neg_sin=True)
+            dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    if emit_dq and causal:
+        @pl.when(jnp.logical_not(run))
+        def _dead():
+            dq_ref[0, 0] = jnp.zeros(dq_ref.shape[2:], dq_ref.dtype)
 
     @pl.when(qb == q_blocks - 1)
     def _store():
         if with_rope:
-            dk_ref[0] = _rope_tile(dk_s[...], cos_i_ref, sin_i_ref,
+            dk_ref[0] = _rope_tile(dk_s[...], cos_k_ref, sin_k_ref,
                                    neg_sin=True).astype(dk_ref.dtype)
         else:
             dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
+                               block_q=256, block_k=1024, rope=None):
+    """Single-kernel flash backward (_flash_bwd_kv_kernel, emit_dq=True).
+    f32 dq partials [n_kb, BH, Sq, D] are reduced by XLA right after —
+    a cheap fused sum over the short k-tile axis (callers bound n_kb so
+    this buffer stays a small multiple of dq)."""
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "pallas TPU support unavailable; use the chunked path")
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    block_q = _fit_block(block_q, Sq)
+    block_k = _fit_block(block_k, Sk)
+    if not block_q or not block_k:
+        raise ValueError(f"no usable pallas block for Sq={Sq}, Sk={Sk}")
+    scale = 1.0 / math.sqrt(D)
+    causal_off = Sk - Sq
+    n_qb = Sq // block_q
+    n_kb = Sk // block_k
+    BH = B * H
+
+    args = (q.reshape(BH, Sq, D), k.reshape(BH, Sk, D),
+            v.reshape(BH, Sk, D), out.reshape(BH, Sq, D),
+            g.reshape(BH, Sq, D))
+    with_rope = rope is not None
+    lser = jnp.broadcast_to(lse.reshape(BH, Sq)[..., None],
+                            (BH, Sq, 128))
+
+    def qs(sel):
+        return pl.BlockSpec((1, block_q, D),
+                            lambda b, i, j: (b, sel(i, j), 0))
+
+    def ks(sel):
+        return pl.BlockSpec((1, block_k, D),
+                            lambda b, i, j: (b, sel(i, j), 0))
+
+    by_i = lambda i, j: i
+    by_j = lambda i, j: j
+
+    in_specs = [qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
+                pl.BlockSpec((1, block_q, 128),
+                             lambda b, i, j: (b, j, 0))]
+    call_args = (*args, lser)
+    if with_rope:
+        cos, sin = rope
+        in_specs += [
+            pl.BlockSpec((block_k, D), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_k, D), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((block_q, D), lambda b, i, j: (j, 0)),
+            pl.BlockSpec((block_q, D), lambda b, i, j: (j, 0))]
+        call_args += (cos, sin, cos, sin)
+
+    with jax.enable_x64(False):
+        dq_part, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_kv_kernel, block_q=block_q, causal=causal,
+                scale=scale, q_blocks=n_qb, causal_off=causal_off,
+                with_rope=with_rope, emit_dq=True),
+            grid=(BH, n_kb, n_qb),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, i, j: (i, b, j, 0)),
+                ks(by_i), ks(by_i)],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_kb, BH, Sq, D), jnp.float32),
+                jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+                jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), jnp.float32)]
+            + ([pltpu.VMEM((block_k, D), k.dtype)] if with_rope else []),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+            if (_HAS_PLTPU and not _INTERPRET[0]) else None,
+            interpret=_INTERPRET[0],
+        )(*call_args)
+
+    dq = jnp.sum(dq_part, axis=0).astype(q.dtype)
+    return (dq.reshape(B, H, Sq, D), dk.reshape(B, H, Sk, D),
+            dv.reshape(B, H, Sk, D))
+
+
+# fused-bwd routing: the dq-partials buffer is n_kb copies of dq, so cap
+# n_kb (block_k grows with Sk) and beyond this Sk hand off to the
+# two-kernel scheme whose memory stays O(S*D + S) regardless
+_FUSED_BWD_MAX_SK = 8192
+
+
+def _flash_bwd_auto(q, k, v, out, lse, g, causal, rope=None):
+    """Pick the backward kernel: the fused single-kernel scheme (~2.4x
+    faster on v5e) when the dq-partials buffer stays small (n_kb <= 4),
+    else the two-kernel FlashAttention-2 split (O(S*D + S) memory)."""
+    Sk = k.shape[2]
+    if Sk <= _FUSED_BWD_MAX_SK:
+        bk = _fit_block(max(1024, Sk // 4), Sk)
+        # the cap must hold for the block actually found: awkward seq
+        # lengths can snap to a much smaller divisor (e.g. Sk=2176 ->
+        # bk=128, n_kb=17), where the partials buffer would dwarf dq
+        if bk and Sk // bk <= 4:
+            return _flash_attention_bwd_fused(q, k, v, out, lse, g,
+                                              causal, 256, bk, rope=rope)
+    return _flash_attention_bwd(q, k, v, out, lse, g, causal, rope=rope)
 
 
 def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
@@ -443,6 +579,8 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
     Sk = k.shape[2]
     block_q = _fit_block(block_q, Sq)
     block_k = _fit_block(block_k, Sk)
+    if not block_q or not block_k:
+        raise ValueError(f"no usable pallas block for Sq={Sq}, Sk={Sk}")
     scale = 1.0 / math.sqrt(D)
     causal_off = Sk - Sq
     n_qb = Sq // block_q
@@ -514,7 +652,7 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             kv_in_specs += [cs_k(by_i), cs_k(by_i), cs_q(by_j), cs_q(by_j)]
             kv_args += (cos, sin, cos, sin)
         dk, dv = pl.pallas_call(
-            functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+            functools.partial(_flash_bwd_kv_kernel, block_q=block_q,
                               causal=causal, scale=scale, q_blocks=n_qb,
                               causal_off=causal_off, with_rope=with_rope),
             grid=(B * H, n_kb, n_qb),
@@ -623,8 +761,8 @@ def _chunked_sdpa(q, k, v, causal, mask=None, block_k=256):
 def _pallas_ok(q, k, mask, block=256) -> bool:
     return (_HAS_PLTPU and _on_tpu() and mask is None
             and q.shape[3] <= 128                      # scratch is 128-lane
-            and q.shape[2] % min(block, q.shape[2]) == 0
-            and k.shape[2] % min(block, k.shape[2]) == 0)
+            and _fit_block(block, q.shape[2]) > 0
+            and _fit_block(block, k.shape[2]) > 0)
 
 
 def _select_flash_blocks(q, k, v, causal):
@@ -670,9 +808,9 @@ def _flash_sdpa_fwd(q, k, v, causal):
 def _flash_sdpa_bwd(causal, res, g):
     q, k, v, out, lse = res
     if lse is not None:
-        # Pallas flash backward: p recomputed from lse per tile, memory
-        # stays O(S·D + S) and both halves run tiled on the MXU
-        return _flash_attention_bwd(q, k, v, out, lse, g, causal)
+        # Pallas flash backward: p recomputed from lse per tile; fused
+        # single-kernel scheme for bounded n_kb, two-kernel beyond
+        return _flash_bwd_auto(q, k, v, out, lse, g, causal)
     # chunked backward: block recompute keeps memory bounded (fallback
     # for masked/ragged configs the Pallas kernel rejects)
     _, vjp = jax.vjp(lambda q_, k_, v_: _chunked_sdpa(q_, k_, v_, causal),
@@ -728,8 +866,8 @@ def _flash_rope_sdpa_fwd(q, k, v, cos, sin, causal):
 def _flash_rope_sdpa_bwd(causal, res, g):
     q, k, v, cos, sin, out, lse = res
     if lse is not None:
-        dq, dk, dv = _flash_attention_bwd(q, k, v, out, lse, g, causal,
-                                          rope=(cos, sin))
+        dq, dk, dv = _flash_bwd_auto(q, k, v, out, lse, g, causal,
+                                     rope=(cos, sin))
         return dq, dk, dv, jnp.zeros_like(cos), jnp.zeros_like(sin)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _chunked_sdpa(

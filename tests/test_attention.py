@@ -205,7 +205,7 @@ def test_llama_uses_ring_under_sep():
 
 def test_pallas_flash_backward_matches_reference():
     """Interpret-mode check of the Pallas flash backward kernels
-    (_flash_bwd_dq_kernel/_flash_bwd_dkv_kernel) against the
+    (_flash_bwd_dq_kernel/_flash_bwd_kv_kernel) against the
     full-materialization reference VJP."""
     import jax
     import jax.numpy as jnp
@@ -239,6 +239,91 @@ def test_pallas_flash_backward_matches_reference():
                                        rtol=2e-3, atol=2e-3)
             np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
                                        rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_rope", [False, True])
+def test_pallas_flash_backward_fused(causal, with_rope):
+    """Interpret-mode check of the single-kernel fused backward
+    (_flash_bwd_kv_kernel emit_dq=True: dk/dv scratch + dq partials)
+    against the full-materialization reference VJP, with and without
+    in-kernel neox rope."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(5)
+    B, H, S, D = 2, 2, 256, 32
+    q = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    rope = None
+    if with_rope:
+        cos, sin = pk.rope_tables(S, D)
+        rope = (cos, sin)
+
+    def ref_fn(q_, k_, v_):
+        if with_rope:
+            q_ = pk._rope_xla(q_, cos, sin)
+            k_ = pk._rope_xla(k_, cos, sin)
+        return pk._sdpa_reference(q_, k_, v_, causal)
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(
+            q, k, v, causal, block_q=128, block_k=128, with_lse=True,
+            rope=rope)
+        dq, dk, dv = pk._flash_attention_bwd_fused(
+            q, k, v, out, lse, g, causal, block_q=64, block_k=128,
+            rope=rope)
+        _, vjp = jax.vjp(ref_fn, q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
+
+
+def test_pallas_flash_backward_fused_rectangular():
+    """Sq != Sk (bottom-right-aligned causal) through the FUSED bwd —
+    the production path for decode-style rectangular shapes."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(6)
+    B, H, Sq, Sk, D = 1, 2, 128, 256, 32
+    q = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, Sk, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, Sq, D).astype(np.float32))
+
+    def ref_fn(q_, k_, v_):
+        return pk._sdpa_reference(q_, k_, v_, True)
+
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(
+            q, k, v, True, block_q=64, block_k=128, with_lse=True)
+        dq, dk, dv = pk._flash_attention_bwd_fused(
+            q, k, v, out, lse, g, True, block_q=64, block_k=128)
+        _, vjp = jax.vjp(ref_fn, q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
     finally:
         pk._INTERPRET[0] = old
 
@@ -543,6 +628,50 @@ def test_fit_block():
     assert _fit_block(512, 2048) == 512
     assert _fit_block(512, 120) == 120
     assert _fit_block(256, 64) == 64
+    # advisor regression: blocks >128 that aren't lane multiples crash
+    # at trace time (128-lane scratch) — must snap to a sub-128 divisor
+    for total, want_block in [(192, 96), (320, 80), (576, 96)]:
+        b = _fit_block(512, total)
+        assert b == want_block and (b <= 128 or b % 128 == 0)
+    assert _fit_block(512, 257) == 0       # prime: no usable block
+    # sub-128 blocks must be sublane-tileable (multiple of 16): 254's
+    # only sub-128 divisor is 127, which is not -> fall back to chunked
+    assert _fit_block(512, 254) == 0
+
+
+def test_pallas_flash_lane_unaligned_seq():
+    """S=192: whole axis is not a lane multiple; kernel must pick a
+    sub-128 block instead of crashing (advisor round-2 regression)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(11)
+    B, H, S, D = 1, 2, 192, 32
+    q = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    g = jnp.asarray(rng.rand(B, H, S, D).astype(np.float32))
+    old = pk._INTERPRET[0]
+    pk._INTERPRET[0] = True
+    try:
+        out, lse = pk._flash_attention_value(q, k, v, True, with_lse=True)
+        ref = pk._sdpa_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        dq, dk, dv = pk._flash_attention_bwd(q, k, v, out, lse, g, True)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: pk._sdpa_reference(q_, k_, v_, True),
+            q, k, v)
+        rdq, rdk, rdv = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv),
+                                   rtol=2e-3, atol=2e-3)
+    finally:
+        pk._INTERPRET[0] = old
 
 
 @pytest.mark.parametrize("mode", ["ring", "ulysses"])

@@ -488,6 +488,29 @@ def test_rnn_cells_and_generic_rnn():
     assert seq.grad is not None and np.isfinite(seq.grad.numpy()).all()
 
 
+def test_birnn_sequence_length():
+    """Advisor round-2: BiRNN must honor sequence_length in BOTH
+    directions — backward direction starts at each example's last valid
+    step.  Parity check against per-example trimmed runs."""
+    nn = paddle.nn
+    rng = np.random.RandomState(40)
+    bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+    xnp = rng.randn(3, 5, 4).astype(np.float32)
+    lens = [5, 3, 2]
+    out, (sf, sb) = bi(t(xnp), sequence_length=t(np.array(lens, np.int64)))
+    assert out.shape == [3, 5, 12]
+    for b, L in enumerate(lens):
+        ob, (sfb, sbb) = bi(t(xnp[b:b + 1, :L]))
+        np.testing.assert_allclose(out.numpy()[b, :L], ob.numpy()[0],
+                                   atol=1e-5)
+        # padding steps emit zeros
+        np.testing.assert_allclose(out.numpy()[b, L:], 0.0, atol=1e-6)
+        np.testing.assert_allclose(sf.numpy()[b], sfb.numpy()[0],
+                                   atol=1e-5)
+        np.testing.assert_allclose(sb.numpy()[b], sbb.numpy()[0],
+                                   atol=1e-5)
+
+
 def test_triplet_margin_with_distance_loss():
     nn = paddle.nn
     a = t(np.random.RandomState(36).rand(4, 8).astype(np.float32))
@@ -571,5 +594,14 @@ def test_rnn_sequence_length_masks_padding():
     out_full, _ = rnn(t(x[0:1, :3]))
     np.testing.assert_allclose(state.numpy()[0], out_full.numpy()[0, -1],
                                rtol=1e-5, atol=1e-6)
-    with pytest.raises(NotImplementedError):
-        nn.RNN(cell, is_reverse=True)(t(x), sequence_length=lens)
+    # is_reverse + sequence_length: starts at each example's last valid
+    # step; parity vs a plain reverse run on the trimmed sequence
+    rrnn = nn.RNN(cell, is_reverse=True)
+    rout, rstate = rrnn(t(x), sequence_length=lens)
+    for b, L in enumerate([3, 5]):
+        tr_out, tr_state = rrnn(t(x[b:b + 1, :L]))
+        np.testing.assert_allclose(rout.numpy()[b, :L], tr_out.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(rstate.numpy()[b], tr_state.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(rout.numpy()[0, 3:], 0.0)

@@ -1,0 +1,95 @@
+"""A/B flash backward variants at the bench shape on the real chip.
+Chained N-vs-2N differencing (outputs feed inputs, so steps serialize and
+the constant RTT cancels).  Run from /root/repo: python tools/ab_flash_bwd.py
+"""
+import os
+import sys
+import time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, H, S, D = 8, 16, 2048, 64
+rng = np.random.RandomState(0)
+q0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+k0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+v0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+
+flops_fwd = 2 * 2 * S * S * D * B * H * 0.5      # causal
+flops_bwd_tot = flops_fwd * 3.5                  # fwd+bwd w/ recompute
+
+
+def chain_time(stepfn, n=24):
+    """stepfn: (q,k,v) -> (q,k,v) chained; returns sec/step."""
+    f = jax.jit(stepfn)
+    r = f(q0, k0, v0)
+    np.asarray(r[0][0, 0, 0])
+
+    def run(m):
+        t0 = time.perf_counter()
+        a = (q0, k0, v0)
+        for _ in range(m):
+            a = f(*a)
+        np.asarray(a[0][0, 0, 0])
+        return time.perf_counter() - t0
+    d1, d2 = run(n), run(2 * n)
+    return (d2 - d1) / n
+
+
+def report(name, dt, fl):
+    print(f"{name:22s} {dt*1e3:8.2f} ms  {fl/dt/1e12:6.1f} TF/s "
+          f"({fl/dt/197e12*100:4.1f}% peak)", flush=True)
+
+
+from paddle_tpu.ops import pallas_kernels as pk
+
+
+def fwd_step(q, k, v):
+    o = pk._flash_sdpa(q, k, v, True)
+    return o, k, v
+
+
+def bwd_step_factory(bwd_fn, bq, bk):
+    def step(q, k, v):
+        out, lse = pk._flash_attention_value(q, k, v, True, 512, 512,
+                                             with_lse=True)
+        dq, dk, dv = bwd_fn(q, k, v, out, lse, out, True, bq, bk)
+        return dq, dk, dv
+    return step
+
+
+report("repo fwd (512/512)", chain_time(fwd_step), flops_fwd)
+for bq, bk in [(512, 1024)]:
+    dt = chain_time(bwd_step_factory(pk._flash_attention_bwd, bq, bk))
+    report(f"two-kernel bwd {bq}/{bk}", dt, flops_bwd_tot)
+for bq, bk in [(256, 1024), (512, 1024), (256, 512), (512, 512),
+               (128, 1024), (512, 2048), (256, 2048)]:
+    dt = chain_time(bwd_step_factory(pk._flash_attention_bwd_fused, bq, bk))
+    report(f"fused bwd {bq}/{bk}", dt, flops_bwd_tot)
+
+# in-tree comparison (needs x64 off end to end)
+with jax.enable_x64(False):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention, BlockSizes)
+    bs = BlockSizes.get_default(B, H, S, S, D)
+
+    def intree_fwd_step(q, k, v):
+        o = flash_attention(q, k, v, causal=True,
+                            sm_scale=float(1.0 / np.sqrt(D)),
+                            block_sizes=bs)
+        return o, k, v
+
+    def intree_bwd_step(q, k, v):
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True,
+                                   sm_scale=float(1.0 / np.sqrt(D)),
+                                   block_sizes=bs).astype(jnp.float32).sum()
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    try:
+        report("intree fwd", chain_time(intree_fwd_step), flops_fwd)
+        report("intree fwd+bwd", chain_time(intree_bwd_step),
+               flops_fwd + flops_bwd_tot)
+    except Exception as e:
+        print("intree failed:", type(e).__name__, str(e)[:200])
