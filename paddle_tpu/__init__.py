@@ -80,3 +80,9 @@ if "static" in _OPTIONAL_SUBMODULES and globals().get("static") is not None:
     # paddle.enable_static()/disable_static() parity; in_dynamic_mode is
     # the registered op (ops/logic.py), which consults static mode
     from .static import enable_static, disable_static  # noqa: E402
+
+# Reference-YAML op-name surface over the loaded subsystems (aliases +
+# op-level adapters; see ops/op_surface.py).  After all submodules so the
+# implementations exist to alias.
+from .ops import op_surface as _op_surface    # noqa: E402
+_op_surface.register_framework_ops()

@@ -1,0 +1,387 @@
+"""Functional optimizer-update ops.
+
+Parity: the reference's optimizer op family in
+paddle/phi/api/yaml/ops.yaml (sgd_, momentum_, adam_, adamw_, lamb_,
+adagrad_, adadelta_, adamax_, rmsprop_, rprop_, merged_/fused_ variants,
+average_accumulates_, plus the AMP bookkeeping ops
+check_finite_and_unscale_ / update_loss_scaling_).  The optimizer
+*classes* (paddle_tpu/optimizer/) are the stateful API; these are the
+op-level single-step update rules operating on explicit state tensors —
+in-place on the param/state (trailing-underscore semantics), returning
+the updated tensors.  Each is one fused XLA computation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ._helpers import as_value, wrap, targ
+
+
+def _assign(t, new_val):
+    """In-place update honoring trailing-underscore op semantics."""
+    if isinstance(t, Tensor):
+        t._inplace_assign(wrap(new_val))
+        return t
+    return wrap(new_val)
+
+
+def _f32(v):
+    return as_value(v).astype(jnp.float32)
+
+
+def sgd_(param, learning_rate, grad, master_param=None,
+         multi_precision=False, name=None):
+    """Parity: reference sgd_ op."""
+    lr = _f32(learning_rate)
+    acc = _f32(master_param) if master_param is not None else _f32(param)
+    new = acc - lr * _f32(grad)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def momentum_(param, grad, velocity, learning_rate, master_param=None,
+              mu=0.9, use_nesterov=False, regularization_method="",
+              regularization_coeff=0.0, multi_precision=False,
+              rescale_grad=1.0, name=None):
+    """Parity: reference momentum_ op."""
+    lr = _f32(learning_rate)
+    g = _f32(grad) * rescale_grad
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * p
+    v = mu * _f32(velocity) + g
+    if use_nesterov:
+        new = p - lr * (g + mu * v)
+    else:
+        new = p - lr * v
+    _assign(velocity, v)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, master_param=None, skip_update=None, beta1=0.9,
+          beta2=0.999, epsilon=1e-8, lazy_mode=False,
+          min_row_size_to_use_multithread=1000, multi_precision=False,
+          use_global_beta_pow=False, name=None):
+    """Parity: reference adam_ op."""
+    lr = _f32(learning_rate)
+    g = _f32(grad)
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    m1 = beta1 * _f32(moment1) + (1 - beta1) * g
+    m2 = beta2 * _f32(moment2) + (1 - beta2) * g * g
+    b1p = _f32(beta1_pow) * beta1
+    b2p = _f32(beta2_pow) * beta2
+    mhat = m1 / (1 - b1p)
+    vhat = m2 / (1 - b2p)
+    new = p - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+    _assign(moment1, m1)
+    _assign(moment2, m2)
+    _assign(beta1_pow, b1p)
+    _assign(beta2_pow, b2p)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+           beta2_pow, master_param=None, skip_update=None, beta1=0.9,
+           beta2=0.999, epsilon=1e-8, lr_ratio=1.0, coeff=0.01,
+           with_decay=True, lazy_mode=False,
+           min_row_size_to_use_multithread=1000, multi_precision=False,
+           use_global_beta_pow=False, name=None):
+    """Parity: reference adamw_ op (decoupled weight decay)."""
+    lr = _f32(learning_rate) * lr_ratio
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    if with_decay:
+        p = p * (1.0 - lr * coeff)
+    g = _f32(grad)
+    m1 = beta1 * _f32(moment1) + (1 - beta1) * g
+    m2 = beta2 * _f32(moment2) + (1 - beta2) * g * g
+    b1p = _f32(beta1_pow) * beta1
+    b2p = _f32(beta2_pow) * beta2
+    new = p - lr * (m1 / (1 - b1p)) / (
+        jnp.sqrt(m2 / (1 - b2p)) + epsilon)
+    _assign(moment1, m1)
+    _assign(moment2, m2)
+    _assign(beta1_pow, b1p)
+    _assign(beta2_pow, b2p)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def adagrad_(param, grad, moment, learning_rate, master_param=None,
+             epsilon=1e-6, multi_precision=False, name=None):
+    """Parity: reference adagrad_ op."""
+    g = _f32(grad)
+    mom = _f32(moment) + g * g
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    new = p - _f32(learning_rate) * g / (jnp.sqrt(mom) + epsilon)
+    _assign(moment, mom)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate=None, master_param=None, rho=0.95,
+              epsilon=1e-6, multi_precision=False, name=None):
+    """Parity: reference adadelta_ op."""
+    g = _f32(grad)
+    asg = rho * _f32(avg_squared_grad) + (1 - rho) * g * g
+    upd = g * jnp.sqrt(_f32(avg_squared_update) + epsilon) / \
+        jnp.sqrt(asg + epsilon)
+    asu = rho * _f32(avg_squared_update) + (1 - rho) * upd * upd
+    lr = _f32(learning_rate) if learning_rate is not None else 1.0
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    new = p - lr * upd
+    _assign(avg_squared_grad, asg)
+    _assign(avg_squared_update, asu)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def adamax_(param, grad, learning_rate, moment, inf_norm, beta1_pow,
+            master_param=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+            multi_precision=False, name=None):
+    """Parity: reference adamax_ op."""
+    g = _f32(grad)
+    m = beta1 * _f32(moment) + (1 - beta1) * g
+    inf = jnp.maximum(beta2 * _f32(inf_norm), jnp.abs(g) + epsilon)
+    lr = _f32(learning_rate) / (1 - _f32(beta1_pow))
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    new = p - lr * m / inf
+    _assign(moment, m)
+    _assign(inf_norm, inf)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def rmsprop_(param, mean_square, grad, moment, learning_rate,
+             mean_grad=None, master_param=None, epsilon=1e-10,
+             decay=0.9, momentum=0.0, centered=False,
+             multi_precision=False, name=None):
+    """Parity: reference rmsprop_ op."""
+    g = _f32(grad)
+    ms = decay * _f32(mean_square) + (1 - decay) * g * g
+    if centered and mean_grad is not None:
+        mg = decay * _f32(mean_grad) + (1 - decay) * g
+        denom = jnp.sqrt(ms - mg * mg + epsilon)
+        _assign(mean_grad, mg)
+    else:
+        denom = jnp.sqrt(ms + epsilon)
+    mom = momentum * _f32(moment) + _f32(learning_rate) * g / denom
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    new = p - mom
+    _assign(mean_square, ms)
+    _assign(moment, mom)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def rprop_(param, grad, prev, learning_rate, master_param=None,
+           learning_rate_range=(1e-5, 50.0), etas=(0.5, 1.2),
+           multi_precision=False, name=None):
+    """Parity: reference rprop_ op (sign-based step adaptation)."""
+    g = _f32(grad)
+    pg = _f32(prev)
+    lr = _f32(learning_rate)
+    sign = jnp.sign(g * pg)
+    eta_n, eta_p = etas
+    lo, hi = learning_rate_range
+    lr = jnp.clip(jnp.where(sign > 0, lr * eta_p,
+                            jnp.where(sign < 0, lr * eta_n, lr)),
+                  lo, hi)
+    g_eff = jnp.where(sign < 0, 0.0, g)
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    new = p - lr * jnp.sign(g_eff)
+    _assign(prev, g_eff)
+    _assign(learning_rate, lr)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, master_param=None, skip_update=None,
+          weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
+          always_adapt=False, multi_precision=False, name=None):
+    """Parity: reference lamb_ op (layerwise trust-ratio Adam)."""
+    g = _f32(grad)
+    p = _f32(master_param) if master_param is not None else _f32(param)
+    m1 = beta1 * _f32(moment1) + (1 - beta1) * g
+    m2 = beta2 * _f32(moment2) + (1 - beta2) * g * g
+    b1p = _f32(beta1_pow) * beta1
+    b2p = _f32(beta2_pow) * beta2
+    upd = (m1 / (1 - b1p)) / (jnp.sqrt(m2 / (1 - b2p)) + epsilon)
+    upd = upd + weight_decay * p
+    w_norm = jnp.linalg.norm(p)
+    u_norm = jnp.linalg.norm(upd)
+    ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                      w_norm / u_norm, 1.0)
+    new = p - ratio * _f32(learning_rate) * upd
+    _assign(moment1, m1)
+    _assign(moment2, m2)
+    _assign(beta1_pow, b1p)
+    _assign(beta2_pow, b2p)
+    if master_param is not None:
+        _assign(master_param, new)
+    return _assign(param, new.astype(as_value(param).dtype))
+
+
+def merged_adam_(params, grads, learning_rate, moments1, moments2,
+                 beta1_pows, beta2_pows, master_params=None, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, multi_precision=False,
+                 use_global_beta_pow=False, name=None):
+    """Parity: reference merged_adam_ op (multi-tensor apply)."""
+    mp = master_params or [None] * len(params)
+    for p, g, m1, m2, b1, b2, m in zip(params, grads, moments1,
+                                       moments2, beta1_pows, beta2_pows,
+                                       mp):
+        adam_(p, g, learning_rate, m1, m2, b1, b2, master_param=m,
+              beta1=beta1, beta2=beta2, epsilon=epsilon,
+              multi_precision=multi_precision)
+    return params
+
+
+def merged_momentum_(params, grads, velocitys, learning_rate,
+                     master_params=None, mu=0.9, use_nesterov=False,
+                     regularization_method=None,
+                     regularization_coeff=None, multi_precision=False,
+                     rescale_grad=1.0, name=None):
+    """Parity: reference merged_momentum_ op."""
+    mp = master_params or [None] * len(params)
+    for i, (p, g, v, m) in enumerate(zip(params, grads, velocitys, mp)):
+        momentum_(p, g, v, learning_rate, master_param=m, mu=mu,
+                  use_nesterov=use_nesterov,
+                  regularization_method=(regularization_method[i]
+                                         if regularization_method
+                                         else ""),
+                  regularization_coeff=(regularization_coeff[i]
+                                        if regularization_coeff
+                                        else 0.0),
+                  multi_precision=multi_precision,
+                  rescale_grad=rescale_grad)
+    return params
+
+
+def fused_adam_(params, grads, learning_rate, moments1, moments2,
+                beta1_pows, beta2_pows, master_params=None,
+                skip_update=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                chunk_size=32768, weight_decay=0.0, use_adamw=False,
+                multi_precision=False, use_global_beta_pow=False,
+                name=None):
+    """Parity: reference fused_adam_ op — XLA fuses the whole multi-
+    tensor update into one executable, the TPU analog of the chunked
+    CUDA multi_tensor kernel."""
+    mp = master_params or [None] * len(params)
+    for p, g, m1, m2, b1, b2, m in zip(params, grads, moments1,
+                                       moments2, beta1_pows, beta2_pows,
+                                       mp):
+        if use_adamw:
+            adamw_(p, g, learning_rate, m1, m2, b1, b2, master_param=m,
+                   beta1=beta1, beta2=beta2, epsilon=epsilon,
+                   coeff=weight_decay,
+                   multi_precision=multi_precision)
+        else:
+            adam_(p, g, learning_rate, m1, m2, b1, b2, master_param=m,
+                  beta1=beta1, beta2=beta2, epsilon=epsilon,
+                  multi_precision=multi_precision)
+    return params
+
+
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=10000,
+                         max_average_window=10000,
+                         min_average_window=10000, name=None):
+    """Parity: reference average_accumulates_ op (ModelAverage's
+    windowed parameter-sum bookkeeping)."""
+    p = _f32(param)
+    s1 = _f32(in_sum_1) + p
+    num = as_value(in_num_accumulates).astype(jnp.int64) + 1
+    nupd = as_value(in_num_updates).astype(jnp.int64) + 1
+    old = as_value(in_old_num_accumulates).astype(jnp.int64)
+    roll = num >= min(int(average_window * 1.5), max_average_window)
+    s2 = jnp.where(roll, _f32(in_sum_2) + s1, _f32(in_sum_2))
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    old = jnp.where(roll, old + num, old)
+    num = jnp.where(roll, jnp.zeros_like(num), num)
+    _assign(in_sum_1, s1)
+    _assign(in_sum_2, s2)
+    _assign(in_sum_3, _f32(in_sum_3))
+    _assign(in_num_accumulates, num)
+    _assign(in_old_num_accumulates, old)
+    _assign(in_num_updates, nupd)
+    return in_sum_1
+
+
+def check_finite_and_unscale_(xs, scale, name=None):
+    """Parity: reference check_finite_and_unscale_ op — divide grads by
+    the loss scale; found_infinite reports any non-finite value."""
+    inv = 1.0 / _f32(scale)
+    found = jnp.asarray(False)
+    for x in xs:
+        v = _f32(x) * inv
+        found = found | jnp.any(~jnp.isfinite(v))
+        _assign(x, v.astype(as_value(x).dtype))
+    return xs, wrap(found)
+
+
+def update_loss_scaling_(xs, found_infinite, prev_loss_scaling,
+                         in_good_steps, in_bad_steps,
+                         incr_every_n_steps=1000,
+                         decr_every_n_nan_or_inf=2, incr_ratio=2.0,
+                         decr_ratio=0.5, stop_update=False, name=None):
+    """Parity: reference update_loss_scaling_ op (dynamic loss-scale
+    state machine)."""
+    inf = as_value(found_infinite)
+    scale = _f32(prev_loss_scaling)
+    good = as_value(in_good_steps).astype(jnp.int32)
+    bad = as_value(in_bad_steps).astype(jnp.int32)
+    bad = jnp.where(inf, bad + 1, 0)
+    good = jnp.where(inf, 0, good + 1)
+    decr = bad >= decr_every_n_nan_or_inf
+    incr = good >= incr_every_n_steps
+    scale = jnp.where(decr, jnp.maximum(scale * decr_ratio, 1.0), scale)
+    scale = jnp.where(incr, scale * incr_ratio, scale)
+    bad = jnp.where(decr, 0, bad)
+    good = jnp.where(incr, 0, good)
+    if not stop_update:
+        for x in xs:
+            _assign(x, jnp.where(inf, jnp.zeros_like(_f32(x)),
+                                 _f32(x)).astype(as_value(x).dtype))
+    _assign(prev_loss_scaling, scale)
+    _assign(in_good_steps, good)
+    _assign(in_bad_steps, bad)
+    return xs, prev_loss_scaling
+
+
+_OPTIM_OPS = [
+    ("sgd_", sgd_), ("momentum_", momentum_), ("adam_", adam_),
+    ("adamw_", adamw_), ("adagrad_", adagrad_), ("adadelta_", adadelta_),
+    ("adamax_", adamax_), ("rmsprop_", rmsprop_), ("rprop_", rprop_),
+    ("lamb_", lamb_), ("merged_adam_", merged_adam_),
+    ("merged_momentum_", merged_momentum_), ("fused_adam_", fused_adam_),
+    ("average_accumulates_", average_accumulates_),
+    ("check_finite_and_unscale_", check_finite_and_unscale_),
+    ("update_loss_scaling_", update_loss_scaling_),
+]
+
+
+def register_optim_ops():
+    from .registry import register, registered_ops
+    for name, fn in _OPTIM_OPS:
+        if name not in registered_ops():
+            register(name, fn, category="optimizer")

@@ -1,0 +1,659 @@
+"""Detection op family.
+
+Parity: the reference's detection kernels —
+paddle/phi/kernels/impl/box_coder.h, prior_box_kernel.cc,
+yolo_box_kernel.cc, yolo_loss (phi/kernels/impl/yolo_loss...),
+matrix_nms_kernel.cc, multiclass_nms3, generate_proposals_v2,
+distribute_fpn_proposals, psroi_pool, deformable_conv.
+
+TPU-native: everything is expressed as dense vectorized jnp over fixed
+shapes (sorting + masks instead of data-dependent loops), so the whole
+family traces under jit; NMS-style selection returns fixed-size outputs
+with a valid-count, the XLA-friendly shape discipline.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import apply_op
+from ..ops._helpers import as_value, wrap, targ
+
+
+# ---------------------------------------------------------------------------
+# box_coder
+# ---------------------------------------------------------------------------
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, variance=None, name=None):
+    """Parity: reference box_coder op (encode/decode center-size)."""
+    def fn(pb, tb, *rest):
+        pbv = rest[0] if rest else None
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            # [N_target, N_prior, 4]
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            if pbv is not None:
+                out = out / pbv[None, :, :]
+            elif variance:
+                out = out / jnp.asarray(variance)[None, None, :]
+            return out
+        # decode_center_size: tb is [N, M, 4] deltas (or [N,4] with
+        # priors broadcast on `axis`)
+        deltas = tb if tb.ndim == 3 else tb[:, None, :]
+        if pbv is not None:
+            deltas = deltas * pbv[None, :, :]
+        elif variance:
+            deltas = deltas * jnp.asarray(variance)[None, None, :]
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+        cx = deltas[..., 0] * pw_ + pcx_
+        cy = deltas[..., 1] * ph_ + pcy_
+        w = jnp.exp(deltas[..., 2]) * pw_
+        h = jnp.exp(deltas[..., 3]) * ph_
+        out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                        axis=-1)
+        return out if tb.ndim == 3 else out[:, 0, :]
+    args = (prior_box, targ(target_box))
+    if prior_box_var is not None:
+        args = args + (targ(prior_box_var),)
+    return apply_op("box_coder", fn, args)
+
+
+# ---------------------------------------------------------------------------
+# prior_box (SSD)
+# ---------------------------------------------------------------------------
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """Parity: reference prior_box op (SSD prior/anchor generation)."""
+    fh, fw = as_value(input).shape[-2:]
+    ih, iw = as_value(image).shape[-2:]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                bs = np.sqrt(ms * max_sizes[k])
+                whs.append((bs, bs))
+    whs = np.asarray(whs, np.float32)            # [P, 2]
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)               # [fh, fw]
+    boxes = np.stack([
+        (cxg[..., None] - whs[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] - whs[None, None, :, 1] / 2) / ih,
+        (cxg[..., None] + whs[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] + whs[None, None, :, 1] / 2) / ih,
+    ], axis=-1)                                   # [fh, fw, P, 4]
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          boxes.shape).copy()
+    return wrap(jnp.asarray(boxes)), wrap(jnp.asarray(var))
+
+
+# ---------------------------------------------------------------------------
+# yolo_box / yolo_loss
+# ---------------------------------------------------------------------------
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Parity: reference yolo_box op (decode a YOLOv3 head)."""
+    def fn(xv, imgs):
+        n, c, h, w = xv.shape
+        an = np.asarray(anchors, np.float32).reshape(-1, 2)
+        na = an.shape[0]
+        xv = xv.reshape(n, na, -1, h, w)          # [N, A, 5+C(+1), H, W]
+        if iou_aware:
+            ioup = jax.nn.sigmoid(xv[:, :, -1])
+            xv = xv[:, :, :-1]
+        gx = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+        gy = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+        bx = (gx + jax.nn.sigmoid(xv[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2) / w
+        by = (gy + jax.nn.sigmoid(xv[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2) / h
+        input_h = downsample_ratio * h
+        input_w = downsample_ratio * w
+        bw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / input_w
+        bh = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / input_h
+        conf = jax.nn.sigmoid(xv[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                ioup ** iou_aware_factor
+        prob = jax.nn.sigmoid(xv[:, :, 5:]) * conf[:, :, None]
+        keep = conf > conf_thresh
+        imh = imgs[:, 0].astype(jnp.float32)
+        imw = imgs[:, 1].astype(jnp.float32)
+        x0 = (bx - bw / 2) * imw[:, None, None, None]
+        y0 = (by - bh / 2) * imh[:, None, None, None]
+        x1 = (bx + bw / 2) * imw[:, None, None, None]
+        y1 = (by + bh / 2) * imh[:, None, None, None]
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0)
+            y0 = jnp.clip(y0, 0)
+            x1 = jnp.minimum(x1, imw[:, None, None, None] - 1)
+            y1 = jnp.minimum(y1, imh[:, None, None, None] - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1)
+        boxes = boxes * keep[..., None]
+        boxes = boxes.reshape(n, -1, 4)
+        scores = (prob * keep[:, :, None]).transpose(0, 1, 3, 4, 2)
+        scores = scores.reshape(n, -1, class_num)
+        return boxes, scores
+    return apply_op("yolo_box", fn, (x, targ(img_size)))
+
+
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+              anchor_mask=(), class_num=0, ignore_thresh=0.7,
+              downsample_ratio=32, use_label_smooth=True,
+              scale_x_y=1.0, name=None):
+    """Parity: reference yolo_loss op (YOLOv3 training loss: xywh
+    regression + objectness/class BCE with ignore-region masking)."""
+    def fn(xv, gb, gl, *rest):
+        gs = rest[0] if rest else None
+        n, c, h, w = xv.shape
+        an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+        mask = np.asarray(anchor_mask, np.int64)
+        an = an_all[mask]
+        na = an.shape[0]
+        xv = xv.reshape(n, na, 5 + class_num, h, w)
+        input_size = downsample_ratio * h
+        b = gb.shape[1]
+
+        # predicted boxes (normalized)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        px = (gx + jax.nn.sigmoid(xv[:, :, 0])) / w
+        py = (gy + jax.nn.sigmoid(xv[:, :, 1])) / h
+        pw = jnp.exp(xv[:, :, 2]) * an[None, :, 0, None, None] \
+            / input_size
+        ph = jnp.exp(xv[:, :, 3]) * an[None, :, 1, None, None] \
+            / input_size
+
+        # iou of every predicted box with every gt -> ignore mask
+        pb = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2,
+                        py + ph / 2], -1)          # [N,A,H,W,4]
+        gbx = jnp.stack([gb[..., 0] - gb[..., 2] / 2,
+                         gb[..., 1] - gb[..., 3] / 2,
+                         gb[..., 0] + gb[..., 2] / 2,
+                         gb[..., 1] + gb[..., 3] / 2], -1)  # [N,B,4]
+        lt = jnp.maximum(pb[..., None, :2], gbx[:, None, None, None, :, :2])
+        rb = jnp.minimum(pb[..., None, 2:], gbx[:, None, None, None, :, 2:])
+        whi = jnp.clip(rb - lt, 0)
+        inter = whi[..., 0] * whi[..., 1]
+        area_p = pw * ph
+        area_g = (gb[..., 2] * gb[..., 3])[:, None, None, None, :]
+        iou = inter / jnp.maximum(area_p[..., None] + area_g - inter,
+                                  1e-10)
+        best_iou = jnp.max(iou, axis=-1)
+        ignore = best_iou > ignore_thresh
+
+        # gt -> (anchor, cell) assignment: best wh-iou over ALL anchors,
+        # responsibility only when the argmax falls in this head's mask
+        gw = gb[..., 2] * input_size
+        gh = gb[..., 3] * input_size
+        inter_wh = jnp.minimum(gw[..., None], an_all[None, None, :, 0]) * \
+            jnp.minimum(gh[..., None], an_all[None, None, :, 1])
+        union_wh = gw[..., None] * gh[..., None] + \
+            (an_all[:, 0] * an_all[:, 1])[None, None, :] - inter_wh
+        an_iou = inter_wh / jnp.maximum(union_wh, 1e-10)
+        best_an = jnp.argmax(an_iou, axis=-1)     # [N, B]
+        valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)
+
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+        loss = jnp.zeros((n,), jnp.float32)
+        obj_target = jnp.zeros((n, na, h, w), jnp.float32)
+        # scatter per-gt losses (vectorized over batch x gt)
+        for a_idx in range(na):
+            resp = valid & (best_an == mask[a_idx])
+            tx = gb[..., 0] * w - gi
+            ty = gb[..., 1] * h - gj
+            tw = jnp.log(jnp.maximum(
+                gw / an[a_idx, 0], 1e-9))
+            th = jnp.log(jnp.maximum(
+                gh / an[a_idx, 1], 1e-9))
+            scale = 2.0 - gb[..., 2] * gb[..., 3]
+            # gather predictions at each gt's responsible cell (gj, gi)
+            px_ = xv[jnp.arange(n)[:, None], a_idx, 0, gj, gi]
+            py_ = xv[jnp.arange(n)[:, None], a_idx, 1, gj, gi]
+            pw_ = xv[jnp.arange(n)[:, None], a_idx, 2, gj, gi]
+            ph_ = xv[jnp.arange(n)[:, None], a_idx, 3, gj, gi]
+            w_resp = resp.astype(jnp.float32) * scale
+            bce = lambda lg, tgt: jnp.maximum(lg, 0) - lg * tgt + \
+                jnp.log1p(jnp.exp(-jnp.abs(lg)))
+            lx = bce(px_, tx) + bce(py_, ty)
+            lwh = (pw_ - tw) ** 2 + (ph_ - th) ** 2
+            loss = loss + jnp.sum(w_resp * (lx + 0.5 * lwh), axis=1)
+            # class loss
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            cls_logit = jnp.moveaxis(
+                xv[:, a_idx, 5:], 1, -1)[
+                    jnp.arange(n)[:, None], gj, gi]       # [N,B,C]
+            tgt_cls = jax.nn.one_hot(gl, class_num) * (1 - smooth * 2) \
+                + smooth
+            lcls = jnp.sum(bce(cls_logit, tgt_cls), axis=-1)
+            if gs is not None:
+                lcls = lcls * gs
+            loss = loss + jnp.sum(resp.astype(jnp.float32) * lcls,
+                                  axis=1)
+            # objectness target scatter
+            obj_target = obj_target.at[
+                jnp.arange(n)[:, None], a_idx, gj, gi].max(
+                    resp.astype(jnp.float32))
+        # objectness loss: positives get BCE target 1; ignored cells drop
+        obj_logit = xv[:, :, 4]
+        bce = lambda lg, tgt: jnp.maximum(lg, 0) - lg * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(lg)))
+        lobj = bce(obj_logit, obj_target)
+        noobj_mask = (obj_target == 0) & (~ignore)
+        loss = loss + jnp.sum(
+            lobj * (obj_target + noobj_mask.astype(jnp.float32)),
+            axis=(1, 2, 3))
+        return loss
+    args = (x, targ(gt_box), targ(gt_label))
+    if gt_score is not None:
+        args = args + (targ(gt_score),)
+    return apply_op("yolo_loss", fn, args)
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+def _iou_matrix(boxes):
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                               1e-10)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, name=None):
+    """Parity: reference matrix_nms op (SOLOv2 decay-based NMS) —
+    fully vectorized: score decay via the pairwise IoU matrix, no
+    sequential suppression loop."""
+    def fn(bx, sc):
+        # single image: bx [M, 4]; sc [C, M]
+        bxv = bx[0] if bx.ndim == 3 else bx
+        scv = sc[0] if sc.ndim == 3 else sc
+        C, M = scv.shape
+        outs = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = scv[c]
+            valid = s > score_threshold
+            s = jnp.where(valid, s, 0.0)
+            k = min(nms_top_k if nms_top_k > 0 else M, M)
+            top_s, top_i = lax.top_k(s, k)
+            b = bxv[top_i]
+            iou = jnp.triu(_iou_matrix(b), 1)     # [i, j]: i higher-scored
+            # SOLOv2 matrix NMS: decay_j = min_i f(iou_ij) / f(cmax_i)
+            # where cmax_i is suppressor i's own max overlap with ITS
+            # higher-scored boxes
+            cmax = jnp.max(iou, axis=0)           # [k]
+            tri = jnp.triu(jnp.ones_like(iou), 1) > 0
+            if use_gaussian:
+                decay = jnp.exp(-(iou ** 2 - cmax[:, None] ** 2)
+                                / gaussian_sigma)
+            else:
+                decay = (1 - iou) / jnp.maximum(1 - cmax[:, None],
+                                                1e-10)
+            decay = jnp.min(jnp.where(tri, decay, 1.0), axis=0)
+            dec_s = top_s * decay
+            keep = dec_s > post_threshold
+            cls = jnp.full((k, 1), c, jnp.float32)
+            outs.append(jnp.concatenate(
+                [cls, (dec_s * keep)[:, None], b], axis=1))
+        if not outs:
+            return jnp.zeros((0, 6), jnp.float32), \
+                jnp.zeros((1,), jnp.int32)
+        cat = jnp.concatenate(outs, axis=0)
+        kk = min(keep_top_k if keep_top_k > 0 else cat.shape[0],
+                 cat.shape[0])
+        top_s2, top_i2 = lax.top_k(cat[:, 1], kk)
+        sel = cat[top_i2]
+        count = jnp.sum((sel[:, 1] > 0).astype(jnp.int32))
+        return sel, count.reshape(1)
+    return apply_op("matrix_nms", fn, (bboxes, targ(scores)))
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=400, keep_top_k=200, nms_threshold=0.5,
+                    normalized=True, nms_eta=1.0, background_label=0,
+                    name=None):
+    """Parity: reference multiclass_nms3 op — per-class greedy NMS +
+    global keep_top_k, fixed-size outputs with valid count."""
+    def fn(bx, sc):
+        bxv = bx[0] if bx.ndim == 3 else bx
+        scv = sc[0] if sc.ndim == 3 else sc
+        C, M = scv.shape
+        outs, orig_idx = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = scv[c]
+            k = min(nms_top_k if nms_top_k > 0 else M, M)
+            top_s, top_i = lax.top_k(jnp.where(s > score_threshold, s,
+                                               0.0), k)
+            b = bxv[top_i]
+            iou = _iou_matrix(b)
+
+            def body(i, keep):
+                sup = (iou[i] > nms_threshold) & keep[i] & \
+                    (jnp.arange(k) > i)
+                return keep & (~sup)
+
+            keep = lax.fori_loop(0, k, body,
+                                 jnp.ones((k,), bool)) & (top_s > 0)
+            cls = jnp.full((k, 1), c, jnp.float32)
+            outs.append(jnp.concatenate(
+                [cls, (top_s * keep)[:, None], b], axis=1))
+            orig_idx.append(top_i)                 # original box rows
+        cat = jnp.concatenate(outs, axis=0)
+        cat_idx = jnp.concatenate(orig_idx, axis=0)
+        kk = min(keep_top_k if keep_top_k > 0 else cat.shape[0],
+                 cat.shape[0])
+        top_s2, top_i2 = lax.top_k(cat[:, 1], kk)
+        sel = cat[top_i2]
+        count = jnp.sum((sel[:, 1] > 0).astype(jnp.int32))
+        index = cat_idx[top_i2]                    # original box ids
+        return sel, index.astype(jnp.int32), count.reshape(1)
+    return apply_op("multiclass_nms3", fn, (bboxes, targ(scores)))
+
+
+# ---------------------------------------------------------------------------
+# proposals
+# ---------------------------------------------------------------------------
+def generate_proposals(scores, bbox_deltas, im_shape, anchors,
+                       variances=None, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1,
+                       eta=1.0, pixel_offset=False, name=None):
+    """Parity: reference generate_proposals_v2 op (RPN head)."""
+    def fn(sc, bd, ims, an, *rest):
+        var = rest[0] if rest else None
+        n = sc.shape[0]
+        A = an.reshape(-1, 4).shape[0]
+        anf = an.reshape(-1, 4)
+        s = sc.reshape(n, -1)                     # [N, A*H*W]
+        d = bd.reshape(n, -1, 4)
+        if var is not None:
+            d = d * var.reshape(-1, 4)[None]
+        off = 1.0 if pixel_offset else 0.0
+        aw = anf[:, 2] - anf[:, 0] + off
+        ah = anf[:, 3] - anf[:, 1] + off
+        acx = anf[:, 0] + aw * 0.5
+        acy = anf[:, 1] + ah * 0.5
+        cx = d[..., 0] * aw + acx
+        cy = d[..., 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[..., 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[..., 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                           cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+        imh = ims[:, 0][:, None]
+        imw = ims[:, 1][:, None]
+        boxes = jnp.stack([
+            jnp.clip(boxes[..., 0], 0, imw - 1),
+            jnp.clip(boxes[..., 1], 0, imh - 1),
+            jnp.clip(boxes[..., 2], 0, imw - 1),
+            jnp.clip(boxes[..., 3], 0, imh - 1)], -1)
+        bw = boxes[..., 2] - boxes[..., 0] + off
+        bh = boxes[..., 3] - boxes[..., 1] + off
+        ok = (bw >= min_size) & (bh >= min_size)
+        s = jnp.where(ok, s, -1.0)
+        k = min(pre_nms_top_n, s.shape[1])
+        top_s, top_i = lax.top_k(s, k)
+        bsel = jnp.take_along_axis(boxes, top_i[..., None], axis=1)
+        # per-image greedy NMS
+        outs_b, outs_s, counts = [], [], []
+        for b_i in range(n):
+            iou = _iou_matrix(bsel[b_i])
+
+            def body(i, keep):
+                sup = (iou[i] > nms_thresh) & keep[i] & \
+                    (jnp.arange(k) > i)
+                return keep & (~sup)
+
+            keep = lax.fori_loop(0, k, body, jnp.ones((k,), bool))
+            keep = keep & (top_s[b_i] > 0)
+            sc_k = jnp.where(keep, top_s[b_i], -1.0)
+            kk = min(post_nms_top_n, k)
+            fs, fi = lax.top_k(sc_k, kk)
+            outs_b.append(bsel[b_i][fi])
+            outs_s.append(jnp.maximum(fs, 0))
+            counts.append(jnp.sum((fs > 0).astype(jnp.int32)))
+        return (jnp.stack(outs_b).reshape(-1, 4),
+                jnp.stack(outs_s).reshape(-1),
+                jnp.stack(counts))
+    args = (scores, targ(bbox_deltas), targ(im_shape), targ(anchors))
+    if variances is not None:
+        args = args + (targ(variances),)
+    return apply_op("generate_proposals", fn, args)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Parity: reference distribute_fpn_proposals op — assign each RoI
+    to an FPN level by sqrt-area scale."""
+    def fn(rois):
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + \
+            refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs, counts = [], []
+        for L in range(min_level, max_level + 1):
+            m = lvl == L
+            idx = jnp.argsort(~m, stable=True)    # level-L rois first
+            cnt = jnp.sum(m.astype(jnp.int32))
+            sel = rois[idx]
+            sel = jnp.where((jnp.arange(rois.shape[0]) < cnt)[:, None],
+                            sel, 0.0)
+            outs.append(sel)
+            counts.append(cnt)
+        # restore index: position of each original roi in the
+        # level-sorted concatenation (inverse of the stable level sort)
+        order = jnp.argsort(lvl, stable=True)
+        restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+        return tuple(outs) + (restore[:, None], jnp.stack(counts))
+    return apply_op("distribute_fpn_proposals", fn, (fpn_rois,))
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7,
+               spatial_scale=1.0, output_channels=None, name=None):
+    """Parity: reference psroi_pool op (position-sensitive RoI AVERAGE
+    pooling: output channel c at bin (i,j) averages input channel
+    c*k*k + i*k + j over the bin's integer pixel window).  Exact bin
+    means via a 2-D integral image (one cumsum, then 4 gathers)."""
+    def fn(xv, bx, *rest):
+        N, C, H, W = xv.shape
+        k = output_size if isinstance(output_size, int) \
+            else output_size[0]
+        oc = output_channels or C // (k * k)
+        M = bx.shape[0]
+        if rest:
+            bnum = rest[0].reshape(-1).astype(jnp.int32)
+            bid = jnp.repeat(jnp.arange(N), bnum,
+                             total_repeat_length=M)
+        else:
+            bid = jnp.zeros((M,), jnp.int32)
+        x0 = jnp.round(bx[:, 0] * spatial_scale)
+        y0 = jnp.round(bx[:, 1] * spatial_scale)
+        x1 = jnp.round(bx[:, 2] * spatial_scale)
+        y1 = jnp.round(bx[:, 3] * spatial_scale)
+        bw = jnp.maximum(x1 - x0, 0.1) / k
+        bh = jnp.maximum(y1 - y0, 0.1) / k
+        ii = jnp.arange(k, dtype=jnp.float32)
+        # integer bin edges, floor start / ceil end (reference kernel)
+        ys = jnp.clip(jnp.floor(y0[:, None] + ii[None] * bh[:, None])
+                      .astype(jnp.int32), 0, H)          # [M, k]
+        ye = jnp.clip(jnp.ceil(y0[:, None] + (ii[None] + 1)
+                               * bh[:, None]).astype(jnp.int32), 0, H)
+        xs = jnp.clip(jnp.floor(x0[:, None] + ii[None] * bw[:, None])
+                      .astype(jnp.int32), 0, W)
+        xe = jnp.clip(jnp.ceil(x0[:, None] + (ii[None] + 1)
+                               * bw[:, None]).astype(jnp.int32), 0, W)
+        # integral image with a zero top/left border: [N, C, H+1, W+1]
+        sat = jnp.pad(jnp.cumsum(jnp.cumsum(
+            xv.astype(jnp.float32), axis=2), axis=3),
+            ((0, 0), (0, 0), (1, 0), (1, 0)))
+        cidx = (jnp.arange(oc)[:, None, None] * k * k
+                + jnp.arange(k)[None, :, None] * k
+                + jnp.arange(k)[None, None, :])          # [oc, k, k]
+        cb = jnp.broadcast_to(cidx[None], (M, oc, k, k))
+        bidb = jnp.broadcast_to(bid[:, None, None, None],
+                                (M, oc, k, k))
+        y0b = jnp.broadcast_to(ys[:, None, :, None], (M, oc, k, k))
+        y1b = jnp.broadcast_to(ye[:, None, :, None], (M, oc, k, k))
+        x0b = jnp.broadcast_to(xs[:, None, None, :], (M, oc, k, k))
+        x1b = jnp.broadcast_to(xe[:, None, None, :], (M, oc, k, k))
+        bin_sum = (sat[bidb, cb, y1b, x1b] - sat[bidb, cb, y0b, x1b]
+                   - sat[bidb, cb, y1b, x0b] + sat[bidb, cb, y0b, x0b])
+        area = jnp.maximum((y1b - y0b) * (x1b - x0b), 1)
+        return (bin_sum / area).astype(xv.dtype)
+    args = (x, targ(boxes))
+    if boxes_num is not None:
+        args = args + (targ(boxes_num),)
+    return apply_op("psroi_pool", fn, args)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+def deformable_conv(x, offset, weight, mask=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=64, name=None):
+    """Parity: reference deformable_conv op (v1/v2 with mask) —
+    bilinear-sample the kernel taps at offset positions (dense gather,
+    MXU matmul for the channel contraction)."""
+    def fn(xv, off, wv, *rest):
+        mk = rest[0] if rest else None
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = wv.shape
+        st = (stride, stride) if isinstance(stride, int) else stride
+        pd = (padding, padding) if isinstance(padding, int) else padding
+        dl = (dilation, dilation) if isinstance(dilation, int) \
+            else dilation
+        Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pd[0], pd[0]),
+                          (pd[1], pd[1])))
+        base_y = (jnp.arange(Ho) * st[0])[:, None, None, None] + \
+            (jnp.arange(kh) * dl[0])[None, None, :, None]
+        base_x = (jnp.arange(Wo) * st[1])[None, :, None, None] + \
+            (jnp.arange(kw) * dl[1])[None, None, None, :]
+        off = off.reshape(N, deformable_groups, kh * kw, 2, Ho, Wo)
+        oy = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            N, deformable_groups, Ho, Wo, kh, kw)
+        ox = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            N, deformable_groups, Ho, Wo, kh, kw)
+        py = base_y[None, None] + oy               # [N,G,Ho,Wo,kh,kw]
+        px = base_x[None, None] + ox
+        Hp, Wp = xp.shape[-2:]
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def samp(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, Hp - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, Wp - 1)
+            ok = (yy >= 0) & (yy <= Hp - 1) & (xx >= 0) & (xx <= Wp - 1)
+            # per deformable group, channels split evenly
+            cg = C // deformable_groups
+            xg = xp.reshape(N, deformable_groups, cg, Hp, Wp)
+
+            def g1(img, yi1, xi1):
+                return img[:, yi1, xi1]            # [cg, ...]
+            g = jax.vmap(jax.vmap(g1))(             # over N, G
+                xg, yi, xi)                        # [N,G,cg,Ho,Wo,kh,kw]
+            return g * ok[:, :, None].astype(xv.dtype)
+
+        v = (samp(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+             + samp(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+             + samp(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+             + samp(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if mk is not None:
+            m = mk.reshape(N, deformable_groups, kh * kw, Ho, Wo)
+            m = m.transpose(0, 1, 3, 4, 2).reshape(
+                N, deformable_groups, Ho, Wo, kh, kw)
+            v = v * m[:, :, None]
+        v = v.reshape(N, C, Ho, Wo, kh, kw)
+        out = jnp.einsum("nchwij,ocij->nohw",
+                         v.astype(jnp.float32),
+                         wv.astype(jnp.float32))
+        return out.astype(xv.dtype)
+    args = (x, targ(offset), targ(weight))
+    if mask is not None:
+        args = args + (targ(mask),)
+    return apply_op("deformable_conv", fn, args)
+
+
+_DET_OPS = [
+    ("box_coder", box_coder), ("prior_box", prior_box),
+    ("yolo_box", yolo_box), ("yolo_loss", yolo_loss),
+    ("matrix_nms", matrix_nms), ("multiclass_nms3", multiclass_nms3),
+    ("generate_proposals", generate_proposals),
+    ("distribute_fpn_proposals", distribute_fpn_proposals),
+    ("psroi_pool", psroi_pool), ("deformable_conv", deformable_conv),
+]
+
+
+def register_detection_ops():
+    from ..ops.registry import register, registered_ops
+    for name, fn in _DET_OPS:
+        if name not in registered_ops():
+            register(name, fn, category="detection")

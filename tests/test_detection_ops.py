@@ -1,0 +1,372 @@
+"""Tests for the detection op family (vision/detection.py) and the
+remaining op-surface tail (rnn, warprnnt, hsigmoid_loss,
+class_center_sample, reindex_graph, weighted_sample_neighbors)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import get_op
+
+
+def t(a):
+    return paddle.to_tensor(a)
+
+
+def call(name, *args, **kw):
+    return get_op(name).fn(*args, **kw)
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[10, 10, 30, 30], [20, 20, 60, 80]], np.float32)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, np.float32)
+    gt = np.array([[12, 8, 33, 28]], np.float32)
+    enc = call("box_coder", t(priors), t(var), t(gt),
+               code_type="encode_center_size").numpy()
+    assert enc.shape == (1, 2, 4)
+    dec = call("box_coder", t(priors), t(var), t(enc[:, :, :]),
+               code_type="decode_center_size", axis=0).numpy()
+    # decoding the encoding recovers the gt box against each prior
+    np.testing.assert_allclose(dec[0, 0], gt[0], rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(dec[0, 1], gt[0], rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    boxes, var = call("prior_box", t(feat), t(img), min_sizes=[16.0],
+                      max_sizes=[32.0], aspect_ratios=[2.0], flip=True,
+                      clip=True)
+    b = boxes.numpy()
+    assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    assert var.numpy().shape == b.shape
+
+
+def test_yolo_box_decode():
+    np.random.seed(0)
+    an = [10, 13, 16, 30]
+    x = np.random.randn(1, 2 * (5 + 3), 4, 4).astype(np.float32)
+    img = np.array([[128, 128]], np.int32)
+    boxes, scores = call("yolo_box", t(x), t(img), anchors=an,
+                         class_num=3, conf_thresh=0.0,
+                         downsample_ratio=32)
+    assert boxes.numpy().shape == (1, 32, 4)
+    assert scores.numpy().shape == (1, 32, 3)
+    assert np.isfinite(boxes.numpy()).all()
+    # clip keeps coordinates inside the image
+    assert boxes.numpy().min() >= 0.0
+    assert boxes.numpy().max() <= 127.0 + 1e-5
+
+
+def test_yolo_loss_finite_and_positive():
+    np.random.seed(1)
+    x = np.random.randn(2, 3 * (5 + 4), 4, 4).astype(np.float32) * 0.1
+    gt_box = np.array([[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]],
+                       [[0.25, 0.25, 0.5, 0.5], [0.7, 0.7, 0.2, 0.2]]],
+                      np.float32)
+    gt_label = np.array([[1, 0], [2, 3]], np.int64)
+    loss = call("yolo_loss", t(x), t(gt_box), t(gt_label),
+                anchors=[10, 13, 16, 30, 33, 23],
+                anchor_mask=[0, 1, 2], class_num=4,
+                downsample_ratio=32).numpy()
+    assert loss.shape == (2,) and np.isfinite(loss).all()
+    assert (loss > 0).all()
+
+
+def test_matrix_nms_decay():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)  # one class
+    out, cnt = call("matrix_nms", t(boxes), t(scores),
+                    score_threshold=0.1, post_threshold=0.0,
+                    background_label=-1)
+    o = out.numpy()
+    # top box keeps its score; overlapping second decays; far third ~keeps
+    assert abs(o[0, 1] - 0.9) < 1e-5
+    decayed = o[o[:, 1] > 0]
+    assert len(decayed) == 3
+    second = sorted(o[:, 1])[::-1][1:]
+    assert max(second) <= 0.8  # decayed below raw score or far box 0.7
+
+
+def test_multiclass_nms3_suppression():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.9, 0.85, 0.7]], np.float32)
+    out, index, cnt = call("multiclass_nms3", t(boxes), t(scores),
+                           nms_threshold=0.5, score_threshold=0.1,
+                           background_label=-1)
+    o = out.numpy()
+    kept = o[o[:, 1] > 0]
+    # the overlapping 0.85 box is suppressed; 0.9 and 0.7 survive
+    assert int(cnt.numpy()[0]) == 2
+    np.testing.assert_allclose(sorted(kept[:, 1])[::-1], [0.9, 0.7],
+                               rtol=1e-5)
+
+
+def test_generate_proposals():
+    np.random.seed(2)
+    N, A, H, W = 1, 3, 4, 4
+    scores = np.random.rand(N, A, H, W).astype(np.float32)
+    deltas = np.random.randn(N, A * 4, H, W).astype(np.float32) * 0.1
+    im = np.array([[64, 64]], np.float32)
+    anchors = np.random.rand(H, W, A, 4).astype(np.float32) * 32
+    anchors[..., 2:] += anchors[..., :2] + 8
+    rois, rscores, num = call("generate_proposals", t(scores),
+                              t(deltas.reshape(N, A, 4, H, W)
+                                .transpose(0, 1, 2, 3, 4)
+                                .reshape(N, A * 4, H, W)),
+                              t(im), t(anchors.reshape(-1, 4)),
+                              pre_nms_top_n=20, post_nms_top_n=10,
+                              nms_thresh=0.7, min_size=1.0)
+    r = rois.numpy()
+    assert r.shape == (10, 4)
+    assert (r[:, 0] <= r[:, 2] + 1e-4).all()
+    assert r.min() >= -1e-4 and r.max() <= 64.0
+    assert 0 < int(num.numpy()[0]) <= 10
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 16, 16],      # small -> low level
+                     [0, 0, 200, 200],    # large -> high level
+                     [0, 0, 56, 56]], np.float32)
+    outs = call("distribute_fpn_proposals", t(rois), 2, 5, 4, 224)
+    *levels, restore, counts = outs
+    assert len(levels) == 4
+    c = counts.numpy()
+    assert c.sum() == 3
+    # restore is a permutation of 0..2
+    assert sorted(restore.numpy().reshape(-1).tolist()) == [0, 1, 2]
+
+
+def test_psroi_pool():
+    k, oc = 2, 3
+    C = oc * k * k
+    x = np.arange(1 * C * 8 * 8, dtype=np.float32).reshape(1, C, 8, 8)
+    boxes = np.array([[0, 0, 8, 8]], np.float32)
+    out = call("psroi_pool", t(x), t(boxes), output_size=k,
+               spatial_scale=1.0, output_channels=oc).numpy()
+    assert out.shape == (1, oc, k, k)
+    # exact position-sensitive average: out[0, c, i, j] is the MEAN of
+    # channel c*k*k + i*k + j over that bin's pixel window
+    for c in range(oc):
+        for i in range(k):
+            for j in range(k):
+                ch = c * k * k + i * k + j
+                expect = x[0, ch, i * 4:(i + 1) * 4,
+                           j * 4:(j + 1) * 4].mean()
+                np.testing.assert_allclose(out[0, c, i, j], expect,
+                                           rtol=1e-5)
+    # batch routing via boxes_num: second image's values differ
+    x2 = np.stack([x[0], x[0] + 1000.0])
+    boxes2 = np.array([[0, 0, 8, 8], [0, 0, 8, 8]], np.float32)
+    out2 = call("psroi_pool", t(x2), t(boxes2),
+                t(np.array([1, 1], np.int32)), output_size=k,
+                spatial_scale=1.0, output_channels=oc).numpy()
+    np.testing.assert_allclose(out2[1] - out2[0], 1000.0, rtol=1e-5)
+
+
+def test_matrix_nms_chained_decay_values():
+    """Chained overlaps: decay of a box compensates by its suppressor's
+    own max-overlap with higher-scored boxes (SOLOv2 formula)."""
+    # b0 high score; b1 overlaps b0 by IoU r01; b2 overlaps b1 by r12
+    boxes = np.array([[0, 0, 10, 10], [0, 4, 10, 14],
+                      [0, 8, 10, 18]], np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+    out, cnt = call("matrix_nms", t(boxes), t(scores),
+                    score_threshold=0.1, post_threshold=0.0,
+                    background_label=-1)
+    o = out.numpy()
+    got = np.sort(o[:, 1])[::-1]
+    iou = lambda a, b: (
+        max(0, min(a[3], b[3]) - max(a[1], b[1])) * 10) / (
+        200 - max(0, min(a[3], b[3]) - max(a[1], b[1])) * 10)
+    r01 = iou(boxes[0], boxes[1])
+    r12 = iou(boxes[1], boxes[2])
+    r02 = iou(boxes[0], boxes[2])
+    d1 = 1 - r01                                  # b1: suppressor b0
+    d2 = min((1 - r02), (1 - r12) / (1 - r01))    # b2: b0 and b1(comp)
+    np.testing.assert_allclose(
+        got, sorted([0.9, 0.8 * d1, 0.7 * d2], reverse=True),
+        rtol=1e-4)
+
+
+def test_multiclass_nms3_index_maps_original_boxes():
+    boxes = np.array([[0, 0, 10, 10], [50, 50, 60, 60],
+                      [100, 100, 110, 110]], np.float32)
+    scores = np.array([[0.2, 0.9, 0.6]], np.float32)  # unsorted
+    out, index, cnt = call("multiclass_nms3", t(boxes), t(scores),
+                           score_threshold=0.1, background_label=-1)
+    o, idx = out.numpy(), index.numpy()
+    kept = o[:, 1] > 0
+    # each kept row's box must equal the original box at its index
+    np.testing.assert_allclose(o[kept][:, 2:], boxes[idx[kept]],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(idx[:3], [1, 2, 0])
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    np.random.seed(3)
+    x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+    w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 3 * 3, 6, 6), np.float32)
+    out = call("deformable_conv", t(x), t(off), t(w), None,
+               stride=1, padding=1).numpy()
+    ref = call("conv2d", t(x), t(w), None, 1, 1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rnn_op_lstm_and_gru():
+    np.random.seed(4)
+    T, B, I, H = 3, 2, 4, 5
+    x = np.random.randn(T, B, I).astype(np.float32)
+    # single layer, unidirectional LSTM
+    w_ih = np.random.randn(4 * H, I).astype(np.float32) * 0.1
+    w_hh = np.random.randn(4 * H, H).astype(np.float32) * 0.1
+    b_ih = np.zeros(4 * H, np.float32)
+    b_hh = np.zeros(4 * H, np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    out, h, c = call("rnn", t(x), (t(h0), t(c0)),
+                     [t(w_ih), t(w_hh), t(b_ih), t(b_hh)],
+                     hidden_size=H, num_layers=1, mode="LSTM")
+    assert out.shape == [T, B, H]
+    np.testing.assert_allclose(out.numpy()[-1], h.numpy()[0],
+                               rtol=1e-5)
+    # GRU bidirectional, 1 layer
+    wg = lambda: np.random.randn(3 * H, I).astype(np.float32) * 0.1
+    wgh = lambda: np.random.randn(3 * H, H).astype(np.float32) * 0.1
+    bg = lambda: np.zeros(3 * H, np.float32)
+    weights = [t(wg()), t(wgh()), t(bg()), t(bg()),
+               t(wg()), t(wgh()), t(bg()), t(bg())]
+    h0 = np.zeros((2, B, H), np.float32)
+    out2, h2 = call("rnn", t(x), (t(h0),), weights, hidden_size=H,
+                    num_layers=1, mode="GRU", is_bidirec=True)
+    assert out2.shape == [T, B, 2 * H]
+    assert h2.shape == [2, B, H]
+
+
+def test_rnn_op_sequence_length():
+    """Padded bidirectional batch: reverse direction must start at each
+    example's last VALID step, outputs zero past the length."""
+    np.random.seed(7)
+    T, B, I, H = 5, 2, 3, 4
+    x = np.random.randn(T, B, I).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    wg = lambda r: np.random.randn(3 * H, r).astype(np.float32) * 0.2
+    bg = lambda: np.zeros(3 * H, np.float32)
+    weights = [t(wg(I)), t(wg(H)), t(bg()), t(bg()),
+               t(wg(I)), t(wg(H)), t(bg()), t(bg())]
+    wnp = [w.numpy() for w in weights]
+    h0 = np.zeros((2, B, H), np.float32)
+    out, h = call("rnn", t(x), (t(h0),), [t(w) for w in wnp],
+                  sequence_length=t(lens), hidden_size=H,
+                  num_layers=1, mode="GRU", is_bidirec=True)
+    o = out.numpy()
+    # padding steps (b=1, t>=3) are zero in both directions
+    np.testing.assert_allclose(o[3:, 1], 0.0, atol=1e-6)
+    # parity vs running the trimmed sequence for example 1
+    out_trim, h_trim = call("rnn", t(x[:3, 1:2]),
+                            (t(h0[:, 1:2]),), [t(w) for w in wnp],
+                            hidden_size=H, num_layers=1, mode="GRU",
+                            is_bidirec=True)
+    np.testing.assert_allclose(o[:3, 1], out_trim.numpy()[:, 0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h.numpy()[:, 1], h_trim.numpy()[:, 0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multihead_matmul_with_bias():
+    np.random.seed(8)
+    B, S, Hd, nh = 1, 4, 6, 2
+    x = np.random.randn(B, S, Hd).astype(np.float32)
+    w = np.random.randn(Hd, 3 * Hd).astype(np.float32) * 0.2
+    bias = np.zeros(3 * Hd, np.float32)
+    out = call("multihead_matmul", t(x), t(w), t(bias),
+               head_number=nh, alpha=1.0).numpy()
+    assert out.shape == (B, S, Hd) and np.isfinite(out).all()
+
+
+def test_fused_linear_param_grad_add_dbias_only():
+    x = np.ones((2, 3), np.float32)
+    dout = np.ones((2, 4), np.float32)
+    db_acc = np.full((4,), 100.0, np.float32)
+    dw, db = call("fused_linear_param_grad_add", t(x), t(dout),
+                  None, t(db_acc))
+    # dweight has NO accumulator: exactly x^T @ dout
+    np.testing.assert_allclose(dw.numpy(), np.full((3, 4), 2.0))
+    # dbias accumulator honored: colsum(dout) + 100
+    np.testing.assert_allclose(db.numpy(), np.full((4,), 102.0))
+
+
+def test_warprnnt_known_value():
+    # T=1, U=0: loss = -log P(blank at (0,0))
+    logits = np.log(np.array(
+        [[[[0.6, 0.4]]]], np.float32))          # [1,1,1,2]
+    loss = call("warprnnt", t(logits),
+                t(np.zeros((1, 1), np.int64)),
+                t(np.array([1], np.int64)),
+                t(np.array([0], np.int64)), blank=0).numpy()
+    np.testing.assert_allclose(loss, [-np.log(0.6)], rtol=1e-4)
+    # T=2, U=1: enumerate the two paths
+    V = 2
+    p = np.random.RandomState(5).rand(1, 2, 2, V).astype(np.float32)
+    lab = np.array([[1]], np.int64)
+    loss2 = call("warprnnt", t(np.log(p)), t(lab),
+                 t(np.array([2], np.int64)),
+                 t(np.array([1], np.int64)), blank=0).numpy()
+    import scipy.special as sp
+    lp = np.log(p / p.sum(-1, keepdims=True))[0]
+    # paths: emit@t0 then blanks / blank@t0 emit@t1 then blank
+    p1 = lp[0, 0, 1] + lp[0, 1, 0] + lp[1, 1, 0]
+    p2 = lp[0, 0, 0] + lp[1, 0, 1] + lp[1, 1, 0]
+    expect = -np.logaddexp(p1, p2)
+    np.testing.assert_allclose(loss2, [expect], rtol=1e-4)
+
+
+def test_hsigmoid_loss():
+    np.random.seed(6)
+    B, D, C = 4, 8, 6
+    x = np.random.randn(B, D).astype(np.float32)
+    lab = np.array([0, 3, 5, 2], np.int64)
+    w = np.random.randn(C, D).astype(np.float32) * 0.1
+    out = call("hsigmoid_loss", t(x), t(lab), C, t(w)).numpy()
+    assert out.shape == (B, 1) and (out > 0).all()
+
+
+def test_class_center_sample():
+    lab = np.array([3, 7, 3, 1], np.int64)
+    remapped, sampled = call("class_center_sample", t(lab), 10, 6)
+    s = sampled.numpy()
+    r = remapped.numpy()
+    # all positive classes kept, labels remap into the sampled set
+    for orig, rm in zip(lab, r):
+        assert s[rm] == orig
+    assert len(set(s.tolist())) == 6
+
+
+def test_reindex_graph():
+    x = np.array([10, 20], np.int64)
+    neighbors = np.array([30, 10, 20, 40], np.int64)
+    count = np.array([2, 2], np.int64)
+    src, dst, nodes = call("reindex_graph", t(x), t(neighbors), t(count))
+    n = nodes.numpy()
+    assert n[0] == 10 and n[1] == 20           # seeds first
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1])
+    # src maps neighbor ids to local ids consistently
+    np.testing.assert_array_equal(n[src.numpy()], neighbors)
+
+
+def test_weighted_sample_neighbors():
+    # CSR: node0 -> {1,2,3}, node1 -> {4}
+    row = np.array([1, 2, 3, 4], np.int64)
+    colptr = np.array([0, 3, 4], np.int64)
+    w = np.array([1.0, 1.0, 1.0, 1.0], np.float32)
+    seeds = np.array([0, 1], np.int64)
+    out, cnt = call("weighted_sample_neighbors", t(row), t(colptr),
+                    t(w), t(seeds), sample_size=2)
+    c = cnt.numpy()
+    np.testing.assert_array_equal(c, [2, 1])
+    o = out.numpy().reshape(2, -1)
+    assert set(o[0][o[0] >= 0].tolist()) <= {1, 2, 3}
+    assert 4 in o[1].tolist()

@@ -1,0 +1,383 @@
+"""Tests for the reference-YAML op-name surface (ops/op_surface.py) and
+the functional optimizer-update ops (ops/optim_ops.py).
+
+Every op implemented (not just aliased) in those modules gets at least a
+numeric check against a numpy reference or a known identity; aliases get
+a smoke call proving the adapter signature works.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import registered_ops, get_op
+
+
+def t(a):
+    return paddle.to_tensor(a)
+
+
+def call(name, *args, **kw):
+    return get_op(name).fn(*args, **kw)
+
+
+def test_surface_registered():
+    live = registered_ops()
+    for name in ["p_norm", "softmax", "conv2d", "pool2d", "warpctc",
+                 "adam_", "sgd_", "gather_tree", "edit_distance",
+                 "sequence_mask", "c_embedding", "weight_only_linear",
+                 "fft_c2c", "send_u_recv", "auc", "spectral_norm"]:
+        assert name in live, name
+
+
+def test_p_norm_and_friends():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        call("p_norm", t(x), 2.0, -1).numpy(),
+        np.linalg.norm(x, axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        call("frobenius_norm", t(x)).numpy(), np.linalg.norm(x),
+        rtol=1e-5)
+    np.testing.assert_allclose(call("mean_all", t(x)).numpy(), x.mean(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(call("squared_l2_norm", t(x)).numpy(),
+                               (x ** 2).sum(), rtol=1e-5)
+    clipped = call("clip_by_norm", t(x), 0.5).numpy()
+    np.testing.assert_allclose(np.linalg.norm(clipped), 0.5, rtol=1e-4)
+
+
+def test_fill_diagonal_ops():
+    x = np.zeros((3, 3), np.float32)
+    out = call("fill_diagonal", t(x), 5.0).numpy()
+    np.testing.assert_allclose(out, np.eye(3) * 5.0)
+    y = np.arange(3).astype(np.float32)
+    out2 = call("fill_diagonal_tensor", t(x), t(y)).numpy()
+    np.testing.assert_allclose(np.diag(out2), y)
+
+
+def test_sequence_mask():
+    out = call("sequence_mask", t(np.array([1, 3, 2])), maxlen=4).numpy()
+    expect = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_gather_tree():
+    # T=3, B=1, beam=2: beams point at parents; final walk re-threads ids
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    out = call("gather_tree", t(ids), t(parents)).numpy()
+    # beam 0 at t=2 has parent 1 -> path follows beam1 at t<=1
+    assert out.shape == (3, 1, 2)
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 0]], np.int64)
+    hl = np.array([3], np.int64)
+    rl = np.array([3], np.int64)
+    d = call("edit_distance", t(hyp), t(ref), t(hl), t(rl),
+             normalized=False).numpy()
+    np.testing.assert_allclose(d, [1.0])
+    dn = call("edit_distance", t(hyp), t(ref), t(hl), t(rl),
+              normalized=True).numpy()
+    np.testing.assert_allclose(dn, [1.0 / 3.0], rtol=1e-6)
+
+
+def test_loss_adapters():
+    rng = np.random.RandomState(1)
+    x = rng.rand(4, 3).astype(np.float32)
+    lab = (rng.rand(4, 3) > 0.5).astype(np.float32)
+    out = call("sigmoid_cross_entropy_with_logits", t(x), t(lab)).numpy()
+    expect = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    h = call("huber_loss", t(x), t(lab), delta=1.0).numpy()
+    d = x - lab
+    expect_h = np.where(np.abs(d) <= 1, 0.5 * d * d,
+                        np.abs(d) - 0.5)
+    np.testing.assert_allclose(h, expect_h, rtol=1e-5)
+    i = call("identity_loss", t(x), "mean").numpy()
+    np.testing.assert_allclose(i, x.mean(), rtol=1e-6)
+
+
+def test_fused_softmax_mask_upper_triangle():
+    x = np.random.RandomState(2).randn(1, 1, 4, 4).astype(np.float32)
+    out = call("fused_softmax_mask_upper_triangle", t(x)).numpy()
+    # each row sums to 1 and masked (upper) entries are 0
+    np.testing.assert_allclose(out.sum(-1), np.ones((1, 1, 4)),
+                               rtol=1e-5)
+    assert out[0, 0, 0, 1] == 0.0 and out[0, 0, 0, 0] == 1.0
+
+
+def test_pool_and_interp_adapters():
+    x = np.random.RandomState(3).rand(1, 2, 8, 8).astype(np.float32)
+    mx = call("pool2d", t(x), 2, pooling_type="max").numpy()
+    av = call("pool2d", t(x), 2, pooling_type="avg").numpy()
+    assert mx.shape == (1, 2, 4, 4) and av.shape == (1, 2, 4, 4)
+    assert (mx >= av - 1e-6).all()
+    out, idx = call("max_pool2d_with_index", t(x), 2)
+    assert out.shape == [1, 2, 4, 4] and idx.shape == [1, 2, 4, 4]
+    up = call("bilinear_interp", t(x), size=[16, 16]).numpy()
+    assert up.shape == (1, 2, 16, 16)
+    x3 = np.random.RandomState(4).rand(1, 1, 4, 4, 4).astype(np.float32)
+    p3 = call("pool3d", t(x3), 2, pooling_type="avg").numpy()
+    assert p3.shape == (1, 1, 2, 2, 2)
+
+
+def test_conv_adapters():
+    x = np.random.RandomState(5).rand(1, 4, 8, 8).astype(np.float32)
+    w = np.random.RandomState(6).rand(4, 1, 3, 3).astype(np.float32)
+    out = call("depthwise_conv2d", t(x), t(w), padding=1).numpy()
+    assert out.shape == (1, 4, 8, 8)
+    # depthwise == grouped conv2d with groups=C
+    ref = call("conv2d", t(x), t(w), None, 1, 1, 1, 4).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fc_and_shape_and_fill():
+    x = np.random.RandomState(7).rand(2, 3, 4).astype(np.float32)
+    w = np.random.RandomState(8).rand(12, 5).astype(np.float32)
+    out = call("fc", t(x), t(w), in_num_col_dims=1)
+    assert out.shape == [2, 5]
+    shp = call("shape", t(x)).numpy()
+    np.testing.assert_array_equal(shp, [2, 3, 4])
+    f = call("fill", t(x), 2.5).numpy()
+    assert (f == 2.5).all()
+    fb = call("full_batch_size_like", t(x), [1, 7], "float32", 3.0)
+    assert fb.shape == [2, 7] and (fb.numpy() == 3.0).all()
+
+
+def test_set_value_op():
+    x = np.zeros((4, 4), np.float32)
+    out = call("set_value", t(x), starts=[1], ends=[3], steps=[1],
+               axes=[0], values=7.0).numpy()
+    assert (out[1:3] == 7.0).all() and (out[0] == 0).all()
+    y = np.ones((2, 4), np.float32) * 2
+    out2 = call("set_value_with_tensor", t(x), t(y), starts=[1],
+                ends=[3], steps=[1], axes=[0]).numpy()
+    assert (out2[1:3] == 2.0).all()
+
+
+def test_random_surface_ops():
+    g = call("gaussian", [1000], mean=1.0, std=2.0)
+    assert abs(float(np.mean(g.numpy())) - 1.0) < 0.3
+    tg = call("truncated_gaussian_random", [2000], std=1.0)
+    assert np.abs(tg.numpy()).max() <= 2.0 + 1e-5
+    al = np.array([2.0, 5.0], np.float32)
+    gm = call("standard_gamma", t(al))
+    assert gm.shape == [2] and (gm.numpy() > 0).all()
+    dr = call("dirichlet", t(np.array([[1.0, 1.0, 1.0]], np.float32)))
+    np.testing.assert_allclose(dr.numpy().sum(-1), [1.0], rtol=1e-5)
+    bn = call("binomial", t(np.array([10.0], np.float32)),
+              t(np.array([0.5], np.float32)))
+    assert 0 <= int(bn.numpy()[0]) <= 10
+
+
+def test_auc_op():
+    pred = np.array([[0.9], [0.1], [0.8], [0.2]], np.float32)
+    lab = np.array([[1], [0], [1], [0]], np.int64)
+    pos = np.zeros((1, 4096), np.int64)
+    neg = np.zeros((1, 4096), np.int64)
+    a, p2, n2 = call("auc", t(pred), t(lab), t(pos), t(neg))
+    np.testing.assert_allclose(float(a.numpy()), 1.0, atol=1e-3)
+
+
+def test_spectral_norm_op():
+    rng = np.random.RandomState(9)
+    w = rng.randn(4, 6).astype(np.float32)
+    u = rng.randn(4).astype(np.float32)
+    v = rng.randn(6).astype(np.float32)
+    out = call("spectral_norm", t(w), t(u), t(v), power_iters=50).numpy()
+    # largest singular value of the output ~ 1
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-2)
+
+
+def test_weight_quant_ops():
+    rng = np.random.RandomState(10)
+    w = rng.randn(16, 8).astype(np.float32)
+    q, scale = call("weight_quantize", t(w))
+    assert q.numpy().dtype == np.int8
+    deq = call("weight_dequantize", q, scale).numpy()
+    np.testing.assert_allclose(deq, w, atol=np.abs(w).max() / 100)
+    x = rng.randn(2, 16).astype(np.float32)
+    out = call("weight_only_linear", t(x), q, weight_scale=scale).numpy()
+    np.testing.assert_allclose(out, x @ w, rtol=0.05, atol=0.05)
+
+
+def test_embedding_grad_dense():
+    ids = np.array([[0, 1], [1, 2]], np.int64)
+    w = np.zeros((4, 3), np.float32)
+    g = np.ones((2, 2, 3), np.float32)
+    out = call("embedding_grad_dense", t(ids), t(w), t(g)).numpy()
+    np.testing.assert_allclose(out[:, 0], [1.0, 2.0, 1.0, 0.0])
+
+
+def test_c_embedding():
+    w = np.arange(12).reshape(4, 3).astype(np.float32)
+    ids = np.array([[2, 5], [7, 3]], np.int64)
+    out = call("c_embedding", t(w), t(ids), start_index=2).numpy()
+    # ids 2..5 map to local rows 0..3; id 7 outside -> zeros
+    np.testing.assert_allclose(out[0, 0], w[0])
+    np.testing.assert_allclose(out[0, 1], w[3])
+    np.testing.assert_allclose(out[1, 0], 0.0)
+
+
+def test_signal_and_views():
+    x = np.arange(8).astype(np.float32)
+    fr = call("frame", t(x), frame_length=4, hop_length=2)
+    assert 4 in fr.shape
+    v = call("view_shape", t(x), [2, 4])
+    assert v.shape == [2, 4]
+    vd = call("view_dtype", t(x), "int32")
+    assert vd.numpy().dtype == np.int32
+    tr = call("trans_layout", t(x.reshape(2, 4)), [1, 0])
+    assert tr.shape == [4, 2]
+
+
+def test_check_numerics_and_flags():
+    has_nan, has_inf = call("check_numerics",
+                            t(np.array([1.0, np.nan], np.float32)))
+    assert bool(has_nan.numpy()) and not bool(has_inf.numpy())
+    call("enable_check_model_nan_inf", 1)
+    from paddle_tpu.core.flags import get_flags
+    assert get_flags(["check_nan_inf"])["check_nan_inf"]
+    call("disable_check_model_nan_inf")
+    assert not get_flags(["check_nan_inf"])["check_nan_inf"]
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops vs torch-style numpy references
+# ---------------------------------------------------------------------------
+def test_sgd_and_momentum():
+    p = t(np.array([1.0, 2.0], np.float32))
+    g = t(np.array([0.5, 0.5], np.float32))
+    call("sgd_", p, t(np.float32(0.1)), g)
+    np.testing.assert_allclose(p.numpy(), [0.95, 1.95], rtol=1e-6)
+
+    p = t(np.array([1.0], np.float32))
+    v = t(np.array([0.0], np.float32))
+    call("momentum_", p, t(np.array([1.0], np.float32)), v,
+         t(np.float32(0.1)), mu=0.9)
+    np.testing.assert_allclose(v.numpy(), [1.0])
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(11)
+    p0 = rng.randn(5).astype(np.float32)
+    g0 = rng.randn(5).astype(np.float32)
+    p = t(p0.copy())
+    m1 = t(np.zeros(5, np.float32))
+    m2 = t(np.zeros(5, np.float32))
+    b1 = t(np.float32(1.0))
+    b2 = t(np.float32(1.0))
+    call("adam_", p, t(g0), t(np.float32(0.01)), m1, m2, b1, b2)
+    # one adam step from zero moments
+    m1n = 0.1 * g0
+    m2n = 0.001 * g0 * g0
+    mhat = m1n / (1 - 0.9)
+    vhat = m2n / (1 - 0.999)
+    expect = p0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), expect, rtol=1e-4, atol=1e-6)
+
+
+def test_adamw_decay_and_lamb_trust():
+    p = t(np.ones(3, np.float32))
+    m1 = t(np.zeros(3, np.float32))
+    m2 = t(np.zeros(3, np.float32))
+    b1 = t(np.float32(1.0)); b2 = t(np.float32(1.0))
+    call("adamw_", p, t(np.zeros(3, np.float32)), t(np.float32(0.1)),
+         m1, m2, b1, b2, coeff=0.5)
+    # zero grad: only decoupled decay applies
+    np.testing.assert_allclose(p.numpy(), [0.95] * 3, rtol=1e-6)
+
+    p = t(np.ones(3, np.float32) * 2)
+    m1 = t(np.zeros(3, np.float32)); m2 = t(np.zeros(3, np.float32))
+    b1 = t(np.float32(1.0)); b2 = t(np.float32(1.0))
+    out = call("lamb_", p, t(np.ones(3, np.float32)),
+               t(np.float32(0.1)), m1, m2, b1, b2, weight_decay=0.0)
+    assert np.isfinite(p.numpy()).all()
+
+
+def test_rmsprop_adagrad_adadelta_adamax_rprop():
+    for name, extra in [
+        ("adagrad_", lambda p, g: (p, g, t(np.zeros(2, np.float32)),
+                                   t(np.float32(0.1)))),
+    ]:
+        pass
+    p = t(np.ones(2, np.float32))
+    g = t(np.ones(2, np.float32))
+    call("adagrad_", p, g, t(np.zeros(2, np.float32)),
+         t(np.float32(0.1)))
+    np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 1 / (1 + 1e-6),
+                               rtol=1e-4)
+
+    p = t(np.ones(2, np.float32))
+    ms = t(np.zeros(2, np.float32))
+    mom = t(np.zeros(2, np.float32))
+    call("rmsprop_", p, ms, g, mom, t(np.float32(0.1)))
+    assert (p.numpy() < 1).all()
+
+    p = t(np.ones(2, np.float32))
+    call("adadelta_", p, g, t(np.zeros(2, np.float32)),
+         t(np.zeros(2, np.float32)), t(np.float32(1.0)))
+    assert (p.numpy() < 1).all()
+
+    p = t(np.ones(2, np.float32))
+    # beta1_pow holds beta1^t (t>=1): 1.0 would mean step 0 (div by 0)
+    call("adamax_", p, g, t(np.float32(0.1)),
+         t(np.zeros(2, np.float32)), t(np.zeros(2, np.float32)),
+         t(np.float32(0.9)))
+    assert np.isfinite(p.numpy()).all() and (p.numpy() < 1).all()
+
+    p = t(np.ones(2, np.float32))
+    call("rprop_", p, g, t(np.ones(2, np.float32)),
+         t(np.full(2, 0.1, np.float32)))
+    assert np.isfinite(p.numpy()).all()
+
+
+def test_merged_and_fused_optimizer_ops():
+    ps = [t(np.ones(2, np.float32)), t(np.ones(3, np.float32))]
+    gs = [t(np.ones(2, np.float32)), t(np.ones(3, np.float32))]
+    m1 = [t(np.zeros(2, np.float32)), t(np.zeros(3, np.float32))]
+    m2 = [t(np.zeros(2, np.float32)), t(np.zeros(3, np.float32))]
+    b1 = [t(np.float32(1.0)), t(np.float32(1.0))]
+    b2 = [t(np.float32(1.0)), t(np.float32(1.0))]
+    call("merged_adam_", ps, gs, t(np.float32(0.01)), m1, m2, b1, b2)
+    for p in ps:
+        assert (p.numpy() < 1).all()
+    vs = [t(np.zeros(2, np.float32)), t(np.zeros(3, np.float32))]
+    call("merged_momentum_", ps, gs, vs, t(np.float32(0.01)))
+    call("fused_adam_", ps, gs, t(np.float32(0.01)), m1, m2, b1, b2,
+         use_adamw=True, weight_decay=0.01)
+    for p in ps:
+        assert np.isfinite(p.numpy()).all()
+
+
+def test_amp_bookkeeping_ops():
+    xs = [t(np.array([2.0, 4.0], np.float32))]
+    scale = t(np.float32(2.0))
+    outs, found = call("check_finite_and_unscale_", xs, scale)
+    np.testing.assert_allclose(xs[0].numpy(), [1.0, 2.0])
+    assert not bool(found.numpy())
+    xs = [t(np.array([np.inf], np.float32))]
+    _, found = call("check_finite_and_unscale_", xs, scale)
+    assert bool(found.numpy())
+
+    ls = t(np.float32(1024.0))
+    good = t(np.int32(0)); bad = t(np.int32(1))
+    call("update_loss_scaling_", [t(np.ones(2, np.float32))],
+         t(np.asarray(True)), ls, good, bad,
+         decr_every_n_nan_or_inf=2)
+    np.testing.assert_allclose(ls.numpy(), 512.0)  # bad hits threshold
+
+
+def test_average_accumulates():
+    p = t(np.ones(3, np.float32))
+    s1 = t(np.zeros(3, np.float32))
+    s2 = t(np.zeros(3, np.float32))
+    s3 = t(np.zeros(3, np.float32))
+    na = t(np.int64(0)); ona = t(np.int64(0)); nu = t(np.int64(0))
+    call("average_accumulates_", p, s1, s2, s3, na, ona, nu,
+         average_window=4, max_average_window=100, min_average_window=2)
+    np.testing.assert_allclose(s1.numpy(), [1.0, 1.0, 1.0])

@@ -1,0 +1,86 @@
+"""A/B rope handling in the flash backward at the bench shape.
+Variants: in-kernel rope (prod), no rope (floor), XLA pre-rope + plain
+kernel + XLA inverse.  Chained N-vs-2N differencing.
+"""
+import os
+import sys
+import time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import pallas_kernels as pk
+
+B, H, S, D = 8, 16, 2048, 64
+rng = np.random.RandomState(0)
+q0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+k0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+v0 = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+cos, sin = pk.rope_tables(S, D)
+
+flops_bwd_tot = 2 * 2 * S * S * D * B * H * 0.5 * 3.5
+
+
+def chain_time(stepfn, n=24):
+    f = jax.jit(stepfn)
+    r = f(q0, k0, v0)
+    np.asarray(r[0][0, 0, 0])
+
+    def run(m):
+        t0 = time.perf_counter()
+        a = (q0, k0, v0)
+        for _ in range(m):
+            a = f(*a)
+        np.asarray(a[0][0, 0, 0])
+        return time.perf_counter() - t0
+    d1, d2 = run(n), run(2 * n)
+    return (d2 - d1) / n
+
+
+def report(name, dt):
+    print(f"{name:34s} {dt*1e3:8.2f} ms "
+          f"({flops_bwd_tot/dt/197e12*100:4.1f}% peak)", flush=True)
+
+
+def norope_step(q, k, v):
+    out, lse = pk._flash_attention_value(q, k, v, True, 512, 512,
+                                         with_lse=True)
+    return pk._flash_attention_bwd_fused(q, k, v, out, lse, out, True,
+                                         256, 1024)
+
+
+def kernelrope_step(q, k, v):
+    out, lse = pk._flash_attention_value(q, k, v, True, 512, 512,
+                                         with_lse=True, rope=(cos, sin))
+    return pk._flash_attention_bwd_fused(q, k, v, out, lse, out, True,
+                                         256, 1024, rope=(cos, sin))
+
+
+def xlarope_step(q, k, v):
+    qr = pk._rope_xla(q, cos, sin)
+    kr = pk._rope_xla(k, cos, sin)
+    out, lse = pk._flash_attention_value(qr, kr, v, True, 512, 512,
+                                         with_lse=True)
+    dqr, dkr, dv = pk._flash_attention_bwd_fused(qr, kr, v, out, lse, out,
+                                                 True, 256, 1024)
+    # inverse rotation (linear): rope with negated sin
+    dq = pk._rope_xla(dqr, cos, -sin).astype(q.dtype)
+    dk = pk._rope_xla(dkr, cos, -sin).astype(k.dtype)
+    return dq, dk, dv
+
+
+report("fwd+bwd no rope", chain_time(norope_step))
+report("fwd+bwd in-kernel rope (prod)", chain_time(kernelrope_step))
+report("fwd+bwd xla pre-rope", chain_time(xlarope_step))
+
+# fwd-only with and without rope
+def fwd_nr(q, k, v):
+    return pk._flash_attention_value(q, k, v, True, 512, 512), k, v
+
+def fwd_r(q, k, v):
+    return pk._flash_attention_value(q, k, v, True, 512, 512,
+                                     rope=(cos, sin)), k, v
+
+report("fwd only no rope", chain_time(lambda q, k, v: fwd_nr(q, k, v)))
+report("fwd only in-kernel rope", chain_time(lambda q, k, v: fwd_r(q, k, v)))

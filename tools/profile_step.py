@@ -1,0 +1,88 @@
+"""Profile the bench train step and print per-op self-times (hlo_stats).
+Run from /root/repo: python tools/profile_step.py
+"""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaForCausalLM, LlamaConfig,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.train_step import TrainStep
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+        dtype="bfloat16")
+    batch, seq = 8, 2048
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                     clip_norm=1.0)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    loss = step(ids, labels)            # compile + run
+    np.asarray(loss._value)
+
+    tracedir = "/tmp/xprof_step"
+    with jax.profiler.trace(tracedir):
+        loss = step(ids, labels)
+        loss = step(ids, labels)
+        np.asarray(loss._value)
+
+    # parse
+    import glob
+    from xprof.convert import raw_to_tool_data
+    xs = glob.glob(tracedir + "/**/*.xplane.pb", recursive=True)
+    data, _ = raw_to_tool_data.xspace_to_tool_data(xs, "hlo_stats", {})
+    import json
+    rows = json.loads(data) if isinstance(data, (str, bytes)) else data
+    print(type(rows))
+    # hlo_stats returns a json table; normalize and aggregate by category
+    if isinstance(rows, dict):
+        cols = [c["name"] if isinstance(c, dict) else c
+                for c in rows.get("cols", [])]
+        print(cols)
+        out = []
+        for r in rows.get("rows", []):
+            vals = [c.get("v") if isinstance(c, dict) else c
+                    for c in r.get("c", [])]
+            out.append(dict(zip(cols, vals)))
+        out.sort(key=lambda d: -(d.get("total_self_time_us") or
+                                 d.get("Total self time (us)") or 0))
+        agg = {}
+        tkey = None
+        for d in out[:1]:
+            for k in d:
+                if "self" in str(k).lower() and "us" in str(k).lower():
+                    tkey = k
+        for d in out:
+            cat = d.get("hlo_category") or d.get("HLO Category") or "?"
+            agg[cat] = agg.get(cat, 0) + (d.get(tkey) or 0)
+        print("=== by category (us, 2 steps) ===")
+        for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
+            print(f"{k:40s} {v/2:10.0f}")
+        print("=== top 25 ops ===")
+        for d in out[:25]:
+            nm = (d.get("hlo_op_name") or d.get("HLO Op Name") or
+                  d.get("hlo_op_expression") or "?")
+            print(f"{str(nm)[:90]:92s} {(d.get(tkey) or 0)/2:9.0f}")
+
+
+if __name__ == "__main__":
+    main()
