@@ -12,8 +12,15 @@ Design (trace-by-execution — see package docstring):
   calls) is interpreted opcode-by-opcode.
 - `scan_code` statically whitelists the opcode set BEFORE execution, so
   the interpreter never aborts mid-frame (side effects run exactly once).
-  Frames using unsupported constructs (try/except, with, generators,
-  match, imports) are skipped — run eagerly, never traced.
+  try/except/finally, `with`, `raise` and imports are interpreted
+  natively: exceptions unwind through the CPython-3.12 exception table
+  (co_exceptiontable) exactly like the real frame would, so a traced
+  function containing a `with autocast()` or try/except body still
+  produces a compiled region — real-value execution makes the
+  reference's resume-function machinery unnecessary (the handler simply
+  keeps executing).  Generator *calls* run natively (their tensor work
+  is still recorded at dispatch); only frames that ARE generators — and
+  `match` statements — are skipped wholesale.
 - Dynamic graph breaks (a jump conditioned on a Tensor, iteration over a
   non-tensor iterator of unknown purity, etc.) do NOT stop execution: the
   interpreter poisons the Recorder and keeps evaluating with concrete
@@ -39,6 +46,12 @@ import numpy as np
 
 class GraphBreakReason(Exception):
     """Raised only by scan_code users — never escapes run()."""
+
+
+class InterpreterInternalError(BaseException):
+    """Interpreter bug / unsupported construct.  Derives from
+    BaseException so user-level ``except Exception`` handlers inside the
+    interpreted frame can never swallow it."""
 
 
 class _NullType:
@@ -78,6 +91,10 @@ SUPPORTED_OPS = frozenset([
     "LIST_EXTEND", "LIST_APPEND", "SET_ADD", "SET_UPDATE", "MAP_ADD",
     "DICT_MERGE", "DICT_UPDATE", "FORMAT_VALUE",
     "BINARY_SUBSCR", "STORE_SUBSCR", "DELETE_SUBSCR",
+    "PUSH_EXC_INFO", "POP_EXCEPT", "RERAISE", "CHECK_EXC_MATCH",
+    "RAISE_VARARGS", "LOAD_ASSERTION_ERROR",
+    "BEFORE_WITH", "WITH_EXCEPT_START",
+    "IMPORT_NAME", "IMPORT_FROM",
     "BINARY_SLICE", "STORE_SLICE",
     "UNPACK_SEQUENCE", "UNPACK_EX",
     "CALL", "KW_NAMES", "CALL_FUNCTION_EX", "CALL_INTRINSIC_1",
@@ -116,11 +133,18 @@ _COMPARE_OPS = {
 }
 
 
+_HAS_EXC_TABLE_PARSER = hasattr(dis, "_parse_exception_table")
+
+
 def scan_code(code: types.CodeType) -> Optional[str]:
     """Return None if the interpreter fully supports this code object,
     else a human-readable reason (→ skip frame, run eagerly)."""
     if code.co_flags & (_CO_GENERATOR | _CO_COROUTINE | _CO_ASYNC_GENERATOR):
         return "generator/coroutine"
+    if code.co_exceptiontable and not _HAS_EXC_TABLE_PARSER:
+        # without the table the handlers can't run — skipping the frame
+        # is correct; silently ignoring the table would NOT be
+        return "exception table parser unavailable"
     for ins in dis.get_instructions(code):
         if ins.opname not in SUPPORTED_OPS:
             return f"unsupported opcode {ins.opname}"
@@ -140,9 +164,15 @@ def _is_tensor(v) -> bool:
 class OpcodeExecutor:
     """Interprets one frame (and inlined user callees) with real values."""
 
-    def __init__(self, recorder, depth: int = 0):
+    def __init__(self, recorder, depth: int = 0, exc_cell=None):
         self.recorder = recorder
         self.depth = depth
+        # the "current exception" is per-TRACE, not per-frame (CPython
+        # keeps it in the thread state): a bare `raise` in an inlined
+        # callee re-raises the caller's handled exception.  The
+        # PUSH_EXC_INFO / POP_EXCEPT save-restore discipline keeps
+        # nesting correct over this single shared cell.
+        self.exc_cell = exc_cell if exc_cell is not None else [None]
 
     # -- inlining decision ---------------------------------------------------
     def _inlinable(self, fn) -> bool:
@@ -196,6 +226,14 @@ class OpcodeExecutor:
     def _run_code(self, code, f_locals, f_globals, closure, builtins_ns):
         instructions = list(dis.get_instructions(code))
         by_offset = {ins.offset: i for i, ins in enumerate(instructions)}
+        # CPython-3.12 zero-cost exception handling: the compiled
+        # exception table maps instruction ranges to (handler, stack
+        # depth, push-lasti); unwinding replays exactly those semantics.
+        # scan_code rejects try/except frames when the parser is
+        # unavailable, so a non-empty table always parses here.
+        exc_table = dis._parse_exception_table(code) \
+            if code.co_exceptiontable else []
+        current_exc = self.exc_cell
         stack: List[Any] = []
         # cells: co_cellvars are fresh cells (MAKE_CELL initializes them,
         # possibly from a local); co_freevars come from the closure
@@ -217,338 +255,429 @@ class OpcodeExecutor:
             op = ins.opname
             arg = ins.arg
 
-            if op in ("RESUME", "CACHE", "NOP", "EXTENDED_ARG", "PRECALL",
-                      "MAKE_CELL", "COPY_FREE_VARS"):
-                if op == "MAKE_CELL":
-                    name = ins.argval
-                    cells[name] = types.CellType(f_locals[name]) \
-                        if name in f_locals else types.CellType()
-                ip += 1
-                continue
+            try:
+                if op in ("RESUME", "CACHE", "NOP", "EXTENDED_ARG", "PRECALL",
+                          "MAKE_CELL", "COPY_FREE_VARS"):
+                    if op == "MAKE_CELL":
+                        name = ins.argval
+                        cells[name] = types.CellType(f_locals[name]) \
+                            if name in f_locals else types.CellType()
+                    ip += 1
+                    continue
 
-            if op == "POP_TOP":
-                stack.pop()
-            elif op == "COPY":
-                stack.append(stack[-arg])
-            elif op == "SWAP":
-                stack[-1], stack[-arg] = stack[-arg], stack[-1]
-            elif op == "PUSH_NULL":
-                stack.append(NULL)
-
-            elif op == "LOAD_CONST":
-                stack.append(ins.argval)
-            elif op == "RETURN_CONST":
-                return ins.argval
-            elif op == "RETURN_VALUE":
-                return stack.pop()
-
-            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
-                stack.append(f_locals[ins.argval])
-            elif op == "LOAD_FAST_AND_CLEAR":
-                stack.append(f_locals.pop(ins.argval, None))
-            elif op == "STORE_FAST":
-                f_locals[ins.argval] = stack.pop()
-            elif op == "DELETE_FAST":
-                del f_locals[ins.argval]
-
-            elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
-                if op == "LOAD_GLOBAL" and arg & 1:
+                if op == "POP_TOP":
+                    stack.pop()
+                elif op == "COPY":
+                    stack.append(stack[-arg])
+                elif op == "SWAP":
+                    stack[-1], stack[-arg] = stack[-arg], stack[-1]
+                elif op == "PUSH_NULL":
                     stack.append(NULL)
-                name = ins.argval
-                if name in f_globals:
-                    val = f_globals[name]
-                    self._guard_env("global", name, val)
-                elif name in builtins_dict:
-                    val = builtins_dict[name]
-                else:
-                    raise NameError(f"name '{name}' is not defined")
-                stack.append(val)
 
-            elif op in ("LOAD_DEREF", "LOAD_CLOSURE"):
-                name = ins.argval
-                if op == "LOAD_CLOSURE":
-                    stack.append(cells[name])
-                else:
-                    val = cells[name].cell_contents
-                    self._guard_env("deref", name, val)
-                    stack.append(val)
-            elif op == "STORE_DEREF":
-                name = ins.argval
-                if name not in cells:
-                    cells[name] = types.CellType()
-                cells[name].cell_contents = stack.pop()
+                elif op == "LOAD_CONST":
+                    stack.append(ins.argval)
+                elif op == "RETURN_CONST":
+                    return ins.argval
+                elif op == "RETURN_VALUE":
+                    return stack.pop()
 
-            elif op == "LOAD_ATTR":
-                owner = stack.pop()
-                name = ins.argval
-                if arg & 1:
-                    # method form: push (unbound, self) or (NULL, attr)
-                    attr = getattr(owner, name)
-                    if isinstance(attr, types.MethodType) \
-                            and attr.__self__ is owner:
-                        stack.append(attr.__func__)
-                        stack.append(owner)
-                    else:
+                elif op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
+                    if ins.argval not in f_locals:
+                        # the exception CPython raises — not the
+                        # machinery's KeyError, which a user handler
+                        # could wrongly catch
+                        raise UnboundLocalError(
+                            f"cannot access local variable "
+                            f"'{ins.argval}' where it is not "
+                            f"associated with a value")
+                    stack.append(f_locals[ins.argval])
+                elif op == "LOAD_FAST_AND_CLEAR":
+                    stack.append(f_locals.pop(ins.argval, None))
+                elif op == "STORE_FAST":
+                    f_locals[ins.argval] = stack.pop()
+                elif op == "DELETE_FAST":
+                    if ins.argval not in f_locals:
+                        raise UnboundLocalError(
+                            f"cannot access local variable "
+                            f"'{ins.argval}' where it is not "
+                            f"associated with a value")
+                    del f_locals[ins.argval]
+
+                elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+                    if op == "LOAD_GLOBAL" and arg & 1:
                         stack.append(NULL)
-                        stack.append(attr)
-                else:
-                    stack.append(getattr(owner, name))
-            elif op == "STORE_ATTR":
-                owner = stack.pop()
-                val = stack.pop()
-                setattr(owner, ins.argval, val)
+                    name = ins.argval
+                    if name in f_globals:
+                        val = f_globals[name]
+                        self._guard_env("global", name, val)
+                    elif name in builtins_dict:
+                        val = builtins_dict[name]
+                    else:
+                        raise NameError(f"name '{name}' is not defined")
+                    stack.append(val)
 
-            elif op == "BINARY_OP":
-                rhs = stack.pop()
-                lhs = stack.pop()
-                fn = _BINARY_OPS.get(ins.argrepr)
-                if fn is None:
-                    raise RuntimeError(f"BINARY_OP {ins.argrepr}")
-                stack.append(fn(lhs, rhs))
-            elif op == "UNARY_NEGATIVE":
-                stack.append(-stack.pop())
-            elif op == "UNARY_NOT":
-                v = stack.pop()
-                if _is_tensor(v):
-                    rec.poison("`not` on a tensor value")
-                stack.append(not v)
-            elif op == "UNARY_INVERT":
-                stack.append(~stack.pop())
+                elif op in ("LOAD_DEREF", "LOAD_CLOSURE"):
+                    name = ins.argval
+                    if op == "LOAD_CLOSURE":
+                        stack.append(cells[name])
+                    else:
+                        val = cells[name].cell_contents
+                        self._guard_env("deref", name, val)
+                        stack.append(val)
+                elif op == "STORE_DEREF":
+                    name = ins.argval
+                    if name not in cells:
+                        cells[name] = types.CellType()
+                    cells[name].cell_contents = stack.pop()
 
-            elif op == "COMPARE_OP":
-                rhs = stack.pop()
-                lhs = stack.pop()
-                fn = _COMPARE_OPS.get(ins.argrepr.strip())
-                if fn is None:
-                    raise RuntimeError(f"COMPARE_OP {ins.argrepr}")
-                stack.append(fn(lhs, rhs))
-            elif op == "IS_OP":
-                rhs = stack.pop()
-                lhs = stack.pop()
-                stack.append((lhs is not rhs) if arg else (lhs is rhs))
-            elif op == "CONTAINS_OP":
-                rhs = stack.pop()
-                lhs = stack.pop()
-                if _is_tensor(rhs) or _is_tensor(lhs):
-                    rec.poison("`in` on a tensor value")
-                res = lhs in rhs
-                stack.append((not res) if arg else res)
+                elif op == "LOAD_ATTR":
+                    owner = stack.pop()
+                    name = ins.argval
+                    if arg & 1:
+                        # method form: push (unbound, self) or (NULL, attr)
+                        attr = getattr(owner, name)
+                        if isinstance(attr, types.MethodType) \
+                                and attr.__self__ is owner:
+                            stack.append(attr.__func__)
+                            stack.append(owner)
+                        else:
+                            stack.append(NULL)
+                            stack.append(attr)
+                    else:
+                        stack.append(getattr(owner, name))
+                elif op == "STORE_ATTR":
+                    owner = stack.pop()
+                    val = stack.pop()
+                    setattr(owner, ins.argval, val)
 
-            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
-                v = stack.pop()
-                if _is_tensor(v):
-                    rec.poison("data-dependent branch on tensor value")
-                truth = bool(v)
-                want = (op == "POP_JUMP_IF_TRUE")
-                if truth == want:
+                elif op == "BINARY_OP":
+                    rhs = stack.pop()
+                    lhs = stack.pop()
+                    fn = _BINARY_OPS.get(ins.argrepr)
+                    if fn is None:
+                        raise InterpreterInternalError(
+                        f"BINARY_OP {ins.argrepr}")
+                    stack.append(fn(lhs, rhs))
+                elif op == "UNARY_NEGATIVE":
+                    stack.append(-stack.pop())
+                elif op == "UNARY_NOT":
+                    v = stack.pop()
+                    if _is_tensor(v):
+                        rec.poison("`not` on a tensor value")
+                    stack.append(not v)
+                elif op == "UNARY_INVERT":
+                    stack.append(~stack.pop())
+
+                elif op == "COMPARE_OP":
+                    rhs = stack.pop()
+                    lhs = stack.pop()
+                    fn = _COMPARE_OPS.get(ins.argrepr.strip())
+                    if fn is None:
+                        raise InterpreterInternalError(
+                        f"COMPARE_OP {ins.argrepr}")
+                    stack.append(fn(lhs, rhs))
+                elif op == "IS_OP":
+                    rhs = stack.pop()
+                    lhs = stack.pop()
+                    stack.append((lhs is not rhs) if arg else (lhs is rhs))
+                elif op == "CONTAINS_OP":
+                    rhs = stack.pop()
+                    lhs = stack.pop()
+                    if _is_tensor(rhs) or _is_tensor(lhs):
+                        rec.poison("`in` on a tensor value")
+                    res = lhs in rhs
+                    stack.append((not res) if arg else res)
+
+                elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                    v = stack.pop()
+                    if _is_tensor(v):
+                        rec.poison("data-dependent branch on tensor value")
+                    truth = bool(v)
+                    want = (op == "POP_JUMP_IF_TRUE")
+                    if truth == want:
+                        ip = by_offset[ins.argval]
+                        continue
+                elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                    v = stack.pop()
+                    is_none = v is None
+                    want = (op == "POP_JUMP_IF_NONE")
+                    if is_none == want:
+                        ip = by_offset[ins.argval]
+                        continue
+                elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
+                            "JUMP_BACKWARD_NO_INTERRUPT"):
                     ip = by_offset[ins.argval]
                     continue
-            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
-                v = stack.pop()
-                is_none = v is None
-                want = (op == "POP_JUMP_IF_NONE")
-                if is_none == want:
-                    ip = by_offset[ins.argval]
-                    continue
-            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
-                        "JUMP_BACKWARD_NO_INTERRUPT"):
-                ip = by_offset[ins.argval]
+
+                elif op == "GET_ITER":
+                    v = stack.pop()
+                    stack.append(iter(v))
+                elif op == "FOR_ITER":
+                    it = stack[-1]
+                    try:
+                        stack.append(next(it))
+                    except StopIteration:
+                        # 3.12: leave iterator; push exhaustion marker; jump
+                        # to the END_FOR at the target, which pops both
+                        stack.append(None)
+                        ip = by_offset[ins.argval]
+                        continue
+                elif op == "END_FOR":
+                    stack.pop()
+                    stack.pop()
+
+                elif op == "BUILD_TUPLE":
+                    vals = stack[len(stack) - arg:] if arg else []
+                    del stack[len(stack) - arg:]
+                    stack.append(tuple(vals))
+                elif op == "BUILD_LIST":
+                    vals = stack[len(stack) - arg:] if arg else []
+                    del stack[len(stack) - arg:]
+                    stack.append(list(vals))
+                elif op == "BUILD_SET":
+                    vals = stack[len(stack) - arg:] if arg else []
+                    del stack[len(stack) - arg:]
+                    stack.append(set(vals))
+                elif op == "BUILD_MAP":
+                    items = stack[len(stack) - 2 * arg:] if arg else []
+                    del stack[len(stack) - 2 * arg:]
+                    stack.append({items[i]: items[i + 1]
+                                  for i in range(0, len(items), 2)})
+                elif op == "BUILD_CONST_KEY_MAP":
+                    keys = stack.pop()
+                    vals = stack[len(stack) - arg:]
+                    del stack[len(stack) - arg:]
+                    stack.append(dict(zip(keys, vals)))
+                elif op == "BUILD_SLICE":
+                    if arg == 3:
+                        step = stack.pop()
+                    else:
+                        step = None
+                    stop = stack.pop()
+                    start = stack.pop()
+                    stack.append(slice(start, stop, step))
+                elif op == "BUILD_STRING":
+                    parts = stack[len(stack) - arg:]
+                    del stack[len(stack) - arg:]
+                    stack.append("".join(parts))
+                elif op == "FORMAT_VALUE":
+                    have_spec = arg & 0x04
+                    spec = stack.pop() if have_spec else ""
+                    v = stack.pop()
+                    conv = arg & 0x03
+                    if conv == 1:
+                        v = str(v)
+                    elif conv == 2:
+                        v = repr(v)
+                    elif conv == 3:
+                        v = ascii(v)
+                    stack.append(format(v, spec))
+
+                elif op == "LIST_EXTEND":
+                    seq = stack.pop()
+                    stack[-arg].extend(seq)
+                elif op == "LIST_APPEND":
+                    v = stack.pop()
+                    stack[-arg].append(v)
+                elif op == "SET_ADD":
+                    v = stack.pop()
+                    stack[-arg].add(v)
+                elif op == "SET_UPDATE":
+                    seq = stack.pop()
+                    stack[-arg].update(seq)
+                elif op == "MAP_ADD":
+                    value = stack.pop()
+                    key_ = stack.pop()
+                    stack[-arg][key_] = value
+                elif op in ("DICT_MERGE", "DICT_UPDATE"):
+                    other = stack.pop()
+                    stack[-arg].update(other)
+
+                elif op == "BINARY_SUBSCR":
+                    idx = stack.pop()
+                    obj = stack.pop()
+                    stack.append(obj[idx])
+                elif op == "STORE_SUBSCR":
+                    idx = stack.pop()
+                    obj = stack.pop()
+                    val = stack.pop()
+                    obj[idx] = val
+                elif op == "DELETE_SUBSCR":
+                    idx = stack.pop()
+                    obj = stack.pop()
+                    del obj[idx]
+                elif op == "BINARY_SLICE":
+                    stop = stack.pop()
+                    start = stack.pop()
+                    obj = stack.pop()
+                    stack.append(obj[start:stop])
+                elif op == "STORE_SLICE":
+                    stop = stack.pop()
+                    start = stack.pop()
+                    obj = stack.pop()
+                    val = stack.pop()
+                    obj[start:stop] = val
+
+                elif op == "UNPACK_SEQUENCE":
+                    seq = stack.pop()
+                    vals = list(seq)
+                    if len(vals) != arg:
+                        raise ValueError(
+                            f"not enough values to unpack (expected {arg})")
+                    stack.extend(reversed(vals))
+                elif op == "UNPACK_EX":
+                    before = arg & 0xFF
+                    after = arg >> 8
+                    seq = list(stack.pop())
+                    rest = seq[before:len(seq) - after] \
+                        if after else seq[before:]
+                    tail = seq[len(seq) - after:] if after else []
+                    for v in reversed(tail):
+                        stack.append(v)
+                    stack.append(rest)
+                    for v in reversed(seq[:before]):
+                        stack.append(v)
+
+                elif op == "KW_NAMES":
+                    kw_names = ins.argval
+                elif op == "CALL":
+                    argc = arg
+                    call_args = stack[len(stack) - argc:] if argc else []
+                    del stack[len(stack) - argc:]
+                    self_or_null = stack.pop()
+                    callable_ = stack.pop()
+                    if callable_ is NULL:
+                        callable_ = self_or_null
+                    elif self_or_null is not NULL:
+                        call_args = [self_or_null] + call_args
+                    if kw_names:
+                        n_kw = len(kw_names)
+                        kw = dict(zip(kw_names, call_args[len(call_args) - n_kw:]))
+                        call_args = call_args[:len(call_args) - n_kw]
+                        kw_names = ()
+                    else:
+                        kw = {}
+                    stack.append(self._call(callable_, call_args, kw))
+                elif op == "CALL_FUNCTION_EX":
+                    kw = stack.pop() if arg & 1 else {}
+                    pos = list(stack.pop())
+                    self_or_null = stack.pop()
+                    callable_ = stack.pop()
+                    if callable_ is NULL:
+                        callable_ = self_or_null
+                    elif self_or_null is not NULL:
+                        pos = [self_or_null] + pos
+                    stack.append(self._call(callable_, pos, dict(kw)))
+                elif op == "CALL_INTRINSIC_1":
+                    which = ins.argrepr
+                    v = stack.pop()
+                    if which == "INTRINSIC_UNARY_POSITIVE":
+                        stack.append(+v)
+                    elif which == "INTRINSIC_LIST_TO_TUPLE":
+                        stack.append(tuple(v))
+                    else:
+                        raise InterpreterInternalError(f"intrinsic {which}")
+
+                # -- exception machinery (3.12 zero-cost scheme) --------
+                elif op == "PUSH_EXC_INFO":
+                    exc = stack.pop()
+                    stack.append(current_exc[0])
+                    stack.append(exc)
+                    current_exc[0] = exc
+                elif op == "POP_EXCEPT":
+                    current_exc[0] = stack.pop()
+                elif op == "RERAISE":
+                    # oparg != 0: a lasti slot sits below the exception;
+                    # it is NOT popped (the unwinder discards it)
+                    exc = stack.pop()
+                    raise exc
+                elif op == "CHECK_EXC_MATCH":
+                    typ = stack.pop()
+                    stack.append(isinstance(stack[-1], typ))
+                elif op == "RAISE_VARARGS":
+                    if arg == 0:
+                        if current_exc[0] is None:
+                            raise RuntimeError(
+                                "No active exception to reraise")
+                        raise current_exc[0]
+                    cause = stack.pop() if arg == 2 else None
+                    exc = stack.pop()
+                    if isinstance(exc, type):
+                        exc = exc()
+                    if arg == 2:
+                        raise exc from cause
+                    raise exc
+                elif op == "LOAD_ASSERTION_ERROR":
+                    stack.append(AssertionError)
+
+                # -- with ----------------------------------------------
+                elif op == "BEFORE_WITH":
+                    mgr = stack.pop()
+                    exit_fn = type(mgr).__exit__.__get__(mgr)
+                    enter_fn = type(mgr).__enter__
+                    stack.append(exit_fn)
+                    stack.append(enter_fn(mgr))
+                elif op == "WITH_EXCEPT_START":
+                    exc = stack[-1]
+                    exit_fn = stack[-4]
+                    stack.append(exit_fn(type(exc), exc,
+                                         exc.__traceback__))
+
+                # -- imports -------------------------------------------
+                elif op == "IMPORT_NAME":
+                    fromlist = stack.pop()
+                    level = stack.pop()
+                    stack.append(__import__(
+                        ins.argval, f_globals, None, fromlist or (),
+                        level or 0))
+                elif op == "IMPORT_FROM":
+                    stack.append(getattr(stack[-1], ins.argval))
+
+                elif op == "MAKE_FUNCTION":
+                    fcode = stack.pop()
+                    closure_t = stack.pop() if arg & 0x08 else None
+                    annotations = stack.pop() if arg & 0x04 else None
+                    kwdefaults = stack.pop() if arg & 0x02 else None
+                    defaults = stack.pop() if arg & 0x01 else None
+                    new_fn = types.FunctionType(
+                        fcode, f_globals, fcode.co_name,
+                        tuple(defaults) if defaults else None,
+                        tuple(closure_t) if closure_t else None)
+                    if kwdefaults:
+                        new_fn.__kwdefaults__ = dict(kwdefaults)
+                    if annotations:
+                        new_fn.__annotations__ = dict(annotations)
+                    stack.append(new_fn)
+
+                else:   # pragma: no cover — scan_code should prevent this
+                    raise InterpreterInternalError(f"unhandled opcode {op}")
+
+                ip += 1
+            except InterpreterInternalError:
+                raise
+            except Exception as e:
+                # unwind through the frame's exception table (the same
+                # zero-cost scheme the real CPython frame would use)
+                ent = None
+                for cand in exc_table:
+                    if cand.start <= ins.offset < cand.end:
+                        ent = cand
+                        break
+                if ent is None:
+                    raise
+                del stack[ent.depth:]
+                if ent.lasti:
+                    stack.append(ins.offset)
+                stack.append(e)
+                ip = by_offset[ent.target]
                 continue
 
-            elif op == "GET_ITER":
-                v = stack.pop()
-                stack.append(iter(v))
-            elif op == "FOR_ITER":
-                it = stack[-1]
-                try:
-                    stack.append(next(it))
-                except StopIteration:
-                    # 3.12: leave iterator; push exhaustion marker; jump
-                    # to the END_FOR at the target, which pops both
-                    stack.append(None)
-                    ip = by_offset[ins.argval]
-                    continue
-            elif op == "END_FOR":
-                stack.pop()
-                stack.pop()
-
-            elif op == "BUILD_TUPLE":
-                vals = stack[len(stack) - arg:] if arg else []
-                del stack[len(stack) - arg:]
-                stack.append(tuple(vals))
-            elif op == "BUILD_LIST":
-                vals = stack[len(stack) - arg:] if arg else []
-                del stack[len(stack) - arg:]
-                stack.append(list(vals))
-            elif op == "BUILD_SET":
-                vals = stack[len(stack) - arg:] if arg else []
-                del stack[len(stack) - arg:]
-                stack.append(set(vals))
-            elif op == "BUILD_MAP":
-                items = stack[len(stack) - 2 * arg:] if arg else []
-                del stack[len(stack) - 2 * arg:]
-                stack.append({items[i]: items[i + 1]
-                              for i in range(0, len(items), 2)})
-            elif op == "BUILD_CONST_KEY_MAP":
-                keys = stack.pop()
-                vals = stack[len(stack) - arg:]
-                del stack[len(stack) - arg:]
-                stack.append(dict(zip(keys, vals)))
-            elif op == "BUILD_SLICE":
-                if arg == 3:
-                    step = stack.pop()
-                else:
-                    step = None
-                stop = stack.pop()
-                start = stack.pop()
-                stack.append(slice(start, stop, step))
-            elif op == "BUILD_STRING":
-                parts = stack[len(stack) - arg:]
-                del stack[len(stack) - arg:]
-                stack.append("".join(parts))
-            elif op == "FORMAT_VALUE":
-                have_spec = arg & 0x04
-                spec = stack.pop() if have_spec else ""
-                v = stack.pop()
-                conv = arg & 0x03
-                if conv == 1:
-                    v = str(v)
-                elif conv == 2:
-                    v = repr(v)
-                elif conv == 3:
-                    v = ascii(v)
-                stack.append(format(v, spec))
-
-            elif op == "LIST_EXTEND":
-                seq = stack.pop()
-                stack[-arg].extend(seq)
-            elif op == "LIST_APPEND":
-                v = stack.pop()
-                stack[-arg].append(v)
-            elif op == "SET_ADD":
-                v = stack.pop()
-                stack[-arg].add(v)
-            elif op == "SET_UPDATE":
-                seq = stack.pop()
-                stack[-arg].update(seq)
-            elif op == "MAP_ADD":
-                value = stack.pop()
-                key_ = stack.pop()
-                stack[-arg][key_] = value
-            elif op in ("DICT_MERGE", "DICT_UPDATE"):
-                other = stack.pop()
-                stack[-arg].update(other)
-
-            elif op == "BINARY_SUBSCR":
-                idx = stack.pop()
-                obj = stack.pop()
-                stack.append(obj[idx])
-            elif op == "STORE_SUBSCR":
-                idx = stack.pop()
-                obj = stack.pop()
-                val = stack.pop()
-                obj[idx] = val
-            elif op == "DELETE_SUBSCR":
-                idx = stack.pop()
-                obj = stack.pop()
-                del obj[idx]
-            elif op == "BINARY_SLICE":
-                stop = stack.pop()
-                start = stack.pop()
-                obj = stack.pop()
-                stack.append(obj[start:stop])
-            elif op == "STORE_SLICE":
-                stop = stack.pop()
-                start = stack.pop()
-                obj = stack.pop()
-                val = stack.pop()
-                obj[start:stop] = val
-
-            elif op == "UNPACK_SEQUENCE":
-                seq = stack.pop()
-                vals = list(seq)
-                if len(vals) != arg:
-                    raise ValueError(
-                        f"not enough values to unpack (expected {arg})")
-                stack.extend(reversed(vals))
-            elif op == "UNPACK_EX":
-                before = arg & 0xFF
-                after = arg >> 8
-                seq = list(stack.pop())
-                rest = seq[before:len(seq) - after] \
-                    if after else seq[before:]
-                tail = seq[len(seq) - after:] if after else []
-                for v in reversed(tail):
-                    stack.append(v)
-                stack.append(rest)
-                for v in reversed(seq[:before]):
-                    stack.append(v)
-
-            elif op == "KW_NAMES":
-                kw_names = ins.argval
-            elif op == "CALL":
-                argc = arg
-                call_args = stack[len(stack) - argc:] if argc else []
-                del stack[len(stack) - argc:]
-                self_or_null = stack.pop()
-                callable_ = stack.pop()
-                if callable_ is NULL:
-                    callable_ = self_or_null
-                elif self_or_null is not NULL:
-                    call_args = [self_or_null] + call_args
-                if kw_names:
-                    n_kw = len(kw_names)
-                    kw = dict(zip(kw_names, call_args[len(call_args) - n_kw:]))
-                    call_args = call_args[:len(call_args) - n_kw]
-                    kw_names = ()
-                else:
-                    kw = {}
-                stack.append(self._call(callable_, call_args, kw))
-            elif op == "CALL_FUNCTION_EX":
-                kw = stack.pop() if arg & 1 else {}
-                pos = list(stack.pop())
-                self_or_null = stack.pop()
-                callable_ = stack.pop()
-                if callable_ is NULL:
-                    callable_ = self_or_null
-                elif self_or_null is not NULL:
-                    pos = [self_or_null] + pos
-                stack.append(self._call(callable_, pos, dict(kw)))
-            elif op == "CALL_INTRINSIC_1":
-                which = ins.argrepr
-                v = stack.pop()
-                if which == "INTRINSIC_UNARY_POSITIVE":
-                    stack.append(+v)
-                elif which == "INTRINSIC_LIST_TO_TUPLE":
-                    stack.append(tuple(v))
-                else:
-                    raise RuntimeError(f"intrinsic {which}")
-
-            elif op == "MAKE_FUNCTION":
-                fcode = stack.pop()
-                closure_t = stack.pop() if arg & 0x08 else None
-                annotations = stack.pop() if arg & 0x04 else None
-                kwdefaults = stack.pop() if arg & 0x02 else None
-                defaults = stack.pop() if arg & 0x01 else None
-                new_fn = types.FunctionType(
-                    fcode, f_globals, fcode.co_name,
-                    tuple(defaults) if defaults else None,
-                    tuple(closure_t) if closure_t else None)
-                if kwdefaults:
-                    new_fn.__kwdefaults__ = dict(kwdefaults)
-                if annotations:
-                    new_fn.__annotations__ = dict(annotations)
-                stack.append(new_fn)
-
-            else:   # pragma: no cover — scan_code should prevent this
-                raise RuntimeError(f"unhandled opcode {op}")
-
-            ip += 1
 
     # -- calls ---------------------------------------------------------------
     def _call(self, callable_, args: list, kwargs: dict):
         if self._inlinable(callable_):
-            sub = OpcodeExecutor(self.recorder, self.depth + 1)
+            sub = OpcodeExecutor(self.recorder, self.depth + 1,
+                                 exc_cell=self.exc_cell)
             return sub.run(callable_, tuple(args), kwargs)
         return callable_(*args, **kwargs)
 

@@ -14,10 +14,16 @@ time, like the reference's convert_* operators).
 
 Supported subset (documented, mirrors the reference's practical coverage):
 - ``if``/``elif``/``else`` with tensor predicates, where branches assign
-  variables (no ``return``/``break`` inside a transformed branch);
-- ``while`` with tensor predicates (no ``break``/``continue``); NOTE:
-  a traced-tensor ``while`` compiles to ``lax.while_loop``, which XLA
-  cannot reverse-differentiate — use it in inference/metrics paths, or a
+  variables;
+- ``break``/``continue`` in ``for``/``while`` and mid-function
+  ``return``: a flattening pre-pass (_FlattenEarlyExits — the analog of
+  the reference's break_continue_transformer.py + return_transformer.py)
+  rewrites them into flag variables + guarded tails, after which the
+  structural converters apply as usual (the flags simply ride the loop
+  carry);
+- ``while`` with tensor predicates; NOTE: a traced-tensor ``while``
+  compiles to ``lax.while_loop``, which XLA cannot
+  reverse-differentiate — use it in inference/metrics paths, or a
   python-bounded ``for`` (stays unrolled, fully differentiable) in
   training code;
 - ``for i in range(...)``: python bounds stay a plain unrolled python
@@ -108,6 +114,216 @@ def _try_read_default(name: str) -> ast.expr:
 
 def _names_tuple(names: List[str], ctx) -> ast.expr:
     return ast.Tuple([ast.Name(n, ctx()) for n in names], ctx())
+
+
+# ---------------------------------------------------------------------------
+# early-exit flattening (break / continue / mid-function return)
+# ---------------------------------------------------------------------------
+def _assign(name: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[ast.Name(name, ast.Store())], value=value)
+
+
+def _not_flags(flags: List[str]) -> ast.expr:
+    """``not (f1 or f2 or ...)`` guard expression."""
+    if len(flags) == 1:
+        test = ast.Name(flags[0], ast.Load())
+    else:
+        test = ast.BoolOp(op=ast.Or(),
+                          values=[ast.Name(f, ast.Load())
+                                  for f in flags])
+    return ast.UnaryOp(op=ast.Not(), operand=test)
+
+
+class _FlattenEarlyExits(ast.NodeTransformer):
+    """Rewrite ``break``/``continue``/mid-function ``return`` into flag
+    variables and guarded statement tails, so the structural converters
+    (if -> cond, while/for -> loop) apply afterwards.
+
+    Parity: the reference's dedicated transformers
+    (python/paddle/jit/dy2static/transformers/break_continue_transformer
+    .py, return_transformer.py, early_return_transformer.py) — same
+    flag-plus-guard strategy, one pass here because the flags compose:
+    ``return`` inside a loop lowers to ret-flag + ``break``, which the
+    loop pass then lowers to the loop's break flag."""
+
+    # ---- function level: returns --------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if not self._has_early_return(node.body):
+            node.body = self._flatten_loops_block(node.body)
+            return node
+        rf, rv = _fresh("ret_flag"), _fresh("ret_val")
+        body = self._rewrite_returns_block(node.body, rf, rv,
+                                           in_loop=False)
+        body = self._flatten_loops_block(body)
+        node.body = ([_assign(rf, ast.Constant(False)),
+                      _assign(rv, ast.Constant(None))] + body
+                     + [ast.Return(ast.Name(rv, ast.Load()))])
+        return node
+
+    @staticmethod
+    def _has_early_return(stmts) -> bool:
+        # any Return not a top-level tail statement
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return) and i == len(stmts) - 1:
+                continue
+            if _contains([s], (ast.Return,)):
+                return True
+        return False
+
+    def _rewrite_returns_block(self, stmts, rf, rv, in_loop):
+        """Replace every Return with rf/rv assignment (+ break inside a
+        loop); guard statements after any construct that may have
+        returned."""
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign(rv, s.value
+                                   if s.value is not None
+                                   else ast.Constant(None)))
+                out.append(_assign(rf, ast.Constant(True)))
+                if in_loop:
+                    out.append(ast.Break())
+                return out          # following statements unreachable
+            if isinstance(s, ast.If) and _contains([s], (ast.Return,)):
+                s = ast.If(
+                    test=s.test,
+                    body=self._rewrite_returns_block(s.body, rf, rv,
+                                                     in_loop),
+                    orelse=self._rewrite_returns_block(s.orelse, rf, rv,
+                                                       in_loop)
+                    if s.orelse else [])
+                out.append(s)
+                rest = self._rewrite_returns_block(stmts[i + 1:], rf,
+                                                   rv, in_loop)
+                if rest:
+                    out.append(ast.If(test=_not_flags([rf]), body=rest,
+                                      orelse=[]))
+                return out
+            if isinstance(s, (ast.For, ast.While)) \
+                    and _contains([s], (ast.Return,)):
+                s = type(s)(**{**{f: getattr(s, f)
+                                  for f in s._fields},
+                               "body": self._rewrite_returns_block(
+                                   s.body, rf, rv, in_loop=True)})
+                out.append(s)
+                if in_loop:
+                    # a return inside a NESTED loop must break every
+                    # enclosing loop, not just the innermost one
+                    out.append(ast.If(test=ast.Name(rf, ast.Load()),
+                                      body=[ast.Break()], orelse=[]))
+                rest = self._rewrite_returns_block(stmts[i + 1:], rf,
+                                                   rv, in_loop)
+                if rest:
+                    out.append(ast.If(test=_not_flags([rf]), body=rest,
+                                      orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    # ---- loop level: break / continue ---------------------------------
+    def _flatten_loops_block(self, stmts):
+        out = []
+        for s in stmts:
+            if isinstance(s, (ast.For, ast.While)):
+                res = self._flatten_loop(s)
+                out.extend(res if isinstance(res, list) else [res])
+            elif isinstance(s, ast.If):
+                out.append(ast.If(
+                    test=s.test,
+                    body=self._flatten_loops_block(s.body),
+                    orelse=self._flatten_loops_block(s.orelse)
+                    if s.orelse else []))
+            else:
+                out.append(s)
+        return out
+
+    def _flatten_loop(self, node):
+        # flatten nested loops inside this body first
+        node.body = self._flatten_loops_block(node.body)
+        has_break = self._direct_exit(node.body, ast.Break)
+        has_cont = self._direct_exit(node.body, ast.Continue)
+        if not has_break and not has_cont:
+            return node
+        bf = _fresh("break_flag") if has_break else None
+        cf = _fresh("cont_flag") if has_cont else None
+        body = self._rewrite_exits_block(node.body, bf, cf)
+        if cf:
+            body = [_assign(cf, ast.Constant(False))] + body
+        # for/while ... else: runs iff the loop exited WITHOUT break —
+        # flatten to a guarded tail (the structural converters reject
+        # orelse, so it must not survive on the loop node itself)
+        if node.orelse and bf:
+            post = [ast.If(test=_not_flags([bf]), body=list(node.orelse),
+                           orelse=[])]
+        else:
+            post = list(node.orelse) if node.orelse else []
+        if isinstance(node, ast.While):
+            test = node.test
+            if bf:
+                # the flag must short-circuit FIRST: after a break the
+                # original condition may no longer be evaluable (python
+                # never re-tests it after break)
+                test = ast.BoolOp(op=ast.And(),
+                                  values=[_not_flags([bf]), test])
+            new = ast.While(test=test, body=body, orelse=[])
+        else:
+            # for loop with break: guard the whole body per iteration
+            # (the iterator still advances, matching a flagged python
+            # loop over the same iterable)
+            if bf:
+                body = [ast.If(test=_not_flags([bf]), body=body,
+                               orelse=[])]
+            new = ast.For(target=node.target, iter=node.iter, body=body,
+                          orelse=[])
+        pre = [_assign(bf, ast.Constant(False))] if bf else []
+        if pre or post:
+            return pre + [new] + post
+        return new
+
+    @staticmethod
+    def _direct_exit(stmts, kind) -> bool:
+        """kind occurs in stmts WITHOUT an intervening loop (i.e. it
+        belongs to this loop, not a nested one)."""
+        class F(ast.NodeVisitor):
+            found = False
+
+            def generic_visit(self, n):
+                if isinstance(n, kind):
+                    self.found = True
+                if not isinstance(n, (ast.For, ast.While,
+                                      ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    super().generic_visit(n)
+        f = F()
+        for s in stmts:
+            f.visit(s)
+        return f.found
+
+    def _rewrite_exits_block(self, stmts, bf, cf):
+        out = []
+        flags = [f for f in (bf, cf) if f]
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign(bf, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.Continue):
+                out.append(_assign(cf, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.If) and self._direct_exit(
+                    [s], (ast.Break, ast.Continue)):
+                s = ast.If(test=s.test,
+                           body=self._rewrite_exits_block(s.body, bf,
+                                                          cf),
+                           orelse=self._rewrite_exits_block(
+                               s.orelse, bf, cf) if s.orelse else [])
+                out.append(s)
+                rest = self._rewrite_exits_block(stmts[i + 1:], bf, cf)
+                if rest:
+                    out.append(ast.If(test=_not_flags(flags), body=rest,
+                                      orelse=[]))
+                return out
+            out.append(s)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +526,7 @@ def convert_function(fn):
         return fn
     fdef.decorator_list = []   # strip @to_static etc.
 
+    tree = _FlattenEarlyExits().visit(tree)
     new_tree = Dy2StaticTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
 

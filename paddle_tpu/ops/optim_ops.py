@@ -34,6 +34,14 @@ def _f32(v):
     return as_value(v).astype(jnp.float32)
 
 
+def _skip(skip_update) -> bool:
+    """AMP overflow skip: when skip_update is truthy the reference op
+    leaves params AND optimizer state untouched."""
+    if skip_update is None:
+        return False
+    return bool(np.asarray(as_value(skip_update)))
+
+
 def sgd_(param, learning_rate, grad, master_param=None,
          multi_precision=False, name=None):
     """Parity: reference sgd_ op."""
@@ -72,6 +80,8 @@ def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
           min_row_size_to_use_multithread=1000, multi_precision=False,
           use_global_beta_pow=False, name=None):
     """Parity: reference adam_ op."""
+    if _skip(skip_update):
+        return param
     lr = _f32(learning_rate)
     g = _f32(grad)
     p = _f32(master_param) if master_param is not None else _f32(param)
@@ -98,6 +108,8 @@ def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow,
            min_row_size_to_use_multithread=1000, multi_precision=False,
            use_global_beta_pow=False, name=None):
     """Parity: reference adamw_ op (decoupled weight decay)."""
+    if _skip(skip_update):
+        return param
     lr = _f32(learning_rate) * lr_ratio
     p = _f32(master_param) if master_param is not None else _f32(param)
     if with_decay:
@@ -218,6 +230,8 @@ def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow,
           weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
           always_adapt=False, multi_precision=False, name=None):
     """Parity: reference lamb_ op (layerwise trust-ratio Adam)."""
+    if _skip(skip_update):
+        return param
     g = _f32(grad)
     p = _f32(master_param) if master_param is not None else _f32(param)
     m1 = beta1 * _f32(moment1) + (1 - beta1) * g
@@ -285,6 +299,8 @@ def fused_adam_(params, grads, learning_rate, moments1, moments2,
     """Parity: reference fused_adam_ op — XLA fuses the whole multi-
     tensor update into one executable, the TPU analog of the chunked
     CUDA multi_tensor kernel."""
+    if _skip(skip_update):
+        return params
     mp = master_params or [None] * len(params)
     for p, g, m1, m2, b1, b2, m in zip(params, grads, moments1,
                                        moments2, beta1_pows, beta2_pows,

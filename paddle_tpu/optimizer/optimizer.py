@@ -249,14 +249,19 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None, **kw):
+                 name=None, moment_dtype="float32", **kw):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        # bf16 moments halve optimizer memory (the update math still runs
+        # in f32) — the memory-reduction knob for >=1B params on one chip,
+        # the single-chip analog of the reference's sharded optim states
+        self._moment_dtype = jnp.bfloat16 \
+            if str(moment_dtype) in ("bfloat16", "bf16") else jnp.float32
 
     def _init_state(self, p):
-        return {"moment1": jnp.zeros_like(p._value, jnp.float32),
-                "moment2": jnp.zeros_like(p._value, jnp.float32),
+        return {"moment1": jnp.zeros_like(p._value, self._moment_dtype),
+                "moment2": jnp.zeros_like(p._value, self._moment_dtype),
                 "beta1_pow": jnp.asarray(1.0, jnp.float32),
                 "beta2_pow": jnp.asarray(1.0, jnp.float32)}
 
@@ -272,15 +277,18 @@ class Adam(Optimizer):
         b1, b2 = self._beta1, self._beta2
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
-        m1 = b1 * state["moment1"] + (1 - b1) * g32
-        m2 = b2 * state["moment2"] + (1 - b2) * jnp.square(g32)
+        m1 = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * g32
+        m2 = b2 * state["moment2"].astype(jnp.float32) \
+            + (1 - b2) * jnp.square(g32)
         mhat = m1 / (1 - b1p)
         vhat = m2 / (1 - b2p)
         if self._decoupled():
             base = base * (1.0 - lr * state["wd"])
         new = base - lr * mhat / (jnp.sqrt(vhat) + self._eps)
         out = dict(state)
-        out.update(moment1=m1, moment2=m2, beta1_pow=b1p, beta2_pow=b2p)
+        out.update(moment1=m1.astype(self._moment_dtype),
+                   moment2=m2.astype(self._moment_dtype),
+                   beta1_pow=b1p, beta2_pow=b2p)
         if "master" in state:
             out["master"] = new
         return new.astype(p.dtype), out
@@ -292,10 +300,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None, **kw):
+                 lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype="float32", **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         name)
+                         name, moment_dtype=moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _decoupled(self):

@@ -372,6 +372,25 @@ def test_amp_bookkeeping_ops():
     np.testing.assert_allclose(ls.numpy(), 512.0)  # bad hits threshold
 
 
+def test_adam_skip_update_leaves_state_untouched():
+    """Review regression: skip_update=True (AMP overflow) must leave
+    params AND moments untouched, exactly like the reference kernel."""
+    p0 = np.array([1.0, 2.0], np.float32)
+    p = t(p0.copy())
+    m1 = t(np.zeros(2, np.float32))
+    m2 = t(np.zeros(2, np.float32))
+    b1 = t(np.float32(1.0)); b2 = t(np.float32(1.0))
+    g = t(np.array([np.inf, np.nan], np.float32))
+    call("adam_", p, g, t(np.float32(0.1)), m1, m2, b1, b2,
+         skip_update=t(np.asarray(True)))
+    np.testing.assert_allclose(p.numpy(), p0)
+    np.testing.assert_allclose(m1.numpy(), 0.0)
+    np.testing.assert_allclose(b1.numpy(), 1.0)
+    call("adamw_", p, g, t(np.float32(0.1)), m1, m2, b1, b2,
+         skip_update=t(np.asarray(True)))
+    np.testing.assert_allclose(p.numpy(), p0)
+
+
 def test_average_accumulates():
     p = t(np.ones(3, np.float32))
     s1 = t(np.zeros(3, np.float32))

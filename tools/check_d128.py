@@ -1,0 +1,42 @@
+"""Check D=128 training parity TPU-vs-CPU at small scale."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+
+def run(platform, dtype):
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (LlamaForCausalLM, LlamaConfig,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.train_step import TrainStep
+    cfg = LlamaConfig(vocab_size=1024, hidden_size=512,
+                      intermediate_size=1408, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=512, dtype=dtype,
+                      recompute=True)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if dtype == "bfloat16":
+        model.bfloat16()
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 moment_dtype="bfloat16")
+    step = TrainStep(model, lambda lg, lb: crit(lg, lb), opt,
+                     clip_norm=1.0)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 1024, (2, 512)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, 1024, (2, 512)).astype(np.int64))
+    out = []
+    for _ in range(6):
+        loss = step(ids, labels)
+        out.append(round(float(np.asarray(loss._value)), 4))
+    return out
+
+
+if __name__ == "__main__":
+    print(sys.argv[1], run(sys.argv[1], sys.argv[2]))
