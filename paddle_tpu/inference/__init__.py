@@ -185,3 +185,7 @@ class PredictorPool:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+from .serving import (ContinuousBatchingEngine,      # noqa: E402,F401
+                      GenerationRequest)

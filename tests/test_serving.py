@@ -1,8 +1,9 @@
 """Serving slice: paged KV cache + paged/block/masked attention kernels,
-inference Predictor, llama KV-cache generation.
+inference Predictor, llama KV-cache generation, continuous batching.
 
 Parity targets: paddle/phi/kernels/fusion/block_multihead_attention_kernel.cu,
-masked_multihead_attention, paddle/fluid/inference/api/analysis_predictor.h.
+masked_multihead_attention, paddle/fluid/inference/api/analysis_predictor.h
+(:210 — the scheduler around the predictor).
 """
 import os
 
@@ -304,3 +305,84 @@ def test_llama_generate_top_p_runs():
     arr = np.asarray(out._value)
     assert arr.shape == (1, 6)
     assert ((arr >= 0) & (arr < 64)).all()
+
+
+# ---------------------------------------------------------------------------
+# continuous batching (VERDICT round-2 item 7)
+# ---------------------------------------------------------------------------
+def _tiny_model(seed=0):
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(seed)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_continuous_batching_matches_sequential():
+    """Three requests of different lengths admitted at different times
+    must produce exactly the tokens each would get alone (greedy)."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    prompts = [np.array([3, 14, 15, 92, 65], np.int64),
+               np.array([1, 2], np.int64),
+               np.array([42, 7, 9], np.int64)]
+    budgets = [6, 9, 4]
+
+    # sequential reference: the model's own KV-cache generate loop
+    want = []
+    for p, n in zip(prompts, budgets):
+        out = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=n)
+        want.append(np.asarray(out._value)[0, len(p):].tolist())
+
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=4)
+    # staggered admission: r0 first, r1 after one step (r0 mid-decode),
+    # r2 after another step
+    r0 = eng.add_request(prompts[0], budgets[0])
+    eng.step()
+    r1 = eng.add_request(prompts[1], budgets[1])
+    eng.step()
+    r2 = eng.add_request(prompts[2], budgets[2])
+    eng.run_to_completion()
+
+    assert eng.result(r0) == want[0]
+    assert eng.result(r1) == want[1]
+    assert eng.result(r2) == want[2]
+
+
+def test_continuous_batching_slot_reuse_and_eviction():
+    """Finished requests free their pages; later requests reuse them
+    (pool smaller than the total footprint of all requests)."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=8, block_size=4)
+    # each request needs ceil((3+6)/4)=3 blocks; pool of 8 can hold at
+    # most 2 at once; 4 requests must cycle through slots
+    rids = [eng.add_request(np.array([i + 1, i + 2, i + 3], np.int64),
+                            max_new_tokens=6) for i in range(4)]
+    outs = eng.run_to_completion()
+    assert set(outs) == set(rids)
+    for rid in rids:
+        assert len(eng.result(rid)) == 6
+    # all pages returned to the pool
+    assert len(eng.caches[0]._free) == 8
+
+
+def test_continuous_batching_eos_stops_early():
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    p = np.array([5, 6, 7], np.int64)
+    ref = model.generate(paddle.to_tensor(p[None, :]), max_new_tokens=8)
+    ref_toks = np.asarray(ref._value)[0, 3:].tolist()
+    eos = ref_toks[2]          # force an early stop at the 3rd token
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=32, block_size=4)
+    rid = eng.add_request(p, max_new_tokens=8, eos_token_id=eos)
+    eng.run_to_completion()
+    assert eng.result(rid) == ref_toks[:3]
