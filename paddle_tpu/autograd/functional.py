@@ -27,7 +27,54 @@ def _vals(xs):
     return [xs._value if isinstance(xs, Tensor) else jnp.asarray(xs)]
 
 
+def _tensorize(xs):
+    xs_list = list(xs) if isinstance(xs, (list, tuple)) else [xs]
+    out = []
+    for x in xs_list:
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x), stop_gradient=False)
+        out.append(x)
+    return out
+
+
+def _rows_to_jacobian(rows, out_shape, in_tensor):
+    """Stack one-hot vjp rows (Tensors) into out_shape + in_shape, keeping
+    the tape history (create_graph path)."""
+    import paddle_tpu as P
+    stacked = P.stack(rows)
+    return stacked.reshape(list(out_shape) + list(in_tensor._value.shape))
+
+
+def _eager_jacobian_rows(out, xs_list, allow_unused):
+    """One grad(create_graph=True) row per scalar element of ``out``."""
+    from .tape import grad as _grad
+    per_input = [[] for _ in xs_list]
+    out_v = out._value
+    n = int(out_v.size)
+    for i in range(n):
+        seed = jnp.zeros((n,), out_v.dtype).at[i].set(1).reshape(out_v.shape)
+        gs = _grad([out], xs_list, grad_outputs=[Tensor._from_value(seed)],
+                   create_graph=True, retain_graph=True,
+                   allow_unused=allow_unused)
+        for k, g in enumerate(gs):
+            if g is None:
+                g = Tensor._from_value(jnp.zeros_like(xs_list[k]._value))
+            per_input[k].append(g)
+    return per_input
+
+
 def jacobian(func, xs, create_graph=False, allow_unused=False):
+    if create_graph:
+        # Eager double-grad path: every row is a paddle.grad(create_graph)
+        # call, so the returned jacobian carries tape history and can be
+        # differentiated again (parity: paddle.autograd.jacobian used inside
+        # gradient-penalty losses).
+        xs_list = _tensorize(xs)
+        out = func(*xs_list)
+        per_input = _eager_jacobian_rows(out, xs_list, allow_unused)
+        jacs = [_rows_to_jacobian(rows, out._value.shape, x)
+                for rows, x in zip(per_input, xs_list)]
+        return jacs[0] if len(jacs) == 1 else tuple(jacs)
     vals = _vals(xs)
     jac = jax.jacrev(_fnize(func), argnums=tuple(range(len(vals))))(*vals)
     if len(vals) == 1:
@@ -36,6 +83,33 @@ def jacobian(func, xs, create_graph=False, allow_unused=False):
 
 
 def hessian(func, xs, create_graph=False, allow_unused=False):
+    if create_graph:
+        from .tape import grad as _grad
+        xs_list = _tensorize(xs)
+        out = func(*xs_list)
+        g1 = _grad([out], xs_list, create_graph=True, retain_graph=True,
+                   allow_unused=allow_unused)
+        if isinstance(g1, Tensor):
+            g1 = [g1]
+        blocks = []
+        for k, gk in enumerate(g1):
+            if gk is None:   # unused input under allow_unused: zero blocks
+                blocks.append(tuple(
+                    Tensor._from_value(jnp.zeros(
+                        tuple(xs_list[k]._value.shape)
+                        + tuple(x._value.shape),
+                        xs_list[k]._value.dtype))
+                    for x in xs_list))
+                continue
+            # inner rows always zero-fill: a structurally-zero cross block
+            # (separable f) is a valid hessian entry, not a user error
+            per_input = _eager_jacobian_rows(gk, xs_list, True)
+            blocks.append(tuple(
+                _rows_to_jacobian(rows, gk._value.shape, x)
+                for rows, x in zip(per_input, xs_list)))
+        if len(xs_list) == 1:
+            return blocks[0][0]
+        return tuple(blocks)
     vals = _vals(xs)
     hes = jax.hessian(_fnize(func), argnums=tuple(range(len(vals))))(*vals)
     if len(vals) == 1:

@@ -79,8 +79,21 @@ class PyLayer(metaclass=PyLayerMeta):
                     vals = vals + (None,) * (len(in_tensors) - len(vals))
                 return vals[: len(in_tensors)]
 
+            def tensor_vjp(cot_tensors, _n=len(in_tensors)):
+                # create_graph path: user backward runs on live Tensors with
+                # grad enabled, so its ops record tape nodes and second-order
+                # flows through the custom layer naturally.
+                grads = cls.backward(ctx, *cot_tensors)
+                if isinstance(grads, Tensor) or grads is None:
+                    grads = (grads,)
+                grads = tuple(grads)
+                if len(grads) < _n:
+                    grads = grads + (None,) * (_n - len(grads))
+                return grads[:_n]
+
             node = _tape.GradNode(cls.__name__, vjp_fn, in_tensors, out_meta,
-                                  out_is_tuple=len(out_meta) > 1)
+                                  out_is_tuple=len(out_meta) > 1,
+                                  tensor_vjp=tensor_vjp)
             i = 0
             for o in out_list:
                 if isinstance(o, Tensor):

@@ -83,11 +83,13 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_meta", "n_outputs",
-                 "out_is_tuple", "_hooks", "__weakref__")
+                 "out_is_tuple", "_hooks", "raw_fn", "tensor_vjp",
+                 "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
                  out_meta: List[Tuple[Tuple[int, ...], Any]],
-                 out_is_tuple: bool = False):
+                 out_is_tuple: bool = False, raw_fn: Optional[Callable] = None,
+                 tensor_vjp: Optional[Callable] = None):
         self.name = name
         self.vjp_fn = vjp_fn          # maps output cotangents -> input cotangents
         self.inputs = list(inputs)    # input Tensors (edges)
@@ -95,6 +97,15 @@ class GradNode:
         self.n_outputs = len(out_meta)
         self.out_is_tuple = out_is_tuple  # forward returned a tuple (even len-1)
         self._hooks: List[Callable] = []
+        # Differentiable forward closure over exactly ``inputs``' values —
+        # enables create_graph backward (higher-order) by re-deriving the VJP
+        # inside a fresh differentiable op.  The TPU-native analog of the
+        # reference's generated higher-order GradNodes
+        # (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py).
+        self.raw_fn = raw_fn
+        # Alternative: a Tensor-level backward (PyLayer) — called with Tensor
+        # cotangents under grad-enabled mode so it records its own tape nodes.
+        self.tensor_vjp = tensor_vjp
 
     def parents(self):
         for t in self.inputs:
@@ -129,10 +140,49 @@ def _add_grad(a, b):
 # --------------------------------------------------------------------------
 # Engine
 # --------------------------------------------------------------------------
+def _node_backward_create_graph(node: GradNode, cots: Tuple):
+    """Run ``node``'s backward as a *differentiable op* so the cotangent
+    computation itself records tape nodes (higher-order autograd).
+
+    Mechanism: the node stores its raw forward closure (``raw_fn``); the
+    backward ``vjp(raw_fn)(cots)`` is re-derived inside a fresh closure that
+    is differentiable in BOTH the primal inputs and the cotangents, and that
+    closure is dispatched through ``apply_op`` — exactly like any forward op
+    (parity: reference higher-order GradNodes from eager_gen.py, exercised by
+    test/legacy_test/test_imperative_double_grad.py)."""
+    from ..core.dispatch import apply_op
+
+    if node.raw_fn is not None:
+        k = len(node.inputs)
+
+        def _bwd(*args, _fn=node.raw_fn, _k=k, _tup=node.out_is_tuple):
+            primals, cs = args[:_k], args[_k:]
+            _, vjp = jax.vjp(_fn, *primals)
+            return vjp(tuple(cs) if _tup else cs[0])
+
+        outs = apply_op(node.name + "_grad", _bwd,
+                        tuple(node.inputs) + tuple(cots))
+        return outs if isinstance(outs, tuple) else (outs,)
+    if node.tensor_vjp is not None:
+        from ..core.tensor import Tensor
+        return tuple(
+            g if g is None or isinstance(g, Tensor) else Tensor._from_value(g)
+            for g in node.tensor_vjp(cots))
+    if node.vjp_fn is None:
+        raise RuntimeError(
+            f"Trying to backward through {node.name} a second time; "
+            "set retain_graph=True if this is intended.")
+    raise RuntimeError(
+        f"create_graph=True through node {node.name} is not supported: "
+        "it has no differentiable backward (recompute blocks and custom "
+        "vjp nodes currently support first-order grad only).")
+
+
 def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                  retain_graph: bool = False,
                  capture: Optional[Dict[int, Any]] = None,
-                 write_leaf_grad: bool = True):
+                 write_leaf_grad: bool = True,
+                 create_graph: bool = False):
     """Run reverse accumulation from ``tensors``.
 
     Mirrors egr::Backward / RunBackward (reference:
@@ -162,20 +212,34 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
 
     for t, g in zip(tensors, grad_tensors):
         node = t._grad_node
-        seed = g._value if isinstance(g, Tensor) else g
-        if seed is None:
-            if t._value.size != 1:
-                raise RuntimeError(
-                    "grad can be implicitly created only for scalar outputs")
-            seed = jnp.ones_like(t._value)
+        if create_graph:
+            # Tensor-mode seeds: cotangents stay Tensors so backward ops
+            # chain into a new tape graph.
+            if g is None:
+                if t._value.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        "outputs")
+                seed = Tensor._from_value(jnp.ones_like(t._value))
+            else:
+                seed = g if isinstance(g, Tensor) \
+                    else Tensor._from_value(jnp.asarray(g))
         else:
-            seed = jnp.asarray(seed)
+            seed = g._value if isinstance(g, Tensor) else g
+            if seed is None:
+                if t._value.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        "outputs")
+                seed = jnp.ones_like(t._value)
+            else:
+                seed = jnp.asarray(seed)
         if capture is not None and id(t) in capture:
             capture[id(t)] = _add_grad(capture[id(t)], seed)
         if node is None:
             # Leaf with no history: backward() on it only seeds its own grad.
             if write_leaf_grad and not t.stop_gradient:
-                t._accumulate_grad(seed)
+                t._accumulate_grad(seed._value if create_graph else seed)
             continue
         h = holders.setdefault(node, [None] * node.n_outputs)
         h[t._out_index] = _add_grad(h[t._out_index], seed)
@@ -214,19 +278,37 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         # Fill missing output cotangents with zeros of the right meta, and
         # coerce dtypes to the recorded output dtype (cross-dtype edges can
         # arise from user casts between ops).
-        cots = tuple(
-            (g.astype(m[1]) if g is not None and hasattr(g, "dtype")
-             and g.dtype != m[1] else g) if g is not None
-            else _zeros_like_meta(m)
-            for g, m in zip(slot_grads, node.out_meta)
-        )
-        for hook in node._hooks:
-            cots = hook(cots)
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                f"Trying to backward through {node.name} a second time; "
-                "set retain_graph=True if this is intended.")
-        in_grads = node.vjp_fn(cots if node.out_is_tuple else cots[0])
+        if create_graph:
+            cots = tuple(
+                (g.astype(m[1]) if g.dtype != m[1] else g) if g is not None
+                else Tensor._from_value(_zeros_like_meta(m))
+                for g, m in zip(slot_grads, node.out_meta)
+            )
+            if node._hooks:
+                # Hooks operate on raw cotangents; a hook that REPLACES a
+                # slot detaches that slot's higher-order history (documented
+                # limitation — hooks are observers, not graph ops).
+                raw = tuple(c._value for c in cots)
+                for hook in node._hooks:
+                    raw = hook(raw)
+                cots = tuple(
+                    c if r is c._value else Tensor._from_value(r)
+                    for c, r in zip(cots, raw))
+            in_grads = _node_backward_create_graph(node, cots)
+        else:
+            cots = tuple(
+                (g.astype(m[1]) if g is not None and hasattr(g, "dtype")
+                 and g.dtype != m[1] else g) if g is not None
+                else _zeros_like_meta(m)
+                for g, m in zip(slot_grads, node.out_meta)
+            )
+            for hook in node._hooks:
+                cots = hook(cots)
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through {node.name} a second time; "
+                    "set retain_graph=True if this is intended.")
+            in_grads = node.vjp_fn(cots if node.out_is_tuple else cots[0])
         if not isinstance(in_grads, tuple):
             in_grads = (in_grads,)
 
@@ -242,7 +324,9 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                     capture[id(t)] = _add_grad(capture[id(t)], gval)
                 if pnode is None:
                     if write_leaf_grad:
-                        t._accumulate_grad(gval)
+                        t._accumulate_grad(
+                            gval._value if create_graph
+                            and isinstance(gval, Tensor) else gval)
                 else:
                     h = holders.setdefault(pnode, [None] * pnode.n_outputs)
                     h[t._out_index] = _add_grad(h[t._out_index], gval)
@@ -252,8 +336,10 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                     queue.append(pnode)
 
         holders.pop(node, None)
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.vjp_fn = None  # free residuals eagerly
+            node.raw_fn = None
+            node.tensor_vjp = None
 
     # Any nodes left with pending in-degree (disconnected islands) are fine.
 
@@ -274,14 +360,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     single_in = isinstance(inputs, Tensor)
     inputs = [inputs] if single_in else list(inputs)
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported yet; "
-            "use paddle_tpu.jit.grad_fn for higher-order derivatives.")
+    if retain_graph is None:
+        retain_graph = create_graph
 
     capture = {id(t): None for t in inputs}
     run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
-                 capture=capture, write_leaf_grad=False)
+                 capture=capture, write_leaf_grad=False,
+                 create_graph=create_graph)
     results = []
     for t in inputs:
         g = capture[id(t)]
@@ -289,5 +374,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             raise RuntimeError(
                 "One of the differentiated tensors appears unused in the "
                 "graph; pass allow_unused=True to return None for it.")
-        results.append(Tensor._from_value(g) if g is not None else None)
+        if g is None:
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)   # create_graph: carries its own tape history
+        else:
+            results.append(Tensor._from_value(g))
     return results[0] if single_in else results

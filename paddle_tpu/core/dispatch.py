@@ -193,7 +193,8 @@ def _apply_op_inner(name, fn, tensor_args, kwargs, multi_output):
         flat_outs = out_vals if out_is_tuple else (out_vals,)
         out_meta = [(tuple(o.shape), o.dtype) for o in flat_outs]
         node = _tape.GradNode(name, vjp_fn, [tensors[i] for i in diff_idx],
-                              out_meta, out_is_tuple=out_is_tuple)
+                              out_meta, out_is_tuple=out_is_tuple,
+                              raw_fn=closed)
         outs = _wrap_outputs(name, out_vals, multi_output, node=node)
 
     if get_flag("check_nan_inf"):

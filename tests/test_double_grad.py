@@ -1,0 +1,245 @@
+"""Eager higher-order autograd (create_graph=True).
+
+Reference analog: test/legacy_test/test_imperative_double_grad.py and
+test/legacy_test/test_imperative_triple_grad.py over the generated
+higher-order GradNodes (paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py); API python/paddle/autograd/backward_mode.py:23.
+
+TPU-native mechanism under test: each tape node stores its differentiable
+forward closure; create_graph backward re-derives the VJP inside a fresh
+``apply_op`` dispatch so cotangent computation records new tape nodes
+(paddle_tpu/autograd/tape.py:_node_backward_create_graph).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def _t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+def _second_derivative_numeric(f, x0, eps=1e-3):
+    """Central finite difference of f' computed by first-order autograd."""
+    def fprime(v):
+        t = _t(v)
+        y = f(t)
+        (g,) = paddle.grad([y], [t])
+        return np.asarray(g.numpy(), np.float64)
+
+    return (fprime(x0 + eps) - fprime(x0 - eps)) / (2 * eps)
+
+
+# -- grad-of-grad vs numeric second derivative on a battery of ops ----------
+UNARY_CASES = [
+    ("sin", lambda x: paddle.sin(x).sum()),
+    ("cos", lambda x: paddle.cos(x).sum()),
+    ("exp", lambda x: paddle.exp(x).sum()),
+    ("tanh", lambda x: paddle.tanh(x).sum()),
+    ("log", lambda x: paddle.log(x + 2.0).sum()),
+    ("sqrt", lambda x: paddle.sqrt(x + 2.0).sum()),
+    ("sigmoid", lambda x: paddle.nn.functional.sigmoid(x).sum()),
+    ("pow3", lambda x: (x ** 3).sum()),
+    ("reciprocal", lambda x: (1.0 / (x + 2.0)).sum()),
+    ("square_mul", lambda x: (x * x * x).sum()),
+    ("softplus", lambda x: paddle.nn.functional.softplus(x).sum()),
+    ("expm1", lambda x: paddle.expm1(x).sum()),
+]
+
+
+@pytest.mark.parametrize("name,f", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_double_grad_matches_numeric(name, f):
+    x0 = np.array([0.3, -0.4, 0.9], np.float32)
+    x = _t(x0)
+    y = f(x)
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    (g2,) = paddle.grad([g1.sum()], [x])
+    num = _second_derivative_numeric(f, x0)
+    np.testing.assert_allclose(g2.numpy(), num, rtol=2e-2, atol=2e-3)
+
+
+def test_triple_grad_pow4():
+    x = _t(2.0)
+    y = x ** 4
+    d1 = paddle.grad([y], [x], create_graph=True)[0]
+    d2 = paddle.grad([d1], [x], create_graph=True)[0]
+    d3 = paddle.grad([d2], [x])[0]
+    np.testing.assert_allclose(d3.numpy(), 48.0, rtol=1e-5)
+
+
+def test_double_grad_multi_path_accumulation():
+    # y = x*x + sin(x): y'' = 2 - sin(x), accumulated across two branches
+    x0 = np.array([0.5, 1.5], np.float32)
+    x = _t(x0)
+    y = (x * x + paddle.sin(x)).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    (g2,) = paddle.grad([g1.sum()], [x])
+    np.testing.assert_allclose(g2.numpy(), 2.0 - np.sin(x0), rtol=1e-5)
+
+
+def test_double_grad_matmul():
+    # f(x) = sum((xW)^2); d2f/dx2 = 2 W W^T (per row, block diagonal)
+    rng = np.random.default_rng(0)
+    W = _t(rng.standard_normal((3, 2)).astype(np.float32), sg=True)
+    x = _t(rng.standard_normal((1, 3)).astype(np.float32))
+    y = (paddle.matmul(x, W) ** 2).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    hess_rows = []
+    for i in range(3):
+        seed = np.zeros((1, 3), np.float32)
+        seed[0, i] = 1.0
+        (row,) = paddle.grad([(g1 * paddle.to_tensor(seed)).sum()], [x],
+                             retain_graph=True)
+        hess_rows.append(row.numpy().ravel())
+    H = np.stack(hess_rows)
+    expect = 2.0 * W.numpy() @ W.numpy().T
+    np.testing.assert_allclose(H, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_double_grad_second_order_into_leaf_grad():
+    # backward() on a loss built from a first-order grad populates .grad
+    x = _t([1.0, 2.0])
+    y = (x ** 3).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    loss = (g1 ** 2).sum()          # sum(9 x^4); dloss/dx = 36 x^3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 36.0 * np.array([1., 8.]),
+                               rtol=1e-5)
+
+
+def test_double_grad_unused_allow():
+    x = _t([1.0])
+    z = _t([2.0])
+    y = (x * x).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    gx, gz = paddle.grad([g1.sum()], [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0], rtol=1e-6)
+    assert gz is None
+
+
+def test_grad_retain_defaults_to_create_graph():
+    x = _t([3.0])
+    y = (x ** 3).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    # graph retained implicitly: a second grad through y still works
+    (g1b,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), g1b.numpy())
+
+
+def test_double_grad_through_pylayer():
+    class CubePlus(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 3.0 * x * x
+
+    x = _t([1.5])
+    y = CubePlus.apply(x).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [3 * 1.5 ** 2], rtol=1e-5)
+    (g2,) = paddle.grad([g1.sum()], [x])
+    np.testing.assert_allclose(g2.numpy(), [6 * 1.5], rtol=1e-5)
+
+
+def test_jacobian_create_graph_differentiable():
+    x = _t([0.5, 1.0])
+    jac = paddle.autograd.jacobian(lambda t: (t ** 3).sum(), x,
+                                   create_graph=True)
+    np.testing.assert_allclose(jac.numpy().ravel(),
+                               3 * np.array([0.25, 1.0]), rtol=1e-5)
+    (g,) = paddle.grad([jac.sum()], [x])
+    np.testing.assert_allclose(g.numpy(), 6 * np.array([0.5, 1.0]),
+                               rtol=1e-5)
+
+
+def test_hessian_create_graph():
+    x = _t([0.7, -0.2])
+    hes = paddle.autograd.hessian(lambda t: (t ** 3).sum(), x,
+                                  create_graph=True)
+    h = hes.numpy().reshape(2, 2)
+    np.testing.assert_allclose(np.diag(h), 6 * np.array([0.7, -0.2]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h[0, 1], 0.0, atol=1e-6)
+
+
+def test_wgan_gp_gradient_penalty_trains():
+    """Gradient-penalty (WGAN-GP) training loop: the canonical double-grad
+    workload (reference: test_imperative_double_grad.py gradient penalty)."""
+    paddle.seed(7)
+    rng = np.random.default_rng(7)
+
+    D = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 16), paddle.nn.Tanh(), paddle.nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=D.parameters())
+
+    losses = []
+    for step in range(8):
+        real = _t(rng.standard_normal((8, 4)).astype(np.float32), sg=True)
+        fake = _t((rng.standard_normal((8, 4)) * 2 + 1).astype(np.float32),
+                  sg=True)
+        alpha = _t(rng.random((8, 1)).astype(np.float32), sg=True)
+        interp = alpha * real + (1 - alpha) * fake
+        interp.stop_gradient = False
+
+        d_interp = D(interp)
+        (g,) = paddle.grad([d_interp.sum()], [interp], create_graph=True)
+        gnorm = paddle.sqrt((g ** 2).sum(axis=1) + 1e-12)
+        gp = ((gnorm - 1.0) ** 2).mean()
+
+        loss = D(fake).mean() - D(real).mean() + 10.0 * gp
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # training moved the objective
+
+
+def test_hessian_multi_input_separable():
+    # f(x, y) = sum(x^2) + sum(y^3): cross blocks are structurally zero
+    x = _t([1.0, 2.0])
+    y = _t([0.5])
+    blocks = paddle.autograd.hessian(
+        lambda a, b: (a ** 2).sum() + (b ** 3).sum(), [x, y],
+        create_graph=True)
+    hxx = blocks[0][0].numpy().reshape(2, 2)
+    np.testing.assert_allclose(hxx, 2 * np.eye(2), atol=1e-6)
+    np.testing.assert_allclose(blocks[0][1].numpy().ravel(), [0, 0],
+                               atol=1e-6)
+    np.testing.assert_allclose(blocks[1][1].numpy().ravel(), [3.0],
+                               rtol=1e-5)
+
+
+def test_double_backward_after_free_raises():
+    x = _t([2.0])
+    y = (x ** 3).sum()
+    paddle.grad([y], [x])         # frees residuals (retain_graph=False)
+    with pytest.raises(RuntimeError, match="second time"):
+        paddle.grad([y], [x], create_graph=True)
+
+
+def test_pylayer_backward_returns_raw_array_create_graph():
+    import jax.numpy as jnp
+
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, dy):
+            return jnp.asarray(dy.numpy()) * 2.0   # raw array return
+
+    x = _t([1.0, -1.0])
+    y = Scale.apply(x).sum()
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [2.0, 2.0])
