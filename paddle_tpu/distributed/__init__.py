@@ -14,6 +14,7 @@ from .process_mesh import (ProcessMesh, Shard, Replicate, Partial, Placement,
                            get_mesh, set_mesh)
 from .api import (shard_tensor, dtensor_from_fn, reshard, shard_layer,
                   shard_optimizer, unshard_dtensor)
+from .auto_parallel.dist_model import DistModel, to_static
 from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
                          all_gather, all_gather_object, broadcast, reduce,
                          reduce_scatter, all_to_all, alltoall,
@@ -35,7 +36,7 @@ __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "ProcessMesh", "Shard", "Replicate", "Partial",
     "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
-    "shard_optimizer", "unshard_dtensor",
+    "shard_optimizer", "unshard_dtensor", "DistModel", "to_static",
     "ReduceOp", "new_group", "all_reduce", "all_gather", "broadcast",
     "reduce", "reduce_scatter", "all_to_all", "scatter", "gather",
     "send", "recv", "barrier", "wait",

@@ -45,7 +45,14 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     placements = list(placements)
     val = _place_value(t._value, mesh, placements, t._value.ndim)
-    out = Tensor._from_value(val)
+    # preserve the concrete type (a sharded Parameter stays a Parameter,
+    # so optimizers / TrainStep still see it as trainable — the reference
+    # likewise returns an EagerParamBase for parameter inputs)
+    out = type(t)._from_value(val)
+    if t.__dict__:
+        out.__dict__.update(t.__dict__)
+    out.trainable = t.trainable
+    out.persistable = t.persistable
     out.stop_gradient = t.stop_gradient if stop_gradient is None \
         else stop_gradient
     out._grad_node = t._grad_node

@@ -4,5 +4,8 @@ Strategy strategy.py, Engine static/engine.py:59).
 """
 from .strategy import Strategy
 from .engine import Engine
+from .dist_model import (DistModel, to_static, read_back_dist_attrs,
+                         DistributedDataLoader)
 
-__all__ = ["Strategy", "Engine"]
+__all__ = ["Strategy", "Engine", "DistModel", "to_static",
+           "read_back_dist_attrs", "DistributedDataLoader"]

@@ -112,6 +112,8 @@ class Engine:
                 arrays = [self._shard_batch(np.asarray(b._value)
                                             if isinstance(b, Tensor)
                                             else b) for b in batch]
+                if getattr(self, "_sample_arrays", None) is None:
+                    self._sample_arrays = arrays
                 loss = step(*arrays)
                 history["loss"].append(float(np.asarray(loss)))
                 it += 1
@@ -202,6 +204,18 @@ class Engine:
                     and p.name != "self":
                 n += 1
         return max(n, 1)
+
+    # -- completion read-back -------------------------------------------------
+    def dist_attrs(self):
+        """Per-op shardings recovered from the compiled train module —
+        the read-back of what GSPMD completion decided (parity: op
+        dist_attr on the reference's completed program,
+        auto_parallel/static/completion.py)."""
+        from .dist_model import read_back_dist_attrs
+        if getattr(self, "_sample_arrays", None) is None:
+            raise RuntimeError("call fit() for at least one step first")
+        lowered = self._train_step.lower(*self._sample_arrays)
+        return read_back_dist_attrs(lowered.compile().as_text())
 
     # -- cost model (parity: static/cost/) ------------------------------------
     def cost(self, inputs_spec=None, mode="train"):

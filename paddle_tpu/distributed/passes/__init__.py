@@ -241,3 +241,221 @@ class ShardingPass(PassBase):
             model, optimizer, level=level,
             offload=bool(self.attrs.get("offload", False)))
         return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# master_grad: accumulate gradients in fp32
+# ---------------------------------------------------------------------------
+@register_pass("master_grad")
+@register_pass("auto_parallel_master_grad_pass")
+class MasterGradPass(PassBase):
+    """Accumulate low-precision grads in fp32 (parity:
+    auto_parallel_master_grad.py — the reference inserts cast-to-fp32 ops
+    after backward so gradient-merge accumulation happens in fp32).
+
+    TPU-native: an accumulate hook on each bf16/fp16 parameter casts every
+    incoming cotangent contribution to fp32 *before* it is added into
+    ``.grad``, so multi-micro-batch sums never round through bf16.  The
+    optimizer update math already runs in fp32, so the fp32 ``.grad``
+    feeds it exactly like the reference's master grad buffer."""
+
+    def _apply_impl(self, model, optimizer):
+        import jax.numpy as jnp
+
+        def _to_fp32(g):
+            return g.astype("float32") if g.dtype in (jnp.bfloat16,
+                                                      jnp.float16) else g
+
+        for p in model.parameters():
+            if p._value.dtype in (jnp.bfloat16, jnp.float16) and \
+                    not getattr(p, "_master_grad_hooked", False):
+                p._hooks.append(_to_fp32)
+                p._master_grad_hooked = True
+        model._master_grad_applied = True
+        return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# fp16 O2 program rewrite (static Program): casts + loss scaling +
+# found_inf update-skip with fp32 master weights
+# ---------------------------------------------------------------------------
+@register_pass("fp16")
+@register_pass("auto_parallel_fp16_pass")
+class FP16Pass(PassBase):
+    """Static-program fp16-O2 rewrite (parity: auto_parallel_fp16.py —
+    cast compute to fp16, keep fp32 master params, scale the loss, check
+    grads for inf/nan and skip the update on overflow, update the dynamic
+    loss scale).
+
+    TPU-native: the cast rewrite retargets each captured statement's
+    ``cast_to`` (the same mechanism as static AMP); loss scaling /
+    found_inf / master weights are honored by the Executor's fused train
+    compile reading ``program.fp16_spec`` — the whole rewritten step is
+    still ONE XLA module.  Apply to a ``paddle_tpu.static.Program``:
+
+        new_pass("fp16", {"init_loss_scaling": 1024.}).apply(prog, None)
+    """
+
+    def _apply_impl(self, program, optimizer):
+        from ...static import Program
+        if not isinstance(program, Program):
+            raise ValueError(
+                "fp16 pass rewrites a static Program (build the model "
+                "under paddle_tpu.static.program_guard first)")
+        dtype = self.attrs.get("dtype", "float16")
+        program.amp_config = ("O2", dtype, frozenset(), frozenset())
+        program.fp16_spec = {
+            "init_loss_scaling": float(
+                self.attrs.get("init_loss_scaling", 2.0 ** 15)),
+            "incr_ratio": float(self.attrs.get("incr_ratio", 2.0)),
+            "decr_ratio": float(self.attrs.get("decr_ratio", 0.5)),
+            "incr_every_n_steps": int(
+                self.attrs.get("incr_every_n_steps", 1000)),
+            "use_dynamic_loss_scaling": bool(
+                self.attrs.get("use_dynamic_loss_scaling", True)),
+        }
+        return program, optimizer
+
+
+# ---------------------------------------------------------------------------
+# DP comm overlap: bucketed gradient allreduce issued during backward
+# ---------------------------------------------------------------------------
+class _DPOverlapState:
+    """Bucket bookkeeping shared by the hooks and the optimizer wrapper."""
+
+    def __init__(self, params, bucket_bytes):
+        # reference reducer buckets in reverse registration order
+        # (grads become ready roughly back-to-front during backward)
+        self.buckets = []
+        cur, cur_bytes = [], 0
+        for p in reversed(list(params)):
+            if p.stop_gradient:
+                continue
+            n = 1
+            for d in p._value.shape:
+                n *= d
+            nbytes = n * p._value.dtype.itemsize
+            if cur and cur_bytes + nbytes > bucket_bytes:
+                self.buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            self.buckets.append(cur)
+        self.bucket_of = {id(p): bi for bi, b in enumerate(self.buckets)
+                          for p in b}
+        self.reset()
+
+    def reset(self):
+        self.touched = {id(p): False for b in self.buckets for p in b}
+        self.fired = [False] * len(self.buckets)
+        self.stale = [False] * len(self.buckets)
+
+
+class _DPOverlapOptimizer:
+    """Wraps an optimizer so DP grad sync is bucketed and issued as soon
+    as each bucket's grads are ready during backward (parity:
+    auto_parallel_data_parallel_optimization.py fuse+overlap; eager analog
+    of the reference EagerReducer, paddle/fluid/distributed/collective/
+    reducer.h:88)."""
+
+    def __init__(self, inner, model, group, bucket_mb, avg=True):
+        from ..env import get_world_size
+        self._inner = inner
+        self._group = group
+        self._avg = avg
+        self._state = _DPOverlapState(model.parameters(),
+                                      int(bucket_mb * 1024 * 1024))
+        self._world = group.nranks if group is not None \
+            else get_world_size()
+        for bucket in self._state.buckets:
+            for p in bucket:
+                p._hooks.append(self._make_hook(p))
+
+    def _make_hook(self, p):
+        st = self._state
+
+        def hook(g, _p=p):
+            bi = st.bucket_of[id(_p)]
+            if st.fired[bi]:
+                # late contribution (shared param): redo this bucket
+                # synchronously at step() time
+                st.stale[bi] = True
+                return g
+            st.touched[id(_p)] = True
+            if all(st.touched[id(q)] for q in st.buckets[bi]):
+                # _p's own .grad does not yet include g (hooks run
+                # pre-accumulate): allreduce it as grad+g
+                self._allreduce_bucket(bi, pending=(_p, g))
+                st.fired[bi] = True
+            return g
+
+        return hook
+
+    def _allreduce_bucket(self, bi, pending=None):
+        from ..collective import all_reduce
+        from ...core.tensor import Tensor
+        if self._world <= 1:
+            return
+        for q in self._state.buckets[bi]:
+            base = q._grad
+            if pending is not None and q is pending[0]:
+                # the firing hook's contribution g is not in .grad yet
+                gpend = pending[1]
+                gpend = gpend._value if isinstance(gpend, Tensor) else gpend
+                base = gpend if base is None else base + gpend
+            if base is None:
+                continue
+            t = Tensor._from_value(base)
+            all_reduce(t, group=self._group, sync_op=False)
+            val = t._value
+            if self._avg:
+                val = val / self._world
+            if pending is not None and q is pending[0]:
+                # .grad will still receive g from the in-flight
+                # accumulation; pre-subtract so the final sum is the
+                # synced average
+                gpend = pending[1]
+                gpend = gpend._value if isinstance(gpend, Tensor) \
+                    else gpend
+                q._grad = val - gpend
+            else:
+                q._grad = val
+
+    def step(self):
+        st = self._state
+        for bi in range(len(st.buckets)):
+            if not st.fired[bi] or st.stale[bi]:
+                self._allreduce_bucket(bi)
+                st.fired[bi] = True
+        self._inner.step()
+        st.reset()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+        self._state.reset()
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@register_pass("data_parallel_optimization")
+@register_pass("auto_parallel_data_parallel_optimization_pass")
+class DataParallelOptimizationPass(PassBase):
+    """attrs: {"bucket_size_mb": 25, "group": Group|None, "avg": True}.
+
+    Under GSPMD (sharded inputs, jitted step) grad sync is fused and
+    overlapped by XLA's latency-hiding scheduler — this pass is the
+    *eager multi-process* analog: bucket grads and issue each bucket's
+    allreduce as soon as its last grad is produced during backward."""
+
+    def _apply_impl(self, model, optimizer):
+        opt = _DPOverlapOptimizer(
+            optimizer, model,
+            self.attrs.get("group"),
+            float(self.attrs.get("bucket_size_mb", 25)),
+            avg=bool(self.attrs.get("avg", True)))
+        model._dp_overlap_applied = True
+        return model, opt

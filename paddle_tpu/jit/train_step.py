@@ -89,6 +89,24 @@ class TrainStep:
         # donate params + opt states: in-place HBM update
         self._step_fn = jax.jit(step, donate_argnums=(0, 2))
 
+    def lower(self, *batch):
+        """AOT-lower the fused step with the current params/shardings
+        (used by DistModel.dist_main_program and the dist-attr
+        read-back)."""
+        if self._step_fn is None:
+            self._build()
+        sd = self.model.state_dict()
+        params = {k: sd[k]._value for k in self._trainable}
+        frozen_vals = {k: sd[k]._value for k in self._frozen}
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        # fixed dummy key: lowering must not perturb the training RNG
+        # stream (the key value cannot affect the lowered HLO)
+        key = jax.random.PRNGKey(0)
+        batch_vals = tuple(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b) for b in batch)
+        return self._step_fn.lower(params, frozen_vals, self._opt_states,
+                                   lr, key, *batch_vals)
+
     def __call__(self, *batch):
         if self._step_fn is None:
             self._build()

@@ -4,7 +4,7 @@ Parity: python/paddle/optimizer/ (reference, SURVEY.md #63).
 """
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
-                        RMSProp, Adadelta, Adamax, Lamb, Rprop)
+                        RMSProp, Adadelta, Adamax, Lamb, Rprop, Adafactor)
 from .lbfgs import LBFGS
 
 

@@ -91,6 +91,7 @@ class Program:
         self.feeds: List[Tuple[str, Tensor]] = []
         self.train_spec = None             # (loss Tensor, optimizer)
         self.amp_config = None             # (level, dtype) via static.amp
+        self.fp16_spec = None              # set by the fp16 program pass
         self._compiled: Dict[Any, Any] = {}
 
     # -- capture-side API ----------------------------------------------------
@@ -115,6 +116,7 @@ class Program:
         cloned.recorder = self.recorder
         cloned.feeds = list(self.feeds)
         cloned.amp_config = self.amp_config
+        cloned.fp16_spec = self.fp16_spec
         if not for_test:
             cloned.train_spec = self.train_spec
         return cloned
@@ -355,7 +357,8 @@ class Executor:
         fetch_syms = tuple(self._resolve_syms(program, fetch_list))
         n_stmt = len(program.recorder.statements)
         train = program.train_spec is not None
-        key = ("cap", fetch_syms, n_stmt, train, program.amp_config)
+        key = ("cap", fetch_syms, n_stmt, train, program.amp_config,
+               bool(getattr(program, "fp16_spec", None)))
         entry = program._compiled.get(key)
         if entry is None:
             ir = self._build_ir(program, fetch_syms)
@@ -428,37 +431,75 @@ class Executor:
         opt_states = [opt._ensure_state(caps[i]) for i in train_idx]
         update = opt._update_rule
 
-        def step(base_key, cap_vals, feed_vals, states, lr):
+        fp16 = getattr(program, "fp16_spec", None)
+
+        def step(base_key, cap_vals, feed_vals, states, lr, scale):
             def loss_fn(train_vals):
                 full = list(cap_vals)
                 for i, v in zip(train_idx, train_vals):
                     full[i] = v
                 outs = replay(base_key, *full, *feed_vals)
-                return outs[-1].astype(jnp.float32).sum(), outs[:-1]
+                # fp16 pass: scale the loss so fp16 grads don't underflow
+                # (parity: auto_parallel_fp16.py loss scaling)
+                return (outs[-1].astype(jnp.float32) * scale).sum(), \
+                    outs[:-1]
 
-            (loss, fetches), grads = jax.value_and_grad(
+            (loss_s, fetches), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)([cap_vals[i] for i in train_idx])
+            loss = loss_s / scale
+            if fp16 is not None:
+                grads = [g.astype(jnp.float32) / scale for g in grads]
+                found_inf = jnp.asarray(False)
+                for g in grads:
+                    found_inf = found_inf | jnp.any(~jnp.isfinite(g))
             hyper = {"lr": lr}
             new_vals, new_states = [], []
             for v, g, st in zip([cap_vals[i] for i in train_idx], grads,
                                 states):
                 nv, nst = update(v, g, st, hyper)
+                if fp16 is not None:
+                    # skip the update on overflow (master fp32 params stay)
+                    nv = jnp.where(found_inf, v, nv)
+                    nst = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(found_inf, old, new),
+                        nst, st)
                 new_vals.append(nv)
                 new_states.append(nst)
-            return loss, fetches, new_vals, new_states
+            if fp16 is None:
+                return loss, fetches, new_vals, new_states, \
+                    jnp.asarray(False), scale
+            return loss, fetches, new_vals, new_states, found_inf, scale
 
         jit_step = jax.jit(step)
+        scale_state = {"scale": jnp.asarray(
+            fp16["init_loss_scaling"] if fp16 is not None else 1.0,
+            jnp.float32), "good": 0}
 
         def run(base_key, feed_vals):
             cap_vals = [t._value for t in caps]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            loss, fetches, new_vals, new_states = jit_step(
-                base_key, cap_vals, feed_vals, opt_states, lr)
+            loss, fetches, new_vals, new_states, found_inf, scale = \
+                jit_step(base_key, cap_vals, feed_vals, opt_states, lr,
+                         scale_state["scale"])
             for pos, (i, nv, nst) in enumerate(
                     zip(train_idx, new_vals, new_states)):
                 caps[i]._value = nv
                 opt_states[pos].update(nst)
             opt._global_step += 1
+            if fp16 is not None and fp16["use_dynamic_loss_scaling"]:
+                # host-side dynamic scale (one scalar fetch per step, the
+                # analog of the reference's update_loss_scaling op)
+                if bool(np.asarray(found_inf)):
+                    scale_state["scale"] = jnp.maximum(
+                        scale * fp16["decr_ratio"], 1.0)
+                    scale_state["good"] = 0
+                else:
+                    scale_state["good"] += 1
+                    if scale_state["good"] >= fp16["incr_every_n_steps"]:
+                        scale_state["scale"] = scale * fp16["incr_ratio"]
+                        scale_state["good"] = 0
+            if fp16 is not None:
+                program.fp16_state = scale_state
             return fetches
 
         return (run, step_ir)

@@ -186,3 +186,50 @@ def test_metric_accuracy():
     assert abs(m.accumulate() - 0.5) < 1e-6
     a = accuracy(pred, lab)
     assert abs(a.item() - 0.5) < 1e-6
+
+
+def test_adafactor_convergence_and_state_shape():
+    """Adafactor: factored second moments — state is O(rows+cols), and it
+    trains a regression to convergence (T5/PaLM recipe; beyond the
+    reference snapshot, added for single-chip billion-param training)."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 4)
+    opt = paddle.optimizer.Adafactor(learning_rate=0.05,
+                                     parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    X = paddle.to_tensor(rng.standard_normal((64, 16)).astype(np.float32))
+    Y = paddle.to_tensor(
+        X.numpy() @ rng.standard_normal((16, 4)).astype(np.float32))
+    first = None
+    for _ in range(150):
+        loss = ((net(X) - Y) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first * 1e-2
+    # factored state: weight (16,4) stores vr(16,) + vc(4,), no full moment
+    w = net.weight
+    st = opt._state[id(w)]
+    assert st["vr"].shape == (16,) and st["vc"].shape == (4,)
+    assert "m" not in st and "v" not in st
+
+
+def test_adafactor_momentum_and_vector_state():
+    paddle.seed(0)
+    net = paddle.nn.Linear(8, 2)
+    opt = paddle.optimizer.Adafactor(learning_rate=0.02, beta1=0.9,
+                                     parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    for _ in range(3):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    st_w = opt._state[id(net.weight)]
+    st_b = opt._state[id(net.bias)]
+    assert "m" in st_w                       # momentum enabled
+    assert st_b["v"].shape == (2,)           # 1-D params: unfactored v
+    assert np.isfinite(float(loss.numpy()))
