@@ -57,9 +57,20 @@ class SparseCooTensor:
             jnp.swapaxes(self._bcoo.indices, 0, 1).astype(jnp.int64))
 
     def values(self) -> Tensor:
+        t = getattr(self, "_values_t", None)
+        if t is not None:
+            return t      # carries tape history from differentiable ops
         return Tensor._from_value(self._bcoo.data)
 
     def to_dense(self) -> Tensor:
+        t = getattr(self, "_values_t", None)
+        if t is not None:
+            from ..core.dispatch import apply_op
+            idx, shp = self._bcoo.indices, self._bcoo.shape
+            return apply_op(
+                "sparse_to_dense",
+                lambda v: jsparse.BCOO((v, idx), shape=shp).todense(),
+                (t,))
         return Tensor._from_value(self._bcoo.todense())
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
@@ -124,6 +135,14 @@ class SparseCsrTensor(SparseCooTensor):
                                  dtype=np.int64))
 
     def values(self) -> Tensor:
+        t = getattr(self, "_values_t", None)
+        if t is not None:
+            # CSR values are row-major sorted: gather through dispatch so
+            # tape history survives the reorder
+            from ..core.dispatch import apply_op
+            idx = self._bcoo.indices
+            order = jnp.lexsort((idx[:, 1], idx[:, 0]))
+            return apply_op("sparse_csr_sort", lambda v: v[order], (t,))
         return Tensor._from_value(self._sorted().data)
 
     def _sorted(self):
@@ -189,23 +208,47 @@ def _wrap_same(x: SparseCooTensor, bcoo):
 
 
 def _binary(x, y, op):
+    from ..core.dispatch import apply_op
+    name = f"sparse_{getattr(op, '__name__', 'binary')}"
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        dense = op(x._bcoo.todense(), y._bcoo.todense())
-        return _wrap_same(x, jsparse.BCOO.fromdense(dense))
+        dense_t = apply_op(name, op, (x.to_dense(), y.to_dense()))
+        bcoo = jsparse.BCOO.fromdense(dense_t._value,
+                                      n_dense=x._bcoo.n_dense)
+        idx_np = np.asarray(bcoo.indices)
+        sel = tuple(jnp.asarray(idx_np[:, i])
+                    for i in range(idx_np.shape[1]))
+        vals_t = apply_op(name + "_vals", lambda dv: dv[sel], (dense_t,))
+        out = _wrap_same(x, bcoo)
+        out._values_t = vals_t
+        return out
     if isinstance(x, SparseCooTensor):
-        return Tensor._from_value(op(x._bcoo.todense(), _v(y)))
-    return Tensor._from_value(op(_v(x), y._bcoo.todense()))
+        yt = y if isinstance(y, Tensor) else Tensor(_v(y))
+        return apply_op(name, op, (x.to_dense(), yt))
+    xt = x if isinstance(x, Tensor) else Tensor(_v(x))
+    return apply_op(name, op, (xt, y.to_dense()))
 
 
 def add(x, y, name=None):
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor) \
             and not isinstance(x, SparseCsrTensor):
-        # structural add stays sparse without densifying
-        data = jnp.concatenate([x._bcoo.data, y._bcoo.data])
-        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices])
-        out = jsparse.BCOO((data, idx),
-                           shape=x._bcoo.shape).sum_duplicates()
-        return SparseCooTensor(out)
+        # structural add stays sparse without densifying: static
+        # coalesce plan + differentiable segment-sum over both value sets
+        from ..core.dispatch import apply_op
+        idx = np.concatenate([np.asarray(x._bcoo.indices),
+                              np.asarray(y._bcoo.indices)])
+        uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+        inv = jnp.asarray(inv)
+        m = uniq.shape[0]
+
+        def fn(xv, yv):
+            data = jnp.concatenate([xv, yv])
+            return jax.ops.segment_sum(data, inv, num_segments=m)
+
+        vals_t = apply_op("sparse_add", fn,
+                          (_values_tensor(x), _values_tensor(y)))
+        return _from_values_tensor(x, vals_t,
+                                   jnp.asarray(uniq, jnp.int32),
+                                   x._bcoo.shape)
     return _binary(x, y, jnp.add)
 
 
@@ -227,25 +270,70 @@ def divide(x, y, name=None):
 
 def matmul(x, y, name=None):
     """sparse @ dense / sparse @ sparse (parity: paddle.sparse.matmul).
-    BCOO dot lowers to XLA dot_general with gathers — MXU-eligible."""
+    BCOO dot lowers to XLA dot_general with gathers — MXU-eligible.
+    Routed through dispatch so gradients flow through sparse pipelines
+    (e.g. conv -> matmul)."""
+    from ..core.dispatch import apply_op
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        out = x._bcoo @ y._bcoo.todense()
-        return Tensor._from_value(out)
+        xi, xs = x._bcoo.indices, x._bcoo.shape
+        yi, ys = y._bcoo.indices, y._bcoo.shape
+
+        def fn2(xv, yv):
+            return jsparse.BCOO((xv, xi), shape=xs) @ \
+                jsparse.BCOO((yv, yi), shape=ys).todense()
+
+        return apply_op("sparse_matmul", fn2,
+                        (_values_tensor(x), _values_tensor(y)))
     if isinstance(x, SparseCooTensor):
-        return Tensor._from_value(x._bcoo @ _v(y))
+        xi, xs = x._bcoo.indices, x._bcoo.shape
+        yt = y if isinstance(y, Tensor) else Tensor(y)
+        if x._bcoo.n_dense:
+            # contraction dim is dense: values (nnz, ..., k) @ y then
+            # scatter rows at the sparse coords (BCOO dot_general cannot
+            # contract dense dims)
+            idx_np = np.asarray(xi)
+            sel = tuple(jnp.asarray(idx_np[:, i])
+                        for i in range(idx_np.shape[1]))
+
+            def fn_d(xv, yv):
+                contrib = xv @ yv
+                out = jnp.zeros(
+                    tuple(xs[: idx_np.shape[1]]) + contrib.shape[1:],
+                    contrib.dtype)
+                return out.at[sel].add(contrib)
+
+            return apply_op("sparse_matmul", fn_d,
+                            (_values_tensor(x), yt))
+        return apply_op(
+            "sparse_matmul",
+            lambda xv, yv: jsparse.BCOO((xv, xi), shape=xs) @ yv,
+            (_values_tensor(x), yt))
     if isinstance(y, SparseCooTensor):
-        return Tensor._from_value(_v(x) @ y._bcoo)
+        yi, ys = y._bcoo.indices, y._bcoo.shape
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        return apply_op(
+            "sparse_matmul",
+            lambda xv, yv: xv @ jsparse.BCOO((yv, yi), shape=ys),
+            (xt, _values_tensor(y)))
     return Tensor._from_value(_v(x) @ _v(y))
 
 
 def masked_matmul(x, y, mask: SparseCooTensor, name=None):
     """(x @ y) sampled at mask's sparsity (parity: SDDMM)."""
-    xv, yv = _v(x), _v(y)
+    from ..core.dispatch import apply_op
     idx = mask._bcoo.indices
     rows, cols = idx[:, 0], idx[:, 1]
-    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
-    return SparseCooTensor(jsparse.BCOO((vals, idx),
-                                        shape=mask._bcoo.shape))
+    xt = x if isinstance(x, Tensor) else Tensor(_v(x))
+    yt = y if isinstance(y, Tensor) else Tensor(_v(y))
+
+    def fn(xv, yv):
+        return jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+
+    vals_t = apply_op("sparse_masked_matmul", fn, (xt, yt))
+    out = SparseCooTensor(jsparse.BCOO((vals_t._value, idx),
+                                       shape=mask._bcoo.shape))
+    out._values_t = vals_t
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -253,9 +341,9 @@ def masked_matmul(x, y, mask: SparseCooTensor, name=None):
 # ---------------------------------------------------------------------------
 def _unary(x, op):
     if isinstance(x, SparseCooTensor):
-        return _wrap_same(x, jsparse.BCOO((op(x._bcoo.data),
-                                           x._bcoo.indices),
-                                          shape=x._bcoo.shape))
+        # through dispatch so the tape links when x carries history
+        return _value_op(x, f"sparse_{getattr(op, '__name__', 'unary')}",
+                         op)
     return Tensor._from_value(op(_v(x)))
 
 
@@ -318,35 +406,245 @@ def coalesce(x, name=None):
     return x.coalesce()
 
 
+
+
 # ---------------------------------------------------------------------------
-# sparse.nn (activations as layers — parity: python/paddle/sparse/nn)
+# round-4 op tail: unary completions, sum/reshape/slice, addmm/mv,
+# conv3d/maxpool (gather-GEMM-scatter), fused_attention
+# (parity: /root/reference/paddle/phi/api/yaml/sparse_ops.yaml, 48 ops;
+# kernels /root/reference/paddle/phi/kernels/sparse/)
 # ---------------------------------------------------------------------------
-class _SparseActLayer:
-    def __init__(self, fn):
-        self._fn = fn
+def _values_tensor(x: SparseCooTensor) -> Tensor:
+    """The tensor view of x's values — carries autograd history when x was
+    produced by a differentiable sparse op."""
+    t = getattr(x, "_values_t", None)
+    if t is None:
+        t = Tensor._from_value(x._bcoo.data)
+    return t
 
-    def __call__(self, x):
-        return self._fn(x)
+
+def _from_values_tensor(like: SparseCooTensor, values_t: Tensor, indices,
+                        shape) -> SparseCooTensor:
+    out = _wrap_same(like, jsparse.BCOO(
+        (values_t._value, indices), shape=tuple(int(s) for s in shape)))
+    out._values_t = values_t
+    return out
 
 
-class nn:
-    class ReLU(_SparseActLayer):
-        def __init__(self):
-            super().__init__(relu)
+def _value_op(x: SparseCooTensor, name, fn) -> SparseCooTensor:
+    """Apply fn to stored values only (the reference's sparse unary
+    convention), through dispatch so gradients flow to the values."""
+    from ..core.dispatch import apply_op
+    out_t = apply_op(name, fn, (_values_tensor(x),))
+    return _from_values_tensor(x, out_t, x._bcoo.indices, x._bcoo.shape)
 
-    class Softmax:
-        """Row-wise softmax over CSR rows (parity: sparse/nn softmax)."""
 
-        def __init__(self, axis=-1):
-            pass
+def asin(x, name=None):
+    return _value_op(x, "sparse_asin", jnp.arcsin)
 
-        def __call__(self, x: SparseCooTensor):
-            idx = x._bcoo.indices
-            rows = idx[:, 0]
-            data = x._bcoo.data
-            n_rows = x.shape[0]
-            row_max = jnp.full((n_rows,), -jnp.inf).at[rows].max(data)
-            e = jnp.exp(data - row_max[rows])
-            denom = jnp.zeros((n_rows,)).at[rows].add(e)
-            return _wrap_same(x, jsparse.BCOO((e / denom[rows], idx),
-                                              shape=x._bcoo.shape))
+
+def asinh(x, name=None):
+    return _value_op(x, "sparse_asinh", jnp.arcsinh)
+
+
+def atan(x, name=None):
+    return _value_op(x, "sparse_atan", jnp.arctan)
+
+
+def atanh(x, name=None):
+    return _value_op(x, "sparse_atanh", jnp.arctanh)
+
+
+def acos(x, name=None):
+    return _value_op(x, "sparse_acos", jnp.arccos)
+
+
+def acosh(x, name=None):
+    return _value_op(x, "sparse_acosh", jnp.arccosh)
+
+
+def sinh(x, name=None):
+    return _value_op(x, "sparse_sinh", jnp.sinh)
+
+
+def tan(x, name=None):
+    return _value_op(x, "sparse_tan", jnp.tan)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _value_op(x, "sparse_leaky_relu",
+                     lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def relu6(x, name=None):
+    return _value_op(x, "sparse_relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def isnan(x, name=None):
+    return _wrap_same(x, jsparse.BCOO(
+        (jnp.isnan(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+
+
+def scale(x, scale, bias=0.0, bias_after_scale=True, name=None):
+    if bias_after_scale:
+        return _value_op(x, "sparse_scale", lambda v: v * scale + bias)
+    return _value_op(x, "sparse_scale", lambda v: (v + bias) * scale)
+
+
+def divide_scalar(x, scalar, name=None):
+    return _value_op(x, "sparse_divide_scalar", lambda v: v / scalar)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    vals = jnp.full_like(x._bcoo.data, fill_value)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    return _wrap_same(x, jsparse.BCOO((vals, x._bcoo.indices),
+                                      shape=x._bcoo.shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Parity: paddle.sparse.sum (sparse_ops.yaml `sum`).  Axis reduction
+    drops the summed coordinate and coalesces duplicates — stays sparse
+    like the reference."""
+    from ..core.dispatch import apply_op
+    from ..core.dtypes import convert_dtype
+    acc = convert_dtype(dtype) if dtype is not None else None
+
+    def _cast(v):
+        return v.astype(acc) if acc is not None else v
+
+    n_sparse = x._bcoo.indices.shape[1]
+    if axis is None:
+        out_t = apply_op("sparse_sum_all",
+                         lambda v: jnp.sum(_cast(v)), (_values_tensor(x),))
+        return out_t
+    ax = axis + len(x.shape) if axis < 0 else axis
+    if ax >= n_sparse:      # dense (trailing) dim: reduce inside values
+        dax = ax - n_sparse + 1
+        out_t = apply_op("sparse_sum_dense",
+                         lambda v: jnp.sum(_cast(v), axis=dax,
+                                           keepdims=keepdim),
+                         (_values_tensor(x),))
+        new_shape = list(x.shape)
+        if keepdim:
+            new_shape[ax] = 1
+        else:
+            new_shape.pop(ax)
+        return _from_values_tensor(x, out_t, x._bcoo.indices, new_shape)
+    idx = np.asarray(x._bcoo.indices)
+    if keepdim:
+        new_idx = idx.copy()
+        new_idx[:, ax] = 0
+        new_shape = list(x.shape)
+        new_shape[ax] = 1
+    else:
+        new_idx = np.delete(idx, ax, axis=1)
+        new_shape = list(x.shape)
+        new_shape.pop(ax)
+    # coalesce duplicates with a segment-sum so grads flow to values
+    uniq, inv = np.unique(new_idx, axis=0, return_inverse=True)
+    inv = jnp.asarray(inv)
+    m = uniq.shape[0]
+
+    def seg(v):
+        return jax.ops.segment_sum(_cast(v), inv, num_segments=m)
+
+    out_t = apply_op("sparse_sum", seg, (_values_tensor(x),))
+    return _from_values_tensor(x, out_t, jnp.asarray(uniq, jnp.int32),
+                               new_shape)
+
+
+def reshape(x, shape, name=None):
+    """Parity: paddle.sparse.reshape — sparse dims remapped through the
+    flat index."""
+    old_sparse_shape = x.shape[: x._bcoo.indices.shape[1]]
+    dense_shape = x.shape[x._bcoo.indices.shape[1]:]
+    shape = list(shape)
+    if dense_shape:
+        if list(shape[len(shape) - len(dense_shape):]) != \
+                list(dense_shape):
+            raise ValueError("sparse reshape cannot cross the dense dims")
+        new_sparse = shape[: len(shape) - len(dense_shape)]
+    else:
+        new_sparse = shape
+    # resolve -1 within the sparse dims only
+    n_el = int(np.prod(old_sparse_shape))
+    known = int(np.prod([s for s in new_sparse if s != -1]))
+    new_sparse = [n_el // known if s == -1 else s for s in new_sparse]
+    if int(np.prod(new_sparse)) != n_el:
+        raise ValueError(
+            f"cannot reshape sparse dims {old_sparse_shape} to "
+            f"{new_sparse}")
+    idx = np.asarray(x._bcoo.indices)
+    flat = np.ravel_multi_index(idx.T, old_sparse_shape)
+    new_idx = np.stack(np.unravel_index(flat, new_sparse), axis=1)
+    return _from_values_tensor(
+        x, _values_tensor(x), jnp.asarray(new_idx, jnp.int32),
+        list(new_sparse) + list(dense_shape))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Parity: paddle.sparse.slice over the sparse dims."""
+    from ..core.dispatch import apply_op
+    idx = np.asarray(x._bcoo.indices)
+    new_shape = list(x.shape)
+    keep = np.ones(idx.shape[0], bool)
+    shift = np.zeros(idx.shape[1], np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax + len(x.shape) if ax < 0 else ax
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        if ax >= idx.shape[1]:
+            raise NotImplementedError("slice over dense dims")
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        shift[ax] = st
+        new_shape[ax] = en - st
+    sel = np.nonzero(keep)[0]
+    new_idx = idx[sel] - shift[None, :]
+    sel_j = jnp.asarray(sel)
+    out_t = apply_op("sparse_slice", lambda v: v[sel_j],
+                     (_values_tensor(x),))
+    return _from_values_tensor(x, out_t, jnp.asarray(new_idx, jnp.int32),
+                               new_shape)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (parity: sparse mv)."""
+    from ..core.dispatch import apply_op
+    idx = x._bcoo.indices
+    shp = x._bcoo.shape
+    v = vec if isinstance(vec, Tensor) else Tensor(vec)
+
+    def fn(vals, dvec):
+        return jsparse.BCOO((vals, idx), shape=shp) @ dvec
+
+    return apply_op("sparse_mv", fn, (_values_tensor(x), v))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) — x sparse, input/y dense (parity:
+    sparse addmm)."""
+    from ..core.dispatch import apply_op
+    idx = x._bcoo.indices
+    shp = x._bcoo.shape
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    dy = y if isinstance(y, Tensor) else Tensor(y)
+
+    def fn(dinp, vals, dv):
+        return beta * dinp + alpha * (
+            jsparse.BCOO((vals, idx), shape=shp) @ dv)
+
+    return apply_op("sparse_addmm", fn, (inp, _values_tensor(x), dy))
+
+
+# sparse.nn subpackage (conv/norm/pool/activations) lazily imports names
+# from this module, so import it last
+from . import nn  # noqa: E402
+
+__all__ += ["asin", "asinh", "atan", "atanh", "acos", "acosh", "sinh",
+            "tan", "leaky_relu", "relu6", "isnan", "scale",
+            "divide_scalar", "full_like", "sum", "reshape", "slice",
+            "mv", "addmm"]

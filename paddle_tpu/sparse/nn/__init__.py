@@ -1,0 +1,167 @@
+"""paddle.sparse.nn — sparse layers.
+
+Parity: python/paddle/sparse/nn/ (reference — layer/conv.py Conv3D:239 /
+SubmConv3D:509, layer/norm.py BatchNorm:24, layer/pooling.py MaxPool3D:20,
+layer/activation.py).  Functional ops in :mod:`.functional` (the
+gather-GEMM-scatter rulebook implementation).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn import initializer as I
+from . import functional as F
+from .functional import conv3d, subm_conv3d, max_pool3d, attention
+
+__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D", "ReLU",
+           "ReLU6", "LeakyReLU", "Softmax", "functional"]
+functional = F
+
+
+class _Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 key=None, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        ks = F._triple(kernel_size)
+        self._subm = subm
+        self._key = key
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [*ks, in_channels // groups, out_channels], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, self._stride,
+                      self._padding, self._dilation, self._groups,
+                      subm=self._subm, key=self._key,
+                      data_format=self._data_format)
+
+
+class Conv3D(_Conv3D):
+    """Parity: paddle.sparse.nn.Conv3D (layer/conv.py:239)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         padding_mode=padding_mode, weight_attr=weight_attr,
+                         bias_attr=bias_attr, data_format=data_format)
+
+
+class SubmConv3D(_Conv3D):
+    """Parity: paddle.sparse.nn.SubmConv3D (layer/conv.py:509) — output
+    sparsity pattern equals the input pattern."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, key=key,
+                         padding_mode=padding_mode, weight_attr=weight_attr,
+                         bias_attr=bias_attr, data_format=data_format)
+
+
+class BatchNorm(Layer):
+    """Batch norm over a sparse tensor's stored values, per channel
+    (parity: paddle.sparse.nn.BatchNorm, layer/norm.py:24 — the reference
+    subclasses BatchNorm1D and applies it to values())."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum, epsilon,
+                               weight_attr, bias_attr, data_format="NLC",
+                               use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from .. import _values_tensor, _from_values_tensor
+        vals = _values_tensor(x)
+        out = self._bn(vals.unsqueeze(0)).squeeze(0)
+        return _from_values_tensor(x, out, x._bcoo.indices,
+                                   x._bcoo.shape)
+
+
+class MaxPool3D(Layer):
+    """Parity: paddle.sparse.nn.MaxPool3D (layer/pooling.py:20)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._ks, self._st, self._pd = kernel_size, stride, padding
+        self._data_format = data_format
+
+    def forward(self, x):
+        return max_pool3d(x, self._ks, self._st, self._pd,
+                          data_format=self._data_format)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from .. import relu6
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from .. import leaky_relu
+        return leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    """Softmax over the last sparse axis, grouped by all leading sparse
+    coordinates (parity: paddle.sparse.nn.Softmax — only axis=-1 is
+    supported, like the reference)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 only")
+
+    def forward(self, x):
+        idx = np.asarray(x._bcoo.indices)
+        # group key = all sparse coords except the last (the softmax axis)
+        lead = idx[:, :-1]
+        uniq, rows_np = np.unique(lead, axis=0, return_inverse=True)
+        rows = jnp.asarray(rows_np)
+        n_rows = uniq.shape[0]
+        data = x._bcoo.data
+        row_max = jnp.full((n_rows,), -jnp.inf,
+                           data.dtype).at[rows].max(data)
+        e = jnp.exp(data - row_max[rows])
+        denom = jnp.zeros((n_rows,), data.dtype).at[rows].add(e)
+        from .. import _wrap_same
+        return _wrap_same(x, jsparse.BCOO(
+            (e / denom[rows], x._bcoo.indices), shape=x._bcoo.shape))
+
+    __call__ = forward

@@ -1,0 +1,260 @@
+"""Sparse conv/pool/attention functional ops.
+
+Parity: python/paddle/sparse/nn/functional/ (reference — conv.py conv3d/
+subm_conv3d over the conv3d_coo kernel with its gather-GEMM-scatter
+"rulebook", paddle/phi/kernels/sparse/gpu/conv_kernel.cu; pooling
+max_pool3d; transformer.py attention over SparseCsrTensor masks).
+
+TPU-native: the rulebook (which input point feeds which output point for
+each kernel offset) is computed host-side in numpy — it is pure integer
+coordinate matching, data-independent given the sparsity pattern — and
+the differentiable value math (per-offset gather -> (n, Ci) @ (Ci, Co)
+GEMM on the MXU -> scatter-add) runs through dispatch so gradients flow
+to features, kernel and bias via the tape."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply_op
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _offsets(kernel_dhw):
+    kd, kh, kw = kernel_dhw
+    return [(a, b, c) for a in range(kd) for b in range(kh)
+            for c in range(kw)]
+
+
+def _lin(coords, dims):
+    """coords (n, 4) [batch, d, h, w] -> int64 scalar key."""
+    key = coords[:, 0].astype(np.int64)
+    for i, s in enumerate(dims):
+        key = key * s + coords[:, i + 1]
+    return key
+
+
+def _build_rulebook(in_coords: np.ndarray, spatial, kernel_dhw, strides,
+                    paddings, dilations, subm: bool):
+    """The conv rulebook: output coords + per-offset (gather, scatter)
+    index pairs (reference: Conv3dCooKernel's rulebook/counter outputs)."""
+    kd, kh, kw = kernel_dhw
+    st = np.asarray(strides)
+    pd = np.asarray(paddings)
+    dl = np.asarray(dilations)
+    ksz = np.asarray(kernel_dhw)
+    out_spatial = tuple(
+        (np.asarray(spatial) + 2 * pd - dl * (ksz - 1) - 1) // st + 1)
+
+    if subm:
+        if tuple(st) != (1, 1, 1):
+            raise ValueError("submanifold conv requires stride 1")
+        out_coords = in_coords
+        out_spatial = tuple(spatial)
+    else:
+        cands = []
+        for off in _offsets(kernel_dhw):
+            c = in_coords[:, 1:4] + pd - dl * np.asarray(off)
+            ok = np.all((c % st == 0) & (c >= 0), axis=1)
+            o = c[ok] // st
+            ok2 = np.all(o < np.asarray(out_spatial), axis=1)
+            cands.append(np.concatenate(
+                [in_coords[ok][ok2][:, :1], o[ok2]], axis=1))
+        allc = np.concatenate(cands, axis=0) if cands else \
+            np.zeros((0, 4), np.int64)
+        out_coords = np.unique(allc, axis=0)
+
+    in_keys = _lin(in_coords, spatial)
+    order = np.argsort(in_keys)
+    sorted_keys = in_keys[order]
+
+    pairs = []
+    for off in _offsets(kernel_dhw):
+        tgt = out_coords[:, 1:4] * st - pd + dl * np.asarray(off)
+        valid = np.all((tgt >= 0) & (tgt < np.asarray(spatial)), axis=1)
+        keys = _lin(np.concatenate([out_coords[:, :1], tgt], axis=1),
+                    spatial)
+        pos = np.searchsorted(sorted_keys, keys)
+        pos = np.clip(pos, 0, len(sorted_keys) - 1)
+        found = valid & (len(sorted_keys) > 0) & \
+            (sorted_keys[pos] == keys)
+        j_out = np.nonzero(found)[0]
+        i_in = order[pos[found]]
+        pairs.append((jnp.asarray(i_in, jnp.int32),
+                      jnp.asarray(j_out, jnp.int32)))
+    return out_coords.astype(np.int64), out_spatial, pairs
+
+
+def _sp_parts(x):
+    """(values Tensor, indices np, batch, spatial, channels)."""
+    from .. import _values_tensor
+    idx = np.asarray(x._bcoo.indices, np.int64)
+    if idx.shape[1] != 4 or x._bcoo.data.ndim != 2:
+        raise ValueError(
+            "sparse conv/pool expect an NDHWC tensor with 4 sparse dims "
+            "(batch, d, h, w) and a DENSE channel dim — build it as "
+            "sparse_coo_tensor(indices[4, nnz], values[nnz, C], shape); "
+            f"got {idx.shape[1]} sparse dims, values ndim "
+            f"{x._bcoo.data.ndim}")
+    shape = x.shape
+    return (_values_tensor(x), idx, shape[0], tuple(shape[1:4]), shape[4])
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           subm=False, key=None, data_format="NDHWC", name=None):
+    """Sparse 3-D convolution over an NDHWC SparseCooTensor (parity:
+    paddle.sparse.nn.functional.conv3d / subm_conv3d; sparse_ops.yaml
+    conv3d)."""
+    from .. import _from_values_tensor
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only")
+    if groups != 1:
+        raise NotImplementedError("sparse conv3d: groups must be 1")
+    vals_t, idx, batch, spatial, cin = _sp_parts(x)
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    kd, kh, kw = (int(s) for s in w.shape[:3])
+    out_coords, out_spatial, pairs = _build_rulebook(
+        idx, spatial, (kd, kh, kw), _triple(stride), _triple(padding),
+        _triple(dilation), subm)
+    m = out_coords.shape[0]
+    cout = int(w.shape[-1])
+    tensor_args = [vals_t, w] + ([bias] if bias is not None else [])
+
+    def compute(feats, wk, *b):
+        wk2 = wk.reshape(kd * kh * kw, cin, cout)
+        out = jnp.zeros((m, cout), feats.dtype)
+        for k, (gi, so) in enumerate(pairs):
+            if gi.shape[0] == 0:
+                continue
+            out = out.at[so].add(feats[gi] @ wk2[k])
+        if b:
+            out = out + b[0]
+        return out
+
+    out_t = apply_op("sparse_conv3d", compute, tensor_args)
+    out_shape = [batch, *out_spatial, cout]
+    return _from_values_tensor(x, out_t,
+                               jnp.asarray(out_coords, jnp.int32),
+                               out_shape)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, key=None, data_format="NDHWC", name=None):
+    return conv3d(x, weight, bias, stride, padding, dilation, groups,
+                  subm=True, key=key, data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse 3-D max pooling over existing points (parity:
+    paddle.sparse.nn.functional.max_pool3d; sparse_ops.yaml maxpool)."""
+    from .. import _from_values_tensor
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    ks = _triple(kernel_size)
+    st = _triple(stride if stride is not None else kernel_size)
+    vals_t, idx, batch, spatial, ch = _sp_parts(x)
+    out_coords, out_spatial, pairs = _build_rulebook(
+        idx, spatial, ks, st, _triple(padding), (1, 1, 1), subm=False)
+    m = out_coords.shape[0]
+
+    def compute(feats):
+        out = jnp.full((m, ch), -jnp.inf, feats.dtype)
+        for gi, so in pairs:
+            if gi.shape[0] == 0:
+                continue
+            out = out.at[so].max(feats[gi])
+        # every out coord has >=1 contributor by construction
+        return out
+
+    out_t = apply_op("sparse_maxpool", compute, (vals_t,))
+    return _from_values_tensor(x, out_t,
+                               jnp.asarray(out_coords, jnp.int32),
+                               [batch, *out_spatial, ch])
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention: softmax((QK^T)/sqrt(d) over the mask's
+    nonzero pattern) V (parity: paddle.sparse.nn.functional.attention,
+    sparse_ops.yaml fused_attention over a SparseCsrTensor mask).
+
+    query/key/value: dense (B, H, S, D) Tensors.  sparse_mask's pattern
+    selects which scores exist: a 2-D (S, S) mask is shared by every
+    batch/head; a 3-D (B*H, S, S) mask (the reference's CSR layout)
+    selects per batch-head.  ``key_padding_mask`` (B, S) and
+    ``attn_mask`` (S, S) are ADDED to the scores like the reference
+    (use -inf/large-negative to mask out)."""
+    q = query if isinstance(query, Tensor) else Tensor(query)
+    k = key if isinstance(key, Tensor) else Tensor(key)
+    v = value if isinstance(value, Tensor) else Tensor(value)
+    idx = np.asarray(sparse_mask._bcoo.indices, np.int64)
+    rows = jnp.asarray(idx[:, -2])
+    cols = jnp.asarray(idx[:, -1])
+    per_bh = idx.shape[1] >= 3
+    bidx = jnp.asarray(idx[:, 0]) if per_bh else None
+    B, H, S, _ = q.shape
+    kpm = None
+    if key_padding_mask is not None:
+        kpm = key_padding_mask._value if isinstance(
+            key_padding_mask, Tensor) else jnp.asarray(key_padding_mask)
+    amask = None
+    if attn_mask is not None:
+        amask = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+
+    def compute(qv, kv, vv):
+        d = qv.shape[-1]
+        scale = jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(qv.dtype)
+        qf = qv.reshape(B * H, S, d)
+        kf = kv.reshape(B * H, S, d)
+        vf = vv.reshape(B * H, S, d)
+        if per_bh:
+            # per-(batch*head) pattern: scores per nnz, segmented rows
+            qs = qf[bidx, rows]
+            ks = kf[bidx, cols]
+            scores = (qs * ks).sum(-1) / scale          # (nnz,)
+            if kpm is not None:
+                scores = scores + kpm.reshape(B, S)[bidx // H, cols]
+            if amask is not None:
+                scores = scores + amask[rows, cols]
+            seg = bidx * S + rows
+            nseg = B * H * S
+            smax = jnp.full((nseg,), -jnp.inf,
+                            scores.dtype).at[seg].max(scores)
+            e = jnp.exp(scores - smax[seg])
+            den = jnp.zeros((nseg,), scores.dtype).at[seg].add(e)
+            p = e / den[seg]
+            out = jnp.zeros_like(qf).at[bidx, rows].add(
+                p[:, None] * vf[bidx, cols])
+            return out.reshape(qv.shape)
+        # shared (S, S) pattern: vectorized over batch*head
+        qs = qf[:, rows]                                 # (BH, nnz, d)
+        ks = kf[:, cols]
+        scores = (qs * ks).sum(-1) / scale               # (BH, nnz)
+        if kpm is not None:
+            pad = jnp.repeat(kpm.reshape(B, S), H, axis=0)  # (BH, S)
+            scores = scores + pad[:, cols]
+        if amask is not None:
+            scores = scores + amask[rows, cols]
+        smax = jnp.full((B * H, S), -jnp.inf,
+                        scores.dtype).at[:, rows].max(scores)
+        e = jnp.exp(scores - smax[:, rows])
+        den = jnp.zeros((B * H, S), scores.dtype).at[:, rows].add(e)
+        p = e / den[:, rows]
+        out = jnp.zeros_like(qf).at[:, rows].add(
+            p[..., None] * vf[:, cols])
+        return out.reshape(qv.shape)
+
+    return apply_op("sparse_fused_attention", compute, (q, k, v))
