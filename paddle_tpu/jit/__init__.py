@@ -17,6 +17,8 @@ TranslatedLayer.
 from .api import to_static, StaticFunction, not_to_static, ignore_module
 from .save_load import save, load, TranslatedLayer
 from .api import enable_to_static
+from .convert_ops import bounded_loops
 
 __all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
+           "bounded_loops",
            "not_to_static", "enable_to_static"]

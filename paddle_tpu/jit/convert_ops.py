@@ -99,21 +99,86 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable):
     return _wrap_like(out_vals, t_out)
 
 
+# Thread-local bound for traced while-loops: inside ``bounded_loops(n)``
+# a tensor-predicate while lowers to a masked lax.scan of length n, which
+# XLA CAN reverse-differentiate (lax.while_loop cannot).  The TPU-native
+# answer to the reference's differentiable static While op
+# (python/paddle/static/nn/control_flow.py While).
+import threading as _threading
+
+_LOOP_BOUND = _threading.local()
+
+
+class bounded_loops:
+    """Context manager: declare a static trip-count bound for traced
+    tensor-``while`` loops so they become reverse-differentiable.
+
+        with paddle_tpu.jit.bounded_loops(64):
+            loss = traced_fn_with_tensor_while(x)
+        loss.backward()           # works: the loop is a masked scan
+    """
+
+    def __init__(self, max_iters: int):
+        self._n = int(max_iters)
+
+    def __enter__(self):
+        self._prev = getattr(_LOOP_BOUND, "n", None)
+        _LOOP_BOUND.n = self._n
+        return self
+
+    def __exit__(self, *exc):
+        _LOOP_BOUND.n = self._prev
+        return False
+
+
+def _bounded_while(cond, body, init_vals, max_iters: int):
+    """while as a masked scan: runs exactly ``max_iters`` (masked) steps,
+    so reverse-mode AD applies.  Semantically equal to the while loop
+    whenever the true trip count <= max_iters."""
+    def step(carry, _):
+        vals, active = carry
+        act = active & cond(vals)
+        new = body(vals)
+        vals = tuple(jnp.where(act, n, v) for n, v in zip(new, vals))
+        return (vals, act), None
+
+    (out_vals, _), _ = lax.scan(step, (tuple(init_vals),
+                                       jnp.asarray(True)), None,
+                                length=max_iters)
+    return out_vals
+
+
 def convert_while_loop(cond_fn: Callable, body_fn: Callable,
-                       loop_vars: Tuple):
+                       loop_vars: Tuple, max_iters: int = None):
     """``while`` whose condition may be a traced tensor.
 
     Loop-carried variables are exactly the names the transformer passed;
     under trace they become the ``lax.while_loop`` carry (shapes must be
-    loop-invariant)."""
+    loop-invariant).  With ``max_iters`` (explicit, or ambient via
+    :class:`bounded_loops`) a traced loop lowers to a masked ``lax.scan``
+    instead — differentiable in reverse mode."""
+    def _norm(out):
+        # body may return list (paddle convention), tuple, or scalar
+        if isinstance(out, list):
+            return tuple(out)
+        if not isinstance(out, tuple):
+            return (out,)
+        return out
+
+    if max_iters is None:
+        max_iters = getattr(_LOOP_BOUND, "n", None)
+
     first = cond_fn(*loop_vars)
     if not _is_traced(first):
-        # eager python loop (condition re-evaluated on real values)
+        # eager python loop (condition re-evaluated on real values);
+        # max_iters truncates exactly like the traced masked scan
+        it = 0
         while bool(np.asarray(_pred_value(first))):
-            loop_vars = body_fn(*loop_vars)
-            if not isinstance(loop_vars, tuple):
-                loop_vars = (loop_vars,)
+            if max_iters is not None and it >= int(max_iters):
+                break
+            loop_vars = _norm(body_fn(*loop_vars))
             first = cond_fn(*loop_vars)
+            it += 1
         return loop_vars
 
     template = loop_vars
@@ -124,12 +189,13 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
 
     def body(vals):
         vars_ = _wrap_like(vals, template)
-        out = body_fn(*vars_)
-        if not isinstance(out, tuple):
-            out = (out,)
-        return _to_vals(out)
+        return _to_vals(_norm(body_fn(*vars_)))
 
-    out_vals = lax.while_loop(cond, body, _to_vals(loop_vars))
+    if max_iters is not None:
+        out_vals = _bounded_while(cond, body, _to_vals(loop_vars),
+                                  int(max_iters))
+    else:
+        out_vals = lax.while_loop(cond, body, _to_vals(loop_vars))
     return _wrap_like(out_vals, template)
 
 

@@ -149,19 +149,24 @@ class Softmax(Layer):
             raise ValueError("sparse Softmax supports axis=-1 only")
 
     def forward(self, x):
+        from ...core.dispatch import apply_op
+        from .. import _values_tensor, _from_values_tensor
         idx = np.asarray(x._bcoo.indices)
         # group key = all sparse coords except the last (the softmax axis)
         lead = idx[:, :-1]
         uniq, rows_np = np.unique(lead, axis=0, return_inverse=True)
         rows = jnp.asarray(rows_np)
         n_rows = uniq.shape[0]
-        data = x._bcoo.data
-        row_max = jnp.full((n_rows,), -jnp.inf,
-                           data.dtype).at[rows].max(data)
-        e = jnp.exp(data - row_max[rows])
-        denom = jnp.zeros((n_rows,), data.dtype).at[rows].add(e)
-        from .. import _wrap_same
-        return _wrap_same(x, jsparse.BCOO(
-            (e / denom[rows], x._bcoo.indices), shape=x._bcoo.shape))
+
+        def compute(data):
+            row_max = jnp.full((n_rows,), -jnp.inf,
+                               data.dtype).at[rows].max(data)
+            e = jnp.exp(data - row_max[rows])
+            denom = jnp.zeros((n_rows,), data.dtype).at[rows].add(e)
+            return e / denom[rows]
+
+        out_t = apply_op("sparse_softmax", compute, (_values_tensor(x),))
+        return _from_values_tensor(x, out_t, x._bcoo.indices,
+                                   x._bcoo.shape)
 
     __call__ = forward

@@ -92,6 +92,7 @@ class Program:
         self.train_spec = None             # (loss Tensor, optimizer)
         self.amp_config = None             # (level, dtype) via static.amp
         self.fp16_spec = None              # set by the fp16 program pass
+        self._nn_params: List[Any] = []    # created by static.nn helpers
         self._compiled: Dict[Any, Any] = {}
 
     # -- capture-side API ----------------------------------------------------
@@ -123,6 +124,11 @@ class Program:
 
     def global_block(self):
         return self
+
+    def all_parameters(self):
+        """Parameters created at build time by static.nn helpers (parity:
+        Program.all_parameters over the global block's persistables)."""
+        return list(self._nn_params)
 
     @property
     def ops(self):
@@ -610,3 +616,9 @@ class _StaticAmp:
 
 
 amp = _StaticAmp()
+
+
+# static.nn control flow + layer helpers (imports converters from jit, so
+# import last)
+from . import nn  # noqa: E402
+__all__.append("nn")

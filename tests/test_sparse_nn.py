@@ -373,3 +373,16 @@ def test_sparse_add_and_binary_keep_grad():
     np.testing.assert_allclose(_dense_of(z), 2 * np.maximum(dense, 0))
     w = sparse.multiply(z, z)
     np.testing.assert_allclose(_dense_of(w), (2 * dense) ** 2)
+
+
+def test_sparse_softmax_preserves_grad_chain():
+    from paddle_tpu import sparse
+    dense = np.array([[1.0, 2.0], [0.0, 3.0]], np.float32)
+    idx = np.stack(np.nonzero(dense))
+    x = sparse.sparse_coo_tensor(idx, dense[np.nonzero(dense)],
+                                 dense.shape)
+    src = paddle.to_tensor(dense[np.nonzero(dense)], stop_gradient=False)
+    x._values_t = src
+    out = snn.Softmax()(x)
+    out.values().sum().backward()
+    assert src.grad is not None and np.isfinite(src.grad.numpy()).all()

@@ -1,0 +1,196 @@
+"""paddle.static.nn control flow + differentiable bounded loops.
+
+Reference analogs: python/paddle/static/nn/control_flow.py (cond :1047,
+while_loop :1249, case :1393, switch_case :1511), common.py (fc :63,
+embedding); the bounded-while -> masked lax.scan lowering is the
+TPU-native answer to the reference's While grad op."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def test_cond_python_and_tensor_pred():
+    out = static.nn.cond(True, lambda: paddle.to_tensor(1.0),
+                         lambda: paddle.to_tensor(2.0))
+    assert float(out.numpy()) == 1.0
+
+    @paddle.jit.to_static
+    def f(x):
+        return static.nn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(f(x).numpy(), [2.0, 4.0])
+    xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+
+
+def test_while_loop_eager_and_traced():
+    def cond(i, s):
+        return i < 5
+
+    def body(i, s):
+        return i + 1, s + i
+
+    i0 = paddle.to_tensor(0)
+    s0 = paddle.to_tensor(0)
+    i, s = static.nn.while_loop(cond, body, [i0, s0])
+    assert int(s.numpy()) == 10
+
+    @paddle.jit.to_static
+    def f(n):
+        i = paddle.to_tensor(0)
+        s = paddle.zeros([])
+        i, s = static.nn.while_loop(
+            lambda i, s: i < n, lambda i, s: (i + 1, s + 2.0), [i, s])
+        return s
+
+    assert float(f(paddle.to_tensor(4)).numpy()) == 8.0
+
+
+def test_while_loop_max_iters_differentiable():
+    """Bounded tensor-while reverse-differentiates (masked scan)."""
+    @paddle.jit.to_static
+    def f(x, n):
+        i = paddle.to_tensor(0)
+        i, y = static.nn.while_loop(
+            lambda i, y: i < n,
+            lambda i, y: (i + 1, y * x),
+            [i, paddle.ones([])], max_iters=8)
+        return y
+
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    n = paddle.to_tensor(3)
+    y = f(x, n)                      # x^3 = 8
+    assert float(y.numpy()) == 8.0
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)   # 3 x^2
+
+
+def test_bounded_loops_context_differentiable():
+    """The ambient bound: user code with a plain tensor `while` becomes
+    differentiable inside paddle.jit.bounded_loops(n)."""
+    @paddle.jit.to_static
+    def geom(x, n):
+        s = paddle.zeros([])
+        t = paddle.ones([])
+        i = paddle.to_tensor(0)
+        while i < n:                  # dy2static converts to while_loop
+            s = s + t
+            t = t * x
+            i = i + 1
+        return s                      # 1 + x + x^2 (n=3)
+
+    x = paddle.to_tensor(0.5, stop_gradient=False)
+    with paddle.jit.bounded_loops(10):
+        s = geom(x, paddle.to_tensor(3))
+        np.testing.assert_allclose(float(s.numpy()), 1.75)
+        s.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.0 + 2 * 0.5)  # 1 + 2x
+
+
+def test_bounded_while_matches_unrolled_grad():
+    """Grad through the bounded while == grad of the unrolled eager
+    computation (the VERDICT ask #5 parity gate)."""
+    def unrolled(xv):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = paddle.ones([])
+        for _ in range(4):
+            y = y * paddle.sin(x)
+        y.backward()
+        return float(x.grad.numpy())
+
+    @paddle.jit.to_static
+    def looped(x, n):
+        i = paddle.to_tensor(0)
+        i, y = static.nn.while_loop(
+            lambda i, y: i < n, lambda i, y: (i + 1, y * paddle.sin(x)),
+            [i, paddle.ones([])], max_iters=6)
+        return y
+
+    x = paddle.to_tensor(0.9, stop_gradient=False)
+    y = looped(x, paddle.to_tensor(4))
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), unrolled(0.9), rtol=1e-5)
+
+
+def test_case_and_switch_case():
+    x = paddle.to_tensor(0.3)
+    out = static.nn.case(
+        [(x > 0.5, lambda: paddle.to_tensor(1.0)),
+         (x > 0.1, lambda: paddle.to_tensor(2.0))],
+        default=lambda: paddle.to_tensor(3.0))
+    assert float(out.numpy()) == 2.0
+
+    out2 = static.nn.switch_case(
+        paddle.to_tensor(2),
+        {1: lambda: paddle.to_tensor(10.0),
+         2: lambda: paddle.to_tensor(20.0)},
+        default=lambda: paddle.to_tensor(-1.0))
+    assert float(out2.numpy()) == 20.0
+
+    @paddle.jit.to_static
+    def f(i):
+        return static.nn.switch_case(
+            i, {0: lambda: paddle.to_tensor(5.0),
+                1: lambda: paddle.to_tensor(6.0)},
+            default=lambda: paddle.to_tensor(7.0))
+
+    assert float(f(paddle.to_tensor(1)).numpy()) == 6.0
+    assert float(f(paddle.to_tensor(9)).numpy()) == 7.0
+
+    with pytest.raises(ValueError, match="duplicate"):
+        static.nn.switch_case(paddle.to_tensor(0),
+                              [(0, lambda: 1), (0, lambda: 2)])
+
+
+def test_static_fc_embedding_program_trains():
+    """fc/embedding create build-time params collected by
+    Program.all_parameters(); the captured program trains via minimize
+    (parity: the LayerHelper static idiom)."""
+    main = static.Program()
+    with static.program_guard(main):
+        ids = static.data("ids", [8, 4], "int64")
+        y = static.data("y", [8, 1], "float32")
+        paddle.seed(11)
+        emb = static.nn.embedding(ids, size=[16, 8])     # (8, 4, 8)
+        flat = emb.reshape([8, 32])
+        h = static.nn.fc(flat, 16, activation="relu")
+        out = static.nn.fc(h, 1)
+        loss = ((out - y) ** 2).mean()
+        params = main.all_parameters()
+        assert len(params) == 5          # emb + 2x(w, b)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params)
+        opt.minimize(loss)
+
+    rng = np.random.RandomState(0)
+    idv = rng.randint(0, 16, (8, 4)).astype(np.int64)
+    yv = rng.rand(8, 1).astype(np.float32)
+    exe = static.Executor()
+    losses = [float(exe.run(main, feed={"ids": idv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_while_loop_body_returns_list():
+    i, s = static.nn.while_loop(
+        lambda i, s: i < 3, lambda i, s: [i + 1, s + i],
+        [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(s.numpy()) == 3
+
+    @paddle.jit.to_static
+    def f(n):
+        i, s = static.nn.while_loop(
+            lambda i, s: i < n, lambda i, s: [i + 1, s + 1.0],
+            [paddle.to_tensor(0), paddle.zeros([])])
+        return s
+
+    assert float(f(paddle.to_tensor(5)).numpy()) == 5.0
+
+
+def test_while_loop_max_iters_truncates_eager_like_traced():
+    i, s = static.nn.while_loop(
+        lambda i, s: i < 100, lambda i, s: (i + 1, s + 1),
+        [paddle.to_tensor(0), paddle.to_tensor(0)], max_iters=7)
+    assert int(s.numpy()) == 7       # truncated, same as the masked scan
