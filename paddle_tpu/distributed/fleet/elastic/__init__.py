@@ -20,8 +20,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["ElasticStatus", "KVStore", "FileKVStore", "ElasticManager",
-           "ELASTIC_TIMEOUT"]
+__all__ = ["ElasticStatus", "KVStore", "FileKVStore", "TCPKVStore",
+           "make_kv_store", "ElasticManager", "ELASTIC_TIMEOUT"]
 
 ELASTIC_TIMEOUT = 30
 
@@ -201,3 +201,99 @@ class ElasticManager:
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_ELASTIC_HOSTS": ",".join(hosts),
         }
+
+
+class TCPKVStore(KVStore):
+    """Registry over the native TCPStore — elastic without a shared
+    filesystem (the multi-cluster analog of the reference's etcd
+    backend, manager.py:126).
+
+    The store has no key-listing command, so membership is kept in a
+    per-store index key maintained read-modify-write; a raced-away
+    insert self-heals on the node's next heartbeat rewrite (<= one
+    heartbeat_interval of staleness, the same window a TTL expiry
+    already tolerates).
+    """
+
+    _INDEX = "__elastic_index__"
+
+    def __init__(self, store):
+        """``store``: a connected paddle_tpu.distributed.TCPStore."""
+        self._s = store
+        # TCPStore GET blocks until the key exists, so an absent index
+        # would cost the full timeout on every read — create it exactly
+        # once (ADD is atomic: only the first client sees 1)
+        if self._s.add(self._INDEX + "_init", 1) == 1:
+            self._s.set(self._INDEX, "")
+
+    # -- raw helpers ---------------------------------------------------------
+    def _raw_get(self, key):
+        try:
+            return self._s.get(key, timeout=0.5).decode()
+        except (TimeoutError, ConnectionError):
+            return None
+
+    def _index(self):
+        raw = self._raw_get(self._INDEX) or ""
+        return set(k for k in raw.split("\n") if k)
+
+    def _write_index(self, keys):
+        self._s.set(self._INDEX, "\n".join(sorted(keys)))
+
+    # -- KVStore surface -----------------------------------------------------
+    def put(self, key, value):
+        self._s.set(key, value)
+        for _ in range(4):
+            keys = self._index()
+            if key in keys:
+                return
+            keys.add(key)
+            self._write_index(keys)
+
+    def get(self, key):
+        return self._raw_get(key)
+
+    def delete(self, key):
+        self._s.delete_key(key)
+        for _ in range(4):       # same retry discipline as put()
+            keys = self._index()
+            if key not in keys:
+                return
+            keys.discard(key)
+            self._write_index(keys)
+
+    def list(self, prefix):
+        out = {}
+        dead = set()
+        keys = self._index()
+        for k in keys:
+            if k.startswith(prefix):
+                v = self._raw_get(k)
+                if v is None:
+                    dead.add(k)   # deleted key still indexed: prune it
+                else:
+                    out[k] = v
+        if dead:
+            self._write_index(keys - dead)
+        return out
+
+    def mtime(self, key):
+        return time.time() if self.get(key) is not None else 0.0
+
+
+def make_kv_store(spec: str, is_master: bool = False) -> KVStore:
+    """Build a KVStore from a launcher spec: ``tcp://host:port`` (native
+    TCPStore — the launcher passes is_master=True on node 0, which hosts
+    the server; PADDLE_ELASTIC_STORE_MASTER=0/1 overrides, e.g. when an
+    external store is already running) or a filesystem path
+    (FileKVStore)."""
+    if spec.startswith("tcp://"):
+        from ...store import TCPStore
+        host, port = spec[len("tcp://"):].rsplit(":", 1)
+        env = os.environ.get("PADDLE_ELASTIC_STORE_MASTER")
+        if env is not None:
+            is_master = env == "1"
+        store = TCPStore(host, int(port), is_master=is_master,
+                         timeout=10.0)
+        return TCPKVStore(store)
+    return FileKVStore(spec)

@@ -48,7 +48,9 @@ def parse_args(argv=None):
                         "--nnodes 'min:max')")
     p.add_argument("--elastic_store", type=str,
                    default=os.environ.get("PADDLE_ELASTIC_STORE", ""),
-                   help="shared directory backing the elastic registry")
+                   help="elastic registry: shared directory, or "
+                        "tcp://host:port for the native TCPStore "
+                        "(no shared FS needed)")
     p.add_argument("--host", type=str,
                    default=os.environ.get("POD_IP", None),
                    help="this node's registry identity; defaults to "
@@ -131,10 +133,11 @@ def _launch_elastic(args, env, cmd):
     register this node in the shared store, keep the worker running, and
     on membership change relaunch it with a regenerated rank map."""
     from ..fleet.elastic import (ElasticManager, ElasticStatus,
-                                 FileKVStore)
+                                 make_kv_store)
     host = args.host or f"node-{args.node_rank}"
     mgr = ElasticManager(args.job_id, args.nnodes, host,
-                         FileKVStore(args.elastic_store),
+                         make_kv_store(args.elastic_store,
+                                       is_master=args.node_rank == 0),
                          heartbeat_interval=0.5, ttl=3.0)
     mgr.register()
     try:

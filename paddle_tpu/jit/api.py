@@ -176,9 +176,13 @@ class StaticFunction:
         # train/eval flags of every (sub)layer are part of the program key
         modes = tuple(l.training for layer in self._layers
                       for _, l in layer.named_sublayers(include_self=True))
+        # the ambient bounded_loops bound changes how tensor whiles lower
+        # (masked scan vs while_loop) — it must be part of the cache key
+        from .convert_ops import _LOOP_BOUND
+        loop_bound = getattr(_LOOP_BOUND, "n", None)
         sig = (str(arg_tree), tuple(_leaf_sig(a) for a in flat_args),
                tuple((tuple(t._value.shape), str(t._value.dtype))
-                     for t in state_tensors), modes)
+                     for t in state_tensors), modes, loop_bound)
 
         compiled = self._cache.get(sig)
         if compiled is None:

@@ -165,13 +165,13 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
             return (out,)
         return out
 
-    if max_iters is None:
-        max_iters = getattr(_LOOP_BOUND, "n", None)
-
     first = cond_fn(*loop_vars)
     if not _is_traced(first):
-        # eager python loop (condition re-evaluated on real values);
-        # max_iters truncates exactly like the traced masked scan
+        # eager python loop (condition re-evaluated on real values).
+        # Only an EXPLICIT max_iters truncates here (matching the traced
+        # masked scan); the ambient bounded_loops bound exists purely to
+        # make traced loops differentiable and must not change eager
+        # semantics.
         it = 0
         while bool(np.asarray(_pred_value(first))):
             if max_iters is not None and it >= int(max_iters):
@@ -180,6 +180,9 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
             first = cond_fn(*loop_vars)
             it += 1
         return loop_vars
+
+    if max_iters is None:
+        max_iters = getattr(_LOOP_BOUND, "n", None)
 
     template = loop_vars
 

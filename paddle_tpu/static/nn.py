@@ -137,10 +137,10 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
     helper = Layer()
     for i, xi in enumerate(xs):
         shape = xi.shape
-        if num_flatten_dims < 0:
-            num_flatten_dims += len(shape)
-        in_dim = int(np.prod(shape[num_flatten_dims:]))
-        flat = xi.reshape(shape[:num_flatten_dims] + [in_dim])
+        nfd = num_flatten_dims + len(shape) if num_flatten_dims < 0 \
+            else num_flatten_dims
+        in_dim = int(np.prod(shape[nfd:]))
+        flat = xi.reshape(shape[:nfd] + [in_dim])
         w = _register_program_param(helper.create_parameter(
             [in_dim, size], attr=weight_attr,
             default_initializer=_nn.initializer.XavierUniform()))

@@ -126,3 +126,120 @@ def test_launcher_elastic_restart_on_scale_out(tmp_path):
     assert ret == 0, proc.stdout.read()[-2000:]
     runs = out.read_text().split()
     assert runs[0] == "1" and runs[-1] == "2", runs
+
+
+WORKER_SRC = '''
+import json, os, sys
+import numpy as np
+import jax; jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+
+ckpt = os.environ["CKPT_PATH"]
+log = os.environ["LOSS_LOG"]
+crash_at = int(os.environ.get("CRASH_AT", "-1"))
+paddle.seed(0)
+net = paddle.nn.Linear(4, 1)
+opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=net.parameters())
+start = 0
+if os.path.exists(ckpt + ".meta"):
+    net.set_state_dict(paddle.load(ckpt + ".pdparams"))
+    opt.set_state_dict(paddle.load(ckpt + ".pdopt"))
+    start = json.load(open(ckpt + ".meta"))["step"]
+rng = np.random.RandomState(0)
+X = paddle.to_tensor(rng.rand(16, 4).astype("float32"))
+Y = paddle.to_tensor(rng.rand(16, 1).astype("float32"))
+for step in range(start, 12):
+    loss = ((net(X) - Y) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+    with open(log, "a") as f:
+        f.write(f"{step} {float(loss.numpy()):.8f}\\n")
+    paddle.save(net.state_dict(), ckpt + ".pdparams")
+    paddle.save(opt.state_dict(), ckpt + ".pdopt")
+    json.dump({"step": step + 1}, open(ckpt + ".meta", "w"))
+    if step == crash_at and not os.path.exists(ckpt + ".crashed"):
+        open(ckpt + ".crashed", "w").write("1")
+        os.kill(os.getpid(), 9)        # SIGKILL: hard crash mid-step
+sys.exit(0)
+'''
+
+
+def _run_training(tmp_path, tag, crash_at, elastic_store, extra_env=None):
+    script = tmp_path / f"worker_{tag}.py"
+    script.write_text(WORKER_SRC)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_")}
+    env.update(extra_env or {})
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CKPT_PATH"] = str(tmp_path / f"ckpt_{tag}")
+    env["LOSS_LOG"] = str(tmp_path / f"loss_{tag}.log")
+    env["CRASH_AT"] = str(crash_at)
+    ret = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--node_rank", "0", "--elastic_level", "1",
+         "--elastic_store", elastic_store, "--host", "nodeA",
+         "--max_restarts", "3", str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert ret.returncode == 0, ret.stdout[-2000:] + ret.stderr[-2000:]
+    lines = (tmp_path / f"loss_{tag}.log").read_text().split("\n")
+    return [(int(l.split()[0]), float(l.split()[1]))
+            for l in lines if l.strip()]
+
+
+def test_kill_worker_resumes_from_checkpoint_with_loss_continuity(
+        tmp_path):
+    """The core elastic promise (reference manager.py:240,301): the
+    worker is SIGKILLed mid-training, the supervisor relaunches it, the
+    relaunched worker reloads the distributed checkpoint (params +
+    Momentum state) and the loss trajectory continues EXACTLY as if no
+    crash had happened."""
+    ref = _run_training(tmp_path, "ref", crash_at=-1,
+                        elastic_store=str(tmp_path / "store_ref"))
+    crashed = _run_training(tmp_path, "crash", crash_at=5,
+                            elastic_store=str(tmp_path / "store_crash"))
+    assert [s for s, _ in ref] == list(range(12))
+    # crashed run: steps 0..5, crash, resume at 6 (no step lost, none
+    # repeated — the checkpoint was written before the kill)
+    assert [s for s, _ in crashed] == list(range(12))
+    for (sr, lr), (sc, lc) in zip(ref, crashed):
+        assert sr == sc and abs(lr - lc) < 1e-7, (sr, lr, lc)
+    # the crash really happened
+    assert (tmp_path / "ckpt_crash.crashed").exists()
+
+
+def test_tcp_kv_store_backs_elastic_registry(tmp_path):
+    """TCPKVStore: elastic membership without a shared filesystem."""
+    from paddle_tpu.distributed import TCPStore
+    from paddle_tpu.distributed.fleet.elastic import TCPKVStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    kv_a = TCPKVStore(TCPStore("127.0.0.1", master.port))
+    kv_b = TCPKVStore(TCPStore("127.0.0.1", master.port))
+    a = ElasticManager("job2", "1:3", "hostA", kv_a,
+                       heartbeat_interval=0.1, ttl=0.5)
+    b = ElasticManager("job2", "1:3", "hostB", kv_b,
+                       heartbeat_interval=0.1, ttl=0.5)
+    a.register()
+    assert a.status() == ElasticStatus.OK
+    b.register()
+    assert a.hosts() == ["hostA", "hostB"]
+    assert a.status() == ElasticStatus.RESTART     # scale-out seen
+    b.exit(completed=False)                        # B leaves
+    time.sleep(0.7)
+    assert a.status() == ElasticStatus.RESTART     # scale-in seen
+    assert a.hosts() == ["hostA"]
+    a.exit()
+
+
+def test_kill_resume_with_tcp_store(tmp_path):
+    """Kill -> re-rendezvous -> checkpoint resume over the TCP registry
+    (no shared FS)."""
+    from paddle_tpu.distributed import TCPStore
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    # the test hosts the store, so the launcher joins as a client (the
+    # documented external-store override)
+    losses = _run_training(
+        tmp_path, "tcp", crash_at=3,
+        elastic_store=f"tcp://127.0.0.1:{master.port}",
+        extra_env={"PADDLE_ELASTIC_STORE_MASTER": "0"})
+    assert [s for s, _ in losses] == list(range(12))
