@@ -220,6 +220,8 @@ class TCPKVStore(KVStore):
     def __init__(self, store):
         """``store``: a connected paddle_tpu.distributed.TCPStore."""
         self._s = store
+        self._index_cache = set()     # last successful index read
+        self._times = {}              # local last-set time per key
         # TCPStore GET blocks until the key exists, so an absent index
         # would cost the full timeout on every read — create it exactly
         # once (ADD is atomic: only the first client sees 1)
@@ -234,8 +236,16 @@ class TCPKVStore(KVStore):
             return None
 
     def _index(self):
-        raw = self._raw_get(self._INDEX) or ""
-        return set(k for k in raw.split("\n") if k)
+        """A transient GET timeout must NOT read as 'empty index' — a
+        put()/delete() RMW on an empty set would wipe every other node's
+        membership and trigger phantom restarts.  Fall back to the last
+        successful read instead (at worst one heartbeat stale, the same
+        window a TTL expiry already tolerates)."""
+        raw = self._raw_get(self._INDEX)
+        if raw is None:
+            return set(self._index_cache)
+        self._index_cache = set(k for k in raw.split("\n") if k)
+        return set(self._index_cache)
 
     def _write_index(self, keys):
         self._s.set(self._INDEX, "\n".join(sorted(keys)))
@@ -243,6 +253,7 @@ class TCPKVStore(KVStore):
     # -- KVStore surface -----------------------------------------------------
     def put(self, key, value):
         self._s.set(key, value)
+        self._times[key] = time.time()
         for _ in range(4):
             keys = self._index()
             if key in keys:
@@ -278,7 +289,13 @@ class TCPKVStore(KVStore):
         return out
 
     def mtime(self, key):
-        return time.time() if self.get(key) is not None else 0.0
+        """Last-set time as seen by THIS process (the TCP protocol has
+        no server-side timestamps); liveness across processes rides the
+        'ts' field inside the heartbeat value, which is what
+        ElasticManager.hosts() actually reads."""
+        if key in self._times and self.get(key) is not None:
+            return self._times[key]
+        return 0.0
 
 
 def make_kv_store(spec: str, is_master: bool = False) -> KVStore:

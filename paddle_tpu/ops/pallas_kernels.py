@@ -971,6 +971,131 @@ def rms_norm_tpu(x, weight, eps=1e-6, block_rows=512):
 # ---------------------------------------------------------------------------
 # ring attention (sequence/context parallelism over the mesh)
 # ---------------------------------------------------------------------------
+def _ring_flash_ok(S, D) -> bool:
+    """Can the per-rotation block run the Pallas flash kernels?"""
+    return (_HAS_PLTPU and (_on_tpu() or _INTERPRET[0])
+            and D <= 128 and _fit_block(256, S) > 0)
+
+
+def _ring_block_fwd(qh, kc, vc, src, idx, causal, hop):
+    """One rotation's partial attention via the Pallas flash kernel.
+
+    Global causal structure picks the block kind: hop 0 holds the local
+    shard (src == idx statically) -> diagonal causal block, no cond;
+    later hops branch at runtime on the device-varying src < idx ->
+    fully-visible block vs fully-masked (zero output, -inf lse).
+    Returns (o f32 [B,H,S,D], lse f32 [B,H,S])."""
+    B, H, S, D = qh.shape
+    bq = _fit_block(512, S)
+    bk = _fit_block(512, S)
+
+    def _run(c):
+        def f():
+            o, lse = _flash_attention_value(qh, kc, vc, c, bq, bk,
+                                            with_lse=True)
+            return o.astype(jnp.float32), lse.reshape(B, H, S)
+        return f
+
+    def _empty():
+        return (jnp.zeros((B, H, S, D), jnp.float32),
+                jnp.full((B, H, S), -jnp.inf, jnp.float32))
+
+    if not causal:
+        return _run(False)()
+    if hop == 0:
+        return _run(True)()
+    return lax.cond(src < idx, _run(False), _empty)
+
+
+def _ring_flash_impl(qh, k0, v0, axis_name, causal):
+    """Forward ring: per-rotation flash blocks combined by running
+    logsumexp (same online-softmax algebra as inside the kernel, one
+    level up)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, H, S, D = qh.shape
+
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+    lse_run = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    kc, vc = k0, v0
+    for i in range(n):                      # static unroll over the ring
+        src = (idx - i) % n
+        o_i, lse_i = _ring_block_fwd(qh, kc, vc, src, idx, causal, i)
+        new_lse = jnp.logaddexp(lse_run, lse_i)
+        w_old = jnp.where(jnp.isfinite(lse_run),
+                          jnp.exp(lse_run - new_lse), 0.0)
+        w_new = jnp.where(jnp.isfinite(lse_i),
+                          jnp.exp(lse_i - new_lse), 0.0)
+        acc = acc * w_old[..., None] + o_i * w_new[..., None]
+        lse_run = new_lse
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+    return acc.astype(qh.dtype), lse_run
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(qh, k0, v0, axis_name, causal):
+    out, _ = _ring_flash_impl(qh, k0, v0, axis_name, causal)
+    return out
+
+
+def _ring_flash_fwd(qh, k0, v0, axis_name, causal):
+    out, lse = _ring_flash_impl(qh, k0, v0, axis_name, causal)
+    return out, (qh, k0, v0, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, res, g):
+    """Ring backward: each rotation runs the FlashAttention-2 backward
+    kernels against the TOTAL out/lse (p recomputed per block is then
+    the correct global softmax probability); dk/dv accumulators travel
+    around the ring with their k/v shard and arrive home after n hops."""
+    qh, k0, v0, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, H, S, D = qh.shape
+    lse_c = lse.reshape(B * H, S)
+    g = g.astype(out.dtype)
+
+    def _blk(kc, vc, c):
+        def f():
+            return _flash_bwd_auto(qh, kc, vc, out, lse_c, g, c)
+        return f
+
+    def _empty(kc, vc):
+        def f():
+            return (jnp.zeros_like(qh), jnp.zeros_like(kc),
+                    jnp.zeros_like(vc))
+        return f
+
+    dq = jnp.zeros((B, H, S, D), jnp.float32)
+    kc, vc = k0, v0
+    dkc = jnp.zeros_like(k0, jnp.float32)
+    dvc = jnp.zeros_like(v0, jnp.float32)
+    for i in range(n):
+        src = (idx - i) % n
+        if not causal:
+            dq_i, dk_i, dv_i = _blk(kc, vc, False)()
+        elif i == 0:                # hop 0: local shard, statically diag
+            dq_i, dk_i, dv_i = _blk(kc, vc, True)()
+        else:
+            dq_i, dk_i, dv_i = lax.cond(
+                src < idx, _blk(kc, vc, False), _empty(kc, vc))
+        dq = dq + dq_i.astype(jnp.float32)
+        dkc = dkc + dk_i.astype(jnp.float32)
+        dvc = dvc + dv_i.astype(jnp.float32)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dkc = jax.lax.ppermute(dkc, axis_name, perm)
+        dvc = jax.lax.ppermute(dvc, axis_name, perm)
+    return (dq.astype(qh.dtype), dkc.astype(k0.dtype),
+            dvc.astype(v0.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str, is_causal=False):
     """Ring attention over a mesh axis (long-context path; SURVEY.md §5.7
     notes the reference LACKS this — sep relied on model-side sharding).
@@ -978,7 +1103,19 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
     Must run inside shard_map with the sequence dim sharded over
     ``axis_name``: each step computes a local flash block then rotates k/v
     one neighbor around the ring with collective-permute (rides ICI).
-    Inputs [B, S_local, H, D] (values, not Tensors)."""
+    Inputs [B, S_local, H, D] (values, not Tensors).
+
+    On TPU with kernel-compatible shapes the per-rotation block IS the
+    Pallas flash kernel (fwd with lse, FlashAttention-2 bwd against the
+    total lse — see _ring_flash); otherwise the einsum online-softmax
+    fallback below runs (CPU mesh tests, odd shapes)."""
+    if _ring_flash_ok(q.shape[1], q.shape[-1]):
+        qh_ = jnp.swapaxes(q, 1, 2)
+        out = _ring_flash(qh_, jnp.swapaxes(k, 1, 2).astype(qh_.dtype),
+                          jnp.swapaxes(v, 1, 2).astype(qh_.dtype),
+                          axis_name, is_causal)
+        return jnp.swapaxes(out, 1, 2)
+
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -1037,14 +1174,36 @@ def sdpa_ring(query, key, value, mesh, axis_name: str = "sep",
     from ..distributed.process_mesh import as_jax_mesh
 
     jmesh = as_jax_mesh(mesh)
-    spec = P(None, axis_name)
+
+    def _spec_for(shape):
+        # all axes are manual under the flash ring (see below), so the
+        # batch/head dims must be EXPLICITLY split over the data/fsdp/
+        # model axes when present+divisible — P(None, sep) alone would
+        # gather and redundantly recompute across those groups
+        def axes(names, dim):
+            chosen, prod = [], 1
+            for name in names:
+                sz = jmesh.shape.get(name, 1)
+                if sz > 1 and dim % (prod * sz) == 0:
+                    chosen.append(name)
+                    prod *= sz
+            if not chosen:
+                return None
+            return chosen[0] if len(chosen) == 1 else tuple(chosen)
+        return P(axes(("data", "sharding"), shape[0]), axis_name,
+                 axes(("model",), shape[2]), None)
 
     def fn(q, k, v):
+        spec = _spec_for(q.shape)
+        # check_vma off: pallas_call outputs carry no vma annotation,
+        # which the checker (correctly) refuses to guess.  All axes
+        # manual (required with the checker off).
         ring = jax.shard_map(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
                                               is_causal),
-            mesh=jmesh, axis_names={axis_name},
-            in_specs=(spec, spec, spec), out_specs=spec)
+            mesh=jmesh, axis_names=set(jmesh.axis_names),
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
         return ring(q, k, v)
 
     return apply_op("ring_attention", fn,

@@ -1,0 +1,123 @@
+"""Ring-attention kernel bench: worst-rank ring compute vs single-chip
+flash at the same total sequence (round-4 ask #7 gate: within 1.5x).
+
+One real chip is available, so the ring's ppermute arrivals are stood in
+by local slices — the measured work IS the per-rotation flash blocks +
+logsumexp combine that _ring_flash_impl runs per rank; comm rides ICI
+concurrently on real meshes.  Run from the repo root."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from paddle_tpu.ops import pallas_kernels as pk
+
+B, H, S, D = 4, 16, 4096, 128
+N_RING = 4
+SL = S // N_RING
+ITERS = 16
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, H, S, D), jnp.bfloat16)
+
+
+def full_flash(q, k, v):
+    return pk._flash_sdpa(q, k, v, True)
+
+
+def ring_worst_rank(q, k, v):
+    """Last rank of an N_RING causal ring: 1 diagonal + N-1 full blocks
+    over S/N, combined by running logsumexp (same math as
+    _ring_flash_impl)."""
+    qh = q[:, :, -SL:, :]
+    bq = pk._fit_block(512, SL)
+    bk = bq
+    acc = jnp.zeros((B, H, SL, D), jnp.float32)
+    lse_run = jnp.full((B, H, SL), -jnp.inf, jnp.float32)
+    for i in range(N_RING):
+        src = N_RING - 1 - i
+        kc = k[:, :, src * SL:(src + 1) * SL, :]
+        vc = v[:, :, src * SL:(src + 1) * SL, :]
+        causal = (i == 0)
+        o_i, lse_i = pk._flash_attention_value(qh, kc, vc, causal, bq,
+                                               bk, with_lse=True)
+        lse_i = lse_i.reshape(B, H, SL)
+        new_lse = jnp.logaddexp(lse_run, lse_i)
+        w_old = jnp.where(jnp.isfinite(lse_run),
+                          jnp.exp(lse_run - new_lse), 0.0)
+        w_new = jnp.where(jnp.isfinite(lse_i),
+                          jnp.exp(lse_i - new_lse), 0.0)
+        acc = acc * w_old[..., None] + o_i.astype(jnp.float32) \
+            * w_new[..., None]
+        lse_run = new_lse
+    return acc.astype(q.dtype)
+
+
+def bench(fn, reps=7):
+    """Min of repeated (2N - N) differences: the tunnel injects multi-ms
+    stalls at random, and a stall can only inflate a sample, never
+    deflate it — so the min is the clean estimate."""
+    def chain(n):
+        f = jax.jit(lambda q, k, v: fn(q, k, v))
+
+        def run(q, k, v):
+            o = None
+            for _ in range(n):
+                o = f(q + (0 if o is None else o[:, :, :1, :1].sum()
+                           .astype(q.dtype) * 0), k, v)
+            return o
+        return run
+
+    f1, f2 = chain(ITERS), chain(2 * ITERS)
+
+    def one(f):
+        o = f(q, k, v)
+        np.asarray(o.ravel()[0:1])     # host fetch = real barrier
+
+    one(f1); one(f2)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); one(f1); d1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); one(f2); d2 = time.perf_counter() - t0
+        if d2 - d1 > 0:
+            ts.append((d2 - d1) / ITERS)
+    return float(np.min(ts)) if ts else float("inf")
+
+
+def main():
+    # correctness first: worst-rank ring rows == full flash's last rows
+    ref = np.asarray(full_flash(q, k, v)[:, :, -SL:, :], np.float32)
+    got = np.asarray(ring_worst_rank(q, k, v), np.float32)
+    err = np.abs(ref - got).max()
+    print(f"max |ring - flash| on shared rows: {err:.4f}")
+    assert err < 0.1, "ring block math diverged"
+
+    t_full = bench(full_flash)
+    t_ring = bench(ring_worst_rank)
+    flops_full = 4.0 * B * H * S * S * D * 0.5
+    flops_ring = 4.0 * B * H * SL * SL * D * (1 * 0.5 + (N_RING - 1))
+    print(f"full flash  S={S}:  {t_full*1e3:.2f} ms  "
+          f"({flops_full/t_full/1e12:.1f} TF/s)")
+    print(f"ring worst rank (n={N_RING}, Sl={SL}): {t_ring*1e3:.2f} ms  "
+          f"({flops_ring/t_ring/1e12:.1f} TF/s)")
+    # informational: per-flop efficiency of the smaller ring blocks
+    # (expected somewhat below the monolithic kernel; microbenchmarks on
+    # the tunneled chip are noisy — see the measurement notes in
+    # bench.py)
+    eff_full = flops_full / t_full
+    eff_ring = flops_ring / t_ring
+    print(f"kernel-efficiency ratio (full/ring): "
+          f"{eff_full / eff_ring:.3f}")
+    # THE round-4 gate (VERDICT ask #7): ring attention wall-clock within
+    # 1.5x of single-chip flash at the same total sequence
+    ratio = t_ring / t_full
+    print(f"wall-clock ratio ring/full: {ratio:.3f} (gate: < 1.5)")
+    assert ratio < 1.5
+
+
+if __name__ == "__main__":
+    main()
