@@ -215,3 +215,7 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("incr_count", 0)
         self._bad_steps = sd.get("decr_count", 0)
+
+
+# amp.debugging tools (imported last: hooks into core.dispatch)
+from . import debugging  # noqa: E402

@@ -43,6 +43,10 @@ _amp_state = {"enabled": False, "dtype": None, "level": "O1",
 # None otherwise so the off path costs one comparison
 _op_profile_hook = [None]
 
+# amp.debugging per-op hook (tensor checker / operator stats); None when
+# no debugging tool is active (paddle_tpu/amp/debugging.py)
+_amp_debug_hook = [None]
+
 # set to the active SOT StatementIR recorder while jit/sot is tracing a
 # frame (reference analog: the StatementIR builder fed by the eval-frame
 # hook, python/paddle/jit/sot/symbolic/statement_ir.py); None otherwise
@@ -200,6 +204,10 @@ def _apply_op_inner(name, fn, tensor_args, kwargs, multi_output):
     if get_flag("check_nan_inf"):
         flat = out_vals if isinstance(out_vals, tuple) else (out_vals,)
         _check_nan_inf(name, flat)
+    dbg = _amp_debug_hook[0]
+    if dbg is not None and not tracing:
+        flat = out_vals if isinstance(out_vals, tuple) else (out_vals,)
+        dbg(name, flat)
     rec = _sot_recorder[0]
     if rec is not None and not tracing:
         rec.record(name, fn, tensor_args, kwargs, outs, multi_output,

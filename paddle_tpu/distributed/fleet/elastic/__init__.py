@@ -222,6 +222,7 @@ class TCPKVStore(KVStore):
         self._s = store
         self._index_cache = set()     # last successful index read
         self._times = {}              # local last-set time per key
+        self._misses = {}             # consecutive GET misses per key
         # TCPStore GET blocks until the key exists, so an absent index
         # would cost the full timeout on every read — create it exactly
         # once (ADD is atomic: only the first client sees 1)
@@ -281,11 +282,21 @@ class TCPKVStore(KVStore):
             if k.startswith(prefix):
                 v = self._raw_get(k)
                 if v is None:
-                    dead.add(k)   # deleted key still indexed: prune it
+                    # a GET miss is ambiguous (deleted vs transient
+                    # timeout): only prune after several consecutive
+                    # misses so a live member can't be evicted by one
+                    # slow read
+                    misses = self._misses.get(k, 0) + 1
+                    self._misses[k] = misses
+                    if misses >= 3:
+                        dead.add(k)
                 else:
+                    self._misses.pop(k, None)
                     out[k] = v
         if dead:
             self._write_index(keys - dead)
+            for k in dead:
+                self._misses.pop(k, None)
         return out
 
     def mtime(self, key):

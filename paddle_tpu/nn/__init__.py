@@ -10,6 +10,8 @@ from .layers import (  # noqa: F401
     PixelShuffle,
     Sequential, LayerList, ParameterList,
     Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose,
+    Conv3DTranspose, SpectralNorm, FeatureAlphaDropout,
+    AdaptiveLogSoftmaxWithLoss,
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
     LayerNorm, RMSNorm, GroupNorm, InstanceNorm2D, LocalResponseNorm,
     MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool2D,

@@ -169,9 +169,43 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                            padding, output_padding, dilation, groups, df)
 
 
+def _resolve_output_padding(nd, x, weight, stride, padding, dilation,
+                            output_size, output_padding, data_format):
+    """Honor an explicit output_size by deriving the per-dim
+    output_padding (parity: the reference's output_size handling);
+    out = (in-1)*s - 2p + d*(k-1) + output_padding + 1."""
+    if output_size is None:
+        return output_padding
+
+    def tup(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * nd
+
+    st, pd, dl = tup(stride), tup(padding), tup(dilation)
+    xs = x.shape if hasattr(x, "shape") else x.shape
+    spatial = list(xs[2:2 + nd]) if data_format.startswith("NC") \
+        else list(xs[1:1 + nd])
+    w = weight.shape
+    ks = list(w[2:2 + nd])
+    want = list(output_size)
+    ops = []
+    for i in range(nd):
+        base = (spatial[i] - 1) * st[i] - 2 * pd[i] \
+            + dl[i] * (ks[i] - 1) + 1
+        op = int(want[i]) - base
+        if not 0 <= op < max(st[i], dl[i]):
+            raise ValueError(
+                f"output_size[{i}]={want[i]} unreachable: base size "
+                f"{base}, stride {st[i]}")
+        ops.append(op)
+    return tuple(ops)
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCHW", name=None):
+    output_padding = _resolve_output_padding(
+        2, x, weight, stride, padding, dilation, output_size,
+        output_padding, data_format)
     return _conv_transpose("conv2d_transpose", 2, x, weight, bias, stride,
                            padding, output_padding, dilation, groups,
                            data_format)
@@ -180,6 +214,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCDHW", name=None):
+    output_padding = _resolve_output_padding(
+        3, x, weight, stride, padding, dilation, output_size,
+        output_padding, data_format)
     return _conv_transpose("conv3d_transpose", 3, x, weight, bias, stride,
                            padding, output_padding, dilation, groups,
                            data_format)

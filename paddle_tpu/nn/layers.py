@@ -1188,3 +1188,188 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self._lower, self._upper, self.training)
+
+
+class Conv3DTranspose(_ConvNd):
+    """Parity: paddle.nn.Conv3DTranspose (conv.py reference)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation,
+                                  output_size, self._data_format)
+
+
+class SpectralNorm(Layer):
+    """Parity: paddle.nn.SpectralNorm (python/paddle/nn/layer/norm.py) —
+    a layer that spectrally normalizes a WEIGHT tensor passed to
+    forward: W / sigma_max(W), sigma estimated by persistent power
+    iteration over the matricized weight (dim rows)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        shape = list(weight_shape)
+        h = shape[dim]
+        w = 1
+        for i, s in enumerate(shape):
+            if i != dim:
+                w *= s
+        import numpy as _np
+        rng = _np.random.RandomState(0)
+        self.register_buffer("weight_u", Tensor(
+            rng.randn(h).astype("float32")))
+        self.register_buffer("weight_v", Tensor(
+            rng.randn(w).astype("float32")))
+
+    def forward(self, weight):
+        from ..autograd.tape import no_grad
+        mat = weight
+        if self._dim != 0:
+            perm = [self._dim] + [i for i in range(len(weight.shape))
+                                  if i != self._dim]
+            mat = weight.transpose(perm)
+        h = mat.shape[0]
+        mat2 = mat.reshape([h, -1])
+        u, v = self.weight_u, self.weight_v
+        with no_grad():
+            for _ in range(self._power_iters):
+                v = F.normalize(mat2.t().matmul(u.unsqueeze(1)).squeeze(1),
+                                epsilon=self._eps, axis=0)
+                u = F.normalize(mat2.matmul(v.unsqueeze(1)).squeeze(1),
+                                epsilon=self._eps, axis=0)
+            self.weight_u.set_value(u.numpy())
+            self.weight_v.set_value(v.numpy())
+        sigma = u.unsqueeze(0).matmul(mat2).matmul(
+            v.unsqueeze(1)).reshape([])
+        return weight / sigma
+
+
+class FeatureAlphaDropout(Layer):
+    """Parity: paddle.nn.FeatureAlphaDropout — alpha dropout that drops
+    whole channels (feature maps), preserving self-normalizing
+    statistics (SELU alpha')."""
+
+    _ALPHA_P = 1.7580993408473766   # -selu_alpha * selu_scale
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        if not 0.0 <= p < 1.0:
+            raise ValueError(
+                f"FeatureAlphaDropout p must be in [0, 1), got {p}")
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..ops.random import next_key
+        import jax as _jax
+
+        p = self.p
+        alpha_p = -self._ALPHA_P
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        key = next_key()
+
+        def fn(v):
+            shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+            keep = _jax.random.bernoulli(key, 1 - p, shape)
+            return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+
+        from ..core.dispatch import apply_op
+        return apply_op("feature_alpha_dropout", fn, (x,))
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Parity: paddle.nn.AdaptiveLogSoftmaxWithLoss
+    (python/paddle/nn/layer/loss.py) — hierarchical softmax with
+    frequency cutoffs: a head over [common classes + cluster tokens] and
+    per-cluster tail projections of decreasing width (div_value)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (not cutoffs or cutoffs != sorted(cutoffs)
+                or len(set(cutoffs)) != len(cutoffs)
+                or cutoffs[-1] > n_classes - 1 or min(cutoffs) <= 0):
+            raise ValueError(
+                "cutoffs must be unique, increasing, positive ints "
+                "< n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=head_bias if head_bias else False)
+        self.tail = LayerList()
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            self.tail.append(Sequential(
+                Linear(in_features, hsz, bias_attr=False),
+                Linear(hsz, osz, bias_attr=False)))
+
+    def _full_log_prob(self, input):
+        head_out = F.log_softmax(self.head(input), axis=-1)
+        parts = [head_out[..., :self.shortlist_size]]
+        for i, tail in enumerate(self.tail):
+            cluster_lp = F.log_softmax(tail(input), axis=-1)
+            gate = head_out[..., self.shortlist_size + i:
+                            self.shortlist_size + i + 1]
+            parts.append(cluster_lp + gate)
+        from ..ops import manipulation as _m
+        return _m.concat(parts, axis=-1)
+
+    def forward(self, input, label):
+        """Target log-probs + NLL loss WITHOUT materializing the full
+        [batch, n_classes] distribution: the head and each (narrow) tail
+        projection are computed densely — XLA's static-shape answer to
+        the reference's per-cluster row gathering — but only the target
+        entry of each is gathered and masked in."""
+        from ..core.dispatch import apply_op
+        head_lp = F.log_softmax(self.head(input), axis=-1)
+        cluster_lps = [F.log_softmax(t(input), axis=-1)
+                       for t in self.tail]
+        c = self.cutoffs
+        short = self.shortlist_size
+
+        def fn(hlp, lab, *clps):
+            lab = lab.astype(jnp.int32)
+            sl = jnp.clip(lab, 0, short - 1)
+            out = jnp.take_along_axis(hlp, sl[..., None],
+                                      axis=-1)[..., 0]
+            for i, clp in enumerate(clps):
+                rel = jnp.clip(lab - c[i], 0, clp.shape[-1] - 1)
+                val = jnp.take_along_axis(clp, rel[..., None],
+                                          axis=-1)[..., 0] \
+                    + hlp[..., short + i]
+                out = jnp.where((lab >= c[i]) & (lab < c[i + 1]), val,
+                                out)
+            return out
+
+        output = apply_op("adaptive_log_softmax", fn,
+                          tuple([head_lp, label] + cluster_lps))
+        loss = -output.mean()
+        return output, loss
+
+    def log_prob(self, input):
+        return self._full_log_prob(input)
+
+    def predict(self, input):
+        lp = self._full_log_prob(input)
+        return lp.argmax(axis=-1)

@@ -586,3 +586,61 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [apply_op("atleast_3d", jnp.atleast_3d, (t,)) for t in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+@register_op("block_diag", category="manipulation")
+def block_diag(inputs, name=None):
+    """Parity: paddle.block_diag — block-diagonal matrix from a list of
+    2-D (or promotable) tensors."""
+    mats = list(inputs)
+
+    def fn(*vals):
+        vs = [jnp.atleast_2d(v) for v in vals]
+        R = sum(v.shape[0] for v in vs)
+        C = sum(v.shape[1] for v in vs)
+        out = jnp.zeros((R, C), vs[0].dtype)
+        r = c = 0
+        for v in vs:
+            out = jax.lax.dynamic_update_slice(out, v.astype(out.dtype),
+                                               (r, c))
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+    return apply_op("block_diag", fn, tuple(mats))
+
+
+@register_op("pdist", category="manipulation")
+def pdist(x, p=2.0, name=None):
+    """Parity: paddle.pdist — condensed pairwise p-distance of the rows
+    of a 2-D tensor (length n*(n-1)/2)."""
+    def fn(v):
+        n = v.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        diff = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply_op("pdist", fn, (x,))
+
+
+@register_op("cartesian_prod", category="manipulation")
+def cartesian_prod(x, name=None):
+    """Parity: paddle.cartesian_prod — cartesian product of 1-D tensors
+    (rows are tuples, itertools.product order)."""
+    ts = list(x)
+
+    def fn(*vals):
+        grids = jnp.meshgrid(*vals, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", fn, tuple(ts))
+
+
+@register_op("positive", category="math", tensor_method=True)
+def positive(x, name=None):
+    """Parity: paddle.positive (+x; errors on bool like the reference)."""
+    from ._helpers import as_value
+    if as_value(x).dtype == jnp.bool_:
+        raise TypeError("positive is not supported for bool tensors")
+    return apply_op("positive", lambda v: +v, (x,))

@@ -10,17 +10,33 @@ from __future__ import annotations
 from ... import nn
 from ...models.resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
                               resnet34, resnet50, resnet101, resnet152)
+from .extra import (SqueezeNet, squeezenet1_0, squeezenet1_1,
+                    MobileNetV1, mobilenet_v1,
+                    MobileNetV3Small, MobileNetV3Large,
+                    mobilenet_v3_small, mobilenet_v3_large,
+                    ShuffleNetV2, shufflenet_v2_x0_25,
+                    shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                    shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                    shufflenet_v2_x2_0, shufflenet_v2_swish,
+                    DenseNet, densenet121, densenet161, densenet169,
+                    densenet201, densenet264,
+                    InceptionV3, inception_v3, GoogLeNet, googlenet)
 
 __all__ = ["LeNet", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16",
            "vgg19", "MobileNetV2", "mobilenet_v2", "ResNet", "resnet18",
-           "resnet34", "resnet50", "resnet101", "resnet152"]
+           "resnet34", "resnet50", "resnet101", "resnet152",
+           "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "MobileNetV1", "mobilenet_v1", "MobileNetV3Small",
+           "MobileNetV3Large", "mobilenet_v3_small", "mobilenet_v3_large",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish", "DenseNet", "densenet121",
+           "densenet161", "densenet169", "densenet201", "densenet264",
+           "InceptionV3", "inception_v3", "GoogLeNet", "googlenet"]
 
 
-def _no_pretrained(pretrained):
-    if pretrained:
-        raise ValueError(
-            "pretrained=True is unsupported in this environment (no "
-            "network egress); load weights explicitly with set_state_dict")
+from .extra import _no_pretrained  # single definition, shared
 
 
 class LeNet(nn.Layer):

@@ -1,0 +1,671 @@
+"""Vision zoo tail: SqueezeNet, MobileNetV1/V3, ShuffleNetV2, DenseNet,
+InceptionV3, GoogLeNet, wide ResNets.
+
+Parity: python/paddle/vision/models/{squeezenet,mobilenetv1,mobilenetv3,
+shufflenetv2,densenet,inceptionv3,googlenet}.py (reference).  Written
+TPU-first over paddle_tpu.nn (NCHW convs lower to XLA convolutions that
+tile onto the MXU); pretrained weights are unsupported in this
+environment (no egress) — load explicitly with set_state_dict."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import nn
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError(
+            "pretrained=True is unsupported in this environment (no "
+            "network egress); load weights explicitly with set_state_dict")
+
+
+def _conv_bn(ic, oc, k, s=1, p=0, groups=1, act="relu"):
+    layers = [nn.Conv2D(ic, oc, k, stride=s, padding=p, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(oc)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (squeezenet.py)
+# ---------------------------------------------------------------------------
+class _Fire(nn.Layer):
+    def __init__(self, ic, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(ic, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        s = self.relu(self.squeeze(x))
+        return paddle.concat(
+            [self.relu(self.expand1(s)), self.relu(self.expand3(s))],
+            axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Parity: squeezenet.py SqueezeNet (version 1.0 / 1.1)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.classifier(self.features(x))
+        return paddle.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1 (mobilenetv1.py)
+# ---------------------------------------------------------------------------
+class MobileNetV1(nn.Layer):
+    """Parity: mobilenetv1.py — depthwise-separable stacks with a width
+    multiplier ``scale``."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 \
+            + [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, c(32), 3, s=2, p=1)]
+        for ic, oc, s in cfg:
+            layers.append(_conv_bn(c(ic), c(ic), 3, s=s, p=1,
+                                   groups=c(ic)))       # depthwise
+            layers.append(_conv_bn(c(ic), c(oc), 1))    # pointwise
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = nn.Linear(c(1024), num_classes) if num_classes > 0 \
+            else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (mobilenetv3.py)
+# ---------------------------------------------------------------------------
+class _SE(nn.Layer):
+    def __init__(self, ch, squeeze):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, ic, exp, oc, k, s, se, act):
+        super().__init__()
+        self.use_res = s == 1 and ic == oc
+        blocks = []
+        if exp != ic:
+            blocks.append(_conv_bn(ic, exp, 1, act=act))
+        blocks.append(_conv_bn(exp, exp, k, s=s, p=k // 2, groups=exp,
+                               act=act))
+        if se:
+            blocks.append(_SE(exp, exp // 4))
+        blocks.append(_conv_bn(exp, oc, 1, act="none"))
+        self.block = nn.Sequential(*blocks)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [  # k, exp, oc, se, act, s
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+
+
+class MobileNetV3(nn.Layer):
+    """Parity: mobilenetv3.py MobileNetV3Small/Large."""
+
+    def __init__(self, config, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+
+        layers = [_conv_bn(3, c(16), 3, s=2, p=1, act="hardswish")]
+        ic = c(16)
+        for k, exp, oc, se, act, s in config:
+            layers.append(_InvertedResidualV3(ic, c(exp), c(oc), k, s,
+                                              se, act))
+            ic = c(oc)
+        last_exp = c(config[-1][1])
+        layers.append(_conv_bn(ic, last_exp, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+        else:
+            self.classifier = None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(paddle.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (shufflenetv2.py)
+# ---------------------------------------------------------------------------
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, ic, oc, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = oc // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(ic // 2, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, s=1, p=1, groups=branch,
+                         act="none"),
+                _conv_bn(branch, branch, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(ic, ic, 3, s=stride, p=1, groups=ic, act="none"),
+                _conv_bn(ic, branch, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(ic, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, s=stride, p=1, groups=branch,
+                         act="none"),
+                _conv_bn(branch, branch, 1, act=act))
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)],
+                                axis=1)
+        return self.shuffle(out)
+
+
+_SHUFFLE_CH = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+               0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464,
+                                                   1024],
+               1.5: [24, 176, 352, 704, 1024],
+               2.0: [24, 244, 488, 976, 2048]}
+
+
+class ShuffleNetV2(nn.Layer):
+    """Parity: shufflenetv2.py."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        ch = _SHUFFLE_CH[scale]
+        self.conv1 = _conv_bn(3, ch[0], 3, s=2, p=1, act=act)
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        ic = ch[0]
+        for stage_idx, repeat in enumerate((4, 8, 4)):
+            oc = ch[stage_idx + 1]
+            stages.append(_ShuffleUnit(ic, oc, 2, act))
+            for _ in range(repeat - 1):
+                stages.append(_ShuffleUnit(oc, oc, 1, act))
+            ic = oc
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = _conv_bn(ic, ch[-1], 1, act=act)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = nn.Linear(ch[-1], num_classes) if num_classes > 0 \
+            else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.conv5(self.stages(self.pool1(self.conv1(x))))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(1.0, act="swish", **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (densenet.py)
+# ---------------------------------------------------------------------------
+class _DenseLayer(nn.Layer):
+    def __init__(self, ic, growth, bn_size, drop):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(ic)
+        self.conv1 = nn.Conv2D(ic, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(drop) if drop else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.drop is not None:
+            out = self.drop(out)
+        return paddle.concat([x, out], axis=1)
+
+
+_DENSE_CFG = {121: (64, 32, (6, 12, 24, 16)),
+              161: (96, 48, (6, 12, 36, 24)),
+              169: (64, 32, (6, 12, 32, 32)),
+              201: (64, 32, (6, 12, 48, 32)),
+              264: (64, 32, (6, 12, 64, 48))}
+
+
+class DenseNet(nn.Layer):
+    """Parity: densenet.py DenseNet."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        init_ch, growth, blocks = _DENSE_CFG[layers]
+        feats = [nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_ch), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_ch
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(blocks) - 1:       # transition
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, stride=2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.fc = nn.Linear(ch, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.features(x)
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(264, **kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (inceptionv3.py)
+# ---------------------------------------------------------------------------
+class _IncA(nn.Layer):
+    def __init__(self, ic, pool_ch):
+        super().__init__()
+        self.b1 = _conv_bn(ic, 64, 1)
+        self.b5 = nn.Sequential(_conv_bn(ic, 48, 1),
+                                _conv_bn(48, 64, 5, p=2))
+        self.b3 = nn.Sequential(_conv_bn(ic, 64, 1),
+                                _conv_bn(64, 96, 3, p=1),
+                                _conv_bn(96, 96, 3, p=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(ic, pool_ch, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b5(x), self.b3(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncB(nn.Layer):       # grid reduction 35 -> 17
+    def __init__(self, ic):
+        super().__init__()
+        self.b3 = _conv_bn(ic, 384, 3, s=2)
+        self.b33 = nn.Sequential(_conv_bn(ic, 64, 1),
+                                 _conv_bn(64, 96, 3, p=1),
+                                 _conv_bn(96, 96, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b3(x), self.b33(x), self.pool(x)],
+                             axis=1)
+
+
+class _IncC(nn.Layer):       # 17x17 factorized 7x7
+    def __init__(self, ic, ch7):
+        super().__init__()
+        self.b1 = _conv_bn(ic, 192, 1)
+        self.b7 = nn.Sequential(
+            _conv_bn(ic, ch7, 1), _conv_bn(ch7, ch7, (1, 7), p=(0, 3)),
+            _conv_bn(ch7, 192, (7, 1), p=(3, 0)))
+        self.b77 = nn.Sequential(
+            _conv_bn(ic, ch7, 1), _conv_bn(ch7, ch7, (7, 1), p=(3, 0)),
+            _conv_bn(ch7, ch7, (1, 7), p=(0, 3)),
+            _conv_bn(ch7, ch7, (7, 1), p=(3, 0)),
+            _conv_bn(ch7, 192, (1, 7), p=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(ic, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b7(x), self.b77(x),
+                              self.bp(x)], axis=1)
+
+
+class _IncD(nn.Layer):       # grid reduction 17 -> 8
+    def __init__(self, ic):
+        super().__init__()
+        self.b3 = nn.Sequential(_conv_bn(ic, 192, 1),
+                                _conv_bn(192, 320, 3, s=2))
+        self.b7 = nn.Sequential(
+            _conv_bn(ic, 192, 1), _conv_bn(192, 192, (1, 7), p=(0, 3)),
+            _conv_bn(192, 192, (7, 1), p=(3, 0)),
+            _conv_bn(192, 192, 3, s=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b3(x), self.b7(x), self.pool(x)],
+                             axis=1)
+
+
+class _IncE(nn.Layer):       # 8x8 expanded
+    def __init__(self, ic):
+        super().__init__()
+        self.b1 = _conv_bn(ic, 320, 1)
+        self.b3_stem = _conv_bn(ic, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), p=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), p=(1, 0))
+        self.b33_stem = nn.Sequential(_conv_bn(ic, 448, 1),
+                                      _conv_bn(448, 384, 3, p=1))
+        self.b33_a = _conv_bn(384, 384, (1, 3), p=(0, 1))
+        self.b33_b = _conv_bn(384, 384, (3, 1), p=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _conv_bn(ic, 192, 1))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        s3 = self.b3_stem(x)
+        s33 = self.b33_stem(x)
+        return paddle.concat(
+            [self.b1(x), self.b3_a(s3), self.b3_b(s3),
+             self.b33_a(s33), self.b33_b(s33), self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Parity: inceptionv3.py InceptionV3 (299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _conv_bn(3, 32, 3, s=2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, p=1), nn.MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160),
+            _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        self.pool = nn.AdaptiveAvgPool2D(1) if with_pool else None
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(2048, num_classes) if num_classes > 0 \
+            else None
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.blocks(self.stem(x))
+        if self.pool is not None:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet (googlenet.py — inception v1 with two aux heads)
+# ---------------------------------------------------------------------------
+class _IncV1(nn.Layer):
+    def __init__(self, ic, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(ic, c1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(ic, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1),
+                                nn.ReLU())
+        self.b5 = nn.Sequential(nn.Conv2D(ic, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2),
+                                nn.ReLU())
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(ic, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        return paddle.concat([self.b1(x), self.b3(x), self.b5(x),
+                              self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Parity: googlenet.py — returns (out, aux1, aux2) like the
+    reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True))
+        self.inc3 = nn.Sequential(
+            _IncV1(192, 64, 96, 128, 16, 32, 32),
+            _IncV1(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, ceil_mode=True))
+        self.inc4a = _IncV1(480, 192, 96, 208, 16, 48, 64)
+        self.inc4bcd = nn.Sequential(
+            _IncV1(512, 160, 112, 224, 24, 64, 64),
+            _IncV1(512, 128, 128, 256, 24, 64, 64),
+            _IncV1(512, 112, 144, 288, 32, 64, 64))
+        self.inc4e = _IncV1(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inc5 = nn.Sequential(
+            _IncV1(832, 256, 160, 320, 32, 128, 128),
+            _IncV1(832, 384, 192, 384, 48, 128, 128))
+
+        def aux(ic):
+            return nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Conv2D(ic, 128, 1),
+                nn.ReLU(), nn.Flatten(),
+                nn.Linear(128 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+
+        self.aux1 = aux(512)
+        self.aux2 = aux(528)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        x = self.inc4a(self.inc3(self.stem(x)))
+        a1 = self.aux1(x)
+        x = self.inc4bcd(x)
+        a2 = self.aux2(x)
+        x = self.inc5(self.pool4(self.inc4e(x)))
+        out = self.fc(self.dropout(paddle.flatten(self.pool(x), 1)))
+        return out, a1, a2
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)

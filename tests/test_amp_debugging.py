@@ -1,0 +1,97 @@
+"""paddle.amp.debugging: tensor checker, operator stats, run compare.
+
+Reference analogs: python/paddle/amp/debugging.py (DebugMode :42,
+TensorCheckerConfig :157, check_numerics :339, operator stats :459-573,
+enable/disable_tensor_checker :634,675), accuracy_compare.py:687."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp.debugging import (
+    DebugMode, TensorCheckerConfig, enable_tensor_checker,
+    disable_tensor_checker, check_numerics, collect_operator_stats,
+    get_operator_stats, compare_accuracy,
+    enable_operator_stats_collection, disable_operator_stats_collection)
+
+
+def test_check_numerics_counts_and_abort():
+    t = paddle.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    with pytest.raises(FloatingPointError, match="1 nan, 1 inf"):
+        check_numerics(t, "my_op", "x")
+    n_nan, n_inf, n_zero = check_numerics(
+        t, "my_op", "x", debug_mode=DebugMode.CHECK_NAN_INF)
+    assert int(n_nan.numpy()) == 1
+    assert int(n_inf.numpy()) == 1
+    assert int(n_zero.numpy()) == 1
+    ok = paddle.to_tensor(np.ones(3, np.float32))
+    n_nan, _, _ = check_numerics(ok, "my_op", "ok")
+    assert int(n_nan.numpy()) == 0
+
+
+def test_tensor_checker_reports_op_name_and_aborts():
+    cfg = TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT)
+    enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+        with pytest.raises(FloatingPointError, match="op=log"):
+            paddle.log(x) + 0   # log(-1) = nan, caught AT the log op
+    finally:
+        disable_tensor_checker()
+    # disabled: no abort
+    y = paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+    assert np.isnan(y.numpy()).all()
+
+
+def test_tensor_checker_skip_list():
+    cfg = TensorCheckerConfig(
+        enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+        skipped_op_list=["log"])
+    enable_tensor_checker(cfg)
+    try:
+        y = paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+        assert np.isnan(y.numpy()).all()   # skipped: no abort
+    finally:
+        disable_tensor_checker()
+
+
+def test_operator_stats_collection_by_dtype():
+    with collect_operator_stats():
+        a32 = paddle.to_tensor(np.ones((4, 4), np.float32))
+        b16 = a32.astype("bfloat16")
+        _ = paddle.matmul(a32, a32)          # fp32 call
+        _ = paddle.matmul(b16, b16)          # bf16 call
+        _ = paddle.matmul(b16, b16)
+        stats = get_operator_stats()
+    assert stats["matmul"][1] == 2           # bf16 count
+    assert stats["matmul"][2] == 1           # fp32 count
+
+
+def test_compare_accuracy_flags_nonfinite_divergence(tmp_path):
+    def run(dump_dir, inject_nan):
+        cfg = TensorCheckerConfig(enable=True,
+                                  debug_mode=DebugMode.CHECK_ALL,
+                                  output_dir=str(dump_dir))
+        enable_tensor_checker(cfg)
+        try:
+            x = paddle.to_tensor(np.array([0.5, 2.0], np.float32))
+            h = paddle.exp(x)
+            if inject_nan:
+                h = h * paddle.to_tensor(
+                    np.array([1.0, np.nan], np.float32))
+            _ = paddle.tanh(h)
+        finally:
+            disable_tensor_checker()
+
+    run(tmp_path / "a", False)
+    run(tmp_path / "b", True)
+    report = str(tmp_path / "cmp.csv")
+    rows = compare_accuracy(str(tmp_path / "a"), str(tmp_path / "b"),
+                            report)
+    assert os.path.exists(report)
+    issues = {r["op"]: r["issue"] for r in rows}
+    assert any("one run" in v or "drift" in v for v in issues.values())
+    # the multiply/tanh after the injection diverge
+    assert any(op in issues for op in ("multiply", "tanh", "mul"))

@@ -605,3 +605,100 @@ def test_rnn_sequence_length_masks_padding():
         np.testing.assert_allclose(rstate.numpy()[b], tr_state.numpy()[0],
                                    rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(rout.numpy()[0, 3:], 0.0)
+
+
+# -- round-4 stragglers -----------------------------------------------------
+def test_conv3d_transpose_layer():
+    m = paddle.nn.Conv3DTranspose(2, 3, 2, stride=2)
+    x = paddle.to_tensor(np.ones((1, 2, 4, 4, 4), np.float32))
+    assert m(x).shape == [1, 3, 8, 8, 8]
+
+
+def test_spectral_norm_layer():
+    sn = paddle.nn.SpectralNorm([4, 6], power_iters=4)
+    w = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 6).astype(np.float32) * 3,
+        stop_gradient=False)
+    wn = sn(w)
+    top_sv = np.linalg.svd(wn.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(top_sv, 1.0, rtol=2e-2)
+    wn.sum().backward()
+    assert w.grad is not None
+
+
+def test_adaptive_log_softmax_with_loss():
+    paddle.seed(0)
+    als = paddle.nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10])
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 16).astype(np.float32),
+        stop_gradient=False)
+    y = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, 20, (8,)).astype(np.int64))
+    out, loss = als(x, y)
+    assert out.shape == [8]
+    loss.backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    lp = als.log_prob(x)
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0,
+                               atol=1e-5)
+    pred = als.predict(x)
+    np.testing.assert_allclose(pred.numpy(),
+                               lp.numpy().argmax(-1))
+    with pytest.raises(ValueError, match="cutoffs"):
+        paddle.nn.AdaptiveLogSoftmaxWithLoss(16, 20, [10, 5])
+
+
+def test_feature_alpha_dropout_channelwise():
+    paddle.seed(3)
+    fd = paddle.nn.FeatureAlphaDropout(0.5)
+    fd.train()
+    x = paddle.to_tensor(np.ones((4, 8, 5, 5), np.float32))
+    o = fd(x).numpy()
+    # whole channels share one value (kept or dropped together)
+    for b in range(4):
+        for c in range(8):
+            assert np.unique(o[b, c]).size == 1
+    fd.eval()
+    np.testing.assert_allclose(fd(x).numpy(), x.numpy())
+
+
+def test_tensor_op_stragglers():
+    a = paddle.to_tensor(np.ones((2, 2), np.float32))
+    b = paddle.to_tensor(np.full((1, 3), 2.0, np.float32))
+    bd = paddle.block_diag([a, b])
+    assert bd.shape == [3, 5]
+    np.testing.assert_allclose(bd.numpy()[2, 2:], [2, 2, 2])
+
+    x = paddle.to_tensor(np.array([[0., 0.], [3., 4.], [0., 1.]],
+                                  np.float32))
+    np.testing.assert_allclose(paddle.pdist(x).numpy(),
+                               [5.0, 1.0, np.sqrt(18)], rtol=1e-6)
+
+    cp = paddle.cartesian_prod([paddle.to_tensor(np.array([1, 2])),
+                                paddle.to_tensor(np.array([4, 5, 6]))])
+    assert cp.shape == [6, 2]
+    np.testing.assert_allclose(cp.numpy()[0], [1, 4])
+    np.testing.assert_allclose(cp.numpy()[-1], [2, 6])
+
+    np.testing.assert_allclose(paddle.positive(x).numpy(), x.numpy())
+    with pytest.raises(TypeError):
+        paddle.positive(paddle.to_tensor(np.array([True])))
+
+
+def test_conv_transpose_output_size_honored():
+    m = paddle.nn.Conv2DTranspose(2, 3, 3, stride=2)
+    x = paddle.to_tensor(np.ones((1, 2, 5, 5), np.float32))
+    assert m(x).shape == [1, 3, 11, 11]          # default formula
+    assert m(x, output_size=[12, 12]).shape == [1, 3, 12, 12]
+    m3 = paddle.nn.Conv3DTranspose(1, 1, 3, stride=2)
+    x3 = paddle.to_tensor(np.ones((1, 1, 4, 4, 4), np.float32))
+    assert m3(x3, output_size=[10, 10, 10]).shape == [1, 1, 10, 10, 10]
+    with pytest.raises(ValueError, match="unreachable"):
+        m(x, output_size=[20, 20])
+
+
+def test_feature_alpha_dropout_rejects_bad_p():
+    with pytest.raises(ValueError, match="p must be"):
+        paddle.nn.FeatureAlphaDropout(1.0)
+    with pytest.raises(ValueError, match="p must be"):
+        paddle.nn.FeatureAlphaDropout(-0.1)

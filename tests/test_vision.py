@@ -286,3 +286,78 @@ def test_vit_b16_structure():
     net = vit_b_16(num_classes=5)
     n = sum(int(np.prod(p.shape)) for p in net.parameters())
     assert 80e6 < n < 100e6       # ViT-B/16 ~86M params
+
+
+# -- round-4 zoo tail (parity: python/paddle/vision/models/__init__.py) -----
+def _fwd(model, size=64):
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, size, size).astype("float32"))
+    model.eval()
+    return model(x)
+
+
+def test_squeezenet_forward():
+    from paddle_tpu.vision.models import squeezenet1_0, squeezenet1_1
+    assert _fwd(squeezenet1_0(num_classes=10)).shape == [1, 10]
+    assert _fwd(squeezenet1_1(num_classes=7)).shape == [1, 7]
+
+
+def test_mobilenet_v1_forward():
+    from paddle_tpu.vision.models import mobilenet_v1
+    assert _fwd(mobilenet_v1(num_classes=10)).shape == [1, 10]
+    assert _fwd(mobilenet_v1(scale=0.5, num_classes=4)).shape == [1, 4]
+
+
+def test_mobilenet_v3_forward():
+    from paddle_tpu.vision.models import (mobilenet_v3_small,
+                                          mobilenet_v3_large)
+    assert _fwd(mobilenet_v3_small(num_classes=10)).shape == [1, 10]
+    assert _fwd(mobilenet_v3_large(num_classes=5)).shape == [1, 5]
+
+
+def test_shufflenet_v2_forward():
+    from paddle_tpu.vision.models import (shufflenet_v2_x0_25,
+                                          shufflenet_v2_x1_0,
+                                          shufflenet_v2_swish)
+    assert _fwd(shufflenet_v2_x0_25(num_classes=10)).shape == [1, 10]
+    assert _fwd(shufflenet_v2_x1_0(num_classes=6)).shape == [1, 6]
+    assert _fwd(shufflenet_v2_swish(num_classes=3)).shape == [1, 3]
+
+
+def test_densenet_forward():
+    from paddle_tpu.vision.models import densenet121
+    assert _fwd(densenet121(num_classes=10)).shape == [1, 10]
+
+
+def test_inception_v3_forward():
+    from paddle_tpu.vision.models import inception_v3
+    assert _fwd(inception_v3(num_classes=10), size=299).shape == [1, 10]
+
+
+def test_googlenet_forward_with_aux():
+    from paddle_tpu.vision.models import googlenet
+    out, a1, a2 = _fwd(googlenet(num_classes=10), size=224)
+    assert out.shape == [1, 10] and a1.shape == [1, 10] \
+        and a2.shape == [1, 10]
+
+
+def test_zoo_pretrained_raises():
+    from paddle_tpu.vision.models import densenet121
+    with pytest.raises(ValueError, match="pretrained"):
+        densenet121(pretrained=True)
+
+
+def test_zoo_model_trains_one_step():
+    from paddle_tpu.vision.models import mobilenet_v3_small
+    paddle.seed(0)
+    m = mobilenet_v3_small(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=m.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32"))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    loss = paddle.nn.functional.cross_entropy(m(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
