@@ -35,12 +35,37 @@ def _sharding_axis(mesh: ProcessMesh):
     return None
 
 
-def _shard_array_spec(shape, axis_name, nshards):
+def _shard_array_spec(shape, axis_name, nshards, stats=None):
     """Shard dim0 if divisible; else replicate (the reference pads/flattens
-    into buffers instead; dim0 sharding covers transformer weights)."""
+    into buffers instead; dim0 sharding covers transformer weights).
+
+    ``stats``: optional [sharded_bytes, replicated_bytes] accumulator —
+    see _report_replicated for the user-facing memory warning."""
+    import numpy as _np
+    nbytes = int(_np.prod(shape)) * 4 if shape else 4
     if len(shape) > 0 and shape[0] % nshards == 0:
+        if stats is not None:
+            stats[0] += nbytes
         return PartitionSpec(axis_name)
+    if stats is not None:
+        stats[1] += nbytes
     return PartitionSpec()
+
+
+def _report_replicated(stats, what: str):
+    """Warn when a non-trivial fraction of state silently stayed
+    replicated (dim0 not divisible by the sharding degree) — at 7B scale
+    with odd vocab shards this changes the memory story, so it must be
+    visible (the reference avoids it by padding into flat buffers)."""
+    total = stats[0] + stats[1]
+    if total and stats[1] / total > 0.05:
+        import warnings
+        warnings.warn(
+            f"group sharding: {stats[1] / total:.1%} of {what} bytes "
+            f"stayed REPLICATED (dim0 not divisible by the sharding "
+            f"degree) — per-device memory is higher than degree-fold "
+            f"sharding would give; pad those dims or adjust the degree",
+            stacklevel=3)
 
 
 _HOST_MEMORY_OK: dict = {}    # backend platform -> bool (probe once)
@@ -86,11 +111,14 @@ class GroupShardedOptimizerStage2:
             n = self._mesh.get_dim_size(self._axis)
             orig_ensure = optim._ensure_state
 
+            stats = self._shard_stats = [0, 0]
+
             def ensure(p):
                 st = orig_ensure(p)
                 for k, v in st.items():
                     if hasattr(v, "ndim") and v.ndim >= 1:
-                        spec = _shard_array_spec(v.shape, self._axis, n)
+                        spec = _shard_array_spec(v.shape, self._axis, n,
+                                                 stats)
                         sh = NamedSharding(self._mesh.jax_mesh, spec)
                         if offload:
                             sh = _offload_sharding(sh)
@@ -104,6 +132,12 @@ class GroupShardedOptimizerStage2:
 
     def step(self):
         self._optim.step()
+        # states are created lazily per param; after the first full step
+        # the replication fraction is known — report it once
+        stats = getattr(self, "_shard_stats", None)
+        if stats is not None and not getattr(self, "_reported", False):
+            self._reported = True
+            _report_replicated(stats, "optimizer state")
 
     def clear_grad(self, *a, **k):
         self._optim.clear_grad(*a, **k)
@@ -141,16 +175,19 @@ class GroupShardedStage2(Layer):
                         jax.device_put(v, spec_sharding))
                 return hook
 
+            stats = self._shard_stats = [0, 0]
             for p in layer.parameters():
                 if p.stop_gradient:
                     continue
-                spec = _shard_array_spec(p._value.shape, self._axis, n)
+                spec = _shard_array_spec(p._value.shape, self._axis, n,
+                                         stats)
                 if len(spec) == 0:
                     continue   # non-divisible dim0: grads stay replicated
                 sh = NamedSharding(self._mesh.jax_mesh, spec)
                 if offload:
                     sh = _offload_sharding(sh)
                 p.register_hook(make_hook(sh))
+            _report_replicated(stats, "gradient")
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -184,14 +221,17 @@ class GroupShardedStage3(Layer):
         self._axis = _sharding_axis(self._mesh) if self._mesh else None
         if self._axis is not None:
             n = self._mesh.get_dim_size(self._axis)
+            stats = self._shard_stats = [0, 0]
             for p in layer.parameters():
-                spec = _shard_array_spec(p._value.shape, self._axis, n)
+                spec = _shard_array_spec(p._value.shape, self._axis, n,
+                                         stats)
                 sharding = NamedSharding(self._mesh.jax_mesh, spec)
                 p._value = jax.device_put(p._value, sharding)
                 p._process_mesh = self._mesh
                 from ...process_mesh import spec_to_placements
                 p._placements = spec_to_placements(self._mesh, spec,
                                                    p._value.ndim)
+            _report_replicated(stats, "parameter")
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

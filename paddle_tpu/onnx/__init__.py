@@ -1,34 +1,71 @@
-"""paddle.onnx (parity: python/paddle/onnx/export.py — export() via the
-external paddle2onnx package).
+"""paddle.onnx (parity: python/paddle/onnx/export.py — reference
+export() delegates to the external paddle2onnx package over the
+ProgramDesc).
 
-This environment has no network egress and no onnx wheel baked in, so
-export() emits the portable StableHLO artifact via jit.save (loadable by
-any StableHLO consumer, including ONNX converters offline) and raises a
-clear error for a true .onnx file unless the `onnx` package is present.
+TPU-native: export() captures the layer as a static Program (the same
+trace-by-execution capture the Executor compiles) and serializes it to a
+real ``.onnx`` ModelProto with the in-tree protobuf writer
+(:mod:`.proto` — no external onnx dependency, which this no-egress
+environment cannot install).  Ops outside the supported subset raise
+with a pointer to the StableHLO export path (jit.save), which covers
+everything.
 """
 from __future__ import annotations
+
+from typing import List, Optional
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Parity: paddle.onnx.export(layer, path, input_spec)."""
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Parity: paddle.onnx.export(layer, path, input_spec) — writes
+    ``<path>.onnx``.  The layer is captured in eval mode (train-mode RNG
+    ops are not exportable)."""
+    import numpy as np
+    from .. import static as static_mod
+    from ..core.tensor import Tensor
+    from ..jit.api import InputSpec
+    from ._convert import program_to_onnx
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
     try:
-        import onnx  # noqa: F401
-        have_onnx = True
-    except ImportError:
-        have_onnx = False
-    # always produce the portable StableHLO artifact, onnx installed or not
-    from .. import jit as jit_mod
-    jit_mod.save(layer, path, input_spec=input_spec, **configs)
-    if not have_onnx:
-        raise RuntimeError(
-            "the 'onnx' package is not installed in this environment "
-            "(no network egress). The model has been exported as a "
-            f"portable StableHLO module at '{path}.pdexec' instead — "
-            "convert it to ONNX offline, or install onnx to enable "
-            "direct export.")
-    raise NotImplementedError(
-        "direct ONNX serialization is not implemented; the model has been "
-        f"exported as a portable StableHLO module at '{path}.pdexec' — "
-        "use that as the interchange format")
+        prog = static_mod.Program(name="onnx_export")
+        declared = {}
+        with static_mod.program_guard(prog):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, Tensor):
+                    shape = list(spec.shape)
+                    decl = list(shape)
+                    dtype = str(spec.dtype)
+                    name = getattr(spec, "name", None) or f"x{i}"
+                elif isinstance(spec, InputSpec):
+                    decl = [None if (s is None or s < 0) else int(s)
+                            for s in spec.shape]
+                    shape = [1 if s is None else s for s in decl]
+                    dtype = str(spec.dtype)
+                    name = spec.name or f"x{i}"
+                else:
+                    arr = np.asarray(spec)
+                    shape, dtype, name = list(arr.shape), str(arr.dtype), \
+                        f"x{i}"
+                    decl = list(shape)
+                declared[name] = decl        # None dims -> dim_param
+                feeds.append(static_mod.data(name, shape, dtype))
+            out = layer(*feeds)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        data = program_to_onnx(prog, outs, opset=opset_version,
+                               declared_shapes=declared)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    target = path if path.endswith(".onnx") else path + ".onnx"
+    with open(target, "wb") as f:
+        f.write(data)
+    return target

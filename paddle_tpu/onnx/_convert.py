@@ -1,0 +1,279 @@
+"""Captured-program -> ONNX graph conversion.
+
+Parity: python/paddle/onnx/export.py (reference — delegates to the
+external paddle2onnx C++ converter over the ProgramDesc).  TPU-native:
+the source of truth is the trace-captured Program (the same StatementIR
+the Executor compiles); each recorded statement maps to ONNX node(s),
+with op attributes recovered from the recorded closures (we own every
+closure, so the freevar names are a stable ABI).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from . import proto as P
+
+
+def _closure_vars(fn) -> Dict:
+    code = getattr(fn, "__code__", None)
+    clo = getattr(fn, "__closure__", None)
+    if not code or not clo:
+        return {}
+    out = {}
+    for name, cell in zip(code.co_freevars, clo):
+        try:
+            out[name] = cell.cell_contents
+        except ValueError:
+            pass
+    return out
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (tuple, list)) else [v, v]
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.shapes: Dict[str, tuple] = {}   # name -> shape (inference)
+        self._const_n = 0
+
+    def const(self, arr: np.ndarray, name_hint="const") -> str:
+        self._const_n += 1
+        name = f"{name_hint}_{self._const_n}"
+        self.initializers.append(P.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op, ins, outs, attrs=()):
+        self.nodes.append(P.node(op, ins, outs,
+                                 name=f"{op}_{len(self.nodes)}",
+                                 attrs=attrs))
+
+    # -- per-op converters ---------------------------------------------------
+    def convert(self, stmt, ins: List[str], outs: List[str]):
+        cv = _closure_vars(stmt.fn)
+        name = stmt.name
+        handler = getattr(self, f"_op_{name}", None)
+        if handler is None:
+            simple = _SIMPLE.get(name)
+            if simple is None:
+                raise NotImplementedError(
+                    f"ONNX export: op '{name}' is not in the supported "
+                    f"subset ({sorted(_SIMPLE) + _SPECIAL}); export via "
+                    "jit.save (StableHLO) instead")
+            self.emit(simple, ins, outs)
+            return
+        handler(ins, outs, cv, stmt)
+
+    def _op_linear(self, ins, outs, cv, stmt):
+        x, w = ins[0], ins[1]
+        mm = outs[0] + "_mm" if len(ins) > 2 and ins[2] else outs[0]
+        self.emit("MatMul", [x, w], [mm])
+        if len(ins) > 2 and ins[2]:
+            self.emit("Add", [mm, ins[2]], [outs[0]])
+
+    def _op_matmul(self, ins, outs, cv, stmt):
+        tx = cv.get("transpose_x") or cv.get("tx")
+        ty = cv.get("transpose_y") or cv.get("ty")
+        x, y = ins[0], ins[1]
+
+        def swap_last2(name):
+            rank = len(self.shapes.get(name, ()))
+            if rank < 2:
+                raise NotImplementedError(
+                    "ONNX export: matmul transpose of rank<2 operand")
+            perm = list(range(rank))
+            perm[-1], perm[-2] = perm[-2], perm[-1]
+            t = name + "_T"
+            self.emit("Transpose", [name], [t],
+                      [P.attr_ints("perm", perm)])
+            self.shapes[t] = tuple(
+                self.shapes[name][p] for p in perm)
+            return t
+
+        if tx:
+            x = swap_last2(x)
+        if ty:
+            y = swap_last2(y)
+        self.emit("MatMul", [x, y], outs)
+
+    @staticmethod
+    def _check_pad(pad, op):
+        if isinstance(pad, str):
+            raise NotImplementedError(
+                f"ONNX export: {op} with '{pad}' padding — use explicit "
+                "integer padding, or export via jit.save (StableHLO)")
+
+    def _op_conv2d(self, ins, outs, cv, stmt):
+        pad = cv.get("pad", [(0, 0), (0, 0)])
+        self._check_pad(pad, "conv2d")
+        if cv.get("channel_last"):
+            raise NotImplementedError(
+                "ONNX export: NHWC conv — export NCHW models")
+        strides = _pair(cv.get("strides", (1, 1)))
+        dil = _pair(cv.get("dil", (1, 1)))
+        attrs = [
+            P.attr_ints("strides", strides),
+            P.attr_ints("dilations", dil),
+            P.attr_ints("pads", [pad[0][0], pad[1][0], pad[0][1],
+                                 pad[1][1]]),
+            P.attr_int("group", int(cv.get("groups", 1))),
+        ]
+        self.emit("Conv", ins, outs, attrs)
+
+    def _pool(self, ins, outs, cv, kind):
+        pad = cv.get("pad", [(0, 0), (0, 0)])
+        self._check_pad(pad, "pool2d")
+        if cv.get("ceil_mode"):
+            raise NotImplementedError(
+                "ONNX export: pool2d ceil_mode=True")
+        if cv.get("channel_last"):
+            raise NotImplementedError("ONNX export: NHWC pooling")
+        if kind == "AveragePool" and not cv.get("exclusive", True):
+            raise NotImplementedError(
+                "ONNX export: avg_pool2d exclusive=False")
+        attrs = [
+            P.attr_ints("kernel_shape", _pair(cv.get("k"))),
+            P.attr_ints("strides", _pair(cv.get("s", cv.get("k")))),
+            P.attr_ints("pads", [pad[0][0], pad[1][0], pad[0][1],
+                                 pad[1][1]]),
+        ]
+        self.emit(kind, ins, outs, attrs)
+
+    def _op_max_pool2d(self, ins, outs, cv, stmt):
+        self._pool(ins, outs, cv, "MaxPool")
+
+    def _op_avg_pool2d(self, ins, outs, cv, stmt):
+        self._pool(ins, outs, cv, "AveragePool")
+
+    def _op_flatten(self, ins, outs, cv, stmt):
+        stop = cv.get("stop_axis", -1)
+        if stop not in (-1,):
+            raise NotImplementedError(
+                "ONNX export: flatten with stop_axis != -1")
+        self.emit("Flatten", ins, outs,
+                  [P.attr_int("axis", int(cv.get("start_axis", 1)))])
+
+    def _op_reshape(self, ins, outs, cv, stmt):
+        shape = cv.get("shape") or cv.get("shp")
+        if shape is None:
+            raise NotImplementedError("ONNX export: dynamic reshape")
+        shp = self.const(np.asarray(list(shape), np.int64), "shape")
+        self.emit("Reshape", [ins[0], shp], outs)
+
+    def _op_transpose(self, ins, outs, cv, stmt):
+        perm = cv.get("perm")
+        self.emit("Transpose", ins, outs,
+                  [P.attr_ints("perm", [int(p) for p in perm])]
+                  if perm is not None else ())
+
+    def _op_softmax(self, ins, outs, cv, stmt):
+        self.emit("Softmax", ins, outs,
+                  [P.attr_int("axis", int(cv.get("axis", -1)))])
+
+    def _op_concat(self, ins, outs, cv, stmt):
+        self.emit("Concat", ins, outs,
+                  [P.attr_int("axis", int(cv.get("axis", 0)))])
+
+
+_SIMPLE = {
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "exp": "Exp",
+    "sqrt": "Sqrt", "add": "Add", "subtract": "Sub", "multiply": "Mul",
+    "divide": "Div", "neg": "Neg", "elementwise_add": "Add",
+}
+_SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
+            "flatten", "reshape", "transpose", "softmax", "concat"]
+
+
+def _elem_type(dtype) -> int:
+    return P._NP2ONNX.get(np.dtype(dtype), P.FLOAT)
+
+
+def program_to_onnx(program, out_tensors, opset: int = 13,
+                    declared_shapes: Dict[str, list] = None) -> bytes:
+    """Convert a captured static Program to ONNX ModelProto bytes.
+
+    ``declared_shapes``: optional feed-name -> shape with None for
+    dynamic dims (emitted as dim_param); the capture itself always runs
+    on concrete shapes."""
+    import jax
+
+    rec = program.recorder
+    conv = _Converter()
+    declared_shapes = declared_shapes or {}
+
+    sym_name: Dict[int, str] = {}
+    sym_sd: Dict[int, "jax.ShapeDtypeStruct"] = {}
+    inputs = []
+    for feed_name, t in program.feeds:
+        sym = rec._sym_of[id(t._value)]
+        sym_name[sym] = feed_name
+        sym_sd[sym] = jax.ShapeDtypeStruct(tuple(t.shape),
+                                           np.dtype(str(t.dtype)))
+        conv.shapes[feed_name] = tuple(t.shape)
+        decl = declared_shapes.get(feed_name, list(t.shape))
+        inputs.append(P.value_info(feed_name,
+                                   _elem_type(str(t.dtype)), decl))
+
+    # captured weights -> initializers
+    for cap_t, sym in rec._captures.values():
+        name = f"w_{sym}"
+        sym_name[sym] = name
+        arr = np.asarray(cap_t._value)
+        sym_sd[sym] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+        conv.shapes[name] = tuple(arr.shape)
+        conv.initializers.append(P.tensor_proto(name, arr))
+
+    for si, stmt in enumerate(rec.statements):
+        ins = []
+        eval_args = []
+        for kind, val in stmt.arg_spec:
+            if kind == "s":
+                ins.append(sym_name[val])
+                eval_args.append(sym_sd[val])
+            elif kind == "c":
+                eval_args.append(val)
+                if isinstance(val, (int, float)):
+                    ins.append(conv.const(
+                        np.asarray(val, np.float32), "scalar"))
+                elif isinstance(val, (np.ndarray,)) or hasattr(
+                        val, "shape"):
+                    ins.append(conv.const(np.asarray(val), "baked"))
+                elif val is None:
+                    ins.append("")
+                else:
+                    raise NotImplementedError(
+                        f"ONNX export: constant arg {type(val)} in "
+                        f"op '{stmt.name}'")
+            else:
+                raise NotImplementedError(
+                    f"ONNX export: op '{stmt.name}' draws RNG (train-"
+                    "mode graph?) — export in eval mode")
+        out_sd = jax.eval_shape(
+            lambda *a: stmt.fn(*a, **stmt.kwargs), *eval_args)
+        flat_sd = out_sd if isinstance(out_sd, tuple) else (out_sd,)
+        outs = []
+        for osym, sd in zip(stmt.out_syms, flat_sd):
+            n = f"t_{osym}"
+            sym_name[osym] = n
+            sym_sd[osym] = sd
+            conv.shapes[n] = tuple(sd.shape)
+            outs.append(n)
+        conv.convert(stmt, ins, outs)
+
+    outputs = []
+    for i, t in enumerate(out_tensors):
+        sym = rec._sym_of.get(id(t._value))
+        if sym is None or sym not in sym_name:
+            raise ValueError("output tensor was not produced by the "
+                             "captured program")
+        outputs.append(P.value_info(sym_name[sym],
+                                    _elem_type(str(t.dtype)),
+                                    list(t.shape)))
+
+    g = P.graph(conv.nodes, program.name, inputs, outputs,
+                conv.initializers)
+    return P.model(g, opset=opset)

@@ -1,0 +1,333 @@
+"""Minimal ONNX protobuf writer/reader (no external onnx dependency).
+
+The environment ships no `onnx` wheel, but ONNX files are plain protobuf
+— this module hand-encodes the ModelProto subset needed to serialize
+captured programs (and decodes it back for verification).  Field numbers
+follow onnx.proto3 (onnx/onnx.proto in the ONNX repo).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# -- protobuf wire primitives ------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def fv(field: int, val: int) -> bytes:
+    """varint field"""
+    return _key(field, 0) + _varint(int(val))
+
+
+def fb(field: int, data: bytes) -> bytes:
+    """length-delimited field"""
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def fs(field: int, s: str) -> bytes:
+    return fb(field, s.encode())
+
+
+def ff(field: int, val: float) -> bytes:
+    """float (fixed32) field"""
+    return _key(field, 5) + struct.pack("<f", float(val))
+
+
+# -- ONNX message builders ---------------------------------------------------
+# TensorProto.DataType
+FLOAT, INT64, INT32, BOOL = 1, 7, 6, 9
+_NP2ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.int64): INT64,
+            np.dtype(np.int32): INT32, np.dtype(np.bool_): BOOL}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = _NP2ONNX.get(arr.dtype)
+    if dt is None:
+        arr = arr.astype(np.float32)
+        dt = FLOAT
+    out = b"".join(fv(1, d) for d in arr.shape)
+    out += fv(2, dt)
+    out += fs(8, name)
+    out += fb(9, arr.tobytes())        # raw_data
+    return out
+
+
+def value_info(name: str, elem_type: int, shape: Sequence) -> bytes:
+    dims = b""
+    for d in shape:
+        if isinstance(d, str) or d is None or (isinstance(d, int)
+                                               and d < 0):
+            dims += fb(1, fs(2, str(d) if d else "N"))   # dim_param
+        else:
+            dims += fb(1, fv(1, int(d)))                  # dim_value
+    tensor_type = fv(1, elem_type) + fb(2, dims)
+    return fs(1, name) + fb(2, fb(1, tensor_type))
+
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS = 1, 2, 3, 4, 6, 7
+
+
+def attr_int(name: str, val: int) -> bytes:
+    return fs(1, name) + fv(3, val) + fv(20, A_INT)
+
+
+def attr_ints(name: str, vals: Sequence[int]) -> bytes:
+    return fs(1, name) + b"".join(fv(8, v) for v in vals) + fv(20, A_INTS)
+
+
+def attr_float(name: str, val: float) -> bytes:
+    return fs(1, name) + ff(2, val) + fv(20, A_FLOAT)
+
+
+def attr_str(name: str, val: str) -> bytes:
+    return fs(1, name) + fb(4, val.encode()) + fv(20, A_STRING)
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", attrs: Sequence[bytes] = ()) -> bytes:
+    out = b"".join(fs(1, i) for i in inputs)
+    out += b"".join(fs(2, o) for o in outputs)
+    out += fs(3, name or op_type)
+    out += fs(4, op_type)
+    out += b"".join(fb(5, a) for a in attrs)
+    return out
+
+
+def graph(nodes: Sequence[bytes], name: str, inputs: Sequence[bytes],
+          outputs: Sequence[bytes], initializers: Sequence[bytes]) -> bytes:
+    out = b"".join(fb(1, n) for n in nodes)
+    out += fs(2, name)
+    out += b"".join(fb(5, t) for t in initializers)
+    out += b"".join(fb(11, i) for i in inputs)
+    out += b"".join(fb(12, o) for o in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    out = fv(1, 8)                      # ir_version 8
+    out += fs(2, producer)
+    out += fb(7, graph_bytes)
+    out += fb(8, fs(1, "") + fv(2, opset))   # opset_import
+    return out
+
+
+# -- generic protobuf reader (for verification / the numpy evaluator) --------
+def decode(buf: bytes) -> Dict[int, List]:
+    """field -> list of raw values (ints for varint/fixed, bytes for
+    length-delimited)."""
+    out: Dict[int, List] = {}
+    i = 0
+    n = len(buf)
+
+    def rv():
+        nonlocal i
+        shift = 0
+        val = 0
+        while True:
+            b = buf[i]
+            i += 1
+            val |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return val
+            shift += 7
+
+    while i < n:
+        key = rv()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val = rv()
+        elif wire == 2:
+            ln = rv()
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _read_tensor(tbytes: bytes) -> Tuple[str, np.ndarray]:
+    f = decode(tbytes)
+    dims = f.get(1, [])
+    dt = _ONNX2NP[f[2][0]]
+    name = f[8][0].decode()
+    arr = np.frombuffer(f[9][0], dtype=dt).reshape(dims)
+    return name, arr
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _read_attrs(node_fields) -> Dict:
+    attrs = {}
+    for ab in node_fields.get(5, []):
+        a = decode(ab)
+        name = a[1][0].decode()
+        atype = a.get(20, [0])[0]
+        if atype == A_INT:
+            attrs[name] = _signed(a[3][0])
+        elif atype == A_INTS:
+            attrs[name] = [_signed(v) for v in a.get(8, [])]
+        elif atype == A_FLOAT:
+            attrs[name] = a[2][0]
+        elif atype == A_STRING:
+            attrs[name] = a[4][0].decode()
+    return attrs
+
+
+def load_model(data: bytes) -> Dict:
+    """Parse a .onnx file into {graph_name, nodes, inputs, outputs,
+    initializers} for verification."""
+    m = decode(data)
+    g = decode(m[7][0])
+    nodes = []
+    for nb in g.get(1, []):
+        nf = decode(nb)
+        nodes.append({
+            "op_type": nf[4][0].decode(),
+            "inputs": [x.decode() for x in nf.get(1, [])],
+            "outputs": [x.decode() for x in nf.get(2, [])],
+            "attrs": _read_attrs(nf),
+        })
+    inits = dict(_read_tensor(t) for t in g.get(5, []))
+
+    def names(field):
+        return [decode(v)[1][0].decode() for v in g.get(field, [])]
+
+    return {"name": g.get(2, [b""])[0].decode(), "nodes": nodes,
+            "inputs": names(11), "outputs": names(12),
+            "initializers": inits,
+            "opset": decode(m[8][0])[2][0] if 8 in m else None}
+
+
+# -- numpy evaluator for the exported subset (verification) -----------------
+def _np_conv2d(x, w, b, strides, pads, dilations, group):
+    from jax import lax
+    import jax.numpy as jnp
+    out = lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), tuple(strides),
+        [(pads[0], pads[2]), (pads[1], pads[3])],
+        rhs_dilation=tuple(dilations), feature_group_count=group,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = np.asarray(out)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _np_pool(x, kind, kernel, strides, pads):
+    N, C, H, W = x.shape
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if kind == "Max" else 0.0
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=fill)
+    kh, kw = kernel
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((N, C, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((2, 3)) if kind == "Max" \
+                else win.mean((2, 3))
+    return out
+
+
+def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
+    """Run the parsed model with numpy (reference interpreter for tests)."""
+    env = dict(model_dict["initializers"])
+    env.update(feeds)
+    for nd in model_dict["nodes"]:
+        ins = [env[i] if i else None for i in nd["inputs"]]
+        op = nd["op_type"]
+        a = nd["attrs"]
+        if op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Relu":
+            out = np.maximum(ins[0], 0)
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-ins[0]))
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Exp":
+            out = np.exp(ins[0])
+        elif op == "Sqrt":
+            out = np.sqrt(ins[0])
+        elif op == "Neg":
+            out = -ins[0]
+        elif op == "Softmax":
+            ax = a.get("axis", -1)
+            e = np.exp(ins[0] - ins[0].max(axis=ax, keepdims=True))
+            out = e / e.sum(axis=ax, keepdims=True)
+        elif op == "Flatten":
+            ax = a.get("axis", 1)
+            out = ins[0].reshape(
+                int(np.prod(ins[0].shape[:ax])) if ax else 1, -1)
+        elif op == "Reshape":
+            shape = [int(s) for s in ins[1]]
+            out = ins[0].reshape(shape)
+        elif op == "Transpose":
+            out = np.transpose(ins[0], a.get("perm"))
+        elif op == "Concat":
+            out = np.concatenate(ins, axis=a.get("axis", 0))
+        elif op == "Conv":
+            out = _np_conv2d(ins[0], ins[1],
+                             ins[2] if len(ins) > 2 else None,
+                             a.get("strides", [1, 1]),
+                             a.get("pads", [0, 0, 0, 0]),
+                             a.get("dilations", [1, 1]),
+                             a.get("group", 1))
+        elif op in ("MaxPool", "AveragePool"):
+            out = _np_pool(ins[0], "Max" if op == "MaxPool" else "Avg",
+                           a.get("kernel_shape"),
+                           a.get("strides", [1, 1]),
+                           a.get("pads", [0, 0, 0, 0]))
+        elif op == "GlobalAveragePool":
+            out = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "Gemm":
+            x, w = ins[0], ins[1]
+            if a.get("transB"):
+                w = w.T
+            out = x @ w
+            if len(ins) > 2:
+                out = out + ins[2]
+        else:
+            raise NotImplementedError(f"evaluator: {op}")
+        env[nd["outputs"][0]] = np.asarray(out)
+    return [env[o] for o in model_dict["outputs"]]

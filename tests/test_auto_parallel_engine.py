@@ -194,3 +194,25 @@ def test_tuner_real_trials_over_engine():
 
     best = AutoTuner(cfg).tune(trial)
     assert best is not None and best["throughput"] > 0
+
+
+def test_engine_cost_calibration():
+    """cost() anchored to a measured step after calibrate_cost (round-3
+    weak item: the analytic pruner formula was never validated)."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    eng = Engine(net, paddle.nn.MSELoss(), opt)
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(0)
+    ds = TensorDataset([paddle.to_tensor(rng.rand(16, 8).astype("f4")),
+                        paddle.to_tensor(rng.rand(16, 1).astype("f4"))])
+    eng.fit(ds, batch_size=8, epochs=1)
+    dt = eng.calibrate_cost()
+    assert dt > 0
+    c = eng.cost()
+    assert c["measured_step_time"] == dt
+    assert c["achieved_flops_per_sec"] > 0
+    assert c["n_params"] == 8 * 16 + 16 + 16 + 1

