@@ -232,13 +232,38 @@ class Engine:
             raise RuntimeError(
                 "call fit() for at least one step first, or pass "
                 "sample_batch")
-        loss = step(*arrays)                      # warm / compile
-        float(np.asarray(loss._value))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            loss = step(*arrays)
-        float(np.asarray(loss._value))            # host fetch = barrier
-        dt = (time.perf_counter() - t0) / iters
+        # snapshot params + optimizer state: calibration is a cost QUERY
+        # and must not move the model (the timed TrainStep applies real
+        # updates)
+        import jax.numpy as jnp
+        sd = self._model.state_dict()
+        # REAL copies: the fused step donates the param/state buffers,
+        # so bare references would be deleted by the timed steps
+        param_snap = {k: jnp.array(t._value, copy=True)
+                      for k, t in sd.items()}
+        ts = self._train_step
+        opt_snap = [
+            {k: (jnp.array(v, copy=True) if hasattr(v, "dtype") else v)
+             for k, v in st.items()} for st in
+            (ts._opt_states[k2] for k2 in ts._trainable)
+        ] if hasattr(ts, "_opt_states") else None
+        gstep = self._optimizer._global_step
+        try:
+            loss = step(*arrays)                  # warm / compile
+            float(np.asarray(loss._value))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step(*arrays)
+            float(np.asarray(loss._value))        # host fetch = barrier
+            dt = (time.perf_counter() - t0) / iters
+        finally:
+            for k, t in sd.items():
+                t._value = param_snap[k]
+            if opt_snap is not None:
+                for k2, snap in zip(ts._trainable, opt_snap):
+                    ts._opt_states[k2].clear()
+                    ts._opt_states[k2].update(snap)
+            self._optimizer._global_step = gstep
         self._measured_step_time = dt
         n_samples = int(np.shape(arrays[0])[0]) if np.ndim(
             arrays[0]) else 1

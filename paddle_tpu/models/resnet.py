@@ -37,14 +37,16 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
+        width = int(planes * (base_width / 64.0))
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
                             bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
-        self.conv3 = Conv2D(planes, planes * 4, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(planes * 4)
         self.downsample = downsample
         self.relu = ReLU()
@@ -63,9 +65,11 @@ class ResNet(Layer):
     """Parity: paddle.vision.models.ResNet."""
 
     def __init__(self, block, depth_cfg: List[int], num_classes=1000,
-                 with_pool=True, in_channels=3):
+                 with_pool=True, in_channels=3, width=64):
         super().__init__()
         self.inplanes = 64
+        # width=64*2 -> wide resnet (reference ResNet(..., width=128))
+        self._base_width = width
         self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
                             bias_attr=False)
         self.bn1 = BatchNorm2D(64)
@@ -89,10 +93,17 @@ class ResNet(Layer):
                 Conv2D(self.inplanes, planes * block.expansion, 1,
                        stride=stride, bias_attr=False),
                 BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        if not issubclass(block, BottleneckBlock) \
+                and self._base_width != 64:
+            raise ValueError(
+                "width != 64 requires BottleneckBlock architectures "
+                "(resnet50+); BasicBlock has no width knob")
+        kw = {"base_width": self._base_width} \
+            if issubclass(block, BottleneckBlock) else {}
+        layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **kw))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -124,3 +135,13 @@ def resnet101(pretrained=False, **kw):
 
 def resnet152(pretrained=False, **kw):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    """Parity: paddle.vision.models.wide_resnet50_2 (resnet.py:66)."""
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], width=64 * 2, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    """Parity: paddle.vision.models.wide_resnet101_2 (resnet.py:70)."""
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], width=64 * 2, **kw)

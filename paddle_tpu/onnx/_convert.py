@@ -150,12 +150,20 @@ class _Converter:
         self._pool(ins, outs, cv, "AveragePool")
 
     def _op_flatten(self, ins, outs, cv, stmt):
+        start = int(cv.get("start_axis", 1))
         stop = cv.get("stop_axis", -1)
-        if stop not in (-1,):
+        if start == 1 and stop == -1:
+            # exactly ONNX Flatten semantics (keep dim0, collapse rest)
+            self.emit("Flatten", ins, outs, [P.attr_int("axis", 1)])
+            return
+        # general flatten keeps ALL leading dims — ONNX Flatten does not;
+        # emit a Reshape to the statically-known output shape instead
+        out_shape = self.shapes.get(outs[0])
+        if out_shape is None:
             raise NotImplementedError(
-                "ONNX export: flatten with stop_axis != -1")
-        self.emit("Flatten", ins, outs,
-                  [P.attr_int("axis", int(cv.get("start_axis", 1)))])
+                "ONNX export: flatten with unknown static shape")
+        shp = self.const(np.asarray(list(out_shape), np.int64), "shape")
+        self.emit("Reshape", [ins[0], shp], outs)
 
     def _op_reshape(self, ins, outs, cv, stmt):
         shape = cv.get("shape") or cv.get("shp")
@@ -228,6 +236,13 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
         conv.initializers.append(P.tensor_proto(name, arr))
 
     for si, stmt in enumerate(rec.statements):
+        # scalar constants take the dtype of the first tensor operand so
+        # binary ops stay type-consistent in the exported graph
+        ref_dtype = np.float32
+        for kind, val in stmt.arg_spec:
+            if kind == "s":
+                ref_dtype = sym_sd[val].dtype
+                break
         ins = []
         eval_args = []
         for kind, val in stmt.arg_spec:
@@ -238,7 +253,7 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
                 eval_args.append(val)
                 if isinstance(val, (int, float)):
                     ins.append(conv.const(
-                        np.asarray(val, np.float32), "scalar"))
+                        np.asarray(val, ref_dtype), "scalar"))
                 elif isinstance(val, (np.ndarray,)) or hasattr(
                         val, "shape"):
                     ins.append(conv.const(np.asarray(val), "baked"))

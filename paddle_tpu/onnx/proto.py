@@ -248,6 +248,10 @@ def _np_pool(x, kind, kernel, strides, pads):
     fill = -np.inf if kind == "Max" else 0.0
     xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
                 constant_values=fill)
+    # ONNX default count_include_pad=0: average divides by the number of
+    # NON-pad elements in each window (what the converter exports)
+    mask = np.pad(np.ones((H, W), x.dtype),
+                  ((ph0, ph1), (pw0, pw1)), constant_values=0.0)
     kh, kw = kernel
     sh, sw = strides
     oh = (xp.shape[2] - kh) // sh + 1
@@ -256,8 +260,12 @@ def _np_pool(x, kind, kernel, strides, pads):
     for i in range(oh):
         for j in range(ow):
             win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
-            out[:, :, i, j] = win.max((2, 3)) if kind == "Max" \
-                else win.mean((2, 3))
+            if kind == "Max":
+                out[:, :, i, j] = win.max((2, 3))
+            else:
+                cnt = mask[i * sh:i * sh + kh,
+                           j * sw:j * sw + kw].sum()
+                out[:, :, i, j] = win.sum((2, 3)) / max(cnt, 1.0)
     return out
 
 

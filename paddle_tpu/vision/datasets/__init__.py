@@ -22,7 +22,7 @@ from PIL import Image
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
 
 
 def _require(path, what):
@@ -238,3 +238,106 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers102 (parity: python/paddle/vision/datasets/flowers.py:41 —
+    102flowers tgz + imagelabels.mat + setid.mat; ``download=True`` is
+    unsupported here, pass the files)."""
+
+    _MODE_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        import tarfile
+        import scipy.io as sio
+
+        assert mode.lower() in self._MODE_KEY, \
+            f"mode must be one of {sorted(self._MODE_KEY)}"
+        _require(data_file, "Flowers images tgz (102flowers.tgz)")
+        _require(label_file, "Flowers imagelabels.mat")
+        _require(setid_file, "Flowers setid.mat")
+        self.transform = transform
+        self._labels = sio.loadmat(label_file)["labels"].ravel()
+        setid = sio.loadmat(setid_file)
+        self._indexes = setid[self._MODE_KEY[mode.lower()]].ravel()
+        self._data_file = data_file
+        self._tar_cache = (None, None)   # (pid, handle): fork safety
+        self._names = {os.path.basename(n): n
+                       for n in self._get_tar().getnames()
+                       if n.endswith(".jpg")}
+
+    def _get_tar(self):
+        # DataLoader workers fork: a shared TarFile/fd would interleave
+        # seeks across processes, so each process opens its own handle
+        import tarfile
+        pid, tar = self._tar_cache
+        if pid != os.getpid():
+            tar = tarfile.open(self._data_file)
+            self._tar_cache = (os.getpid(), tar)
+        return tar
+
+    def __getitem__(self, idx):
+        flower_id = int(self._indexes[idx])
+        name = "image_%05d.jpg" % flower_id
+        f = self._get_tar().extractfile(self._names[name])
+        img = np.asarray(Image.open(f))
+        label = np.array([self._labels[flower_id - 1]], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (parity:
+    python/paddle/vision/datasets/voc2012.py — VOCtrainval tar; yields
+    (image, segmentation mask))."""
+
+    _SPLIT_DIR = "ImageSets/Segmentation"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import tarfile
+
+        assert mode.lower() in ("train", "valid", "test"), mode
+        _require(data_file, "VOC2012 tar (VOCtrainval_11-May-2012.tar)")
+        self.transform = transform
+        self._data_file = data_file
+        self._tar_cache = (None, None)
+        names = self._get_tar().getnames()
+        split_name = {"train": "train.txt", "valid": "val.txt",
+                      "test": "val.txt"}[mode.lower()]
+        split_path = next(n for n in names
+                          if n.endswith(f"{self._SPLIT_DIR}/{split_name}"))
+        ids = self._get_tar().extractfile(split_path).read().decode() \
+            .split()
+        self._jpeg = {os.path.basename(n)[:-4]: n for n in names
+                      if "/JPEGImages/" in n and n.endswith(".jpg")}
+        self._mask = {os.path.basename(n)[:-4]: n for n in names
+                      if "/SegmentationClass/" in n
+                      and n.endswith(".png")}
+        self._ids = [i for i in ids if i in self._jpeg and i in self._mask]
+
+    def _get_tar(self):
+        import tarfile
+        pid, tar = self._tar_cache
+        if pid != os.getpid():
+            tar = tarfile.open(self._data_file)
+            self._tar_cache = (os.getpid(), tar)
+        return tar
+
+    def __getitem__(self, idx):
+        key = self._ids[idx]
+        tar = self._get_tar()
+        img = np.asarray(Image.open(tar.extractfile(self._jpeg[key])))
+        mask = np.asarray(Image.open(tar.extractfile(self._mask[key])))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._ids)

@@ -216,3 +216,21 @@ def test_engine_cost_calibration():
     assert c["measured_step_time"] == dt
     assert c["achieved_flops_per_sec"] > 0
     assert c["n_params"] == 8 * 16 + 16 + 16 + 1
+
+
+def test_calibrate_cost_does_not_mutate_model():
+    paddle.seed(1)
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=net.parameters())
+    eng = Engine(net, paddle.nn.MSELoss(), opt)
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(0)
+    ds = TensorDataset([paddle.to_tensor(rng.rand(8, 4).astype("f4")),
+                        paddle.to_tensor(rng.rand(8, 1).astype("f4"))])
+    eng.fit(ds, batch_size=8, epochs=1)
+    w_before = net.weight.numpy().copy()
+    step_before = opt._global_step
+    eng.calibrate_cost(iters=2)
+    np.testing.assert_allclose(net.weight.numpy(), w_before)
+    assert opt._global_step == step_before

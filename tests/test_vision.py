@@ -361,3 +361,82 @@ def test_zoo_model_trains_one_step():
     loss.backward()
     opt.step()
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_wide_resnet_variants():
+    from paddle_tpu.vision.models import wide_resnet50_2
+    m = wide_resnet50_2(num_classes=5)
+    # wide bottleneck: first block's 3x3 conv has doubled width
+    blk = m.layer1[0]
+    assert blk.conv2.weight.shape[0] == 128      # 64 * 2
+    out = _fwd(m, size=64)
+    assert out.shape == [1, 5]
+
+
+def test_flowers_dataset_from_local_files(tmp_path):
+    import tarfile
+    import scipy.io as sio
+    from paddle_tpu.vision.datasets import Flowers
+
+    # synthesize a miniature flowers layout
+    img_dir = tmp_path / "jpg"
+    img_dir.mkdir()
+    from PIL import Image as PILImage
+    for i in range(1, 5):
+        PILImage.fromarray(
+            (np.random.RandomState(i).rand(8, 8, 3) * 255)
+            .astype("uint8")).save(img_dir / ("image_%05d.jpg" % i))
+    tgz = tmp_path / "102flowers.tgz"
+    with tarfile.open(tgz, "w:gz") as t:
+        for i in range(1, 5):
+            t.add(img_dir / ("image_%05d.jpg" % i),
+                  arcname="jpg/image_%05d.jpg" % i)
+    sio.savemat(tmp_path / "imagelabels.mat",
+                {"labels": np.array([[3, 1, 4, 1]])})
+    sio.savemat(tmp_path / "setid.mat",
+                {"trnid": np.array([[1, 3]]),
+                 "valid": np.array([[2]]), "tstid": np.array([[4]])})
+
+    ds = Flowers(str(tgz), str(tmp_path / "imagelabels.mat"),
+                 str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and int(label[0]) == 3
+    test = Flowers(str(tgz), str(tmp_path / "imagelabels.mat"),
+                   str(tmp_path / "setid.mat"), mode="test")
+    assert len(test) == 1 and int(test[0][1][0]) == 1
+
+    with pytest.raises(RuntimeError, match="not found"):
+        Flowers(None, None, None)
+
+
+def test_voc2012_dataset_from_local_tar(tmp_path):
+    import tarfile
+    from PIL import Image as PILImage
+    from paddle_tpu.vision.datasets import VOC2012
+
+    root = tmp_path / "VOCdevkit" / "VOC2012"
+    (root / "JPEGImages").mkdir(parents=True)
+    (root / "SegmentationClass").mkdir(parents=True)
+    (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+    for name in ("2007_000001", "2007_000002"):
+        PILImage.fromarray(
+            (np.random.rand(6, 6, 3) * 255).astype("uint8")).save(
+            root / "JPEGImages" / f"{name}.jpg")
+        PILImage.fromarray(
+            np.random.randint(0, 20, (6, 6)).astype("uint8")).save(
+            root / "SegmentationClass" / f"{name}.png")
+    (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
+        "2007_000001\n")
+    (root / "ImageSets" / "Segmentation" / "val.txt").write_text(
+        "2007_000002\n")
+    tar = tmp_path / "voc.tar"
+    with tarfile.open(tar, "w") as t:
+        t.add(tmp_path / "VOCdevkit", arcname="VOCdevkit")
+
+    ds = VOC2012(str(tar), mode="train")
+    assert len(ds) == 1
+    img, mask = ds[0]
+    assert img.shape == (6, 6, 3) and mask.shape == (6, 6)
+    val = VOC2012(str(tar), mode="valid")
+    assert len(val) == 1
