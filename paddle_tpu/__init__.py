@@ -86,3 +86,107 @@ if "static" in _OPTIONAL_SUBMODULES and globals().get("static") is not None:
 # implementations exist to alias.
 from .ops import op_surface as _op_surface    # noqa: E402
 _op_surface.register_framework_ops()
+
+# round-4 top-level tail: dtype info, ParamAttr, flops, rng aliases
+from .framework_misc import iinfo, finfo, ParamAttr, flops  # noqa: E402
+get_cuda_rng_state = get_rng_state     # device-agnostic aliases
+set_cuda_rng_state = set_rng_state
+import numpy as _np_mod  # noqa: E402
+dtype = _np_mod.dtype    # paddle.dtype: canonical dtype constructor
+
+
+def shape(x):
+    """Parity: paddle.shape — the runtime shape as an int64 Tensor
+    (static shapes under XLA, so this is the concrete shape)."""
+    import numpy as _np
+    return Tensor(_np.asarray(x.shape if isinstance(x, Tensor)
+                              else _np.shape(x), _np.int64))
+
+
+def tolist(x):
+    """Parity: paddle.tolist."""
+    return x.tolist() if isinstance(x, Tensor) else list(x)
+
+
+def check_shape(x):
+    """Parity: paddle.check_shape (shape sanity guard)."""
+    for s in (x.shape if isinstance(x, Tensor) else x):
+        if s is not None and s < -1:
+            raise ValueError(f"invalid dim {s} in shape")
+    return True
+
+
+def disable_signal_handler():
+    """Parity: paddle.disable_signal_handler — no custom signal
+    handlers are installed in this runtime, so this is a no-op."""
+
+
+class LazyGuard:
+    """Parity: paddle.LazyGuard — the reference defers parameter
+    materialization inside this scope.  Under JAX, parameter init is an
+    XLA computation that only materializes on first device use, so
+    layers built here behave identically; the guard is a scope marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def binomial(count, prob, name=None):
+    """Parity: paddle.binomial."""
+    from .ops import random as _r
+    import jax as _jax
+    import jax.numpy as _jnp
+    from .core.dispatch import apply_op
+    from .ops._helpers import targ
+    key = _r.next_key()
+
+    def fn(n, p):
+        # sum of Bernoulli draws via uniform comparisons (static bound)
+        nmax = int(_np_mod.asarray(n).max())
+        u = _jax.random.uniform(key, (nmax,) + _jnp.shape(p))
+        idx = _jnp.arange(nmax).reshape((nmax,) + (1,) * _jnp.ndim(p))
+        draws = (u < p) & (idx < n)
+        return draws.sum(0).astype(_jnp.int64)
+
+    return apply_op("binomial", fn, (count, targ(prob)))
+
+
+def standard_gamma(x, name=None):
+    """Parity: paddle.standard_gamma — Gamma(alpha, 1) samples."""
+    from .ops import random as _r
+    import jax as _jax
+    from .core.dispatch import apply_op
+    key = _r.next_key()
+
+    def fn(alpha):
+        return _jax.random.gamma(key, alpha)
+
+    return apply_op("standard_gamma", fn, (x,))
+
+
+# device-place aliases for reference-code portability (map to the
+# accelerator place; there is no CUDA here)
+CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
+DataParallel = None  # filled below once distributed is loaded
+try:
+    from .distributed import DataParallel  # noqa: E402,F811
+except Exception:
+    pass
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Parity: paddle.batch (legacy reader decorator)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

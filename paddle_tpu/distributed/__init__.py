@@ -43,3 +43,169 @@ __all__ = [
     "DataParallel", "spawn", "fleet", "checkpoint", "rpc",
     "fleet_executor", "TCPStore", "group_sharded_parallel",
 ]
+
+
+# -- round-4 surface tail (parity: python/paddle/distributed/__init__.py) --
+from . import launch as launch              # noqa: F401
+from .collective import all_to_all_single as alltoall_single  # noqa: F401
+
+
+class ParallelMode:
+    """Parity: paddle.distributed.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """Parity: paddle.distributed.ReduceType (dist-tensor partial kinds)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Parity: paddle.distributed.DistAttr — (process_mesh, sharding
+    specs) annotation carrier; under GSPMD this maps directly to a
+    (mesh, placements) pair."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def is_available() -> bool:
+    """Parity: paddle.distributed.is_available (the distributed package
+    is always functional here — collectives fall back to single-process
+    semantics)."""
+    return True
+
+
+def get_backend() -> str:
+    """Parity: paddle.distributed.get_backend — the comm backend name
+    (XLA collectives over ICI/DCN stand in for nccl/gloo)."""
+    return "xla"
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Parity: paddle.distributed.scatter_object_list (pickle over the
+    object-collective path)."""
+    import pickle
+    world = get_world_size()
+    rank = get_rank()
+    if world <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return
+    # ship the full pickled list from src; each rank keeps its slot
+    payload = pickle.dumps(in_object_list if rank == src else None)
+    gathered = []
+    all_gather_object(gathered, payload, group=group)
+    src_payload = next(p for i, p in enumerate(gathered)
+                       if pickle.loads(p) is not None and i == src)
+    objs = pickle.loads(src_payload)
+    out_object_list[:] = [objs[rank]]
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Parity: paddle.distributed.broadcast_object_list."""
+    import pickle
+    world = get_world_size()
+    rank = get_rank()
+    if world <= 1:
+        return
+    payload = pickle.dumps(object_list if rank == src else None)
+    gathered = []
+    all_gather_object(gathered, payload, group=group)
+    objs = pickle.loads(gathered[src])
+    object_list[:] = objs
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    """Parity: paddle.distributed.save_state_dict — the distributed
+    checkpoint save (delegates to the checkpoint package)."""
+    from .checkpoint import save_state_dict as _impl
+    return _impl(state_dict, path)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    from .checkpoint import load_state_dict as _impl
+    return _impl(state_dict, path)
+
+
+# gloo_* compatibility: the CPU rendezvous/barrier path rides the same
+# store/collective machinery (no separate gloo backend under XLA)
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass
+
+
+from .auto_parallel.strategy import Strategy  # noqa: E402,F401
+from .. import io as io  # noqa: E402,F401  (paddle.distributed.io alias)
+
+
+_SPLIT_CACHE = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: paddle.distributed.split — a linear/embedding whose weight
+    is partitioned over the model-parallel ranks (reference
+    python/paddle/distributed/collective.py split).
+
+    TPU-native: delegates to the GSPMD parallel layers
+    (Col/RowParallelLinear, VocabParallelEmbedding).  Pass ``name`` to
+    reuse the created weights across calls (training loops); anonymous
+    calls create fresh parameters each time, like a build-once static
+    graph."""
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+    key = (name, operation, tuple(size), axis) if name else None
+    layer = _SPLIT_CACHE.get(key) if key else None
+    if key and any(k[0] == name for k in _SPLIT_CACHE) \
+            and layer is None:
+        raise ValueError(
+            f"distributed.split name {name!r} was already used with a "
+            "different (operation, size, axis)")
+    if layer is None:
+        if operation == "linear":
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            elif axis == 0:
+                layer = RowParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False)
+            else:
+                raise ValueError("linear split axis must be 0 or 1")
+        elif operation == "embedding":
+            if axis != 0:
+                raise ValueError("embedding split axis must be 0")
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        if key:
+            _SPLIT_CACHE[key] = layer
+    return layer(x)

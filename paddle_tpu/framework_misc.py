@@ -1,0 +1,121 @@
+"""Top-level framework misc: iinfo/finfo, ParamAttr, flops.
+
+Parity: python/paddle/framework/dtype.py (iinfo/finfo), python/paddle/
+base/param_attr.py (ParamAttr), python/paddle/hapi/dynamic_flops.py
+(paddle.flops)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .core import dtypes as _dt
+
+
+class _DTypeInfo:
+    def __init__(self, npinfo, dtype_name):
+        is_float = hasattr(npinfo, "eps")
+        # iinfo bounds stay EXACT python ints (float64 cannot represent
+        # int64 max and would overflow on round-trip)
+        cast = float if is_float else int
+        self.min = cast(npinfo.min)
+        self.max = cast(npinfo.max)
+        self.bits = npinfo.bits
+        self.dtype = dtype_name
+        if is_float:
+            self.eps = float(npinfo.eps)
+            self.tiny = float(npinfo.tiny)
+            self.smallest_normal = float(npinfo.smallest_normal)
+            self.resolution = float(npinfo.resolution)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+def iinfo(dtype):
+    """Parity: paddle.iinfo."""
+    d = np.dtype(str(_dt.convert_dtype(dtype)))
+    return _DTypeInfo(np.iinfo(d), d.name)
+
+
+def finfo(dtype):
+    """Parity: paddle.finfo (incl. bfloat16 via ml_dtypes)."""
+    import jax.numpy as jnp
+    d = _dt.convert_dtype(dtype)
+    try:
+        return _DTypeInfo(np.finfo(d), np.dtype(d).name)
+    except Exception:
+        return _DTypeInfo(jnp.finfo(d), str(d))
+
+
+class ParamAttr:
+    """Parity: paddle.ParamAttr (base/param_attr.py) — parameter config
+    holder consumed by Layer.create_parameter."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Parity: paddle.flops (hapi/dynamic_flops.py) — per-layer
+    multiply-add count via forward hooks (the reference's convention:
+    one MAC = one FLOP)."""
+    from .core.tensor import Tensor
+    from . import nn
+
+    counts = {}
+    handles = []
+
+    def count(layer, name):
+        def hook(l, inputs, output):
+            x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            n = 0
+            if isinstance(l, nn.Linear):
+                n = int(np.prod(x.shape[:-1])) * l.weight.shape[0] \
+                    * l.weight.shape[1]
+            elif hasattr(l, "weight") and l.__class__.__name__.startswith(
+                    "Conv"):
+                w = l.weight
+                out_elems = int(np.prod(output.shape))
+                k_elems = int(np.prod(w.shape[1:]))
+                n = out_elems * k_elems
+            elif l.__class__.__name__.startswith("BatchNorm"):
+                n = int(np.prod(x.shape))
+            if custom_ops and type(l) in custom_ops:
+                n = custom_ops[type(l)](l, x, output)
+            counts[name] = counts.get(name, 0) + n
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.named_children()):    # leaves only
+            handles.append(sub.register_forward_post_hook(
+                count(sub, name or sub.__class__.__name__)))
+    import jax.numpy as jnp
+    x = Tensor(np.zeros(input_size, np.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            try:
+                h.remove()
+            except Exception:
+                pass
+    total = int(sum(counts.values()))
+    if print_detail:
+        for k, v in counts.items():
+            print(f"  {k}: {v:,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

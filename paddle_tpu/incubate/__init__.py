@@ -4,3 +4,146 @@ from . import nn
 from . import optimizer
 from . import autotune
 from .optimizer import LookAhead, ModelAverage
+
+
+# -- round-4 incubate surface (parity: python/paddle/incubate/__init__.py) --
+from ..geometric import (segment_sum, segment_mean, segment_max,  # noqa
+                         segment_min)
+from ..geometric import send_u_recv as _send_u_recv
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Parity: paddle.incubate.graph_send_recv (renamed send_u_recv in
+    newer APIs — same gather-scatter message passing)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Parity: incubate.softmax_mask_fuse — softmax(x + mask) fused by
+    XLA (one kernel on TPU; the reference hand-writes the fusion)."""
+    from ..core.dispatch import apply_op
+    import jax.numpy as jnp
+    from ..ops._helpers import targ
+
+    def fn(v, m):
+        return jax.nn.softmax(v + m, axis=-1)
+
+    import jax
+    return apply_op("softmax_mask_fuse", fn, (x, targ(mask)))
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Parity: incubate.softmax_mask_fuse_upper_triangle — causal-masked
+    softmax (upper triangle masked out)."""
+    from ..core.dispatch import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def fn(v):
+        S = v.shape[-1]
+        rows = jnp.arange(v.shape[-2])[:, None]
+        cols = jnp.arange(S)[None, :]
+        masked = jnp.where(rows >= cols, v, -1e9)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", fn, (x,))
+
+
+def identity_loss(x, reduction="none"):
+    """Parity: incubate.identity_loss."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Parity: incubate.graph_khop_sampler — multi-hop neighbor sampling
+    over a CSC graph (eager host sampling; graphs are host data)."""
+    import numpy as np
+    from ..core.tensor import Tensor as _T
+
+    rowv = np.asarray(row._value if hasattr(row, "_value") else row)
+    colp = np.asarray(colptr._value if hasattr(colptr, "_value")
+                      else colptr)
+    nodes = np.asarray(input_nodes._value
+                       if hasattr(input_nodes, "_value")
+                       else input_nodes).reshape(-1)
+    rng = np.random.RandomState(0)
+    edge_src, edge_dst = [], []
+    frontier = nodes
+    seen = list(nodes)
+    for k in sample_sizes:
+        nxt = []
+        for n in frontier:
+            beg, end = int(colp[n]), int(colp[n + 1])
+            neigh = rowv[beg:end]
+            if len(neigh) > k:
+                neigh = rng.choice(neigh, k, replace=False)
+            for m in neigh:
+                edge_src.append(int(m))
+                edge_dst.append(int(n))
+                nxt.append(int(m))
+        frontier = np.unique(np.asarray(nxt, np.int64)) \
+            if nxt else np.zeros((0,), np.int64)
+        seen.extend(frontier.tolist())
+    uniq, inv = np.unique(np.asarray(
+        list(nodes) + edge_src, np.int64), return_inverse=True)
+    reindex_src = inv[len(nodes):]
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    reindex_dst = np.asarray([remap[d] for d in edge_dst], np.int64)
+    return (_T(reindex_src), _T(reindex_dst), _T(uniq),
+            _T(np.asarray(edge_src, np.int64)))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Parity: incubate.graph_sample_neighbors — one-hop sampling."""
+    import numpy as np
+    from ..core.tensor import Tensor as _T
+    rowv = np.asarray(row._value if hasattr(row, "_value") else row)
+    colp = np.asarray(colptr._value if hasattr(colptr, "_value")
+                      else colptr)
+    nodes = np.asarray(input_nodes._value
+                       if hasattr(input_nodes, "_value")
+                       else input_nodes).reshape(-1)
+    rng = np.random.RandomState(0)
+    out_n, out_count = [], []
+    for n in nodes:
+        beg, end = int(colp[n]), int(colp[n + 1])
+        neigh = rowv[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, sample_size, replace=False)
+        out_n.extend(int(m) for m in neigh)
+        out_count.append(len(neigh))
+    return (_T(np.asarray(out_n, np.int64)),
+            _T(np.asarray(out_count, np.int64)))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Parity: incubate.graph_reindex — compact node ids to 0..n."""
+    import numpy as np
+    from ..core.tensor import Tensor as _T
+    xs = np.asarray(x._value if hasattr(x, "_value") else x).reshape(-1)
+    nb = np.asarray(neighbors._value if hasattr(neighbors, "_value")
+                    else neighbors).reshape(-1)
+    cnt = np.asarray(count._value if hasattr(count, "_value")
+                     else count).reshape(-1)
+    uniq = []
+    seen = {}
+    for v in list(xs) + list(nb):
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(uniq)
+            uniq.append(v)
+    re_nb = np.asarray([seen[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (_T(re_nb), _T(dst), _T(np.asarray(uniq, np.int64)))

@@ -40,3 +40,7 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
 from .rnn import (SimpleRNN, LSTM, GRU, SimpleRNNCell,
                   RNNCellBase, LSTMCell, GRUCell, RNN, BiRNN)
 from .clip import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm
+
+# round-4 tail
+from .layers import (HSigmoidLoss, RNNTLoss, FractionalMaxPool3D)
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode

@@ -1,0 +1,191 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Parity: python/paddle/nn/decode.py (reference — BeamSearchDecoder over
+an RNNCell with batch*beam expansion, Decoder protocol
+initialize/step/finalize, dynamic_decode loop).
+
+TPU-native: the decode loop runs eagerly (each step is a compiled cell
+call); beam bookkeeping (top-k over beam*vocab, state gather, finished
+masking) is plain tensor math.  For a fully-compiled decode use
+jit.to_static around a bounded loop instead.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Decoder protocol (parity: decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder(Decoder):
+    """Parity: decode.py BeamSearchDecoder.
+
+    cell: an RNNCell (``cell(inputs, states) -> (outputs, new_states)``);
+    embedding_fn maps ids -> embeddings; output_fn maps cell outputs ->
+    vocab logits."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (parity: the tile_* static methods) -------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (repeat each batch row beam times)."""
+        v = _v(x)
+        out = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor._from_value(
+            out.reshape((-1,) + tuple(v.shape[1:])))
+
+    def _merge(self, v):
+        return v.reshape((-1,) + tuple(v.shape[2:]))      # [B,K,...]→[BK,...]
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + tuple(v.shape[1:]))
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (list, tuple)):
+            return type(states)(self._map_states(s, fn) for s in states)
+        return Tensor._from_value(fn(_v(states)))
+
+    # -- Decoder protocol ----------------------------------------------------
+    def initialize(self, initial_cell_states):
+        K = self.beam_size
+        states = self._map_states(
+            initial_cell_states,
+            lambda v: self._merge(jnp.repeat(v[:, None], K, axis=1)))
+        some = states[0] if isinstance(states, (list, tuple)) else states
+        BK = _v(some).shape[0]
+        B = BK // K
+        ids = jnp.full((B, K), self.start_token, jnp.int64)
+        # only beam 0 is live initially (others -inf so top-k picks
+        # distinct continuations of the single start hypothesis)
+        log_probs = jnp.tile(
+            jnp.asarray([[0.0] + [-1e9] * (K - 1)], jnp.float32), (B, 1))
+        finished = jnp.zeros((B, K), bool)
+        return (Tensor._from_value(ids), states,
+                {"log_probs": log_probs, "finished": finished})
+
+    def step(self, time, inputs, states, beam_state=None):
+        K = self.beam_size
+        ids = _v(inputs)                                 # [B, K]
+        B = ids.shape[0]
+        emb_in = Tensor._from_value(ids.reshape(-1))
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(emb_in)
+        else:
+            emb = emb_in
+        cell_out, next_states = self.cell(emb, states)
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        logit_v = _v(logits).astype(jnp.float32)          # [BK, V]
+        V = logit_v.shape[-1]
+        step_lp = jax.nn.log_softmax(logit_v, axis=-1).reshape(B, K, V)
+
+        prev_lp = beam_state["log_probs"]                 # [B, K]
+        prev_fin = beam_state["finished"]
+        # finished beams only extend with end_token at no cost
+        end_only = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(prev_fin[..., None], end_only[None, None],
+                            step_lp)
+        total = prev_lp[..., None] + step_lp              # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top_lp, top_idx = jax.lax.top_k(flat, K)
+        beam_idx = top_idx // V                           # [B, K]
+        token_idx = (top_idx % V).astype(jnp.int64)
+
+        # gather states along the beam dim
+        def gather(v):
+            s = self._split(v)                            # [B, K, ...]
+            out = jnp.take_along_axis(
+                s, beam_idx.reshape((B, K) + (1,) * (s.ndim - 2)),
+                axis=1)
+            return self._merge(out)
+
+        next_states = self._map_states(next_states, gather)
+        finished = jnp.take_along_axis(prev_fin, beam_idx, axis=1) \
+            | (token_idx == self.end_token)
+        new_beam_state = {"log_probs": top_lp, "finished": finished}
+        return (Tensor._from_value(token_idx),
+                Tensor._from_value(beam_idx), next_states,
+                new_beam_state)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 100, output_time_major=False,
+                   impute_finished=False, is_test=False,
+                   return_length=False, **kwargs):
+    """Parity: decode.py dynamic_decode — run the decoder until every
+    beam finished or max_step_num; returns (ids [B, K, T], beam
+    log-probs) (+ lengths when return_length)."""
+    inputs, states, beam = decoder.initialize(inits)
+    B, K = _v(inputs).shape
+    step_tokens = []
+    step_parents = []
+    lengths = jnp.zeros((B, K), jnp.int64)
+    for t in range(int(max_step_num)):
+        tokens, parents, states, beam = decoder.step(
+            t, inputs, states, beam_state=beam)
+        step_tokens.append(_v(tokens))
+        step_parents.append(_v(parents))
+        # lengths follow their hypotheses through the beam reorder
+        lengths = jnp.take_along_axis(lengths, _v(parents), axis=1)
+        lengths = jnp.where(beam["finished"] & (lengths == 0),
+                            t + 1, lengths)
+        inputs = tokens
+        if bool(beam["finished"].all()):
+            break
+    lengths = jnp.where(lengths == 0, len(step_tokens), lengths)
+
+    # backtrack parent pointers into full sequences (gather_tree)
+    T = len(step_tokens)
+    seq = np.zeros((B, K, T), np.int64)
+    tok = [np.asarray(x) for x in step_tokens]
+    par = [np.asarray(x) for x in step_parents]
+    for b in range(B):
+        for k in range(K):
+            kk = k
+            for t in range(T - 1, -1, -1):
+                seq[b, k, t] = tok[t][b, kk]
+                kk = int(par[t][b, kk])
+    out_ids = Tensor._from_value(jnp.asarray(seq))
+    scores = Tensor._from_value(beam["log_probs"])
+    if return_length:
+        return out_ids, scores, Tensor._from_value(lengths)
+    return out_ids, scores

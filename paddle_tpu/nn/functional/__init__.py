@@ -32,3 +32,69 @@ from .loss import (cross_entropy, softmax_with_cross_entropy,
                    margin_cross_entropy)
 from .vision import (affine_grid, grid_sample, channel_shuffle,
                      temporal_shift)
+
+# round-4 functional tail
+from .extended import (pairwise_distance, triplet_margin_with_distance_loss,
+                       hsigmoid_loss, rnnt_loss, class_center_sample,
+                       fractional_max_pool3d)
+from ...ops.op_surface import sequence_mask, gather_tree  # noqa: F401
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, sparse_mask=None,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Parity: paddle.nn.functional.sparse_attention — delegates to the
+    sparse-pattern attention kernel (paddle_tpu/sparse/nn/functional.py);
+    accepts either a prebuilt sparse_mask or CSR offset/columns."""
+    from ...sparse.nn.functional import attention as _attn
+    from ...sparse import sparse_coo_tensor, sparse_csr_tensor
+    if sparse_mask is None:
+        if sparse_csr_offset is None or sparse_csr_columns is None:
+            raise ValueError("pass sparse_mask or CSR offset/columns")
+        import numpy as _np
+        off = _np.asarray(sparse_csr_offset._value
+                          if hasattr(sparse_csr_offset, "_value")
+                          else sparse_csr_offset)
+        cols = _np.asarray(sparse_csr_columns._value
+                           if hasattr(sparse_csr_columns, "_value")
+                           else sparse_csr_columns)
+        S = query.shape[-2]
+        if off.ndim >= 2:
+            # reference layout: per-(batch, head) CSR [B, H, S+1] /
+            # [B, H, nnz] -> one 3-D pattern indexed by b*H + h
+            BH = int(_np.prod(off.shape[:-1]))
+            off2 = off.reshape(BH, -1)
+            cols2 = cols.reshape(BH, -1)
+            bh_idx, row_idx, col_idx = [], [], []
+            for bh in range(BH):
+                counts = _np.diff(off2[bh])
+                nnz = int(off2[bh, -1])
+                bh_idx.append(_np.full(nnz, bh))
+                row_idx.append(_np.repeat(_np.arange(S), counts))
+                col_idx.append(cols2[bh, :nnz])
+            idx = _np.stack([_np.concatenate(bh_idx),
+                             _np.concatenate(row_idx),
+                             _np.concatenate(col_idx)])
+            sparse_mask = sparse_coo_tensor(
+                idx, _np.ones(idx.shape[1], _np.float32), (BH, S, S))
+        else:
+            sparse_mask = sparse_csr_tensor(
+                off.reshape(-1)[: S + 1], cols.reshape(-1),
+                _np.ones(cols.size, _np.float32), (S, S))
+    return _attn(query, key, value, sparse_mask,
+                 key_padding_mask=key_padding_mask, attn_mask=attn_mask)
+
+
+def _inplace_act(fn):
+    def g(x, *a, **k):
+        return x._inplace_assign(fn(x, *a, **k))
+    g.__name__ = fn.__name__ + "_"
+    return g
+
+
+elu_ = _inplace_act(elu)
+hardtanh_ = _inplace_act(hardtanh)
+leaky_relu_ = _inplace_act(leaky_relu)
+softmax_ = _inplace_act(softmax)
+tanh_ = _inplace_act(tanh)
+thresholded_relu_ = _inplace_act(thresholded_relu)

@@ -1,0 +1,280 @@
+"""Functional tail: distance/loss/decoding ops (round-4 surface sweep).
+
+Parity: python/paddle/nn/functional/ (reference — distance.py
+pairwise_distance, loss.py hsigmoid_loss/rnnt_loss/
+triplet_margin_with_distance_loss, common.py class_center_sample,
+pooling.py fractional_max_pool3d) and the generated inplace activation
+variants (elu_/hardtanh_/...)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+from ...core.dispatch import apply_op
+from ...ops._helpers import targ
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    """Parity: paddle.nn.functional.pairwise_distance (distance.py)."""
+    def fn(a, b):
+        d = a - b + epsilon
+        if p == float("inf"):
+            out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(a.dtype), axis=-1,
+                          keepdims=keepdim)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, axis=-1,
+                          keepdims=keepdim) ** (1.0 / p)
+        return out
+    return apply_op("pairwise_distance", fn, (x, targ(y)))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Parity: loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dsn = dist(positive, negative)
+        dn = apply_op("minimum", jnp.minimum, (dn, targ(dsn)))
+
+    def fn(a, b):
+        loss = jnp.maximum(a - b + margin, 0.0)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+    return apply_op("triplet_margin_loss", fn, (dp, targ(dn)))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _hsigmoid_tree(C: int):
+    """Default complete-binary-tree path table — depends only on
+    num_classes, so cache it (rebuilding the C*log2(C) table per forward
+    would dominate large-vocab training steps)."""
+    depth = max(1, int(math.ceil(math.log2(C))))
+    table = np.zeros((C, depth), np.int32)
+    code = np.zeros((C, depth), np.float32)
+    valid = np.zeros((C, depth), np.float32)
+    for c in range(C):
+        # root-to-leaf walk of the complete binary tree: node ids are
+        # the heap positions of c + C
+        bits = bin(c + C)[3:]              # drop '0b1' (the root marker)
+        node = 1
+        for d, b in enumerate(bits):
+            table[c, d] = node - 1         # internal node index
+            code[c, d] = 1.0 if b == "1" else 0.0
+            valid[c, d] = 1.0
+            node = node * 2 + (1 if b == "1" else 0)
+    return jnp.asarray(table), jnp.asarray(code), jnp.asarray(valid)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Parity: loss.py hsigmoid_loss — hierarchical sigmoid over the
+    default complete binary tree (path for class c = bits of
+    c + num_classes walked from the root), or a custom path_table/
+    path_code pair.  weight: [num_classes-1, D] internal-node vectors."""
+    C = int(num_classes)
+    if path_table is None:
+        table_j, code_j, valid_j = _hsigmoid_tree(C)
+    else:
+        table_j = path_table._value if isinstance(path_table, Tensor) \
+            else jnp.asarray(path_table)
+        code_j = (path_code._value if isinstance(path_code, Tensor)
+                  else jnp.asarray(path_code)).astype(jnp.float32)
+        valid_j = (table_j >= 0).astype(jnp.float32)
+        table_j = jnp.maximum(table_j, 0)
+
+    args = [input, label, targ(weight)] + ([bias] if bias is not None
+                                           else [])
+
+    def fn(x, lab, w, *b):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        nodes = table_j[lab]                  # [B, depth]
+        codes = code_j[lab]
+        mask = valid_j[lab]
+        wv = w[nodes]                         # [B, depth, D]
+        logits = jnp.einsum("bd,bnd->bn", x.astype(jnp.float32),
+                            wv.astype(jnp.float32))
+        if b:
+            logits = logits + b[0].reshape(-1)[nodes]
+        # BCE-with-logits per node: code is the binary target
+        per = jnp.maximum(logits, 0) - logits * codes \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return (per * mask).sum(-1, keepdims=True)
+
+    return apply_op("hsigmoid_loss", fn, tuple(args))
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """Parity: loss.py rnnt_loss — RNN-Transducer loss.
+
+    input: [B, T, U+1, V] log-probs or logits (normalized internally);
+    label: [B, U] int.  TPU-native: the alpha DP runs as a lax.scan over
+    T (differentiable — reverse-mode AD through the scan yields the
+    standard occupancy gradients, no hand-written backward kernel).
+    FastEmit regularization is not implemented — a nonzero
+    ``fastemit_lambda`` raises rather than being silently ignored."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: fastemit_lambda != 0 is not supported yet")
+    def fn(logits, lab, in_len, lab_len):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        # per-(t,u) transition log-probs
+        p_blank = logp[..., blank]                       # [B, T, U+1]
+        lab_pad = jnp.pad(lab, ((0, 0), (0, 1)))          # [B, U+1]
+        p_emit = jnp.take_along_axis(
+            logp, lab_pad[:, None, :, None], axis=-1)[..., 0]
+
+        NEG = -1e30
+        u_idx = jnp.arange(U1)
+
+        def row(alpha_prev, t):
+            # alpha[t, u] = logaddexp(alpha[t-1, u] + blank(t-1, u),
+            #                         alpha[t, u-1] + emit(t, u-1))
+            from_blank = alpha_prev + p_blank[:, t - 1, :]
+
+            def inner(carry, u):
+                # left-to-right within the row (sequential in u)
+                prev_u = carry
+                a = jnp.where(
+                    u == 0, from_blank[:, 0],
+                    jnp.logaddexp(
+                        from_blank[:, u],
+                        prev_u + p_emit[:, t, u - 1]))
+                return a, a
+
+            _, cols = lax.scan(inner, jnp.full((B,), NEG), u_idx)
+            alpha_t = jnp.moveaxis(cols, 0, 1)           # [B, U+1]
+            return alpha_t, None
+
+        # t = 0 row: only emissions
+        def first_row(carry, u):
+            prev = carry
+            a = jnp.where(u == 0, 0.0, prev + p_emit[:, 0, u - 1])
+            return a, a
+
+        _, cols0 = lax.scan(first_row, jnp.zeros((B,)), u_idx)
+        alpha0 = jnp.moveaxis(cols0, 0, 1)
+
+        def step(alpha, t):
+            alpha_t, _ = row(alpha, t)
+            return alpha_t, alpha_t
+
+        _, rows = lax.scan(step, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], 0)  # [T, B, U+1]
+        all_rows = jnp.moveaxis(all_rows, 1, 0)              # [B, T, U+1]
+
+        bi = jnp.arange(B)
+        t_last = (in_len - 1).astype(jnp.int32)
+        u_last = lab_len.astype(jnp.int32)
+        ll = all_rows[bi, t_last, u_last] \
+            + p_blank[bi, t_last, u_last]
+        loss = -ll
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return apply_op("rnnt_loss", fn,
+                    (input, targ(label), targ(input_lengths),
+                     targ(label_lengths)))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Parity: common.py class_center_sample — sample num_samples class
+    centers always including the labels' classes; returns
+    (remapped_label, sampled_class_index)."""
+    lab = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    lab_np = np.asarray(lab).astype(np.int64)
+    pos = np.unique(lab_np)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.RandomState(len(pos))
+        extra = rng.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(remap[lab_np]), Tensor(sampled.astype(np.int64)))
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Parity: pooling.py fractional_max_pool3d — pseudo-random pooling
+    regions whose boundaries follow the fractional-stride sequence of
+    Graham's fractional max-pooling paper."""
+    xs = x.shape
+    D, H, W = xs[-3], xs[-2], xs[-1]
+    if isinstance(output_size, int):
+        od = oh = ow = output_size
+    else:
+        od, oh, ow = output_size
+    u = float(random_u) if random_u is not None else 0.5
+
+    def edges(in_sz, out_sz):
+        alpha = in_sz / out_sz
+        # ceil(alpha * (i + u)) - ceil(alpha * u) boundary sequence
+        idx = np.arange(out_sz + 1)
+        e = np.ceil(alpha * (idx + u)).astype(np.int64) \
+            - int(np.ceil(alpha * u))
+        e = np.clip(e, 0, in_sz)
+        e[-1] = in_sz
+        return e
+
+    ed, eh, ew = edges(D, od), edges(H, oh), edges(W, ow)
+
+    def fn(v):
+        outs, masks = [], []
+        for i in range(od):
+            for j in range(oh):
+                for k in range(ow):
+                    win = v[..., ed[i]:ed[i + 1], eh[j]:eh[j + 1],
+                            ew[k]:ew[k + 1]]
+                    outs.append(win.max((-3, -2, -1)))
+                    if return_mask:
+                        wd = ed[i + 1] - ed[i]
+                        wh = eh[j + 1] - eh[j]
+                        ww = ew[k + 1] - ew[k]
+                        flat = win.reshape(win.shape[:-3] + (-1,))
+                        am = flat.argmax(-1)
+                        dz, rem = am // (wh * ww), am % (wh * ww)
+                        dy, dx = rem // ww, rem % ww
+                        gidx = ((ed[i] + dz) * H + eh[j] + dy) * W \
+                            + ew[k] + dx
+                        masks.append(gidx)
+        out = jnp.stack(outs, -1).reshape(
+            v.shape[:-3] + (od, oh, ow))
+        if return_mask:
+            m = jnp.stack(masks, -1).reshape(
+                v.shape[:-3] + (od, oh, ow)).astype(jnp.int64)
+            return out, m
+        return out
+
+    return apply_op("fractional_max_pool3d", fn, (x,))

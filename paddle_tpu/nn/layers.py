@@ -1373,3 +1373,64 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
     def predict(self, input):
         lp = self._full_log_prob(input)
         return lp.argmax(axis=-1)
+
+
+class HSigmoidLoss(Layer):
+    """Parity: paddle.nn.HSigmoidLoss (loss.py) — hierarchical sigmoid
+    over the default complete binary tree or a custom path table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [num_classes - 1], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class RNNTLoss(Layer):
+    """Parity: paddle.nn.RNNTLoss (loss.py)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class FractionalMaxPool3D(Layer):
+    """Parity: paddle.nn.FractionalMaxPool3D (pooling.py)."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._kernel_size = kernel_size
+        self._random_u = random_u
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self._output_size,
+                                       self._kernel_size,
+                                       self._random_u,
+                                       return_mask=self._return_mask)

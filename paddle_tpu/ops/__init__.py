@@ -31,6 +31,11 @@ from .registry import registered_ops, get_op  # noqa: F401
 for _name, _opdef in registry.registered_ops().items():
     globals().setdefault(_name, _opdef.fn)
 
+# top-level inplace variants (paddle.cumsum_ etc.)
+from . import inplace as _inplace_mod  # noqa: E402
+for _name, _fn in _inplace_mod.build().items():
+    globals().setdefault(_name, _fn)
+
 # plain-function extras (not dispatch-registered)
 from .extras import (broadcast_shape, is_complex, is_floating_point,  # noqa
                      is_integer, create_tensor, create_parameter,

@@ -644,3 +644,44 @@ def positive(x, name=None):
     if as_value(x).dtype == jnp.bool_:
         raise TypeError("positive is not supported for bool tensors")
     return apply_op("positive", lambda v: +v, (x,))
+
+
+@register_op("hstack", category="manipulation")
+def hstack(x, name=None):
+    """Parity: paddle.hstack."""
+    ts = list(x)
+
+    def fn(*vals):
+        return jnp.hstack(vals)
+    return apply_op("hstack", fn, tuple(ts))
+
+
+@register_op("vstack", category="manipulation")
+def vstack(x, name=None):
+    ts = list(x)
+
+    def fn(*vals):
+        return jnp.vstack(vals)
+    return apply_op("vstack", fn, tuple(ts))
+
+
+@register_op("dstack", category="manipulation")
+def dstack(x, name=None):
+    ts = list(x)
+
+    def fn(*vals):
+        return jnp.dstack(vals)
+    return apply_op("dstack", fn, tuple(ts))
+
+
+@register_op("column_stack", category="manipulation")
+def column_stack(x, name=None):
+    ts = list(x)
+
+    def fn(*vals):
+        return jnp.column_stack(vals)
+    return apply_op("column_stack", fn, tuple(ts))
+
+
+row_stack = vstack
+register("row_stack", vstack, category="manipulation")

@@ -179,3 +179,130 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                 if patch.size:
                     out[i, :, py, px] = patch.max(axis=(1, 2))
     return Tensor(out)
+
+
+# -- reference vision.ops surface (round-4 sweep): the detection ops live
+# in .detection; re-exported here under the reference names, plus layer
+# wrappers and the image-file ops --------------------------------------------
+from .detection import (box_coder, prior_box, yolo_box, yolo_loss,  # noqa
+                        matrix_nms, generate_proposals,
+                        distribute_fpn_proposals, psroi_pool,
+                        deformable_conv)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Parity: paddle.vision.ops.deform_conv2d (v2 when mask given)."""
+    out = deformable_conv(x, offset, weight, mask=mask, stride=stride,
+                          padding=padding, dilation=dilation,
+                          deformable_groups=deformable_groups,
+                          groups=groups)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+def read_file(filename, name=None):
+    """Parity: paddle.vision.ops.read_file — raw bytes as a uint8
+    tensor."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Parity: paddle.vision.ops.decode_jpeg — decode a uint8 byte
+    tensor to CHW uint8 (PIL-backed host op; the reference uses
+    nvjpeg)."""
+    import io as _io
+    import numpy as np
+    from PIL import Image
+    from ..core.tensor import Tensor
+    raw = bytes(np.asarray(x._value if hasattr(x, "_value") else x,
+                           np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr.copy())
+
+
+class RoIAlign:
+    """Parity: paddle.vision.ops.RoIAlign (layer wrapper)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Parity: paddle.vision.ops.RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool:
+    """Parity: paddle.vision.ops.PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D:
+    """Parity: paddle.vision.ops.DeformConv2D (layer with weights)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        import numpy as np
+        from ..nn.layer_base import Layer
+        from ..nn import initializer as I
+        helper = Layer.__new__(Layer)
+        Layer.__init__(helper)
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._stride, self._padding = stride, padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self._helper = helper
+        self.weight = helper.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        if bias_attr is not False:
+            self.bias = helper.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def parameters(self):
+        return self._helper.parameters()
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
