@@ -40,13 +40,42 @@ def read_back_dist_attrs(hlo_text: str) -> Dict[str, str]:
     """Per-op dist-attr read-back from a compiled HLO module: maps each
     instruction name to the sharding GSPMD assigned it (the analog of
     reading op dist_attrs off the reference's completed program,
-    python/paddle/distributed/auto_parallel/static/completion.py)."""
+    python/paddle/distributed/auto_parallel/static/completion.py).
+    Raises instead of returning ``{}`` when the module plainly contains
+    sharding annotations the regex failed to parse (an XLA printer
+    change must be loud, not a silent empty dict)."""
     out: Dict[str, str] = {}
     for line in hlo_text.splitlines():
         m = _SHARDING_RE.search(line)
         if m:
             out[m.group(1)] = m.group(2)
+    if not out and "sharding={" in hlo_text:
+        raise RuntimeError(
+            "compiled HLO contains sharding annotations but "
+            "read_back_dist_attrs parsed none — the XLA text printer "
+            "format changed; update _SHARDING_RE")
     return out
+
+
+def _batch_spec(val, mesh, axis):
+    """Batch-dim PartitionSpec over ``axis``; a batch whose dim0 is not
+    divisible by the dp degree replicates with a warning (the same
+    accounting the sharding module gives non-divisible params) instead
+    of silently costing dp× the HBM and compute."""
+    if axis is None or val.ndim < 1:
+        return PartitionSpec()
+    deg = mesh.shape[axis]
+    if val.shape[0] % deg == 0:
+        return PartitionSpec(axis)
+    if deg > 1:
+        import warnings
+        warnings.warn(
+            f"batch dim0={val.shape[0]} is not divisible by the "
+            f"data-parallel degree {deg}; replicating the batch on "
+            f"every dp rank (each rank computes the full batch). Pad "
+            f"or drop to a multiple of {deg} to actually parallelize.",
+            UserWarning, stacklevel=3)
+    return PartitionSpec()
 
 
 class DistributedDataLoader:
@@ -62,10 +91,7 @@ class DistributedDataLoader:
     def _shard(self, v):
         val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
         mesh = self._mesh.jax_mesh
-        spec = PartitionSpec()
-        if self._axis is not None and val.ndim >= 1 and \
-                val.shape[0] % mesh.shape[self._axis] == 0:
-            spec = PartitionSpec(self._axis)
+        spec = _batch_spec(val, mesh, self._axis)
         return Tensor._from_value(
             jax.device_put(val, NamedSharding(mesh, spec)))
 
@@ -129,11 +155,7 @@ class DistModel:
     def _shard_batch(self, v):
         val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
         mesh = self._mesh.jax_mesh
-        axis = self._data_axis()
-        spec = PartitionSpec()
-        if axis is not None and val.ndim >= 1 and \
-                val.shape[0] % mesh.shape[axis] == 0:
-            spec = PartitionSpec(axis)
+        spec = _batch_spec(val, mesh, self._data_axis())
         return Tensor._from_value(
             jax.device_put(val, NamedSharding(mesh, spec)))
 

@@ -350,6 +350,11 @@ class _DPOverlapState:
         self.touched = {id(p): False for b in self.buckets for p in b}
         self.fired = [False] * len(self.buckets)
         self.stale = [False] * len(self.buckets)
+        # id(param) -> grad value as of its last sync, so a stale-bucket
+        # resync allreduces only the late delta (correct for avg=False
+        # too: resyncing the full grad would re-sum the already-summed
+        # portion world_size times)
+        self.synced = {}
 
 
 class _DPOverlapOptimizer:
@@ -397,6 +402,7 @@ class _DPOverlapOptimizer:
         from ...core.tensor import Tensor
         if self._world <= 1:
             return
+        st = self._state
         for q in self._state.buckets[bi]:
             base = q._grad
             if pending is not None and q is pending[0]:
@@ -406,11 +412,15 @@ class _DPOverlapOptimizer:
                 base = gpend if base is None else base + gpend
             if base is None:
                 continue
-            t = Tensor._from_value(base)
+            prev = st.synced.get(id(q))
+            t = Tensor._from_value(base if prev is None else base - prev)
             all_reduce(t, group=self._group, sync_op=False)
             val = t._value
             if self._avg:
                 val = val / self._world
+            if prev is not None:
+                val = prev + val
+            st.synced[id(q)] = val
             if pending is not None and q is pending[0]:
                 # .grad will still receive g from the in-flight
                 # accumulation; pre-subtract so the final sum is the

@@ -217,7 +217,11 @@ def class_center_sample(label, num_classes, num_samples, group=None,
         sampled = pos
     else:
         rest = np.setdiff1d(np.arange(num_classes), pos)
-        rng = np.random.RandomState(len(pos))
+        # fresh negatives each call (reference samples per step), seeded
+        # from the framework generator so paddle.seed reproduces the run
+        from ...ops import random as _random
+        key = np.asarray(jax.random.key_data(_random.next_key()))
+        rng = np.random.default_rng(key.astype(np.uint32))
         extra = rng.choice(rest, num_samples - len(pos), replace=False)
         sampled = np.sort(np.concatenate([pos, extra]))
     remap = -np.ones(num_classes, np.int64)

@@ -275,15 +275,52 @@ def matmul(x, y, name=None):
     (e.g. conv -> matmul)."""
     from ..core.dispatch import apply_op
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        xi, xs = x._bcoo.indices, x._bcoo.shape
-        yi, ys = y._bcoo.indices, y._bcoo.shape
+        # Structural spGEMM: coo @ coo -> coo (parity:
+        # python/paddle/sparse/binary.py matmul returns sparse for
+        # sparse x sparse).  The output sparsity pattern and the
+        # (a, b) -> out_pos contribution lists depend only on the index
+        # structure, so they are computed host-side once; the values
+        # flow through dispatch (gather-multiply-scatter with static
+        # shapes), keeping the product differentiable in both operands.
+        if len(x.shape) != 2 or len(y.shape) != 2 \
+                or x._bcoo.n_sparse != 2 or y._bcoo.n_sparse != 2:
+            raise NotImplementedError(
+                "sparse @ sparse matmul supports 2-D fully-sparse "
+                "operands (n_dense/n_batch layouts unsupported)")
+        if int(x.shape[1]) != int(y.shape[0]):
+            raise ValueError(
+                f"sparse matmul shape mismatch: {x.shape} @ {y.shape}")
+        xi = np.asarray(x._bcoo.indices)   # [nnzA, 2] rows (i, j)
+        yi = np.asarray(y._bcoo.indices)   # [nnzB, 2] rows (j, k)
+        n, m = int(x.shape[0]), int(y.shape[1])
+        ja, jb = xi[:, 1], yi[:, 0]
+        order_b = np.argsort(jb, kind="stable")
+        jb_sorted = jb[order_b]
+        starts = np.searchsorted(jb_sorted, ja, side="left")
+        counts = np.searchsorted(jb_sorted, ja, side="right") - starts
+        a_sel = np.repeat(np.arange(len(ja)), counts)
+        base = np.repeat(starts, counts)
+        local = np.arange(len(a_sel)) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        b_sel = order_b[base + local]
+        out_keys = xi[a_sel, 0].astype(np.int64) * m + yi[b_sel, 1]
+        uniq, out_pos = np.unique(out_keys, return_inverse=True)
+        out_idx = np.stack([uniq // m, uniq % m], axis=1)
+        nnz_out = len(uniq)
+        a_sel_j = jnp.asarray(a_sel)
+        b_sel_j = jnp.asarray(b_sel)
+        out_pos_j = jnp.asarray(out_pos)
 
         def fn2(xv, yv):
-            return jsparse.BCOO((xv, xi), shape=xs) @ \
-                jsparse.BCOO((yv, yi), shape=ys).todense()
+            contrib = xv[a_sel_j] * yv[b_sel_j]
+            return jax.ops.segment_sum(contrib, out_pos_j,
+                                       num_segments=nnz_out)
 
-        return apply_op("sparse_matmul", fn2,
-                        (_values_tensor(x), _values_tensor(y)))
+        vals_t = apply_op("sparse_matmul", fn2,
+                          (_values_tensor(x), _values_tensor(y)))
+        return _from_values_tensor(x, vals_t,
+                                   jnp.asarray(out_idx, jnp.int32),
+                                   (n, m))
     if isinstance(x, SparseCooTensor):
         xi, xs = x._bcoo.indices, x._bcoo.shape
         yt = y if isinstance(y, Tensor) else Tensor(y)

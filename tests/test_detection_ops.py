@@ -370,3 +370,25 @@ def test_weighted_sample_neighbors():
     o = out.numpy().reshape(2, -1)
     assert set(o[0][o[0] >= 0].tolist()) <= {1, 2, 3}
     assert 4 in o[1].tolist()
+
+
+def test_class_center_sample_fresh_negatives():
+    """Negatives are redrawn each call (reference samples per step;
+    ADVICE r4: a length-seeded RandomState froze them), and paddle.seed
+    makes the stream reproducible."""
+    import paddle_tpu as paddle
+    lab = np.array([3, 7, 3, 1], np.int64)
+
+    def draws(n=6):
+        out = []
+        for _ in range(n):
+            _, sampled = call("class_center_sample", t(lab), 50, 8)
+            out.append(tuple(sampled.numpy().tolist()))
+        return out
+
+    paddle.seed(123)
+    a = draws()
+    assert len(set(a)) > 1, "negative classes identical on every call"
+    paddle.seed(123)
+    b = draws()
+    assert a == b, "paddle.seed does not reproduce the sampling stream"

@@ -255,3 +255,86 @@ def test_dp_overlap_pass_buckets_and_matches_plain():
         return losses
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_dp_overlap_stale_bucket_sum_mode(monkeypatch):
+    """A shared param contributing a second (late) grad after its bucket
+    fired must resync only the DELTA: with avg=False a full-grad resync
+    would re-sum the already-summed portion world_size times (ADVICE r4).
+
+    world=2 is simulated: every rank holds identical data, so the
+    allreduce-sum of any tensor is 2x its value."""
+    from paddle_tpu.distributed import collective as coll
+
+    def fake_all_reduce(t, group=None, sync_op=True, **kw):
+        t._value = t._value * 2.0
+        return t
+
+    monkeypatch.setattr(coll, "all_reduce", fake_all_reduce)
+
+    class FakeGroup:
+        nranks = 2
+
+    def build():
+        paddle.seed(7)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            def forward(self, x):
+                # the same Linear used twice -> its weight grad arrives
+                # in two contributions; the second is "late" for the
+                # already-fired bucket
+                return (self.lin(x) + self.lin(x * 2.0)).sum()
+
+        return Net()
+
+    x = paddle.to_tensor(np.random.RandomState(3).rand(2, 4)
+                         .astype("float32"))
+
+    ref = build()
+    ref(x).backward()
+    expected = {k: 2.0 * np.asarray(p.grad._value)   # sum over 2 ranks
+                for k, p in ref.named_parameters()}
+
+    net = build()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    net, opt = new_pass(
+        "data_parallel_optimization",
+        {"bucket_size_mb": 1e-7, "group": FakeGroup(), "avg": False}
+    ).apply(net, opt)
+    net(x).backward()
+    assert any(opt._state.stale), "test setup: no bucket went stale"
+    opt_inner_step = opt._inner.step
+    opt._inner.step = lambda: None   # inspect grads before the update
+    opt.step()
+    for k, p in net.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.grad._value),
+                                   expected[k], rtol=1e-5,
+                                   err_msg=k)
+    opt._inner.step = opt_inner_step
+
+
+def test_distributed_dataloader_warns_on_indivisible_batch():
+    import warnings as _warnings
+    from paddle_tpu.distributed.auto_parallel.dist_model import \
+        DistributedDataLoader
+    mesh = _mesh()
+    loader = [[np.zeros((3, 4), np.float32)]]   # dim0=3, dp degree 2
+    dl = DistributedDataLoader(loader, mesh, "dp")
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        batches = [b for b in dl]
+    assert any("not divisible by the data-parallel degree" in str(x.message)
+               for x in w)
+    assert batches[0][0].shape == [3, 4]
+    # divisible batch: no warning
+    dl2 = DistributedDataLoader([[np.zeros((4, 4), np.float32)]], mesh,
+                                "dp")
+    with _warnings.catch_warnings(record=True) as w2:
+        _warnings.simplefilter("always")
+        _ = [b for b in dl2]
+    assert not any("not divisible" in str(x.message) for x in w2)

@@ -91,10 +91,39 @@ def test_sparse_matmul():
     a = _rand_sparse((4, 6))
     d = rng.randn(6, 3).astype(np.float32)
     out = sparse.matmul(_coo(a), paddle.to_tensor(d))
-    np.testing.assert_allclose(np.asarray(out._value), a @ d, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out._value), a @ d, rtol=1e-5,
+                               atol=1e-6)
     b = _rand_sparse((6, 3))
     out2 = sparse.matmul(_coo(a), _coo(b))
-    np.testing.assert_allclose(np.asarray(out2._value), a @ b, rtol=1e-5)
+    # coo @ coo -> coo (reference binary.py matmul contract)
+    assert isinstance(out2, sparse.SparseCooTensor)
+    np.testing.assert_allclose(np.asarray(out2.to_dense()._value),
+                               a @ b, rtol=1e-5)
+
+
+def test_sparse_matmul_coo_coo_grad():
+    a = _rand_sparse((4, 6))
+    b = _rand_sparse((6, 3))
+    xa, xb = _coo(a), _coo(b)
+    va, vb = xa.values(), xb.values()
+    va.stop_gradient = False
+    vb.stop_gradient = False
+    xa._values_t = va
+    xb._values_t = vb
+    out = sparse.matmul(xa, xb)
+    loss = out.values().sum()
+    loss.backward()
+    # numeric check against the dense product: d(sum C)/dA = 1 @ B^T at
+    # A's nonzero coords, d/dB = A^T @ 1 at B's coords
+    ones = np.ones((4, 3), np.float32)
+    ga_dense = ones @ b.T
+    gb_dense = a.T @ ones
+    ai = np.asarray(xa._bcoo.indices)
+    bi = np.asarray(xb._bcoo.indices)
+    np.testing.assert_allclose(np.asarray(va.grad._value),
+                               ga_dense[ai[:, 0], ai[:, 1]], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(vb.grad._value),
+                               gb_dense[bi[:, 0], bi[:, 1]], rtol=1e-5)
 
 
 def test_masked_matmul_sddmm():
