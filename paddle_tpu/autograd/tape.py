@@ -174,8 +174,8 @@ def _node_backward_create_graph(node: GradNode, cots: Tuple):
             "set retain_graph=True if this is intended.")
     raise RuntimeError(
         f"create_graph=True through node {node.name} is not supported: "
-        "it has no differentiable backward (recompute blocks and custom "
-        "vjp nodes currently support first-order grad only).")
+        "it declares neither a differentiable forward closure (raw_fn) "
+        "nor a Tensor-level backward (tensor_vjp).")
 
 
 def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,

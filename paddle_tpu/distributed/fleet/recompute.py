@@ -86,6 +86,46 @@ def recompute(function, *args, **kwargs):
         return tuple(capture.get(id(d))
                      for d in detached if isinstance(d, Tensor))
 
+    def tensor_vjp(cot_tensors):
+        # create_graph path: re-recompute with grads ENABLED so the
+        # backward computation itself records tape nodes — the cotangent
+        # -> input-grad map is built by a nested create_graph tape.grad
+        # over the replay graph, so second-order flows through the
+        # recomputed block (gradient-penalty training).  The replay uses
+        # the ORIGINAL args (not detached copies) so the returned grads'
+        # history reaches the true inputs; for a chain of recomputed
+        # blocks this makes create_graph backward O(N^2) in replays —
+        # correct but costly; prefer the traced jax.checkpoint tier for
+        # deep stacks under higher-order grad.
+        if rng_state is not None:
+            saved = _random.get_rng_state()
+            _random.set_rng_state(rng_state)
+        try:
+            replay = function(*args, **kwargs)
+        finally:
+            if rng_state is not None:
+                _random.set_rng_state(saved)
+        replay_list = [replay] if isinstance(replay, Tensor) else \
+            [o for o in replay if isinstance(o, Tensor)]
+        grads = _tape.grad(replay_list, tensor_args,
+                           grad_outputs=list(cot_tensors),
+                           create_graph=True, allow_unused=True)
+        if not isinstance(grads, (list, tuple)):
+            grads = [grads]
+        # tape.grad de-dups inputs by id and returns the TOTAL grad for
+        # a tensor passed in several positions; report it once (first
+        # occurrence) so the engine's per-position accumulation does not
+        # double-count
+        seen_ids = set()
+        out = []
+        for a, g in zip(tensor_args, grads):
+            if id(a) in seen_ids:
+                out.append(None)
+            else:
+                seen_ids.add(id(a))
+                out.append(g)
+        return tuple(out)
+
     # Record the replay node when any *input* requires grad OR the
     # function's own state is trainable (first block: data inputs are
     # stop_gradient but the layer's params still need grads from the
@@ -95,7 +135,8 @@ def recompute(function, *args, **kwargs):
     if any(not t.stop_gradient for t in diff_inputs) or \
             _has_trainable_state(function):
         node = GradNode("recompute", vjp_fn, diff_inputs, out_meta,
-                        out_is_tuple=len(out_meta) > 1)
+                        out_is_tuple=len(out_meta) > 1,
+                        tensor_vjp=tensor_vjp)
         for i, o in enumerate(out_list):
             o._grad_node = node
             o._out_index = i

@@ -355,6 +355,10 @@ class _DPOverlapState:
         # too: resyncing the full grad would re-sum the already-summed
         # portion world_size times)
         self.synced = {}
+        # params that contributed grads AFTER their bucket fired — a
+        # stale resync touches only these (the rest would allreduce an
+        # exact-zero delta)
+        self.late = set()
 
 
 class _DPOverlapOptimizer:
@@ -383,9 +387,10 @@ class _DPOverlapOptimizer:
         def hook(g, _p=p):
             bi = st.bucket_of[id(_p)]
             if st.fired[bi]:
-                # late contribution (shared param): redo this bucket
+                # late contribution (shared param): resync this param
                 # synchronously at step() time
                 st.stale[bi] = True
+                st.late.add(id(_p))
                 return g
             st.touched[id(_p)] = True
             if all(st.touched[id(q)] for q in st.buckets[bi]):
@@ -397,13 +402,15 @@ class _DPOverlapOptimizer:
 
         return hook
 
-    def _allreduce_bucket(self, bi, pending=None):
+    def _allreduce_bucket(self, bi, pending=None, only_late=False):
         from ..collective import all_reduce
         from ...core.tensor import Tensor
         if self._world <= 1:
             return
         st = self._state
         for q in self._state.buckets[bi]:
+            if only_late and id(q) not in st.late:
+                continue
             base = q._grad
             if pending is not None and q is pending[0]:
                 # the firing hook's contribution g is not in .grad yet
@@ -436,7 +443,7 @@ class _DPOverlapOptimizer:
         st = self._state
         for bi in range(len(st.buckets)):
             if not st.fired[bi] or st.stale[bi]:
-                self._allreduce_bucket(bi)
+                self._allreduce_bucket(bi, only_late=st.fired[bi])
                 st.fired[bi] = True
         self._inner.step()
         st.reset()
