@@ -49,6 +49,16 @@ def recompute(function, *args, **kwargs):
     # eager rematerialization
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     rng_state = _random.get_rng_state() if preserve_rng else None
+    # The block's own trainable params become GradNode inputs (when
+    # enumerable), so paddle.grad(loss, params) works through the block
+    # — both first order and under create_graph (the reference's
+    # RecomputeFunction marks them non-differentiable inputs the same
+    # way).  None = opaque callable: params flow by leaf side effect.
+    params = _collect_params(function)
+    arg_ids = {id(a) for a in tensor_args}
+    if params is not None:
+        params = [p for p in params
+                  if not p.stop_gradient and id(p) not in arg_ids]
 
     with no_grad():
         outputs = function(*args, **kwargs)
@@ -76,15 +86,20 @@ def recompute(function, *args, **kwargs):
                 _random.set_rng_state(saved)
         replay_list = [replay] if isinstance(replay, Tensor) else \
             [o for o in replay if isinstance(o, Tensor)]
-        # Leaf grads (the layer's parameters, closed over by ``function``)
-        # accumulate normally during the replay; the detached inputs'
-        # cotangents are captured and returned as this node's input grads.
+        # Cotangents for the detached inputs AND the declared params are
+        # captured and returned as this node's input grads (the engine
+        # then accumulates/captures them like any other edge).  Only for
+        # an opaque callable (params is None) do the replay's leaf grads
+        # accumulate by side effect instead.
         capture = {id(d): None for d in detached if isinstance(d, Tensor)
                    and not d.stop_gradient}
+        for p in (params or []):
+            capture[id(p)] = None
         _tape.run_backward(replay_list, list(cots), capture=capture,
-                           write_leaf_grad=True)
+                           write_leaf_grad=params is None)
         return tuple(capture.get(id(d))
-                     for d in detached if isinstance(d, Tensor))
+                     for d in detached if isinstance(d, Tensor)) + \
+            tuple(capture.get(id(p)) for p in (params or []))
 
     def tensor_vjp(cot_tensors):
         # create_graph path: re-recompute with grads ENABLED so the
@@ -107,7 +122,8 @@ def recompute(function, *args, **kwargs):
                 _random.set_rng_state(saved)
         replay_list = [replay] if isinstance(replay, Tensor) else \
             [o for o in replay if isinstance(o, Tensor)]
-        grads = _tape.grad(replay_list, tensor_args,
+        targets = list(tensor_args) + list(params or [])
+        grads = _tape.grad(replay_list, targets,
                            grad_outputs=list(cot_tensors),
                            create_graph=True, allow_unused=True)
         if not isinstance(grads, (list, tuple)):
@@ -118,7 +134,7 @@ def recompute(function, *args, **kwargs):
         # double-count
         seen_ids = set()
         out = []
-        for a, g in zip(tensor_args, grads):
+        for a, g in zip(targets, grads):
             if id(a) in seen_ids:
                 out.append(None)
             else:
@@ -131,7 +147,7 @@ def recompute(function, *args, **kwargs):
     # stop_gradient but the layer's params still need grads from the
     # replay).  Fully-frozen blocks skip the node so backward does not
     # waste a forward+backward replay producing no grads.
-    diff_inputs = [a for a in tensor_args]
+    diff_inputs = list(tensor_args) + list(params or [])
     if any(not t.stop_gradient for t in diff_inputs) or \
             _has_trainable_state(function):
         node = GradNode("recompute", vjp_fn, diff_inputs, out_meta,
@@ -142,6 +158,42 @@ def recompute(function, *args, **kwargs):
             o._out_index = i
             o.stop_gradient = False
     return outputs
+
+
+def _collect_params(function):
+    """Enumerate the trainable Tensors ``function`` closes over — a
+    Layer, a bound Layer method, or closure cells holding Layers/Tensors.
+    Returns None for an opaque callable (cannot enumerate), in which
+    case recompute falls back to side-effect leaf accumulation."""
+    from ...nn.layer_base import Layer
+
+    owner = getattr(function, "__self__", None)
+    if isinstance(function, Layer):
+        owner = function
+    if isinstance(owner, Layer):
+        return list(owner.parameters())
+    found = []
+    saw_any = False
+    for cell in (getattr(function, "__closure__", None) or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            saw_any = True
+            found.extend(v.parameters())
+        elif isinstance(v, Tensor):
+            saw_any = True
+            if not v.stop_gradient:
+                found.append(v)
+        elif isinstance(v, (list, tuple)) and v and \
+                all(isinstance(e, Layer) for e in v):
+            saw_any = True
+            for e in v:
+                found.extend(e.parameters())
+    if saw_any:
+        return found
+    return None   # opaque (could reference globals): side-effect path
 
 
 def _has_trainable_state(function) -> bool:

@@ -10,9 +10,22 @@ each kernel offset) is computed host-side in numpy — it is pure integer
 coordinate matching, data-independent given the sparsity pattern — and
 the differentiable value math (per-offset gather -> (n, Ci) @ (Ci, Co)
 GEMM on the MXU -> scatter-add) runs through dispatch so gradients flow
-to features, kernel and bias via the tape."""
+to features, kernel and bias via the tape.
+
+Compile hygiene for training loops where the point cloud changes every
+step (the reference amortizes via rulebook/workspace reuse,
+paddle/phi/kernels/sparse/gpu/conv_kernel.cu):
+- rulebooks are cached keyed on a fingerprint of the coords + geometry,
+  so a repeated cloud never re-matches coordinates;
+- gather/scatter index lists are PADDED to power-of-two buckets and fed
+  to the kernel as runtime arrays (not baked-in constants), so XLA sees
+  a stable shape signature across steps and reuses its compiled kernels
+  instead of recompiling per batch.  ``compile_stats()`` exposes the
+  distinct-signature count the tests assert on."""
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,6 +36,39 @@ from jax.experimental import sparse as jsparse
 
 from ...core.tensor import Tensor
 from ...core.dispatch import apply_op
+
+_RULEBOOK_CACHE: "OrderedDict[bytes, tuple]" = OrderedDict()
+_RULEBOOK_CACHE_MAX = 64
+_KERNEL_SIGS = set()
+_STATS = {"rulebook_builds": 0, "rulebook_hits": 0, "kernel_compiles": 0}
+
+
+def compile_stats() -> dict:
+    """Counters: rulebook_builds / rulebook_hits / kernel_compiles (the
+    number of distinct padded shape signatures — each is one XLA
+    compile; bucket reuse across steps keeps it bounded)."""
+    return dict(_STATS)
+
+
+def clear_compile_stats():
+    _RULEBOOK_CACHE.clear()
+    _KERNEL_SIGS.clear()
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def _bucket(n: int, base: int = 16) -> int:
+    """Next power-of-two >= n (min ``base``): bounds the number of
+    distinct padded shapes XLA ever compiles to O(log nnz)."""
+    if n <= base:
+        return base
+    return 1 << (int(n) - 1).bit_length()
+
+
+def _track_sig(*sig) -> None:
+    if sig not in _KERNEL_SIGS:
+        _KERNEL_SIGS.add(sig)
+        _STATS["kernel_compiles"] += 1
 
 
 def _triple(v):
@@ -57,6 +103,14 @@ def _build_rulebook(in_coords: np.ndarray, spatial, kernel_dhw, strides,
     out_spatial = tuple(
         (np.asarray(spatial) + 2 * pd - dl * (ksz - 1) - 1) // st + 1)
 
+    if in_coords.shape[0] == 0:
+        # empty cloud: empty output, no pairs (the searchsorted block
+        # below would index into empty sorted_keys)
+        empty = np.zeros(0, np.int64)
+        return (np.zeros((0, 4), np.int64),
+                tuple(spatial) if subm else out_spatial,
+                [(empty, empty) for _ in _offsets(kernel_dhw)])
+
     if subm:
         if tuple(st) != (1, 1, 1):
             raise ValueError("submanifold conv requires stride 1")
@@ -87,13 +141,64 @@ def _build_rulebook(in_coords: np.ndarray, spatial, kernel_dhw, strides,
                     spatial)
         pos = np.searchsorted(sorted_keys, keys)
         pos = np.clip(pos, 0, len(sorted_keys) - 1)
-        found = valid & (len(sorted_keys) > 0) & \
-            (sorted_keys[pos] == keys)
+        found = valid & (sorted_keys[pos] == keys)
         j_out = np.nonzero(found)[0]
         i_in = order[pos[found]]
-        pairs.append((jnp.asarray(i_in, jnp.int32),
-                      jnp.asarray(j_out, jnp.int32)))
+        pairs.append((i_in.astype(np.int32), j_out.astype(np.int32)))
     return out_coords.astype(np.int64), out_spatial, pairs
+
+
+def _cached_rulebook(in_coords, spatial, kernel_dhw, strides, paddings,
+                     dilations, subm):
+    """LRU rulebook cache + bucket padding.
+
+    Returns ``(out_coords, out_spatial, m, m_pad, padded_pairs)`` where
+    each padded pair is (gather, scatter) int32 device arrays of
+    power-of-two length; padding gathers row 0 and scatters to the
+    sentinel row ``m_pad`` (dropped by the kernel's static slice)."""
+    h = hashlib.sha1(in_coords.tobytes())
+    h.update(np.asarray(
+        [in_coords.shape[0], *spatial, *kernel_dhw, *strides, *paddings,
+         *dilations, int(subm)], np.int64).tobytes())
+    key = h.digest()
+    ent = _RULEBOOK_CACHE.get(key)
+    if ent is not None:
+        _RULEBOOK_CACHE.move_to_end(key)
+        _STATS["rulebook_hits"] += 1
+        return ent
+    _STATS["rulebook_builds"] += 1
+    out_coords, out_spatial, pairs = _build_rulebook(
+        in_coords, spatial, kernel_dhw, strides, paddings, dilations,
+        subm)
+    m = out_coords.shape[0]
+    m_pad = _bucket(max(m, 1))
+    # ONE padded length for every offset (the bucketed max): the shape
+    # signature is then a single number, so clouds of similar density
+    # share one compiled kernel even when per-offset counts differ
+    p = _bucket(max([1] + [len(gi) for gi, _ in pairs]))
+    padded = []
+    for gi, so in pairs:
+        gi_p = np.zeros(p, np.int32)
+        gi_p[: len(gi)] = gi
+        so_p = np.full(p, m_pad, np.int32)
+        so_p[: len(so)] = so
+        padded.append((jnp.asarray(gi_p), jnp.asarray(so_p)))
+    ent = (out_coords, out_spatial, m, m_pad, padded)
+    _RULEBOOK_CACHE[key] = ent
+    while len(_RULEBOOK_CACHE) > _RULEBOOK_CACHE_MAX:
+        _RULEBOOK_CACHE.popitem(last=False)
+    return ent
+
+
+def _pad_values(vals_t: Tensor, nnz: int):
+    """Pad values rows to the nnz bucket (tape op: grads slice back)."""
+    nnz_pad = _bucket(nnz)
+    if nnz_pad == nnz:
+        return vals_t, nnz_pad
+    out = apply_op(
+        "sparse_pad_values",
+        lambda v: jnp.pad(v, ((0, nnz_pad - nnz), (0, 0))), (vals_t,))
+    return out, nnz_pad
 
 
 def _sp_parts(x):
@@ -124,26 +229,48 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     vals_t, idx, batch, spatial, cin = _sp_parts(x)
     w = weight if isinstance(weight, Tensor) else Tensor(weight)
     kd, kh, kw = (int(s) for s in w.shape[:3])
-    out_coords, out_spatial, pairs = _build_rulebook(
+    out_coords, out_spatial, m, m_pad, pairs = _cached_rulebook(
         idx, spatial, (kd, kh, kw), _triple(stride), _triple(padding),
         _triple(dilation), subm)
-    m = out_coords.shape[0]
     cout = int(w.shape[-1])
-    tensor_args = [vals_t, w] + ([bias] if bias is not None else [])
+    out_shape = [batch, *out_spatial, cout]
+    nnz = idx.shape[0]
+    if nnz == 0 or m == 0:
+        out_t = apply_op(
+            "sparse_conv3d",
+            lambda f, wk, *b: jnp.zeros((m, cout), f.dtype),
+            [vals_t, w] + ([bias] if bias is not None else []))
+        return _from_values_tensor(x, out_t,
+                                   jnp.asarray(out_coords, jnp.int32),
+                                   out_shape)
 
-    def compute(feats, wk, *b):
-        wk2 = wk.reshape(kd * kh * kw, cin, cout)
-        out = jnp.zeros((m, cout), feats.dtype)
-        for k, (gi, so) in enumerate(pairs):
-            if gi.shape[0] == 0:
-                continue
+    vals_p, nnz_pad = _pad_values(vals_t, nnz)
+    K = kd * kh * kw
+    _track_sig("conv3d", nnz_pad, m_pad, cin, cout,
+               tuple(int(gi.shape[0]) for gi, _ in pairs),
+               str(vals_t._value.dtype), bias is not None)
+    flat_idx = [a for p in pairs for a in p]
+    n_extra = 1 if bias is not None else 0
+
+    def compute(feats, wk, *rest):
+        b = rest[:n_extra]
+        idxs = rest[n_extra:]
+        wk2 = wk.reshape(K, cin, cout)
+        # sentinel row m_pad absorbs padded pairs; dropped by the slice
+        out = jnp.zeros((m_pad + 1, cout), feats.dtype)
+        for k in range(K):
+            gi, so = idxs[2 * k], idxs[2 * k + 1]
             out = out.at[so].add(feats[gi] @ wk2[k])
+        out = out[:m_pad]
         if b:
             out = out + b[0]
         return out
 
+    tensor_args = [vals_p, w] + ([bias] if bias is not None else []) \
+        + flat_idx
     out_t = apply_op("sparse_conv3d", compute, tensor_args)
-    out_shape = [batch, *out_spatial, cout]
+    if m != m_pad:
+        out_t = out_t[:m]
     return _from_values_tensor(x, out_t,
                                jnp.asarray(out_coords, jnp.int32),
                                out_shape)
@@ -165,23 +292,38 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
     ks = _triple(kernel_size)
     st = _triple(stride if stride is not None else kernel_size)
     vals_t, idx, batch, spatial, ch = _sp_parts(x)
-    out_coords, out_spatial, pairs = _build_rulebook(
+    out_coords, out_spatial, m, m_pad, pairs = _cached_rulebook(
         idx, spatial, ks, st, _triple(padding), (1, 1, 1), subm=False)
-    m = out_coords.shape[0]
+    out_shape = [batch, *out_spatial, ch]
+    nnz = idx.shape[0]
+    if nnz == 0 or m == 0:
+        out_t = apply_op("sparse_maxpool",
+                         lambda f: jnp.zeros((m, ch), f.dtype), (vals_t,))
+        return _from_values_tensor(x, out_t,
+                                   jnp.asarray(out_coords, jnp.int32),
+                                   out_shape)
 
-    def compute(feats):
-        out = jnp.full((m, ch), -jnp.inf, feats.dtype)
-        for gi, so in pairs:
-            if gi.shape[0] == 0:
-                continue
+    vals_p, nnz_pad = _pad_values(vals_t, nnz)
+    _track_sig("maxpool", nnz_pad, m_pad, ch,
+               tuple(int(gi.shape[0]) for gi, _ in pairs),
+               str(vals_t._value.dtype))
+
+    def compute(feats, *idxs):
+        out = jnp.full((m_pad + 1, ch), -jnp.inf, feats.dtype)
+        for k in range(len(idxs) // 2):
+            gi, so = idxs[2 * k], idxs[2 * k + 1]
             out = out.at[so].max(feats[gi])
-        # every out coord has >=1 contributor by construction
-        return out
+        # every REAL out coord has >=1 contributor by construction;
+        # rows m..m_pad and the sentinel are dropped by the slices
+        return out[:m_pad]
 
-    out_t = apply_op("sparse_maxpool", compute, (vals_t,))
+    flat_idx = [a for p in pairs for a in p]
+    out_t = apply_op("sparse_maxpool", compute, [vals_p] + flat_idx)
+    if m != m_pad:
+        out_t = out_t[:m]
     return _from_values_tensor(x, out_t,
                                jnp.asarray(out_coords, jnp.int32),
-                               [batch, *out_spatial, ch])
+                               out_shape)
 
 
 def attention(query, key, value, sparse_mask, key_padding_mask=None,

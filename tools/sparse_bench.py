@@ -1,0 +1,71 @@
+"""Sparse-conv training-loop micro-bench: changing point cloud per step.
+
+Measures the cost of the round-5 rulebook cache + bucket padding
+(reference analog: conv_kernel.cu rulebook/workspace reuse).  Steady-
+state steps should be far cheaper than the first (compile) step, and a
+repeated cloud should skip the host-side rulebook build entirely.
+
+Run from the repo root: python tools/sparse_bench.py
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import paddle_tpu as paddle                              # noqa: E402
+from paddle_tpu import sparse                            # noqa: E402
+import paddle_tpu.sparse.nn as snn                       # noqa: E402
+from paddle_tpu.sparse.nn import functional as SF        # noqa: E402
+
+
+def _cloud(seed, shape=(2, 32, 32, 32, 16), n_pts=2000):
+    r = np.random.RandomState(seed)
+    flat = r.choice(shape[0] * shape[1] * shape[2] * shape[3],
+                    size=n_pts, replace=False)
+    b, rem = np.divmod(flat, shape[1] * shape[2] * shape[3])
+    d, rem = np.divmod(rem, shape[2] * shape[3])
+    h, w = np.divmod(rem, shape[3])
+    idx = np.stack([b, d, h, w]).astype(np.int64)
+    vals = r.randn(n_pts, shape[-1]).astype(np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, shape)
+
+
+def main():
+    paddle.seed(0)
+    SF.clear_compile_stats()
+    conv = snn.SubmConv3D(16, 32, 3, padding=1)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=conv.parameters())
+
+    def step(x):
+        out = conv(x)
+        loss = (out.values() ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(np.asarray(loss._value))
+
+    times = []
+    for s in range(6):
+        x = _cloud(seed=s)
+        t0 = time.perf_counter()
+        step(x)
+        times.append(time.perf_counter() - t0)
+    # repeated cloud: rulebook cache hit
+    x = _cloud(seed=0)
+    t0 = time.perf_counter()
+    step(x)
+    t_repeat = time.perf_counter() - t0
+
+    stats = SF.compile_stats()
+    print(f"first step (compiles):  {times[0]*1e3:9.1f} ms")
+    print(f"steady state (median):  {np.median(times[2:])*1e3:9.1f} ms")
+    print(f"repeated cloud:         {t_repeat*1e3:9.1f} ms")
+    print(f"stats: {stats}")
+    assert stats["kernel_compiles"] <= 4, stats
+
+
+if __name__ == "__main__":
+    main()
