@@ -66,6 +66,64 @@ def _run_steps(step, batches, n, start=0):
     return time.perf_counter() - t0, val
 
 
+
+def _make_batches(cfg, batch, seq, n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, cfg.vocab_size, (batch, seq))
+             .astype(np.int32),
+             rng.randint(0, cfg.vocab_size, (batch, seq))
+             .astype(np.int64)) for _ in range(n)]
+
+
+def _measure_and_report(step_fn, batches, batch, seq, steps, cfg,
+                        peak_flops, on_tpu, metric_name):
+    """Shared harness: warmup, N vs 2N delta timing (cancels RTT), MFU
+    bound check, one JSON line.  ``step_fn(ids, labels) -> loss``
+    fetched via np.asarray (the only real barrier over the tunnel)."""
+    from paddle_tpu.models.llama import param_count, llama_flops_per_token
+
+    def run(n, start):
+        loss = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            loss = step_fn(*batches[(start + i) % len(batches)])
+        val = float(np.asarray(loss._value))
+        return time.perf_counter() - t0, val
+
+    run(2, 0)                                    # compile + warm
+    dt_n, _ = run(steps, 2)
+    dt_2n, loss_val = run(2 * steps, 2 + steps)
+    raw = (dt_2n - dt_n) / steps
+    step_time = raw if 0 < raw < dt_2n else dt_2n / (2 * steps)
+
+    tokens_per_sec = batch * seq / step_time
+    mfu = tokens_per_sec * llama_flops_per_token(cfg, seq) / peak_flops
+    if on_tpu:
+        assert 0.0 < mfu < 1.0, (
+            f"physically impossible MFU {mfu:.3f} "
+            f"(tokens/s={tokens_per_sec:.0f}, peak={peak_flops:.3g}) — "
+            f"synchronization is broken, refusing to report")
+    assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+    pcount = param_count(cfg)
+    print(json.dumps({
+        "metric": metric_name,
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.5, 4),
+    }), flush=True)
+    print(f"# loss={loss_val:.4f} params={pcount/1e6:.0f}M "
+          f"mfu={mfu:.3f} step_time={step_time*1000:.1f}ms",
+          file=sys.stderr)
+
+
+def _metric_name(cfg, suffix=""):
+    from paddle_tpu.models.llama import param_count
+    pcount = param_count(cfg)
+    name = ("llama_%.1fB" % (pcount / 1e9)) if pcount >= 1e9 \
+        else ("llama_%dM" % (pcount // 1_000_000))
+    return f"{name}{suffix}_train_tokens_per_sec_per_chip"
+
+
 def _bench_config(cfg, batch, seq, steps, peak_flops, on_tpu,
                   moment_dtype="float32", optimizer="adamw"):
     import paddle_tpu as paddle
@@ -94,47 +152,27 @@ def _bench_config(cfg, batch, seq, steps, peak_flops, on_tpu,
     step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
                      clip_norm=1.0)
 
-    rng = np.random.RandomState(0)
-    batches = []
-    for _ in range(6):   # fresh data each step (no memorized-batch loss)
-        batches.append((
-            paddle.to_tensor(rng.randint(
-                0, cfg.vocab_size, (batch, seq)).astype(np.int32)),
-            paddle.to_tensor(rng.randint(
-                0, cfg.vocab_size, (batch, seq)).astype(np.int64))))
+    batches = [(paddle.to_tensor(i), paddle.to_tensor(l))
+               for i, l in _make_batches(cfg, batch, seq)]
+    _measure_and_report(step, batches, batch, seq, steps, cfg,
+                        peak_flops, on_tpu, _metric_name(cfg))
 
-    # warmup: compile + first real execution, fully fetched
-    _run_steps(step, batches, 2)
 
-    # Two timed runs; the difference cancels constant RTT/dispatch cost.
-    dt_n, _ = _run_steps(step, batches, steps, start=2)
-    dt_2n, loss_val = _run_steps(step, batches, 2 * steps, start=2 + steps)
-    raw = (dt_2n - dt_n) / steps
-    # Fallback if timing noise made the difference non-positive/absurd:
-    step_time = raw if 0 < raw < dt_2n else dt_2n / (2 * steps)
+def _bench_layerwise(cfg, batch, seq, steps, peak_flops, on_tpu):
+    """Largest-config line: optimizer-in-backward layerwise step
+    (paddle_tpu/jit/layerwise.py) — params + ONE layer's grads resident,
+    so Llama-2-7B (6.74B params, 12.6 GiB bf16) trains on a single
+    16 GB chip."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.layerwise import LlamaLayerwiseTrainStep
+    from paddle_tpu.optimizer.optimizer import Adafactor
 
-    tokens_per_sec = batch * seq / step_time
-    mfu = tokens_per_sec * llama_flops_per_token(cfg, seq) / peak_flops
-
-    if on_tpu:
-        assert 0.0 < mfu < 1.0, (
-            f"physically impossible MFU {mfu:.3f} "
-            f"(tokens/s={tokens_per_sec:.0f}, peak={peak_flops:.3g}) — "
-            f"synchronization is broken, refusing to report")
-    assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
-
-    pcount = param_count(cfg)
-    name = ("llama_%.1fB" % (pcount / 1e9)) if pcount >= 1e9 \
-        else ("llama_%dM" % (pcount // 1_000_000))
-    print(json.dumps({
-        "metric": f"{name}_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.5, 4),
-    }), flush=True)
-    print(f"# loss={loss_val:.4f} "
-          f"params={pcount/1e6:.0f}M mfu={mfu:.3f} "
-          f"step_time={step_time*1000:.1f}ms", file=sys.stderr)
+    paddle.seed(0)
+    lw = LlamaLayerwiseTrainStep(cfg, Adafactor(1e-3, parameters=[]))
+    lw.init(0)
+    batches = _make_batches(cfg, batch, seq)
+    _measure_and_report(lw, batches, batch, seq, steps, cfg, peak_flops,
+                        on_tpu, _metric_name(cfg, suffix="_layerwise"))
 
 
 def main():
@@ -183,6 +221,21 @@ def main():
     for cfg, batch, seq, steps, mdtype, opt_name in configs:
         _bench_config(cfg, batch, seq, steps, peak_flops, on_tpu,
                       moment_dtype=mdtype, optimizer=opt_name)
+
+    if on_tpu:
+        # headline (LAST): Llama-2-7B architecture (6.74B params) on one
+        # chip via the layerwise optimizer-in-backward step — the
+        # BASELINE.json north-star model, single-chip form
+        cfg_7b = LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=32, max_position_embeddings=2048,
+            dtype="bfloat16")
+        _bench_layerwise(cfg_7b, 2, 2048, 4, peak_flops, on_tpu)
+    else:
+        from paddle_tpu.models.llama import llama_tiny_config
+        _bench_layerwise(llama_tiny_config(), 2, 128, 2, peak_flops,
+                         on_tpu)
 
 
 if __name__ == "__main__":
