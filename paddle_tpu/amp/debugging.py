@@ -303,3 +303,36 @@ def compare_accuracy(dump_path: str, another_dump_path: str,
                     f"\"{r['issue']}\",{r.get('a', '')},"
                     f"{r.get('b', '')}\n")
     return rows
+
+
+def check_layer_numerics(func):
+    """Decorator checking a layer forward's input/output for nan/inf
+    (parity: amp/debugging.py:64).  Raises FloatingPointError naming the
+    offending argument or output."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        import numpy as _np
+        from ..core.tensor import Tensor as _T
+
+        def _chk(t, what):
+            if isinstance(t, _T):
+                a = _np.asarray(t._value)
+                if _np.issubdtype(a.dtype, _np.floating) and \
+                        not _np.isfinite(a).all():
+                    raise FloatingPointError(
+                        f"{type(self).__name__}.{func.__name__}: "
+                        f"non-finite values in {what}")
+        for i, a in enumerate(args):
+            _chk(a, f"input {i}")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for i, o in enumerate(outs):
+            _chk(o, f"output {i}")
+        return out
+
+    return wrapper
+
+
+__all__.append("check_layer_numerics")

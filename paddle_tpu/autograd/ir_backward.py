@@ -1,0 +1,29 @@
+"""Parity import path: paddle.autograd.ir_backward (reference PIR
+backward builder, __all__ = [grad, calc_gradient, calc_gradient_helper]).
+
+TPU-native: the "IR" is the captured tape; all three entry points reduce
+to the tape engine (paddle_tpu/autograd/tape.py) — calc_gradient is the
+static-program form the reference routes through the same machinery."""
+from .tape import grad
+
+__all__ = ["grad", "calc_gradient", "calc_gradient_helper"]
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference signature (ir_backward.calc_gradient): list-in/list-out
+    gradients of targets w.r.t. inputs."""
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gouts = target_gradients
+    res = grad(list(outs), list(ins), grad_outputs=gouts,
+               allow_unused=True)
+    return res if isinstance(res, list) else [res]
+
+
+def calc_gradient_helper(targets, inputs, target_gradients=None,
+                         no_grad_set=None):
+    """Returns the accumulated-grad map keyed by input (the reference
+    returns a value->grad dict for the IR builder)."""
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grads = calc_gradient(targets, ins, target_gradients, no_grad_set)
+    return dict(zip([id(i) for i in ins], grads))

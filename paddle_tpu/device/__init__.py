@@ -263,5 +263,106 @@ class _CudaNamespace:
         import gc
         gc.collect()
 
+    @staticmethod
+    def get_device_name(device=None):
+        """Parity: device/cuda get_device_name — the accelerator kind
+        string (TPU kind here, e.g. 'TPU v5 lite')."""
+        return _device(device).device_kind
+
+    @staticmethod
+    def get_device_capability(device=None):
+        """Parity: get_device_capability — (major, minor).  CUDA compute
+        capability has no TPU analog; the TPU generation number is the
+        meaningful major version."""
+        kind = _device(device).device_kind
+        import re as _re
+        m = _re.search(r"v(\d+)", kind)
+        return (int(m.group(1)) if m else 0, 0)
+
 
 cuda = _CudaNamespace()
+
+
+def get_cudnn_version():
+    """Parity: paddle.device.get_cudnn_version — None when not built
+    with cuDNN (always, on the TPU stack)."""
+    return None
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """The graph compiler here is XLA, not CINN."""
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    """Distributed (collectives over ICI/DCN) is always built in."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str = None) -> bool:
+    """PJRT plugins are the custom-device mechanism; 'tpu' (and the
+    axon tunnel) count."""
+    import jax
+    try:
+        plats = {d.platform for d in jax.devices()}
+    except RuntimeError:
+        return False
+    if device_type is None:
+        return bool(plats - {"cpu", "gpu"})
+    return device_type in plats
+
+
+def get_all_custom_device_type():
+    import jax
+    try:
+        return sorted({d.platform for d in jax.devices()}
+                      - {"cpu", "gpu"})
+    except RuntimeError:
+        return []
+
+
+class XPUPlace:
+    """Parity name (device/__init__ XPUPlace): Kunlun XPU hardware is
+    not present on a TPU stack; constructing one is an error, as on any
+    paddle build without XPU support."""
+
+    def __init__(self, dev_id=0):
+        raise RuntimeError(
+            "XPUPlace is unavailable: this framework targets TPU "
+            "devices (use paddle.TPUPlace / CPUPlace)")
+
+
+class IPUPlace:
+    """Parity name (device/__init__ IPUPlace); same contract as
+    XPUPlace on a non-IPU build."""
+
+    def __init__(self):
+        raise RuntimeError(
+            "IPUPlace is unavailable: this framework targets TPU "
+            "devices (use paddle.TPUPlace / CPUPlace)")
+
+
+def set_stream(stream=None):
+    """Parity: device.set_stream.  XLA orders work on a single device
+    stream by data dependence; the call validates the handle and
+    returns the previous (current) stream."""
+    prev = current_stream()
+    if stream is not None and not isinstance(stream, Stream):
+        raise TypeError(f"set_stream expects a Stream, got {type(stream)}")
+    return prev
+
+
+__all__ += ["get_cudnn_version", "XPUPlace", "IPUPlace",
+            "is_compiled_with_ipu", "is_compiled_with_cinn",
+            "is_compiled_with_rocm", "is_compiled_with_distribute",
+            "is_compiled_with_custom_device",
+            "get_all_custom_device_type", "set_stream"]
+

@@ -1,0 +1,5 @@
+"""paddle.distributed.communication — explicit-stream collective API
+(parity: python/paddle/distributed/communication/)."""
+from . import stream  # noqa: F401
+
+__all__ = ["stream"]

@@ -1,0 +1,124 @@
+"""paddle.distributed.communication.stream — stream-explicit collectives.
+
+Parity: python/paddle/distributed/communication/stream/ (all_reduce.py
+and siblings): the variants that take ``sync_op`` / ``use_calc_stream``
+and return a waitable task.
+
+TPU-native mapping: XLA dispatch is asynchronous by construction — every
+collective is enqueued on the device stream and ordered by data
+dependence, which is exactly the semantics the reference's
+``use_calc_stream=True`` fast path requests.  ``sync_op=False`` returns
+a task whose ``wait()`` blocks on the result buffer (the analog of
+stream synchronization); ``sync_op=True`` waits before returning.
+"""
+from __future__ import annotations
+
+from .. import collective as _c
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+           "send", "gather"]
+
+
+class _StreamTask:
+    """Waitable handle (parity: the task returned by stream
+    collectives)."""
+
+    def __init__(self, tensors):
+        self._tensors = tensors if isinstance(tensors, (list, tuple)) \
+            else [tensors]
+
+    def wait(self):
+        import jax
+        for t in self._tensors:
+            v = getattr(t, "_value", None)
+            if v is not None:
+                jax.block_until_ready(v)
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _task(result, fallback, sync_op):
+    task = _StreamTask(result if result is not None else fallback)
+    if sync_op:
+        task.wait()
+    return task
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    op = op if op is not None else _c.ReduceOp.SUM
+    r = _c.all_reduce(tensor, op=op, group=group, sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    r = _c.all_gather(tensor_or_tensor_list, tensor, group=group,
+                      sync_op=False)
+    return _task(r, tensor_or_tensor_list, sync_op)
+
+
+def alltoall(out_tensor_or_list, in_tensor_or_list, group=None,
+             sync_op=True, use_calc_stream=False):
+    r = _c.all_to_all(out_tensor_or_list, in_tensor_or_list, group=group,
+                      sync_op=False)
+    return _task(r, out_tensor_or_list, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    r = _c.all_to_all_single(out_tensor, in_tensor,
+                             out_split_sizes=out_split_sizes,
+                             in_split_sizes=in_split_sizes, group=group,
+                             sync_op=False)
+    return _task(r, out_tensor, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    r = _c.broadcast(tensor, src=src, group=group, sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    op = op if op is not None else _c.ReduceOp.SUM
+    r = _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    op = op if op is not None else _c.ReduceOp.SUM
+    r = _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op,
+                          group=group, sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    r = _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+                   sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    r = _c.gather(tensor, gather_list=gather_list, dst=dst, group=group,
+                  sync_op=False)
+    return _task(r, gather_list if gather_list is not None else tensor,
+                 sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    r = _c.send(tensor, dst=dst, group=group, sync_op=False)
+    return _task(r, tensor, sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    r = _c.recv(tensor, src=src, group=group, sync_op=False)
+    return _task(r, tensor, sync_op)

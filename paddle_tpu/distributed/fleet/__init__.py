@@ -4,13 +4,22 @@ Parity: python/paddle/distributed/fleet/ (reference — fleet.init,
 distributed_model fleet/model.py:32,141-160, distributed_optimizer,
 DistributedStrategy fleet/base/distributed_strategy.py).
 """
-from .base import (init, DistributedStrategy, distributed_model,
-                   distributed_optimizer, get_hybrid_communicate_group,
-                   worker_index, worker_num, is_first_worker)
+from ._base_impl import (init, DistributedStrategy, distributed_model,
+                         distributed_optimizer,
+                         get_hybrid_communicate_group,
+                         worker_index, worker_num, is_first_worker,
+                         fleet)
 from ..topology import HybridCommunicateGroup, CommunicateTopology
 from .recompute import recompute, recompute_sequential
 from . import meta_parallel
+from . import base
+from .base import Fleet, UtilBase
+from . import utils
+
+# fleet.util singleton (parity: fleet/__init__.py util = UtilBase())
+util = UtilBase()
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
-           "recompute", "meta_parallel"]
+           "recompute", "meta_parallel", "Fleet", "UtilBase", "fleet",
+           "util", "utils"]

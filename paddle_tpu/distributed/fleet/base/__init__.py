@@ -1,0 +1,12 @@
+"""fleet.base — the Fleet engine internals as a package (parity:
+python/paddle/distributed/fleet/base/)."""
+from .._base_impl import (_Fleet, DistributedStrategy, fleet, init,
+                          distributed_model, distributed_optimizer,
+                          get_hybrid_communicate_group, worker_index,
+                          worker_num, is_first_worker)
+from .util_factory import UtilBase
+from . import topology  # noqa: F401
+
+Fleet = _Fleet
+
+__all__ = ["Fleet", "DistributedStrategy", "UtilBase", "fleet"]

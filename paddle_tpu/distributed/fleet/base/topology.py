@@ -1,0 +1,6 @@
+"""Parity import path: paddle.distributed.fleet.base.topology
+(reference file of the same path; the implementations live in
+paddle_tpu/distributed/topology.py)."""
+from ...topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]

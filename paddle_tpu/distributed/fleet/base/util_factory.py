@@ -1,0 +1,79 @@
+"""fleet.util — cross-worker utility helpers.
+
+Parity: python/paddle/distributed/fleet/base/util_factory.py:49
+(UtilBase).  The PS comm worlds ("server"/"all") collapse to the worker
+world here — there are no parameter servers on a TPU mesh (SURVEY §7
+non-goal); numpy inputs ride the regular collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+        self.dist_strategy = None
+        self.fs_client = None
+
+    def _set_strategy(self, dist_strategy):
+        self.dist_strategy = dist_strategy
+
+    def _set_file_system(self, fs_client):
+        self.fs_client = fs_client
+
+    def _world(self):
+        from ...env import get_world_size
+        return get_world_size()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ...collective import all_reduce, ReduceOp
+        from ....core.tensor import Tensor
+        arr = np.asarray(input)
+        if self._world() <= 1:
+            return arr
+        t = Tensor(arr)
+        op = {"sum": ReduceOp.SUM, "min": ReduceOp.MIN,
+              "max": ReduceOp.MAX}[mode]
+        all_reduce(t, op=op)
+        return np.asarray(t._value)
+
+    def all_gather(self, input, comm_world="worker"):
+        from ...collective import all_gather_object
+        if self._world() <= 1:
+            return [input]
+        out: list = []
+        all_gather_object(out, input)
+        return out
+
+    def barrier(self, comm_world="worker"):
+        from ...collective import barrier
+        if self._world() > 1:
+            barrier()
+
+    def get_file_shard(self, files):
+        """Split ``files`` contiguously over workers (parity:
+        util_factory.get_file_shard: first ``len % n`` workers take one
+        extra)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        from ...env import get_rank
+        n = max(self._world(), 1)
+        trainer_id = get_rank()
+        blocks = len(files) // n
+        remainder = len(files) % n
+        if trainer_id < remainder:
+            begin = trainer_id * (blocks + 1)
+            end = begin + blocks + 1
+        else:
+            begin = remainder * (blocks + 1) + (trainer_id - remainder) \
+                * blocks
+            end = begin + blocks
+        return files[begin:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ...env import get_rank
+        if get_rank() == rank_id:
+            print(message)

@@ -1,0 +1,20 @@
+"""fleet.utils (parity: python/paddle/distributed/fleet/utils/__init__.py
+__all__ = [LocalFS, recompute, DistributedInfer, HDFSClient])."""
+from ..recompute import recompute
+from .fs import LocalFS, HDFSClient
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
+
+class DistributedInfer:
+    """Parity name: fleet/utils/__init__.py DistributedInfer — the
+    parameter-server distributed-inference helper.  Parameter servers
+    are an explicit non-goal (SURVEY §7 row 38); on a TPU mesh use
+    ``paddle.distributed.fleet.distributed_model`` + the Predictor
+    (paddle_tpu/inference/serving.py) instead."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise NotImplementedError(
+            "DistributedInfer is a parameter-server workflow (non-goal); "
+            "use fleet.distributed_model + paddle_tpu.inference for "
+            "mesh-parallel inference")

@@ -1,0 +1,205 @@
+"""Filesystem clients (parity: python/paddle/distributed/fleet/utils/
+fs.py — FS/LocalFS/HDFSClient).  LocalFS is fully native; HDFSClient
+shells out to the ``hadoop`` CLI like the reference and therefore
+requires it on PATH."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Parity: fs.py LocalFS — local-filesystem client."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), \
+            f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError
+        return self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        dirs, _ = self.ls_dir(fs_path)
+        return dirs
+
+
+class HDFSClient(FS):
+    """Parity: fs.py HDFSClient — drives the ``hadoop fs`` CLI.  Needs
+    the hadoop binary on PATH (same requirement as the reference, which
+    builds its commands from ``hadoop_home``)."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        if self._hadoop is None or not os.path.exists(self._hadoop):
+            raise RuntimeError(
+                "HDFSClient requires the hadoop CLI (pass hadoop_home or "
+                "put `hadoop` on PATH)")
+        self._base = [self._hadoop, "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base += ["-D", f"{k}={v}"]
+
+    def _run(self, *args) -> str:
+        proc = subprocess.run(self._base + list(args),
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ExecuteError(proc.stderr)
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path, multi_processes=1,
+               overwrite=False):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path):
+        return self._run("-cat", fs_path)

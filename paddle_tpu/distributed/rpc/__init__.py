@@ -315,3 +315,20 @@ def shutdown():
             time.sleep(0.05)
     agent.close()
     _agent[0] = None
+
+
+def get_current_worker_info():
+    """Parity: rpc.get_current_worker_info — this process's WorkerInfo."""
+    from ..env import get_rank
+    return get_worker_info_by_rank(get_rank())
+
+
+def get_worker_info_by_rank(rank):
+    infos = get_all_worker_infos()
+    for info in infos:
+        if info.rank == rank:
+            return info
+    raise RuntimeError(f"no worker with rank {rank}")
+
+
+__all__.append("get_current_worker_info")

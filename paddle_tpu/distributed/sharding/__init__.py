@@ -1,0 +1,8 @@
+"""paddle.distributed.sharding (parity:
+python/paddle/distributed/sharding/__init__.py — group_sharded_parallel,
+save_group_sharded_model; implementations in
+fleet/meta_parallel/sharding_api.py)."""
+from ..fleet.meta_parallel.sharding_api import (group_sharded_parallel,
+                                                save_group_sharded_model)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

@@ -911,3 +911,403 @@ def _kl_laplace(p, q):
     return _t(-jnp.log(scale_ratio) + scale_ratio
               * jnp.exp(-jnp.abs(p.loc - q.loc) / p.scale)
               + delta - 1)
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: ExponentialFamily, Cauchy, ContinuousBernoulli, Binomial,
+# MultivariateNormal (parity: python/paddle/distribution/
+# exponential_family.py, cauchy.py, continuous_bernoulli.py, binomial.py,
+# multivariate_normal.py)
+# ---------------------------------------------------------------------------
+class ExponentialFamily(Distribution):
+    """Parity: distribution/exponential_family.py — base class whose
+    generic ``entropy`` is derived from the log normalizer via autodiff
+    (Bregman form: H = A(eta) - sum eta_i * dA/deta_i + E[-h(x)]),
+    exactly the reference's _entropy built on paddle.grad."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(p, jnp.float32)
+                   for p in self._natural_parameters]
+        lg, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=0)(tuple(nparams))
+        ent = -self._mean_carrier_measure + lg
+        for np_, g in zip(nparams, grads):
+            ent = ent - np_ * g
+        return _t(ent)
+
+
+class Cauchy(Distribution):
+    """Parity: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.loc),
+                                              jnp.shape(self.scale)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return _t(self.loc + self.scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+    def sample(self, shape=()):
+        return _t(jax.lax.stop_gradient(self.rsample(shape)._value))
+
+    def log_prob(self, value):
+        v = _v(value)
+        z = (v - self.loc) / self.scale
+        return _t(-jnp.log(jnp.pi) - jnp.log(self.scale)
+                  - jnp.log1p(z * z))
+
+    def cdf(self, value):
+        v = _v(value)
+        return _t(jnp.arctan((v - self.loc) / self.scale) / jnp.pi + 0.5)
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            jnp.log(4 * jnp.pi * self.scale), self.batch_shape))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class ContinuousBernoulli(Distribution):
+    """Parity: distribution/continuous_bernoulli.py (probs param;
+    lims window around 0.5 uses the Taylor form like the reference)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _v(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _cut(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _log_norm(self):
+        # C(p) = 2 atanh(1-2p) / (1-2p) for p != 0.5 ; 2 at p = 0.5
+        p = jnp.where(self._cut(), self.probs, 0.45)   # safe operand
+        val = jnp.log(2.0 * jnp.arctanh(1.0 - 2.0 * p)
+                      / (1.0 - 2.0 * p))
+        # 2nd-order Taylor around 0.5: log(2 + 8/3 e^2), e = p - 0.5
+        e = self.probs - 0.5
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * e * e
+        return jnp.where(self._cut(), val, taylor)
+
+    @property
+    def mean(self):
+        p = jnp.where(self._cut(), self.probs, 0.45)
+        m = p / (2.0 * p - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * p))
+        e = self.probs - 0.5
+        taylor = 0.5 + e / 3.0
+        return _t(jnp.where(self._cut(), m, taylor))
+
+    @property
+    def variance(self):
+        p = jnp.where(self._cut(), self.probs, 0.45)
+        v = p * (p - 1.0) / jnp.square(1.0 - 2.0 * p) \
+            + 1.0 / jnp.square(2.0 * jnp.arctanh(1.0 - 2.0 * p))
+        e = self.probs - 0.5
+        taylor = 1.0 / 12.0 - 2.0 / 15.0 * e * e
+        return _t(jnp.where(self._cut(), v, taylor))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(next_key(),
+                               _shape(shape, self.batch_shape),
+                               minval=1e-6, maxval=1.0 - 1e-6)
+        return self.icdf(_t(u))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return _t(v * jnp.log(self.probs)
+                  + (1.0 - v) * jnp.log1p(-self.probs)
+                  + self._log_norm())
+
+    def cdf(self, value):
+        v = _v(value)
+        p = jnp.where(self._cut(), self.probs, 0.45)
+        num = (jnp.power(p, v) * jnp.power(1.0 - p, 1.0 - v)
+               + p - 1.0)
+        c = num / (2.0 * p - 1.0)
+        return _t(jnp.clip(jnp.where(self._cut(), c, v), 0.0, 1.0))
+
+    def icdf(self, value):
+        u = _v(value)
+        p = jnp.where(self._cut(), self.probs, 0.45)
+        ratio = jnp.log1p(-p) - jnp.log(p)
+        x = (jnp.log1p(u * jnp.expm1(ratio))) / ratio
+        return _t(jnp.where(self._cut(), x, u))
+
+    def entropy(self):
+        m = self.mean._value
+        return _t(-(m * jnp.log(self.probs)
+                    + (1.0 - m) * jnp.log1p(-self.probs)
+                    + self._log_norm()))
+
+
+class Binomial(Distribution):
+    """Parity: distribution/binomial.py (total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), jnp.shape(self.probs)))
+
+    @property
+    def mean(self):
+        return _t(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.total_count * self.probs * (1.0 - self.probs))
+
+    def sample(self, shape=()):
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs, self.batch_shape)
+        out = jax.random.binomial(next_key(), n.astype(jnp.float32), p,
+                                  shape=_shape(shape, self.batch_shape))
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        n = self.total_count
+        logp = jnp.log(self.probs)
+        log1mp = jnp.log1p(-self.probs)
+        return _t(gammaln(n + 1.0) - gammaln(v + 1.0)
+                  - gammaln(n - v + 1.0) + v * logp + (n - v) * log1mp)
+
+    def entropy(self):
+        # exact finite sum over the support (reference computes the
+        # same sum); vectorized over [0, max_n]
+        n_max = int(np.max(np.asarray(self.total_count)))
+        ks = jnp.arange(n_max + 1, dtype=jnp.float32)
+        grid = ks.reshape((-1,) + (1,) * len(self.batch_shape))
+        lp = self.log_prob(_t(jnp.broadcast_to(
+            grid, (n_max + 1,) + tuple(self.batch_shape))))._value
+        valid = grid <= self.total_count
+        return _t(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0),
+                           axis=0))
+
+
+class MultivariateNormal(Distribution):
+    """Parity: distribution/multivariate_normal.py (loc +
+    covariance_matrix / precision_matrix / scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _v(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "exactly one of covariance_matrix, precision_matrix, "
+                "scale_tril must be given")
+        if scale_tril is not None:
+            self._scale_tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        else:
+            prec_chol = jnp.linalg.cholesky(_v(precision_matrix))
+            eye = jnp.eye(prec_chol.shape[-1], dtype=prec_chol.dtype)
+            self._scale_tril = jax.scipy.linalg.solve_triangular(
+                prec_chol, eye, lower=True, trans=1)
+        d = self._scale_tril.shape[-1]
+        batch = jnp.broadcast_shapes(jnp.shape(self.loc)[:-1],
+                                     jnp.shape(self._scale_tril)[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def scale_tril(self):
+        return _t(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        L = self._scale_tril
+        return _t(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        cov = self.covariance_matrix._value
+        return _t(jnp.linalg.inv(cov))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(
+            self.loc, self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        L = self._scale_tril
+        var = jnp.sum(jnp.square(L), axis=-1)
+        return _t(jnp.broadcast_to(
+            var, self.batch_shape + self.event_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self.batch_shape + self.event_shape)
+        eps = jax.random.normal(next_key(), shp)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i",
+                                        self._scale_tril, eps))
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        diff = v - self.loc
+        y = jax.scipy.linalg.solve_triangular(
+            self._scale_tril, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(y), axis=-1)
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        d = self.event_shape[0]
+        return _t(-0.5 * (d * jnp.log(2 * jnp.pi) + maha) - half_logdet)
+
+    def entropy(self):
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1)
+        d = self.event_shape[0]
+        ent = 0.5 * d * (1.0 + jnp.log(2 * jnp.pi)) + half_logdet
+        return _t(jnp.broadcast_to(ent, self.batch_shape))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    Lp, Lq = p._scale_tril, q._scale_tril
+    d = p.event_shape[0]
+    half_logdet_p = jnp.sum(jnp.log(
+        jnp.diagonal(Lp, axis1=-2, axis2=-1)), axis=-1)
+    half_logdet_q = jnp.sum(jnp.log(
+        jnp.diagonal(Lq, axis1=-2, axis2=-1)), axis=-1)
+    M = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    tr = jnp.sum(jnp.square(M), axis=(-2, -1))
+    diff = q.loc - p.loc
+    y = jax.scipy.linalg.solve_triangular(
+        Lq, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(jnp.square(y), axis=-1)
+    return _t(half_logdet_q - half_logdet_p + 0.5 * (tr + maha - d))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    """Closed form (reference cauchy.py kl_divergence):
+    log(((s_p + s_q)^2 + (l_p - l_q)^2) / (4 s_p s_q))."""
+    return _t(jnp.log(
+        (jnp.square(p.scale + q.scale) + jnp.square(p.loc - q.loc))
+        / (4.0 * p.scale * q.scale)))
+
+
+__all__ += ["ExponentialFamily", "Cauchy", "ContinuousBernoulli",
+            "Binomial", "MultivariateNormal"]
+
+
+class IndependentTransform(Transform):
+    """Parity: transform.py IndependentTransform — reinterpret the
+    rightmost ``reinterpreted_batch_rank`` dims as event dims (sums the
+    log-det over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self._base._fldj(x)
+        axes = tuple(range(-self._rank, 0))
+        return jnp.sum(ld, axis=axes)
+
+
+class ReshapeTransform(Transform):
+    """Parity: transform.py ReshapeTransform (in_event_shape ->
+    out_event_shape; volume-preserving, log-det 0)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(int(s) for s in in_event_shape)
+        self._out = tuple(int(s) for s in out_event_shape)
+        if int(np.prod(self._in)) != int(np.prod(self._out)):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape "
+                f"{self._out} have different sizes")
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        return x.reshape(batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self._out)]
+        return y.reshape(batch + self._in)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Parity: transform.py StackTransform — apply a list of transforms
+    to slices of ``x`` along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self._transforms = list(transforms)
+        self._axis = int(axis)
+
+    def _map(self, method, x):
+        slices = jnp.moveaxis(x, self._axis, 0)
+        outs = [getattr(t, method)(slices[i])
+                for i, t in enumerate(self._transforms)]
+        return jnp.moveaxis(jnp.stack(outs), 0, self._axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+
+__all__ += ["IndependentTransform", "ReshapeTransform", "StackTransform"]

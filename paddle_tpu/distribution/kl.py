@@ -1,0 +1,5 @@
+"""Parity import path: paddle.distribution.kl (__all__ = [kl_divergence,
+register_kl]); implementations in the package __init__."""
+from . import kl_divergence, register_kl
+
+__all__ = ["kl_divergence", "register_kl"]

@@ -1,0 +1,13 @@
+"""Parity import path: paddle.distribution.transform (the 13 Transform
+classes of reference transform.py); implementations in the package
+__init__."""
+from . import (Transform, AbsTransform, AffineTransform, ChainTransform,
+               ExpTransform, IndependentTransform, PowerTransform,
+               ReshapeTransform, SigmoidTransform, SoftmaxTransform,
+               StackTransform, StickBreakingTransform, TanhTransform)
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]

@@ -153,3 +153,95 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
                        jnp.take(yv, dst, axis=0))
 
     return apply_op("send_uv", fn, (x, y, src_val, dst_val))
+
+
+# ---------------------------------------------------------------------------
+# GNN mini-batch sampling (parity: python/paddle/geometric/sampling/
+# neighbors.py sample_neighbors:23 / weighted_sample_neighbors, and
+# reindex.py reindex_graph:25 / reindex_heter_graph)
+# ---------------------------------------------------------------------------
+from ..ops.op_surface import (reindex_graph,               # noqa: E402
+                              weighted_sample_neighbors)
+from ..core.tensor import Tensor as _Tensor                # noqa: E402
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Parity: geometric.sample_neighbors — uniform sampling without
+    replacement over a CSC graph; the TPU form is the same Gumbel
+    top-k kernel as weighted_sample_neighbors with unit weights (fixed
+    dense shapes, XLA-friendly)."""
+    import jax.numpy as jnp
+    rw = row._value if isinstance(row, _Tensor) else jnp.asarray(row)
+    ones = _Tensor._from_value(
+        jnp.ones(rw.reshape(-1).shape, jnp.float32))
+    if return_eids:
+        if eids is None:
+            raise ValueError("return_eids=True requires eids")
+        out, cnt = weighted_sample_neighbors(
+            row, colptr, ones, input_nodes, sample_size=sample_size)
+        # map sampled positions back to eids via the row-position table
+        return out, cnt, _gather_eids(row, colptr, input_nodes, out,
+                                      cnt, eids)
+    return weighted_sample_neighbors(row, colptr, ones, input_nodes,
+                                     sample_size=sample_size)
+
+
+def _gather_eids(row, colptr, seeds, out, cnt, eids):
+    import numpy as _np
+    rw = _np.asarray(row._value if isinstance(row, _Tensor) else row) \
+        .reshape(-1)
+    cp = _np.asarray(colptr._value if isinstance(colptr, _Tensor)
+                     else colptr).reshape(-1)
+    sd = _np.asarray(seeds._value if isinstance(seeds, _Tensor)
+                     else seeds).reshape(-1)
+    ev = _np.asarray(eids._value if isinstance(eids, _Tensor)
+                     else eids).reshape(-1)
+    out_np = _np.asarray(out._value).reshape(len(sd), -1)
+    cnt_np = _np.asarray(cnt._value).reshape(-1)
+    res = []
+    for i, s in enumerate(sd):
+        lo, hi = int(cp[s]), int(cp[s + 1])
+        nbr_eid = {}
+        for pos in range(lo, hi):
+            nbr_eid.setdefault(int(rw[pos]), int(ev[pos]))
+        for v in out_np[i][: cnt_np[i]]:
+            res.append(nbr_eid[int(v)])
+    return _Tensor(_np.asarray(res, _np.int64))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Parity: geometric.reindex_heter_graph — reindex over multiple
+    edge types: the hashtable (first-occurrence order over seeds then
+    each type's neighbors) is shared, edges stay per-type concatenated."""
+    import numpy as _np
+    xv = _np.asarray(x._value if isinstance(x, _Tensor) else x) \
+        .reshape(-1).astype(_np.int64)
+    nbs = [_np.asarray(n._value if isinstance(n, _Tensor) else n)
+           .reshape(-1).astype(_np.int64) for n in neighbors]
+    cts = [_np.asarray(c._value if isinstance(c, _Tensor) else c)
+           .reshape(-1).astype(_np.int64) for c in count]
+    remap = {}
+    out_nodes = []
+    for v in xv:
+        v = int(v)
+        if v not in remap:
+            remap[v] = len(out_nodes)
+            out_nodes.append(v)
+    srcs, dsts = [], []
+    for nb, ct in zip(nbs, cts):
+        for v in nb:
+            v = int(v)
+            if v not in remap:
+                remap[v] = len(out_nodes)
+                out_nodes.append(v)
+        srcs.append(_np.asarray([remap[int(v)] for v in nb], _np.int64))
+        dsts.append(_np.repeat(_np.arange(len(xv), dtype=_np.int64), ct))
+    return (_Tensor(_np.concatenate(srcs)),
+            _Tensor(_np.concatenate(dsts)),
+            _Tensor(_np.asarray(out_nodes, _np.int64)))
+
+
+__all__ += ["sample_neighbors", "weighted_sample_neighbors",
+            "reindex_graph", "reindex_heter_graph"]

@@ -154,7 +154,10 @@ class ASPHelper:
 
     @classmethod
     def supported(cls, layer: Layer) -> bool:
-        return isinstance(layer, (nn.Linear, nn.Conv2D))
+        if isinstance(layer, (nn.Linear, nn.Conv2D)):
+            return True
+        name = type(layer).__name__.lower()
+        return name in _SUPPORTED_LAYERS
 
     @classmethod
     def prunable_params(cls, model: Layer):
@@ -181,11 +184,27 @@ def reset_excluded_layers(main_program=None):
 def prune_model(model: Layer, n=2, m=4, mask_algo=MaskAlgo.MASK_1D,
                 with_mask=True):
     """Apply n:m masks to every supported layer's weight (parity:
-    asp.py prune_model).  Returns {param_id: mask}."""
+    asp.py prune_model).  Layers registered via ``add_supported_layer``
+    with a custom pruning function use it (must return
+    (pruned_weight, mask) numpy arrays).  Returns {param_id: mask}."""
     masks = {}
-    for w in ASPHelper.prunable_params(model):
-        mask = create_mask(w, mask_algo, n, m)
-        w.set_value(np.asarray(w._value) * np.asarray(mask._value))
+    for lname, sub in model.named_sublayers(include_self=True):
+        if not ASPHelper.supported(sub):
+            continue
+        if any(lname.startswith(e) for e in ASPHelper._excluded if e):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or w._value.ndim < 2:
+            continue
+        custom = _custom_pruning_func(sub)
+        if custom is not None:
+            pruned, mask_arr = custom(np.asarray(w._value), n, m)
+            from ...core.tensor import Tensor as _T
+            mask = _T(np.asarray(mask_arr))
+            w.set_value(np.asarray(pruned))
+        else:
+            mask = create_mask(w, mask_algo, n, m)
+            w.set_value(np.asarray(w._value) * np.asarray(mask._value))
         masks[id(w)] = mask
         if with_mask:
             ASPHelper._masks[id(w)] = mask
@@ -216,3 +235,21 @@ class OptimizerWithSparsityGuarantee:
 
 def decorate(optimizer):
     return OptimizerWithSparsityGuarantee(optimizer)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Parity: incubate/asp/supported_layer_list.py add_supported_layer —
+    register a layer type (or name) whose weights ASP should prune, with
+    an optional custom pruning function (weight, mask) consulted by
+    prune_model via ``_SUPPORTED_LAYERS``."""
+    name = (layer if isinstance(layer, str)
+            else getattr(layer, "__name__", str(layer))).lower()
+    _SUPPORTED_LAYERS[name] = pruning_func
+
+
+def _custom_pruning_func(layer):
+    return _SUPPORTED_LAYERS.get(type(layer).__name__.lower())
+
+
+_SUPPORTED_LAYERS = {"linear": None, "conv2d": None}
+__all__.append("add_supported_layer")

@@ -1,0 +1,144 @@
+"""paddle.incubate.autograd (parity: python/paddle/incubate/autograd/
+__all__ = [vjp, jvp, Jacobian, Hessian, enable_prim, disable_prim,
+forward_grad, grad]).
+
+TPU-native: the reference's "prim" lowering (decompose to primitive ops
+for the static AD pass) is absorbed by jax/XLA — every op here is
+already primitive-backed, so enable_prim/disable_prim toggle a flag the
+translator does not need.  jvp is forward-over-reverse (two VJPs via
+create_graph), the classical identity Jv = d/du [ (J^T u) . v ]."""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ...core.tensor import Tensor
+from ...autograd import tape as _tape
+from ...autograd.functional import jacobian as _jacobian, \
+    hessian as _hessian
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_PRIM = {"enabled": False}
+
+
+def enable_prim():
+    """Ops are already primitive-level under jax; the flag is kept for
+    API parity and introspection."""
+    _PRIM["enabled"] = True
+
+
+def disable_prim():
+    _PRIM["enabled"] = False
+
+
+def prim_enabled():
+    return _PRIM["enabled"]
+
+
+def _tolist(xs):
+    return list(xs) if isinstance(xs, (list, tuple)) else [xs]
+
+
+def vjp(func, xs, v=None):
+    """Parity: incubate.autograd.vjp — returns (func(xs), vjp_result)."""
+    xs_l = _tolist(xs)
+    for x in xs_l:
+        x.stop_gradient = False
+    ys = func(*xs_l)
+    ys_l = _tolist(ys)
+    seeds = _tolist(v) if v is not None else None
+    grads = _tape.grad(ys_l, xs_l, grad_outputs=seeds,
+                       retain_graph=True, allow_unused=True)
+    if not isinstance(grads, list):
+        grads = [grads]
+    out = grads if isinstance(xs, (list, tuple)) else grads[0]
+    return ys, out
+
+
+def _tangent(outs, ins, vs):
+    """Forward-over-reverse core: tangents of ``outs`` at input
+    tangents ``vs`` via two nested VJPs."""
+    import jax.numpy as jnp
+    us = []
+    for y in outs:
+        u = Tensor._from_value(jnp.zeros_like(y._value))
+        u.stop_gradient = False
+        us.append(u)
+    s = None
+    for y, u in zip(outs, us):
+        term = (y * u).sum()
+        s = term if s is None else s + term
+    gx = _tape.grad([s], ins, create_graph=True, allow_unused=True)
+    if not isinstance(gx, list):
+        gx = [gx]
+    t = None
+    for g, vv in zip(gx, vs):
+        if g is None:
+            continue
+        term = (g * vv).sum()
+        t = term if t is None else t + term
+    jv = _tape.grad([t], us, allow_unused=True)
+    return jv if isinstance(jv, list) else [jv]
+
+
+def jvp(func, xs, v=None):
+    """Parity: incubate.autograd.jvp."""
+    import jax.numpy as jnp
+    xs_l = _tolist(xs)
+    for x in xs_l:
+        x.stop_gradient = False
+    ys = func(*xs_l)
+    ys_l = _tolist(ys)
+    vs = _tolist(v) if v is not None else \
+        [Tensor._from_value(jnp.ones_like(x._value)) for x in xs_l]
+    jv = _tangent(ys_l, xs_l, vs)
+    out = jv if isinstance(ys, (list, tuple)) else jv[0]
+    return ys, out
+
+
+class Jacobian:
+    """Parity: incubate.autograd.Jacobian — row access over the full
+    jacobian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._jac = _jacobian(func, xs, create_graph=False)
+
+    def __getitem__(self, idx):
+        return self._jac[idx]
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+
+class Hessian:
+    """Parity: incubate.autograd.Hessian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._hes = _hessian(func, xs, create_graph=False)
+
+    def __getitem__(self, idx):
+        return self._hes[idx]
+
+    @property
+    def shape(self):
+        return self._hes.shape
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Parity: incubate.autograd.forward_grad (prim-mode forward AD):
+    tangents of ``outputs`` given input tangents."""
+    import jax.numpy as jnp
+    outs = _tolist(outputs)
+    ins = _tolist(inputs)
+    vs = _tolist(grad_inputs) if grad_inputs is not None else \
+        [Tensor._from_value(jnp.ones_like(x._value)) for x in ins]
+    jv = _tangent(outs, ins, vs)
+    return jv if isinstance(outputs, (list, tuple)) else jv[0]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Parity: incubate.autograd.grad (the prim-mode reverse grad)."""
+    return _tape.grad(_tolist(outputs), _tolist(inputs),
+                      grad_outputs=grad_outputs, allow_unused=True)

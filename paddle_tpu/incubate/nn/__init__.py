@@ -4,4 +4,6 @@ kernels #17)."""
 from . import functional
 from .layer import (FusedMultiHeadAttention, FusedFeedForward,
                     FusedTransformerEncoderLayer, FusedLinear,
-                    FusedRMSNorm, FusedEcMoe)
+                    FusedRMSNorm, FusedEcMoe, FusedDropoutAdd,
+                    FusedBiasDropoutResidualLayerNorm,
+                    FusedMultiTransformer)

@@ -206,3 +206,140 @@ class FusedEcMoe(nn.Layer):
         from .functional import fused_ec_moe
         return fused_ec_moe(x, self.gate(x), self.w1, self.b1,
                             self.w2, self.b2, act_type=self.act_type)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """Parity: incubate.nn.FusedDropoutAdd — dropout(x) + y in one
+    dispatched op (XLA fuses the mask-scale-add chain)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as F
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + y
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """Parity: incubate.nn.FusedBiasDropoutResidualLayerNorm —
+    LN(residual + dropout(x + bias)); one fused region under XLA
+    (reference kernel paddle/phi/kernels/fusion/
+    fused_bias_dropout_residual_layer_norm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, residual):
+        from ...nn import functional as F
+        h = F.dropout(x + self.linear_bias, p=self._dropout_rate,
+                      training=self.training)
+        return F.layer_norm(residual + h,
+                            normalized_shape=[x.shape[-1]],
+                            weight=self.ln_scale, bias=self.ln_bias,
+                            epsilon=self._epsilon)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Parity: incubate.nn.FusedMultiTransformer — the inference-side
+    stacked transformer (reference fused_multi_transformer kernel,
+    python/paddle/incubate/nn/layer/fused_transformer.py:1103): L
+    pre-LN decoder layers in one module, optional per-layer KV caches
+    for autoregressive decode.  Attention/FFN math runs the same fused
+    paths as the serving engine (flash attention + swiglu/relu MLP
+    fusion under XLA)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer supports the pre-LN form "
+                "(normalize_before=True), like the reference kernel")
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self._epsilon = epsilon
+        self._dropout_rate = dropout_rate
+        self._activation = activation
+        self.layers = nn.LayerList()
+        for _ in range(num_layers):
+            self.layers.append(_FusedMTLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation, epsilon))
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                **kw):
+        h = src
+        new_caches = []
+        offset = int(time_step) if time_step is not None else \
+            (caches[0][0].shape[1] if caches and caches[0][0] is not None
+             else 0)
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            h, c = layer(h, attn_mask, cache, offset)
+            new_caches.append(c)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+
+class _FusedMTLayer(nn.Layer):
+    def __init__(self, d, nh, dff, p, act, eps):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(d, epsilon=eps)
+        self.qkv = nn.Linear(d, 3 * d)
+        self.out_proj = nn.Linear(d, d)
+        self.ln2 = nn.LayerNorm(d, epsilon=eps)
+        self.ffn1 = nn.Linear(d, dff)
+        self.ffn2 = nn.Linear(dff, d)
+        self.nh = nh
+        self.act = act
+
+    def forward(self, x, attn_mask, cache, offset):
+        from ...nn import functional as F
+        B, S, D = x.shape
+        hd = D // self.nh
+        y = self.ln1(x)
+        qkv = self.qkv(y).reshape([B, S, 3, self.nh, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        new_cache = None
+        if cache is not None:
+            from ...ops.manipulation import concat
+            if cache[0] is not None and cache[0].shape[1] > 0:
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        out = self.out_proj(out.reshape([B, S, D]))
+        h = x + out
+        z = self.ln2(h)
+        a = getattr(F, self.act)(self.ffn1(z))
+        return h + self.ffn2(a), new_cache

@@ -17,7 +17,10 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...autograd.tape import no_grad
 
-__all__ = ["LookAhead", "ModelAverage"]
+from ...optimizer.lbfgs import LBFGS
+from . import functional
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS"]
 
 
 class LookAhead:

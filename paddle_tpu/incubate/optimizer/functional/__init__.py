@@ -1,0 +1,154 @@
+"""paddle.incubate.optimizer.functional (parity:
+python/paddle/incubate/optimizer/functional/ — minimize_bfgs /
+minimize_lbfgs: functional quasi-Newton minimization of an objective
+closure, returning (is_converge, num_func_calls, x, f, g))."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _wolfe_line_search(f_g, xk, pk, fk, gk, max_iters=50):
+    """Strong-Wolfe line search (same recipe the reference's
+    line_search_wolfe uses)."""
+    c1, c2 = 1e-4, 0.9
+    alpha, prev_alpha, prev_f = 1.0, 0.0, fk
+    calls = 0
+    lo, hi = 0.0, None
+    for _ in range(max_iters):
+        fx, gx = f_g(xk + alpha * pk)
+        calls += 1
+        if fx > fk + c1 * alpha * float(gk @ pk) or fx >= prev_f:
+            hi = alpha
+        else:
+            d = float(gx @ pk)
+            if abs(d) <= -c2 * float(gk @ pk):
+                return alpha, fx, gx, calls
+            if d >= 0:
+                hi = alpha
+            else:
+                lo = alpha
+        alpha = (lo + hi) / 2.0 if hi is not None else alpha * 2.0
+        prev_f = fx
+    fx, gx = f_g(xk + alpha * pk)
+    return alpha, fx, gx, calls + 1
+
+
+def _prep(objective_func, initial_position):
+    x0 = initial_position._value if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+
+    def f_g(x):
+        t = Tensor._from_value(x)
+        t.stop_gradient = False
+        y = objective_func(t)
+        from ....autograd.tape import grad as _grad
+        g = _grad([y], [t])
+        g = g[0] if isinstance(g, list) else g
+        return float(np.asarray(y._value)), jnp.asarray(g._value)
+
+    return x0.astype(jnp.float32), f_g
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn
+                  ="strong_wolfe", dtype="float32", name=None):
+    """Parity: functional/bfgs.py minimize_bfgs."""
+    x, f_g = _prep(objective_func, initial_position)
+    n = x.size
+    H = jnp.eye(n) if initial_inverse_hessian_estimate is None else \
+        jnp.asarray(initial_inverse_hessian_estimate._value
+                    if isinstance(initial_inverse_hessian_estimate,
+                                  Tensor)
+                    else initial_inverse_hessian_estimate)
+    fk, gk = f_g(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(gk))) < tolerance_grad:
+            converged = True
+            break
+        p = -(H @ gk)
+        alpha, fn, gn, c = _wolfe_line_search(f_g, x, p, fk, gk)
+        calls += c
+        s = alpha * p
+        y = gn - gk
+        sy = float(s @ y)
+        if abs(float(jnp.max(jnp.abs(s)))) < tolerance_change:
+            converged = True
+            x, fk, gk = x + s, fn, gn
+            break
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I = jnp.eye(n)
+            V = I - rho * jnp.outer(s, y)
+            H = V @ H @ V.T + rho * jnp.outer(s, s)
+        x, fk, gk = x + s, fn, gn
+    if float(jnp.max(jnp.abs(gk))) < tolerance_grad:
+        converged = True
+    return (Tensor._from_value(jnp.asarray(converged)),
+            Tensor._from_value(jnp.asarray(calls)),
+            Tensor._from_value(x),
+            Tensor._from_value(jnp.asarray(fk, jnp.float32)),
+            Tensor._from_value(gk))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7,
+                   tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", dtype="float32",
+                   name=None):
+    """Parity: functional/lbfgs.py minimize_lbfgs (two-loop recursion)."""
+    x, f_g = _prep(objective_func, initial_position)
+    fk, gk = f_g(x)
+    calls = 1
+    S, Y = [], []
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(gk))) < tolerance_grad:
+            converged = True
+            break
+        q = gk
+        alphas = []
+        for s, y in reversed(list(zip(S, Y))):
+            rho = 1.0 / float(s @ y)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        gamma = (float(S[-1] @ Y[-1]) / float(Y[-1] @ Y[-1])) \
+            if S else 1.0
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(y @ r)
+            r = r + (a - b) * s
+        p = -r
+        alpha, fn, gn, c = _wolfe_line_search(f_g, x, p, fk, gk)
+        calls += c
+        s = alpha * p
+        y = gn - gk
+        if abs(float(jnp.max(jnp.abs(s)))) < tolerance_change:
+            converged = True
+            x, fk, gk = x + s, fn, gn
+            break
+        if float(s @ y) > 1e-10:
+            S.append(s)
+            Y.append(y)
+            if len(S) > history_size:
+                S.pop(0)
+                Y.pop(0)
+        x, fk, gk = x + s, fn, gn
+    if float(jnp.max(jnp.abs(gk))) < tolerance_grad:
+        converged = True
+    return (Tensor._from_value(jnp.asarray(converged)),
+            Tensor._from_value(jnp.asarray(calls)),
+            Tensor._from_value(x),
+            Tensor._from_value(jnp.asarray(fk, jnp.float32)),
+            Tensor._from_value(gk))

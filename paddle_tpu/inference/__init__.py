@@ -189,3 +189,103 @@ def create_predictor(config: Config) -> Predictor:
 
 from .serving import (ContinuousBatchingEngine,      # noqa: E402,F401
                       GenerationRequest)
+
+
+# ---------------------------------------------------------------------------
+# enums + version/introspection tail (parity: paddle/inference/__init__.py)
+# ---------------------------------------------------------------------------
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    """Parity: paddle_infer.DataType."""
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+    FLOAT64 = 8
+
+
+class PlaceType(_enum.Enum):
+    """Parity: paddle_infer.PlaceType (the accelerator slot is the TPU
+    here)."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+    TPU = 4
+
+
+class PrecisionType(_enum.Enum):
+    """Parity: paddle_infer.PrecisionType."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+def get_version() -> str:
+    """Parity: paddle_infer.get_version."""
+    from .. import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+def get_num_bytes_of_data_type(dtype: "DataType") -> int:
+    """Parity: paddle_infer.get_num_bytes_of_data_type."""
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+             DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+             DataType.BOOL: 1, DataType.BFLOAT16: 2, DataType.FLOAT64: 8}
+    return sizes[DataType(dtype)]
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """Parity: inference/__init__.py _get_phi_kernel_name — maps a
+    legacy op name to its phi kernel name.  Our dispatch already uses
+    phi-style names, so this is mostly identity plus the historical
+    renames the reference carries."""
+    legacy = {"matmul_v2": "matmul", "elementwise_add": "add",
+              "elementwise_mul": "multiply", "elementwise_sub": "subtract",
+              "elementwise_div": "divide", "reduce_sum": "sum",
+              "reduce_mean": "mean", "fill_constant": "full"}
+    return legacy.get(op_name, op_name)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Parity: paddle.inference.convert_to_mixed_precision — rewrite a
+    saved model's weights to a mixed-precision copy.  Operates on our
+    jit.save artifacts: parameters are cast to the target dtype
+    (bf16 by default on TPU), io dtypes preserved when keep_io_types."""
+    import shutil
+    import numpy as np
+    from .. import framework_io
+    target = "bfloat16"
+    if mixed_precision in (PrecisionType.Half, "float16", "fp16"):
+        target = "float16"
+    state = framework_io.load(params_file)
+    black = set(black_list or ())
+
+    def cast(val):
+        a = np.asarray(getattr(val, "_value", val))
+        if np.issubdtype(a.dtype, np.floating):
+            import jax.numpy as jnp
+            return np.asarray(a, dtype=jnp.dtype(target))
+        return a
+
+    new_state = {k: (cast(v) if k not in black else v)
+                 for k, v in state.items()}
+    framework_io.save(new_state, mixed_params_file)
+    if model_file and mixed_model_file and model_file != mixed_model_file:
+        shutil.copy(model_file, mixed_model_file)
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "get_version",
+            "get_num_bytes_of_data_type", "_get_phi_kernel_name",
+            "convert_to_mixed_precision"]

@@ -22,3 +22,26 @@ from .convert_ops import bounded_loops
 __all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
            "bounded_loops",
            "not_to_static", "enable_to_static"]
+
+
+# -- translator logging knobs (parity: paddle/jit/dy2static/logging_utils
+# set_code_level/set_verbosity).  The SOT/AST translator honors these via
+# paddle_tpu.jit.sot logging.
+_TRANSLATOR_LOG = {"code_level": -1, "verbosity": 0}
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Parity: paddle.jit.set_code_level — log the transformed code at
+    ``level`` (our translator logs captured StatementIR instead of AST
+    stages)."""
+    _TRANSLATOR_LOG["code_level"] = int(level)
+    _TRANSLATOR_LOG["also_to_stdout"] = bool(also_to_stdout)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Parity: paddle.jit.set_verbosity."""
+    _TRANSLATOR_LOG["verbosity"] = int(level)
+    _TRANSLATOR_LOG["also_to_stdout"] = bool(also_to_stdout)
+
+
+__all__ += ["set_code_level", "set_verbosity"]

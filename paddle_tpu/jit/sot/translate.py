@@ -171,6 +171,7 @@ class SOTFunction:
             self._cache[key] = ("skip", str(e))
             return result
 
+        _log_captured_ir(ir)
         jit_fn = jax.jit(build_replay(ir))
         self._cache[key] = _CompiledEntry(jit_fn, ir, rec.env_guards)
         return result
@@ -221,3 +222,22 @@ def symbolic_translate(fn=None, **kwargs):
     if fn is None:
         return lambda f: SOTFunction(f, **kwargs)
     return SOTFunction(fn, **kwargs)
+
+
+def _log_captured_ir(ir):
+    """jit.set_code_level hook: log the captured StatementIR (our analog
+    of the reference translator's transformed-code logging,
+    paddle/jit/dy2static/logging_utils.py)."""
+    import logging
+    from .. import _TRANSLATOR_LOG
+    lvl = _TRANSLATOR_LOG.get("code_level", -1)
+    if lvl < 0:
+        return
+    lines = [f"StatementIR: {len(ir.statements)} statements, "
+             f"{len(ir.input_syms)} inputs, {len(ir.captures)} captures"]
+    for st in ir.statements:
+        lines.append(f"  {st.name}")
+    text = "\n".join(lines)
+    logging.getLogger("paddle_tpu.jit").log(max(int(lvl), 1), text)
+    if _TRANSLATOR_LOG.get("also_to_stdout"):
+        print(text)

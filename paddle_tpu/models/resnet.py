@@ -38,13 +38,13 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 base_width=64):
+                 base_width=64, groups=1):
         super().__init__()
-        width = int(planes * (base_width / 64.0))
+        width = int(planes * (base_width / 64.0)) * groups
         self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
         self.bn1 = BatchNorm2D(width)
         self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
-                            bias_attr=False)
+                            groups=groups, bias_attr=False)
         self.bn2 = BatchNorm2D(width)
         self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(planes * 4)
@@ -65,11 +65,13 @@ class ResNet(Layer):
     """Parity: paddle.vision.models.ResNet."""
 
     def __init__(self, block, depth_cfg: List[int], num_classes=1000,
-                 with_pool=True, in_channels=3, width=64):
+                 with_pool=True, in_channels=3, width=64, groups=1):
         super().__init__()
         self.inplanes = 64
-        # width=64*2 -> wide resnet (reference ResNet(..., width=128))
+        # width=64*2 -> wide resnet (reference ResNet(..., width=128));
+        # groups>1 + width=4/8 -> resnext (cardinality x bottleneck width)
         self._base_width = width
+        self._groups = groups
         self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
                             bias_attr=False)
         self.bn1 = BatchNorm2D(64)
@@ -94,11 +96,11 @@ class ResNet(Layer):
                        stride=stride, bias_attr=False),
                 BatchNorm2D(planes * block.expansion))
         if not issubclass(block, BottleneckBlock) \
-                and self._base_width != 64:
+                and (self._base_width != 64 or self._groups != 1):
             raise ValueError(
-                "width != 64 requires BottleneckBlock architectures "
-                "(resnet50+); BasicBlock has no width knob")
-        kw = {"base_width": self._base_width} \
+                "width != 64 / groups != 1 require BottleneckBlock "
+                "architectures (resnet50+); BasicBlock has no width knob")
+        kw = {"base_width": self._base_width, "groups": self._groups} \
             if issubclass(block, BottleneckBlock) else {}
         layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
@@ -145,3 +147,33 @@ def wide_resnet50_2(pretrained=False, **kw):
 def wide_resnet101_2(pretrained=False, **kw):
     """Parity: paddle.vision.models.wide_resnet101_2 (resnet.py:70)."""
     return ResNet(BottleneckBlock, [3, 4, 23, 3], width=64 * 2, **kw)
+
+
+def _resnext(depth_cfg, groups, width, **kw):
+    return ResNet(BottleneckBlock, depth_cfg, groups=groups, width=width,
+                  **kw)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    """Parity: paddle.vision.models.resnext50_32x4d (resnext.py)."""
+    return _resnext([3, 4, 6, 3], 32, 4, **kw)
+
+
+def resnext50_64x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 6, 3], 64, 4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 23, 3], 32, 4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return _resnext([3, 4, 23, 3], 64, 4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return _resnext([3, 8, 36, 3], 32, 4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return _resnext([3, 8, 36, 3], 64, 4, **kw)

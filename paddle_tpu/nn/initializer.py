@@ -169,3 +169,89 @@ class Orthogonal(Initializer):
 # paddle aliases
 constant_init = Constant
 normal_init = Normal
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: Bilinear, set_global_initializer, calculate_gain, LazyGuard
+# (parity: nn/initializer/__init__.py, initializer.py:118, lazy_init.py)
+# ---------------------------------------------------------------------------
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (parity: nn/initializer/Bilinear — the deconv upsampling recipe)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D weight")
+        # weight layout (C_out, C_in, H, W) like the reference
+        h, w = shape[2], shape[3]
+        f_h, f_w = (h + 1) // 2, (w + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:h, :w]
+        filt = (1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w))
+        weight = np.zeros(shape, np.float32)
+        rng = range(min(shape[0], shape[1]))
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                if shape[0] == shape[1] and i != j:
+                    continue
+                weight[i, j] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(weight, dtype)
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: nn/initializer/set_global_initializer — override the
+    framework-default weight/bias initializers used by
+    Layer.create_parameter when no explicit initializer is given.  Pass
+    None to restore the defaults."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_INIT["bias" if is_bias else "weight"]
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Parity: nn/initializer/initializer.py:118 calculate_gain."""
+    import math
+    if param is None:
+        param = 0.01
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "conv_transpose1d": 1.0,
+        "conv_transpose2d": 1.0, "conv_transpose3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + param ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(
+            f"nonlinearity {nonlinearity!r} has no recommended gain")
+    return recommended[nonlinearity]
+
+
+class LazyGuard:
+    """Parity: nn/initializer/lazy_init.py LazyGuard — a scope in which
+    Layer construction defers parameter materialization.  On this
+    runtime parameters are jax arrays materialized lazily by XLA's
+    async dispatch already, so the guard's observable contract (layers
+    constructible before data/device placement; params valid after the
+    scope) holds with immediate shapes."""
+
+    def __enter__(self):
+        _GLOBAL_INIT["_lazy"] = True
+        return self
+
+    def __exit__(self, *exc):
+        _GLOBAL_INIT.pop("_lazy", None)
+        return False

@@ -131,6 +131,11 @@ class Layer:
             init = getattr(attr, "initializer", None)
             name = getattr(attr, "name", None)
         if init is None:
+            # a user ParamAttr initializer wins; otherwise the global
+            # override (set_global_initializer) beats the layer's own
+            # default, matching reference precedence
+            init = I._global_initializer(is_bias)
+        if init is None:
             init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()

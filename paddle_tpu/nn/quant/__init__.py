@@ -1,0 +1,24 @@
+"""paddle.nn.quant (parity: python/paddle/nn/quant/__init__.py —
+Stub + the weight-only linear family; fake-quant layers in
+quant_layers.py).  Kernels live in ops/op_surface.py (int8 pack +
+dequant-into-matmul on the MXU)."""
+from ...ops.op_surface import (weight_only_linear, llm_int8_linear,
+                               weight_quantize, weight_dequantize)
+from . import quant_layers  # noqa: F401
+from ..layer_base import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub(Layer):
+    """Parity: nn/quant/stub.py Stub — a quantization insertion point:
+    identity in float graphs, replaced by a QuanterStub (observer) when
+    a QAT config quantizes the model."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x

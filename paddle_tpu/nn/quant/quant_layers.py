@@ -1,0 +1,278 @@
+"""Fake-quantization layers (parity: python/paddle/nn/quant/
+quant_layers.py — the QAT building blocks).
+
+All quantizers are symmetric-absmax with straight-through-estimator
+gradients, built on the shared ``_fake_quant`` op
+(paddle_tpu/quantization/__init__.py) so they fuse into the surrounding
+matmul under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer_base import Layer
+from ... import nn as _nn
+
+__all__ = ["FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+           "FakeQuantChannelWiseAbsMax", "QuantizedConv2D",
+           "QuantizedConv2DTranspose", "QuantizedLinear",
+           "MovingAverageAbsMaxScale", "MAOutputScaleLayer",
+           "FakeQuantMAOutputScaleLayer", "QuantStub",
+           "QuantizedRowParallelLinear",
+           "QuantizedColumnParallelLinear", "QuantizedMatmul"]
+
+
+def _fq(x, scale, bits):
+    from ...quantization import _fake_quant
+    return _fake_quant(x, scale, bit_length=bits)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor absmax fake quant (parity: quant_layers.FakeQuantAbsMax)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self.scale = None
+
+    def forward(self, x):
+        scale = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        self.scale = Tensor._from_value(scale)
+        return _fq(x, scale, self._quant_bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Moving-average absmax activation quant (parity:
+    quant_layers.FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._rate = moving_rate
+        self._quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+        if self.training:
+            self._scale = cur if self._scale is None else \
+                self._rate * self._scale + (1 - self._rate) * cur
+        scale = self._scale if self._scale is not None else cur
+        return _fq(x, scale, self._quant_bits)
+
+    @property
+    def scale(self):
+        return None if self._scale is None else \
+            Tensor._from_value(self._scale)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-channel weight quant (parity:
+    quant_layers.FakeQuantChannelWiseAbsMax)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", reduce_type=None):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = quant_axis
+        self.scale = None
+
+    def forward(self, w):
+        axes = tuple(i for i in range(w._value.ndim) if i != self._axis)
+        scale = jnp.max(jnp.abs(w._value), axis=axes).astype(jnp.float32)
+        self.scale = Tensor._from_value(scale)
+        shape = [1] * w._value.ndim
+        shape[self._axis] = -1
+        return _fq(w, scale.reshape(shape), self._quant_bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Track (not quantize) the moving-average output scale (parity:
+    quant_layers.MovingAverageAbsMaxScale)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32",
+                 reduce_type=None):
+        super().__init__()
+        self._rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(x._value)).astype(jnp.float32)
+            self._scale = cur if self._scale is None else \
+                self._rate * self._scale + (1 - self._rate) * cur
+        return x
+
+    @property
+    def scale(self):
+        return None if self._scale is None else \
+            Tensor._from_value(self._scale)
+
+
+class QuantStub(Layer):
+    """Input quant stub (parity: quant_layers QuantStub)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self._q = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                               quant_bits=quant_bits)
+
+    def forward(self, x):
+        return self._q(x)
+
+
+class _QuantizedWrap(Layer):
+    """Shared fake-quant wrapper: quantize activations (moving-average
+    absmax) and weights (channel-wise absmax) then run the float op."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quant_axis=0):
+        super().__init__()
+        self._inner = layer
+        self._act_q = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+        self._w_q = FakeQuantChannelWiseAbsMax(
+            quant_bits=weight_bits, quant_axis=weight_quant_axis)
+
+    def forward(self, x):
+        xq = self._act_q(x)
+        w = self._inner.weight
+        wq = self._w_q(w)
+        return self._apply(xq, wq)
+
+
+class QuantizedLinear(_QuantizedWrap):
+    """Parity: quant_layers.QuantizedLinear."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__(layer, weight_bits, activation_bits,
+                         moving_rate, weight_quant_axis=1)
+
+    def _apply(self, xq, wq):
+        from ...ops.linalg import matmul
+        out = matmul(xq, wq)
+        if self._inner.bias is not None:
+            out = out + self._inner.bias
+        return out
+
+
+class QuantizedConv2D(_QuantizedWrap):
+    """Parity: quant_layers.QuantizedConv2D."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__(layer, weight_bits, activation_bits,
+                         moving_rate, weight_quant_axis=0)
+
+    def _apply(self, xq, wq):
+        from ..functional import conv2d
+        c = self._inner
+        return conv2d(xq, wq, bias=c.bias, stride=c._stride,
+                      padding=c._padding, dilation=c._dilation,
+                      groups=c._groups, data_format=c._data_format)
+
+
+class QuantizedConv2DTranspose(_QuantizedWrap):
+    """Parity: quant_layers.QuantizedConv2DTranspose."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__(layer, weight_bits, activation_bits,
+                         moving_rate, weight_quant_axis=1)
+
+    def _apply(self, xq, wq):
+        from ..functional import conv2d_transpose
+        c = self._inner
+        return conv2d_transpose(
+            xq, wq, bias=c.bias, stride=c._stride, padding=c._padding,
+            dilation=c._dilation, groups=c._groups,
+            output_padding=getattr(c, "_output_padding", 0),
+            data_format=c._data_format)
+
+
+class QuantizedMatmul(Layer):
+    """Parity: quant_layers.QuantizedMatmul — fake-quant both operands
+    of a matmul."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__()
+        self._qx = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+        self._qy = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, x, y, transpose_x=False, transpose_y=False,
+                name=None):
+        from ...ops.linalg import matmul
+        return matmul(self._qx(x), self._qy(y), transpose_x=transpose_x,
+                      transpose_y=transpose_y)
+
+
+class MAOutputScaleLayer(Layer):
+    """Wrap a layer, tracking its output scale (parity:
+    quant_layers.MAOutputScaleLayer)."""
+
+    def __init__(self, layer, moving_rate=0.9, name=None,
+                 dtype="float32", reduce_type=None):
+        super().__init__()
+        self._layer = layer
+        self._ma = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, *args, **kwargs):
+        out = self._layer(*args, **kwargs)
+        return self._ma(out)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer, fake-quantizing its output with a moving-average
+    scale (parity: quant_layers.FakeQuantMAOutputScaleLayer)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, name=None, reduce_type=None):
+        super().__init__()
+        self._layer = layer
+        self._q = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, *args, **kwargs):
+        return self._q(self._layer(*args, **kwargs))
+
+
+class _QuantizedParallelLinear(Layer):
+    """Shared body for the tensor-parallel quantized linears: fake-quant
+    input + weight, delegate to the wrapped mp layer's collective
+    forward."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quant_axis=1):
+        super().__init__()
+        self._inner = layer
+        self._act_q = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+        self._w_q = FakeQuantChannelWiseAbsMax(
+            quant_bits=weight_bits, quant_axis=weight_quant_axis)
+
+    def forward(self, x):
+        xq = self._act_q(x)
+        w = self._inner.weight
+        saved = w._value
+        wq = self._w_q(w)
+        try:
+            w._value = wq._value
+            return self._inner(xq)
+        finally:
+            w._value = saved
+
+
+class QuantizedColumnParallelLinear(_QuantizedParallelLinear):
+    """Parity: quant_layers.QuantizedColumnParallelLinear."""
+
+
+class QuantizedRowParallelLinear(_QuantizedParallelLinear):
+    """Parity: quant_layers.QuantizedRowParallelLinear."""

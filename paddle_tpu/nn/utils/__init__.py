@@ -1,0 +1,190 @@
+"""paddle.nn.utils (parity: python/paddle/nn/utils/__init__.py —
+weight_norm/remove_weight_norm/spectral_norm reparametrizations via
+forward pre-hooks, parameter<->vector packing, grad clipping)."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer_base import Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(w, dim):
+    """L2 norm over all axes except ``dim`` (dim=None: full norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, layer: Layer, name: str, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        g = Parameter(_norm_except(w._value, dim), name=f"{w.name}_g")
+        v = Parameter(jnp.array(w._value), name=f"{w.name}_v")
+        layer._parameters.pop(name, None)
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+        # the composed weight becomes a plain attribute refreshed by the
+        # pre-hook so tape history flows g/v -> weight each forward
+        self._compose(layer)
+
+    def _compose(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        norm = v / (Tensor._from_value(_norm_except(v._value, self.dim))
+                    if v.stop_gradient else _norm_t(v, self.dim))
+        w = g * norm
+        object.__setattr__(layer, self.name, w)
+
+    def __call__(self, layer, inputs):
+        self._compose(layer)
+        return None
+
+
+def _norm_t(v: Tensor, dim):
+    """Differentiable norm-except-dim on Tensors."""
+    from ...core.dispatch import apply_op
+    return apply_op("weight_norm_norm",
+                    lambda x: _norm_except(x, dim), (v,))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Parity: nn.utils.weight_norm — reparametrize ``layer.name`` as
+    g * v/||v|| with g/v trainable; recomposed every forward."""
+    hook = _WeightNormHook(layer, name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = (hook, handle)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Parity: nn.utils.remove_weight_norm — bake the composed weight
+    back into a single parameter."""
+    hook, handle = layer._weight_norm_hook
+    hook._compose(layer)
+    w = getattr(layer, name)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    handle.remove()
+    layer.add_parameter(name, Parameter(w._value))
+    del layer._weight_norm_hook
+    return layer
+
+
+class _SpectralNormHook:
+    def __init__(self, layer, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+        w = getattr(layer, name)._value
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        key = jax.random.PRNGKey(0)
+        self._u = jax.random.normal(key, (wm.shape[0],))
+        self._u = self._u / (jnp.linalg.norm(self._u) + eps)
+
+    def __call__(self, layer, inputs):
+        from ...core.dispatch import apply_op
+        w_p = layer._parameters.get(self.name + "_orig")
+        w = w_p
+
+        def fn(wv):
+            wm = jnp.moveaxis(wv, self.dim, 0).reshape(wv.shape[self.dim],
+                                                       -1)
+            u = self._u
+            for _ in range(self.n):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + self.eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + self.eps)
+            sigma = u @ wm @ v
+            return wv / sigma
+
+        object.__setattr__(layer, self.name, apply_op(
+            "spectral_norm_reparam", fn, (w,)))
+        return None
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim=None):
+    """Parity: nn.utils.spectral_norm — divide the weight by its largest
+    singular value (power iteration) each forward."""
+    if dim is None:
+        dim = 1 if layer.__class__.__name__ in (
+            "Linear", "Embedding") else 0
+    hook = _SpectralNormHook(layer, name, n_power_iterations, eps, dim)
+    w = getattr(layer, name)
+    layer._parameters.pop(name, None)
+    layer.add_parameter(name + "_orig", w)
+    hook(layer, None)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Parity: nn.utils.parameters_to_vector."""
+    vals = [jnp.ravel(p._value) for p in parameters]
+    return Tensor._from_value(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    """Parity: nn.utils.vector_to_parameters (in-place set_value)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        p._value = v[off:off + n].reshape(p._value.shape) \
+            .astype(p._value.dtype)
+        off += n
+    if off != v.shape[0]:
+        raise ValueError(
+            f"vector has {v.shape[0]} elements but parameters take {off}")
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Parity: nn.utils.clip_grad_norm_ — in-place global-norm clip of
+    ``.grad``; returns the total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters
+             if not p.stop_gradient and p._grad is not None]
+    if not grads:
+        return Tensor._from_value(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "the total norm for gradients is non-finite")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if not p.stop_gradient and p._grad is not None:
+            p._grad = (p._grad * scale).astype(p._grad.dtype)
+    return Tensor._from_value(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Parity: nn.utils.clip_grad_value_ — elementwise grad clamp."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = abs(float(clip_value))
+    for p in parameters:
+        if not p.stop_gradient and p._grad is not None:
+            p._grad = jnp.clip(p._grad, -cv, cv)

@@ -322,3 +322,31 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp * pct
+
+
+class LinearLR(LRScheduler):
+    """Linear warm/anneal between start_factor*lr and end_factor*lr over
+    total_steps (parity: paddle.optimizer.lr.LinearLR, lr.py:2252)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor must be in (0, 1]")
+        if not 0 <= end_factor <= 1:
+            raise ValueError("end_factor must be in [0, 1]")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch == 0:
+            return self.base_lr * self.start_factor
+        if self.last_epoch > self.total_steps:
+            return self.last_lr
+        base_lr = self.total_steps * self.start_factor
+        cur = self.end_factor - self.start_factor
+        return self.last_lr * (
+            1.0 + cur / (base_lr + (self.last_epoch - 1) * cur))

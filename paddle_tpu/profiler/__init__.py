@@ -158,6 +158,11 @@ def export_chrome_tracing(dir_name: str, worker_name: str = None):
 
 
 def load_profiler_result(path: str):
+    if path.endswith(".pb"):
+        from ..onnx.proto import decode
+        with open(path, "rb") as f:
+            fields = decode(f.read())
+        return json.loads(fields[2][0].decode())
     with open(path) as f:
         return json.load(f)
 
@@ -322,3 +327,55 @@ class Profiler:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class SortedKeys(enum.Enum):
+    """Summary-table sort keys (parity: profiler_statistic.py SortedKeys;
+    the GPU* keys order by device-span time here — TPU device spans)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Summary views (parity: profiler.py SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: str = None):
+    """Parity: paddle.profiler.export_protobuf — an on_trace_ready
+    callback writing a protobuf file.  Payload schema (proto wire
+    format, written with the in-tree writer): field 1 = version string,
+    field 2 = chrome-trace JSON bytes; ``load_profiler_result`` on the
+    .pb path round-trips it."""
+    def handler(prof: "Profiler"):
+        from ..onnx.proto import fs, fb
+        os.makedirs(dir_name, exist_ok=True)
+        fname = worker_name or f"paddle_tpu_{os.getpid()}"
+        path = os.path.join(dir_name, f"{fname}_{prof._round}.pb")
+        tmp_json = path + ".json.tmp"
+        prof._export_chrome(tmp_json)
+        with open(tmp_json, "rb") as f:
+            payload = f.read()
+        os.remove(tmp_json)
+        with open(path, "wb") as f:
+            f.write(fs(1, "paddle_tpu-profiler-v1") + fb(2, payload))
+        prof._last_path = path
+        return path
+    return handler
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf"]

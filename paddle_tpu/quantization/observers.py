@@ -1,0 +1,5 @@
+"""Parity import path: paddle.quantization.observers (__all__ =
+[AbsmaxObserver]); implementation in the package __init__."""
+from . import AbsmaxObserver
+
+__all__ = ["AbsmaxObserver"]

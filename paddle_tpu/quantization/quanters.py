@@ -1,0 +1,6 @@
+"""Parity import path: paddle.quantization.quanters (__all__ =
+[FakeQuanterWithAbsMaxObserver]); implementation in the package
+__init__."""
+from . import FakeQuanterWithAbsMaxObserver
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

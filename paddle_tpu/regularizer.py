@@ -1,0 +1,5 @@
+"""paddle.regularizer (parity: python/paddle/regularizer.py __all__ =
+[L1Decay, L2Decay]; implementations shared with paddle.optimizer)."""
+from .optimizer import L1Decay, L2Decay
+
+__all__ = ["L1Decay", "L2Decay"]

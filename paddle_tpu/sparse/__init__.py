@@ -685,3 +685,78 @@ __all__ += ["asin", "asinh", "atan", "atanh", "acos", "acosh", "sinh",
             "tan", "leaky_relu", "relu6", "isnan", "scale",
             "divide_scalar", "full_like", "sum", "reshape", "slice",
             "mv", "addmm"]
+
+
+# ---------------------------------------------------------------------------
+# round-5 package tail (parity: sparse/__init__ deg2rad/rad2deg/
+# is_same_shape/pca_lowrank; sparse/creation.py module path)
+# ---------------------------------------------------------------------------
+def deg2rad(x, name=None):
+    """Parity: paddle.sparse.deg2rad (values-wise unary)."""
+    return _value_op_public(x, "sparse_deg2rad",
+                            lambda v: v * (jnp.pi / 180.0))
+
+
+def rad2deg(x, name=None):
+    """Parity: paddle.sparse.rad2deg."""
+    return _value_op_public(x, "sparse_rad2deg",
+                            lambda v: v * (180.0 / jnp.pi))
+
+
+def _value_op_public(x, name, fn):
+    from .nn import functional as _  # noqa: F401 (package init)
+    return _value_op(x, name, fn)
+
+
+def is_same_shape(x, y) -> bool:
+    """Parity: paddle.sparse.is_same_shape."""
+    return list(x.shape) == list(y.shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Parity: paddle.sparse.pca_lowrank — randomized low-rank PCA of a
+    sparse matrix: returns (U, S, V) with X ~ U diag(S) V^T.  The
+    randomized range finder (Halko et al.) runs its matmuls through the
+    sparse kernel so X never densifies."""
+    from ..ops import random as _random
+    import jax
+    m, n = int(x.shape[0]), int(x.shape[1])
+    if q is None:
+        q = min(6, m, n)
+    if not (0 <= q <= min(m, n)):
+        raise ValueError(f"q={q} out of range for shape {x.shape}")
+    # Halko-style oversampling: project with extra columns, truncate to q
+    q_eff = min(q + 10, m, n)
+    # materialize through matmuls only: Y = X @ G  (sparse @ dense)
+    key = _random.next_key()
+    G = jax.random.normal(key, (n, q_eff), jnp.float32)
+    c = None
+    if center:
+        idx = np.asarray(x._bcoo.indices)
+        colsum = np.zeros(n, np.float32)
+        np.add.at(colsum, idx[:, 1], np.asarray(x._bcoo.data,
+                                                np.float32))
+        c = jnp.asarray(colsum / m)          # column means
+    Y = matmul(x, Tensor._from_value(G))._value
+    if c is not None:
+        Y = Y - jnp.outer(jnp.ones(m), c @ G)
+    Q, _r = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = matmul(transpose(x, [1, 0]), Tensor._from_value(Q))._value
+        if c is not None:
+            Z = Z - jnp.outer(c, jnp.ones(m) @ Q)
+        Qz, _r = jnp.linalg.qr(Z)
+        Y = matmul(x, Tensor._from_value(Qz))._value
+        if c is not None:
+            Y = Y - jnp.outer(jnp.ones(m), c @ Qz)
+        Q, _r = jnp.linalg.qr(Y)
+    B = matmul(transpose(x, [1, 0]), Tensor._from_value(Q))._value
+    if c is not None:
+        B = B - jnp.outer(c, jnp.ones(m) @ Q)
+    Ub, S, Vt = jnp.linalg.svd(B.T, full_matrices=False)
+    U = Q @ Ub
+    return (Tensor._from_value(U[:, :q]), Tensor._from_value(S[:q]),
+            Tensor._from_value(Vt[:q].T))
+
+
+__all__ += ["deg2rad", "rad2deg", "is_same_shape", "pca_lowrank"]

@@ -1,0 +1,6 @@
+"""Parity import path: paddle.sparse.creation (__all__ =
+[sparse_coo_tensor, sparse_csr_tensor]); implementations in the package
+__init__."""
+from . import sparse_coo_tensor, sparse_csr_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor"]

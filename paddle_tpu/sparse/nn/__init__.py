@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
@@ -18,8 +19,9 @@ from ...nn import initializer as I
 from . import functional as F
 from .functional import conv3d, subm_conv3d, max_pool3d, attention
 
-__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D", "ReLU",
-           "ReLU6", "LeakyReLU", "Softmax", "functional"]
+__all__ = ["Conv3D", "SubmConv3D", "Conv2D", "SubmConv2D", "BatchNorm",
+           "SyncBatchNorm", "MaxPool3D", "ReLU", "ReLU6", "LeakyReLU",
+           "Softmax", "functional"]
 functional = F
 
 
@@ -170,3 +172,115 @@ class Softmax(Layer):
                                    x._bcoo.shape)
 
     __call__ = forward
+
+
+class _Conv2D(Layer):
+    """Shared 2-D sparse conv body (lifts onto the 3-D rulebook)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, key=None,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__()
+        ks = F._pair(kernel_size)
+        self._subm = subm
+        self._key = key
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [*ks, in_channels // groups, out_channels], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        subm=self._subm, key=self._key,
+                        data_format=self._data_format)
+
+
+class Conv2D(_Conv2D):
+    """Parity: paddle.sparse.nn.Conv2D (layer/conv.py:570)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         padding_mode=padding_mode,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format)
+
+
+class SubmConv2D(_Conv2D):
+    """Parity: paddle.sparse.nn.SubmConv2D — submanifold 2-D conv."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, key=key,
+                         padding_mode=padding_mode,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Parity: paddle.sparse.nn.SyncBatchNorm — BatchNorm whose batch
+    statistics are averaged across the data-parallel group.  Under a
+    jitted sharded step GSPMD inserts the cross-replica mean reduction
+    automatically; in eager multi-process mode the values-stat moments
+    ride an explicit all_reduce."""
+
+    def forward(self, x):
+        from ...distributed.env import get_world_size
+        if get_world_size() <= 1:
+            return super().forward(x)
+        from ...core.dispatch import apply_op
+        from ...distributed.collective import all_reduce
+        from .. import _values_tensor, _from_values_tensor
+        from ...core.tensor import Tensor as _T
+        vals = _values_tensor(x)
+        n = vals._value.shape[0]
+        # cross-rank moments of the nnz values (per channel)
+        s1 = _T(np.asarray(
+            jnp.sum(vals._value, axis=0, dtype=jnp.float32)))
+        s2 = _T(np.asarray(
+            jnp.sum(jnp.square(vals._value.astype(jnp.float32)), axis=0)))
+        cnt = _T(np.float32(n))
+        for t in (s1, s2, cnt):
+            all_reduce(t)
+        mean = s1._value / cnt._value
+        var = s2._value / cnt._value - jnp.square(mean)
+        bn = self._bn
+        eps = bn._epsilon
+        w = bn.weight._value if bn.weight is not None else 1.0
+        b = bn.bias._value if bn.bias is not None else 0.0
+
+        def fn(v):
+            return ((v - mean) * jax.lax.rsqrt(var + eps) * w + b)                 .astype(v.dtype)
+
+        out_t = apply_op("sparse_sync_batch_norm", fn, (vals,))
+        return _from_values_tensor(x, out_t, x._bcoo.indices,
+                                   x._bcoo.shape)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Parity: SyncBatchNorm.convert_sync_batchnorm — recursively
+        swap BatchNorm sublayers for SyncBatchNorm."""
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            out = SyncBatchNorm.__new__(SyncBatchNorm)
+            out.__dict__.update(layer.__dict__)
+            return out
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer

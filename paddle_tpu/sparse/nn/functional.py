@@ -400,3 +400,96 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         return out.reshape(qv.shape)
 
     return apply_op("sparse_fused_attention", compute, (q, k, v))
+
+
+# ---------------------------------------------------------------------------
+# 2-D variants (parity: python/paddle/sparse/nn/functional/conv.py conv2d/
+# subm_conv2d): lifted onto the 3-D rulebook with a unit depth dim, so
+# they share the cache/bucketing machinery above.
+# ---------------------------------------------------------------------------
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 2
+
+
+def _lift_2d(x):
+    """NHWC sparse (3 sparse dims) -> NDHWC with D=1."""
+    from .. import SparseCooTensor, _values_tensor
+    import jax.numpy as jnp
+    idx = np.asarray(x._bcoo.indices, np.int64)     # [nnz, 3] (b, h, w)
+    if idx.shape[1] != 3:
+        raise ValueError(
+            "sparse conv2d expects an NHWC tensor with 3 sparse dims "
+            "(batch, h, w) and a dense channel dim")
+    lifted_idx = np.concatenate(
+        [idx[:, :1], np.zeros((idx.shape[0], 1), np.int64), idx[:, 1:]],
+        axis=1)
+    shape = x.shape
+    from jax.experimental import sparse as jsparse
+    lifted = SparseCooTensor(jsparse.BCOO(
+        (x._bcoo.data, jnp.asarray(lifted_idx, jnp.int32)),
+        shape=(shape[0], 1, shape[1], shape[2], shape[3])))
+    t = getattr(x, "_values_t", None)
+    if t is not None:
+        lifted._values_t = t
+    return lifted
+
+
+def _drop_depth(y):
+    from .. import SparseCooTensor, _from_values_tensor, _values_tensor
+    import jax.numpy as jnp
+    idx = np.asarray(y._bcoo.indices, np.int64)     # [nnz, 4]
+    flat = np.concatenate([idx[:, :1], idx[:, 2:]], axis=1)
+    s = y.shape
+    return _from_values_tensor(y, _values_tensor(y),
+                               jnp.asarray(flat, jnp.int32),
+                               (s[0], s[2], s[3], s[4]))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, subm=False, key=None, data_format="NHWC", name=None):
+    """Parity: paddle.sparse.nn.functional.conv2d (weight [kh, kw, ci,
+    co])."""
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d supports NHWC only")
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    import jax.numpy as jnp
+    w3 = Tensor._from_value(w._value[None])   # [1, kh, kw, ci, co]
+    w3.stop_gradient = w.stop_gradient
+    if not w.stop_gradient:
+        from ...core.dispatch import apply_op
+        w3 = apply_op("sparse_conv2d_lift_w",
+                      lambda v: v[None], (w,))
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    out = conv3d(_lift_2d(x), w3, bias, (1,) + st, (0,) + pd,
+                 (1,) + dl, groups, subm=subm, key=key)
+    return _drop_depth(out)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, key=None, data_format="NHWC", name=None):
+    return conv2d(x, weight, bias, stride, padding, dilation, groups,
+                  subm=True, key=key, data_format=data_format)
+
+
+# activation re-exports (parity: sparse/nn/functional/__init__.py lists
+# relu/relu6/leaky_relu/softmax alongside the conv family)
+def relu(x, name=None):
+    from .. import relu as _impl
+    return _impl(x, name)
+
+
+def relu6(x, name=None):
+    from .. import relu6 as _impl
+    return _impl(x, name)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from .. import leaky_relu as _impl
+    return _impl(x, negative_slope, name)
+
+
+def softmax(x, axis=-1, name=None):
+    from . import Softmax
+    return Softmax(axis)(x)

@@ -81,3 +81,39 @@ def run_check():
     assert float(np.asarray(y._value)) == 8.0
     n = paddle.device.device_count() if paddle.device else 1
     print(f"PaddleTPU works! devices: {n}")
+
+
+def require_version(min_version: str, max_version=None):
+    """Parity: paddle.utils.require_version — check the installed
+    framework version against [min_version, max_version].  Raises
+    ValueError/TypeError exactly like the reference on malformed input
+    or unsatisfied bounds."""
+    if not isinstance(min_version, str):
+        raise TypeError(f"min_version must be str, got {type(min_version)}")
+    if max_version is not None and not isinstance(max_version, str):
+        raise TypeError(f"max_version must be str or None, "
+                        f"got {type(max_version)}")
+    import re as _re
+    ver_pat = _re.compile(r"^\d+(\.\d+){0,3}$")
+    if not ver_pat.match(min_version):
+        raise ValueError(f"invalid min_version {min_version!r}")
+    if max_version is not None and not ver_pat.match(max_version):
+        raise ValueError(f"invalid max_version {max_version!r}")
+    from .. import __version__
+
+    def parts(v):
+        return [int(x) for x in v.split(".")] + [0] * (4 - len(v.split(".")))
+
+    cur = parts(__version__.split("+")[0].split("rc")[0])
+    if parts(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parts(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+from . import dlpack          # noqa: E402,F401
+from . import download        # noqa: E402,F401
+__all__ += ["require_version", "dlpack", "download"]

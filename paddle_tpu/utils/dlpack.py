@@ -1,0 +1,42 @@
+"""DLPack interop (parity: python/paddle/utils/dlpack.py — to_dlpack /
+from_dlpack).  TPU-native: jax arrays speak dlpack directly; CPU-backed
+arrays exchange zero-copy with torch/numpy."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule."""
+    v = x._value if isinstance(x, Tensor) else x
+    return v.__dlpack__()
+
+
+class _CapsuleHolder:
+    """Adapter giving a raw PyCapsule the array-API dlpack protocol
+    (modern jax/numpy consume only objects, not bare capsules)."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)          # kDLCPU; host staging is the exchange path
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule (or any object with __dlpack__) -> Tensor."""
+    import numpy as np
+    import jax.numpy as jnp
+    if not hasattr(dlpack, "__dlpack__"):
+        dlpack = _CapsuleHolder(dlpack)   # reference API passes capsules
+    try:
+        return Tensor._from_value(jnp.from_dlpack(dlpack))
+    except (TypeError, RuntimeError):
+        # jax rejects some producers (e.g. unaligned/readonly): stage
+        # through numpy's dlpack import instead
+        return Tensor._from_value(jnp.asarray(np.from_dlpack(dlpack)))

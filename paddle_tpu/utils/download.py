@@ -1,0 +1,28 @@
+"""Parity: python/paddle/utils/download.py (get_weights_path_from_url).
+This environment has no egress; cache hits (and file:// URLs) work, a
+genuine network fetch raises with a clear message."""
+from __future__ import annotations
+
+import os
+import shutil
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    """Resolve ``url`` to a local weights path via the cache directory
+    (reference keeps the same layout under ~/.cache/paddle/hapi)."""
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    fname = os.path.basename(url.split("?")[0])
+    target = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(target):
+        return target
+    if url.startswith("file://"):
+        shutil.copy(url[len("file://"):], target)
+        return target
+    raise RuntimeError(
+        f"weights {fname!r} not in cache ({WEIGHTS_HOME}) and this "
+        "environment has no network egress; place the file there "
+        "manually or pass a file:// URL")

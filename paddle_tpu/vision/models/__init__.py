@@ -10,7 +10,10 @@ from __future__ import annotations
 from ... import nn
 from ...models.resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
                               resnet34, resnet50, resnet101, resnet152,
-                              wide_resnet50_2, wide_resnet101_2)
+                              wide_resnet50_2, wide_resnet101_2,
+                              resnext50_32x4d, resnext50_64x4d,
+                              resnext101_32x4d, resnext101_64x4d,
+                              resnext152_32x4d, resnext152_64x4d)
 from .extra import (SqueezeNet, squeezenet1_0, squeezenet1_1,
                     MobileNetV1, mobilenet_v1,
                     MobileNetV3Small, MobileNetV3Large,
@@ -35,7 +38,9 @@ __all__ = ["LeNet", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16",
            "shufflenet_v2_swish", "DenseNet", "densenet121",
            "densenet161", "densenet169", "densenet201", "densenet264",
            "InceptionV3", "inception_v3", "GoogLeNet", "googlenet",
-           "wide_resnet50_2", "wide_resnet101_2"]
+           "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
+           "resnext50_64x4d", "resnext101_32x4d", "resnext101_64x4d",
+           "resnext152_32x4d", "resnext152_64x4d"]
 
 
 from .extra import _no_pretrained  # single definition, shared

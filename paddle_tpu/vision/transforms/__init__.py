@@ -18,7 +18,8 @@ __all__ = ["BaseTransform", "Compose", "Resize", "RandomResizedCrop",
            "Transpose", "Normalize", "BrightnessTransform",
            "ContrastTransform", "SaturationTransform", "HueTransform",
            "ColorJitter", "RandomCrop", "Pad", "RandomRotation",
-           "Grayscale", "ToTensor", "RandomErasing"] + list(F.__all__)
+           "Grayscale", "ToTensor", "RandomErasing", "RandomAffine",
+           "RandomPerspective"] + list(F.__all__)
 
 
 class Compose:
@@ -352,3 +353,96 @@ class RandomErasing(BaseTransform):
                 return F.erase(img, top, left, eh, ew, self.value,
                                self.inplace)
         return img
+
+
+class RandomAffine(BaseTransform):
+    """Parity: paddle.vision.transforms.RandomAffine — random rotation/
+    translation/scale/shear within the given ranges."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(float(degrees)), abs(float(degrees)))
+        self.degrees = tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        if isinstance(shear, (int, float)):
+            shear = (-abs(float(shear)), abs(float(shear)))
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _get_param(self, img_size):
+        import random
+        w, h = img_size
+        angle = random.uniform(*self.degrees)
+        if self.translate is not None:
+            max_dx = self.translate[0] * w
+            max_dy = self.translate[1] * h
+            translate = (random.uniform(-max_dx, max_dx),
+                         random.uniform(-max_dy, max_dy))
+        else:
+            translate = (0.0, 0.0)
+        scale = random.uniform(*self.scale) if self.scale is not None             else 1.0
+        if self.shear is not None:
+            sh = list(self.shear)
+            shear_x = random.uniform(sh[0], sh[1])
+            shear_y = random.uniform(sh[2], sh[3]) if len(sh) == 4                 else 0.0
+            shear = (shear_x, shear_y)
+        else:
+            shear = (0.0, 0.0)
+        return angle, translate, scale, shear
+
+    def _apply_image(self, img):
+        size = img.size if F._is_pil(img) else             (np.asarray(img).shape[-2], np.asarray(img).shape[-3])             if not F._is_pil(img) else None
+        if F._is_pil(img):
+            w, h = img.size
+        else:
+            a = np.asarray(img._value if F._is_tensor(img) else img)
+            h, w = (a.shape[-2], a.shape[-1]) if a.shape[0] in (1, 3)                 and a.ndim == 3 and F._is_tensor(img) else                 (a.shape[0], a.shape[1])
+        angle, translate, scale, shear = self._get_param((w, h))
+        return F.affine(img, angle, translate, scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Parity: paddle.vision.transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _get_param(self, width, height):
+        import random
+        d = self.distortion_scale
+        half_w, half_h = width // 2, height // 2
+        tl = (random.randint(0, int(d * half_w)),
+              random.randint(0, int(d * half_h)))
+        tr = (random.randint(width - int(d * half_w) - 1, width - 1),
+              random.randint(0, int(d * half_h)))
+        br = (random.randint(width - int(d * half_w) - 1, width - 1),
+              random.randint(height - int(d * half_h) - 1, height - 1))
+        bl = (random.randint(0, int(d * half_w)),
+              random.randint(height - int(d * half_h) - 1, height - 1))
+        start = [(0, 0), (width - 1, 0), (width - 1, height - 1),
+                 (0, height - 1)]
+        return start, [tl, tr, br, bl]
+
+    def _apply_image(self, img):
+        import random
+        if random.random() >= self.prob:
+            return img
+        if F._is_pil(img):
+            w, h = img.size
+        else:
+            a = np.asarray(img._value if F._is_tensor(img) else img)
+            h, w = (a.shape[-2], a.shape[-1]) if F._is_tensor(img)                 and a.ndim == 3 and a.shape[0] in (1, 3) else                 (a.shape[0], a.shape[1])
+        start, end = self._get_param(w, h)
+        return F.perspective(img, start, end, self.interpolation,
+                             self.fill)

@@ -19,7 +19,7 @@ from ...core.tensor import Tensor
 __all__ = ["to_tensor", "hflip", "vflip", "resize", "pad", "crop",
            "center_crop", "adjust_brightness", "adjust_contrast",
            "adjust_hue", "adjust_saturation", "rotate", "to_grayscale",
-           "normalize", "erase"]
+           "normalize", "erase", "affine", "perspective"]
 
 _PIL_MODES = {
     "nearest": Image.NEAREST,
@@ -285,3 +285,81 @@ def erase(img, i, j, h, w, v, inplace=False):
     if pil_in:
         return Image.fromarray(arr)
     return Tensor(arr) if tensor_in else arr
+
+
+def _inverse_affine_matrix(center, angle, translate, scale, shear):
+    """Inverse affine coefficients for PIL Image.transform (output ->
+    input mapping), the standard RSS decomposition
+    (reference transforms/functional.py affine; same math as the
+    C++ affine_grid path)."""
+    import math
+    rot = math.radians(angle)
+    sx = math.radians(shear[0])
+    sy = math.radians(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # RSS = rotation * shear * scale
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    # inverse of scale * RSS
+    matrix = [d, -b, 0.0, -c, a, 0.0]
+    matrix = [m / scale for m in matrix]
+    # inverse translation: -C - T
+    matrix[2] += matrix[0] * (-cx - tx) + matrix[1] * (-cy - ty)
+    matrix[5] += matrix[3] * (-cx - tx) + matrix[4] * (-cy - ty)
+    # recenter
+    matrix[2] += cx
+    matrix[5] += cy
+    return matrix
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Parity: paddle.vision.transforms.affine — rotation + translation
+    + isotropic scale + shear about ``center``."""
+    if isinstance(shear, (int, float)):
+        shear = [float(shear), 0.0]
+    shear = list(shear) + [0.0] * (2 - len(list(shear)))
+    arr, back = (None, None) if _is_pil(img) else _as_numpy(img)
+    pil = img if _is_pil(img) else Image.fromarray(np.asarray(arr))
+    w, h = pil.size
+    if center is None:
+        center = (w * 0.5, h * 0.5)
+    coeffs = _inverse_affine_matrix(center, angle, translate, scale,
+                                    shear)
+    out = pil.transform((w, h), Image.AFFINE, coeffs,
+                        _PIL_MODES[interpolation], fillcolor=fill)
+    if _is_pil(img):
+        return out
+    return _restore(np.asarray(out), back)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints -> startpoints
+    (PIL wants the output->input direction)."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b += [sx, sy]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+    return coeffs.tolist()
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Parity: paddle.vision.transforms.perspective — projective warp
+    taking ``startpoints`` (4 corners) to ``endpoints``."""
+    arr, back = (None, None) if _is_pil(img) else _as_numpy(img)
+    pil = img if _is_pil(img) else Image.fromarray(np.asarray(arr))
+    w, h = pil.size
+    coeffs = _perspective_coeffs(startpoints, endpoints)
+    out = pil.transform((w, h), Image.PERSPECTIVE, coeffs,
+                        _PIL_MODES[interpolation], fillcolor=fill)
+    if _is_pil(img):
+        return out
+    return _restore(np.asarray(out), back)
