@@ -2,8 +2,13 @@
 mel/fbank/dct helpers, features/layers.py Spectrogram/MelSpectrogram/
 LogMelSpectrogram/MFCC, window functions)."""
 from . import functional, features
+from . import backends
+from . import datasets
+from .backends.wave_backend import load, save, info
 from .features import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
                        MFCC)
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+__all__ = ["functional", "features", "datasets", "backends",
+           "load", "info", "save",
+           "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

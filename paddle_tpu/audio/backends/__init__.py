@@ -1,0 +1,37 @@
+"""paddle.audio.backends (parity: python/paddle/audio/backends/
+init_backend.py — get_current_backend / list_available_backends /
+set_backend).  The in-tree wave backend is always available; soundfile
+registers if its wheel is importable (it is not baked into this
+environment)."""
+from . import wave_backend
+from .wave_backend import info, load, save, AudioInfo
+
+__all__ = ["get_current_backend", "list_available_backends",
+           "set_backend"]
+
+_BACKEND = ["wave_backend"]
+
+
+def list_available_backends():
+    """Parity: init_backend.list_available_backends."""
+    backends = ["wave_backend"]
+    try:
+        import soundfile  # noqa: F401
+        backends.append("soundfile")
+    except ImportError:
+        pass
+    return backends
+
+
+def get_current_backend() -> str:
+    """Parity: init_backend.get_current_backend."""
+    return _BACKEND[0]
+
+
+def set_backend(backend_name: str):
+    """Parity: init_backend.set_backend."""
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} is not available "
+            f"(available: {list_available_backends()})")
+    _BACKEND[0] = backend_name

@@ -1,0 +1,101 @@
+"""In-tree WAV codec backend (parity: python/paddle/audio/backends/
+wave_backend.py — the reference's default backend is also built on the
+stdlib ``wave`` module, PCM16)."""
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+
+class AudioInfo:
+    """Parity: backends/backend.AudioInfo."""
+
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_frames={self.num_frames}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")
+
+
+def info(filepath: str) -> AudioInfo:
+    """Parity: paddle.audio.info."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8,
+                         "PCM_S")
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Parity: paddle.audio.load — PCM16 WAV; normalize=True returns
+    float32 in (-1, 1), else raw int16-valued float32."""
+    file_obj = filepath if hasattr(filepath, "read") \
+        else open(filepath, "rb")
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        file_obj.seek(0)
+        file_obj.close()
+        raise NotImplementedError(
+            "wave backend supports PCM16 WAV files only")
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    frames = f.getnframes()
+    width = f.getsampwidth()
+    content = f.readframes(frames)
+    file_obj.close()
+    if width != 2:
+        raise NotImplementedError(
+            f"wave backend reads PCM16 (2-byte) samples; file has "
+            f"{width}-byte samples")
+    audio = np.frombuffer(content, dtype=np.int16).astype(np.float32)
+    if normalize:
+        audio = audio / (2 ** 15)
+    waveform = audio.reshape(frames, channels)
+    if num_frames != -1:
+        waveform = waveform[frame_offset:frame_offset + num_frames]
+    elif frame_offset:
+        waveform = waveform[frame_offset:]
+    if channels_first:
+        waveform = waveform.T
+    return Tensor(np.ascontiguousarray(waveform)), sample_rate
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: Optional[str] = None,
+         bits_per_sample: Optional[int] = 16):
+    """Parity: paddle.audio.save — PCM16 WAV."""
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if arr.ndim != 2:
+        raise AssertionError("Expected 2D tensor")
+    if bits_per_sample not in (None, 16) or encoding not in (None,
+                                                             "PCM_S"):
+        raise ValueError("wave backend saves PCM16 only")
+    if channels_first:
+        arr = arr.T                      # -> (time, channels)
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0 - 1.0 / (2 ** 15))
+        arr = (arr * (2 ** 15)).astype(np.int16)
+    else:
+        arr = arr.astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,133 @@
+"""paddle.audio.datasets (parity: python/paddle/audio/datasets/ — ESC50,
+TESS).  No network egress in this environment: pass ``archive_dir``
+pointing at the extracted dataset (same directory layout the reference
+downloads); feature modes (raw/spectrogram/melspectrogram/logmelspectrogram/
+mfcc) match the reference's feature plumbing."""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ...io import Dataset
+from ..backends.wave_backend import load as _load
+
+__all__ = ["ESC50", "TESS"]
+
+
+class _AudioClassificationDataset(Dataset):
+    feat_types = ("raw", "spectrogram", "melspectrogram",
+                  "logmelspectrogram", "mfcc")
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = 16000,
+                 **kwargs):
+        if feat_type not in self.feat_types:
+            raise RuntimeError(
+                f"feat_type {feat_type!r} not in {self.feat_types}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.feat_config = kwargs
+        self.sample_rate = sample_rate
+
+    def _convert_to_record(self, idx):
+        waveform, sr = _load(self.files[idx], channels_first=False)
+        wav = np.asarray(waveform._value)[:, 0]
+        if self.feat_type == "raw":
+            return wav.astype(np.float32), self.labels[idx]
+        from .. import features as _feat
+        from ...core.tensor import Tensor
+        name = {"spectrogram": "Spectrogram",
+                "melspectrogram": "MelSpectrogram",
+                "logmelspectrogram": "LogMelSpectrogram",
+                "mfcc": "MFCC"}[self.feat_type]
+        extractor = getattr(_feat, name)(sr=sr, **self.feat_config)
+        out = extractor(Tensor(wav[None, :]))
+        return np.asarray(out._value)[0], self.labels[idx]
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(_AudioClassificationDataset):
+    """Toronto Emotional Speech Set (parity: audio/datasets/tess.py).
+    Layout: <archive_dir>/TESS_Toronto_emotional_speech_set_data/
+    <speaker>_<word>_<emotion>.wav (any nesting); the emotion is the
+    label, parsed from the filename like the reference."""
+
+    n_folds = 5
+    label_list = ["angry", "disgust", "fear", "happy", "neutral",
+                  "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 archive_dir: Optional[str] = None, **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        if archive_dir is None:
+            raise RuntimeError(
+                "no network egress: pass archive_dir=<path to the "
+                "extracted TESS dataset>")
+        wavs = []
+        for root, _, files in os.walk(archive_dir):
+            for fn in sorted(files):
+                if fn.lower().endswith(".wav"):
+                    wavs.append(os.path.join(root, fn))
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            emotion = os.path.basename(path).rsplit(".", 1)[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feat_type, **kwargs)
+
+
+class ESC50(_AudioClassificationDataset):
+    """ESC-50 environmental sounds (parity: audio/datasets/esc50.py).
+    Layout: <archive_dir>/ESC-50-master/{meta/esc50.csv, audio/*.wav};
+    fold-based train/dev split like the reference."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw",
+                 archive_dir: Optional[str] = None, **kwargs):
+        if archive_dir is None:
+            raise RuntimeError(
+                "no network egress: pass archive_dir=<path to the "
+                "extracted ESC-50 dataset>")
+        meta = None
+        for cand in (os.path.join(archive_dir, "ESC-50-master", "meta",
+                                  "esc50.csv"),
+                     os.path.join(archive_dir, "meta", "esc50.csv")):
+            if os.path.exists(cand):
+                meta = cand
+                break
+        if meta is None:
+            raise FileNotFoundError("esc50.csv not found under "
+                                    f"{archive_dir}")
+        audio_dir = os.path.join(os.path.dirname(os.path.dirname(meta)),
+                                 "audio")
+        files, labels = [], []
+        with open(meta) as f:
+            header = f.readline().strip().split(",")
+            fi = header.index("filename")
+            foldi = header.index("fold")
+            ti = header.index("target")
+            for line in f:
+                parts = line.strip().split(",")
+                fold = int(parts[foldi])
+                keep = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if keep:
+                    files.append(os.path.join(audio_dir, parts[fi]))
+                    labels.append(int(parts[ti]))
+        super().__init__(files, labels, feat_type, **kwargs)

@@ -84,7 +84,7 @@ class GradNode:
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_meta", "n_outputs",
                  "out_is_tuple", "_hooks", "raw_fn", "tensor_vjp",
-                 "__weakref__")
+                 "raw_all_inputs", "raw_diff_pos", "__weakref__")
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence,
                  out_meta: List[Tuple[Tuple[int, ...], Any]],
@@ -103,6 +103,10 @@ class GradNode:
         # reference's generated higher-order GradNodes
         # (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py).
         self.raw_fn = raw_fn
+        # When raw_fn spans ALL tensor inputs (dispatch sets these), the
+        # full input list + the positions of the differentiable subset:
+        self.raw_all_inputs = None
+        self.raw_diff_pos = None
         # Alternative: a Tensor-level backward (PyLayer) — called with Tensor
         # cotangents under grad-enabled mode so it records its own tape nodes.
         self.tensor_vjp = tensor_vjp
@@ -153,15 +157,34 @@ def _node_backward_create_graph(node: GradNode, cots: Tuple):
     from ..core.dispatch import apply_op
 
     if node.raw_fn is not None:
-        k = len(node.inputs)
+        if node.raw_all_inputs is None:
+            raise AssertionError(
+                f"node {node.name} has raw_fn but no raw_all_inputs — "
+                "dispatch always sets both; a raw_fn spanning only the "
+                "diff inputs would re-bake stop_gradient inputs as "
+                "closure constants")
+        # raw_fn spans ALL tensor inputs (incl. stop_gradient ones):
+        # every one enters the dispatched grad op as a real argument —
+        # program capture then records them as symbolic inputs — while
+        # the VJP differentiates only the diff positions.
+        k = len(node.raw_all_inputs)
+        dpos = node.raw_diff_pos
 
-        def _bwd(*args, _fn=node.raw_fn, _k=k, _tup=node.out_is_tuple):
+        def _bwd(*args, _fn=node.raw_fn, _k=k, _dpos=dpos,
+                 _tup=node.out_is_tuple):
             primals, cs = args[:_k], args[_k:]
-            _, vjp = jax.vjp(_fn, *primals)
+
+            def f_diff(*dvals):
+                full = list(primals)
+                for p, dv in zip(_dpos, dvals):
+                    full[p] = dv
+                return _fn(*full)
+
+            _, vjp = jax.vjp(f_diff, *[primals[p] for p in _dpos])
             return vjp(tuple(cs) if _tup else cs[0])
 
         outs = apply_op(node.name + "_grad", _bwd,
-                        tuple(node.inputs) + tuple(cots))
+                        tuple(node.raw_all_inputs) + tuple(cots))
         return outs if isinstance(outs, tuple) else (outs,)
     if node.tensor_vjp is not None:
         from ..core.tensor import Tensor
@@ -339,6 +362,7 @@ def run_backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
         if not retain_graph and not create_graph:
             node.vjp_fn = None  # free residuals eagerly
             node.raw_fn = None
+            node.raw_all_inputs = None
             node.tensor_vjp = None
 
     # Any nodes left with pending in-degree (disconnected islands) are fine.

@@ -196,9 +196,27 @@ def _apply_op_inner(name, fn, tensor_args, kwargs, multi_output):
         out_is_tuple = isinstance(out_vals, tuple)
         flat_outs = out_vals if out_is_tuple else (out_vals,)
         out_meta = [(tuple(o.shape), o.dtype) for o in flat_outs]
+
+        # For create_graph backward the re-derived VJP must treat EVERY
+        # tensor input as an argument (not a baked closure constant):
+        # a stop_gradient tensor (e.g. a static.data feed) still has to
+        # enter the recorded grad op as a symbolic input so program
+        # capture replays it with the run's value.
+        tensor_idx = [i for i, t in enumerate(tensors) if t is not None]
+
+        def closed_all(*tvals):
+            full = list(vals)
+            for i, tv in zip(tensor_idx, tvals):
+                full[i] = tv
+            if cast_to is not None:
+                full = [_amp_cast(v, cast_to) for v in full]
+            return fn(*full, **kwargs)
+
         node = _tape.GradNode(name, vjp_fn, [tensors[i] for i in diff_idx],
                               out_meta, out_is_tuple=out_is_tuple,
-                              raw_fn=closed)
+                              raw_fn=closed_all)
+        node.raw_all_inputs = [tensors[i] for i in tensor_idx]
+        node.raw_diff_pos = tuple(tensor_idx.index(i) for i in diff_idx)
         outs = _wrap_outputs(name, out_vals, multi_output, node=node)
 
     if get_flag("check_nan_inf"):

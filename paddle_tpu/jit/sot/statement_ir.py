@@ -106,6 +106,16 @@ class Recorder:
         self._inputs.append((tensor, sym, id(tensor._value)))
         return sym
 
+    def input_sym_of(self, tensor):
+        """The sym DECLARED for an input placeholder.  Resolving by the
+        current value id is wrong when an aliasing op (same-shape
+        reshape, no-op cast, ...) returned the placeholder's buffer and
+        remapped it to the op's output sym."""
+        for t, sym, _ in self._inputs:
+            if t is tensor:
+                return sym
+        return self._sym_of.get(id(tensor._value))
+
     def register_rng_key(self, key):
         self._rng_pending[id(key)] = key
         self._keepalive.append(key)

@@ -40,6 +40,21 @@ __all__ = ["InputSpec", "Program", "Executor", "Job", "Plan", "data",
            "load_inference_model", "enable_static", "disable_static",
            "in_static_mode", "reset_default_programs"]
 
+from .extras import (Scope, global_scope, scope_guard,     # noqa: E402
+                     append_backward, gradients, Print, py_func,
+                     BuildStrategy, CompiledProgram, ExecutionStrategy,
+                     WeightNormParamAttr, ExponentialMovingAverage,
+                     save, load, serialize_program,
+                     serialize_persistables, save_to_file,
+                     deserialize_program, deserialize_persistables,
+                     load_from_file, normalize_program,
+                     load_program_state, set_program_state, cpu_places,
+                     cuda_places, Variable, create_global_var,
+                     create_parameter, accuracy, auc,
+                     ctr_metric_bundle, device_guard)
+from .extras import __all__ as _extras_all                 # noqa: E402
+__all__ += _extras_all
+
 
 class Job:
     """One schedulable unit (parity: interpreter/job.h) — a compiled
@@ -323,9 +338,9 @@ class Executor:
         stmts = [Statement(s.name, s.fn, s.arg_spec, s.kwargs, s.cast_to,
                            s.out_syms) for s in rec.statements]
         return StatementIR(
-            # inputs come from the program's CURRENT feed list (a
-            # rebound placeholder leaves a stale sym in the recorder)
-            input_syms=[rec._sym_of[id(t._value)]
+            # inputs resolve by DECLARED placeholder (value-id lookup
+            # breaks when an aliasing op returned the feed's buffer)
+            input_syms=[rec.input_sym_of(t)
                         for (_, t) in program.feeds],
             captures=captures,
             statements=stmts,
@@ -376,9 +391,9 @@ class Executor:
                 # pruning) and require only the feeds that slice uses
                 needed = self._dce(ir)
                 used_feeds = [(n, t) for (n, t) in program.feeds
-                              if program.recorder._sym_of.get(
-                                  id(t._value)) in needed]
-                ir.input_syms = [program.recorder._sym_of[id(t._value)]
+                              if program.recorder.input_sym_of(t)
+                              in needed]
+                ir.input_syms = [program.recorder.input_sym_of(t)
                                  for (_, t) in used_feeds]
                 entry = self._compile_infer(ir) + (used_feeds,)
             program._compiled[key] = entry

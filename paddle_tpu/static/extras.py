@@ -1,0 +1,667 @@
+"""static-mode API tail: scopes, program-level autodiff, host ops,
+compile-strategy shims, program io, metrics.
+
+Parity targets (reference):
+- Scope/global_scope/scope_guard: python/paddle/base/executor.py
+- append_backward/gradients: python/paddle/base/backward.py
+- Print: python/paddle/static/nn/control_flow.py
+- py_func: python/paddle/static/nn/common.py
+- BuildStrategy/CompiledProgram/ExecutionStrategy: base/compiler.py
+- WeightNormParamAttr: base/param_attr.py
+- ExponentialMovingAverage: static/nn/common.py:3980
+- program io family: python/paddle/static/io.py
+- create_global_var/create_parameter: python/paddle/tensor/creation.py
+- accuracy/auc/ctr_metric_bundle: static/nn/metric.py
+
+TPU-native notes: append_backward/gradients run the eager tape's
+create_graph backward WHILE the program recorder is active, so every
+VJP is dispatched through apply_op and lands in the captured program as
+ordinary grad statements — the analog of the reference appending grad
+ops to the ProgramDesc.  Program serialization rides jax.export
+(StableHLO), the portable compiled form of the captured statements.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Scope", "global_scope", "scope_guard", "append_backward",
+    "gradients", "Print", "py_func", "BuildStrategy", "CompiledProgram",
+    "ExecutionStrategy", "WeightNormParamAttr",
+    "ExponentialMovingAverage", "save", "load", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places",
+    "cuda_places", "Variable", "create_global_var", "create_parameter",
+    "accuracy", "auc", "ctr_metric_bundle", "device_guard",
+]
+
+
+# ---------------------------------------------------------------------------
+# Scope
+# ---------------------------------------------------------------------------
+class _ScopeTensor:
+    """The object find_var(...).get_tensor() returns (LoDTensor shim)."""
+
+    def __init__(self, holder: Tensor):
+        self._holder = holder
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._holder._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def set(self, array, place=None):
+        self._holder._value = jnp.asarray(array)
+
+    def shape(self):
+        return list(self._holder._value.shape)
+
+
+class _ScopeVar:
+    def __init__(self, name: str, holder: Tensor):
+        self.name = name
+        self._holder = holder
+
+    def get_tensor(self) -> _ScopeTensor:
+        return _ScopeTensor(self._holder)
+
+
+class Scope:
+    """Name -> variable store (parity: paddle.static.Scope /
+    base.Scope).  Parameters created by static.nn helpers and
+    create_parameter/create_global_var register here."""
+
+    def __init__(self):
+        self._vars: Dict[str, Tensor] = {}
+
+    def var(self, name: str) -> _ScopeVar:
+        if name not in self._vars:
+            self._vars[name] = Tensor(np.zeros((), np.float32))
+        return _ScopeVar(name, self._vars[name])
+
+    def find_var(self, name: str) -> Optional[_ScopeVar]:
+        t = self._vars.get(name)
+        return None if t is None else _ScopeVar(name, t)
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars.keys())
+
+    def _register(self, name: str, tensor: Tensor):
+        self._vars[name] = tensor
+
+
+_GLOBAL_SCOPE = Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope() -> Scope:
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Parity: paddle.static.scope_guard."""
+    if not isinstance(scope, Scope):
+        raise TypeError("scope_guard expects a paddle.static.Scope")
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+def _register_var(name: str, tensor: Tensor):
+    global_scope()._register(name, tensor)
+
+
+# ---------------------------------------------------------------------------
+# program-level autodiff
+# ---------------------------------------------------------------------------
+def _program_params(program=None):
+    from . import default_main_program
+    program = program or default_main_program()
+    return [p for p in program.all_parameters() if not p.stop_gradient]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Parity: paddle.static.append_backward (base/backward.py) —
+    append the backward graph for ``loss`` to the current program and
+    return [(param, grad_var)] pairs.
+
+    The grad statements are recorded by running the tape's create_graph
+    backward under the active program recorder; each returned grad var
+    is fetchable via Executor.run(fetch_list=[g])."""
+    from ..autograd import tape as _tape
+    params = list(parameter_list) if parameter_list is not None \
+        else _program_params()
+    params = [p for p in params
+              if no_grad_set is None or p not in no_grad_set]
+    if not params:
+        raise ValueError(
+            "append_backward found no trainable parameters; build the "
+            "model with static.nn helpers or pass parameter_list")
+    grads = _tape.grad([loss], params, create_graph=True,
+                       allow_unused=True)
+    if not isinstance(grads, list):
+        grads = [grads]
+    out = []
+    for p, g in zip(params, grads):
+        if g is not None:
+            g.name = f"{getattr(p, 'name', 'param')}@GRAD"
+        out.append((p, g))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """Parity: paddle.static.gradients (base/backward.py) — grads of
+    ``targets`` w.r.t. ``inputs`` appended to the current program."""
+    from ..autograd import tape as _tape
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    res = _tape.grad(list(tgts), list(ins),
+                     grad_outputs=target_gradients, create_graph=True,
+                     allow_unused=True)
+    return res if isinstance(res, list) else [res]
+
+
+# ---------------------------------------------------------------------------
+# host-interaction ops
+# ---------------------------------------------------------------------------
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Parity: paddle.static.Print (static/nn/control_flow.py) — prints
+    the tensor at execution time and passes it through.  TPU-native:
+    ``jax.debug.print`` rides the compiled module (works under jit and
+    in the captured-program replay)."""
+    from ..core.dispatch import apply_op
+    msg = message or ""
+    name = getattr(input, "name", None)
+
+    def fn(v):
+        jax.debug.print(
+            "{msg}{name} shape={shape} dtype={dtype} data={data}",
+            msg=(msg + " ") if msg else "",
+            name=name or "var",
+            shape=str(v.shape), dtype=str(v.dtype),
+            data=(v.reshape(-1)[:summarize] if summarize >= 0
+                  else v.reshape(-1)))
+        # a DISTINCT output array: returning v unchanged would alias the
+        # input buffer and collide the capture recorder's sym table
+        return v.copy()
+
+    return apply_op("print", fn, (input,))
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Parity: paddle.static.py_func (static/nn/common.py) — run a host
+    Python function inside the graph.  TPU-native: jax.pure_callback
+    (the host-callback mechanism of the compiled module); an optional
+    ``backward_func`` becomes the custom VJP, also as a callback."""
+    from ..core.dispatch import apply_op
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    single = not isinstance(out, (list, tuple))
+    structs = tuple(jax.ShapeDtypeStruct(tuple(o._value.shape),
+                                         o._value.dtype) for o in outs)
+
+    def host_fwd(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(getattr(r, "_value", r)).astype(s.dtype)
+                     .reshape(s.shape) for r, s in zip(res, structs))
+
+    if backward_func is None:
+        def fn(*vals):
+            r = jax.pure_callback(host_fwd, structs, *vals)
+            return r[0] if single else tuple(r)
+        return apply_op("py_func", fn, tuple(xs),
+                        multi_output=not single)
+
+    in_structs = tuple(jax.ShapeDtypeStruct(tuple(t._value.shape),
+                                            t._value.dtype) for t in xs)
+
+    @jax.custom_vjp
+    def _core(*vals):
+        r = jax.pure_callback(host_fwd, structs, *vals)
+        return tuple(r)
+
+    def _core_fwd(*vals):
+        r = _core(*vals)
+        return r, (vals, r)
+
+    def _core_bwd(res, gs):
+        vals, outs_v = res
+
+        def host_bwd(*args):
+            n = len(vals)
+            m = len(outs_v)
+            a_in, a_out, a_g = args[:n], args[n:n + m], args[n + m:]
+            d = backward_func(*[np.asarray(v) for v in
+                                (*a_in, *a_out, *a_g)])
+            d = d if isinstance(d, (list, tuple)) else [d]
+            return tuple(np.asarray(getattr(r, "_value", r))
+                         .astype(s.dtype).reshape(s.shape)
+                         for r, s in zip(d, in_structs))
+
+        dx = jax.pure_callback(host_bwd, in_structs, *vals, *outs_v, *gs)
+        return tuple(dx)
+
+    _core.defvjp(_core_fwd, _core_bwd)
+
+    def fn(*vals):
+        r = _core(*vals)
+        return r[0] if single else r
+
+    return apply_op("py_func", fn, tuple(xs), multi_output=not single)
+
+
+# ---------------------------------------------------------------------------
+# compiler shims
+# ---------------------------------------------------------------------------
+class BuildStrategy:
+    """Parity: paddle.static.BuildStrategy — graph-build knobs.  Under
+    XLA every listed fusion/optimization is the compiler's default;
+    the attributes are accepted and recorded for introspection."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = None
+        self.enable_inplace = False
+        self.build_cinn_pass = False
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+
+    def __repr__(self):
+        return f"BuildStrategy({self.__dict__})"
+
+
+class ExecutionStrategy:
+    """Parity: paddle.static.ExecutionStrategy."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.allow_op_delay = False
+
+
+class CompiledProgram:
+    """Parity: paddle.static.CompiledProgram — wraps a Program with a
+    BuildStrategy; Executor.run accepts it transparently (compilation
+    happens per (feed, fetch) signature either way under XLA)."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class WeightNormParamAttr:
+    """Parity: paddle.static.WeightNormParamAttr (base/param_attr.py) —
+    a ParamAttr requesting weight-norm reparametrization over ``dim``.
+    static.nn.fc honors it by creating g/v parameters and composing
+    w = g * v / ||v||."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """Parity: paddle.static.ExponentialMovingAverage
+    (static/nn/common.py:3980) — EMA of the current program's
+    parameters with bias correction, apply/restore swapping."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema: Dict[int, jnp.ndarray] = {}
+        self._step = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._params: List[Tensor] = []
+
+    def _track(self, params=None):
+        if params is not None:
+            self._params = list(params)
+        elif not self._params:
+            self._params = _program_params()
+
+    def update(self, params=None):
+        self._track(params)
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            pid = id(p)
+            prev = self._ema.get(pid)
+            v = p._value.astype(jnp.float32)
+            self._ema[pid] = v if prev is None else d * prev + (1 - d) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        bias = 1.0 - self._decay ** max(self._step, 1)
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            ema = self._ema.get(id(p))
+            if ema is not None:
+                p._value = (ema / bias).astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup.pop(id(p))
+
+
+# ---------------------------------------------------------------------------
+# program io (jax.export = the portable compiled form)
+# ---------------------------------------------------------------------------
+def _export_program(program, feed_vars, fetch_vars):
+    from . import Executor
+    ex = Executor()
+    fetch_syms = tuple(ex._resolve_syms(program, fetch_vars))
+    ir = ex._build_ir(program, fetch_syms)
+    needed = ex._dce(ir)
+    used = [(n, t) for (n, t) in program.feeds
+            if program.recorder.input_sym_of(t) in needed]
+    ir.input_syms = [program.recorder.input_sym_of(t)
+                     for (_, t) in used]
+    from ..jit.sot.statement_ir import build_replay
+    replay = build_replay(ir)
+    caps = [t._value for (t, _) in ir.captures]
+
+    def pure(key, *feeds):
+        return replay(key, *caps, *feeds)
+
+    args = [jax.random.PRNGKey(0)] + [t._value for (_, t) in used]
+    try:
+        exported = jax.export.export(jax.jit(pure))(*args)
+    except NotImplementedError as e:
+        raise NotImplementedError(
+            "this program contains host-callback ops (py_func / Print) "
+            "which have no portable serialized form — the reference has "
+            "the same restriction (py_func is not saveable into an "
+            "inference program); prune them from the fetch slice first"
+        ) from e
+    return exported, [n for (n, _) in used]
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Parity: static.serialize_program — the program's portable
+    compiled form (StableHLO via jax.export) as bytes."""
+    from . import default_main_program
+    program = program or default_main_program()
+    exported, feed_names = _export_program(
+        program,
+        feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars],
+        fetch_vars if isinstance(fetch_vars, (list, tuple))
+        else [fetch_vars])
+    return pickle.dumps({"stablehlo": exported.serialize(),
+                         "feed_names": feed_names})
+
+
+def deserialize_program(data: bytes):
+    """Parity: static.deserialize_program — a runnable Program whose
+    body is the deserialized compiled function."""
+    from . import Program
+    blob = pickle.loads(data)
+    rehydrated = jax.export.deserialize(blob["stablehlo"])
+    feed_names = blob["feed_names"]
+
+    def fn(**feed):
+        vals = [feed[n] for n in feed_names]
+        vals = [v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                for v in vals]
+        outs = rehydrated.call(jax.random.PRNGKey(0), *vals)
+        return [Tensor._from_value(o) for o in outs]
+
+    prog = Program(fn=fn, name="deserialized")
+    prog._feed_names = feed_names
+    return prog
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kw):
+    """Parity: static.serialize_persistables — the program's parameter
+    state as bytes."""
+    from . import default_main_program
+    program = program or default_main_program()
+    state = {}
+    for i, p in enumerate(program.all_parameters()):
+        state[getattr(p, "name", None) or f"param_{i}"] = \
+            np.asarray(p._value)
+    for name, t in global_scope()._vars.items():
+        state.setdefault(name, np.asarray(t._value))
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    """Parity: static.deserialize_persistables — restore parameter
+    values into ``program`` (matched by name)."""
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path: str, protocol=4, **configs):
+    """Parity: static.save — <path>.pdparams (+ .pdmodel when the
+    program has feeds/fetches registered via its train/nn state)."""
+    state = {}
+    for i, p in enumerate(program.all_parameters()):
+        state[getattr(p, "name", None) or f"param_{i}"] = \
+            np.asarray(p._value)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    """Parity: static.load — restore .pdparams into the program."""
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state, var_list)
+
+
+def load_program_state(model_path: str, var_list=None):
+    """Parity: static.load_program_state."""
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict, var_list=None):
+    """Parity: static.set_program_state — assign by name."""
+    targets = var_list if var_list is not None \
+        else program.all_parameters()
+    by_name = {getattr(p, "name", None) or f"param_{i}": p
+               for i, p in enumerate(targets)}
+    for name, val in state_dict.items():
+        p = by_name.get(name)
+        if p is not None:
+            p._value = jnp.asarray(val, p._value.dtype)
+    # scope vars too
+    for name, val in state_dict.items():
+        if name in global_scope()._vars:
+            global_scope()._vars[name]._value = jnp.asarray(val)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Parity: static.normalize_program — validate feeds/fetches and
+    return a clone pruned to the fetch slice (our Executor prunes at
+    compile; the clone records the chosen io so save_inference_model
+    and serialize_program agree)."""
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    for t in feeds:
+        if not isinstance(t, Tensor):
+            raise TypeError("feed_vars must be Tensors from static.data")
+    cloned = program.clone()
+    cloned._normalized_io = ([getattr(t, "name", None) for t in feeds],
+                             list(fetches))
+    return cloned
+
+
+# ---------------------------------------------------------------------------
+# places / vars / metrics / guards
+# ---------------------------------------------------------------------------
+def cpu_places(device_count=None):
+    """Parity: static.cpu_places."""
+    from ..device import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Parity: static.cuda_places — the accelerator places; on this
+    stack the accelerators are TPU chips."""
+    from ..device import TPUPlace
+    import jax as _jax
+    if device_ids is None:
+        device_ids = range(len(_jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+Variable = Tensor   # parity alias: static.Variable IS the tensor type
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity: paddle.static.create_global_var."""
+    from ..core import dtypes as _dt
+    t = Tensor(np.full(tuple(shape), value, _dt.convert_dtype(dtype)))
+    t.name = name or f"global_var_{id(t)}"
+    t.stop_gradient = True
+    t.persistable = True
+    _register_var(t.name, t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Parity: paddle.static.create_parameter — registered into the
+    current program (trainable by append_backward/minimize) and the
+    global scope."""
+    from ..nn import initializer as I
+    from ..nn.layer_base import Parameter
+    from ..core import dtypes as _dt
+    from . import default_main_program
+    init = getattr(attr, "initializer", None) if attr is not None \
+        else None
+    init = init or default_initializer or \
+        (I.Constant(0.0) if is_bias else I.XavierUniform())
+    value = init(tuple(shape), _dt.convert_dtype(dtype))
+    p = Parameter(value, name=name or (getattr(attr, "name", None)
+                                       if attr is not None else None))
+    prog = default_main_program()
+    prog._nn_params.append(p)
+    if p.name:
+        _register_var(p.name, p)
+    return p
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Parity: static.accuracy — top-k accuracy over softmax scores."""
+    from ..metric import accuracy as _impl
+    return _impl(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1,
+        topk=1, slide_steps=1, ins_tag_weight=None):
+    """Parity: static.auc — returns (auc_out, batch_auc_out,
+    [stat tensors]).  Computed exactly over the batch (threshold-free
+    rank statistic) instead of the reference's binned accumulators."""
+    from ..core.dispatch import apply_op
+
+    def fn(scores, lab):
+        s = scores[:, -1] if scores.ndim == 2 else scores.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(s)
+        ranks = jnp.zeros_like(s).at[order].set(
+            jnp.arange(1, s.shape[0] + 1, dtype=s.dtype))
+        n_pos = y.sum()
+        n_neg = y.shape[0] - n_pos
+        sum_rank_pos = (ranks * y).sum()
+        a = (sum_rank_pos - n_pos * (n_pos + 1) / 2.0) / \
+            jnp.maximum(n_pos * n_neg, 1.0)
+        return a.astype(jnp.float32)
+
+    out = apply_op("auc", fn, (input, label))
+    return out, out, [out]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """Parity: static.ctr_metric_bundle — (ctr, sum(q), ins_num,
+    predicted_ctr) for CTR evaluation."""
+    from ..core.dispatch import apply_op
+
+    def fn(scores, lab):
+        s = scores[:, -1] if scores.ndim == 2 else scores.reshape(-1)
+        y = lab.reshape(-1).astype(jnp.float32)
+        n = jnp.asarray(s.shape[0], jnp.float32)
+        return (y.sum() / n, s.sum(), n, s.sum() / n)
+
+    outs = apply_op("ctr_metric_bundle", fn, (input, label),
+                    multi_output=True)
+    return outs
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Parity: static.device_guard — op-placement hint.  One TPU device
+    executes the compiled module; 'cpu' sections correspond to host
+    callbacks, which our py_func/Print already use explicitly, so the
+    guard validates the name and is otherwise advisory."""
+    if device is not None and device.split(":")[0] not in (
+            "cpu", "gpu", "tpu", "xpu", "npu"):
+        raise ValueError(f"unknown device {device!r} in device_guard")
+    yield

@@ -171,3 +171,806 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         list(size), attr=param_attr, dtype=dtype,
         default_initializer=_nn.initializer.XavierUniform()))
     return _nn.functional.embedding(input, w, padding_idx=padding_idx)
+
+
+# ---------------------------------------------------------------------------
+# round-5: layer-helper ops (parity: python/paddle/static/nn/common.py —
+# conv2d :397, conv3d, conv2d_transpose, conv3d_transpose, batch_norm
+# :2724, layer_norm, group_norm, instance_norm, data_norm, spectral_norm,
+# prelu, deform_conv2d, bilinear_tensor_product, row_conv, nce,
+# sparse_embedding; control_flow.py static_pylayer)
+#
+# The LayerHelper idiom: parameters are created at program-build time,
+# registered on the active Program (Program.all_parameters /
+# append_backward see them), and the math runs through the same
+# functional ops the dygraph layers use, so capture records one clean
+# statement list.
+# ---------------------------------------------------------------------------
+def _helper():
+    from ..nn.layer_base import Layer
+    return Layer()
+
+
+def _param(shape, attr=None, is_bias=False, default_init=None,
+           dtype=None):
+    from .. import nn as _nn
+    h = _helper()
+    init = default_init
+    if init is None and not is_bias:
+        init = _nn.initializer.XavierUniform()
+    p = h.create_parameter(list(shape), attr=attr, is_bias=is_bias,
+                           dtype=dtype, default_initializer=init)
+    from .extras import _register_var
+    if getattr(p, "name", None):
+        _register_var(p.name, p)
+    return _register_program_param(p)
+
+
+def _act(y, act):
+    if act:
+        from .. import nn as _nn
+        return getattr(_nn.functional, act)(y)
+    return y
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """Parity: static.nn.conv2d (common.py:397)."""
+    from ..nn import functional as F
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _param([num_filters, cin // groups, *fs], attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    """Parity: static.nn.conv3d."""
+    from ..nn import functional as F
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = _param([num_filters, cin // groups, *fs], attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    """Parity: static.nn.conv2d_transpose."""
+    from ..nn import functional as F
+    if filter_size is None:
+        raise ValueError("filter_size must be given (output_size-only "
+                         "inference is not supported)")
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _param([cin, num_filters // groups, *fs], attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups, output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    """Parity: static.nn.conv3d_transpose."""
+    from ..nn import functional as F
+    if filter_size is None:
+        raise ValueError("filter_size must be given")
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    w = _param([cin, num_filters // groups, *fs], attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, dilation=dilation,
+                             groups=groups, output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """Parity: static.nn.deform_conv2d (build-time params over
+    vision.ops.deform_conv2d)."""
+    from ..vision.ops import deform_conv2d as _impl
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = x.shape[1]
+    w = _param([num_filters, cin // groups, *fs], attr=weight_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    return _impl(x, offset, w, bias=b, stride=stride, padding=padding,
+                 dilation=dilation, deformable_groups=deformable_groups,
+                 groups=groups, mask=mask)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    """Parity: static.nn.batch_norm (common.py:2724) — scale/bias are
+    trainable build-time params; moving stats are persistable
+    non-trainable vars updated when not is_test."""
+    from ..nn import functional as F
+    from .. import nn as _nn
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _param([c], attr=param_attr,
+                   default_init=_nn.initializer.Constant(1.0))
+    bias = _param([c], attr=bias_attr, is_bias=True)
+    mean = _param([c], default_init=_nn.initializer.Constant(0.0))
+    var = _param([c], default_init=_nn.initializer.Constant(1.0))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """Parity: static.nn.layer_norm — normalize over dims
+    [begin_norm_axis:]."""
+    from ..nn import functional as F
+    from .. import nn as _nn
+    norm_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    w = _param(norm_shape, attr=param_attr,
+               default_init=_nn.initializer.Constant(1.0)) if scale \
+        else None
+    b = _param(norm_shape, attr=bias_attr, is_bias=True) if shift \
+        else None
+    out = F.layer_norm(input, normalized_shape=norm_shape, weight=w,
+                       bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """Parity: static.nn.group_norm."""
+    from ..nn import functional as F
+    from .. import nn as _nn
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = None if param_attr is False else _param(
+        [c], attr=param_attr, default_init=_nn.initializer.Constant(1.0))
+    b = None if bias_attr is False else _param([c], attr=bias_attr,
+                                               is_bias=True)
+    out = F.group_norm(input, num_groups=groups, epsilon=epsilon,
+                       weight=w, bias=b, data_format=data_layout)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """Parity: static.nn.instance_norm."""
+    from ..nn import functional as F
+    from .. import nn as _nn
+    c = input.shape[1]
+    w = None if param_attr is False else _param(
+        [c], attr=param_attr, default_init=_nn.initializer.Constant(1.0))
+    b = None if bias_attr is False else _param([c], attr=bias_attr,
+                                               is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, data_layout="NCHW",
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999):
+    """Parity: static.nn.data_norm — normalization by ACCUMULATED batch
+    statistics held in persistable vars (batch_size / batch_sum /
+    batch_square_sum), the CTR-model normalization."""
+    from ..core.dispatch import apply_op
+    from .. import nn as _nn
+    c = input.shape[-1] if data_layout == "NHWC" or input.ndim == 2 \
+        else input.shape[1]
+    bsz = _param([c], default_init=_nn.initializer.Constant(1e4))
+    bsum = _param([c], default_init=_nn.initializer.Constant(0.0))
+    bsq = _param([c], default_init=_nn.initializer.Constant(1e4))
+    for t in (bsz, bsum, bsq):
+        t.stop_gradient = True
+
+    ch_axis = -1 if (data_layout == "NHWC" or input.ndim == 2) else 1
+
+    def fn(x, n, s, sq):
+        shape = [1] * x.ndim
+        shape[ch_axis] = -1
+        mean = (s / n).reshape(shape)
+        scale = jnp.sqrt(n / sq).reshape(shape)  # reference data_norm
+        return (x - mean) * scale
+
+    out = apply_op("data_norm", fn, (input, bsz, bsum, bsq))
+    if enable_scale_and_shift:
+        w = _param([c], attr=param_attr,
+                   default_init=_nn.initializer.Constant(1.0))
+        b = _param([c], is_bias=True)
+        bshape = [1] * input.ndim
+        bshape[ch_axis] = -1
+        out = out * w.reshape(bshape) + b.reshape(bshape)
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Parity: static.nn.spectral_norm — normalize ``weight`` by its
+    largest singular value (power iteration with persistable u/v)."""
+    from ..core.dispatch import apply_op
+    import jax as _jax
+
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = _jax.random.normal(_jax.random.PRNGKey(0), (wm.shape[0],))
+        u = u / (jnp.linalg.norm(u) + eps)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+
+    return apply_op("spectral_norm", fn, (weight,))
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """Parity: static.nn.prelu — modes all/channel/element with a
+    build-time alpha parameter."""
+    from ..nn import functional as F
+    from .. import nn as _nn
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1] if data_format == "NCHW" else x.shape[-1]]
+    elif mode == "element":
+        shape = [int(s) for s in x.shape[1:]]
+    else:
+        raise ValueError("mode must be one of all/channel/element")
+    alpha = _param(shape, attr=param_attr,
+                   default_init=_nn.initializer.Constant(0.25))
+    return F.prelu(x, alpha, data_format=data_format)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """Parity: static.nn.bilinear_tensor_product —
+    out_k = x W_k y^T + b."""
+    from ..core.dispatch import apply_op
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    w = _param([size, dx, dy], attr=param_attr)
+    b = None if bias_attr is False else _param([size], attr=bias_attr,
+                                               is_bias=True)
+
+    def fn(xv, yv, wv, *bb):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x, y, w) + ((b,) if b is not None else ())
+    return _act(apply_op("bilinear_tensor_product", fn, args), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Parity: static.nn.row_conv — lookahead row convolution
+    y[t] = sum_{i=0..k} x[t+i] * w[i] (per channel), over (B, T, D)."""
+    from ..core.dispatch import apply_op
+    from .. import nn as _nn
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = _param([k + 1, d], attr=param_attr,
+               default_init=_nn.initializer.Constant(1.0 / (k + 1)))
+
+    def fn(x, wv):
+        pads = [(0, 0)] * x.ndim
+        pads[-2] = (0, k)
+        xp = jnp.pad(x, pads)
+        out = jnp.zeros_like(x)
+        T = x.shape[-2]
+        for i in range(k + 1):
+            out = out + xp[..., i:i + T, :] * wv[i]
+        return out
+
+    return _act(apply_op("row_conv", fn, (input, w)), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Parity: static.nn.nce — noise-contrastive estimation loss with a
+    build-time class-embedding table and uniform negative sampling."""
+    from ..core.dispatch import apply_op
+    from ..ops import random as _random
+    import jax as _jax
+    if sampler != "uniform":
+        raise NotImplementedError(
+            f"nce sampler {sampler!r}: only 'uniform' is implemented")
+    if custom_dist is not None:
+        raise NotImplementedError("nce custom_dist is not implemented")
+    d = int(input.shape[-1])
+    w = _param([num_total_classes, d], attr=param_attr)
+    b = None if bias_attr is False else _param([num_total_classes],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    # the key rides the op as an argument: the capture recorder
+    # registers it as an RNG slot, so every replayed step draws FRESH
+    # negatives (a closure-baked key would freeze them)
+    key = _random.next_key()
+    n = num_neg_samples
+
+    def fn(x, lab, wv, *rest):
+        *bb, key = rest
+        B = x.shape[0]
+        neg = _jax.random.randint(key, (B, n), 0, num_total_classes)
+        pos_w = wv[lab.reshape(-1)]                      # (B, D)
+        neg_w = wv[neg]                                  # (B, n, D)
+        pos_logit = (x * pos_w).sum(-1)
+        neg_logit = jnp.einsum("bd,bnd->bn", x, neg_w)
+        if bb:
+            pos_logit = pos_logit + bb[0][lab.reshape(-1)]
+            neg_logit = neg_logit + bb[0][neg]
+        # NCE: positives scored against noise prob 1/C
+        log_noise = jnp.log(jnp.asarray(1.0 / num_total_classes))
+        pos_loss = _jax.nn.log_sigmoid(pos_logit - log_noise)
+        neg_loss = _jax.nn.log_sigmoid(-(neg_logit - log_noise)).sum(-1)
+        return -(pos_loss + neg_loss).reshape(B, 1)
+
+    args = (input, label, w) + ((b,) if b is not None else ()) + (key,)
+    return apply_op("nce", fn, args)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None,
+                     name=None):
+    """Parity: static.nn.sparse_embedding — the PS-era large-vocab
+    lookup.  On a TPU mesh the table is a dense (vocab-sharded under
+    GSPMD) parameter; semantics (lookup + padding_idx) are identical."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Parity: static.nn.static_pylayer (control_flow.py) — run
+    ``forward_fn`` inside the program with a user-defined backward.
+    Mechanism: a dynamically-built PyLayer whose tensor-level backward
+    re-enters the tape, so append_backward records the custom VJP as
+    ordinary grad statements."""
+    from ..autograd import PyLayer
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            ctx.save_for_backward(*xs)
+            out = forward_fn(*xs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *gs):
+            if backward_fn is None:
+                raise RuntimeError(
+                    "static_pylayer built without backward_fn cannot "
+                    "be differentiated")
+            return backward_fn(*gs)
+
+    outs = _StaticPyLayer.apply(*inputs)
+    if backward_fn is None:
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        for o in out_list:
+            o.stop_gradient = True
+    return outs
+
+
+from .extras import py_func   # noqa: E402  (listed in static.nn too)
+
+__all__ += ["conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
+            "deform_conv2d", "batch_norm", "layer_norm", "group_norm",
+            "instance_norm", "data_norm", "spectral_norm", "prelu",
+            "bilinear_tensor_product", "row_conv", "nce",
+            "sparse_embedding", "static_pylayer", "py_func"]
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (parity: python/paddle/static/nn/sequence_lod.py).
+#
+# LoD convention here: a "sequence tensor" is the flattened row tensor
+# (total_rows, ...) with level-1 offsets attached as ``x._lod`` (e.g.
+# [0, 2, 5] = two sequences of lengths 2 and 3) — the exact memory
+# layout of the reference's LoDTensor.  Offsets are host-side static
+# (like every shape in this trace-specialized static mode), so each op
+# precomputes an integer plan and dispatches one gather/segment kernel;
+# grads flow through dispatch.  ``set_lod``/``get_lod`` attach/read
+# offsets (the analog of LoDTensor.set_lod).
+# ---------------------------------------------------------------------------
+def set_lod(x, lod):
+    """Attach level-1 offsets (list starting at 0) to a tensor."""
+    lod = [int(v) for v in lod]
+    if lod[0] != 0 or any(b < a for a, b in zip(lod, lod[1:])):
+        raise ValueError(f"invalid lod offsets {lod}")
+    x._lod = lod
+    return x
+
+
+def get_lod(x):
+    return list(getattr(x, "_lod", []))
+
+
+def _lod_of(x):
+    lod = getattr(x, "_lod", None)
+    if lod is None:
+        raise ValueError(
+            "sequence ops need level-1 lod offsets; attach them with "
+            "paddle.static.nn.set_lod(x, [0, len0, len0+len1, ...])")
+    if lod[-1] != x.shape[0]:
+        raise ValueError(
+            f"lod {lod} does not cover the {x.shape[0]} rows")
+    return lod
+
+
+def _seg_ids(lod):
+    return np.repeat(np.arange(len(lod) - 1),
+                     np.diff(np.asarray(lod)))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    """Parity: sequence_lod.sequence_pool — per-sequence reduce."""
+    from ..core.dispatch import apply_op
+    import jax as _jax
+    lod = _lod_of(input)
+    ids = jnp.asarray(_seg_ids(lod))
+    n = len(lod) - 1
+    lens = jnp.asarray(np.diff(np.asarray(lod)), jnp.float32)
+    pt = pool_type.lower()
+
+    def fn(x):
+        if pt == "sum":
+            return _jax.ops.segment_sum(x, ids, num_segments=n)
+        if pt == "average":
+            return _jax.ops.segment_sum(x, ids, num_segments=n) / \
+                jnp.maximum(lens, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+        if pt == "sqrt":
+            return _jax.ops.segment_sum(x, ids, num_segments=n) / \
+                jnp.sqrt(jnp.maximum(lens, 1.0)).reshape(
+                    (-1,) + (1,) * (x.ndim - 1))
+        if pt == "max":
+            return _jax.ops.segment_max(x, ids, num_segments=n)
+        if pt == "min":
+            return _jax.ops.segment_min(x, ids, num_segments=n)
+        if pt == "first":
+            return x[jnp.asarray(lod[:-1])]
+        if pt == "last":
+            return x[jnp.asarray([v - 1 for v in lod[1:]])]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    out = apply_op("sequence_pool", fn, (input,))
+    empty = np.diff(np.asarray(lod)) == 0
+    if empty.any() and pt in ("max", "min", "average", "sqrt"):
+        from ..core.dispatch import apply_op as _ap
+        mask = jnp.asarray(empty).reshape(
+            (-1,) + (1,) * (len(out.shape) - 1))
+        out = _ap("sequence_pool_pad",
+                  lambda o: jnp.where(mask, pad_value, o), (out,))
+    return out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    """Parity: sequence_softmax — softmax within each sequence."""
+    from ..core.dispatch import apply_op
+    import jax as _jax
+    lod = _lod_of(input)
+    ids = jnp.asarray(_seg_ids(lod))
+    n = len(lod) - 1
+
+    def fn(x):
+        flat = x.reshape(-1)
+        mx = _jax.ops.segment_max(flat, ids, num_segments=n)
+        e = jnp.exp(flat - mx[ids])
+        den = _jax.ops.segment_sum(e, ids, num_segments=n)
+        return (e / den[ids]).reshape(x.shape)
+
+    out = apply_op("sequence_softmax", fn, (input,))
+    out._lod = lod
+    return out
+
+
+def sequence_first_step(input):
+    """Parity: sequence_first_step."""
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    """Parity: sequence_last_step."""
+    return sequence_pool(input, "last")
+
+
+def sequence_concat(input, name=None):
+    """Parity: sequence_concat — concat the i-th sequences of every
+    input into the i-th output sequence."""
+    from ..core.dispatch import apply_op
+    lods = [_lod_of(x) for x in input]
+    n = len(lods[0]) - 1
+    if any(len(l) - 1 != n for l in lods):
+        raise ValueError("all inputs need the same number of sequences")
+    order = []
+    out_lod = [0]
+    for i in range(n):
+        seg_len = 0
+        for j, (x, lod) in enumerate(zip(input, lods)):
+            start = lod[i] + sum(l[-1] for l in lods[:j])
+            order.extend(range(start, start + (lod[i + 1] - lod[i])))
+            seg_len += lod[i + 1] - lod[i]
+        out_lod.append(out_lod[-1] + seg_len)
+    gather = jnp.asarray(np.asarray(order, np.int32))
+
+    def fn(*xs):
+        return jnp.concatenate(xs, axis=0)[gather]
+
+    out = apply_op("sequence_concat", fn, tuple(input))
+    out._lod = out_lod
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Parity: sequence_slice — per-sequence [offset, offset+length)."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(input)
+    off = np.asarray(getattr(offset, "_value", offset)).reshape(-1)
+    ln = np.asarray(getattr(length, "_value", length)).reshape(-1)
+    order = []
+    out_lod = [0]
+    for i in range(len(lod) - 1):
+        s = lod[i] + int(off[i])
+        e = s + int(ln[i])
+        if e > lod[i + 1]:
+            raise ValueError("slice exceeds sequence length")
+        order.extend(range(s, e))
+        out_lod.append(out_lod[-1] + int(ln[i]))
+    gather = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_op("sequence_slice", lambda x: x[gather], (input,))
+    out._lod = out_lod
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Parity: sequence_expand — repeat x's i-th sequence as many times
+    as y's i-th sequence has entries at ref_level."""
+    from ..core.dispatch import apply_op
+    x_lod = getattr(x, "_lod", None)
+    y_lod = _lod_of(y)
+    n = len(y_lod) - 1
+    if x_lod is None:
+        x_lod = list(range(x.shape[0] + 1))     # each row = one seq
+    order = []
+    out_lod = [0]
+    for i in range(len(x_lod) - 1):
+        times = y_lod[i + 1] - y_lod[i]
+        seg = list(range(x_lod[i], x_lod[i + 1]))
+        for _ in range(max(times, 0)):
+            order.extend(seg)
+            out_lod.append(out_lod[-1] + len(seg))
+    gather = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_op("sequence_expand", lambda v: v[gather], (x,))
+    out._lod = out_lod
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """Parity: sequence_expand_as — x's i-th row expands to the length
+    of y's i-th sequence."""
+    from ..core.dispatch import apply_op
+    y_lod = _lod_of(y)
+    reps = np.diff(np.asarray(y_lod))
+    order = np.repeat(np.arange(x.shape[0]), reps)
+    gather = jnp.asarray(order.astype(np.int32))
+    out = apply_op("sequence_expand_as", lambda v: v[gather], (x,))
+    out._lod = list(y_lod)
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Parity: sequence_pad — (num_seq, maxlen, ...) dense + lengths."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(x)
+    lens = np.diff(np.asarray(lod))
+    n = len(lens)
+    m = int(maxlen) if maxlen is not None else int(lens.max())
+    gather = np.zeros((n, m), np.int32)
+    mask = np.zeros((n, m), bool)
+    for i in range(n):
+        k = min(int(lens[i]), m)
+        gather[i, :k] = np.arange(lod[i], lod[i] + k)
+        mask[i, :k] = True
+    g = jnp.asarray(gather)
+    msk = jnp.asarray(mask)
+    pv = pad_value if hasattr(pad_value, "_value") \
+        else Tensor(np.asarray(pad_value))
+
+    def fn(v, p):
+        out = v[g.reshape(-1)].reshape((n, m) + v.shape[1:])
+        pm = msk.reshape((n, m) + (1,) * (v.ndim - 1))
+        return jnp.where(pm, out, p.astype(v.dtype))
+
+    out = apply_op("sequence_pad", fn, (x, pv))
+    return out, Tensor(np.asarray(lens, np.int64))
+
+
+def sequence_unpad(x, length, name=None):
+    """Parity: sequence_unpad — inverse of sequence_pad."""
+    from ..core.dispatch import apply_op
+    lens = np.asarray(getattr(length, "_value", length)).reshape(-1)
+    n, m = int(x.shape[0]), int(x.shape[1])
+    order = []
+    out_lod = [0]
+    for i in range(n):
+        k = min(int(lens[i]), m)
+        order.extend(range(i * m, i * m + k))
+        out_lod.append(out_lod[-1] + k)
+    gather = jnp.asarray(np.asarray(order, np.int32))
+
+    def fn(v):
+        flat = v.reshape((n * m,) + v.shape[2:])
+        return flat[gather]
+
+    out = apply_op("sequence_unpad", fn, (x,))
+    out._lod = out_lod
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Parity: sequence_reshape — re-chunk each sequence's rows to width
+    new_dim (total elements per sequence must divide)."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(input)
+    d = int(input.shape[-1])
+    out_lod = [0]
+    for i in range(len(lod) - 1):
+        elems = (lod[i + 1] - lod[i]) * d
+        if elems % new_dim:
+            raise ValueError("sequence elements not divisible by new_dim")
+        out_lod.append(out_lod[-1] + elems // new_dim)
+    out = apply_op("sequence_reshape",
+                   lambda v: v.reshape(-1, new_dim), (input,))
+    out._lod = out_lod
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Parity: sequence_scatter — add updates' rows into ``input`` at
+    per-sequence positions ``index`` (sequence i writes into row i)."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(index)
+    seg = _seg_ids(lod)
+    idx_np = np.asarray(getattr(index, "_value", index)).reshape(-1)
+    rows = jnp.asarray(seg.astype(np.int32))
+    cols = jnp.asarray(idx_np.astype(np.int32))
+
+    def fn(base, upd):
+        return base.at[rows, cols].add(upd.reshape(-1))
+
+    return apply_op("sequence_scatter", fn, (input, updates))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Parity: sequence_enumerate — sliding windows of ids per
+    sequence, padded with pad_value past each sequence end."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(input)
+    T = int(input.shape[0])
+    gather = np.zeros((T, win_size), np.int32)
+    mask = np.zeros((T, win_size), bool)
+    for i in range(len(lod) - 1):
+        for t in range(lod[i], lod[i + 1]):
+            for wjj in range(win_size):
+                if t + wjj < lod[i + 1]:
+                    gather[t, wjj] = t + wjj
+                    mask[t, wjj] = True
+    g = jnp.asarray(gather)
+    msk = jnp.asarray(mask)
+
+    def fn(v):
+        flat = v.reshape(-1)
+        out = flat[g.reshape(-1)].reshape(T, win_size)
+        return jnp.where(msk, out, pad_value)
+
+    out = apply_op("sequence_enumerate", fn, (input,))
+    out._lod = lod
+    return out
+
+
+def sequence_reverse(x, name=None):
+    """Parity: sequence_reverse — reverse rows within each sequence."""
+    from ..core.dispatch import apply_op
+    lod = _lod_of(x)
+    order = []
+    for i in range(len(lod) - 1):
+        order.extend(range(lod[i + 1] - 1, lod[i] - 1, -1))
+    gather = jnp.asarray(np.asarray(order, np.int32))
+    out = apply_op("sequence_reverse", lambda v: v[gather], (x,))
+    out._lod = lod
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Parity: sequence_conv — context-window convolution within each
+    sequence (rows outside the sequence are zero), weight
+    [filter_size * D, num_filters]."""
+    from ..core.dispatch import apply_op
+    if filter_stride != 1:
+        raise ValueError("sequence_conv supports filter_stride=1")
+    lod = _lod_of(input)
+    d = int(input.shape[-1])
+    T = int(input.shape[0])
+    w = _param([filter_size * d, num_filters], attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters],
+                                               attr=bias_attr,
+                                               is_bias=True)
+    start = padding_start if padding_start is not None \
+        else -((filter_size - 1) // 2)
+    # context gather plan: row t sees rows t+start .. t+start+k-1,
+    # clipped to its own sequence (zeros outside)
+    gather = np.zeros((T, filter_size), np.int32)
+    mask = np.zeros((T, filter_size), bool)
+    for i in range(len(lod) - 1):
+        for t in range(lod[i], lod[i + 1]):
+            for j in range(filter_size):
+                src = t + start + j
+                if lod[i] <= src < lod[i + 1]:
+                    gather[t, j] = src
+                    mask[t, j] = True
+    g = jnp.asarray(gather)
+    msk = jnp.asarray(mask)
+
+    def fn(x, wv, *bb):
+        ctx = x[g.reshape(-1)].reshape(T, filter_size, d)
+        ctx = jnp.where(msk[..., None], ctx, 0.0)
+        out = ctx.reshape(T, filter_size * d) @ wv
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (input, w) + ((b,) if b is not None else ())
+    out = apply_op("sequence_conv", fn, args)
+    out._lod = lod
+    return _act(out, act)
+
+
+__all__ += ["set_lod", "get_lod", "sequence_conv", "sequence_softmax",
+            "sequence_pool", "sequence_concat", "sequence_first_step",
+            "sequence_last_step", "sequence_slice", "sequence_expand",
+            "sequence_expand_as", "sequence_pad", "sequence_unpad",
+            "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+            "sequence_reverse"]

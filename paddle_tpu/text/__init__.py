@@ -1,101 +1,11 @@
 """paddle.text (parity: python/paddle/text/ — viterbi_decode.py
-ViterbiDecoder/viterbi_decode; datasets are download-backed in the
-reference and therefore file-gated here).
-
-TPU-native: the Viterbi recursion is a lax.scan over time steps — one
-compiled kernel, batch-parallel — instead of the reference's CUDA
-viterbi_decode kernel.
+ViterbiDecoder/viterbi_decode; datasets are archive-file-backed here,
+the reference downloads them).
 """
-from __future__ import annotations
+from .viterbi_decode import viterbi_decode, ViterbiDecoder
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,
+                       UCIHousing, WMT14, WMT16)
+from . import datasets
 
-from typing import Optional
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from ..core.tensor import Tensor
-from ..nn.layer_base import Layer
-
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
-
-
-def viterbi_decode(potentials, transition_params, lengths=None,
-                   include_bos_eos_tag: bool = True, name=None):
-    """Batched Viterbi decode (parity: text/viterbi_decode.py).
-
-    potentials: [B, T, N] unary emissions; transition_params: [N, N]
-    (with BOS=N-2, EOS=N-1 rows/cols when include_bos_eos_tag);
-    lengths: [B] int64.  Returns (scores [B], paths [B, T])."""
-    e = potentials._value if isinstance(potentials, Tensor) \
-        else jnp.asarray(potentials)
-    trans = transition_params._value \
-        if isinstance(transition_params, Tensor) \
-        else jnp.asarray(transition_params)
-    B, T, N = e.shape
-    if lengths is None:
-        lens = jnp.full((B,), T, jnp.int32)
-    else:
-        lens = jnp.asarray(
-            lengths._value if isinstance(lengths, Tensor) else lengths,
-            jnp.int32)
-
-    if include_bos_eos_tag:
-        bos, eos = N - 2, N - 1
-        alpha0 = e[:, 0] + trans[bos][None, :]
-    else:
-        alpha0 = e[:, 0]
-
-    def step(carry, t):
-        alpha, = carry
-        # scores[b, i, j] = alpha[b, i] + trans[i, j] + e[b, t, j]
-        scores = alpha[:, :, None] + trans[None, :, :]
-        best_prev = jnp.argmax(scores, axis=1)               # [B, N]
-        best_score = jnp.max(scores, axis=1) + e[:, t]
-        # sequences shorter than t keep their alpha frozen
-        active = (t < lens)[:, None]
-        new_alpha = jnp.where(active, best_score, alpha)
-        return (new_alpha,), best_prev
-
-    (alpha,), backptrs = lax.scan(
-        step, (alpha0,), jnp.arange(1, T, dtype=jnp.int32))
-    # backptrs: [T-1, B, N]
-
-    if include_bos_eos_tag:
-        alpha = alpha + trans[:, eos][None, :]
-
-    last_tag = jnp.argmax(alpha, axis=-1)                    # [B]
-    scores = jnp.max(alpha, axis=-1)
-
-    def backtrace(carry, bp_t):
-        tag, t = carry
-        # bp_t: [B, N] pointers at step t+1; only follow while t+1 < len
-        prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
-        use = (t + 1) < lens
-        new_tag = jnp.where(use, prev, tag)
-        return (new_tag, t - 1), new_tag
-
-    ts = jnp.arange(T - 2, -1, -1, dtype=jnp.int32)
-    (first_tag, _), rev_path = lax.scan(
-        backtrace, (last_tag, jnp.int32(T - 2)), backptrs[::-1])
-    path = jnp.concatenate([rev_path[::-1],
-                            last_tag[None, :]], 0).T       # [B, T]
-    return (Tensor._from_value(scores),
-            Tensor._from_value(path.astype(jnp.int64)))
-
-
-class ViterbiDecoder(Layer):
-    """Parity: paddle.text.ViterbiDecoder."""
-
-    def __init__(self, transitions, include_bos_eos_tag: bool = True,
-                 name=None):
-        super().__init__()
-        self.transitions = transitions if isinstance(transitions, Tensor) \
-            else Tensor(np.asarray(transitions, np.float32))
-        self.include_bos_eos_tag = include_bos_eos_tag
-
-    def forward(self, potentials, lengths=None):
-        return viterbi_decode(potentials, self.transitions, lengths,
-                              self.include_bos_eos_tag)
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "viterbi_decode", "ViterbiDecoder"]

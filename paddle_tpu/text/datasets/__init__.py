@@ -1,0 +1,18 @@
+"""paddle.text datasets (parity: python/paddle/text/datasets/ —
+Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16).
+
+No network egress in this environment: every dataset takes the
+``data_file`` path the reference would have downloaded (same archive
+format, parsed identically); item structures/dtypes match the
+reference's ``__getitem__``.
+"""
+from .imdb import Imdb
+from .imikolov import Imikolov
+from .movielens import Movielens
+from .uci_housing import UCIHousing
+from .wmt14 import WMT14
+from .wmt16 import WMT16
+from .conll05 import Conll05st
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,0 +1,174 @@
+"""Parity: python/paddle/text/datasets/conll05.py — CoNLL-2005 SRL test
+set over (data tar with test.wsj/words + test.wsj/props, word dict,
+verb dict, target/label dict).  Items follow the reference's 9-slot
+layout: word_ids, ctx_n2/n1/0/p1/p2 predicate-context ids, predicate
+marks, predicate id, label ids."""
+from __future__ import annotations
+
+import gzip
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    """Parity: paddle.text.Conll05st(data_file, word_dict_file,
+    verb_dict_file, target_dict_file)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 emb_file=None, download=True):
+        self.data_file = _require(data_file)
+        self.word_dict_file = _require(word_dict_file)
+        self.verb_dict_file = _require(verb_dict_file)
+        self.target_dict_file = _require(target_dict_file)
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rt") if path.endswith(".gz") \
+            else open(path)
+
+    def _load_dict(self, path):
+        d = {}
+        with self._open(path) as f:
+            for i, line in enumerate(f):
+                d[line.strip()] = i
+        return d
+
+    def _load_label_dict(self, path):
+        d = {}
+        index = 0
+        with self._open(path) as f:
+            for line in f:
+                label = line.strip()
+                if label.startswith("B-"):
+                    d[label] = index
+                    d["I-" + label[2:]] = index + 1
+                    index += 2
+                elif label == "O":
+                    d[label] = index
+                    index += 1
+        return d
+
+    def _load_anno(self):
+        self.sentences = []
+        self.predicates = []
+        self.labels = []
+        with tarfile.open(self.data_file) as tf:
+            wordfile = [m.name for m in tf
+                        if m.name.endswith("words.gz")
+                        or m.name.endswith("words")][0]
+            propfile = [m.name for m in tf
+                        if m.name.endswith("props.gz")
+                        or m.name.endswith("props")][0]
+
+            def lines(name):
+                f = tf.extractfile(name)
+                data = f.read()
+                if name.endswith(".gz"):
+                    data = gzip.decompress(data)
+                return data.decode().splitlines()
+
+            sentences = []
+            labels = []
+            one_seg = []
+            for word_line, prop_line in zip(lines(wordfile),
+                                            lines(propfile)):
+                word = word_line.strip()
+                label = prop_line.strip().split()
+                if len(label) == 0:          # sentence boundary
+                    if len(one_seg) > 0:
+                        self._parse_sentence(one_seg, sentences, labels)
+                    one_seg = []
+                else:
+                    one_seg.append((word, label))
+            if one_seg:
+                self._parse_sentence(one_seg, sentences, labels)
+
+    def _parse_sentence(self, seg, sentences, labels):
+        words = [w for w, _ in seg]
+        n_pred = len(seg[0][1]) - 1
+        for p in range(n_pred):
+            # column p+1 holds the BIO chunks for predicate p
+            tags = []
+            verb = None
+            cur = None
+            for w, cols in seg:
+                chunk = cols[p + 1]
+                if chunk.startswith("("):
+                    cur = chunk[1:].split("*")[0]
+                    tags.append("B-" + cur)
+                    if cur == "V":
+                        verb = w
+                elif cur is not None:
+                    tags.append("I-" + cur)
+                else:
+                    tags.append("O")
+                if chunk.endswith(")"):
+                    cur = None
+            if verb is None:
+                continue
+            self.sentences.append(words)
+            self.predicates.append(verb)
+            self.labels.append(tags)
+
+    def get_dict(self):
+        """Parity: Conll05st.get_dict."""
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+        if verb_index > 0:
+            mark[verb_index - 1] = 1
+            ctx_n1 = sentence[verb_index - 1]
+        else:
+            ctx_n1 = "bos"
+        if verb_index > 1:
+            mark[verb_index - 2] = 1
+            ctx_n2 = sentence[verb_index - 2]
+        else:
+            ctx_n2 = "bos"
+        mark[verb_index] = 1
+        ctx_0 = sentence[verb_index]
+        if verb_index < len(labels) - 1:
+            mark[verb_index + 1] = 1
+            ctx_p1 = sentence[verb_index + 1]
+        else:
+            ctx_p1 = "eos"
+        if verb_index < len(labels) - 2:
+            mark[verb_index + 2] = 1
+            ctx_p2 = sentence[verb_index + 2]
+        else:
+            ctx_p2 = "eos"
+        word_idx = [self.word_dict.get(w, UNK_IDX) for w in sentence]
+        ctx_n2_idx = [self.word_dict.get(ctx_n2, UNK_IDX)] * sen_len
+        ctx_n1_idx = [self.word_dict.get(ctx_n1, UNK_IDX)] * sen_len
+        ctx_0_idx = [self.word_dict.get(ctx_0, UNK_IDX)] * sen_len
+        ctx_p1_idx = [self.word_dict.get(ctx_p1, UNK_IDX)] * sen_len
+        ctx_p2_idx = [self.word_dict.get(ctx_p2, UNK_IDX)] * sen_len
+        pred_idx = [self.predicate_dict.get(predicate)] * sen_len
+        label_idx = [self.label_dict.get(l) for l in labels]
+        return (np.array(word_idx), np.array(ctx_n2_idx),
+                np.array(ctx_n1_idx), np.array(ctx_0_idx),
+                np.array(ctx_p1_idx), np.array(ctx_p2_idx),
+                np.array(pred_idx), np.array(mark),
+                np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)

@@ -1,0 +1,79 @@
+"""Parity: python/paddle/text/datasets/imdb.py — IMDB sentiment over
+the aclImdb_v1.tar.gz layout (train|test)/(pos|neg)/*.txt."""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = []
+
+
+def _require(data_file):
+    if data_file is None:
+        raise RuntimeError(
+            "no network egress in this environment: pass data_file="
+            "<path to aclImdb_v1.tar.gz> (reference layout)")
+    return data_file
+
+
+class Imdb(Dataset):
+    """Parity: paddle.text.Imdb(data_file, mode, cutoff) — docs are
+    id-lists over a frequency-sorted word dict (built from train+test
+    like the reference), labels 0=pos 1=neg."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        self.data_file = _require(data_file)
+        self.mode = mode
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, text):
+        pat = re.compile(r"[^a-z\s]")
+        return pat.sub("", text.decode("latin-1").lower()).split()
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                if pattern.match(member.name):
+                    for w in self._tokenize(
+                            tf.extractfile(member).read()):
+                        word_freq[w] += 1
+        word_freq.pop("<unk>", None)
+        freq = [x for x in word_freq.items() if x[1] > cutoff]
+        sorted_freq = sorted(freq, key=lambda x: (-x[1], x[0]))
+        words, _ = list(zip(*sorted_freq)) if sorted_freq else ((), ())
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, pat in ((0, rf"aclImdb/{self.mode}/pos/.*\.txt$"),
+                           (1, rf"aclImdb/{self.mode}/neg/.*\.txt$")):
+            pattern = re.compile(pat)
+            with tarfile.open(self.data_file) as tf:
+                for member in tf.getmembers():
+                    if pattern.match(member.name):
+                        doc = self._tokenize(
+                            tf.extractfile(member).read())
+                        self.docs.append(
+                            [self.word_idx.get(w, unk) for w in doc])
+                        self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return (np.array(self.docs[idx]),
+                np.array([self.labels[idx]]))
+
+    def __len__(self):
+        return len(self.docs)

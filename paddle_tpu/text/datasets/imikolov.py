@@ -1,0 +1,91 @@
+"""Parity: python/paddle/text/datasets/imikolov.py — PTB language-model
+dataset over simple-examples.tgz (ptb.train.txt / ptb.valid.txt)."""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+
+class Imikolov(Dataset):
+    """Parity: paddle.text.Imikolov(data_file, data_type, window_size,
+    mode, min_word_freq)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        if data_type.upper() == "NGRAM":
+            assert window_size > 0
+        self.data_file = _require(data_file)
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode
+        self.min_word_freq = min_word_freq
+        self.word_idx = self._build_dict()
+        self._load_anno()
+
+    def _word_count(self, f, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in f:
+            words = line.decode().strip().split()
+            for w in words:
+                word_freq[w] += 1
+            word_freq["<s>"] += 1
+            word_freq["<e>"] += 1
+        return word_freq
+
+    def _build_dict(self):
+        train_name = "./simple-examples/data/ptb.train.txt"
+        test_name = "./simple-examples/data/ptb.valid.txt"
+        with tarfile.open(self.data_file) as tf:
+            word_freq = self._word_count(
+                tf.extractfile(test_name),
+                self._word_count(tf.extractfile(train_name)))
+            word_freq.pop("<unk>", None)
+            word_freq = [x for x in word_freq.items()
+                         if x[1] >= self.min_word_freq]
+            word_freq_sorted = sorted(word_freq,
+                                      key=lambda x: (-x[1], x[0]))
+            words, _ = list(zip(*word_freq_sorted)) \
+                if word_freq_sorted else ((), ())
+            word_idx = dict(zip(words, range(len(words))))
+            word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        fname = "./simple-examples/data/ptb.{}.txt".format(
+            "train" if self.mode == "train" else "valid")
+        unk = self.word_idx["<unk>"]
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(fname)
+            for line in f:
+                if self.data_type == "NGRAM":
+                    words = ["<s>"] + line.decode().strip().split() \
+                        + ["<e>"]
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    if len(ids) >= self.window_size:
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    words = line.decode().strip().split()
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx["<s>"]] + ids
+                    trg = ids + [self.word_idx["<e>"]]
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx]) \
+            if self.data_type == "SEQ" else np.array(self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,118 @@
+"""Parity: python/paddle/text/datasets/movielens.py — MovieLens-1M
+rating prediction over the ml-1m.zip layout (users.dat / movies.dat /
+ratings.dat, '::'-separated)."""
+from __future__ import annotations
+
+import re
+import zipfile
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+
+class MovieInfo:
+    """Parity: movielens.MovieInfo."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    """Parity: movielens.UserInfo."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = [1, 18, 25, 35, 45, 50, 56].index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """Parity: paddle.text.Movielens(data_file, mode, test_ratio,
+    rand_seed)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode in ("train", "test")
+        self.data_file = _require(data_file)
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info = {}
+        self.movie_title_dict = {}
+        self.categories_dict = {}
+        self.user_info = {}
+        with zipfile.ZipFile(self.data_file) as package:
+            for info in package.namelist():
+                if "movies.dat" in info:
+                    with package.open(info) as f:
+                        for line in f:
+                            line = line.decode("latin-1").strip()
+                            idx, title, categories = line.split("::")
+                            m = pattern.match(title)
+                            title = m.group(1) if m else title
+                            cats = categories.split("|")
+                            for c in cats:
+                                self.categories_dict.setdefault(
+                                    c, len(self.categories_dict))
+                            for w in title.split():
+                                self.movie_title_dict.setdefault(
+                                    w.lower(),
+                                    len(self.movie_title_dict))
+                            self.movie_info[int(idx)] = MovieInfo(
+                                idx, cats, title)
+                elif "users.dat" in info:
+                    with package.open(info) as f:
+                        for line in f:
+                            line = line.decode("latin-1").strip()
+                            uid, gender, age, job, _ = line.split("::")
+                            self.user_info[int(uid)] = UserInfo(
+                                uid, gender, age, job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            ratings = [n for n in package.namelist()
+                       if "ratings.dat" in n][0]
+            with package.open(ratings) as f:
+                for line in f:
+                    line = line.decode("latin-1").strip()
+                    if (np.random.rand() < self.test_ratio) == is_test:
+                        uid, mid, rating, _ = line.split("::")
+                        uid, mid = int(uid), int(mid)
+                        if uid not in self.user_info or \
+                                mid not in self.movie_info:
+                            continue
+                        usr = self.user_info[uid].value()
+                        mov = self.movie_info[mid].value(
+                            self.categories_dict, self.movie_title_dict)
+                        self.data.append(
+                            usr + mov + [[float(rating)]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)

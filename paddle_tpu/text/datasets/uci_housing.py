@@ -1,0 +1,45 @@
+"""Parity: python/paddle/text/datasets/uci_housing.py — Boston housing
+regression over the whitespace-separated housing.data file."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+FEATURE_NAMES = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT",
+                 "convert"]
+
+
+class UCIHousing(Dataset):
+    """Parity: paddle.text.UCIHousing(data_file, mode) — features
+    min-max normalized by the training statistics, 80/20 split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test")
+        self.data_file = _require(data_file)
+        self.mode = mode
+        self.dtype = "float32"
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" \
+            else data[offset:]
+
+    def __getitem__(self, idx):
+        d = self.data[idx]
+        return (np.array(d[:-1]).astype(self.dtype),
+                np.array(d[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,81 @@
+"""Parity: python/paddle/text/datasets/wmt14.py — WMT14 en-fr over the
+wmt14 tar layout (*/src.dict, */trg.dict, <mode>/<mode> bitext with
+tab-separated src/trg)."""
+from __future__ import annotations
+
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """Parity: paddle.text.WMT14(data_file, mode, dict_size)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode in ("train", "test", "gen")
+        self.data_file = _require(data_file)
+        self.mode = mode
+        assert dict_size > 0
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i < size:
+                    out[line.strip().decode()] = i
+                else:
+                    break
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            src_name = [m.name for m in f
+                        if m.name.endswith("src.dict")]
+            trg_name = [m.name for m in f
+                        if m.name.endswith("trg.dict")]
+            assert len(src_name) == 1 and len(trg_name) == 1
+            self.src_dict = to_dict(f.extractfile(src_name[0]),
+                                    self.dict_size)
+            self.trg_dict = to_dict(f.extractfile(trg_name[0]),
+                                    self.dict_size)
+            file_name = f"{self.mode}/{self.mode}"
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [self.src_dict.get(w, UNK_IDX)
+                               for w in [START] + parts[0].split()
+                               + [END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    trg_ids_next = trg_ids + [self.trg_dict[END]]
+                    trg_ids = [self.trg_dict[START]] + trg_ids
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append(trg_ids)
+                    self.trg_ids_next.append(trg_ids_next)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -1,0 +1,116 @@
+"""Parity: python/paddle/text/datasets/wmt16.py — WMT16 en-de over the
+wmt16.tar.gz layout (wmt16/{train,val,test} with tab-separated
+bitext); dictionaries built from the train split with <s>/<e>/<unk>
+heads, cached next to the archive."""
+from __future__ import annotations
+
+import os
+import tarfile
+from collections import defaultdict
+
+import numpy as np
+
+from ...io import Dataset
+from .imdb import _require
+
+__all__ = []
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+
+class WMT16(Dataset):
+    """Parity: paddle.text.WMT16(data_file, mode, src_dict_size,
+    trg_dict_size, lang)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode in ("train", "test", "val")
+        self.data_file = _require(data_file)
+        self.mode = mode
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict_size should be set as positive number"
+        self.src_dict_size = min(
+            src_dict_size,
+            TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS)
+        self.trg_dict_size = min(
+            trg_dict_size,
+            TOTAL_DE_WORDS if lang == "en" else TOTAL_EN_WORDS)
+        self.src_dict = self._load_dict(lang, self.src_dict_size)
+        self.trg_dict = self._load_dict(
+            "de" if lang == "en" else "en", self.trg_dict_size)
+        self._load_data()
+
+    def _dict_path(self, lang, dict_size):
+        return os.path.join(os.path.dirname(self.data_file),
+                            f"wmt16_{lang}_{dict_size}.dict")
+
+    def _load_dict(self, lang, dict_size):
+        path = self._dict_path(lang, dict_size)
+        found = os.path.exists(path) and \
+            len(open(path, "rb").readlines()) == dict_size
+        if not found:
+            self._build_dict(path, dict_size, lang)
+        word_dict = {}
+        with open(path, "rb") as f:
+            for idx, line in enumerate(f):
+                word_dict[line.strip().decode()] = idx
+        return word_dict
+
+    def _build_dict(self, path, dict_size, lang):
+        word_freq = defaultdict(int)
+        col = 0 if lang == "en" else 1
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    word_freq[w] += 1
+        with open(path, "wb") as fout:
+            fout.write(
+                f"{START_MARK}\n{END_MARK}\n{UNK_MARK}\n".encode())
+            for idx, (word, _) in enumerate(sorted(
+                    word_freq.items(), key=lambda x: x[1],
+                    reverse=True)):
+                if idx + 3 == dict_size:
+                    break
+                fout.write(word.encode() + b"\n")
+
+    def _load_data(self):
+        start_id = self.src_dict[START_MARK]
+        end_id = self.src_dict[END_MARK]
+        unk_id = self.src_dict[UNK_MARK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + [
+                    self.src_dict.get(w, unk_id)
+                    for w in parts[src_col].split()] + [end_id]
+                trg_ids = [self.trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                self.src_ids.append(src_ids)
+                self.trg_ids.append([start_id] + trg_ids)
+                self.trg_ids_next.append(trg_ids + [end_id])
+
+    def get_dict(self, lang, reverse=False):
+        """Parity: WMT16.get_dict."""
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

@@ -156,3 +156,40 @@ class CppExtension:
 
     def __init__(self, sources, *a, **kw):
         self.sources = sources
+
+
+class BuildExtension:
+    """setuptools command shim (parity: cpp_extension.BuildExtension);
+    ``setup`` drives the in-tree compiler directly, so this carries only
+    the options the reference command accepts."""
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+    def __init__(self, *a, **kw):
+        pass
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Parity: paddle.utils.cpp_extension.setup — build the extension's
+    sources into a shared library under the build directory (the
+    ``python setup.py install`` flow of the reference collapses to the
+    same in-tree g++ compile that ``load`` uses; import the ops with
+    ``load(name, sources, functions)``)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    built = []
+    for ext in exts:
+        if ext is None:
+            continue
+        sources = getattr(ext, "sources", ext)
+        ext_name = getattr(ext, "name", None) or name or "custom_ops"
+        so_path = _compile(ext_name, sources,
+                           kwargs.get("extra_cflags"),
+                           kwargs.get("verbose", False))
+        built.append(so_path)
+    return built
+
+
+__all__ += ["BuildExtension", "setup"]

@@ -1,0 +1,223 @@
+"""static API tail: program-level autodiff, scopes, host ops, program
+io, layer helpers, sequence family.
+
+Reference analogs: python/paddle/base/backward.py (append_backward /
+gradients), base/executor.py (Scope/scope_guard), static/nn/common.py
+(layer helpers, py_func, ExponentialMovingAverage), static/nn/
+sequence_lod.py (sequence ops), static/io.py (serialize family).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+static = paddle.static
+nn = static.nn
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    static.reset_default_programs()
+    yield
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def test_append_backward_symbolic_replay():
+    """Grad statements are recorded symbolically: a second run with a
+    DIFFERENT feed recomputes grads from that feed (not the capture
+    placeholders)."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 3])
+        w = static.create_parameter([3, 1], "float32", name="ab_w")
+        loss = (paddle.matmul(x, w) ** 2).mean()
+        pg = static.append_backward(loss, parameter_list=[w])
+    exe = static.Executor()
+    w_np = np.asarray(pg[0][0]._value)
+    for seed in (0, 1):
+        xf = np.random.RandomState(seed).rand(4, 3).astype("float32")
+        out = exe.run(prog, feed={"x": xf}, fetch_list=[loss, pg[0][1]])
+        np.testing.assert_allclose(out[0], ((xf @ w_np) ** 2).mean(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[1], 2 * xf.T @ (xf @ w_np) / 4,
+                                   rtol=1e-5)
+
+
+def test_gradients_wrt_feed():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 3])
+        x.stop_gradient = False
+        w = static.create_parameter([3, 1], "float32", name="g_w")
+        loss = (paddle.matmul(x, w) ** 2).mean()
+        g = static.gradients(loss, x)[0]
+    exe = static.Executor()
+    xf = np.random.RandomState(2).rand(4, 3).astype("float32")
+    w_np = np.asarray(prog.all_parameters()[0]._value)
+    out = exe.run(prog, feed={"x": xf}, fetch_list=[g])
+    np.testing.assert_allclose(out[0], 2 * (xf @ w_np) @ w_np.T / 4,
+                               rtol=1e-5)
+
+
+def test_scope_guard_and_global_scope():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.create_parameter([2], "float32", name="sv_w")
+    v = static.global_scope().find_var("sv_w")
+    assert v is not None and np.asarray(v.get_tensor()).shape == (2,)
+    with static.scope_guard(static.Scope()):
+        assert static.global_scope().find_var("sv_w") is None
+    assert static.global_scope().find_var("sv_w") is not None
+
+
+def test_py_func_forward_backward():
+    t = _t(np.array([2.0, 3.0], np.float32))
+    t.stop_gradient = False
+    o = static.py_func(lambda v: v * v, t,
+                       _t(np.zeros(2, np.float32)),
+                       backward_func=lambda x, y, dy: 2 * x * dy)
+    o.sum().backward()
+    np.testing.assert_allclose(np.asarray(o._value), [4.0, 9.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.grad._value), [4.0, 6.0],
+                               rtol=1e-6)
+
+
+def test_print_passthrough_in_program():
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = static.data("a", [2, 2])
+        b = static.Print(a, message="dbg")
+        c = (b * 2).sum()
+    exe = static.Executor()
+    af = np.arange(4, dtype=np.float32).reshape(2, 2)
+    out = exe.run(prog, feed={"a": af}, fetch_list=[c])
+    np.testing.assert_allclose(out[0], af.sum() * 2, rtol=1e-6)
+
+
+def test_serialize_program_roundtrip():
+    prog = static.Program()
+    with static.program_guard(prog):
+        a = static.data("a", [2, 2])
+        w = static.create_parameter([2, 2], "float32", name="ser_w")
+        c = (paddle.matmul(a, w) ** 2).sum()
+    data = static.serialize_program([a], [c], program=prog)
+    prog2 = static.deserialize_program(data)
+    exe = static.Executor()
+    af = np.arange(4, dtype=np.float32).reshape(2, 2)
+    res = exe.run(prog2, feed={"a": af})
+    w_np = np.asarray(prog.all_parameters()[0]._value)
+    v = res[0]
+    np.testing.assert_allclose(
+        np.asarray(getattr(v, "_value", v)), ((af @ w_np) ** 2).sum(),
+        rtol=1e-5)
+
+
+def test_static_save_load_state(tmp_path):
+    prog = static.Program()
+    with static.program_guard(prog):
+        w = static.create_parameter([3], "float32", name="sl_w")
+    w_np = np.asarray(w._value).copy()
+    static.save(prog, str(tmp_path / "m"))
+    st = static.load_program_state(str(tmp_path / "m"))
+    w._value = w._value * 0
+    static.set_program_state(prog, st)
+    np.testing.assert_allclose(np.asarray(w._value), w_np)
+
+
+def test_ema_apply_restore():
+    prog = static.Program()
+    with static.program_guard(prog):
+        w = static.create_parameter([2], "float32", name="ema_w")
+    ema = static.ExponentialMovingAverage(0.5)
+    ema._track([w])
+    w._value = w._value * 0 + 1.0
+    ema.update([w])
+    w._value = w._value * 0 + 3.0
+    ema.update([w])
+    # ema = 0.5*1 + 0.5*3 = 2; bias corr (1-0.25) -> 2/0.75
+    with ema.apply():
+        np.testing.assert_allclose(np.asarray(w._value),
+                                   2.0 / 0.75, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(w._value), 3.0, rtol=1e-6)
+
+
+def test_layer_helpers_build_and_backward():
+    prog = static.Program()
+    rng = np.random.RandomState(0)
+    with static.program_guard(prog):
+        img = static.data("img", [2, 3, 8, 8])
+        h = nn.conv2d(img, 4, 3, padding=1, act="relu")
+        h = nn.batch_norm(h)
+        h = nn.group_norm(h, groups=2)
+        flat = h.reshape([2, -1])
+        fcout = nn.fc(flat, 16, activation="relu")
+        ln = nn.layer_norm(fcout)
+        pr = nn.prelu(ln, "all")
+        x2 = static.data("x2", [2, 5])
+        y2 = static.data("y2", [2, 7])
+        bt = nn.bilinear_tensor_product(x2, y2, 6)
+        lab = static.data("lab", [2, 1], dtype="int64")
+        nce_l = nn.nce(fcout, lab, 30, num_neg_samples=5)
+        loss = (pr ** 2).mean() + (bt ** 2).mean() + nce_l.mean()
+        pg = static.append_backward(loss)
+    exe = static.Executor()
+    feed = {"img": rng.rand(2, 3, 8, 8).astype("float32"),
+            "x2": rng.rand(2, 5).astype("float32"),
+            "y2": rng.rand(2, 7).astype("float32"),
+            "lab": rng.randint(0, 30, (2, 1)).astype("int64")}
+    fetch = [loss] + [g for _, g in pg if g is not None]
+    out = exe.run(prog, feed=feed, fetch_list=fetch)
+    assert np.isfinite(out[0])
+    nonzero = sum(1 for o in out[1:] if np.abs(o).sum() > 0)
+    assert nonzero >= len(out) - 3   # bn moving stats carry no grad
+
+
+def test_sequence_ops_match_hand_computed():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    x = nn.set_lod(_t(data.copy()), [0, 2, 5])
+    np.testing.assert_allclose(
+        np.asarray(nn.sequence_pool(x, "sum")._value),
+        [data[:2].sum(0), data[2:].sum(0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.sequence_last_step(x)._value), data[[1, 4]])
+    rv = np.asarray(nn.sequence_reverse(x)._value)
+    np.testing.assert_allclose(rv, data[[1, 0, 4, 3, 2]])
+    # expand per reference doc example
+    xe = nn.set_lod(_t(np.array([[1.], [2.], [3.]], np.float32)),
+                    [0, 1, 3])
+    ye = nn.set_lod(_t(np.zeros((5, 1), np.float32)), [0, 2, 5])
+    ex = nn.sequence_expand(xe, ye)
+    np.testing.assert_allclose(np.asarray(ex._value).ravel(),
+                               [1, 1, 2, 3, 2, 3, 2, 3])
+    padded, lens = nn.sequence_pad(x, _t(np.float32(0.0)))
+    assert padded.shape == [2, 3, 2]
+    unp = nn.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(np.asarray(unp._value), data)
+    ids = nn.set_lod(_t(np.array([1, 2, 3, 4, 5], np.int64)), [0, 2, 5])
+    en = np.asarray(nn.sequence_enumerate(ids, 2)._value)
+    np.testing.assert_array_equal(
+        en, [[1, 2], [2, 0], [3, 4], [4, 5], [5, 0]])
+    sm = np.asarray(nn.sequence_softmax(
+        nn.set_lod(_t(np.array([1., 2., 1., 2., 3.], np.float32)),
+                   [0, 2, 5]))._value)
+    np.testing.assert_allclose([sm[:2].sum(), sm[2:].sum()], [1.0, 1.0],
+                               rtol=1e-5)
+
+
+def test_sequence_conv_trains():
+    data = np.random.RandomState(0).rand(5, 2).astype("float32")
+    prog = static.Program()
+    with static.program_guard(prog):
+        xin = static.data("xin", [5, 2])
+        xin.stop_gradient = False
+        nn.set_lod(xin, [0, 2, 5])
+        cv = nn.sequence_conv(xin, 4, filter_size=3)
+        loss = (cv ** 2).sum()
+        pg = static.append_backward(loss)
+    exe = static.Executor()
+    out = exe.run(prog, feed={"xin": data}, fetch_list=[loss, pg[0][1]])
+    assert np.isfinite(out[0]) and np.abs(out[1]).sum() > 0

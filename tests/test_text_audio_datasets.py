@@ -1,0 +1,220 @@
+"""text datasets + audio backends/datasets over reference-format
+fixtures (no egress: data_file/archive_dir point at locally-built
+archives with the exact layouts the reference downloads).
+
+Reference analogs: python/paddle/text/datasets/*.py,
+python/paddle/audio/backends/wave_backend.py, audio/datasets/tess.py.
+"""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                             UCIHousing, WMT14, WMT16)
+
+
+def _add(tf, name, text):
+    data = text.encode()
+    ti = tarfile.TarInfo(name)
+    ti.size = len(data)
+    tf.addfile(ti, io.BytesIO(data))
+
+
+def test_imdb_fixture(tmp_path):
+    p = str(tmp_path / "aclImdb_v1.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        for split in ("train", "test"):
+            for lab, stem in (("pos", "great movie"),
+                              ("neg", "terrible boring")):
+                for i in range(2):
+                    _add(tf, f"aclImdb/{split}/{lab}/{i}.txt",
+                         (stem + " film ") * 60)
+    ds = Imdb(data_file=p, mode="train", cutoff=1)
+    assert len(ds) == 4
+    doc, label = ds[0]
+    assert doc.ndim == 1 and label.shape == (1,)
+    assert "film" in ds.word_idx and "<unk>" in ds.word_idx
+    assert {int(l) for _, l in (ds[i] for i in range(4))} == {0, 1}
+
+
+def test_imikolov_fixture(tmp_path):
+    p = str(tmp_path / "simple-examples.tgz")
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "./simple-examples/data/ptb.train.txt",
+             "the cat sat\nthe dog ran\n" * 30)
+        _add(tf, "./simple-examples/data/ptb.valid.txt",
+             "the cat ran\n" * 20)
+    ds = Imikolov(data_file=p, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    assert len(ds) > 0 and ds[0].shape == (2,)
+    src, trg = Imikolov(data_file=p, data_type="SEQ", mode="test",
+                        min_word_freq=1)[0]
+    assert len(src) == len(trg)   # <s>+ids vs ids+<e>
+
+
+def test_uci_housing_fixture(tmp_path):
+    p = str(tmp_path / "housing.data")
+    np.savetxt(p, np.random.RandomState(0).rand(20, 14), fmt="%.4f")
+    tr = UCIHousing(data_file=p, mode="train")
+    te = UCIHousing(data_file=p, mode="test")
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert len(tr) == 16 and len(te) == 4
+    assert x.dtype == np.float32
+
+
+def test_movielens_fixture(tmp_path):
+    p = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::10::12345\n2::F::35::5::54321\n")
+        z.writestr("ml-1m/ratings.dat", "\n".join(
+            f"{u}::{m}::{r}::0" for u, m, r in
+            [(1, 1, 5), (1, 2, 3), (2, 1, 4), (2, 2, 2)] * 3) + "\n")
+    ds = Movielens(data_file=p, mode="train", test_ratio=0.2,
+                   rand_seed=0)
+    item = ds[0]
+    # usr(4) + mov(3) + rating(1) slots, reference layout
+    assert len(item) == 8 and item[-1].shape == (1,)
+
+
+def test_wmt14_fixture(tmp_path):
+    p = str(tmp_path / "wmt14.tgz")
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "data/src.dict", "<s>\n<e>\n<unk>\nhello\nworld\n")
+        _add(tf, "data/trg.dict", "<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _add(tf, "train/train",
+             "hello world\tbonjour monde\nworld hello\tmonde bonjour\n")
+        _add(tf, "test/test", "hello\tbonjour\n")
+    ds = WMT14(data_file=p, mode="train", dict_size=5)
+    src, trg, trg_next = ds[0]
+    assert src[0] == 0 and src[-1] == 1       # <s> ... <e>
+    assert trg[0] == 0 and trg_next[-1] == 1
+    assert len(WMT14(data_file=p, mode="test", dict_size=5)) == 1
+
+
+def test_wmt16_fixture(tmp_path):
+    p = str(tmp_path / "wmt16.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        bitext = "hello world\thallo welt\nworld peace\twelt frieden\n"
+        _add(tf, "wmt16/train", bitext * 5)
+        _add(tf, "wmt16/val", bitext)
+        _add(tf, "wmt16/test", bitext)
+    ds = WMT16(data_file=p, mode="train", src_dict_size=8,
+               trg_dict_size=8)
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"]
+    assert trg_next[-1] == ds.src_dict["<e>"]
+    assert ds.get_dict("en", reverse=True)[0] == "<s>"
+
+
+def test_conll05_fixture(tmp_path):
+    p = str(tmp_path / "conll05st.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "conll05st-release/test.wsj/words",
+             "The\ncat\nsat\n\n")
+        _add(tf, "conll05st-release/test.wsj/props",
+             "- (A0*\n- *)\nsat (V*)\n\n")
+    wd = str(tmp_path / "wordDict.txt")
+    open(wd, "w").write("The\ncat\nsat\n")
+    vd = str(tmp_path / "verbDict.txt")
+    open(vd, "w").write("sat\n")
+    td = str(tmp_path / "targetDict.txt")
+    open(td, "w").write("B-A0\nB-V\nO\n")
+    ds = Conll05st(data_file=p, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td)
+    assert len(ds) == 1
+    item = ds[0]
+    assert len(item) == 9 and len(item[0]) == 3    # 9-slot SRL layout
+    assert item[-1][2] == ds.label_dict["B-V"]   # "sat" is the verb
+
+
+def test_datasets_require_data_file():
+    with pytest.raises(RuntimeError, match="no network egress"):
+        Imdb()
+
+
+# -- audio ------------------------------------------------------------------
+def test_wav_codec_roundtrip(tmp_path):
+    sr = 16000
+    t = np.linspace(0, 0.1, 1600, dtype=np.float32)
+    wav = np.stack([0.5 * np.sin(2 * np.pi * 440 * t),
+                    0.25 * np.sin(2 * np.pi * 880 * t)])
+    path = str(tmp_path / "t.wav")
+    paddle.audio.save(path, paddle.to_tensor(wav), sr)
+    meta = paddle.audio.info(path)
+    assert (meta.sample_rate, meta.num_channels,
+            meta.bits_per_sample) == (sr, 2, 16)
+    back, sr2 = paddle.audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(back._value), wav, atol=1e-3)
+    seg, _ = paddle.audio.load(path, frame_offset=100, num_frames=50,
+                               channels_first=False)
+    assert seg.shape == [50, 2]
+    raw, _ = paddle.audio.load(path, normalize=False)
+    assert np.abs(np.asarray(raw._value)).max() > 1000   # int16 scale
+
+
+def test_audio_backend_registry():
+    assert paddle.audio.backends.get_current_backend() == "wave_backend"
+    assert "wave_backend" in \
+        paddle.audio.backends.list_available_backends()
+    with pytest.raises(NotImplementedError):
+        paddle.audio.backends.set_backend("nonexistent")
+
+
+def test_tess_dataset(tmp_path):
+    sr = 16000
+    t = np.linspace(0, 0.05, 800, dtype=np.float32)
+    tess_dir = str(tmp_path / "TESS_data")
+    os.makedirs(tess_dir)
+    emotions = ["angry", "happy", "sad", "neutral", "fear", "disgust",
+                "ps"]
+    for i, emo in enumerate(emotions):
+        w = (0.1 * np.sin(2 * np.pi * (300 + 50 * i) * t))[None, :]
+        paddle.audio.save(os.path.join(tess_dir, f"OAF_w_{emo}.wav"),
+                          paddle.to_tensor(w.astype(np.float32)), sr)
+    dev = paddle.audio.datasets.TESS(mode="dev", split=1,
+                                     archive_dir=tess_dir)
+    train = paddle.audio.datasets.TESS(mode="train", split=1,
+                                       archive_dir=tess_dir)
+    assert len(dev) + len(train) == len(emotions)
+    wavdata, label = train[0]
+    assert wavdata.dtype == np.float32 and 0 <= label < 7
+    feat, _ = paddle.audio.datasets.TESS(
+        mode="train", split=1, archive_dir=tess_dir, feat_type="mfcc",
+        n_mfcc=13)[0]
+    assert feat.shape[0] == 13
+
+
+def test_esc50_dataset(tmp_path):
+    sr = 16000
+    t = np.linspace(0, 0.05, 800, dtype=np.float32)
+    root = str(tmp_path / "ESC-50-master")
+    os.makedirs(os.path.join(root, "meta"))
+    os.makedirs(os.path.join(root, "audio"))
+    rows = ["filename,fold,target,category"]
+    for i in range(6):
+        fn = f"1-{i}-A-{i % 3}.wav"
+        w = (0.1 * np.sin(2 * np.pi * (200 + 40 * i) * t))[None, :]
+        paddle.audio.save(os.path.join(root, "audio", fn),
+                          paddle.to_tensor(w.astype(np.float32)), sr)
+        rows.append(f"{fn},{i % 5 + 1},{i % 3},cat{i % 3}")
+    open(os.path.join(root, "meta", "esc50.csv"), "w") \
+        .write("\n".join(rows) + "\n")
+    tr = paddle.audio.datasets.ESC50(mode="train", split=1,
+                                     archive_dir=str(tmp_path))
+    dv = paddle.audio.datasets.ESC50(mode="dev", split=1,
+                                     archive_dir=str(tmp_path))
+    assert len(tr) + len(dv) == 6
+    wav, label = tr[0]
+    assert wav.dtype == np.float32 and 0 <= label < 3
