@@ -832,3 +832,21 @@ def test_ring_attention_hybrid_mesh_dp_sep():
                                atol=1e-4)
     (got ** 2).sum().backward()
     assert np.isfinite(qp.grad.numpy()).all()
+
+
+def test_ring_bench_artifact_gate():
+    """The ring-vs-flash perf gate is a driver-readable artifact
+    (VERDICT r4 ask #7): when BENCH_ATTN_r05.json exists (written by
+    tools/ring_bench.py on TPU), its recorded ratio must satisfy the
+    1.5x gate; the artifact also carries the flash-block table."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_ATTN_r05.json")
+    if not os.path.exists(path):
+        import pytest
+        pytest.skip("artifact not generated on this host (needs TPU)")
+    rec = json.load(open(path))
+    assert rec["passed"]   # the unrounded gate decision at measurement time
+    assert rec["flash_blocks"]
+    assert rec["max_abs_err_vs_full"] < 0.1

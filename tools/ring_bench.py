@@ -1,10 +1,17 @@
 """Ring-attention kernel bench: worst-rank ring compute vs single-chip
 flash at the same total sequence (round-4 ask #7 gate: within 1.5x).
 
+Emits a driver-readable artifact (BENCH_ATTN_r05.json at the repo root,
+or the path in argv[1]): the measured ring/full wall-clock ratio plus
+the flash-block table the autotuner would pick for the bench shapes,
+so the perf gate is visible across rounds instead of living in a
+commit message (round-4 weak #3).
+
 One real chip is available, so the ring's ppermute arrivals are stood in
 by local slices — the measured work IS the per-rotation flash blocks +
 logsumexp combine that _ring_flash_impl runs per rank; comm rides ICI
 concurrently on real meshes.  Run from the repo root."""
+import json
 import sys
 import time
 
@@ -116,6 +123,34 @@ def main():
     # 1.5x of single-chip flash at the same total sequence
     ratio = t_ring / t_full
     print(f"wall-clock ratio ring/full: {ratio:.3f} (gate: < 1.5)")
+
+    # flash-block table: what _select_flash_blocks resolves for the
+    # bench shapes (the autotune winners when the cache is warm,
+    # otherwise the documented defaults)
+    blocks = {}
+    for (bb, hh, ss, dd) in ((B, H, S, D), (8, 16, 2048, 64),
+                             (4, 20, 2048, 128)):
+        qq = jnp.zeros((bb, hh, ss, dd), jnp.bfloat16)
+        bq, bk = pk._select_flash_blocks(qq, qq, qq, True)
+        blocks[f"B{bb}_H{hh}_S{ss}_D{dd}"] = [int(bq), int(bk)]
+
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_ATTN_r05.json"
+    record = {
+        "metric": "ring_attention_worst_rank_vs_full_flash_wallclock",
+        "ring_over_full_ratio": round(ratio, 4),
+        "gate": 1.5,
+        "passed": bool(ratio < 1.5),
+        "t_full_ms": round(t_full * 1e3, 3),
+        "t_ring_ms": round(t_ring * 1e3, 3),
+        "config": {"B": B, "H": H, "S": S, "D": D, "n_ring": N_RING},
+        "kernel_efficiency_full_over_ring": round(eff_full / eff_ring,
+                                                  4),
+        "flash_blocks": blocks,
+        "max_abs_err_vs_full": float(err),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"wrote {out_path}")
     assert ratio < 1.5
 
 
