@@ -44,4 +44,14 @@ def set_verbosity(level=0, also_to_stdout=False):
     _TRANSLATOR_LOG["also_to_stdout"] = bool(also_to_stdout)
 
 
-__all__ += ["set_code_level", "set_verbosity"]
+__all__ += ["set_code_level", "set_verbosity",
+            "LlamaLayerwiseTrainStep"]
+
+
+def __getattr__(name):
+    # lazy: layerwise pulls the llama model + pallas kernels, which
+    # plain to_static/save/load users should not pay for at import
+    if name == "LlamaLayerwiseTrainStep":
+        from .layerwise import LlamaLayerwiseTrainStep
+        return LlamaLayerwiseTrainStep
+    raise AttributeError(name)

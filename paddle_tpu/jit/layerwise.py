@@ -47,6 +47,20 @@ from ..ops.pallas_kernels import _flash_rope_sdpa, rope_tables
 
 __all__ = ["LlamaLayerwiseTrainStep"]
 
+# stacked-buffer leaf -> LlamaForCausalLM parameter name (one source of
+# truth for from_model/state_dict/set_state_dict)
+_KEY_MAP = {
+    "wq": "llama.layers.{}.self_attn.q_proj.weight",
+    "wk": "llama.layers.{}.self_attn.k_proj.weight",
+    "wv": "llama.layers.{}.self_attn.v_proj.weight",
+    "wo": "llama.layers.{}.self_attn.o_proj.weight",
+    "gate": "llama.layers.{}.mlp.gate_proj.weight",
+    "up": "llama.layers.{}.mlp.up_proj.weight",
+    "down": "llama.layers.{}.mlp.down_proj.weight",
+    "ln1": "llama.layers.{}.input_layernorm.weight",
+    "ln2": "llama.layers.{}.post_attention_layernorm.weight",
+}
+
 
 def _rms_norm(x, w, eps):
     x32 = x.astype(jnp.float32)
@@ -195,20 +209,57 @@ class LlamaLayerwiseTrainStep:
             "emb": jnp.array(sd["llama.embed_tokens.weight"]),
             "norm": jnp.array(sd["llama.norm.weight"]),
             "head": jnp.array(sd["lm_head.weight"]),
-            "blocks": {
-                "wq": stack("llama.layers.{}.self_attn.q_proj.weight"),
-                "wk": stack("llama.layers.{}.self_attn.k_proj.weight"),
-                "wv": stack("llama.layers.{}.self_attn.v_proj.weight"),
-                "wo": stack("llama.layers.{}.self_attn.o_proj.weight"),
-                "gate": stack("llama.layers.{}.mlp.gate_proj.weight"),
-                "up": stack("llama.layers.{}.mlp.up_proj.weight"),
-                "down": stack("llama.layers.{}.mlp.down_proj.weight"),
-                "ln1": stack("llama.layers.{}.input_layernorm.weight"),
-                "ln2": stack(
-                    "llama.layers.{}.post_attention_layernorm.weight"),
-            },
+            "blocks": {name: stack(fmt)
+                       for name, fmt in _KEY_MAP.items()},
         }
         self.opt_state = self._init_opt_state()
+        return self
+
+    def state_dict(self):
+        """Checkpoint in LlamaForCausalLM's key layout (per-layer slices
+        of the stacked buffers), so a layerwise-trained model loads
+        straight into the standard eager model for serving — and vice
+        versa.  The unstacked leaves are COPIED: the step donates
+        self.params, so aliasing views would die at the next step."""
+        from ..core.tensor import Tensor
+        if self.params is None:
+            raise RuntimeError("no parameters: call init()/from_model()")
+        out = {
+            "llama.embed_tokens.weight": Tensor._from_value(
+                jnp.array(self.params["emb"])),
+            "llama.norm.weight": Tensor._from_value(
+                jnp.array(self.params["norm"])),
+            "lm_head.weight": Tensor._from_value(
+                jnp.array(self.params["head"])),
+        }
+        for name, stacked in self.params["blocks"].items():
+            for l in range(self.cfg.num_hidden_layers):
+                out[_KEY_MAP[name].format(l)] = Tensor._from_value(
+                    stacked[l])
+        return out
+
+    def set_state_dict(self, state):
+        """Load a LlamaForCausalLM-layout state dict into the stacked
+        buffers (inverse of state_dict)."""
+        def val(k):
+            v = state[k]
+            return getattr(v, "_value", v)
+
+        L = self.cfg.num_hidden_layers
+        self.params = {
+            "emb": jnp.asarray(val("llama.embed_tokens.weight"),
+                               self._dtype),
+            "norm": jnp.asarray(val("llama.norm.weight"), self._dtype),
+            "head": jnp.asarray(val("lm_head.weight"), self._dtype),
+            "blocks": {
+                name: jnp.stack(
+                    [jnp.asarray(val(fmt.format(l)), self._dtype)
+                     for l in range(L)])
+                for name, fmt in _KEY_MAP.items()
+            },
+        }
+        if self.opt_state is None:
+            self.opt_state = self._init_opt_state()
         return self
 
     def _init_opt_state(self):
@@ -322,3 +373,4 @@ class LlamaLayerwiseTrainStep:
 
     def param_count(self) -> int:
         return param_count(self.cfg)
+

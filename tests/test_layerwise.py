@@ -85,3 +85,27 @@ def test_layerwise_head_loss_matches_criterion():
     want = float(np.asarray(
         crit(logits, paddle.to_tensor(labels))._value))
     np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_layerwise_checkpoint_interop_with_eager_model():
+    """Train layerwise -> state_dict in LlamaForCausalLM key layout ->
+    the eager model computes the SAME loss (serving handoff), and the
+    dict loads back into a fresh layerwise step."""
+    cfg = llama_tiny_config()
+    lw = LlamaLayerwiseTrainStep(cfg, Adafactor(1e-2, parameters=[]))
+    lw.init(0)
+    (ids, lab), = _batches(cfg, n=1)
+    for _ in range(3):
+        lw(ids, lab)
+    sd = lw.state_dict()
+    model = LlamaForCausalLM(cfg)
+    model.set_state_dict(sd)
+    crit = LlamaPretrainingCriterion()
+    l_eager = float(np.asarray(crit(
+        model(paddle.to_tensor(ids)), paddle.to_tensor(lab))._value))
+    l_lw = float(np.asarray(lw(ids, lab)._value))
+    assert abs(l_eager - l_lw) < 5e-4 * max(1.0, abs(l_eager))
+    lw2 = LlamaLayerwiseTrainStep(cfg, Adafactor(1e-2, parameters=[]))
+    lw2.set_state_dict(sd)
+    l2 = float(np.asarray(lw2(ids, lab)._value))
+    assert abs(l2 - l_lw) < 5e-4
