@@ -190,11 +190,12 @@ def main():
             num_key_value_heads=16, max_position_embeddings=2048,
             dtype="bfloat16")
         configs = [
-            # continuity line (round-1/2 metric).  MFU ~0.54 is this
-            # config's character, not an overhead: head_dim = 64 runs
-            # the MXU's 128-deep contraction at half rate on 21% of the
-            # FLOPs, and the profile shows the chip ~100% busy
-            # (BASELINE.md "373M-line MFU analysis")
+            # continuity line (round-1/2 metric).  MFU ~0.58 after the
+            # round-5 kernel work; the residual vs the 0.63-0.64 lines
+            # is this config's character, not an overhead: head_dim =
+            # 64 runs the MXU's 128-deep contraction at half rate on
+            # 21% of the FLOPs, and the profile shows the chip ~100%
+            # busy (BASELINE.md "373M-line MFU analysis")
             (cfg_373m, 8, 2048, 10, "float32", "adamw"),
             # >=1B-param, head_dim 128, per-layer recompute + bf16
             # moments to fit 16 GB HBM

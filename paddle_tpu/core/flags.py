@@ -49,6 +49,13 @@ def define_flag(name: str, default, help: str = "", type: type | None = None,
     return value
 
 
+def _canon(name: str) -> str:
+    # the reference spells flags "FLAGS_<name>" at the set_flags/get_flags
+    # surface (python/paddle/base/framework.py set_flags); the registry
+    # stores bare names — accept both
+    return name[6:] if name.startswith("FLAGS_") else name
+
+
 def get_flags(flags=None) -> Dict[str, Any]:
     """Query flag values. ``flags`` may be a name, list of names, or None (all)."""
     with _lock:
@@ -58,9 +65,10 @@ def get_flags(flags=None) -> Dict[str, Any]:
             flags = [flags]
         out = {}
         for k in flags:
-            if k not in _REGISTRY:
+            c = _canon(k)
+            if c not in _REGISTRY:
                 raise ValueError(f"Flag {k!r} is not defined")
-            out[k] = _REGISTRY[k].value
+            out[k] = _REGISTRY[c].value
         return out
 
 
@@ -72,6 +80,7 @@ def set_flags(flags: Dict[str, Any]):
     """Set flag values (same surface as paddle.set_flags)."""
     with _lock:
         for k, v in flags.items():
+            k = _canon(k)
             if k not in _REGISTRY:
                 raise ValueError(f"Flag {k!r} is not defined")
             d = _REGISTRY[k]

@@ -240,7 +240,11 @@ class LlamaLayerwiseTrainStep:
 
     def set_state_dict(self, state):
         """Load a LlamaForCausalLM-layout state dict into the stacked
-        buffers (inverse of state_dict)."""
+        buffers (inverse of state_dict).  Optimizer state is re-
+        initialized — like from_model — since moment statistics
+        accumulated for the previous weights do not apply to the loaded
+        ones (restoring mid-run optimizer state is the distributed-
+        checkpoint API's job, which saves it explicitly)."""
         def val(k):
             v = state[k]
             return getattr(v, "_value", v)
@@ -258,8 +262,7 @@ class LlamaLayerwiseTrainStep:
                 for name, fmt in _KEY_MAP.items()
             },
         }
-        if self.opt_state is None:
-            self.opt_state = self._init_opt_state()
+        self.opt_state = self._init_opt_state()
         return self
 
     def _init_opt_state(self):

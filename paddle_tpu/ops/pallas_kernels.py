@@ -55,6 +55,13 @@ _DIMNUM_TN = (((0,), (0,)), ((), ()))    # x.T @ y
 _MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 _MASK_THRESH = 0.5 * _MASK_VALUE      # any real score is above this
 _LANES = 128
+# Scores are kept in exp2 space: scale*log2(e) is folded into the q (or k)
+# tile ONCE per VMEM tile, so the inner loop runs exp2 directly — saving
+# the per-[bq,bk]-block scale multiply AND the log2e multiply XLA would
+# emit inside exp.  lse residuals stay in natural-log space at the API
+# boundary (the *_LN2 conversion happens at store).
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 
 def _fit_block(want, total):
@@ -107,6 +114,48 @@ def _rope_tile(t_ref, cos_ref, sin_ref, neg_sin=False):
     return tf * c + rot * sn
 
 
+def _causal_run(qi, kb, block_q, block_k, causal_off):
+    """True iff q tile ``qi`` has any visible column in k tile ``kb``
+    (the q tile's last row reaches the k tile's first column).  Single
+    source of truth shared by the kernels' skip predicate and the
+    streamed-block index remaps below — they MUST agree or a skipped
+    grid step would read a remapped (wrong) tile."""
+    return (qi + 1) * block_q - 1 + causal_off >= kb * block_k
+
+
+def _need_mask(qi, kb, block_q, block_k, causal_off):
+    """True iff the (qi, kb) block contains any masked entry (its first
+    row does not reach its last column); fully-visible blocks skip the
+    iota/compare/select masking and the dead-row guard."""
+    return qi * block_q + causal_off < kb * block_k + block_k - 1
+
+
+def _causal_stream_kv(i, j, block_q, block_k, causal_off, causal):
+    """Index remap for a streamed k/v grid axis under causal masking: a
+    skipped (fully-masked) k block re-fetches block 0 — the block the
+    NEXT q row starts with — so skipped grid steps cost no DMA and
+    double as prefetch (the in-tree flash kernel's kv_index_map trick;
+    without it the upper triangle streams ~60% extra k/v bytes through
+    a stalled pipeline).  ``i`` is the resident q-tile index, ``j`` the
+    streamed k-tile index."""
+    if not causal:
+        return j
+    return jnp.where(_causal_run(i, j, block_q, block_k, causal_off),
+                     j, 0)
+
+
+def _causal_stream_q(i, j, block_q, block_k, causal_off, causal):
+    """Index remap for a streamed q grid axis (k-tile-resident backward
+    kernels): skipped ABOVE-diagonal q blocks re-fetch the first running
+    q block of this k row.  ``i`` is the resident k-tile index, ``j``
+    the streamed q-tile index."""
+    if not causal:
+        return j
+    first = jnp.maximum(0, (i * block_k - causal_off) // block_q)
+    return jnp.where(_causal_run(j, i, block_q, block_k, causal_off),
+                     j, first)
+
+
 def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
                       kv_blocks: int, causal_off: int = 0,
                       with_rope: bool = False):
@@ -127,9 +176,8 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
         i = 7
     o_ref = refs[i]
     rest = refs[i + 1:]
-    if with_rope:
-        qr_s = rest[-1]
-        rest = rest[:-1]
+    qs_s = rest[-1]    # exp2-space q tile (scaled by scale*log2e; +rope)
+    rest = rest[:-1]
     save_lse = len(rest) == 4
     if save_lse:
         lse_ref, m_s, l_s, acc_s = rest
@@ -139,39 +187,39 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     bq, d = q_ref.shape[1], q_ref.shape[-1]
+    c = scale * _LOG2E
 
     @pl.when(kb == 0)
     def _init():
         m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
         l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+        # scale (and rope) q once per q tile — per-k-block rope dominated
+        # the kernel, and a per-block scale multiply would cost a full
+        # [bq, bk] VPU pass where this is [bq, d] once
         if with_rope:
-            # rope(q) once per q tile — recomputing it per k block
-            # dominated the kernel (the k-block rope is structural:
-            # online softmax pins kb as the inner grid dim)
-            qr_s[...] = _rope_tile(q_ref[0], cos_i_ref,
-                                   sin_i_ref).astype(qr_s.dtype)
+            qs_s[...] = (_rope_tile(q_ref[0], cos_i_ref, sin_i_ref)
+                         * c).astype(qs_s.dtype)
+        else:
+            qs_s[...] = (q_ref[0].astype(jnp.float32)
+                         * c).astype(qs_s.dtype)
 
-    # visible iff the q tile's last row reaches the k tile's first column
     run = True
     if causal:
-        run = (qi + 1) * bq - 1 + causal_off >= kb * block_k
+        run = _causal_run(qi, kb, bq, block_k, causal_off)
 
-    @pl.when(run)
-    def _body():
+    def _tile_body(mask: bool):
+        q = qs_s[...]
         if with_rope:
-            q = qr_s[...]
             k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
                 k_ref.dtype)
         else:
-            q = q_ref[0]                               # [bq, d]
             k = k_ref[0]                               # [bk, d]
         v = v_ref[0]
+        # scores arrive pre-scaled into exp2 space via qs_s
         s = lax.dot_general(q, k, _DIMNUM_NT,
                             preferred_element_type=jnp.float32)
-        if scale != 1.0:
-            s = s * scale
-        if causal:
+        if mask:
             rows = qi * bq + causal_off + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             cols = kb * block_k + lax.broadcasted_iota(
@@ -181,32 +229,48 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
         l_prev = l_s[...]
         m_curr = jnp.max(s, axis=1)[:, None]           # [bq, 1]
         m_next = jnp.maximum(m_prev, m_curr)           # [bq, 128]
-        p = jnp.exp(s - _cols(m_next, block_k))
-        if causal:
-            # rows whose every score is masked must contribute nothing
-            # (a finite mask value would otherwise give p = exp(0) = 1)
+        p = jnp.exp2(s - _cols(m_next, block_k))
+        if mask:
+            # rows whose every score so far is masked must contribute
+            # nothing (a finite mask value would otherwise give
+            # p = exp2(0) = 1).  Dead rows can only exist in blocks with
+            # masked entries, so the guard lives in the masked body only.
             p = jnp.where(_cols(m_next, block_k) > _MASK_THRESH, p, 0.0)
-        alpha = jnp.exp(m_prev - m_next)               # [bq, 128]
-        l_corr = alpha * l_prev
-        l_next = jnp.sum(p, axis=1)[:, None] + l_corr  # [bq, 128]
+        alpha = jnp.exp2(m_prev - m_next)              # [bq, 128]
         m_s[...] = m_next
-        l_s[...] = l_next
-        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
-        acc_s[...] = acc_s[...] * _cols(l_corr * l_inv, d)
+        l_s[...] = jnp.sum(p, axis=1)[:, None] + alpha * l_prev
+        # FA2 deferred normalization: accumulate unnormalized, divide by
+        # l once at store — saves a reciprocal + [bq, d] multiply per block
         pv = lax.dot_general(p.astype(v.dtype), v, _DIMNUM_NN,
                              preferred_element_type=jnp.float32)
-        acc_s[...] += pv * _cols(l_inv, d)
+        acc_s[...] = acc_s[...] * _cols(alpha, d) + pv
+
+    if causal:
+        # skip the iota/compare/select masking entirely on fully-visible
+        # blocks (the majority for block-aligned causal self-attention)
+        need_mask = _need_mask(qi, kb, bq, block_k, causal_off)
+        @pl.when(run & need_mask)
+        def _body_masked():
+            _tile_body(True)
+
+        @pl.when(run & jnp.logical_not(need_mask))
+        def _body_full():
+            _tile_body(False)
+    else:
+        _tile_body(False)
 
     @pl.when(kb == kv_blocks - 1)
     def _store():
-        o_ref[0] = acc_s[...].astype(o_ref.dtype)
+        l_v = l_s[...]
+        l_inv = jnp.where(l_v > 0.0, 1.0 / l_v, 0.0)
+        o_ref[0] = (acc_s[...] * _cols(l_inv, d)).astype(o_ref.dtype)
         if save_lse:
-            # log-sum-exp residual for the backward, lane-broadcast to
-            # the mosaic-tileable 128-lane layout; -inf marks rows that
-            # attended nothing
-            m_v = m_s[...]
-            l_v = l_s[...]
-            lse = jnp.where(l_v > 0.0, m_v + jnp.log(l_v), -jnp.inf)
+            # natural-log log-sum-exp residual for the backward (scores
+            # live in exp2 space in-kernel: convert m back with ln2),
+            # lane-broadcast to the mosaic-tileable 128-lane layout;
+            # -inf marks rows that attended nothing
+            lse = jnp.where(l_v > 0.0,
+                            m_s[...] * _LN2 + jnp.log(l_v), -jnp.inf)
             lse_ref[0] = lse.astype(jnp.float32)
 
 
@@ -238,15 +302,23 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
                                causal=causal, scale=scale,
                                kv_blocks=n_kb, causal_off=Sk - Sq,
                                with_rope=rope is not None)
+    causal_off = Sk - Sq
+
+    def _kv_j(i, j):
+        return _causal_stream_kv(i, j, block_q, block_k, causal_off,
+                                 causal)
+
     q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, D),
+                           lambda b, i, j: (b, _kv_j(i, j), 0))
     in_specs = [q_spec, kv_spec, kv_spec]
     args = [q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
             v.reshape(B * H, Sk, D)]
     if rope is not None:
         cos, sin = rope
         cs_i = pl.BlockSpec((block_q, D), lambda b, i, j: (i, 0))
-        cs_j = pl.BlockSpec((block_k, D), lambda b, i, j: (j, 0))
+        cs_j = pl.BlockSpec((block_k, D),
+                            lambda b, i, j: (_kv_j(i, j), 0))
         in_specs += [cs_i, cs_i, cs_j, cs_j]
         args += [cos, sin, cos, sin]
     out_specs = [q_spec]
@@ -267,9 +339,8 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
             out_shape=out_shape,
             scratch_shapes=[pltpu.VMEM((block_q, 128), jnp.float32),
                             pltpu.VMEM((block_q, 128), jnp.float32),
-                            pltpu.VMEM((block_q, D), jnp.float32)]
-            + ([pltpu.VMEM((block_q, D), q.dtype)]
-               if rope is not None else []),
+                            pltpu.VMEM((block_q, D), jnp.float32),
+                            pltpu.VMEM((block_q, D), q.dtype)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"))
             if (_HAS_PLTPU and not _INTERPRET[0]) else None,
@@ -284,23 +355,33 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
     return out
 
 
-def _bwd_p_ds(q, k, v, do, lse, delta, *, causal, scale, row_off, col_off):
+def _bwd_p_ds(q2, k, v, do, lse2, delta, *, mask, row_off, col_off):
     """Shared backward tile math (used by all backward kernels):
     recompute p from the saved lse, then ds = p * (dp - delta).
-    delta is [bq, 1]; lse is the [bq, 128] lane-broadcast residual."""
-    bq, bk = q.shape[0], k.shape[0]
-    s = lax.dot_general(q, k, _DIMNUM_NT,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
+
+    exp2-space convention: EXACTLY ONE of q2/k carries the scale*log2e
+    factor (folded in once per VMEM tile by the caller) and lse2 is the
+    [bq, 128] lane-broadcast residual already multiplied by log2e, so
+    p = exp2(q2.k - lse2) = softmax probabilities with no per-block
+    scale pass.  ds is returned in natural d/ds space (p is unitless).
+    ``mask`` is a static flag: fully-visible causal blocks skip the
+    iota/compare/select AND the dead-row guard (dead rows can only
+    exist in blocks that contain masked entries).  delta is [bq, 1]."""
+    bq, bk = q2.shape[0], k.shape[0]
+    s = lax.dot_general(q2, k, _DIMNUM_NT,
+                        preferred_element_type=jnp.float32)
+    if mask:
         rows = row_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = col_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(rows >= cols, s, _MASK_VALUE)
-    # dead rows have lse = -inf: exp(s - lse) would be inf -> 0 them
-    finite = jnp.isfinite(lse[:, :1])
-    p = jnp.where(finite, jnp.exp(s - _cols(lse, bk)), 0.0)
+        # dead rows have lse = -inf: exp2(s - lse2) would be inf -> 0
+        finite = jnp.isfinite(lse2[:, :1])
+        p = jnp.where(finite, jnp.exp2(s - _cols(lse2, bk)), 0.0)
+    else:
+        p = jnp.exp2(s - _cols(lse2, bk))
     dp = lax.dot_general(do, v, _DIMNUM_NT,
                          preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta)).astype(q.dtype)
+    ds = (p * (dp - delta)).astype(k.dtype)
     return p, ds
 
 
@@ -317,15 +398,11 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
         cos_i_ref, sin_i_ref, cos_j_ref, sin_j_ref = refs[6:10]
         i = 10
     dq_ref = refs[i]
-    rest = refs[i + 1:]
-    if with_rope:
-        dq_s, delta_s, qr_s = rest
-    else:
-        dq_s, delta_s = rest
-        qr_s = None
+    dq_s, delta_s, qs_s = refs[i + 1:]
     qi = pl.program_id(1)
     kb = pl.program_id(2)
     bq, d = q_ref.shape[1], q_ref.shape[-1]
+    c = scale * _LOG2E
 
     @pl.when(kb == 0)
     def _init():
@@ -334,29 +411,42 @@ def _flash_bwd_dq_kernel(*refs, block_k: int,
         o32 = o_ref[0].astype(jnp.float32)
         delta_s[...] = jnp.broadcast_to(
             jnp.sum(do32 * o32, axis=1)[:, None], delta_s.shape)
+        # exp2-space q tile: scale*log2e (and rope) folded in once
         if with_rope:
-            qr_s[...] = _rope_tile(q_ref[0], cos_i_ref,
-                                   sin_i_ref).astype(qr_s.dtype)
+            qs_s[...] = (_rope_tile(q_ref[0], cos_i_ref, sin_i_ref)
+                         * c).astype(qs_s.dtype)
+        else:
+            qs_s[...] = (q_ref[0].astype(jnp.float32)
+                         * c).astype(qs_s.dtype)
 
     run = True
     if causal:
-        run = (qi + 1) * bq - 1 + causal_off >= kb * block_k
+        run = _causal_run(qi, kb, bq, block_k, causal_off)
 
-    @pl.when(run)
-    def _body():
+    def _tile_body(mask: bool):
         if with_rope:
-            q = qr_s[...]
             k = _rope_tile(k_ref[0], cos_j_ref, sin_j_ref).astype(
                 k_ref.dtype)
         else:
-            q = q_ref[0]
             k = k_ref[0]
-        _, ds = _bwd_p_ds(q, k, v_ref[0], do_ref[0], lse_ref[0],
-                          delta_s[:, :1], causal=causal, scale=scale,
+        _, ds = _bwd_p_ds(qs_s[...], k, v_ref[0], do_ref[0],
+                          lse_ref[0], delta_s[:, :1], mask=mask,
                           row_off=qi * bq + causal_off,
                           col_off=kb * block_k)
         dq_s[...] += lax.dot_general(
             ds, k, _DIMNUM_NN, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        need_mask = _need_mask(qi, kb, bq, block_k, causal_off)
+        @pl.when(run & need_mask)
+        def _body_masked():
+            _tile_body(True)
+
+        @pl.when(run & jnp.logical_not(need_mask))
+        def _body_full():
+            _tile_body(False)
+    else:
+        _tile_body(False)
 
     @pl.when(kb == kv_blocks - 1)
     def _store():
@@ -391,37 +481,35 @@ def _flash_bwd_kv_kernel(*refs, block_q: int,
         dq_ref = refs[i]
         i += 1
     dk_ref, dv_ref = refs[i:i + 2]
-    rest = refs[i + 2:]
-    if with_rope:
-        dk_s, dv_s, kr_s = rest
-    else:
-        dk_s, dv_s = rest
-        kr_s = None
+    dk_s, dv_s, ks_s = refs[i + 2:]
     ki = pl.program_id(1)
     qb = pl.program_id(2)
     bk = k_ref.shape[1]
+    c = scale * _LOG2E
 
     @pl.when(qb == 0)
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+        # here k is the resident tile, so the exp2-space scale*log2e
+        # factor folds into K (q streams through unscaled)
         if with_rope:
-            kr_s[...] = _rope_tile(k_ref[0], cos_k_ref,
-                                   sin_k_ref).astype(kr_s.dtype)
+            ks_s[...] = (_rope_tile(k_ref[0], cos_k_ref, sin_k_ref)
+                         * c).astype(ks_s.dtype)
+        else:
+            ks_s[...] = (k_ref[0].astype(jnp.float32)
+                         * c).astype(ks_s.dtype)
 
     run = True
     if causal:
-        run = (qb + 1) * block_q - 1 + causal_off >= ki * bk
+        run = _causal_run(qb, ki, block_q, bk, causal_off)
 
-    @pl.when(run)
-    def _body():
+    def _tile_body(mask: bool):
         if with_rope:
             q = _rope_tile(q_ref[0], cos_q_ref, sin_q_ref).astype(
                 q_ref.dtype)
-            k = kr_s[...]
         else:
             q = q_ref[0]
-            k = k_ref[0]
         do = do_ref[0]
         # delta recomputed per (k,q) cell: the o tile is DMA'd for this
         # cell regardless (block specs fetch per grid step), so caching
@@ -429,8 +517,8 @@ def _flash_bwd_kv_kernel(*refs, block_q: int,
         delta = jnp.sum(do.astype(jnp.float32)
                         * o_ref[0].astype(jnp.float32),
                         axis=1)[:, None]               # [bq, 1]
-        p, ds = _bwd_p_ds(q, k, v_ref[0], do, lse_ref[0], delta,
-                          causal=causal, scale=scale,
+        p, ds = _bwd_p_ds(q, ks_s[...], v_ref[0], do,
+                          lse_ref[0], delta, mask=mask,
                           row_off=qb * block_q + causal_off,
                           col_off=ki * bk)
         dv_s[...] += lax.dot_general(p.astype(do.dtype), do, _DIMNUM_TN,
@@ -438,9 +526,11 @@ def _flash_bwd_kv_kernel(*refs, block_q: int,
         dk_s[...] += lax.dot_general(
             ds, q, _DIMNUM_TN, preferred_element_type=jnp.float32) * scale
         if emit_dq:
+            # ks_s carries the exp2-space factor c; dq wants ds @ k_rope
+            # * scale, so correct by scale/c = 1/log2e
             dq = lax.dot_general(
-                ds, k, _DIMNUM_NN,
-                preferred_element_type=jnp.float32) * scale
+                ds, ks_s[...], _DIMNUM_NN,
+                preferred_element_type=jnp.float32) * (1.0 / _LOG2E)
             if with_rope:
                 # inverse-rotate each partial in-kernel (linear, so it
                 # commutes with the sum).  Measured: cheaper than one
@@ -449,6 +539,18 @@ def _flash_bwd_kv_kernel(*refs, block_q: int,
                 # HBM-bound pattern the in-kernel rope exists to avoid)
                 dq = _rope_tile(dq, cos_q_ref, sin_q_ref, neg_sin=True)
             dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    if causal:
+        need_mask = _need_mask(qb, ki, block_q, bk, causal_off)
+        @pl.when(run & need_mask)
+        def _body_masked():
+            _tile_body(True)
+
+        @pl.when(run & jnp.logical_not(need_mask))
+        def _body_full():
+            _tile_body(False)
+    else:
+        _tile_body(False)
 
     if emit_dq and causal:
         @pl.when(jnp.logical_not(run))
@@ -490,7 +592,9 @@ def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
             v.reshape(BH, Sk, D), out.reshape(BH, Sq, D),
             g.reshape(BH, Sq, D))
     with_rope = rope is not None
-    lser = jnp.broadcast_to(lse.reshape(BH, Sq)[..., None],
+    # exp2-space residual (×log2e) built once at graph level — cheaper
+    # than a per-grid-step [block_q, 128] multiply inside the kernel
+    lser = jnp.broadcast_to((lse * _LOG2E).reshape(BH, Sq)[..., None],
                             (BH, Sq, 128))
 
     def qs(sel):
@@ -502,19 +606,22 @@ def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
                             lambda b, i, j: (b, sel(i, j), 0))
 
     by_i = lambda i, j: i
-    by_j = lambda i, j: j
+
+    def by_j(i, j):
+        return _causal_stream_q(i, j, block_q, block_k, causal_off,
+                                causal)
 
     in_specs = [qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
                 pl.BlockSpec((1, block_q, 128),
-                             lambda b, i, j: (b, j, 0))]
+                             lambda b, i, j: (b, by_j(i, j), 0))]
     call_args = (*args, lser)
     if with_rope:
         cos, sin = rope
         in_specs += [
             pl.BlockSpec((block_k, D), lambda b, i, j: (i, 0)),
             pl.BlockSpec((block_k, D), lambda b, i, j: (i, 0)),
-            pl.BlockSpec((block_q, D), lambda b, i, j: (j, 0)),
-            pl.BlockSpec((block_q, D), lambda b, i, j: (j, 0))]
+            pl.BlockSpec((block_q, D), lambda b, i, j: (by_j(i, j), 0)),
+            pl.BlockSpec((block_q, D), lambda b, i, j: (by_j(i, j), 0))]
         call_args += (cos, sin, cos, sin)
 
     with jax.enable_x64(False):
@@ -534,8 +641,8 @@ def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
                 jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
                 jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)],
             scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
-                            pltpu.VMEM((block_k, D), jnp.float32)]
-            + ([pltpu.VMEM((block_k, D), k.dtype)] if with_rope else []),
+                            pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), k.dtype)],
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary"))
             if (_HAS_PLTPU and not _INTERPRET[0]) else None,
@@ -564,8 +671,10 @@ def _flash_bwd_auto(q, k, v, out, lse, g, causal, rope=None):
         # lengths can snap to a much smaller divisor (e.g. Sk=2176 ->
         # bk=128, n_kb=17), where the partials buffer would dwarf dq
         if bk and Sk // bk <= 4:
+            # block_q=512 measured ~7-11% faster than 256 on v5e at both
+            # D=64 and D=128 (tools/attn_sweep.py; BENCH_ATTN artifact)
             return _flash_attention_bwd_fused(q, k, v, out, lse, g,
-                                              causal, 256, bk, rope=rope)
+                                              causal, 512, bk, rope=rope)
     return _flash_attention_bwd(q, k, v, out, lse, g, causal, rope=rope)
 
 
@@ -593,9 +702,10 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             v.reshape(B * H, Sk, D), out.reshape(B * H, Sq, D),
             g.reshape(B * H, Sq, D))
     with_rope = rope is not None
-    # lane-broadcast lse to the mosaic-tileable [BH, Sq, 128] layout
-    # (transient per-layer; the saved residual stays compact [BH, Sq])
-    lser = jnp.broadcast_to(lse.reshape(B * H, Sq)[..., None],
+    # lane-broadcast lse to the mosaic-tileable [BH, Sq, 128] layout, in
+    # exp2 space (×log2e) so the kernels consume it without a per-step
+    # multiply (transient per-layer; the saved residual stays compact)
+    lser = jnp.broadcast_to((lse * _LOG2E).reshape(B * H, Sq)[..., None],
                             (B * H, Sq, 128))
 
     def qs(sel):
@@ -611,7 +721,17 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
                             lambda b, i, j: (b, sel(i, j), 0))
 
     by_i = lambda i, j: i
-    by_j = lambda i, j: j
+
+    # causal skipped-block remaps: dq pass streams k tiles (skipped ks
+    # are the LATE ones -> restart at block 0); kv pass streams q tiles
+    # (skipped qs are the EARLY above-diagonal ones -> first running)
+    def kb_j(i, j):
+        return _causal_stream_kv(i, j, block_q, block_k, causal_off,
+                                 causal)
+
+    def qb_j(i, j):
+        return _causal_stream_q(i, j, block_q, block_k, causal_off,
+                                causal)
 
     def cs_q(sel):
         return pl.BlockSpec((block_q, D), lambda b, i, j: (sel(i, j), 0))
@@ -626,12 +746,12 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
         interpret=_INTERPRET[0])
 
     with jax.enable_x64(False):
-        dq_in_specs = [qs(by_i), ks(by_j), ks(by_j), qs(by_i), qs(by_i),
+        dq_in_specs = [qs(by_i), ks(kb_j), ks(kb_j), qs(by_i), qs(by_i),
                        rows(by_i)]
         dq_args = (*args, lser)
         if with_rope:
             cos, sin = rope
-            dq_in_specs += [cs_q(by_i), cs_q(by_i), cs_k(by_j), cs_k(by_j)]
+            dq_in_specs += [cs_q(by_i), cs_q(by_i), cs_k(kb_j), cs_k(kb_j)]
             dq_args += (cos, sin, cos, sin)
         dq = pl.pallas_call(
             functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -642,17 +762,17 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             out_specs=qs(by_i),
             out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32),
-                            pltpu.VMEM((block_q, 128), jnp.float32)]
-            + ([pltpu.VMEM((block_q, D), q.dtype)] if with_rope else []),
+                            pltpu.VMEM((block_q, 128), jnp.float32),
+                            pltpu.VMEM((block_q, D), q.dtype)],
             **params,
         )(*dq_args)
 
-        kv_in_specs = [qs(by_j), ks(by_i), ks(by_i), qs(by_j), qs(by_j),
-                       rows(by_j)]
+        kv_in_specs = [qs(qb_j), ks(by_i), ks(by_i), qs(qb_j), qs(qb_j),
+                       rows(qb_j)]
         kv_args = (*args, lser)
         if with_rope:
             cos, sin = rope
-            kv_in_specs += [cs_k(by_i), cs_k(by_i), cs_q(by_j), cs_q(by_j)]
+            kv_in_specs += [cs_k(by_i), cs_k(by_i), cs_q(qb_j), cs_q(qb_j)]
             kv_args += (cos, sin, cos, sin)
         dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_kv_kernel, block_q=block_q,
@@ -664,8 +784,8 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
             out_shape=[jax.ShapeDtypeStruct((B * H, Sk, D), k.dtype),
                        jax.ShapeDtypeStruct((B * H, Sk, D), v.dtype)],
             scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
-                            pltpu.VMEM((block_k, D), jnp.float32)]
-            + ([pltpu.VMEM((block_k, D), k.dtype)] if with_rope else []),
+                            pltpu.VMEM((block_k, D), jnp.float32),
+                            pltpu.VMEM((block_k, D), k.dtype)],
             **params,
         )(*kv_args)
 
