@@ -69,14 +69,14 @@ def _conv(name, nd, x, weight, bias, stride, padding, dilation, groups,
         if channel_last:
             # OIHW -> HWIO
             w = jnp.moveaxis(w, (0, 1), (-1, -2))
+        # NOTE: no preferred_element_type here — the TPU MXU already
+        # accumulates bf16 convs in f32 internally, and requesting an
+        # f32 output + downcast breaks jax's conv transpose rule under
+        # value_and_grad (the f32 cotangent meets the bf16 weight)
         out = lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if v.dtype == jnp.bfloat16 else None)
-        if v.dtype == jnp.bfloat16:
-            out = out.astype(jnp.bfloat16)
+            feature_group_count=groups)
         if b:
             bshape = [1] * out.ndim
             bshape[-1 if channel_last else 1] = b[0].size

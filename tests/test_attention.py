@@ -850,3 +850,45 @@ def test_ring_bench_artifact_gate():
     assert rec["passed"]   # the unrounded gate decision at measurement time
     assert rec["flash_blocks"]
     assert rec["max_abs_err_vs_full"] < 0.1
+
+
+def test_causal_stream_remap_lockstep_with_run_predicate():
+    """The streamed-block DMA remaps (_causal_stream_kv/_q) must agree
+    with the kernels' _causal_run skip predicate for EVERY grid cell:
+    running cells keep their own index, skipped cells must re-fetch a
+    block that is itself valid (so the fetch doubles as prefetch and
+    never reads out of range).  Pure-python sweep over block shapes and
+    decode offsets — guards the lock-step invariant the kernel relies
+    on (a desync would make a skipped step DMA a wrong tile)."""
+    from paddle_tpu.ops.pallas_kernels import (
+        _causal_run, _causal_stream_kv, _causal_stream_q)
+
+    for Sq, Sk, bq, bk in ((512, 512, 128, 128), (512, 512, 128, 256),
+                           (256, 512, 128, 128), (128, 512, 64, 128),
+                           (512, 512, 256, 128), (384, 768, 128, 128)):
+        off = Sk - Sq
+        n_q, n_k = Sq // bq, Sk // bk
+        for qi in range(n_q):
+            for kb in range(n_k):
+                run = bool(_causal_run(qi, kb, bq, bk, off))
+                kv = int(_causal_stream_kv(qi, kb, bq, bk, off, True))
+                qv = int(_causal_stream_q(kb, qi, bq, bk, off, True))
+                if run:
+                    assert kv == kb, (Sq, Sk, bq, bk, qi, kb)
+                else:
+                    # skipped k block -> block 0 (next q row's start)
+                    assert kv == 0
+                # _causal_stream_q: i = resident k tile (kb), j =
+                # streamed q tile (qi); skipped q blocks must remap to
+                # the FIRST running q block of this k row
+                if bool(_causal_run(qi, kb, bq, bk, off)):
+                    assert qv == qi
+                else:
+                    assert 0 <= qv < n_q
+                    assert bool(_causal_run(qv, kb, bq, bk, off)), \
+                        (Sq, Sk, bq, bk, qi, kb, qv)
+                # non-causal: identity
+                assert int(_causal_stream_kv(qi, kb, bq, bk, off,
+                                             False)) == kb
+                assert int(_causal_stream_q(kb, qi, bq, bk, off,
+                                            False)) == qi

@@ -114,11 +114,15 @@ def main():
     # the ratio; each side takes the median over its POOLED raw samples
     # (~27), with a peak-FLOP/s floor rejecting stall-deflated ones —
     # a trial landing wholly inside a stall burst is then 9 outlier
-    # samples out of 27, not one of three votes
+    # samples out of 27, not one of three votes.  The floor derives
+    # from the actual chip's peak (x1.02 tolerance), not a constant, so
+    # faster chips (v5p/v6e) don't reject honest samples.
+    from bench import _peak_flops
+    peak_bound = _peak_flops(jax.devices()[0]) * 1.02
     fulls, rings = [], []
     for _ in range(3):
-        fulls += bench(full_flash, floor=flops_full / 200e12)
-        rings += bench(ring_worst_rank, floor=flops_ring / 200e12)
+        fulls += bench(full_flash, floor=flops_full / peak_bound)
+        rings += bench(ring_worst_rank, floor=flops_ring / peak_bound)
     t_full = float(np.median(fulls)) if fulls else float("inf")
     t_ring = float(np.median(rings)) if rings else float("inf")
     print(f"full flash  S={S}:  {t_full*1e3:.2f} ms  "
