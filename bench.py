@@ -20,6 +20,7 @@ Measurement notes (this environment tunnels the TPU, so sync is subtle):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -80,13 +81,20 @@ def _timed_steps(step_fn, batches, steps):
     then N vs 2N delta timing (cancels the constant RTT + dispatch
     overhead), with a fallback to the plain 2N average when the delta
     is degenerate.  ``step_fn(*batch) -> loss`` fetched via np.asarray
-    (the only real barrier over the tunnel).  Returns
+    (the only real barrier over the tunnel).  ``batches`` is a list of
+    batch tuples (cycled by index) or a zero-arg callable yielding the
+    next batch (streaming DataLoaders).  Returns
     (step_time_seconds, last_loss)."""
+    if callable(batches):
+        get = lambda i: batches()
+    else:
+        get = lambda i: batches[i % len(batches)]
+
     def run(n, start):
         loss = None
         t0 = time.perf_counter()
         for i in range(n):
-            loss = step_fn(*batches[(start + i) % len(batches)])
+            loss = step_fn(*get(start + i))
         val = float(np.asarray(loss._value))
         return time.perf_counter() - t0, val
 
@@ -270,6 +278,130 @@ def _bench_bert_finetune(batch, seq, steps, peak_flops, on_tpu):
                      "samples/s", note=f"batch={batch} seq={seq}")
 
 
+def _bench_yolo_pipeline(batch, steps, on_tpu):
+    """BASELINE.json configs[2]: detector train throughput through the
+    REAL input pipeline — multi-worker DataLoader (CPU decode/augment
+    in workers, shm transport) -> HBM -> fused train step over
+    yolo_loss.  The detector is the YOLOv3-tiny-class model assembled
+    from the core detection ops (vision/models/yolo.py; the reference
+    keeps full PP-YOLOE in PaddleDetection — core paddle ships the
+    ops).  Async dispatch overlaps the host-side loader work with
+    device compute; the stderr note separates loader-only throughput
+    so the overlap is visible."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.vision.models.yolo import yolov3_tiny
+    from paddle_tpu.jit.train_step import TrainStep
+
+    class _SynthCoco(Dataset):
+        """COCO-shaped samples over a small in-memory u8 image pool
+        (fork-shared, like a page-cached dataset); __getitem__ does the
+        CPU-side work — decode-equivalent slicing + random flip augment
+        — and ships uint8 HWC.  Normalize/transpose runs ON DEVICE
+        inside the fused step: u8 transport is 4x less host->HBM
+        traffic, the TPU-native pipeline layout."""
+
+        _POOL = 48
+
+        def __init__(self, n):
+            self.n = n
+            rng = np.random.RandomState(1234)
+            self.images = rng.randint(
+                0, 255, (self._POOL, 320, 320, 3), dtype=np.uint8)
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            img_u8 = self.images[i % self._POOL]
+            if i % 2:
+                img_u8 = np.ascontiguousarray(img_u8[:, ::-1])  # hflip
+            nb = int(rng.randint(1, 12))
+            gt = np.zeros((20, 5), np.float32)
+            gt[:nb, 0:2] = rng.rand(nb, 2) * 0.6 + 0.2
+            gt[:nb, 2:4] = rng.rand(nb, 2) * 0.3 + 0.05
+            gt[:nb, 4] = rng.randint(0, 80, nb)
+            return img_u8, gt
+
+    paddle.seed(0)
+    det = yolov3_tiny(num_classes=80)
+
+    class _WithPreproc(paddle.nn.Layer):
+        """On-device preprocessing head: u8 HWC -> normalized f32 CHW.
+        XLA fuses the cast/scale into the first conv's input."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, img_u8):
+            x = img_u8.astype("float32") / 255.0 - 0.5
+            return self.inner(x.transpose([0, 3, 1, 2]))
+
+    model = _WithPreproc(det)
+    opt = paddle.optimizer.Momentum(0.01, momentum=0.9,
+                                    parameters=model.parameters())
+
+    def criterion(outs, gt):
+        box = gt[:, :, 0:4]
+        label = gt[:, :, 4].astype("int64")
+        # per-image mean: keeps the gradient scale batch-invariant
+        return det.loss(outs, box, label) / float(batch)
+
+    step = TrainStep(model, criterion, opt, clip_norm=10.0)
+    n_need = batch * (3 * steps + 6)
+    # batch messages are ~1.2 MB/image; size the shm ring for them
+    os.environ.setdefault("FLAGS_dataloader_ring_bytes",
+                          str(max(64, 4 * batch) << 20))
+    loader = DataLoader(_SynthCoco(n_need), batch_size=batch,
+                        num_workers=4, drop_last=True)
+
+    it = iter(loader)
+    e2e, loss_val = _timed_steps(step, lambda: next(it), steps)
+
+    # loader-only throughput (same preprocessing, no device step)
+    it2 = iter(DataLoader(_SynthCoco(batch * (steps + 2)),
+                          batch_size=batch, num_workers=4,
+                          drop_last=True))
+    next(it2)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        img, _gt = next(it2)
+    np.asarray(img._value[0, 0, 0, 0])
+    dt_loader = (time.perf_counter() - t0) / steps
+
+    # host->device ingest bandwidth for one u8 batch (on tunneled dev
+    # chips this link is the bottleneck; on a real TPU host it's PCIe).
+    # Barrier = a host fetch through a device op: block_until_ready is
+    # NOT a real barrier over the tunnel (see the header note), and a
+    # straight round-trip of the input could be served from the host
+    # copy — reading one element of x+1 forces the upload to complete.
+    import jax as _jax
+    import jax.numpy as _jnp
+    xfer = np.zeros((batch, 320, 320, 3), np.uint8)
+    t0 = time.perf_counter()
+    dev = _jax.device_put(xfer)
+    np.asarray((dev[0, 0, 0, 0] + _jnp.uint8(1)))
+    dt_put = time.perf_counter() - t0
+    mbps = xfer.nbytes / dt_put / 1e6
+
+    ips = batch / e2e
+    assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+    print(json.dumps({
+        "metric": "yolov3_tiny_pipeline_train_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/s",
+        "vs_baseline": 1.0,
+    }), flush=True)
+    print(f"# loss={loss_val:.4f} e2e_step={e2e*1000:.1f}ms "
+          f"loader_only={dt_loader*1000:.1f}ms/batch batch={batch} "
+          f"h2d={mbps:.0f}MB/s "
+          f"(u8 transport + on-device normalize: 4x less ingest than "
+          f"f32; on tunneled dev chips the h2d link bounds e2e)",
+          file=sys.stderr)
+
+
 def _bench_layerwise(cfg, batch, seq, steps, peak_flops, on_tpu):
     """Largest-config line: optimizer-in-backward layerwise step
     (paddle_tpu/jit/layerwise.py) — params + ONE layer's grads resident,
@@ -340,10 +472,11 @@ def main():
                       moment_dtype=mdtype, optimizer=opt_name)
 
     if on_tpu:
-        # BASELINE.json configs[0]/[1]: the non-LLM baseline rows
+        # BASELINE.json configs[0]/[1]/[2]: the non-LLM baseline rows
         # ("TBD — first measured milestone" until round 5)
         _bench_resnet50(128, 4, peak_flops, on_tpu)
         _bench_bert_finetune(128, 128, 8, peak_flops, on_tpu)
+        _bench_yolo_pipeline(32, 4, on_tpu)
 
         # headline (LAST): Llama-2-7B architecture (6.74B params) on one
         # chip via the layerwise optimizer-in-backward step — the
