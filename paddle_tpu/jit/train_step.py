@@ -64,9 +64,22 @@ class TrainStep:
                                       for b in batch[:-1]])
                         loss = criterion(out,
                                          Tensor._from_value(batch[-1]))
-                return loss._value.astype(jnp.float32)
+                    # collect traced buffer updates (BatchNorm running
+                    # stats reassign their bound tracer in training
+                    # mode — F.batch_norm's contract expects the fused
+                    # step to persist them) BEFORE bind_state restores
+                    # the originals.  Returned as aux: excluded from
+                    # the grad but part of the compiled step's outputs.
+                    new_bufs = {}
+                    sd = model.state_dict()
+                    for k in frozen:
+                        v = sd[k]._value
+                        if v is not state[k]:
+                            new_bufs[k] = v
+                return loss._value.astype(jnp.float32), new_bufs
 
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
 
             if clip_norm is not None:
                 gnorm = jnp.sqrt(sum(
@@ -84,7 +97,7 @@ class TrainStep:
                                             opt_states[k], hyper)
                 new_params[k] = np_
                 new_states[k] = nst
-            return loss, new_params, new_states
+            return loss, new_params, new_states, new_bufs
 
         # donate params + opt states: in-place HBM update
         self._step_fn = jax.jit(step, donate_argnums=(0, 2))
@@ -117,9 +130,12 @@ class TrainStep:
         key = _random.next_key()
         batch_vals = tuple(b._value if isinstance(b, Tensor)
                            else jnp.asarray(b) for b in batch)
-        loss, new_params, new_states = self._step_fn(
+        loss, new_params, new_states, new_bufs = self._step_fn(
             params, frozen_vals, self._opt_states, lr, key, *batch_vals)
         for k, v in new_params.items():
+            sd[k]._value = v
+        # persist traced buffer updates (BatchNorm running stats)
+        for k, v in new_bufs.items():
             sd[k]._value = v
         # update the per-param state DICTS in place: optimizer._state
         # holds the same dict objects, so optimizer.state_dict() stays

@@ -244,3 +244,39 @@ def test_grad_clip():
     clipped = clip(pg)
     total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in clipped))
     assert total <= 1.0 + 1e-4
+
+
+def test_bf16_conv_net_trains_under_fused_step():
+    """Regression x2: (a) conv kernels used preferred_element_type=f32
+    with a bf16 downcast, which broke jax's conv transpose rule inside
+    value_and_grad (f32 cotangent vs bf16 weight) — the first bf16 conv
+    net trained under TrainStep hit it; (b) TrainStep dropped the
+    traced BatchNorm running-stat updates that F.batch_norm's contract
+    expects the fused step to persist — eval after training normalized
+    with the INIT stats."""
+    from paddle_tpu.jit.train_step import TrainStep
+
+    paddle.seed(0)
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2, stride=2), nn.Conv2D(8, 16, 3, padding=1),
+        nn.ReLU(), nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+        nn.Linear(16, 4))
+    net.bfloat16()
+    bn = net[1]
+    mean0 = np.array(bn._mean.numpy(), np.float32).copy()
+    opt = paddle.optimizer.Momentum(0.05, momentum=0.9,
+                                    parameters=net.parameters())
+    step = TrainStep(net, lambda lg, lb: F.cross_entropy(lg, lb), opt)
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 3, 16, 16).astype(np.float32)
+                         + 1.0, dtype="bfloat16")
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+    losses = [float(np.asarray(step(x, y)._value)) for _ in range(5)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # running stats must have moved toward the (mean ~1) batch stats
+    mean5 = np.array(bn._mean.numpy(), np.float32)
+    assert not np.allclose(mean5, mean0), (
+        "BatchNorm running stats were not persisted by the fused step")
