@@ -39,6 +39,7 @@ class _Converter:
         self.nodes: List[bytes] = []
         self.initializers: List[bytes] = []
         self.shapes: Dict[str, tuple] = {}   # name -> shape (inference)
+        self.dtypes: Dict[str, np.dtype] = {}  # name -> numpy dtype
         self._const_n = 0
 
     def const(self, arr: np.ndarray, name_hint="const") -> str:
@@ -178,6 +179,41 @@ class _Converter:
                   [P.attr_ints("perm", [int(p) for p in perm])]
                   if perm is not None else ())
 
+    def _op_batch_norm(self, ins, outs, cv, stmt):
+        """Eval-mode batch_norm -> ONNX BatchNormalization.  Op input
+        order is (x, mean, var[, weight][, bias]) per F.batch_norm;
+        ONNX wants (X, scale, B, input_mean, input_var).  Training mode
+        recomputes batch statistics and is not exportable — call
+        model.eval() first (same contract as the reference's
+        paddle2onnx path)."""
+        if not cv.get("use_stats", False):
+            raise NotImplementedError(
+                "ONNX export: batch_norm in training mode — call "
+                "model.eval() before export")
+        if cv.get("channel_axis", 1) != 1:
+            raise NotImplementedError("ONNX export: NHWC batch_norm")
+        x, mean, var = ins[0], ins[1], ins[2]
+        rest = list(ins[3:])
+        scale = rest.pop(0) if cv.get("weight") is not None else None
+        bias = rest.pop(0) if cv.get("bias") is not None else None
+        if scale is None or bias is None:
+            shape = self.shapes.get(x)
+            if shape is None or len(shape) < 2:
+                raise NotImplementedError(
+                    "ONNX export: affine-less batch_norm needs a "
+                    "static input shape to synthesize scale/bias")
+            ch = int(shape[1])
+            # ONNX requires scale/B to match X's dtype
+            dt = self.dtypes.get(x, np.dtype(np.float32))
+            if scale is None:
+                scale = self.const(np.ones(ch, dt), "bn_scale")
+            if bias is None:
+                bias = self.const(np.zeros(ch, dt), "bn_bias")
+        self.emit("BatchNormalization", [x, scale, bias, mean, var],
+                  outs,
+                  [P.attr_float("epsilon",
+                                float(cv.get("epsilon", 1e-5)))])
+
     def _op_softmax(self, ins, outs, cv, stmt):
         self.emit("Softmax", ins, outs,
                   [P.attr_int("axis", int(cv.get("axis", -1)))])
@@ -193,7 +229,8 @@ _SIMPLE = {
     "divide": "Div", "neg": "Neg", "elementwise_add": "Add",
 }
 _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
-            "flatten", "reshape", "transpose", "softmax", "concat"]
+            "flatten", "reshape", "transpose", "softmax", "concat",
+            "batch_norm"]
 
 
 def _elem_type(dtype) -> int:
@@ -222,6 +259,7 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
         sym_sd[sym] = jax.ShapeDtypeStruct(tuple(t.shape),
                                            np.dtype(str(t.dtype)))
         conv.shapes[feed_name] = tuple(t.shape)
+        conv.dtypes[feed_name] = np.dtype(str(t.dtype))
         decl = declared_shapes.get(feed_name, list(t.shape))
         inputs.append(P.value_info(feed_name,
                                    _elem_type(str(t.dtype)), decl))
@@ -233,6 +271,7 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
         arr = np.asarray(cap_t._value)
         sym_sd[sym] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
         conv.shapes[name] = tuple(arr.shape)
+        conv.dtypes[name] = arr.dtype
         conv.initializers.append(P.tensor_proto(name, arr))
 
     for si, stmt in enumerate(rec.statements):
@@ -276,6 +315,7 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
             sym_name[osym] = n
             sym_sd[osym] = sd
             conv.shapes[n] = tuple(sd.shape)
+            conv.dtypes[n] = np.dtype(sd.dtype)
             outs.append(n)
         conv.convert(stmt, ins, outs)
 

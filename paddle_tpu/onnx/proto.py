@@ -328,6 +328,13 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
                            a.get("pads", [0, 0, 0, 0]))
         elif op == "GlobalAveragePool":
             out = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "BatchNormalization":
+            x, scale, bias, mean, var = ins[:5]
+            eps = a.get("epsilon", 1e-5)
+            shp = [1, -1] + [1] * (x.ndim - 2)
+            out = (x - mean.reshape(shp)) / np.sqrt(
+                var.reshape(shp) + eps) * scale.reshape(shp) \
+                + bias.reshape(shp)
         elif op == "Gemm":
             x, w = ins[0], ins[1]
             if a.get("transB"):

@@ -115,3 +115,30 @@ def test_export_string_padding_raises(tmp_path):
     with pytest.raises(NotImplementedError, match="padding"):
         export(C(), str(tmp_path / "same"),
                input_spec=[InputSpec([1, 1, 8, 8], "float32")])
+
+
+def test_export_conv_bn_eval_roundtrip(tmp_path):
+    """BatchNorm exports as ONNX BatchNormalization with the trained
+    running stats (export() captures in eval mode by contract, so the
+    converter always sees use_stats=True; its training-mode refusal is
+    a safety net for direct program captures)."""
+    paddle.seed(3)
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(3, 8, 3, padding=1), paddle.nn.BatchNorm2D(8),
+        paddle.nn.ReLU(), paddle.nn.MaxPool2D(2, stride=2),
+        paddle.nn.Flatten(), paddle.nn.Linear(8 * 8 * 8, 4))
+    # move the running stats off init so the export carries real state
+    warm = paddle.to_tensor(np.random.RandomState(3)
+                            .rand(4, 3, 16, 16).astype(np.float32) + 1)
+    net.train()
+    net(warm)
+    net.eval()
+    f = export(net, str(tmp_path / "bn"),
+               input_spec=[InputSpec([1, 3, 16, 16], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    ops = [n["op_type"] for n in m["nodes"]]
+    assert "BatchNormalization" in ops
+    xi = np.random.RandomState(4).rand(1, 3, 16, 16).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: xi})[0]
+    np.testing.assert_allclose(got, net(paddle.to_tensor(xi)).numpy(),
+                               rtol=1e-4, atol=1e-5)
