@@ -280,7 +280,15 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def _adaptive_pool(name, nd, x, output_size, mode, data_format):
     channel_last = not data_format.startswith("NC")
-    out_sz = _pair(output_size, nd)
+    # None entries mean "keep this axis's input size" (reference
+    # adaptive_avg_pool2d contract) — _pair would int()-crash on them
+    if isinstance(output_size, (list, tuple)):
+        out_sz = tuple(None if s is None else int(s)
+                       for s in output_size)
+        if len(out_sz) != nd:
+            out_sz = out_sz * nd
+    else:
+        out_sz = (int(output_size),) * nd
 
     def fn(v):
         spatial_axes = list(range(2, 2 + nd)) if not channel_last \

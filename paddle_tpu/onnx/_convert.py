@@ -179,6 +179,35 @@ class _Converter:
                   [P.attr_ints("perm", [int(p) for p in perm])]
                   if perm is not None else ())
 
+    def _op_adaptive_avg_pool2d(self, ins, outs, cv, stmt):
+        """output_size=1 is exactly ONNX GlobalAveragePool; any other
+        static output size lowers to AveragePool when the input splits
+        evenly (the torchvision/zoo cases)."""
+        if cv.get("channel_last"):
+            raise NotImplementedError(
+                "ONNX export: NHWC adaptive_avg_pool2d")
+        osz = _pair(cv.get("out_sz") or 1)
+        in_shape = self.shapes.get(ins[0])
+        if tuple(osz) == (1, 1):
+            self.emit("GlobalAveragePool", ins, outs)
+            return
+        if in_shape is None or len(in_shape) != 4:
+            raise NotImplementedError(
+                "ONNX export: adaptive_avg_pool2d needs a static NCHW "
+                "input shape")
+        H, W = int(in_shape[2]), int(in_shape[3])
+        # None output axes keep the input size (identity on that axis)
+        osz = [H if osz[0] is None else int(osz[0]),
+               W if osz[1] is None else int(osz[1])]
+        if H % osz[0] or W % osz[1]:
+            raise NotImplementedError(
+                "ONNX export: adaptive_avg_pool2d with non-divisible "
+                f"output size {osz} for input {H}x{W}")
+        k = [H // osz[0], W // osz[1]]
+        self.emit("AveragePool", ins, outs,
+                  [P.attr_ints("kernel_shape", k),
+                   P.attr_ints("strides", k)])
+
     def _op_batch_norm(self, ins, outs, cv, stmt):
         """Eval-mode batch_norm -> ONNX BatchNormalization.  Op input
         order is (x, mean, var[, weight][, bias]) per F.batch_norm;
@@ -230,7 +259,7 @@ _SIMPLE = {
 }
 _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "flatten", "reshape", "transpose", "softmax", "concat",
-            "batch_norm"]
+            "batch_norm", "adaptive_avg_pool2d"]
 
 
 def _elem_type(dtype) -> int:

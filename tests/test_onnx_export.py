@@ -142,3 +142,22 @@ def test_export_conv_bn_eval_roundtrip(tmp_path):
     got = P.evaluate(m, {m["inputs"][0]: xi})[0]
     np.testing.assert_allclose(got, net(paddle.to_tensor(xi)).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_export_resnet18_roundtrip(tmp_path):
+    """A real vision-zoo model (residual adds, BN, strided convs,
+    global average pool) exports and matches the eager model
+    numerically — the paddle2onnx-equivalent inference-deploy path."""
+    paddle.seed(5)
+    net = paddle.vision.models.resnet18(num_classes=10)
+    net.eval()
+    f = export(net, str(tmp_path / "r18"),
+               input_spec=[InputSpec([1, 3, 64, 64], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    ops = [n["op_type"] for n in m["nodes"]]
+    assert ops.count("Conv") == 20          # 16 block + stem + 3 downsample
+    assert "GlobalAveragePool" in ops and "BatchNormalization" in ops
+    x = np.random.RandomState(5).rand(1, 3, 64, 64).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    np.testing.assert_allclose(got, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
