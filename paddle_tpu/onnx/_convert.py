@@ -179,6 +179,38 @@ class _Converter:
                   [P.attr_ints("perm", [int(p) for p in perm])]
                   if perm is not None else ())
 
+    def _op_leaky_relu(self, ins, outs, cv, stmt):
+        self.emit("LeakyRelu", ins, outs,
+                  [P.attr_float("alpha",
+                                float(cv.get("negative_slope", 0.01)))])
+
+    def _op_interpolate(self, ins, outs, cv, stmt):
+        """nearest-mode upsampling with an integer scale (the detector/
+        segmentation skip-connection case) -> ONNX Resize with a scales
+        input; other modes/fractional scales fall back to jit.save."""
+        if cv.get("mode", "nearest") != "nearest":
+            raise NotImplementedError(
+                "ONNX export: interpolate mode="
+                f"{cv.get('mode')!r} — only 'nearest' is supported; "
+                "export via jit.save (StableHLO) instead")
+        if cv.get("channel_last"):
+            raise NotImplementedError("ONNX export: NHWC interpolate")
+        in_shape = self.shapes.get(ins[0])
+        out_shape = self.shapes.get(outs[0])
+        if in_shape is None or out_shape is None:
+            raise NotImplementedError(
+                "ONNX export: interpolate needs static shapes")
+        scales = [float(o) / float(i)
+                  for o, i in zip(out_shape, in_shape)]
+        if any(s != int(s) for s in scales[2:]):
+            raise NotImplementedError(
+                "ONNX export: non-integer interpolate scale "
+                f"{scales[2:]}")
+        sc = self.const(np.asarray(scales, np.float32), "scales")
+        # Resize(X, roi, scales) — roi unused for nearest (empty name)
+        self.emit("Resize", [ins[0], "", sc], outs,
+                  [P.attr_str("mode", "nearest")])
+
     def _op_adaptive_avg_pool2d(self, ins, outs, cv, stmt):
         """output_size=1 is exactly ONNX GlobalAveragePool; any other
         static output size lowers to AveragePool when the input splits
@@ -248,8 +280,9 @@ class _Converter:
                   [P.attr_int("axis", int(cv.get("axis", -1)))])
 
     def _op_concat(self, ins, outs, cv, stmt):
+        # the recorder (ops.manipulation.concat) closes over ``ax``
         self.emit("Concat", ins, outs,
-                  [P.attr_int("axis", int(cv.get("axis", 0)))])
+                  [P.attr_int("axis", int(cv.get("ax", 0)))])
 
 
 _SIMPLE = {
@@ -259,7 +292,8 @@ _SIMPLE = {
 }
 _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "flatten", "reshape", "transpose", "softmax", "concat",
-            "batch_norm", "adaptive_avg_pool2d"]
+            "batch_norm", "adaptive_avg_pool2d", "leaky_relu",
+            "interpolate"]
 
 
 def _elem_type(dtype) -> int:

@@ -328,6 +328,16 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
                            a.get("pads", [0, 0, 0, 0]))
         elif op == "GlobalAveragePool":
             out = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "LeakyRelu":
+            alpha = a.get("alpha", 0.01)
+            out = np.where(ins[0] >= 0, ins[0], alpha * ins[0])
+        elif op == "Resize":
+            # nearest + integer scales (the exporter's contract)
+            scales = [int(s) for s in ins[2]]
+            out = ins[0]
+            for ax, s in enumerate(scales):
+                if s != 1:
+                    out = np.repeat(out, s, axis=ax)
         elif op == "BatchNormalization":
             x, scale, bias, mean, var = ins[:5]
             eps = a.get("epsilon", 1e-5)

@@ -161,3 +161,28 @@ def test_export_resnet18_roundtrip(tmp_path):
     got = P.evaluate(m, {m["inputs"][0]: x})[0]
     np.testing.assert_allclose(got, net(paddle.to_tensor(x)).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_export_yolov3_tiny_roundtrip(tmp_path):
+    """The detector exports end-to-end: LeakyRelu (alpha attr), Resize
+    (nearest, scales input), multi-output graph, and Concat on the
+    CHANNEL axis — the case that exposed _op_concat reading the wrong
+    closure name (recorder freevar is ``ax``)."""
+    from paddle_tpu.vision.models.yolo import yolov3_tiny
+
+    paddle.seed(6)
+    net = yolov3_tiny(num_classes=20)
+    net.eval()
+    f = export(net, str(tmp_path / "yolo"),
+               input_spec=[InputSpec([1, 3, 160, 160], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    ops = [n["op_type"] for n in m["nodes"]]
+    assert "Resize" in ops and "LeakyRelu" in ops and "Concat" in ops
+    cnode = [n for n in m["nodes"] if n["op_type"] == "Concat"][0]
+    assert cnode["attrs"]["axis"] == 1
+    x = np.random.RandomState(6).rand(1, 3, 160, 160).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: x})
+    refs = [o.numpy() for o in net(paddle.to_tensor(x))]
+    assert len(got) == 2
+    for g, r in zip(got, refs):
+        np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-5)
