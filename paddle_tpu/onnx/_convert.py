@@ -40,6 +40,7 @@ class _Converter:
         self.initializers: List[bytes] = []
         self.shapes: Dict[str, tuple] = {}   # name -> shape (inference)
         self.dtypes: Dict[str, np.dtype] = {}  # name -> numpy dtype
+        self.min_opset = 13                  # raised by opset-17+ ops
         self._const_n = 0
 
     def const(self, arr: np.ndarray, name_hint="const") -> str:
@@ -179,6 +180,200 @@ class _Converter:
                   [P.attr_ints("perm", [int(p) for p in perm])]
                   if perm is not None else ())
 
+    def _op_flash_attention_pallas(self, ins, outs, cv, stmt):
+        """Scaled-dot-product attention decomposed to the standard ONNX
+        MatMul/Softmax chain (the fused TPU kernel is an execution
+        detail, not graph semantics).  Inputs are paddle-layout
+        (q, k, v[, additive mask]) in [B, S, H, D]; causal masking
+        bakes a bottom-right-aligned additive constant."""
+        qs = self.shapes.get(ins[0])
+        ks = self.shapes.get(ins[1], qs)
+        if qs is None or len(qs) != 4:
+            raise NotImplementedError(
+                "ONNX export: attention needs a static [B, S, H, D] "
+                "query shape")
+        S, D = int(qs[1]), int(qs[3])
+        kS = int(ks[1])
+        dt = self.dtypes.get(ins[0], np.dtype(np.float32))
+        t = outs[0]
+        perm = [0, 2, 1, 3]
+        # q/v -> [B,H,S,D]; k fuses both transposes into [B,H,D,S]
+        self.emit("Transpose", [ins[0]], [f"{t}_qt"],
+                  [P.attr_ints("perm", perm)])
+        self.emit("Transpose", [ins[1]], [f"{t}_kT"],
+                  [P.attr_ints("perm", [0, 2, 3, 1])])
+        self.emit("Transpose", [ins[2]], [f"{t}_vt"],
+                  [P.attr_ints("perm", perm)])
+        self.emit("MatMul", [f"{t}_qt", f"{t}_kT"], [f"{t}_s"])
+        scale = self.const(np.asarray(1.0 / np.sqrt(D), dt), "scale")
+        self.emit("Mul", [f"{t}_s", scale], [f"{t}_ss"])
+        cur = f"{t}_ss"
+        if len(ins) > 3:
+            mdt = self.dtypes.get(ins[3])
+            if mdt is not None and mdt == np.dtype(bool):
+                raise NotImplementedError(
+                    "ONNX export: boolean attention mask — pass an "
+                    "additive float mask")
+            self.emit("Add", [cur, ins[3]], [f"{t}_sm"])
+            cur = f"{t}_sm"
+        if cv.get("is_causal"):
+            m = np.triu(np.full((S, kS), -1e9, np.float32),
+                        k=1 + kS - S).astype(dt)
+            cm = self.const(m, "causal_mask")
+            self.emit("Add", [cur, cm], [f"{t}_cm"])
+            cur = f"{t}_cm"
+        self.emit("Softmax", [cur], [f"{t}_p"],
+                  [P.attr_int("axis", -1)])
+        self.emit("MatMul", [f"{t}_p", f"{t}_vt"], [f"{t}_o"])
+        self.emit("Transpose", [f"{t}_o"], outs,
+                  [P.attr_ints("perm", perm)])
+
+    def _op_getitem(self, ins, outs, cv, stmt):
+        """Static int/slice indexing -> ONNX Slice (+ Squeeze for int
+        axes).  Tensor-valued / bool / newaxis indices fall back to
+        jit.save (StableHLO)."""
+        if len(ins) != 1:
+            raise NotImplementedError(
+                "ONNX export: tensor-valued index in getitem")
+        template = cv.get("template") or []
+        shape = self.shapes.get(ins[0])
+        if shape is None:
+            raise NotImplementedError(
+                "ONNX export: getitem needs a static input shape")
+        starts, ends, axes, steps, sq = [], [], [], [], []
+        for ax, (kind, payload) in enumerate(template):
+            if kind != "static":
+                raise NotImplementedError(
+                    "ONNX export: tensor index in getitem")
+            dim = int(shape[ax])
+            if isinstance(payload, slice):
+                if payload == slice(None):
+                    continue
+                sp = 1 if payload.step is None else int(payload.step)
+                if sp <= 0:
+                    raise NotImplementedError(
+                        "ONNX export: negative-step slice")
+                # slice.indices applies Python's clamping rules (e.g.
+                # x[-7:] on dim 5 starts at 0, not (-7 % 5))
+                st, en, sp = payload.indices(dim)
+                starts.append(st); ends.append(en)
+                axes.append(ax); steps.append(sp)
+            elif isinstance(payload, (int, np.integer)) and \
+                    not isinstance(payload, (bool, np.bool_)):
+                i = int(payload) % dim
+                starts.append(i); ends.append(i + 1)
+                axes.append(ax); steps.append(1)
+                sq.append(ax)
+            else:
+                raise NotImplementedError(
+                    f"ONNX export: getitem index {payload!r}")
+        src = ins[0]
+        if axes:
+            dst = outs[0] + "_sl" if sq else outs[0]
+            self.emit("Slice", [
+                src,
+                self.const(np.asarray(starts, np.int64), "starts"),
+                self.const(np.asarray(ends, np.int64), "ends"),
+                self.const(np.asarray(axes, np.int64), "axes"),
+                self.const(np.asarray(steps, np.int64), "steps")], [dst])
+            src = dst
+        if sq:
+            self.emit("Squeeze",
+                      [src, self.const(np.asarray(sq, np.int64),
+                                       "axes")], outs)
+        elif not axes:
+            self.emit("Identity", [src], outs)
+
+    def _op_unsqueeze(self, ins, outs, cv, stmt):
+        ax = cv.get("axis")
+        axes = sorted(int(a) for a in
+                      (ax if isinstance(ax, (list, tuple)) else [ax]))
+        # ONNX Unsqueeze-13 takes negative axes relative to the OUTPUT
+        # rank (same as a single expand_dims); the eager op applies
+        # sorted axes sequentially, which only matches the all-at-once
+        # ONNX semantics when multi-axis lists are non-negative
+        if len(axes) > 1 and any(a < 0 for a in axes):
+            raise NotImplementedError(
+                "ONNX export: multiple negative unsqueeze axes")
+        a_in = self.const(np.asarray(axes, np.int64), "axes")
+        self.emit("Unsqueeze", [ins[0], a_in], outs)
+
+    def _op_squeeze(self, ins, outs, cv, stmt):
+        ax = cv.get("axis")
+        shape = self.shapes.get(ins[0])
+        if ax is None:
+            if shape is None:
+                raise NotImplementedError(
+                    "ONNX export: squeeze(all) needs a static shape")
+            axes = [i for i, s in enumerate(shape) if s == 1]
+        else:
+            axes = [int(a) for a in
+                    (ax if isinstance(ax, (list, tuple)) else [ax])]
+            if shape is not None:
+                # eager semantics: silently keep non-1 dims
+                axes = [a % len(shape) for a in axes
+                        if shape[a % len(shape)] == 1]
+        if not axes:
+            # real runtimes treat an EMPTY axes tensor as
+            # squeeze-all-unit-dims — emit the intended no-op instead
+            self.emit("Identity", ins, outs)
+            return
+        a_in = self.const(np.asarray(sorted(axes), np.int64), "axes")
+        self.emit("Squeeze", [ins[0], a_in], outs)
+
+    def _op_embedding(self, ins, outs, cv, stmt):
+        """op inputs are (indices, weight); ONNX Gather wants
+        (data, indices)."""
+        if cv.get("padding_idx") is not None:
+            raise NotImplementedError(
+                "ONNX export: embedding with padding_idx")
+        self.emit("Gather", [ins[1], ins[0]], outs,
+                  [P.attr_int("axis", 0)])
+
+    def _op_layer_norm(self, ins, outs, cv, stmt):
+        """ONNX LayerNormalization (opset 17): normalizes axes
+        [rank - nd, rank); scale/bias carry the normalized shape."""
+        x = ins[0]
+        shape = self.shapes.get(x)
+        if shape is None:
+            raise NotImplementedError(
+                "ONNX export: layer_norm needs a static input shape")
+        nd = int(cv.get("nd", 1))
+        axis = len(shape) - nd
+        rest = list(ins[1:])
+        w = rest.pop(0) if cv.get("weight") is not None else None
+        b = rest.pop(0) if cv.get("bias") is not None else None
+        if w is None:
+            dt = self.dtypes.get(x, np.dtype(np.float32))
+            w = self.const(
+                np.ones(tuple(int(s) for s in shape[axis:]), dt),
+                "ln_scale")
+        node_ins = [x, w] + ([b] if b is not None else [])
+        self.emit("LayerNormalization", node_ins, outs,
+                  [P.attr_int("axis", axis),
+                   P.attr_float("epsilon",
+                                float(cv.get("epsilon", 1e-5)))])
+        self.min_opset = max(self.min_opset, 17)
+
+    def _op_gelu(self, ins, outs, cv, stmt):
+        """Exact gelu decomposed as 0.5*x*(1+Erf(x/sqrt(2))) — Erf is
+        opset 9, so transformer graphs stay broadly loadable."""
+        if cv.get("approximate"):
+            raise NotImplementedError(
+                "ONNX export: tanh-approximate gelu — use exact gelu "
+                "or export via jit.save (StableHLO)")
+        x = ins[0]
+        dt = self.dtypes.get(x, np.dtype(np.float32))
+        inv = self.const(np.asarray(1.0 / np.sqrt(2.0), dt), "isqrt2")
+        half = self.const(np.asarray(0.5, dt), "half")
+        one = self.const(np.asarray(1.0, dt), "one")
+        t = outs[0]
+        self.emit("Mul", [x, inv], [t + "_s"])
+        self.emit("Erf", [t + "_s"], [t + "_e"])
+        self.emit("Add", [t + "_e", one], [t + "_a"])
+        self.emit("Mul", [x, t + "_a"], [t + "_m"])
+        self.emit("Mul", [t + "_m", half], outs)
+
     def _op_leaky_relu(self, ins, outs, cv, stmt):
         self.emit("LeakyRelu", ins, outs,
                   [P.attr_float("alpha",
@@ -293,7 +488,8 @@ _SIMPLE = {
 _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "flatten", "reshape", "transpose", "softmax", "concat",
             "batch_norm", "adaptive_avg_pool2d", "leaky_relu",
-            "interpolate"]
+            "interpolate", "unsqueeze", "squeeze", "embedding",
+            "layer_norm", "gelu", "flash_attention_pallas", "getitem"]
 
 
 def _elem_type(dtype) -> int:
@@ -317,7 +513,10 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
     sym_sd: Dict[int, "jax.ShapeDtypeStruct"] = {}
     inputs = []
     for feed_name, t in program.feeds:
-        sym = rec._sym_of[id(t._value)]
+        # input_sym_of, NOT _sym_of[id]: an aliasing op (identity slice,
+        # same-shape reshape) can return the placeholder's buffer and
+        # remap its id to the op's OUTPUT sym
+        sym = rec.input_sym_of(t)
         sym_name[sym] = feed_name
         sym_sd[sym] = jax.ShapeDtypeStruct(tuple(t.shape),
                                            np.dtype(str(t.dtype)))
@@ -394,4 +593,4 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
 
     g = P.graph(conv.nodes, program.name, inputs, outputs,
                 conv.initializers)
-    return P.model(g, opset=opset)
+    return P.model(g, opset=max(opset, conv.min_opset))

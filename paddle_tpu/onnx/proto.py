@@ -328,6 +328,36 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
                            a.get("pads", [0, 0, 0, 0]))
         elif op == "GlobalAveragePool":
             out = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Slice":
+            data = ins[0]
+            sl = [slice(None)] * data.ndim
+            for st, en, ax, sp in zip(ins[1], ins[2], ins[3], ins[4]):
+                sl[int(ax)] = slice(int(st), int(en), int(sp))
+            out = data[tuple(sl)]
+        elif op == "Gather":
+            out = np.take(ins[0], ins[1], axis=a.get("axis", 0))
+        elif op == "Unsqueeze":
+            out = ins[0]
+            for ax in sorted(int(s) for s in ins[1]):
+                out = np.expand_dims(out, ax)
+        elif op == "Squeeze":
+            out = np.squeeze(ins[0],
+                             tuple(int(s) for s in ins[1]))
+        elif op == "Erf":
+            import math
+            out = np.vectorize(math.erf)(ins[0]).astype(ins[0].dtype)
+        elif op == "LayerNormalization":
+            x = ins[0]
+            ax = a.get("axis", -1)
+            eps = a.get("epsilon", 1e-5)
+            axes = tuple(range(ax % x.ndim, x.ndim))
+            m = x.mean(axis=axes, keepdims=True)
+            v = x.var(axis=axes, keepdims=True)
+            out = (x - m) / np.sqrt(v + eps) * ins[1]
+            if len(ins) > 2 and ins[2] is not None:
+                out = out + ins[2]
         elif op == "LeakyRelu":
             alpha = a.get("alpha", 0.01)
             out = np.where(ins[0] >= 0, ins[0], alpha * ins[0])

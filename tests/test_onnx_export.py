@@ -186,3 +186,52 @@ def test_export_yolov3_tiny_roundtrip(tmp_path):
     assert len(got) == 2
     for g, r in zip(got, refs):
         np.testing.assert_allclose(g, r, rtol=1e-4, atol=1e-5)
+
+
+def test_export_bert_encoder_roundtrip(tmp_path):
+    """A full transformer encoder exports to real ONNX: Gather
+    embeddings, LayerNormalization (bumps the model to opset 17),
+    Erf-decomposed gelu, the fused attention op decomposed to the
+    standard MatMul/Softmax chain, and Slice for the pooler's [:, 0]."""
+    from paddle_tpu.models.bert import (BertConfig,
+                                        BertForSequenceClassification)
+
+    paddle.seed(7)
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64)
+    net = BertForSequenceClassification(cfg)
+    net.eval()
+    f = export(net, str(tmp_path / "bert"),
+               input_spec=[InputSpec([1, 16], "int32")])
+    m = P.load_model(open(f, "rb").read())
+    assert m["opset"] == 17                  # LayerNormalization
+    ops = [n["op_type"] for n in m["nodes"]]
+    for required in ("Gather", "LayerNormalization", "Erf", "Softmax",
+                     "Slice", "Tanh"):
+        assert required in ops, required
+    x = np.random.RandomState(7).randint(0, 128, (1, 16)) \
+        .astype(np.int32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_clamped_slice_and_negative_unsqueeze(tmp_path):
+    """x[:, -7:] on dim 5 must clamp like Python (not -7 % 5), and a
+    clamped identity slice ALIASES the feed's buffer — the feed must
+    resolve via input_sym_of, not the current value-id map (which the
+    aliasing op remapped to its own output sym)."""
+    class M(paddle.nn.Layer):
+        def forward(self, x):
+            return x[:, -7:].unsqueeze(-1)
+
+    net = M()
+    f = export(net, str(tmp_path / "edge"),
+               input_spec=[InputSpec([2, 5], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    assert got.shape == (2, 5, 1)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=0)
