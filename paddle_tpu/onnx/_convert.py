@@ -42,11 +42,21 @@ class _Converter:
         self.dtypes: Dict[str, np.dtype] = {}  # name -> numpy dtype
         self.min_opset = 13                  # raised by opset-17+ ops
         self._const_n = 0
+        self._const_cache: Dict[tuple, str] = {}
 
     def const(self, arr: np.ndarray, name_hint="const") -> str:
+        # content-addressed: per-layer converters bake identical large
+        # constants (rope tables, causal masks) — dedup by value so an
+        # L-layer model carries ONE copy, not L
+        arr = np.asarray(arr)
+        key = (name_hint, str(arr.dtype), arr.shape, arr.tobytes())
+        cached = self._const_cache.get(key)
+        if cached is not None:
+            return cached
         self._const_n += 1
         name = f"{name_hint}_{self._const_n}"
-        self.initializers.append(P.tensor_proto(name, np.asarray(arr)))
+        self.initializers.append(P.tensor_proto(name, arr))
+        self._const_cache[key] = name
         return name
 
     def emit(self, op, ins, outs, attrs=()):
@@ -180,53 +190,68 @@ class _Converter:
                   [P.attr_ints("perm", [int(p) for p in perm])]
                   if perm is not None else ())
 
+    def _sdpa_chain(self, t, q_bhsd, kT_bhds, v_bhsd, outs, dt, S, kS,
+                    causal, mask_name=None):
+        """Shared scores->softmax->output tail of every attention
+        decomposition: inputs are already [B,H,S,D] (q, v) and
+        [B,H,D,S] (k); causal masking bakes a bottom-right-aligned
+        additive constant.  Writes the final [B,S,H,D] transpose to
+        ``outs``."""
+        self.emit("MatMul", [q_bhsd, kT_bhds], [f"{t}_s"])
+        qshape = self.shapes.get(q_bhsd)
+        head_d = int(qshape[-1]) if qshape else None
+        if head_d is None:
+            raise NotImplementedError(
+                "ONNX export: attention needs static head dim")
+        scale = self.const(np.asarray(1.0 / np.sqrt(head_d), dt),
+                           "scale")
+        self.emit("Mul", [f"{t}_s", scale], [f"{t}_ss"])
+        cur = f"{t}_ss"
+        if mask_name is not None:
+            mdt = self.dtypes.get(mask_name)
+            if mdt is not None and mdt == np.dtype(bool):
+                raise NotImplementedError(
+                    "ONNX export: boolean attention mask — pass an "
+                    "additive float mask")
+            self.emit("Add", [cur, mask_name], [f"{t}_sm"])
+            cur = f"{t}_sm"
+        if causal:
+            m = np.triu(np.full((S, kS), -1e9, np.float32),
+                        k=1 + kS - S).astype(dt)
+            self.emit("Add", [cur, self.const(m, "causal_mask")],
+                      [f"{t}_cm"])
+            cur = f"{t}_cm"
+        self.emit("Softmax", [cur], [f"{t}_p"],
+                  [P.attr_int("axis", -1)])
+        self.emit("MatMul", [f"{t}_p", v_bhsd], [f"{t}_o"])
+        self.emit("Transpose", [f"{t}_o"], outs,
+                  [P.attr_ints("perm", [0, 2, 1, 3])])
+
     def _op_flash_attention_pallas(self, ins, outs, cv, stmt):
         """Scaled-dot-product attention decomposed to the standard ONNX
         MatMul/Softmax chain (the fused TPU kernel is an execution
         detail, not graph semantics).  Inputs are paddle-layout
-        (q, k, v[, additive mask]) in [B, S, H, D]; causal masking
-        bakes a bottom-right-aligned additive constant."""
+        (q, k, v[, additive mask]) in [B, S, H, D]."""
         qs = self.shapes.get(ins[0])
         ks = self.shapes.get(ins[1], qs)
         if qs is None or len(qs) != 4:
             raise NotImplementedError(
                 "ONNX export: attention needs a static [B, S, H, D] "
                 "query shape")
-        S, D = int(qs[1]), int(qs[3])
-        kS = int(ks[1])
+        S, kS = int(qs[1]), int(ks[1])
         dt = self.dtypes.get(ins[0], np.dtype(np.float32))
         t = outs[0]
-        perm = [0, 2, 1, 3]
         # q/v -> [B,H,S,D]; k fuses both transposes into [B,H,D,S]
         self.emit("Transpose", [ins[0]], [f"{t}_qt"],
-                  [P.attr_ints("perm", perm)])
+                  [P.attr_ints("perm", [0, 2, 1, 3])])
+        self.shapes[f"{t}_qt"] = (qs[0], qs[2], qs[1], qs[3])
         self.emit("Transpose", [ins[1]], [f"{t}_kT"],
                   [P.attr_ints("perm", [0, 2, 3, 1])])
         self.emit("Transpose", [ins[2]], [f"{t}_vt"],
-                  [P.attr_ints("perm", perm)])
-        self.emit("MatMul", [f"{t}_qt", f"{t}_kT"], [f"{t}_s"])
-        scale = self.const(np.asarray(1.0 / np.sqrt(D), dt), "scale")
-        self.emit("Mul", [f"{t}_s", scale], [f"{t}_ss"])
-        cur = f"{t}_ss"
-        if len(ins) > 3:
-            mdt = self.dtypes.get(ins[3])
-            if mdt is not None and mdt == np.dtype(bool):
-                raise NotImplementedError(
-                    "ONNX export: boolean attention mask — pass an "
-                    "additive float mask")
-            self.emit("Add", [cur, ins[3]], [f"{t}_sm"])
-            cur = f"{t}_sm"
-        if cv.get("is_causal"):
-            m = np.triu(np.full((S, kS), -1e9, np.float32),
-                        k=1 + kS - S).astype(dt)
-            cm = self.const(m, "causal_mask")
-            self.emit("Add", [cur, cm], [f"{t}_cm"])
-            cur = f"{t}_cm"
-        self.emit("Softmax", [cur], [f"{t}_p"],
-                  [P.attr_int("axis", -1)])
-        self.emit("MatMul", [f"{t}_p", f"{t}_vt"], [f"{t}_o"])
-        self.emit("Transpose", [f"{t}_o"], outs,
-                  [P.attr_ints("perm", perm)])
+                  [P.attr_ints("perm", [0, 2, 1, 3])])
+        self._sdpa_chain(t, f"{t}_qt", f"{t}_kT", f"{t}_vt", outs, dt,
+                         S, kS, bool(cv.get("is_causal")),
+                         mask_name=ins[3] if len(ins) > 3 else None)
 
     def _op_getitem(self, ins, outs, cv, stmt):
         """Static int/slice indexing -> ONNX Slice (+ Squeeze for int
@@ -283,6 +308,58 @@ class _Converter:
                                        "axes")], outs)
         elif not axes:
             self.emit("Identity", [src], outs)
+
+    def _op_flash_attention_rope(self, ins, outs, cv, stmt):
+        """Rope-fused attention decomposed for ONNX: the neox rotation
+        is Slice/Neg/Concat/Mul/Add against baked cos/sin tables (the
+        same rope_tables the Pallas kernel consumes), followed by the
+        standard MatMul/Softmax chain."""
+        from ..ops.pallas_kernels import rope_tables
+
+        qs = self.shapes.get(ins[0])
+        if qs is None or len(qs) != 4:
+            raise NotImplementedError(
+                "ONNX export: rope attention needs a static "
+                "[B, S, H, D] query shape")
+        S, D = int(qs[1]), int(qs[3])
+        dt = self.dtypes.get(ins[0], np.dtype(np.float32))
+        # rope_tables takes a float base — int() would silently corrupt
+        # rope-scaled fine-tunes with non-integral theta
+        cos, sin = rope_tables(S, D, float(cv.get("rotary_base",
+                                                  10000.0)))
+        cosc = self.const(np.asarray(cos, dt), "rope_cos")
+        sinc = self.const(np.asarray(sin, dt), "rope_sin")
+        half = D // 2
+        t = outs[0]
+        perm = [0, 2, 1, 3]
+
+        def i64(vals, hint):
+            return self.const(np.asarray(vals, np.int64), hint)
+
+        def rope(src, dst):
+            self.emit("Slice", [src, i64([half], "st"), i64([D], "en"),
+                                i64([3], "ax"), i64([1], "sp")],
+                      [dst + "_h2"])
+            self.emit("Slice", [src, i64([0], "st"), i64([half], "en"),
+                                i64([3], "ax"), i64([1], "sp")],
+                      [dst + "_h1"])
+            self.emit("Neg", [dst + "_h2"], [dst + "_n"])
+            self.emit("Concat", [dst + "_n", dst + "_h1"],
+                      [dst + "_rot"], [P.attr_int("axis", 3)])
+            self.emit("Mul", [src, cosc], [dst + "_tc"])
+            self.emit("Mul", [dst + "_rot", sinc], [dst + "_rs"])
+            self.emit("Add", [dst + "_tc", dst + "_rs"], [dst])
+
+        for i, nm in enumerate("qkv"):
+            self.emit("Transpose", [ins[i]], [f"{t}_{nm}t"],
+                      [P.attr_ints("perm", perm)])
+        rope(f"{t}_qt", f"{t}_qr")
+        rope(f"{t}_kt", f"{t}_kr")
+        self.shapes[f"{t}_qr"] = (qs[0], qs[2], qs[1], qs[3])
+        self.emit("Transpose", [f"{t}_kr"], [f"{t}_kT"],
+                  [P.attr_ints("perm", [0, 1, 3, 2])])
+        self._sdpa_chain(t, f"{t}_qr", f"{t}_kT", f"{t}_vt", outs, dt,
+                         S, S, bool(cv.get("is_causal")))
 
     def _op_unsqueeze(self, ins, outs, cv, stmt):
         ax = cv.get("axis")
@@ -373,6 +450,48 @@ class _Converter:
         self.emit("Add", [t + "_e", one], [t + "_a"])
         self.emit("Mul", [x, t + "_a"], [t + "_m"])
         self.emit("Mul", [t + "_m", half], outs)
+
+    def _op_rms_norm(self, ins, outs, cv, stmt):
+        """Fused RMSNorm decomposed to ReduceMean/Sqrt/Div (+ Mul by
+        the weight when present) — all opset-13 ops."""
+        x = ins[0]
+        dt = self.dtypes.get(x, np.dtype(np.float32))
+        if dt == np.dtype(np.float16) or str(dt) == "bfloat16":
+            raise NotImplementedError(
+                "ONNX export: rms_norm in reduced precision computes "
+                "stats in f32 — export a float32 model")
+        eps = self.const(
+            np.asarray(float(cv.get("epsilon", 1e-6)), dt), "eps")
+        t = outs[0]
+        self.emit("Mul", [x, x], [t + "_sq"])
+        self.emit("ReduceMean", [t + "_sq"], [t + "_ms"],
+                  [P.attr_ints("axes", [-1]), P.attr_int("keepdims", 1)])
+        self.emit("Add", [t + "_ms", eps], [t + "_mse"])
+        self.emit("Sqrt", [t + "_mse"], [t + "_rms"])
+        has_w = len(ins) > 1
+        div_out = t + "_n" if has_w else outs[0]
+        self.emit("Div", [x, t + "_rms"], [div_out])
+        if has_w:
+            self.emit("Mul", [div_out, ins[1]], outs)
+
+    def _op_silu(self, ins, outs, cv, stmt):
+        t = outs[0]
+        self.emit("Sigmoid", ins, [t + "_sg"])
+        self.emit("Mul", [ins[0], t + "_sg"], outs)
+
+    def _op_swiglu(self, ins, outs, cv, stmt):
+        """silu(a) * b — the fused Llama MLP gate.  The packed
+        single-input form splits x in half on the last axis first."""
+        t = outs[0]
+        if len(ins) == 1:
+            self.emit("Split", [ins[0]], [t + "_a", t + "_b"],
+                      [P.attr_int("axis", -1)])
+            a, b = t + "_a", t + "_b"
+        else:
+            a, b = ins[0], ins[1]
+        self.emit("Sigmoid", [a], [t + "_sg"])
+        self.emit("Mul", [a, t + "_sg"], [t + "_si"])
+        self.emit("Mul", [t + "_si", b], outs)
 
     def _op_leaky_relu(self, ins, outs, cv, stmt):
         self.emit("LeakyRelu", ins, outs,
@@ -489,7 +608,8 @@ _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "flatten", "reshape", "transpose", "softmax", "concat",
             "batch_norm", "adaptive_avg_pool2d", "leaky_relu",
             "interpolate", "unsqueeze", "squeeze", "embedding",
-            "layer_norm", "gelu", "flash_attention_pallas", "getitem"]
+            "layer_norm", "gelu", "flash_attention_pallas", "getitem",
+            "rms_norm", "silu", "swiglu", "flash_attention_rope"]
 
 
 def _elem_type(dtype) -> int:
@@ -505,6 +625,11 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
     on concrete shapes."""
     import jax
 
+    if opset > 17:
+        raise NotImplementedError(
+            "ONNX export targets opsets 13-17: ReduceMean (and other "
+            "emitted nodes) use the axes-ATTRIBUTE form that opset 18 "
+            "moved to an input")
     rec = program.recorder
     conv = _Converter()
     declared_shapes = declared_shapes or {}

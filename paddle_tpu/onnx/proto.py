@@ -330,6 +330,10 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
             out = ins[0].mean(axis=(2, 3), keepdims=True)
         elif op == "Identity":
             out = ins[0]
+        elif op == "ReduceMean":
+            axes = tuple(a.get("axes", [-1]))
+            out = ins[0].mean(axis=axes,
+                              keepdims=bool(a.get("keepdims", 1)))
         elif op == "Slice":
             data = ins[0]
             sl = [slice(None)] * data.ndim
@@ -382,6 +386,12 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
             out = x @ w
             if len(ins) > 2:
                 out = out + ins[2]
+        elif op == "Split":
+            parts = np.array_split(ins[0], len(nd["outputs"]),
+                                   axis=a.get("axis", 0))
+            for name, p in zip(nd["outputs"], parts):
+                env[name] = np.asarray(p)
+            continue
         else:
             raise NotImplementedError(f"evaluator: {op}")
         env[nd["outputs"][0]] = np.asarray(out)

@@ -235,3 +235,30 @@ def test_export_clamped_slice_and_negative_unsqueeze(tmp_path):
     ref = net(paddle.to_tensor(x)).numpy()
     assert got.shape == (2, 5, 1)
     np.testing.assert_allclose(got, ref, rtol=0, atol=0)
+
+
+def test_export_llama_roundtrip(tmp_path):
+    """A full Llama decoder exports to real ONNX: RMSNorm decomposed to
+    ReduceMean/Sqrt/Div, swiglu to Sigmoid/Mul, and the rope-fused
+    attention to Slice/Neg/Concat (neox rotation against baked cos/sin
+    tables) + the causal MatMul/Softmax chain."""
+    from paddle_tpu.models import llama_tiny_config, LlamaForCausalLM
+
+    paddle.seed(8)
+    cfg = llama_tiny_config(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, intermediate_size=88, vocab_size=128)
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    f = export(net, str(tmp_path / "llama"),
+               input_spec=[InputSpec([1, 16], "int32")])
+    m = P.load_model(open(f, "rb").read())
+    ops = [n["op_type"] for n in m["nodes"]]
+    for required in ("Gather", "ReduceMean", "Softmax", "Concat",
+                     "Neg"):
+        assert required in ops, required
+    x = np.random.RandomState(8).randint(0, 128, (1, 16)) \
+        .astype(np.int32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
