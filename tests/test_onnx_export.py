@@ -262,3 +262,50 @@ def test_export_llama_roundtrip(tmp_path):
     got = P.evaluate(m, {m["inputs"][0]: x})[0]
     ref = net(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_gpt_and_qwen2_roundtrip(tmp_path):
+    """The other causal-LM families export through the same converter
+    set: GPT (learned positions, causal flash_attention_pallas path)
+    and Qwen2 (rope + attention bias)."""
+    from paddle_tpu.models import Qwen2ForCausalLM, qwen2_tiny_config
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(9)
+    gpt = GPTForCausalLM(GPTConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64))
+    qwen = Qwen2ForCausalLM(qwen2_tiny_config(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, intermediate_size=88, vocab_size=128))
+    for name, net, tol in (("gpt", gpt, 2e-5), ("qwen", qwen, 1e-5)):
+        net.eval()
+        f = export(net, str(tmp_path / name),
+                   input_spec=[InputSpec([1, 16], "int32")])
+        m = P.load_model(open(f, "rb").read())
+        x = np.random.RandomState(9).randint(0, 128, (1, 16)) \
+            .astype(np.int32)
+        got = P.evaluate(m, {m["inputs"][0]: x})[0]
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=tol,
+                                   err_msg=name)
+
+
+def test_export_packed_swiglu(tmp_path):
+    """Single-input swiglu splits on the last axis via ONNX Split."""
+    from paddle_tpu.incubate.nn import functional as IF
+
+    class M(paddle.nn.Layer):
+        def forward(self, x):
+            return IF.swiglu(x)
+
+    net = M()
+    f = export(net, str(tmp_path / "sw"),
+               input_spec=[InputSpec([2, 8], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    assert "Split" in [n["op_type"] for n in m["nodes"]]
+    x = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    np.testing.assert_allclose(got, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-6)
