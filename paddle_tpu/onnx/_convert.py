@@ -451,6 +451,19 @@ class _Converter:
         self.emit("Mul", [x, t + "_a"], [t + "_m"])
         self.emit("Mul", [t + "_m", half], outs)
 
+    def _op_expand(self, ins, outs, cv, stmt):
+        """broadcast_to -> ONNX Expand with the statically-known output
+        shape (eval_shape already resolved -1 dims)."""
+        out_shape = self.shapes.get(outs[0])
+        if out_shape is None:
+            raise NotImplementedError(
+                "ONNX export: expand needs a static output shape")
+        shp = self.const(
+            np.asarray([int(s) for s in out_shape], np.int64), "shape")
+        self.emit("Expand", [ins[0], shp], outs)
+
+    _op_expand_as = _op_expand
+
     def _op_rms_norm(self, ins, outs, cv, stmt):
         """Fused RMSNorm decomposed to ReduceMean/Sqrt/Div (+ Mul by
         the weight when present) — all opset-13 ops."""
@@ -609,7 +622,8 @@ _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "batch_norm", "adaptive_avg_pool2d", "leaky_relu",
             "interpolate", "unsqueeze", "squeeze", "embedding",
             "layer_norm", "gelu", "flash_attention_pallas", "getitem",
-            "rms_norm", "silu", "swiglu", "flash_attention_rope"]
+            "rms_norm", "silu", "swiglu", "flash_attention_rope",
+            "expand", "expand_as"]
 
 
 def _elem_type(dtype) -> int:

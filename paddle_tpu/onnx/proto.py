@@ -330,6 +330,9 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
             out = ins[0].mean(axis=(2, 3), keepdims=True)
         elif op == "Identity":
             out = ins[0]
+        elif op == "Expand":
+            out = np.broadcast_to(ins[0],
+                                  tuple(int(s) for s in ins[1]))
         elif op == "ReduceMean":
             axes = tuple(a.get("axes", [-1]))
             out = ins[0].mean(axis=axes,

@@ -309,3 +309,22 @@ def test_export_packed_swiglu(tmp_path):
     got = P.evaluate(m, {m["inputs"][0]: x})[0]
     np.testing.assert_allclose(got, net(paddle.to_tensor(x)).numpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_export_vit_roundtrip(tmp_path):
+    """ViT exports: conv patch embed, cls-token Expand over the batch,
+    non-causal attention, LayerNormalization, gelu."""
+    from paddle_tpu.vision.models.vit import VisionTransformer
+
+    paddle.seed(10)
+    net = VisionTransformer(image_size=32, patch_size=8, embed_dim=32,
+                            depth=2, num_heads=2, num_classes=10)
+    net.eval()
+    f = export(net, str(tmp_path / "vit"),
+               input_spec=[InputSpec([1, 3, 32, 32], "float32")])
+    m = P.load_model(open(f, "rb").read())
+    assert "Expand" in [n["op_type"] for n in m["nodes"]]
+    x = np.random.RandomState(10).rand(1, 3, 32, 32).astype(np.float32)
+    got = P.evaluate(m, {m["inputs"][0]: x})[0]
+    np.testing.assert_allclose(got, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
