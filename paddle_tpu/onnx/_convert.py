@@ -616,6 +616,8 @@ _SIMPLE = {
     "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "exp": "Exp",
     "sqrt": "Sqrt", "add": "Add", "subtract": "Sub", "multiply": "Mul",
     "divide": "Div", "neg": "Neg", "elementwise_add": "Add",
+    "erf": "Erf", "log": "Log", "abs": "Abs", "floor": "Floor",
+    "ceil": "Ceil", "sin": "Sin", "cos": "Cos",
 }
 _SPECIAL = ["linear", "matmul", "conv2d", "max_pool2d", "avg_pool2d",
             "flatten", "reshape", "transpose", "softmax", "concat",

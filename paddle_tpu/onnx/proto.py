@@ -330,6 +330,18 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
             out = ins[0].mean(axis=(2, 3), keepdims=True)
         elif op == "Identity":
             out = ins[0]
+        elif op == "Log":
+            out = np.log(ins[0])
+        elif op == "Abs":
+            out = np.abs(ins[0])
+        elif op == "Floor":
+            out = np.floor(ins[0])
+        elif op == "Ceil":
+            out = np.ceil(ins[0])
+        elif op == "Sin":
+            out = np.sin(ins[0])
+        elif op == "Cos":
+            out = np.cos(ins[0])
         elif op == "Expand":
             out = np.broadcast_to(ins[0],
                                   tuple(int(s) for s in ins[1]))
