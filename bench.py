@@ -40,6 +40,40 @@ _PEAK_FLOPS = {
 }
 
 
+def _init_backend(max_tries: int = 4, delay_s: float = 5.0):
+    """Bounded retry/backoff around TPU-backend init.
+
+    Round 5's entire perf record was erased by ONE transient backend
+    wedge at `jax.devices()` (BENCH_r05.json rc=1, VERDICT ask #1) even
+    though the chip had worked minutes earlier.  Retry with backoff;
+    on final failure emit a driver-parseable partial-failure JSON marker
+    instead of a bare traceback, so the round still has a record."""
+    import jax
+    last = None
+    for attempt in range(max_tries):
+        try:
+            return jax.devices()[0]
+        except Exception as e:                        # noqa: BLE001
+            last = e
+            print(f"# backend init failed "
+                  f"(try {attempt + 1}/{max_tries}): {e!r}",
+                  file=sys.stderr)
+            try:    # drop the cached failed backend before retrying
+                jax.extend.backend.clear_backends()
+            except Exception:                         # noqa: BLE001
+                pass
+            if attempt < max_tries - 1:
+                time.sleep(delay_s * (2 ** attempt))
+    print(json.dumps({
+        "metric": "bench_backend_unavailable",
+        "value": 0.0,
+        "unit": "error",
+        "vs_baseline": 0.0,
+        "error": repr(last)[:300],
+    }), flush=True)
+    sys.exit(1)
+
+
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "")
     for name, peak in _PEAK_FLOPS.items():
@@ -351,25 +385,34 @@ def _bench_yolo_pipeline(batch, steps, on_tpu):
 
     step = TrainStep(model, criterion, opt, clip_norm=10.0)
     n_need = batch * (3 * steps + 6)
-    # batch messages are ~1.2 MB/image; size the shm ring for them
-    os.environ.setdefault("FLAGS_dataloader_ring_bytes",
-                          str(max(64, 4 * batch) << 20))
-    loader = DataLoader(_SynthCoco(n_need), batch_size=batch,
-                        num_workers=4, drop_last=True)
+    # batch messages are ~1.2 MB/image; size the shm ring for them —
+    # set/restore around the bench so the bump never leaks into later
+    # bench lines or the caller's process (ADVICE round 5)
+    _ring_key = "FLAGS_dataloader_ring_bytes"
+    _ring_prev = os.environ.get(_ring_key)
+    os.environ.setdefault(_ring_key, str(max(64, 4 * batch) << 20))
+    try:
+        loader = DataLoader(_SynthCoco(n_need), batch_size=batch,
+                            num_workers=4, drop_last=True)
 
-    it = iter(loader)
-    e2e, loss_val = _timed_steps(step, lambda: next(it), steps)
+        it = iter(loader)
+        e2e, loss_val = _timed_steps(step, lambda: next(it), steps)
 
-    # loader-only throughput (same preprocessing, no device step)
-    it2 = iter(DataLoader(_SynthCoco(batch * (steps + 2)),
-                          batch_size=batch, num_workers=4,
-                          drop_last=True))
-    next(it2)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        img, _gt = next(it2)
-    np.asarray(img._value[0, 0, 0, 0])
-    dt_loader = (time.perf_counter() - t0) / steps
+        # loader-only throughput (same preprocessing, no device step)
+        it2 = iter(DataLoader(_SynthCoco(batch * (steps + 2)),
+                              batch_size=batch, num_workers=4,
+                              drop_last=True))
+        next(it2)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            img, _gt = next(it2)
+        np.asarray(img._value[0, 0, 0, 0])
+        dt_loader = (time.perf_counter() - t0) / steps
+    finally:
+        if _ring_prev is None:
+            os.environ.pop(_ring_key, None)
+        else:
+            os.environ[_ring_key] = _ring_prev
 
     # host->device ingest bandwidth for one u8 batch (on tunneled dev
     # chips this link is the bottleneck; on a real TPU host it's PCIe).
@@ -420,10 +463,9 @@ def _bench_layerwise(cfg, batch, seq, steps, peak_flops, on_tpu):
 
 
 def main():
-    import jax
     from paddle_tpu.models import LlamaConfig
 
-    dev = jax.devices()[0]
+    dev = _init_backend()
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:

@@ -318,6 +318,68 @@ class FP16Pass(PassBase):
 
 
 # ---------------------------------------------------------------------------
+# fused-buffer machinery (reference: coalesce_tensor op,
+# phi/kernels/coalesce_tensor_kernel.cc — the kernel behind DP fused
+# grad buffers).  The op-surface name `coalesce_tensor` aliases onto
+# these helpers; the DP-overlap pass below uses them so each grad
+# bucket is ONE collective over one flat buffer, not one per param.
+# ---------------------------------------------------------------------------
+def coalesce_tensor(inputs, dtype=None, copy_data=True,
+                    set_constant=False, persist_output=True,
+                    constant=0.0, use_align=True, align_size=-1,
+                    name=None):
+    """Fuse a list of tensors into one contiguous flat buffer.
+
+    Returns ``(outputs, fused_output)``: ``fused_output`` is the 1-D
+    fused buffer, ``outputs`` are per-input views of it (same shapes as
+    the inputs).  ``copy_data`` fills the buffer from the inputs;
+    ``set_constant`` fills it with ``constant`` instead.  ``use_align``
+    pads each chunk to an alignment boundary — ``align_size`` bytes
+    when positive, else 128 elements (the TPU lane width, so every
+    chunk of the fused buffer tiles cleanly).
+    """
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+
+    vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in inputs]
+    if not vals:
+        raise ValueError("coalesce_tensor: empty input list")
+    # resolve through jnp so paddle dtype strings incl. bfloat16 work
+    dt = vals[0].dtype if dtype is None else jnp.empty((0,), dtype).dtype
+    if align_size and align_size > 0:
+        align = max(1, int(align_size) // dt.itemsize)
+    elif use_align:
+        align = 128
+    else:
+        align = 1
+    sizes = [int(v.size) for v in vals]          # true element counts
+    # every chunk occupies at least one aligned slot (zero-size inputs
+    # still get a distinct address, like the reference kernel)
+    padded = [-(-max(n, 1) // align) * align for n in sizes]
+    total = sum(padded)
+    if set_constant:
+        buf = jnp.full((total,), constant, dt)
+    elif copy_data:
+        parts = []
+        for v, n, p in zip(vals, sizes, padded):
+            flat = v.reshape(-1).astype(dt)
+            if p > n:
+                flat = jnp.pad(flat, (0, p - n))
+            parts.append(flat)
+        buf = jnp.concatenate(parts)
+    else:
+        buf = jnp.zeros((total,), dt)
+    outputs = []
+    off = 0
+    for v, n, p in zip(vals, sizes, padded):
+        outputs.append(Tensor._from_value(
+            buf[off:off + n].reshape(v.shape)))
+        off += p
+    return outputs, Tensor._from_value(buf)
+
+
+# ---------------------------------------------------------------------------
 # DP comm overlap: bucketed gradient allreduce issued during backward
 # ---------------------------------------------------------------------------
 class _DPOverlapState:
@@ -405,9 +467,12 @@ class _DPOverlapOptimizer:
     def _allreduce_bucket(self, bi, pending=None, only_late=False):
         from ..collective import all_reduce
         from ...core.tensor import Tensor
+        import jax.numpy as jnp
         if self._world <= 1:
             return
         st = self._state
+        # collect the bucket's per-param deltas first ...
+        work = []                        # (param, delta, prev_synced)
         for q in self._state.buckets[bi]:
             if only_late and id(q) not in st.late:
                 continue
@@ -420,24 +485,40 @@ class _DPOverlapOptimizer:
             if base is None:
                 continue
             prev = st.synced.get(id(q))
-            t = Tensor._from_value(base if prev is None else base - prev)
+            work.append((q, base if prev is None else base - prev, prev))
+        # ... then reduce each dtype group as ONE coalesced flat buffer
+        # (the coalesce_tensor machinery): one collective per bucket,
+        # which is the whole point of bucketing — not one per param
+        groups: Dict[Any, list] = {}
+        for item in work:
+            groups.setdefault(str(item[1].dtype), []).append(item)
+        for items in groups.values():
+            fused = jnp.concatenate(
+                [d.reshape(-1) for _, d, _ in items]) \
+                if len(items) > 1 else items[0][1].reshape(-1)
+            t = Tensor._from_value(fused)
             all_reduce(t, group=self._group, sync_op=False)
-            val = t._value
+            red = t._value
             if self._avg:
-                val = val / self._world
-            if prev is not None:
-                val = prev + val
-            st.synced[id(q)] = val
-            if pending is not None and q is pending[0]:
-                # .grad will still receive g from the in-flight
-                # accumulation; pre-subtract so the final sum is the
-                # synced average
-                gpend = pending[1]
-                gpend = gpend._value if isinstance(gpend, Tensor) \
-                    else gpend
-                q._grad = val - gpend
-            else:
-                q._grad = val
+                red = red / self._world
+            off = 0
+            for q, delta, prev in items:
+                n = delta.size
+                val = red[off:off + n].reshape(delta.shape)
+                off += n
+                if prev is not None:
+                    val = prev + val
+                st.synced[id(q)] = val
+                if pending is not None and q is pending[0]:
+                    # .grad will still receive g from the in-flight
+                    # accumulation; pre-subtract so the final sum is
+                    # the synced average
+                    gpend = pending[1]
+                    gpend = gpend._value if isinstance(gpend, Tensor) \
+                        else gpend
+                    q._grad = val - gpend
+                else:
+                    q._grad = val
 
     def step(self):
         st = self._state

@@ -4,16 +4,23 @@ Parity: the reference serving stack's batched multi-request execution —
 block_multihead_attention
 (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
 driven by a request scheduler around AnalysisPredictor
-(paddle/fluid/inference/api/analysis_predictor.h:210).
+(paddle/fluid/inference/api/analysis_predictor.h:210 ZeroCopyRun).
 
-TPU-native design: the scheduler keeps a fixed number of decode SLOTS
-(static shapes — one compiled decode step reused forever); requests are
-admitted into free slots per step (prompt prefilled through the model's
-dense path, K/V scattered into cache pages), every active slot decodes
-one token per engine step via the paged-attention kernel, and finished
-slots release their pages immediately, making room for waiting requests
-mid-flight.  Admission/eviction is host control flow; all math is jitted
-device compute.
+TPU-native design: the scheduler keeps a fixed number of decode SLOTS and
+one engine step is ONE jitted XLA module (jit/serving_step.DecodeStep)
+at that fixed slot count — all layers, the paged cache append, paged
+attention, the LM head and greedy sampling fused, with the per-layer KV
+pools donated so the append is an in-place HBM write.  Inactive slots
+are masked (token 0, seq_len 0, block table aimed at the cache's sink
+page), never dropped, so admission/eviction churn never changes a traced
+shape and the decode step compiles exactly once for the engine's
+lifetime.  Requests are admitted into free slots per step: the prompt is
+prefilled through the model's dense path and its per-layer K/V scattered
+into cache pages in one fused call per request; finished slots release
+their pages immediately, making room for waiting requests mid-flight.
+Admission/eviction is host control flow; all math is jitted device
+compute, and the only per-step host traffic is the [slots] int32
+next-token fetch.
 """
 from __future__ import annotations
 
@@ -24,8 +31,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
-from ..ops.paged_attention import PagedKVCache, paged_attention
+from ..ops.paged_attention import PagedKVCache
 
 
 @dataclass
@@ -46,15 +52,25 @@ class GenerationRequest:
 
 
 class ContinuousBatchingEngine:
-    """Slot scheduler + batched paged decode for LlamaForCausalLM.
+    """Slot scheduler + single-compile batched paged decode for
+    LlamaForCausalLM.
 
     add_request() may be called at any time (including between steps
     while other requests are mid-decode); step() advances every running
     request by one token.  Greedy decoding — interleaved execution is
-    bit-identical to running each request alone (the test contract)."""
+    bit-identical to running each request alone (the test contract).
+
+    ``max_seq_len`` bounds prompt + generation per request and fixes the
+    block-table width (the compiled decode step's shape); it defaults to
+    the pool's fair share per slot, num_blocks * block_size //
+    max_batch_size.
+    """
 
     def __init__(self, model, max_batch_size: int = 8,
-                 num_blocks: int = 256, block_size: int = 16):
+                 num_blocks: int = 256, block_size: int = 16,
+                 max_seq_len: Optional[int] = None,
+                 use_pallas: Optional[bool] = None):
+        from ..jit.serving_step import DecodeStep
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -64,13 +80,28 @@ class ContinuousBatchingEngine:
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.caches = [
             PagedKVCache(num_blocks, block_size,
-                         cfg.num_key_value_heads, self.head_dim, dtype)
+                         cfg.num_key_value_heads, self.head_dim, dtype,
+                         sink_block=True)
             for _ in range(cfg.num_hidden_layers)]
+        if max_seq_len is None:
+            max_seq_len = max(block_size,
+                              num_blocks * block_size // max_batch_size)
+        self.max_seq_len = max_seq_len
+        self.bt_width = -(-max_seq_len // block_size)
+        self._sink = self.caches[0].sink
         self.slots: List[Optional[GenerationRequest]] = \
             [None] * max_batch_size
         self.waiting: List[GenerationRequest] = []
         self.finished: Dict[int, GenerationRequest] = {}
         self._next_id = 0
+        # slot-padded device-step inputs (fixed shapes forever): masked
+        # slots hold token 0 / seq_len 0 / an all-sink block-table row
+        self._tokens = np.zeros((max_batch_size,), np.int32)
+        self._seq_lens = np.zeros((max_batch_size,), np.int32)
+        self._bt = np.full((max_batch_size, self.bt_width), self._sink,
+                           np.int32)
+        self.decode_step = DecodeStep(model, self.caches,
+                                      use_pallas=use_pallas)
 
     # ---- public API ----------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16,
@@ -79,6 +110,18 @@ class ContinuousBatchingEngine:
             req_id=self._next_id,
             prompt_ids=np.asarray(prompt_ids, np.int64).reshape(-1),
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
+        need = self.caches[0].blocks_needed(
+            len(req.prompt_ids) + max_new_tokens)
+        if need > self.bt_width:
+            raise ValueError(
+                "request needs %d pages but the engine's block-table "
+                "width is %d (max_seq_len=%d); raise max_seq_len"
+                % (need, self.bt_width, self.max_seq_len))
+        if need > self.caches[0].num_blocks:
+            # would never admit: _admit waits for pages that can't exist
+            raise ValueError(
+                "request needs %d pages but the pool only has %d; "
+                "raise num_blocks" % (need, self.caches[0].num_blocks))
         self._next_id += 1
         self.waiting.append(req)
         return req.req_id
@@ -117,95 +160,56 @@ class ContinuousBatchingEngine:
 
     def _prefill(self, req: GenerationRequest, slot: int):
         """Run the prompt through the model's dense path once, scatter
-        the per-layer K/V into cache pages, sample the first token."""
+        the per-layer K/V into cache pages with ONE fused call, sample
+        the first token."""
         import paddle_tpu as paddle
         from ..autograd.tape import no_grad
+        from ..jit.serving_step import prefill_scatter
         L = len(req.prompt_ids)
         ids = paddle.to_tensor(req.prompt_ids[None, :].astype(np.int64))
         with no_grad():
             logits, kv = self.model.forward(
                 ids, caches=[(None, None)] * self.cfg.num_hidden_layers)
-        # allocate pages covering prompt + generation budget up front
-        # (simple fixed reservation; ensure_capacity grows on demand too)
+        # allocate pages covering prompt + generation budget up front.
+        # Pools share the free-list of cache 0 so one table serves every
+        # layer.
         n_blocks = self.caches[0].blocks_needed(L + req.max_new_tokens)
         req.block_ids = [self.caches[0].allocate_block()
                          for _ in range(n_blocks)]
-        bt = np.asarray(req.block_ids, np.int32)[None, :]
-        zeros = np.zeros((1,), np.int32)
-        for cache, (k, v) in zip(self.caches, kv):
-            # k/v [1, L, Hkv, D] pre-GQA-repeat — prefill scatter at 0.
-            # Pools share the free-list of cache 0 so one table serves
-            # every layer; write through the functional API.
-            from ..ops.paged_attention import write_kv_to_cache
-            cache.key_cache, cache.value_cache = write_kv_to_cache(
-                k, v, cache.key_cache, cache.value_cache, bt, zeros,
-                donate=True)
+        row = np.full((1, self.bt_width), self._sink, np.int32)
+        row[0, :n_blocks] = req.block_ids
+        # k/v [1, L, Hkv, D] pre-GQA-repeat — one donated scatter over
+        # ALL layers (not a Python loop of per-layer dispatches)
+        prefill_scatter(self.caches, kv, row)
         req.slot = slot
         req.seq_len = L
         req.state = "running"
         self.slots[slot] = req
         last = np.asarray(logits[:, -1, :]._value, np.float32)
-        self._append_token(req, int(last[0].argmax()))
+        first = int(last[0].argmax())
+        self._append_token(req, first)
+        if self.slots[slot] is req:         # still running after budget
+            self._tokens[slot] = first
+            self._seq_lens[slot] = L
+            self._bt[slot] = row[0]
 
     # ---- batched decode -------------------------------------------------
-    def _active(self) -> List[GenerationRequest]:
-        return [r for r in self.slots if r is not None]
-
     def _decode_batch(self) -> List[int]:
-        import paddle_tpu as paddle
-        from ..autograd.tape import no_grad
-        from ..incubate.nn.functional import \
-            fused_rotary_position_embedding
-        reqs = self._active()
-        if not reqs:
+        if all(r is None for r in self.slots):
             return []
-        B = len(reqs)
-        tokens = np.asarray([r.output_ids[-1] for r in reqs],
-                            np.int64)[:, None]
-        seq_lens = np.asarray([r.seq_len for r in reqs], np.int32)
-        max_blocks = max(len(r.block_ids) for r in reqs)
-        bt = np.full((B, max_blocks), -1, np.int32)
-        for i, r in enumerate(reqs):
-            bt[i, :len(r.block_ids)] = r.block_ids
-
-        llama = self.model.llama
-        cfg = self.cfg
-        H = cfg.num_attention_heads
-        Hkv = cfg.num_key_value_heads
-        D = self.head_dim
-        with no_grad():
-            x = llama.embed_tokens(paddle.to_tensor(tokens))  # [B,1,h]
-            pos = paddle.to_tensor(seq_lens[:, None].astype(np.int32))
-            for layer, cache in zip(llama.layers, self.caches):
-                h = layer.input_layernorm(x)
-                attn = layer.self_attn
-                q = attn.q_proj(h).reshape([B, 1, H, D])
-                k = attn.k_proj(h).reshape([B, 1, Hkv, D])
-                v = attn.v_proj(h).reshape([B, 1, Hkv, D])
-                q, k, _ = fused_rotary_position_embedding(
-                    q, k, position_ids=pos,
-                    rotary_emb_base=cfg.rope_theta)
-                cache.append(k[:, 0], v[:, 0], bt, seq_lens)
-                out = paged_attention(
-                    q[:, 0], cache.key_cache, cache.value_cache, bt,
-                    seq_lens + 1)                      # incl. new token
-                out = out.reshape([B, 1, H * D])
-                x = x + attn.o_proj(out)
-                h2 = layer.post_attention_layernorm(x)
-                x = x + layer.mlp(h2)
-            x = llama.norm(x)
-            if self.model.lm_head is None:
-                from ..ops.linalg import matmul
-                logits = matmul(x, llama.embed_tokens.weight,
-                                transpose_y=True)
-            else:
-                logits = self.model.lm_head(x)
-        nxt = np.asarray(logits[:, 0, :]._value, np.float32).argmax(-1)
-
+        # ONE fused XLA call at the fixed slot count; masked slots ride
+        # along (their writes hit the sink page, their token is ignored)
+        nxt = self.decode_step(self._tokens, self._seq_lens, self._bt)
         done = []
-        for i, r in enumerate(reqs):
+        for i, r in enumerate(list(self.slots)):
+            if r is None:
+                continue
             r.seq_len += 1
-            self._append_token(r, int(nxt[i]))
+            self._seq_lens[i] += 1
+            tok = int(nxt[i])
+            self._append_token(r, tok)
+            if self.slots[i] is r:
+                self._tokens[i] = tok
             if r.state == "done":
                 done.append(r.req_id)
         return done
@@ -221,7 +225,11 @@ class ContinuousBatchingEngine:
     def _finish(self, req: GenerationRequest):
         req.state = "done"
         if req.slot >= 0:
-            self.slots[req.slot] = None
+            s = req.slot
+            self.slots[s] = None
+            self._tokens[s] = 0
+            self._seq_lens[s] = 0
+            self._bt[s, :] = self._sink
         self.caches[0].free_sequence(req.block_ids)
         req.block_ids = []
         self.finished[req.req_id] = req

@@ -1,0 +1,187 @@
+"""Fully-fused compiled serving steps (decode + prefill scatter).
+
+The serving analog of ``TrainStep``: one engine decode step — every
+transformer layer (projections, fused RoPE, paged KV-cache append,
+paged attention, MLP), the final norm, the LM head, and greedy sampling
+— traced into ONE XLA module at a fixed slot count, with the per-layer
+KV-cache pages passed as donated arguments so the append is an in-place
+HBM update.  Parity intent: the reference's ``AnalysisPredictor::
+ZeroCopyRun`` single-graph serving execution (analysis_predictor.h:210)
+driven per token by the block_multihead_attention kernel.
+
+Shape policy: the batch dimension is the engine's slot count, NEVER the
+number of active requests.  Inactive slots are masked, not dropped —
+their token id is 0, their seq_len is 0, and their block-table row
+points every entry at the cache's sink page (PagedKVCache
+``sink_block``), so their writes land in a page no request owns and
+their sampled token is ignored by the host.  Admission, eviction and
+slot churn therefore never change a traced shape: the decode step
+compiles exactly once per engine lifetime (``compile_count`` asserts
+this in tests).
+
+The only per-step host traffic is the [slots] int32 next-token fetch —
+sampling runs on device, so the 1-token logits tensor never crosses the
+link.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["DecodeStep", "prefill_scatter"]
+
+
+def _prefill_scatter_impl(ks, vs, kcs, vcs, block_tables, start):
+    """Scatter one request's per-layer prompt K/V ([1, L, Hkv, D] each)
+    into the per-layer page pools in a single traced module."""
+    from ..ops.paged_attention import write_prefill_kv
+    new_k, new_v = [], []
+    for k, v, kc, vc in zip(ks, vs, kcs, vcs):
+        kc, vc = write_prefill_kv(k, v, kc, vc, block_tables, start)
+        new_k.append(kc)
+        new_v.append(vc)
+    return tuple(new_k), tuple(new_v)
+
+
+# donate the cache pools: prefill admission is an in-place HBM write.
+# One XLA dispatch per REQUEST (all layers fused), not one per layer —
+# recompiles only per distinct prompt length (the scatter is tiny).
+_prefill_scatter_j = jax.jit(_prefill_scatter_impl, donate_argnums=(2, 3))
+
+
+def prefill_scatter(caches, kv, block_table_row):
+    """Write a freshly-prefilled request's K/V into the paged caches.
+
+    caches: per-layer PagedKVCache list (rebound in place).
+    kv: per-layer (k, v) Tensors/arrays [1, L, Hkv, D] from the model's
+    dense prefill forward.  block_table_row: [1, W] int32.
+    """
+    ks = tuple(k._value if isinstance(k, Tensor) else jnp.asarray(k)
+               for k, _ in kv)
+    vs = tuple(v._value if isinstance(v, Tensor) else jnp.asarray(v)
+               for _, v in kv)
+    kcs = tuple(c.key_cache for c in caches)
+    vcs = tuple(c.value_cache for c in caches)
+    bt = jnp.asarray(np.asarray(block_table_row), jnp.int32)
+    start = jnp.zeros((1,), jnp.int32)
+    new_k, new_v = _prefill_scatter_j(ks, vs, kcs, vcs, bt, start)
+    for c, kc, vc in zip(caches, new_k, new_v):
+        c.key_cache = kc
+        c.value_cache = vc
+
+
+class DecodeStep:
+    """Compile the whole per-token decode into one donated-buffer call.
+
+    ``__call__(tokens, seq_lens, block_tables)`` advances every slot by
+    one token: appends the previous token's K/V at position seq_len,
+    attends over seq_len+1 cached tokens, and returns the greedy next
+    token per slot as a host int32 array (the step's only host fetch).
+    The per-layer caches are read from — and rebound onto — the
+    PagedKVCache objects handed to the constructor.
+    """
+
+    def __init__(self, model, caches: List, use_pallas: Optional[bool]
+                 = None):
+        from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
+        self.model = model
+        self.caches = caches
+        self.cfg = model.config
+        if use_pallas is None:
+            use_pallas = _HAS_PLTPU and _on_tpu()
+        self.use_pallas = use_pallas
+        # capture the param TENSORS once: per-step we only read their
+        # current values, no module-tree walk in the serving hot loop
+        self._param_tensors = dict(model.state_dict())
+        self._fn = None
+        # incremented inside the traced body: one bump per (re)trace, so
+        # tests can assert the decode step compiles exactly once across
+        # admission/eviction churn
+        self.compile_count = 0
+
+    def _build(self):
+        from ..autograd.tape import no_grad
+        from ..incubate.nn.functional import \
+            fused_rotary_position_embedding
+        from ..ops.paged_attention import (_paged_attention_pallas,
+                                           _paged_attention_xla,
+                                           write_decode_kv)
+        model = self.model
+        cfg = self.cfg
+        llama = model.llama
+        H = cfg.num_attention_heads
+        Hkv = cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        scale = 1.0 / math.sqrt(D)
+        attn_fn = _paged_attention_pallas if self.use_pallas \
+            else _paged_attention_xla
+
+        def step(params, tokens, seq_lens, block_tables, kcs, vcs):
+            self.compile_count += 1
+            S = tokens.shape[0]
+            new_kcs, new_vcs = [], []
+            with model.bind_state(params), no_grad():
+                x = llama.embed_tokens(
+                    Tensor._from_value(tokens[:, None]))     # [S, 1, h]
+                if cfg.dtype == "bfloat16":
+                    x = x.astype("bfloat16")
+                pos = Tensor._from_value(seq_lens[:, None])
+                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                    h = layer.input_layernorm(x)
+                    attn = layer.self_attn
+                    q = attn.q_proj(h).reshape([S, 1, H, D])
+                    k = attn.k_proj(h).reshape([S, 1, Hkv, D])
+                    v = attn.v_proj(h).reshape([S, 1, Hkv, D])
+                    q, k, _ = fused_rotary_position_embedding(
+                        q, k, position_ids=pos,
+                        rotary_emb_base=cfg.rope_theta)
+                    kc, vc = write_decode_kv(
+                        k._value[:, 0], v._value[:, 0], kc, vc,
+                        block_tables, seq_lens)
+                    new_kcs.append(kc)
+                    new_vcs.append(vc)
+                    out = attn_fn(q._value[:, 0], kc, vc, block_tables,
+                                  seq_lens + 1, scale)   # incl. new token
+                    out = Tensor._from_value(out.reshape(S, 1, H * D))
+                    x = x + attn.o_proj(out)
+                    h2 = layer.post_attention_layernorm(x)
+                    x = x + layer.mlp(h2)
+                x = llama.norm(x)
+                if model.lm_head is None:
+                    from ..ops.linalg import matmul
+                    logits = matmul(x, llama.embed_tokens.weight,
+                                    transpose_y=True)
+                else:
+                    logits = model.lm_head(x)
+            # greedy sampling ON DEVICE: only the [S] token ids cross
+            # the link, never the [S, V] logits
+            nxt = jnp.argmax(
+                logits._value[:, 0, :].astype(jnp.float32),
+                axis=-1).astype(jnp.int32)
+            return nxt, tuple(new_kcs), tuple(new_vcs)
+
+        self._fn = jax.jit(step, donate_argnums=(4, 5))
+
+    def __call__(self, tokens, seq_lens, block_tables) -> np.ndarray:
+        if self._fn is None:
+            self._build()
+        params = {k: t._value for k, t in self._param_tensors.items()}
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        nxt, new_kcs, new_vcs = self._fn(
+            params,
+            jnp.asarray(np.asarray(tokens, np.int32)),
+            jnp.asarray(np.asarray(seq_lens, np.int32)),
+            jnp.asarray(np.asarray(block_tables, np.int32)),
+            kcs, vcs)
+        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
+            c.key_cache = kc
+            c.value_cache = vc
+        return np.asarray(nxt)

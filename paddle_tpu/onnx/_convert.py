@@ -734,4 +734,14 @@ def program_to_onnx(program, out_tensors, opset: int = 13,
 
     g = P.graph(conv.nodes, program.name, inputs, outputs,
                 conv.initializers)
+    if conv.min_opset > opset:
+        # never silently emit a model at a different opset than the one
+        # the caller pinned: a deploy pipeline that validates against
+        # opset N must find out at export time, not at load time
+        import warnings
+        warnings.warn(
+            f"ONNX export: requested opset {opset} but the converted "
+            f"graph uses ops that require opset {conv.min_opset} "
+            f"(e.g. LayerNormalization needs 17); emitting opset "
+            f"{conv.min_opset}", UserWarning, stacklevel=2)
     return P.model(g, opset=max(opset, conv.min_opset))

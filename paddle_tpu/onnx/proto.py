@@ -381,8 +381,20 @@ def evaluate(model_dict: Dict, feeds: Dict[str, np.ndarray]) -> List:
             alpha = a.get("alpha", 0.01)
             out = np.where(ins[0] >= 0, ins[0], alpha * ins[0])
         elif op == "Resize":
-            # nearest + integer scales (the exporter's contract)
-            scales = [int(s) for s in ins[2]]
+            # nearest + integer scales (the exporter's contract).
+            # Round rather than truncate: a scale serialized as
+            # 1.9999999 is 2, while a genuinely fractional scale is a
+            # contract violation and must fail loudly, not floor to a
+            # wrong-shaped output.
+            scales = []
+            for s in ins[2]:
+                r = int(round(float(s)))
+                if abs(float(s) - r) >= 1e-4:
+                    raise ValueError(
+                        f"Resize: non-integer scale {float(s)!r} — the "
+                        "exporter only emits integer nearest-neighbor "
+                        "scales")
+                scales.append(r)
             out = ins[0]
             for ax, s in enumerate(scales):
                 if s != 1:

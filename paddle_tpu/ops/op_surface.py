@@ -1007,6 +1007,23 @@ def data(name, shape, dtype="float32", lod_level=0):
     return _static.data(name, shape, dtype)
 
 
+def coalesce_tensor(inputs, dtype=None, copy_data=True,
+                    set_constant=False, persist_output=True,
+                    constant=0.0, use_align=True, align_size=-1,
+                    name=None):
+    """Parity: reference coalesce_tensor op
+    (phi/kernels/coalesce_tensor_kernel.cc) — fuse a tensor list into
+    one contiguous buffer + per-input views, the kernel behind the DP
+    fused-grad buffers.  Alias onto the DP-overlap fused-buffer
+    machinery (distributed/passes), which buckets and coalesces grads
+    natively; returns (outputs, fused_output)."""
+    from ..distributed.passes import coalesce_tensor as _impl
+    return _impl(inputs, dtype=dtype, copy_data=copy_data,
+                 set_constant=set_constant,
+                 persist_output=persist_output, constant=constant,
+                 use_align=use_align, align_size=align_size)
+
+
 def warprnnt(input, label, input_lengths, label_lengths, blank=0,
              fastemit_lambda=0.0, name=None):
     """Parity: reference warprnnt op (RNN-Transducer loss) — the
@@ -1535,6 +1552,7 @@ def _surface_entries():
         ("reindex_graph", reindex_graph, "geometric"),
         ("weighted_sample_neighbors", weighted_sample_neighbors,
          "geometric"),
+        ("coalesce_tensor", coalesce_tensor, "fused"),
     ]
     return rows
 

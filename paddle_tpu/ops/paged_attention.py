@@ -37,6 +37,7 @@ except Exception:  # pragma: no cover
 from ..core.tensor import Tensor
 
 __all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
+           "write_decode_kv", "write_prefill_kv",
            "reconstruct_kv", "block_multihead_attention",
            "masked_multihead_attention"]
 
@@ -58,12 +59,19 @@ class PagedKVCache:
     """
 
     def __init__(self, num_blocks: int, block_size: int, num_kv_heads: int,
-                 head_dim: int, dtype=jnp.float32):
+                 head_dim: int, dtype=jnp.float32, sink_block: bool = False):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
-        shape = (num_blocks, block_size, num_kv_heads, head_dim)
+        # sink_block=True adds ONE extra physical page, never in the free
+        # list, exposed as .sink: a fixed-shape compiled decode step
+        # routes the writes of inactive (masked) batch slots there, so
+        # slot occupancy changes never corrupt live pages and never
+        # change any traced shape.
+        self.sink = num_blocks if sink_block else -1
+        phys = num_blocks + (1 if sink_block else 0)
+        shape = (phys, block_size, num_kv_heads, head_dim)
         self.key_cache = jnp.zeros(shape, dtype)
         self.value_cache = jnp.zeros(shape, dtype)
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
@@ -160,6 +168,13 @@ def _write_prefill_impl(k_new, v_new, key_cache, value_cache, block_tables,
 
 _write_prefill = jax.jit(_write_prefill_impl)
 _write_prefill_donated = jax.jit(_write_prefill_impl, donate_argnums=(2, 3))
+
+# traceable (un-jitted) functional appends: COMPOSE these under an outer
+# jax.jit (the serving engine's single fused decode step) — calling the
+# jitted variants from inside a trace would nest dispatches instead of
+# fusing the scatter into the surrounding module
+write_decode_kv = _write_decode_impl
+write_prefill_kv = _write_prefill_impl
 
 
 def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
@@ -295,15 +310,15 @@ def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
         _paged_decode_kernel, block_size=bs, pages_per_seq=pages_per_seq,
         scale=scale, groups=groups)
 
-    with jax.enable_x64(False):
+    with jax.experimental.disable_x64():
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, Hkv),
             in_specs=[
                 pl.BlockSpec((1, 1, groups, D),
                              lambda b, h, *_: (b, h, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
             ],
             out_specs=pl.BlockSpec((1, 1, groups, D),
                                    lambda b, h, *_: (b, h, 0, 0)),

@@ -45,6 +45,30 @@ def test_p_norm_and_friends():
     np.testing.assert_allclose(np.linalg.norm(clipped), 0.5, rtol=1e-4)
 
 
+def test_coalesce_tensor_fused_buffer():
+    """coalesce_tensor (the last non-hardware reference-YAML op name):
+    fuse a tensor list into one flat buffer + per-input views — the
+    DP-overlap fused-grad-buffer machinery behind an op-level name."""
+    xs = [np.random.RandomState(i).randn(3, 4).astype(np.float32)
+          for i in range(3)]
+    outs, fused = call("coalesce_tensor", [t(x) for x in xs],
+                       dtype="float32", use_align=False)
+    assert fused.numpy().shape == (36,)
+    np.testing.assert_allclose(
+        fused.numpy(), np.concatenate([x.ravel() for x in xs]),
+        rtol=1e-6)
+    for o, x in zip(outs, xs):
+        np.testing.assert_allclose(o.numpy(), x, rtol=1e-6)
+    # aligned mode pads each chunk to the 128-element lane boundary
+    outs2, fused2 = call("coalesce_tensor", [t(x) for x in xs])
+    assert fused2.numpy().shape == (3 * 128,)
+    np.testing.assert_allclose(outs2[1].numpy(), xs[1], rtol=1e-6)
+    # set_constant fills the whole buffer
+    _, fused3 = call("coalesce_tensor", [t(x) for x in xs],
+                     set_constant=True, constant=2.5, use_align=False)
+    assert (fused3.numpy() == 2.5).all()
+
+
 def test_fill_diagonal_ops():
     x = np.zeros((3, 3), np.float32)
     out = call("fill_diagonal", t(x), 5.0).numpy()

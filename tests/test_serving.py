@@ -374,6 +374,72 @@ def test_continuous_batching_slot_reuse_and_eviction():
     assert len(eng.caches[0]._free) == 8
 
 
+def test_compiled_decode_compiles_once_across_churn():
+    """The decode step is ONE jitted module at the fixed slot count:
+    admission, eviction, and re-admission (occupancy 0 -> 2 -> 1 -> 2
+    -> ... -> 0 with a 2-slot engine cycling 4 requests) must leave the
+    trace count at exactly 1, with tokens byte-identical to each
+    request's solo eager generate (pattern: the compile-hygiene gate in
+    tests/test_sparse_nn.py)."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    prompts = [np.array([3, 14, 15, 92, 65], np.int64),
+               np.array([1, 2], np.int64),
+               np.array([42, 7, 9], np.int64),
+               np.array([8, 8, 120, 4], np.int64)]
+    budgets = [6, 9, 4, 7]
+    want = []
+    for p, n in zip(prompts, budgets):
+        out = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=n)
+        want.append(np.asarray(out._value)[0, len(p):].tolist())
+
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=16, block_size=4)
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, budgets)]
+    eng.run_to_completion()
+    for rid, w in zip(rids, want):
+        assert eng.result(rid) == w
+    assert eng.decode_step.compile_count == 1, (
+        "decode step recompiled under slot churn: occupancy changes "
+        "must be masked, never re-shaped")
+    # a second wave through the SAME engine reuses the compiled step
+    rid2 = eng.add_request(prompts[0], budgets[0])
+    eng.run_to_completion()
+    assert eng.result(rid2) == want[0]
+    assert eng.decode_step.compile_count == 1
+
+
+def test_engine_rejects_request_beyond_table_width():
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=8, block_size=4,
+                                   max_seq_len=8)
+    with pytest.raises(ValueError, match="raise max_seq_len"):
+        eng.add_request(np.arange(1, 7, dtype=np.int64),
+                        max_new_tokens=8)   # needs 14 > 8 tokens
+
+
+def test_masked_slots_do_not_perturb_live_request():
+    """A request decoding alongside empty (masked) slots must produce
+    the same tokens as one occupying a full engine: inactive-slot
+    writes land in the sink page, never in live pages."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    p = np.array([7, 11, 13], np.int64)
+    ref = model.generate(paddle.to_tensor(p[None, :]), max_new_tokens=6)
+    ref_toks = np.asarray(ref._value)[0, 3:].tolist()
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=32, block_size=4)
+    rid = eng.add_request(p, max_new_tokens=6)
+    eng.run_to_completion()
+    assert eng.result(rid) == ref_toks
+    # sink page is not in the free list and was never handed out
+    assert eng.caches[0].sink not in eng.caches[0]._free
+    assert len(eng.caches[0]._free) == 32
+
+
 def test_continuous_batching_eos_stops_early():
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
     model = _tiny_model()

@@ -1,0 +1,184 @@
+"""Serving bench: prefill + decode tokens/s/chip for the continuous-
+batching engine's single-compile decode step (ISSUE round-6 tentpole).
+
+Emits a driver-readable artifact (BENCH_SERVE_r06.json at the repo root,
+or the path in argv[1]) in the BENCH_ATTN_r05.json style: decode
+tokens/s/chip over a slot-occupancy sweep, prefill tokens/s, the decode
+step's compile count (must be 1 across the whole sweep — occupancy is
+masked, never re-shaped), and a correctness gate: engine tokens must be
+byte-identical to the model's eager ``generate`` before any number is
+trusted ("passed").
+
+Model: the 1.1B-param bench config (bench.py's second line) on TPU; the
+tiny llama config on CPU so the artifact schema is CI-checkable.
+
+Measurement: every engine step ends with a host fetch of the [slots]
+int32 next-token array — that fetch is the real synchronization barrier
+over the tunneled chip (see bench.py header), and it is also genuine
+per-token serving behavior (the scheduler needs the ids), so wall-clock
+per step IS the served step time.  Run from the repo root.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models import LlamaConfig  # noqa: E402
+from paddle_tpu.models.llama import (LlamaForCausalLM,  # noqa: E402
+                                     llama_tiny_config, param_count)
+from paddle_tpu.inference.serving import (  # noqa: E402
+    ContinuousBatchingEngine)
+
+
+def build_model(on_tpu):
+    if on_tpu:
+        # the 1.1B line from bench.py (head_dim 128, bf16)
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=20, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+    else:
+        cfg = llama_tiny_config()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.bfloat16()
+    model.eval()
+    return cfg, model
+
+
+def parity_gate(model, max_abs=0):
+    """Engine output must be byte-identical to eager generate for a
+    staggered 3-request mix before any throughput number is trusted."""
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+               for n in (5, 3, 8)]
+    budgets = [6, 8, 5]
+    want = []
+    for p, n in zip(prompts, budgets):
+        out = model.generate(paddle.to_tensor(p[None, :]),
+                             max_new_tokens=n)
+        want.append(np.asarray(out._value)[0, len(p):].tolist())
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=16)
+    r0 = eng.add_request(prompts[0], budgets[0])
+    eng.step()
+    r1 = eng.add_request(prompts[1], budgets[1])
+    eng.step()
+    r2 = eng.add_request(prompts[2], budgets[2])
+    eng.run_to_completion()
+    ok = (eng.result(r0) == want[0] and eng.result(r1) == want[1]
+          and eng.result(r2) == want[2])
+    return ok
+
+
+def bench_decode(model, slots, occupancy, prompt_len, warm, steps,
+                 num_blocks, block_size):
+    """tokens/s for `occupancy` active requests in a `slots`-slot
+    engine (the compiled shape is always `slots` wide)."""
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(0)
+    eng = ContinuousBatchingEngine(model, max_batch_size=slots,
+                                   num_blocks=num_blocks,
+                                   block_size=block_size)
+    budget = warm + steps + 8           # nobody finishes mid-window
+    for _ in range(occupancy):
+        eng.add_request(rng.randint(1, vocab, (prompt_len,))
+                        .astype(np.int64), max_new_tokens=budget)
+    # prefill admission timed alone (dense forward + one fused scatter
+    # per request); the decode-step compile lands in the warm window
+    t0 = time.perf_counter()
+    eng._admit()
+    np.asarray(eng.caches[-1].key_cache[0, 0, 0, 0])  # fetch barrier
+    dt_prefill = time.perf_counter() - t0
+    for _ in range(warm + 1):
+        eng.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        eng.step()
+    dt = time.perf_counter() - t0
+    assert eng.decode_step.compile_count == 1, (
+        "decode step recompiled mid-bench")
+    return {
+        "occupancy": occupancy,
+        "decode_tokens_per_sec": round(occupancy * steps / dt, 1),
+        "decode_step_ms": round(dt / steps * 1000, 3),
+        "prefill_tokens_per_sec": round(
+            occupancy * prompt_len / dt_prefill, 1),
+    }
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE_r06.json"
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg, model = build_model(on_tpu)
+
+    ok = parity_gate(model)
+    print(f"# parity gate vs eager generate: {'OK' if ok else 'FAILED'}",
+          file=sys.stderr)
+
+    if on_tpu:
+        slots, prompt_len = 8, 128
+        num_blocks, block_size = 8 * (-(-(128 + 64) // 16) + 2), 16
+        occupancies = [1, 2, 4, 8]
+        warm, steps = 4, 32
+    else:
+        slots, prompt_len = 4, 12
+        num_blocks, block_size = 64, 4
+        occupancies = [1, 2, 4]
+        warm, steps = 2, 8
+
+    sweep = []
+    for occ in occupancies:
+        r = bench_decode(model, slots, occ, prompt_len, warm, steps,
+                         num_blocks, block_size)
+        sweep.append(r)
+        print(f"# occ={occ}/{slots}: {r['decode_tokens_per_sec']} tok/s "
+              f"decode ({r['decode_step_ms']} ms/step), "
+              f"{r['prefill_tokens_per_sec']} tok/s prefill",
+              file=sys.stderr)
+
+    full = sweep[-1]
+    artifact = {
+        "metric": "serving_decode_tokens_per_sec_per_chip",
+        "value": full["decode_tokens_per_sec"],
+        "passed": bool(ok),
+        "prefill_tokens_per_sec": full["prefill_tokens_per_sec"],
+        "decode_sweep": sweep,
+        "decode_compile_count": 1,
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "slots": slots,
+            "prompt_len": prompt_len,
+            "block_size": block_size,
+            "num_blocks": num_blocks,
+            "dtype": cfg.dtype,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "tokens/s",
+        "vs_baseline": 1.0 if ok else 0.0,
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
