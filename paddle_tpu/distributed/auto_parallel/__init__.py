@@ -5,7 +5,8 @@ Strategy strategy.py, Engine static/engine.py:59).
 from .strategy import Strategy
 from .engine import Engine
 from .dist_model import (DistModel, to_static, read_back_dist_attrs,
-                         DistributedDataLoader)
+                         DistributedDataLoader, verify_sharded_update)
 
 __all__ = ["Strategy", "Engine", "DistModel", "to_static",
-           "read_back_dist_attrs", "DistributedDataLoader"]
+           "read_back_dist_attrs", "DistributedDataLoader",
+           "verify_sharded_update"]

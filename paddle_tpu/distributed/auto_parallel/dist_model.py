@@ -30,7 +30,7 @@ from .engine import Engine
 from .strategy import Strategy
 
 __all__ = ["DistModel", "to_static", "read_back_dist_attrs",
-           "DistributedDataLoader"]
+           "DistributedDataLoader", "verify_sharded_update"]
 
 _SHARDING_RE = re.compile(
     r"%?([\w.\-]+)\s*=\s*[^=]*?sharding=\{([^}]*)\}")
@@ -55,6 +55,52 @@ def read_back_dist_attrs(hlo_text: str) -> Dict[str, str]:
             "read_back_dist_attrs parsed none — the XLA text printer "
             "format changed; update _SHARDING_RE")
     return out
+
+
+def verify_sharded_update(train_step, *batch, stage: Optional[int] = None):
+    """The "it actually sharded" check for a ZeRO :class:`TrainStep`:
+    compile the sharded step (the same ``lower().compile().as_text()``
+    path the dist-attr read-back uses) and assert
+
+    - stage >= 2: the optimized HLO contains a ``reduce-scatter``
+      instruction (the per-bucket grad sync), and
+    - the updated params come back via ``all-gather``, and
+    - no shardable optimizer-state buffer has a replicated sharding
+      (each replica holds only its 1/dp shard).
+
+    Returns the optimized HLO text for further inspection.  Raises
+    AssertionError with a pointed message otherwise.  NOTE: lowering
+    re-traces the step, so check ``train_step.compile_count`` BEFORE
+    calling this.
+    """
+    if not getattr(train_step, "_sharded", False):
+        raise AssertionError(
+            "TrainStep was built without a mesh/ShardingConfig — "
+            "nothing is sharded")
+    txt = train_step.lower(*batch).compile().as_text()
+    stage = stage if stage is not None else train_step._shard_cfg.stage
+    if stage >= 2 and "reduce-scatter" not in txt:
+        raise AssertionError(
+            "stage-2 sharded step compiled WITHOUT a reduce-scatter — "
+            "the grad sync fell back to something else; inspect the "
+            "returned HLO")
+    if "all-gather" not in txt:
+        raise AssertionError(
+            "sharded step compiled without an all-gather — updated "
+            "params are not being re-assembled from shards")
+    sd = train_step.model.state_dict()
+    for k, st in train_step._opt_states.items():
+        if not train_step._shardable.get(k):
+            continue
+        for name, v in st.items():
+            if not (hasattr(v, "sharding") and getattr(v, "ndim", 0) >= 1):
+                continue
+            if v.sharding.is_fully_replicated and \
+                    v.shape == sd[k]._value.shape:
+                raise AssertionError(
+                    f"optimizer state {k!r}/{name!r} is REPLICATED — the "
+                    f"1/dp memory saving is not happening")
+    return txt
 
 
 def _batch_spec(val, mesh, axis):

@@ -85,10 +85,22 @@ class Engine:
     def _build_step(self):
         if self._step_fn is not None:
             return self._step_fn
-        from ...jit.train_step import TrainStep
+        from ...jit.train_step import TrainStep, ShardingConfig
         clip = None
+        mesh = None
+        shard_cfg = None
+        s = self._strategy.sharding
+        if getattr(s, "enable", False):
+            # ZeRO-1/2 weight-update sharding inside the SAME fused
+            # donated module (Strategy.sharding stage/degree knobs)
+            mesh = self._build_mesh()
+            shard_cfg = ShardingConfig(
+                stage=int(getattr(s, "stage", 1) or 1),
+                degree=int(getattr(s, "degree", -1) or -1),
+                axis="dp")
         self._train_step = TrainStep(self._model, self._loss,
-                                     self._optimizer, clip_norm=clip)
+                                     self._optimizer, clip_norm=clip,
+                                     mesh=mesh, sharding=shard_cfg)
         self._step_fn = self._train_step
         return self._step_fn
 
@@ -106,25 +118,42 @@ class Engine:
         it = 0
         for epoch in range(epochs):
             epoch_steps = 0
-            for batch in loader:
-                batch = batch if isinstance(batch, (list, tuple)) \
-                    else [batch]
-                arrays = [self._shard_batch(np.asarray(b._value)
-                                            if isinstance(b, Tensor)
-                                            else b) for b in batch]
+            batch_it = iter(loader)
+            # one-batch lookahead: the host->device transfer (device_put
+            # dispatch) for batch k+1 is issued while step k executes on
+            # device — the loss fetch (the sync point) comes only after
+            # the next transfer is in flight
+            arrays = self._next_device_batch(batch_it)
+            while arrays is not None:
                 if getattr(self, "_sample_arrays", None) is None:
                     self._sample_arrays = arrays
-                loss = step(*arrays)
+                loss = step(*arrays)                     # async dispatch
+                epoch_steps += 1
+                last = bool(steps_per_epoch
+                            and epoch_steps >= steps_per_epoch)
+                # overlap h2d with the running step — but never pull a
+                # batch past the epoch cap (a shared/streaming iterator
+                # would silently lose it)
+                arrays = None if last \
+                    else self._next_device_batch(batch_it)
                 history["loss"].append(float(np.asarray(loss)))
                 it += 1
-                epoch_steps += 1
                 if verbose and it % log_freq == 0:
                     print(f"[AutoParallel Engine] epoch {epoch} step "
                           f"{it}: loss {history['loss'][-1]:.5f}")
-                if steps_per_epoch and epoch_steps >= steps_per_epoch:
-                    break
         self._history = history
         return history
+
+    def _next_device_batch(self, batch_it):
+        """Fetch + shard the next batch onto the mesh; None at the end."""
+        try:
+            batch = next(batch_it)
+        except StopIteration:
+            return None
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        return [self._shard_batch(np.asarray(b._value)
+                                  if isinstance(b, Tensor)
+                                  else b) for b in batch]
 
     def evaluate(self, valid_data, valid_sample_split=None, batch_size=1,
                  steps=None, collate_fn=None, verbose=0):

@@ -24,6 +24,12 @@ class Strategy:
         self.amp = _Config(dtype="float16", level="O1",
                            init_loss_scaling=32768.0,
                            use_master_weights=False)
+        # sharding.enable=True makes the Engine compile the ZeRO
+        # weight-update sharding INTO the fused donated train step
+        # (jit/train_step.py ShardingConfig): stage 1 = 'os' (full-grad
+        # all-reduce, optimizer state + update sharded over dp),
+        # stage 2 = 'os_g' (grads reduce-scattered per coalesced
+        # bucket).  degree=-1 infers the dp axis size.
         self.sharding = _Config(stage=1, degree=-1)
         self.recompute = _Config(refined_ops=None)
         self.pipeline = _Config(schedule_mode="1F1B",

@@ -31,6 +31,7 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
         model = GroupShardedStage2(model, opt, group=group,
                                    sync_buffers=sync_buffers,
                                    buffer_max_size=buffer_max_size)
+        _mark_sharded_update(opt, level)
         return model, opt, scaler
     model = GroupShardedStage3(model, optimizer=optimizer, group=group,
                                sync_buffers=sync_buffers,
@@ -38,6 +39,24 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
     opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
                                       group=group, offload=offload)
     return model, opt, scaler
+
+
+def _mark_sharded_update(opt, level: str):
+    """Route 'os'/'os_g' onto the fused ZeRO train step: a TrainStep
+    built from this optimizer compiles the sharded weight update (stage
+    1 for 'os', stage 2 / per-bucket reduce-scatter for 'os_g') over the
+    hybrid-communicate-group mesh — so the eager wrapper and the
+    compiled path shard the same state over the same axis."""
+    from ...topology import get_hybrid_communicate_group
+    from .sharding import _sharding_axis
+    hcg = get_hybrid_communicate_group()
+    mesh = hcg.mesh if hcg else None
+    axis = _sharding_axis(mesh) if mesh is not None else None
+    if axis is None:
+        return
+    from ....jit.train_step import ShardingConfig
+    opt._sharded_update = (
+        mesh, ShardingConfig(stage=1 if level == "os" else 2, axis=axis))
 
 
 def save_group_sharded_model(model, output, optimizer=None):

@@ -227,7 +227,12 @@ class AMPPass(PassBase):
 @register_pass("auto_parallel_sharding_pass")
 class ShardingPass(PassBase):
     """attrs: {"stage": 1|2|3, "offload": bool} — wraps via
-    group_sharded_parallel (parity: auto_parallel_sharding.py)."""
+    group_sharded_parallel (parity: auto_parallel_sharding.py).
+    group_sharded_parallel also stamps the optimizer with a
+    ``_sharded_update`` marker for stage 1/2, so a TrainStep built from
+    the returned pair compiles the ZeRO-sharded fused update (see
+    ShardedWeightUpdatePass) — the eager wrapper and the compiled path
+    agree."""
 
     def check(self, model, optimizer):
         return int(self.attrs.get("stage", 1)) in (1, 2, 3)
@@ -240,6 +245,51 @@ class ShardingPass(PassBase):
         model, optimizer, _ = group_sharded_parallel(
             model, optimizer, level=level,
             offload=bool(self.attrs.get("offload", False)))
+        return model, optimizer
+
+
+# ---------------------------------------------------------------------------
+# sharded weight update (compiled path: ZeRO-1/2 inside the fused step)
+# ---------------------------------------------------------------------------
+@register_pass("sharded_weight_update")
+@register_pass("auto_parallel_sharded_weight_update_pass")
+class ShardedWeightUpdatePass(PassBase):
+    """attrs: {"stage": 1|2, "degree": -1, "axis": "dp",
+    "bucket_mb": 25, "mesh": ProcessMesh|None}.
+
+    The compiled-path counterpart of :class:`ShardingPass`: instead of
+    eager grad hooks, it marks the (model, optimizer) pair so the next
+    :class:`~paddle_tpu.jit.train_step.TrainStep` compiles the ZeRO
+    sharded update INSIDE the donated XLA module — gradients
+    reduce-scattered over the dp axis (stage 2: one reduce-scatter per
+    coalesced dtype bucket, the same flat-buffer layout as the
+    DP-overlap/coalesce_tensor machinery above, sized by ``bucket_mb``),
+    the optimizer update applied to each replica's 1/dp shard of params
+    + state (states created sharded, never materialized replicated),
+    and updated params all-gathered.  ``mesh`` defaults to the current
+    hybrid-communicate-group mesh."""
+
+    def check(self, model, optimizer):
+        return int(self.attrs.get("stage", 1)) in (1, 2)
+
+    def _apply_impl(self, model, optimizer):
+        from ...jit.train_step import ShardingConfig
+        mesh = self.attrs.get("mesh")
+        if mesh is None:
+            from ..topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            mesh = hcg.mesh if hcg else None
+        if mesh is None:
+            raise ValueError(
+                "sharded_weight_update: pass a 'mesh' attr or fleet.init "
+                "first (no hybrid communicate group)")
+        cfg = ShardingConfig(
+            stage=int(self.attrs.get("stage", 1)),
+            degree=int(self.attrs.get("degree", -1)),
+            axis=self.attrs.get("axis", "dp"),
+            bucket_mb=float(self.attrs.get("bucket_mb", 25)))
+        optimizer._sharded_update = (mesh, cfg)
+        model._sharded_update_applied = cfg.stage
         return model, optimizer
 
 

@@ -68,11 +68,15 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, xs: jax.Array,
         idx = lax.axis_index(axis_name)
 
         mb_shape = stream.shape[1:]
-        # initial carries are device-varying (they hold per-stage values)
-        state0 = lax.pcast(jnp.zeros(mb_shape, stream.dtype),
-                           (axis_name,), to="varying")
-        out0 = lax.pcast(jnp.zeros((n_micro,) + mb_shape, stream.dtype),
-                         (axis_name,), to="varying")
+        # initial carries are device-varying (they hold per-stage values);
+        # jax 0.4.x has no varying-type tracking (and check_rep=False
+        # there), so pcast degrades to identity
+        def _vary(x):
+            return lax.pcast(x, (axis_name,), to="varying") \
+                if hasattr(lax, "pcast") else x
+
+        state0 = _vary(jnp.zeros(mb_shape, stream.dtype))
+        out0 = _vary(jnp.zeros((n_micro,) + mb_shape, stream.dtype))
         pad = jnp.zeros((n_stages - 1,) + mb_shape, stream.dtype)
         feed = jnp.concatenate([stream, pad], 0)   # [T, ...]
 
@@ -98,8 +102,10 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, xs: jax.Array,
                                   jnp.zeros_like(outputs)), axis_name)
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
-    fn = jax.shard_map(pipelined, mesh=mesh, axis_names={axis_name},
-                       in_specs=(param_specs, P()), out_specs=P())
+    from ..core.jax_compat import shard_map_compat
+    fn = shard_map_compat(pipelined, mesh, in_specs=(param_specs, P()),
+                          out_specs=P(), manual_axes={axis_name},
+                          check=True)
     return fn(stage_params, xs)
 
 

@@ -208,31 +208,75 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(
             maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
+        # a consumer that stops early (break / exception / gc of this
+        # generator) sets `stop`; the producer's bounded put polls it so
+        # it can never block forever on a full queue the consumer will
+        # never drain again
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             global _worker_info
             _worker_info = WorkerInfo(0, self.num_workers, self.dataset)
             if self.worker_init_fn:
                 self.worker_init_fn(0)
+            pool = ThreadPoolExecutor(self.num_workers)
             try:
-                with ThreadPoolExecutor(self.num_workers) as pool:
-                    def load(batch_idx):
-                        samples = [self.dataset[i] for i in batch_idx]
-                        return self.collate_fn(samples)
+                def load(batch_idx):
+                    samples = [self.dataset[i] for i in batch_idx]
+                    return self.collate_fn(samples)
 
-                    for out in pool.map(load, self.batch_sampler):
-                        q.put(out)
+                # bounded submission window (Executor.map would submit
+                # the WHOLE sampler eagerly, letting finished batches
+                # pile up in memory ahead of a slow consumer — the queue
+                # bound must also bound the in-flight work)
+                from collections import deque
+                window = q.maxsize + self.num_workers
+                pending: "deque" = deque()
+                sampler_it = iter(self.batch_sampler)
+                exhausted = False
+                while pending or not exhausted:
+                    while not exhausted and len(pending) < window \
+                            and not stop.is_set():
+                        try:
+                            pending.append(
+                                pool.submit(load, next(sampler_it)))
+                        except StopIteration:
+                            exhausted = True
+                    if not pending:
+                        break
+                    if not _put(pending.popleft().result()):
+                        return
             except Exception as e:  # surface worker errors to the consumer
-                q.put(e)
+                _put(e)
             finally:
-                q.put(sentinel)
+                pool.shutdown(wait=False, cancel_futures=True)
+                _put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, daemon=True,
+                             name="pdtpu-dataloader-prefetch")
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so a put blocked on the full queue returns promptly
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -9,6 +9,26 @@ flexible path.
 Usage:
     step = TrainStep(model, criterion, optimizer)
     loss = step(batch_inputs, labels)        # one fused XLA call
+
+ZeRO-1/2 sharded weight update (Xu et al., arXiv:2004.13336 "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training"):
+pass a mesh + :class:`ShardingConfig` and the SAME donated module
+reduce-scatters gradients over the data-parallel axis, applies the
+optimizer update to only this replica's 1/dp shard of the parameters and
+optimizer state (states are CREATED sharded via ``NamedSharding`` —
+never materialized replicated), then all-gathers the updated parameters:
+
+    cfg  = ShardingConfig(stage=2)           # 1 = os, 2 = os_g (ZeRO-2)
+    step = TrainStep(model, criterion, opt, mesh=mesh, sharding=cfg)
+
+Optimizer-state HBM per replica drops by the dp degree; stage-2 lowers
+the grad sync itself to ONE ``reduce-scatter`` per coalesced bucket
+(the same dtype-bucketed flat-buffer layout as the DP-overlap
+``coalesce_tensor`` machinery in ``distributed/passes``), instead of a
+full-gradient all-reduce.  The sharded step is an explicit SPMD program
+(``shard_map``): each replica computes grads on its batch shard, so the
+criterion must be batch-separable with a mean (default) or sum
+reduction — the standard data-parallel contract.
 """
 from __future__ import annotations
 
@@ -25,15 +45,69 @@ from ..optimizer.optimizer import Optimizer
 from ..ops import random as _random
 
 
+class ShardingConfig:
+    """ZeRO-style sharded-weight-update config for :class:`TrainStep`.
+
+    stage: 1 (ZeRO-1 / 'os'): full-gradient all-reduce, optimizer state
+        + weight update sharded over the dp axis.  2 (ZeRO-2 / 'os_g'):
+        the grad sync itself becomes one reduce-scatter per coalesced
+        bucket — each replica only ever receives its 1/dp grad shard.
+    degree: number of update shards; -1 infers the mesh axis size (a
+        positive value must equal it — sub-axis sharding would need a
+        mesh reshape).
+    axis: mesh axis name to shard over ('dp' on the Engine mesh,
+        'sharding'/'data' on fleet HCG meshes).
+    bucket_mb: stage-2 coalesced reduce-scatter bucket size (same role
+        as the DP-overlap pass's ``bucket_size_mb``).
+    loss_reduction: how per-replica losses/grads combine ('mean' for
+        mean-reduced criteria — the common case — or 'sum').
+    """
+
+    def __init__(self, stage: int = 1, degree: int = -1, axis: str = "dp",
+                 bucket_mb: float = 25.0, loss_reduction: str = "mean"):
+        if int(stage) not in (1, 2):
+            raise ValueError(
+                f"ShardingConfig stage must be 1 (os) or 2 (os_g), got "
+                f"{stage!r}; stage 3 stores the params themselves sharded "
+                f"(GroupShardedStage3)")
+        if loss_reduction not in ("mean", "sum"):
+            raise ValueError(
+                f"loss_reduction must be 'mean' or 'sum', got "
+                f"{loss_reduction!r}")
+        self.stage = int(stage)
+        self.degree = int(degree)
+        self.axis = axis
+        self.bucket_mb = float(bucket_mb)
+        self.loss_reduction = loss_reduction
+
+    def __repr__(self):
+        return (f"ShardingConfig(stage={self.stage}, degree={self.degree}, "
+                f"axis={self.axis!r}, bucket_mb={self.bucket_mb}, "
+                f"loss_reduction={self.loss_reduction!r})")
+
+
+class _ParamShim:
+    """Duck-typed stand-in so ``optimizer._init_state`` can be traced
+    (it only reads ``p._value`` and ``p.name``)."""
+
+    def __init__(self, value, name):
+        self._value = value
+        self.name = name
+
+
 class TrainStep:
     """Compile model+criterion+optimizer into one donated-buffer XLA step."""
 
     def __init__(self, model: Layer, criterion: Callable,
-                 optimizer: Optimizer, clip_norm: Optional[float] = None):
+                 optimizer: Optimizer, clip_norm: Optional[float] = None,
+                 mesh=None, sharding: Optional[ShardingConfig] = None):
         self.model = model
         self.criterion = criterion
         self.optimizer = optimizer
         self.clip_norm = clip_norm
+        # bumped inside the traced body: one bump per (re)trace, so tests
+        # can assert the step compiles exactly once across training
+        self.compile_count = 0
 
         sd = model.state_dict()
         self._keys = list(sd.keys())
@@ -41,43 +115,209 @@ class TrainStep:
                            if isinstance(sd[k], Parameter)
                            and not sd[k].stop_gradient]
         self._frozen = [k for k in self._keys if k not in self._trainable]
-        # optimizer state pytree per trainable param
-        self._opt_states = {k: optimizer._ensure_state(sd[k])
-                            for k in self._trainable}
         self._step_fn = None
 
+        # a sharding pass / group_sharded_parallel may have marked the
+        # optimizer for the fused sharded path — pick it up so the eager
+        # wrapper and the compiled path agree.  An implicit marker must
+        # never make a previously-working construction crash: it degrades
+        # to the replicated step with a warning instead of raising.
+        implicit = False
+        if mesh is None and sharding is None:
+            marker = getattr(optimizer, "_sharded_update", None)
+            if marker is not None:
+                mesh, sharding = marker
+                implicit = True
+
+        self._sharded = False
+        if mesh is not None or sharding is not None:
+            try:
+                self._setup_sharded(mesh, sharding or ShardingConfig(), sd)
+            except (ValueError, NotImplementedError):
+                if not implicit:
+                    raise
+                import warnings
+                import sys as _sys
+                warnings.warn(
+                    f"ignoring the optimizer's _sharded_update marker "
+                    f"({_sys.exc_info()[1]}); building the replicated "
+                    f"TrainStep instead", stacklevel=2)
+                self._sharded = False
+
+        if not self._sharded:
+            # optimizer state pytree per trainable param (replicated path)
+            self._opt_states = {k: optimizer._ensure_state(sd[k])
+                                for k in self._trainable}
+
+    # -- sharded setup -------------------------------------------------------
+    def _setup_sharded(self, mesh, cfg: ShardingConfig, sd):
+        from ..distributed.process_mesh import as_jax_mesh
+        if mesh is None:
+            raise ValueError("ShardingConfig requires a mesh")
+        jmesh = as_jax_mesh(mesh)
+        axis = cfg.axis
+        if axis not in jmesh.axis_names:
+            axis = next((a for a in ("dp", "sharding", "data")
+                         if a in jmesh.axis_names
+                         and jmesh.shape[a] > 1), None)
+            if axis is None:
+                raise ValueError(
+                    f"no data-parallel axis on mesh {jmesh.axis_names} "
+                    f"(wanted {cfg.axis!r})")
+        deg = jmesh.shape[axis]
+        if cfg.degree not in (-1, deg):
+            raise ValueError(
+                f"sharding degree {cfg.degree} must equal the '{axis}' "
+                f"axis size {deg} (or -1 to infer)")
+        if deg <= 1:
+            return     # degenerate: plain replicated step
+        other = [a for a in jmesh.axis_names if a != axis
+                 and jmesh.shape[a] > 1]
+        if other:
+            raise NotImplementedError(
+                f"sharded weight update composes only with pure data "
+                f"parallelism for now; mesh has extra axes {other}")
+        if not getattr(self.optimizer, "shardable_update", True):
+            raise ValueError(
+                f"{type(self.optimizer).__name__}'s update rule is not "
+                f"elementwise (cross-element reductions would be computed "
+                f"per shard) — use the replicated TrainStep; its state is "
+                f"small anyway")
+        self._sharded = True
+        self._jmesh = jmesh
+        self._axis = axis
+        self._deg = deg
+        self._shard_cfg = cfg
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._repl = NamedSharding(jmesh, PartitionSpec())
+        self._row_sh = NamedSharding(jmesh, PartitionSpec(axis))
+
+        # which params can shard their update: dim0 divisible by the
+        # degree AND every array state leaf is param-shaped (elementwise
+        # state) — others update replicated on every rank
+        self._shardable: Dict[str, bool] = {}
+        self._state_shardings: Dict[str, Dict[str, Any]] = {}
+        for k in self._trainable:
+            p = sd[k]
+            shape = tuple(p._value.shape)
+            ok = len(shape) >= 1 and shape[0] % deg == 0
+            if ok:
+                abstract = jax.eval_shape(
+                    self._make_state_init(p, k),
+                    jax.ShapeDtypeStruct(shape, p._value.dtype))
+                for leaf in jax.tree_util.tree_leaves(abstract):
+                    if leaf.ndim >= 1 and tuple(leaf.shape) != shape:
+                        import warnings
+                        warnings.warn(
+                            f"param {k!r}: optimizer state leaf of shape "
+                            f"{leaf.shape} is not parameter-shaped; its "
+                            f"update stays replicated", stacklevel=3)
+                        ok = False
+                        break
+            self._shardable[k] = ok
+        self._opt_states = {}
+        for k in self._trainable:
+            self._refresh_state(k, sd[k])
+
+    def _make_state_init(self, p, k):
+        opt = self.optimizer
+        name = getattr(p, "name", k)
+        multi = bool(getattr(opt, "_multi_precision", False))
+
+        def init_fn(pv):
+            st = opt._init_state(_ParamShim(pv, name))
+            if multi and pv.dtype in (jnp.bfloat16, jnp.float16):
+                st["master"] = pv.astype(jnp.float32)
+            return st
+
+        return init_fn
+
+    def _leaf_sharding(self, k, p, leaf_shape):
+        if self._shardable[k] and len(leaf_shape) >= 1 \
+                and tuple(leaf_shape) == tuple(p._value.shape):
+            return self._row_sh
+        return self._repl
+
+    def _refresh_state(self, k, p):
+        """Bind ``self._opt_states[k]`` to the optimizer's live state dict
+        for ``p``, creating it ALREADY SHARDED (jitted init with
+        ``out_shardings`` — the replicated tensor never exists) or
+        re-placing leaves that lost their sharding (set_state_dict loads
+        full host arrays)."""
+        opt_state = self.optimizer._state
+        st = opt_state.get(id(p))
+        if st is not None and st is self._opt_states.get(k):
+            # fast path for the hot loop: the step updates this dict in
+            # place with already-sharded outputs, so nothing to re-place
+            # unless set_state_dict swapped the dict object out
+            return
+        if st is None:
+            init_fn = self._make_state_init(p, k)
+            abstract = jax.eval_shape(
+                init_fn, jax.ShapeDtypeStruct(p._value.shape,
+                                              p._value.dtype))
+            out_sh = jax.tree_util.tree_map(
+                lambda l: self._leaf_sharding(k, p, l.shape), abstract)
+            st = jax.jit(init_fn, out_shardings=out_sh)(p._value)
+            opt_state[id(p)] = st
+        shardings = {}
+        for name, v in st.items():
+            if not hasattr(v, "shape"):
+                continue
+            sh = self._leaf_sharding(k, p, v.shape)
+            shardings[name] = sh
+            if not (isinstance(v, jax.Array) and v.sharding == sh):
+                st[name] = jax.device_put(jnp.asarray(v), sh)
+        self._opt_states[k] = st
+        self._state_shardings[k] = shardings
+
+    def _place_replicated(self, sd):
+        """Params + frozen buffers replicated over the mesh before the
+        call, so jit never reshards a donated argument (donation aliases
+        from the very first step)."""
+        for k in self._trainable + self._frozen:
+            v = sd[k]._value
+            if not (isinstance(v, jax.Array) and v.sharding == self._repl):
+                sd[k]._value = jax.device_put(jnp.asarray(v), self._repl)
+
+    # -- traced loss (shared by both paths) ----------------------------------
+    def _make_loss_fn(self, frozen_vals, batch, key):
+        model, criterion, frozen = self.model, self.criterion, self._frozen
+
+        def loss_fn(p):
+            state = dict(p)
+            state.update(frozen_vals)
+            with model.bind_state(state):
+                with _random.trace_rng_scope(key):
+                    out = model(*[Tensor._from_value(b)
+                                  for b in batch[:-1]])
+                    loss = criterion(out,
+                                     Tensor._from_value(batch[-1]))
+                # collect traced buffer updates (BatchNorm running
+                # stats reassign their bound tracer in training
+                # mode — F.batch_norm's contract expects the fused
+                # step to persist them) BEFORE bind_state restores
+                # the originals.  Returned as aux: excluded from
+                # the grad but part of the compiled step's outputs.
+                new_bufs = {}
+                sd = model.state_dict()
+                for k in frozen:
+                    v = sd[k]._value
+                    if v is not state[k]:
+                        new_bufs[k] = v
+            return loss._value.astype(jnp.float32), new_bufs
+
+        return loss_fn
+
+    # -- replicated build -----------------------------------------------------
     def _build(self):
-        model = self.model
-        criterion = self.criterion
         opt = self.optimizer
         trainable = self._trainable
-        frozen = self._frozen
         clip_norm = self.clip_norm
 
         def step(params, frozen_vals, opt_states, lr, key, *batch):
-            def loss_fn(p):
-                state = dict(p)
-                state.update(frozen_vals)
-                with model.bind_state(state):
-                    with _random.trace_rng_scope(key):
-                        out = model(*[Tensor._from_value(b)
-                                      for b in batch[:-1]])
-                        loss = criterion(out,
-                                         Tensor._from_value(batch[-1]))
-                    # collect traced buffer updates (BatchNorm running
-                    # stats reassign their bound tracer in training
-                    # mode — F.batch_norm's contract expects the fused
-                    # step to persist them) BEFORE bind_state restores
-                    # the originals.  Returned as aux: excluded from
-                    # the grad but part of the compiled step's outputs.
-                    new_bufs = {}
-                    sd = model.state_dict()
-                    for k in frozen:
-                        v = sd[k]._value
-                        if v is not state[k]:
-                            new_bufs[k] = v
-                return loss._value.astype(jnp.float32), new_bufs
-
+            self.compile_count += 1
+            loss_fn = self._make_loss_fn(frozen_vals, batch, key)
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
 
@@ -102,34 +342,225 @@ class TrainStep:
         # donate params + opt states: in-place HBM update
         self._step_fn = jax.jit(step, donate_argnums=(0, 2))
 
-    def lower(self, *batch):
-        """AOT-lower the fused step with the current params/shardings
-        (used by DistModel.dist_main_program and the dist-attr
-        read-back)."""
-        if self._step_fn is None:
-            self._build()
+    # -- sharded build --------------------------------------------------------
+    def _grad_buckets(self):
+        """Stage-2 coalesce layout: shardable keys grouped by dtype, then
+        packed into buckets of <= bucket_mb — ONE reduce-scatter per
+        bucket over the flat (degree, cols) buffer (the coalesce_tensor
+        fused-buffer idea applied to the grad sync)."""
         sd = self.model.state_dict()
+        budget = int(self._shard_cfg.bucket_mb * 1024 * 1024)
+        groups: Dict[str, List[str]] = {}
+        nonshard = []
+        for k in self._trainable:
+            if self._shardable[k]:
+                groups.setdefault(str(sd[k]._value.dtype), []).append(k)
+            else:
+                nonshard.append(k)
+        buckets: List[List[str]] = []
+        for keys in groups.values():
+            cur, cur_bytes = [], 0
+            for k in keys:
+                v = sd[k]._value
+                nbytes = int(np.prod(v.shape)) * v.dtype.itemsize
+                if cur and cur_bytes + nbytes > budget:
+                    buckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(k)
+                cur_bytes += nbytes
+            if cur:
+                buckets.append(cur)
+        return buckets, nonshard
+
+    def _build_sharded(self, batch_vals):
+        from ..core.jax_compat import shard_map_compat
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        opt = self.optimizer
+        trainable, frozen = self._trainable, self._frozen
+        clip_norm = self.clip_norm
+        mesh, axis, deg = self._jmesh, self._axis, self._deg
+        cfg = self._shard_cfg
+        stage = cfg.stage
+        mean_combine = cfg.loss_reduction == "mean"
+        shardable = self._shardable
+        buckets, nonshard = self._grad_buckets()
+        sd0 = self.model.state_dict()
+        shapes = {k: tuple(sd0[k]._value.shape) for k in trainable}
+        rows = {k: shapes[k][0] // deg for k in trainable if shardable[k]}
+
+        def sync_grads(grads):
+            """All grads leave this function mean/sum-combined across
+            replicas; shardable keys leave SHARDED (this rank's rows)."""
+            out = {}
+            for bucket in buckets:
+                cols = [int(np.prod(shapes[k])) // deg for k in bucket]
+                mat = jnp.concatenate(
+                    [grads[k].reshape(deg, -1) for k in bucket], axis=1) \
+                    if len(bucket) > 1 else grads[bucket[0]].reshape(deg, -1)
+                if stage >= 2:
+                    # ZeRO-2: each rank only ever receives its grad shard
+                    row = jax.lax.psum_scatter(mat, axis,
+                                               scatter_dimension=0,
+                                               tiled=False)
+                else:
+                    # ZeRO-1: full-gradient all-reduce, local row slice
+                    full = jax.lax.psum(mat, axis)
+                    row = jnp.squeeze(jax.lax.dynamic_slice_in_dim(
+                        full, jax.lax.axis_index(axis), 1, 0), 0)
+                if mean_combine:
+                    row = row / deg
+                off = 0
+                for k, c in zip(bucket, cols):
+                    out[k] = row[off:off + c].reshape(
+                        (rows[k],) + shapes[k][1:])
+                    off += c
+            # non-shardable params: coalesced all-reduce, replicated update
+            by_dtype: Dict[str, List[str]] = {}
+            for k in nonshard:
+                by_dtype.setdefault(str(grads[k].dtype), []).append(k)
+            for keys in by_dtype.values():
+                flat = jnp.concatenate([grads[k].reshape(-1)
+                                        for k in keys]) \
+                    if len(keys) > 1 else grads[keys[0]].reshape(-1)
+                red = jax.lax.psum(flat, axis)
+                if mean_combine:
+                    red = red / deg
+                off = 0
+                for k in keys:
+                    n = int(np.prod(shapes[k])) if shapes[k] else 1
+                    out[k] = red[off:off + n].reshape(shapes[k])
+                    off += n
+            return out
+
+        def step(params, frozen_vals, opt_states, lr, key, *batch):
+            self.compile_count += 1
+            idx = jax.lax.axis_index(axis)
+            # distinct dropout stream per replica (true-DP semantics)
+            loss_fn = self._make_loss_fn(
+                frozen_vals, batch, jax.random.fold_in(key, idx))
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+
+            grads = sync_grads(grads)
+
+            if clip_norm is not None:
+                # global grad norm: sharded pieces psum'd, replicated
+                # pieces counted once per rank (identical on all ranks)
+                local = sum((jnp.sum(jnp.square(
+                    grads[k].astype(jnp.float32)))
+                    for k in trainable if shardable[k]),
+                    jnp.asarray(0.0, jnp.float32))
+                total = jax.lax.psum(local, axis) + sum(
+                    (jnp.sum(jnp.square(grads[k].astype(jnp.float32)))
+                     for k in trainable if not shardable[k]),
+                    jnp.asarray(0.0, jnp.float32))
+                gnorm = jnp.sqrt(total)
+                scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                grads = {k: (g * scale).astype(g.dtype)
+                         for k, g in grads.items()}
+
+            hyper = {"lr": lr}
+            new_params = {}
+            new_states = {}
+            for k in trainable:
+                if shardable[k]:
+                    # update THIS rank's 1/deg rows, then all-gather the
+                    # refreshed parameter (the weight-update-sharding
+                    # dataflow of arXiv:2004.13336)
+                    p_sh = jax.lax.dynamic_slice_in_dim(
+                        params[k], idx * rows[k], rows[k], 0)
+                    np_, nst = opt._update_rule(p_sh, grads[k],
+                                                opt_states[k], hyper)
+                    new_params[k] = jax.lax.all_gather(
+                        np_, axis, axis=0, tiled=True)
+                else:
+                    np_, nst = opt._update_rule(params[k], grads[k],
+                                                opt_states[k], hyper)
+                    new_params[k] = np_
+                new_states[k] = nst
+            # combine per-replica losses the same way the grads combine,
+            # so the reported loss matches the replicated step's
+            loss = jax.lax.pmean(loss, axis) if mean_combine \
+                else jax.lax.psum(loss, axis)
+            # running stats (BN) are averages in either mode
+            new_bufs = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axis), new_bufs)
+            return loss, new_params, new_states, new_bufs
+
+        P = PartitionSpec
+        repl_spec = P()
+        state_specs = {
+            k: {n: (P(axis) if sh is self._row_sh else P())
+                for n, sh in self._state_shardings[k].items()}
+            for k in trainable}
+        batch_specs = tuple(P(axis) if np.ndim(b) >= 1 else P()
+                            for b in batch_vals)
+        in_specs = (repl_spec, repl_spec, state_specs, repl_spec,
+                    repl_spec) + batch_specs
+        out_specs = (repl_spec, repl_spec, state_specs, repl_spec)
+        fn = shard_map_compat(step, mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+        def to_sh(spec_tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+        state_sh = to_sh(state_specs)
+        in_sh = (self._repl, self._repl, state_sh, self._repl,
+                 self._repl) + tuple(to_sh(s) for s in batch_specs)
+        out_sh = (self._repl, self._repl, state_sh, self._repl)
+        self._step_fn = jax.jit(fn, donate_argnums=(0, 2),
+                                in_shardings=in_sh, out_shardings=out_sh)
+
+    # -- common driver --------------------------------------------------------
+    def _ensure_built(self, batch_vals):
+        if self._step_fn is None:
+            if self._sharded:
+                self._build_sharded(batch_vals)
+            else:
+                self._build()
+
+    def _gather_inputs(self, batch):
+        sd = self.model.state_dict()
+        batch_vals = tuple(b._value if isinstance(b, Tensor)
+                           else jnp.asarray(b) for b in batch)
+        if self._sharded:
+            for b in batch_vals:
+                if np.ndim(b) >= 1 and b.shape[0] % self._deg:
+                    # fail with an actionable message instead of the
+                    # cryptic mid-jit divisibility error
+                    raise ValueError(
+                        f"sharded TrainStep: batch dim0={b.shape[0]} "
+                        f"is not divisible by the dp degree "
+                        f"{self._deg}; use drop_last=True (Engine.fit "
+                        f"does) or pad the tail batch")
+            self._place_replicated(sd)
+            for k in self._trainable:
+                self._refresh_state(k, sd[k])
         params = {k: sd[k]._value for k in self._trainable}
         frozen_vals = {k: sd[k]._value for k in self._frozen}
+        return sd, params, frozen_vals, batch_vals
+
+    def lower(self, *batch):
+        """AOT-lower the fused step with the current params/shardings
+        (used by DistModel.dist_main_program, the dist-attr read-back,
+        and verify_sharded_update's HLO assertions)."""
+        sd, params, frozen_vals, batch_vals = self._gather_inputs(batch)
+        self._ensure_built(batch_vals)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         # fixed dummy key: lowering must not perturb the training RNG
         # stream (the key value cannot affect the lowered HLO)
         key = jax.random.PRNGKey(0)
-        batch_vals = tuple(b._value if isinstance(b, Tensor)
-                           else jnp.asarray(b) for b in batch)
         return self._step_fn.lower(params, frozen_vals, self._opt_states,
                                    lr, key, *batch_vals)
 
     def __call__(self, *batch):
-        if self._step_fn is None:
-            self._build()
-        sd = self.model.state_dict()
-        params = {k: sd[k]._value for k in self._trainable}
-        frozen_vals = {k: sd[k]._value for k in self._frozen}
+        sd, params, frozen_vals, batch_vals = self._gather_inputs(batch)
+        self._ensure_built(batch_vals)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.next_key()
-        batch_vals = tuple(b._value if isinstance(b, Tensor)
-                           else jnp.asarray(b) for b in batch)
         loss, new_params, new_states, new_bufs = self._step_fn(
             params, frozen_vals, self._opt_states, lr, key, *batch_vals)
         for k, v in new_params.items():

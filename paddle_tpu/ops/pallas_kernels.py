@@ -35,6 +35,7 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 from ..core.dispatch import apply_op
+from ..core.jax_compat import axis_size as _axis_size, shard_map_compat
 from ..core.tensor import Tensor
 from ._helpers import targ
 
@@ -1131,7 +1132,7 @@ def _ring_flash_impl(qh, k0, v0, axis_name, causal):
     """Forward ring: per-rotation flash blocks combined by running
     logsumexp (same online-softmax algebra as inside the kernel, one
     level up)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     B, H, S, D = qh.shape
@@ -1171,7 +1172,7 @@ def _ring_flash_bwd(axis_name, causal, res, g):
     the correct global softmax probability); dk/dv accumulators travel
     around the ring with their k/v shard and arrive home after n hops."""
     qh, k0, v0, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     B, H, S, D = qh.shape
@@ -1236,7 +1237,7 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
                           axis_name, is_causal)
         return jnp.swapaxes(out, 1, 2)
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -1244,9 +1245,11 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
     scale = 1.0 / math.sqrt(q.shape[-1])
     B, H, S, D = qh.shape
 
-    # carries are device-varying under shard_map vma checking
+    # carries are device-varying under shard_map vma checking (jax 0.4.x
+    # has no varying-type tracking — identity there)
     def vary(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pcast(x, (axis_name,), to="varying") \
+            if hasattr(jax.lax, "pcast") else x
 
     m = vary(jnp.full((B, H, S, 1), -jnp.inf, jnp.float32))
     l = vary(jnp.zeros((B, H, S, 1), jnp.float32))
@@ -1318,12 +1321,10 @@ def sdpa_ring(query, key, value, mesh, axis_name: str = "sep",
         # check_vma off: pallas_call outputs carry no vma annotation,
         # which the checker (correctly) refuses to guess.  All axes
         # manual (required with the checker off).
-        ring = jax.shard_map(
+        ring = shard_map_compat(
             lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name,
                                               is_causal),
-            mesh=jmesh, axis_names=set(jmesh.axis_names),
-            in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            jmesh, in_specs=(spec, spec, spec), out_specs=spec)
         return ring(q, k, v)
 
     return apply_op("ring_attention", fn,
@@ -1342,7 +1343,7 @@ def ulysses_attention(q, k, v, axis_name: str, is_causal=False):
     sequence sharding.  Two all-to-alls ride ICI; compute is exactly the
     dense/flash kernel, so Ulysses wins over ring when heads ≥ ranks and
     the per-rank full sequence fits.  Inputs [B, S_local, H, D]."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     B, S, H, D = q.shape
     if H % n:
         raise ValueError(f"ulysses needs heads ({H}) divisible by the "
@@ -1404,12 +1405,10 @@ def sdpa_ulysses(query, key, value, mesh, axis_name: str = "sep",
 
     def fn(q, k, v):
         spec = _spec_for(q.shape)
-        uly = jax.shard_map(
+        uly = shard_map_compat(
             lambda q_, k_, v_: ulysses_attention(q_, k_, v_, axis_name,
                                                  is_causal),
-            mesh=jmesh, axis_names=set(jmesh.axis_names),
-            in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            jmesh, in_specs=(spec, spec, spec), out_specs=spec)
         return uly(q, k, v)
 
     return apply_op("ulysses_attention", fn,

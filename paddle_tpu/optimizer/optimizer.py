@@ -24,6 +24,12 @@ from .lr import LRScheduler
 class Optimizer:
     """Base optimizer (parity: paddle.optimizer.Optimizer)."""
 
+    # _update_rule is elementwise over (param, grad, state): the ZeRO
+    # sharded TrainStep may apply it to each replica's 1/dp param shard.
+    # Optimizers with cross-element reductions (trust ratios, factored
+    # stats) override this to False and stay replicated.
+    shardable_update = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
         if parameters is None:
@@ -141,8 +147,19 @@ class Optimizer:
             st = self._state.get(id(p))
             if st:
                 for k, v in st.items():
-                    out[f"{p.name}_{k}"] = Tensor._from_value(v)
+                    out[f"{p.name}_{k}"] = Tensor._from_value(
+                        self._unshard_state_value(v))
         return out
+
+    @staticmethod
+    def _unshard_state_value(v):
+        """Checkpoints stay portable: a ZeRO-sharded state array is
+        gathered to its full (unsharded) value on save, so the same
+        state_dict loads into an unsharded optimizer or a different
+        sharding degree."""
+        if isinstance(v, jax.Array) and len(v.devices()) > 1:
+            return jnp.asarray(np.asarray(v))
+        return v
 
     def set_state_dict(self, state_dict):
         self._global_step = int(state_dict.get("global_step", 0))
@@ -435,6 +452,10 @@ class Adamax(Optimizer):
 class Lamb(Optimizer):
     """Parity: paddle.optimizer.Lamb / DistributedFusedLamb capability."""
 
+    # trust ratio needs the FULL param/update norms — a per-shard norm
+    # would silently change the math, so Lamb stays replicated
+    shardable_update = False
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
@@ -517,6 +538,10 @@ class Adafactor(Optimizer):
     is the memory win).  Update is RMS-clipped (``clip_threshold``) and,
     with ``scale_parameter``, scaled by max(eps2, RMS(param)).
     """
+
+    # factored row/col stats + RMS clipping reduce over the FULL param;
+    # the state is O(rows+cols) anyway, so ZeRO sharding buys nothing
+    shardable_update = False
 
     def __init__(self, learning_rate=1e-3, beta1=None, epsilon1=1e-30,
                  epsilon2=1e-3, clip_threshold=1.0, decay_rate=0.8,

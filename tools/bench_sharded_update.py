@@ -1,0 +1,209 @@
+"""ZeRO-1/2 sharded weight update benchmark (BENCH_SHARD_r07.json).
+
+On a forced 8-device CPU mesh (dp=8), measure per-replica optimizer-state
+bytes and step latency for the fused train step in three configurations:
+
+- replicated: plain TrainStep, batch sharded over dp (GSPMD data
+  parallelism), optimizer state replicated on every replica — the
+  baseline the ZeRO paper (arXiv:2004.13336) starts from.
+- stage1 ('os'):  full-gradient all-reduce, optimizer state + weight
+  update sharded 1/dp per replica, updated params all-gathered.
+- stage2 ('os_g'): grads reduce-scattered per coalesced bucket instead
+  of all-reduced; everything else as stage 1.
+
+Every number is parity-gated: the three loss trajectories must agree to
+<= 1e-5 over >= 10 steps (same seeds, same batches), each step function
+must have compiled exactly once across all steps, and the stage-2
+compiled HLO must contain a reduce-scatter (verify_sharded_update).
+Writes BENCH_SHARD_r07.json next to the repo root.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# before ANY jax import: the forced host-device count only applies when
+# the CPU client initializes under these env vars
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_DEV = 8
+STEPS = 12
+TIMED = 8
+TOL = 1e-5
+
+
+def _force_cpu_mesh():
+    from __graft_entry__ import _force_cpu_mesh as force
+    force(N_DEV)
+
+
+def _make_model_and_step(stage):
+    """stage None -> replicated baseline; 1/2 -> sharded."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (llama_tiny_config, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.train_step import TrainStep, ShardingConfig
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=4,
+                            intermediate_size=176, vocab_size=512)
+    model = LlamaForCausalLM(cfg)
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    mesh = ProcessMesh(shape=[N_DEV, 1], dim_names=["dp", "mp"])
+    if stage is None:
+        step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                         clip_norm=1.0)
+    else:
+        step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                         clip_norm=1.0, mesh=mesh,
+                         sharding=ShardingConfig(stage=stage))
+    return model, opt, step, mesh, cfg
+
+
+def _batches(cfg, n=4, batch=16, seq=32, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        out.append((ids, ids.astype(np.int64)))
+    return out, batch, seq
+
+
+def _shard_batch(vals, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(mesh.jax_mesh, PartitionSpec("dp"))
+    return tuple(jax.device_put(jnp.asarray(v), sh) for v in vals)
+
+
+def _state_bytes_per_replica(step):
+    """Sum of each optimizer-state leaf's PER-DEVICE bytes (sharded
+    leaves count their shard, replicated leaves their full size)."""
+    total = 0
+    for st in step._opt_states.values():
+        for v in st.values():
+            if not hasattr(v, "nbytes"):
+                continue
+            if hasattr(v, "sharding"):
+                shard = v.sharding.shard_shape(v.shape)
+                total += int(np.prod(shard)) * v.dtype.itemsize \
+                    if shard else v.dtype.itemsize
+            else:
+                total += int(v.nbytes)
+    return total
+
+
+def _run(stage, label):
+    import paddle_tpu as paddle
+    model, opt, step, mesh, cfg = _make_model_and_step(stage)
+    batches, batch, seq = _batches(cfg)
+    dev_batches = [_shard_batch(b, mesh) for b in batches]
+
+    losses = []
+    paddle.seed(1234)       # identical RNG stream for every config
+    for i in range(STEPS):
+        ids, labels = dev_batches[i % len(dev_batches)]
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        losses.append(float(np.asarray(loss._value)))
+
+    # latency: steps are already warm; host fetch is the barrier
+    t0 = time.perf_counter()
+    last = None
+    for i in range(TIMED):
+        ids, labels = dev_batches[i % len(dev_batches)]
+        last = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+    float(np.asarray(last._value))
+    dt = (time.perf_counter() - t0) / TIMED
+
+    sbytes = _state_bytes_per_replica(step)
+    res = {
+        "label": label,
+        "opt_state_bytes_per_replica": sbytes,
+        "step_ms": round(dt * 1000, 3),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "loss": [round(v, 8) for v in losses],
+        "compile_count": step.compile_count,
+    }
+    return res, step, dev_batches, mesh
+
+
+def main():
+    _force_cpu_mesh()
+    import jax
+    assert jax.device_count() >= N_DEV
+
+    out = {"n_devices": N_DEV, "dp": N_DEV, "steps": STEPS,
+           "model": "llama_tiny(h=64,L=2,V=512)", "optimizer": "AdamW",
+           "batch": 16, "seq": 32}
+
+    rep, _, _, _ = _run(None, "replicated")
+    s1, _, _, _ = _run(1, "stage1")
+    s2, step2, dev_batches, _ = _run(2, "stage2")
+
+    # parity gate (same seeds, same batches)
+    diff1 = max(abs(a - b) for a, b in zip(rep["loss"], s1["loss"]))
+    diff2 = max(abs(a - b) for a, b in zip(rep["loss"], s2["loss"]))
+    compile_ok = (rep["compile_count"] == 1 and s1["compile_count"] == 1
+                  and s2["compile_count"] == 1)
+
+    # HLO gate (re-traces, so AFTER the compile_count snapshot above)
+    from paddle_tpu.distributed.auto_parallel import verify_sharded_update
+    import paddle_tpu as paddle
+    ids, labels = dev_batches[0]
+    hlo = verify_sharded_update(step2, paddle.to_tensor(ids),
+                                paddle.to_tensor(labels))
+
+    passed = diff1 <= TOL and diff2 <= TOL and compile_ok
+    out.update({
+        "replicated": rep, "stage1": s1, "stage2": s2,
+        "state_bytes_ratio_stage1": round(
+            s1["opt_state_bytes_per_replica"]
+            / rep["opt_state_bytes_per_replica"], 4),
+        "state_bytes_ratio_stage2": round(
+            s2["opt_state_bytes_per_replica"]
+            / rep["opt_state_bytes_per_replica"], 4),
+        "parity": {"max_loss_diff_stage1": diff1,
+                   "max_loss_diff_stage2": diff2, "tol": TOL},
+        "compile_once": compile_ok,
+        "stage2_hlo_has_reduce_scatter": "reduce-scatter" in hlo,
+        "passed": bool(passed),
+    })
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_SHARD_r07.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "metric": "zero_sharded_update_state_bytes_ratio_dp8",
+        "value": out["state_bytes_ratio_stage2"],
+        "unit": "sharded/replicated",
+        "vs_baseline": round(1.0 / max(out["state_bytes_ratio_stage2"],
+                                       1e-9), 2),
+    }), flush=True)
+    print(f"# replicated={rep['opt_state_bytes_per_replica']}B/replica "
+          f"stage1={s1['opt_state_bytes_per_replica']}B "
+          f"stage2={s2['opt_state_bytes_per_replica']}B "
+          f"step_ms rep/s1/s2={rep['step_ms']}/{s1['step_ms']}/"
+          f"{s2['step_ms']} parity={max(diff1, diff2):.2e} "
+          f"passed={passed}", file=sys.stderr)
+    if not passed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
