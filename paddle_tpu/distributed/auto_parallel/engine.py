@@ -30,6 +30,25 @@ from .strategy import Strategy
 __all__ = ["Engine"]
 
 
+def _jsonable(obj):
+    """Sanitize a small state dict for the checkpoint manifest (numpy
+    scalars -> python; anything exotic -> repr, better than a failed
+    manifest write)."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (bool, int, float, str)) or obj is None:
+        return obj
+    if isinstance(obj, np.ndarray) and obj.ndim == 0:
+        return obj.item()
+    return repr(obj)
+
+
 class Engine:
     """Parity: auto_parallel static Engine (engine.py:59)."""
 
@@ -107,42 +126,212 @@ class Engine:
     # -- loops ----------------------------------------------------------------
     def fit(self, train_data, train_sample_split=None, batch_size=1,
             epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
-            collate_fn=None, verbose=0):
+            collate_fn=None, verbose=0, checkpoint_dir=None,
+            save_interval=None, keep_last_k=3, async_save=True,
+            resume=True):
+        """Train; optionally fault-tolerantly.
+
+        With ``checkpoint_dir`` set, fit() becomes resumable: every
+        ``save_interval`` global steps the full train state (params,
+        possibly-sharded optimizer state, LR scheduler, RNG key,
+        dataloader position, step counter) is snapshotted at the step
+        boundary and committed atomically by a background writer
+        (:class:`~paddle_tpu.distributed.checkpoint.CheckpointManager`).
+        On entry (``resume=True``) the newest VALID checkpoint under the
+        directory — partial/corrupt ones are skipped — is restored,
+        including resharding ZeRO state onto the current mesh, and
+        training continues bit-compatibly mid-epoch.  SIGTERM (the TPU
+        preemption notice) triggers one final synchronous checkpoint,
+        then exits with the elastic launcher's restart code so the
+        supervisor relaunches without burning its failure budget.
+        """
         from ...io import DataLoader
         loader = (train_data if isinstance(train_data, DataLoader)
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=False, drop_last=True,
                                   collate_fn=collate_fn))
         step = self._build_step()
-        history = {"loss": []}
+
+        mgr = None
         it = 0
-        for epoch in range(epochs):
-            epoch_steps = 0
-            batch_it = iter(loader)
-            # one-batch lookahead: the host->device transfer (device_put
-            # dispatch) for batch k+1 is issued while step k executes on
-            # device — the loss fetch (the sync point) comes only after
-            # the next transfer is in flight
-            arrays = self._next_device_batch(batch_it)
-            while arrays is not None:
-                if getattr(self, "_sample_arrays", None) is None:
-                    self._sample_arrays = arrays
-                loss = step(*arrays)                     # async dispatch
-                epoch_steps += 1
-                last = bool(steps_per_epoch
-                            and epoch_steps >= steps_per_epoch)
-                # overlap h2d with the running step — but never pull a
-                # batch past the epoch cap (a shared/streaming iterator
-                # would silently lose it)
-                arrays = None if last \
-                    else self._next_device_batch(batch_it)
-                history["loss"].append(float(np.asarray(loss)))
-                it += 1
-                if verbose and it % log_freq == 0:
-                    print(f"[AutoParallel Engine] epoch {epoch} step "
-                          f"{it}: loss {history['loss'][-1]:.5f}")
+        start_epoch = 0
+        resume_batches = 0
+        if checkpoint_dir is not None:
+            from ..checkpoint import CheckpointManager
+            mgr = CheckpointManager(checkpoint_dir,
+                                    keep_last_k=keep_last_k,
+                                    async_save=async_save)
+            if resume:
+                state = mgr.load()
+                if state is not None:
+                    it, start_epoch, resume_batches = \
+                        self._restore_train_state(step, state)
+                    if steps_per_epoch \
+                            and resume_batches >= steps_per_epoch:
+                        # the checkpoint landed exactly on a capped
+                        # epoch boundary: the uninterrupted run moved to
+                        # the NEXT epoch's batch 0, not this epoch's
+                        # batch steps_per_epoch
+                        start_epoch += 1
+                        resume_batches = 0
+                    if verbose:
+                        print(f"[AutoParallel Engine] resumed from "
+                              f"checkpoint step {it} (epoch "
+                              f"{start_epoch}, batch {resume_batches})")
+
+        self._preempted = False
+        old_handler = self._install_sigterm(mgr)
+        history = {"loss": []}
+        try:
+            for epoch in range(start_epoch, epochs):
+                epoch_steps = 0
+                if mgr is not None and epoch == start_epoch \
+                        and resume_batches and hasattr(loader,
+                                                       "set_state_dict"):
+                    # mid-epoch resume: fast-forward the loader to the
+                    # first batch no completed step has consumed
+                    loader.set_state_dict(
+                        {"batches_yielded": resume_batches})
+                    epoch_steps = resume_batches
+                batch_it = iter(loader)
+                # one-batch lookahead: the host->device transfer
+                # (device_put dispatch) for batch k+1 is issued while
+                # step k executes on device — the loss fetch (the sync
+                # point) comes only after the next transfer is in flight
+                arrays = self._next_device_batch(batch_it)
+                while arrays is not None:
+                    if getattr(self, "_sample_arrays", None) is None:
+                        self._sample_arrays = arrays
+                    loss = step(*arrays)                 # async dispatch
+                    epoch_steps += 1
+                    last = bool(steps_per_epoch
+                                and epoch_steps >= steps_per_epoch)
+                    # overlap h2d with the running step — but never pull
+                    # a batch past the epoch cap (a shared/streaming
+                    # iterator would silently lose it)
+                    arrays = None if last \
+                        else self._next_device_batch(batch_it)
+                    history["loss"].append(float(np.asarray(loss)))
+                    it += 1
+                    if verbose and it % log_freq == 0:
+                        print(f"[AutoParallel Engine] epoch {epoch} "
+                              f"step {it}: "
+                              f"loss {history['loss'][-1]:.5f}")
+                    if mgr is not None and self._preempted:
+                        # preemption notice: ONE synchronous checkpoint
+                        # at this step boundary, then ask the elastic
+                        # launcher for a relaunch.  The final save is
+                        # best-effort — a stale async-write error or a
+                        # failing disk must not swallow the restart
+                        # code (an older valid checkpoint still exists)
+                        from ...distributed.fleet.elastic import \
+                            ELASTIC_RESTART_CODE
+                        try:
+                            self._save_checkpoint(mgr, step, it, epoch,
+                                                  epoch_steps, sync=True)
+                        except BaseException:          # noqa: BLE001
+                            import traceback
+                            traceback.print_exc()
+                        raise SystemExit(ELASTIC_RESTART_CODE)
+                    if mgr is not None and save_interval \
+                            and it % int(save_interval) == 0:
+                        self._save_checkpoint(mgr, step, it, epoch,
+                                              epoch_steps)
+        finally:
+            self._restore_sigterm(old_handler)
+            if mgr is not None:
+                mgr.wait()       # surface any background-write failure
         self._history = history
         return history
+
+    # -- fault tolerance ------------------------------------------------------
+    def _install_sigterm(self, mgr):
+        if mgr is None:
+            return None
+        import signal as _signal
+        import threading as _threading
+        if _threading.current_thread() is not _threading.main_thread():
+            return None
+
+        def _on_term(signum, frame):
+            self._preempted = True
+
+        try:
+            return _signal.signal(_signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return None
+
+    def _restore_sigterm(self, old_handler):
+        if old_handler is None:
+            return
+        import signal as _signal
+        try:
+            _signal.signal(_signal.SIGTERM, old_handler)
+        except (ValueError, OSError):
+            pass
+
+    def _train_state_values(self, step):
+        """Flat {key: live array} of everything a resume needs — params
+        + frozen buffers, the (possibly ZeRO-sharded) optimizer state,
+        and the RNG key."""
+        from ...ops import random as _random
+        sd = self._model.state_dict()
+        values = {f"model.{k}": t._value for k, t in sd.items()}
+        values.update(step.opt_state_arrays())
+        values["rng_state"] = _random.get_rng_state()[0]._value
+        return values
+
+    def _save_checkpoint(self, mgr, step, it, epoch, epoch_steps,
+                         sync=False):
+        meta = {"global_step": int(it), "epoch": int(epoch),
+                "epoch_batches": int(epoch_steps),
+                "optimizer_global_step":
+                    int(self._optimizer._global_step),
+                "dp_degree": int(self.mesh.get_dim_size("dp"))}
+        lr = self._optimizer._learning_rate
+        if hasattr(lr, "state_dict"):
+            meta["lr_scheduler"] = _jsonable(lr.state_dict())
+        mgr.save(it, self._train_state_values(step), meta, sync=sync)
+
+    def _restore_train_state(self, step, state):
+        """Load a TrainState back into the live model/optimizer —
+        reassembling saved shards and resharding onto THIS run's mesh
+        (which may have a different dp degree than the save)."""
+        import jax as _jax
+        import jax.numpy as _jnp
+        from ...ops import random as _random
+        sd = self._model.state_dict()
+        for k, t in sd.items():
+            key = f"model.{k}"
+            if key not in state.arrays:
+                continue
+            full = _jnp.asarray(state.global_value(key))
+            cur = t._value
+            if isinstance(cur, _jax.Array) and \
+                    not isinstance(cur, _jax.core.Tracer) and \
+                    len(cur.devices()) > 1:
+                # distributed target: reshard onto its live placement.
+                # Single-device targets stay UNCOMMITTED so jit remains
+                # free to (re)place them with the batch's mesh.
+                full = _jax.device_put(full.astype(cur.dtype),
+                                       cur.sharding)
+            t._value = full.astype(cur.dtype)
+        step.load_opt_state_arrays(
+            {k: state.global_value(k) for k in state.arrays
+             if k.startswith("opt.")})
+        if "rng_state" in state.arrays:
+            from ...core.tensor import Tensor
+            _random.set_rng_state(
+                [Tensor(state.global_value("rng_state"))])
+        meta = state.meta
+        self._optimizer._global_step = int(
+            meta.get("optimizer_global_step", meta.get("global_step", 0)))
+        lr = self._optimizer._learning_rate
+        if hasattr(lr, "set_state_dict") and "lr_scheduler" in meta:
+            lr.set_state_dict(meta["lr_scheduler"])
+        return (int(meta.get("global_step", 0)),
+                int(meta.get("epoch", 0)),
+                int(meta.get("epoch_batches", 0)))
 
     def _next_device_batch(self, batch_it):
         """Fetch + shard the next batch onto the mesh; None at the end."""

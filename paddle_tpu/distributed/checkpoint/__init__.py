@@ -1,3 +1,4 @@
 from .save_state_dict import save_state_dict
 from .load_state_dict import load_state_dict
 from .metadata import Metadata, LocalTensorMetadata, LocalTensorIndex
+from .manager import CheckpointManager, TrainState, assemble

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import Dict
 
 import numpy as np
@@ -19,7 +20,9 @@ import numpy as np
 import jax
 
 from ...core.tensor import Tensor
-from ...framework_io import save as _save
+from ...framework_io import _atomic_pickle
+from ...testing.faults import fault_point
+from ..comm_watchdog import comm_task
 from .metadata import Metadata, LocalTensorMetadata, LocalTensorIndex
 
 
@@ -46,28 +49,74 @@ def _shard_info(value) -> list:
 def save_state_dict(state_dict: Dict[str, Tensor], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save=False):
-    """Parity: paddle.distributed.checkpoint.save_state_dict."""
+    """Parity: paddle.distributed.checkpoint.save_state_dict.
+
+    Both files are written atomically (temp + ``os.replace``), with the
+    ``.metadata`` index committed LAST — a load only ever sees a
+    checkpoint whose data file already landed, so a crash mid-save can
+    never present a truncated pickle as a checkpoint.
+
+    ``async_save=True`` (previously accepted and silently ignored) now
+    snapshots the shards to host on the calling thread and performs the
+    pickling/fsync/rename on a background thread; the returned handle's
+    ``.join()`` blocks until the commit.
+    """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = Metadata()
     shards_payload = {}
 
-    for key, t in state_dict.items():
-        val = t._value if isinstance(t, Tensor) else t
-        infos = _shard_info(val)
-        metas = []
-        for offset, shape, arr in infos:
-            dtype_name = "bfloat16" if arr.dtype == jax.numpy.bfloat16 \
-                else arr.dtype.name
-            metas.append(LocalTensorMetadata(offset, shape, dtype_name))
-            fname = f"{rank}_0.distcp"
-            meta.storage_metadata[LocalTensorIndex(key, offset)] = fname
-            store = arr.view(np.uint16) if dtype_name == "bfloat16" else arr
-            shards_payload[(key, offset)] = (store, dtype_name)
-        meta.state_dict_metadata[key] = metas
+    with comm_task("save_state_dict.gather"):
+        # cross-host shard gather / device->host copies: watchdogged so
+        # a rank stuck in a collective yields a stack diagnostic
+        for key, t in state_dict.items():
+            val = t._value if isinstance(t, Tensor) else t
+            infos = _shard_info(val)
+            metas = []
+            for offset, shape, arr in infos:
+                dtype_name = "bfloat16" if arr.dtype == jax.numpy.bfloat16 \
+                    else arr.dtype.name
+                metas.append(LocalTensorMetadata(offset, shape, dtype_name))
+                fname = f"{rank}_0.distcp"
+                meta.storage_metadata[LocalTensorIndex(key, offset)] = fname
+                store = arr.view(np.uint16) if dtype_name == "bfloat16" \
+                    else arr
+                shards_payload[(key, offset)] = (store, dtype_name)
+            meta.state_dict_metadata[key] = metas
 
-    with open(os.path.join(path, f"{rank}_0.distcp"), "wb") as f:
-        pickle.dump(shards_payload, f, protocol=4)
-    if rank == coordinator_rank:
-        with open(os.path.join(path, f"{rank}.metadata"), "wb") as f:
-            pickle.dump(meta, f, protocol=4)
+    def _commit():
+        fault_point("ckpt.write")
+        _atomic_pickle(shards_payload,
+                       os.path.join(path, f"{rank}_0.distcp"))
+        if rank == coordinator_rank:
+            fault_point("ckpt.manifest")
+            _atomic_pickle(meta, os.path.join(path, f"{rank}.metadata"))
+
+    if async_save:
+        t = _AsyncSaveHandle(_commit)
+        t.start()
+        return t
+    _commit()
+    return None
+
+
+class _AsyncSaveHandle(threading.Thread):
+    """Background save whose failure surfaces on ``join()`` — a caller
+    must never believe a checkpoint landed when the write died."""
+
+    def __init__(self, fn):
+        super().__init__(name="save-state-dict", daemon=True)
+        self._fn = fn
+        self.error = None
+
+    def run(self):
+        try:
+            self._fn()
+        except BaseException as e:                    # noqa: BLE001
+            self.error = e
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if not self.is_alive() and self.error is not None:
+            err, self.error = self.error, None
+            raise err

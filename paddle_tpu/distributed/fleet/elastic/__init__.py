@@ -21,9 +21,16 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["ElasticStatus", "KVStore", "FileKVStore", "TCPKVStore",
-           "make_kv_store", "ElasticManager", "ELASTIC_TIMEOUT"]
+           "make_kv_store", "ElasticManager", "ELASTIC_TIMEOUT",
+           "ELASTIC_RESTART_CODE"]
 
 ELASTIC_TIMEOUT = 30
+
+# Worker exit code meaning "I checkpointed and want to be relaunched"
+# (TPU preemption notice / SIGTERM path): the launcher relaunches
+# WITHOUT consuming the --max_restarts failure budget, mirroring the
+# reference's elastic restart vs. fault restart distinction.
+ELASTIC_RESTART_CODE = 67
 
 
 class ElasticStatus:

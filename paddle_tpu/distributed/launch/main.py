@@ -107,6 +107,13 @@ def launch(argv=None):
                 logf.close()
         if ret == 0:
             return 0
+        from ..fleet.elastic import ELASTIC_RESTART_CODE
+        if ret == ELASTIC_RESTART_CODE:
+            # the worker checkpointed on SIGTERM (preemption notice) and
+            # asked to be relaunched: a planned restart, not a failure —
+            # it never consumes the restart budget
+            time.sleep(1)
+            continue
         # fault tolerance: relaunch up to max_restarts (elastic parity:
         # reference ElasticManager restart path, manager.py:126)
         restarts += 1
@@ -180,9 +187,12 @@ def _launch_elastic(args, env, cmd):
                     logf.close()
             if ret == 0:
                 return 0
-            if isinstance(ret, int):
+            from ..fleet.elastic import ELASTIC_RESTART_CODE
+            if isinstance(ret, int) and ret != ELASTIC_RESTART_CODE:
                 # a real worker failure consumes the restart budget;
-                # scale-driven relaunches (ret == "RESTART") do not
+                # scale-driven relaunches (ret == "RESTART") and
+                # checkpoint-then-restart exits (preemption SIGTERM
+                # path) do not
                 failures += 1
                 if failures > args.max_restarts:
                     return ret

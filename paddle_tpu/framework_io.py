@@ -76,13 +76,56 @@ def _unpack(obj, return_numpy=False):
     return obj
 
 
+def _atomic_pickle(payload: Any, path: str, protocol: int = 4,
+                   max_tries: int = 3, backoff_s: float = 0.05):
+    """Pickle ``payload`` to ``path`` via temp file + ``os.replace`` —
+    a crash or injected failure at any instant leaves either the old
+    file or the new one, never a truncated pickle.  Transient I/O errors
+    retry with exponential backoff (flaky network filesystems under
+    checkpoint pressure are the norm, not the exception)."""
+    from .testing.faults import fault_point
+    tmp = f"{path}.tmp.{os.getpid()}"
+    last = None
+    for attempt in range(max_tries):
+        try:
+            fault_point("io.save")
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f, protocol=protocol)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return
+        except OSError as e:
+            last = e
+            if attempt < max_tries - 1:
+                import time
+                time.sleep(backoff_s * (2 ** attempt))
+        except BaseException:
+            # non-I/O failure (unpicklable object, interrupt): no point
+            # retrying, but never leave the temp file behind
+            _remove_quiet(tmp)
+            raise
+    _remove_quiet(tmp)
+    raise last
+
+
+def _remove_quiet(path):
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
 def save(obj: Any, path: str, protocol: int = 4, **configs):
-    """paddle.save parity (reference python/paddle/framework/io.py:721)."""
+    """paddle.save parity (reference python/paddle/framework/io.py:721).
+
+    Crash-safe: written through :func:`_atomic_pickle`, so an
+    interrupted save can never leave a truncated ``.pdparams`` where a
+    good one (or nothing) used to be."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    _atomic_pickle(_pack(obj), path, protocol=protocol)
 
 
 def load(path: str, return_numpy: bool = False, **configs) -> Any:

@@ -44,6 +44,9 @@ class GenerationRequest:
     eos_token_id: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
     state: str = "waiting"                 # waiting -> running -> done
+    # True when the engine ran out of KV pages mid-decode and finished
+    # this request early instead of wedging the whole batch
+    truncated: bool = False
 
     # slot bookkeeping (set while running)
     slot: int = -1
@@ -69,9 +72,18 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_batch_size: int = 8,
                  num_blocks: int = 256, block_size: int = 16,
                  max_seq_len: Optional[int] = None,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 lazy_alloc: bool = False):
         from ..jit.serving_step import DecodeStep
         self.model = model
+        # lazy_alloc: pages are allocated as a sequence actually grows
+        # instead of reserving the full prompt+budget footprint at
+        # admission — higher occupancy for the same pool, at the cost
+        # that the pool CAN run dry mid-decode.  When it does, the
+        # victim request is finished early with ``truncated=True``
+        # (robustness contract: step() never raises out of a full
+        # batch; the other slots keep decoding).
+        self.lazy_alloc = bool(lazy_alloc)
         cfg = model.config
         self.cfg = cfg
         self.max_batch_size = max_batch_size
@@ -117,11 +129,15 @@ class ContinuousBatchingEngine:
                 "request needs %d pages but the engine's block-table "
                 "width is %d (max_seq_len=%d); raise max_seq_len"
                 % (need, self.bt_width, self.max_seq_len))
-        if need > self.caches[0].num_blocks:
+        min_need = need if not self.lazy_alloc else \
+            self.caches[0].blocks_needed(len(req.prompt_ids) + 1)
+        if min_need > self.caches[0].num_blocks:
             # would never admit: _admit waits for pages that can't exist
+            # (lazy mode only needs the prompt to fit — the tail may be
+            # truncated if the pool runs dry)
             raise ValueError(
                 "request needs %d pages but the pool only has %d; "
-                "raise num_blocks" % (need, self.caches[0].num_blocks))
+                "raise num_blocks" % (min_need, self.caches[0].num_blocks))
         self._next_id += 1
         self.waiting.append(req)
         return req.req_id
@@ -152,7 +168,9 @@ class ContinuousBatchingEngine:
                 continue
             req = self.waiting[0]
             L = len(req.prompt_ids)
-            need = self.caches[0].blocks_needed(L + req.max_new_tokens)
+            need = (self.caches[0].blocks_needed(L + 1) if self.lazy_alloc
+                    else self.caches[0].blocks_needed(
+                        L + req.max_new_tokens))
             if len(self.caches[0]._free) < need:
                 break                       # no room yet: keep waiting
             self.waiting.pop(0)
@@ -170,10 +188,13 @@ class ContinuousBatchingEngine:
         with no_grad():
             logits, kv = self.model.forward(
                 ids, caches=[(None, None)] * self.cfg.num_hidden_layers)
-        # allocate pages covering prompt + generation budget up front.
-        # Pools share the free-list of cache 0 so one table serves every
-        # layer.
-        n_blocks = self.caches[0].blocks_needed(L + req.max_new_tokens)
+        # allocate pages covering prompt + generation budget up front
+        # (lazy mode: prompt + the first decode position only; the rest
+        # are grown page-by-page in _decode_batch).  Pools share the
+        # free-list of cache 0 so one table serves every layer.
+        n_blocks = (self.caches[0].blocks_needed(L + 1) if self.lazy_alloc
+                    else self.caches[0].blocks_needed(
+                        L + req.max_new_tokens))
         req.block_ids = [self.caches[0].allocate_block()
                          for _ in range(n_blocks)]
         row = np.full((1, self.bt_width), self._sink, np.int32)
@@ -194,13 +215,39 @@ class ContinuousBatchingEngine:
             self._bt[slot] = row[0]
 
     # ---- batched decode -------------------------------------------------
+    def _grow_pages(self) -> List[int]:
+        """Lazy mode: before the fused step runs, every running slot
+        must own a real page for the position it writes this step
+        (seq_len).  A slot that needs a page the pool cannot supply is
+        the VICTIM: it is finished early with ``truncated=True`` — its
+        pages return to the pool (often unblocking the others) and the
+        batch keeps decoding.  step() never raises for pool exhaustion."""
+        truncated = []
+        for i, r in enumerate(list(self.slots)):
+            if r is None:
+                continue
+            need = self.caches[0].blocks_needed(r.seq_len + 1)
+            grew = True
+            while len(r.block_ids) < need:
+                if not self.caches[0]._free:
+                    grew = False
+                    break
+                blk = self.caches[0].allocate_block()
+                self._bt[i, len(r.block_ids)] = blk
+                r.block_ids.append(blk)
+            if not grew:
+                r.truncated = True
+                self._finish(r)
+                truncated.append(r.req_id)
+        return truncated
+
     def _decode_batch(self) -> List[int]:
+        done = self._grow_pages() if self.lazy_alloc else []
         if all(r is None for r in self.slots):
-            return []
+            return done
         # ONE fused XLA call at the fixed slot count; masked slots ride
         # along (their writes hit the sink page, their token is ignored)
         nxt = self.decode_step(self._tokens, self._seq_lens, self._bt)
-        done = []
         for i, r in enumerate(list(self.slots)):
             if r is None:
                 continue

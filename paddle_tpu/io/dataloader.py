@@ -74,6 +74,10 @@ class DataLoader:
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
+        # resumable-iterator state (fault-tolerant training): position
+        # within the CURRENT epoch, and a pending fast-forward request
+        self._batches_yielded = 0
+        self._resume_skip = 0
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -95,17 +99,63 @@ class DataLoader:
     def __call__(self):
         return self.__iter__()
 
+    # -- resumable-iterator state (parity: the reference's resumable
+    # dataloader position in its distributed checkpoint layer) -----------
+    def state_dict(self):
+        """Position of the live iterator within its epoch — checkpoint
+        this and feed it back through :meth:`set_state_dict` to resume
+        mid-epoch.  (A consumer that prefetches ahead of its compute —
+        like Engine.fit's one-batch lookahead — should instead record
+        its own completed-step count and pass that to set_state_dict.)"""
+        return {"batches_yielded": int(self._batches_yielded)}
+
+    def set_state_dict(self, state):
+        """Arm the NEXT ``iter()`` to fast-forward ``batches_yielded``
+        batches.  Map-style single-process loading skips by advancing
+        the sampler only (no sample is decoded); prefetch/worker paths
+        decode-and-discard.  Deterministic resume additionally needs a
+        deterministic sampler order (shuffle=False, or a seeded
+        sampler)."""
+        self._resume_skip = max(0, int(state.get("batches_yielded", 0)))
+
     def __iter__(self):
+        skip, self._resume_skip = self._resume_skip, 0
+        # position set EAGERLY: state_dict() between iter() and the
+        # first next() must already report the fast-forwarded position,
+        # not 0 (a preemption landing there would otherwise rewind the
+        # whole epoch prefix on the following resume)
+        self._batches_yielded = skip
         if self.num_workers > 0:
             from .shm_ring import native_available
             if self.use_shared_memory and native_available():
-                return self._iter_multiprocess()
+                return self._count(self._discard(
+                    self._iter_multiprocess(), skip))
             if self._iterable:
-                return self._iter_iterable()
-            return self._iter_prefetch()
+                return self._count(self._discard(
+                    self._iter_iterable(), skip))
+            return self._count(self._discard(
+                self._iter_prefetch(), skip))
         if self._iterable:
-            return self._iter_iterable()
-        return self._iter_single()
+            return self._count(self._discard(
+                self._iter_iterable(), skip))
+        return self._count(self._iter_single(skip))
+
+    def _count(self, gen):
+        for item in gen:
+            # count BEFORE handing the batch out: a consumer that
+            # checkpoints state_dict() after training on batch k must
+            # see position k+1, not k (or resume would replay a batch)
+            self._batches_yielded += 1
+            yield item
+
+    @staticmethod
+    def _discard(gen, skip):
+        """Lazily drop the first ``skip`` batches (the generic resume
+        path for worker-backed iterators, where batch k's bytes only
+        exist by producing batches 0..k-1)."""
+        for i, item in enumerate(gen):
+            if i >= skip:
+                yield item
 
     # -- multi-process workers over native shm rings --------------------------
     def _iter_multiprocess(self):
@@ -188,8 +238,12 @@ class DataLoader:
                 r.unlink()
 
     # -- single process ------------------------------------------------------
-    def _iter_single(self):
-        for batch_idx in self.batch_sampler:
+    def _iter_single(self, skip=0):
+        # resume fast-forward: advance the sampler WITHOUT touching the
+        # dataset — skipping 10k batches costs index arithmetic, not I/O
+        for n, batch_idx in enumerate(self.batch_sampler):
+            if n < skip:
+                continue
             samples = [self.dataset[i] for i in batch_idx]
             yield self.collate_fn(samples)
 

@@ -514,6 +514,51 @@ class TrainStep:
         self._step_fn = jax.jit(fn, donate_argnums=(0, 2),
                                 in_shardings=in_sh, out_shardings=out_sh)
 
+    # -- checkpoint plumbing --------------------------------------------------
+    # The CheckpointManager snapshots these LIVE (possibly ZeRO-sharded)
+    # state arrays shard-wise at a step boundary; restore reshards them
+    # onto whatever mesh/dp degree the resumed run is using.
+    def opt_state_arrays(self) -> Dict[str, Any]:
+        """Flat ``{"opt.<param>.<leaf>": array}`` of the live optimizer
+        state — sharded leaves stay sharded (the manager saves each
+        replica's shard with its global offset)."""
+        out = {}
+        for k in self._trainable:
+            for name, v in self._opt_states[k].items():
+                if hasattr(v, "shape"):
+                    out[f"opt.{k}.{name}"] = v
+        return out
+
+    def load_opt_state_arrays(self, flat: Dict[str, Any]):
+        """Restore state saved by :meth:`opt_state_arrays` — possibly
+        under a DIFFERENT dp degree: each full (reassembled) array is
+        ``device_put`` with THIS step's current sharding, which is the
+        whole reshard path (array redistribution, arXiv:2112.01075).
+        Unknown keys are ignored; missing keys keep their fresh init."""
+        for k in self._trainable:
+            st = self._opt_states[k]
+            for name, cur in list(st.items()):
+                full = flat.get(f"opt.{k}.{name}")
+                if full is None or not hasattr(cur, "shape"):
+                    continue
+                val = jnp.asarray(np.asarray(full)).astype(cur.dtype)
+                if tuple(val.shape) != tuple(cur.shape):
+                    raise ValueError(
+                        f"checkpointed state {k}.{name} has shape "
+                        f"{val.shape}, current run expects {cur.shape}")
+                if self._sharded:
+                    sh = self._state_shardings[k].get(name)
+                    if sh is not None:
+                        val = jax.device_put(val, sh)
+                # in-place: optimizer._state holds the same dict object
+                st[name] = val
+
+    @property
+    def global_step(self) -> int:
+        """Steps applied through this TrainStep (the optimizer's counter
+        — restored by the checkpoint layer on resume)."""
+        return int(self.optimizer._global_step)
+
     # -- common driver --------------------------------------------------------
     def _ensure_built(self, batch_vals):
         if self._step_fn is None:

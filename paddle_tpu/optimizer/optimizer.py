@@ -139,7 +139,12 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
-    def state_dict(self):
+    def state_dict(self, gather: bool = True):
+        """``gather=False`` keeps ZeRO-sharded state arrays as their live
+        sharded ``jax.Array`` s (shard-wise checkpointing: the
+        CheckpointManager saves each replica's shard with its offset and
+        reshards at load); the default gathers to full host values for a
+        portable pickle."""
         out = {"global_step": self._global_step}
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
@@ -148,7 +153,7 @@ class Optimizer:
             if st:
                 for k, v in st.items():
                     out[f"{p.name}_{k}"] = Tensor._from_value(
-                        self._unshard_state_value(v))
+                        self._unshard_state_value(v) if gather else v)
         return out
 
     @staticmethod
@@ -156,9 +161,15 @@ class Optimizer:
         """Checkpoints stay portable: a ZeRO-sharded state array is
         gathered to its full (unsharded) value on save, so the same
         state_dict loads into an unsharded optimizer or a different
-        sharding degree."""
+        sharding degree.  The cross-replica gather runs under the comm
+        watchdog: a rank hung in the collective produces the watchdog's
+        stack diagnostic instead of a silent checkpoint-time freeze."""
         if isinstance(v, jax.Array) and len(v.devices()) > 1:
-            return jnp.asarray(np.asarray(v))
+            from ..distributed.comm_watchdog import comm_task
+            from ..testing.faults import fault_point
+            with comm_task("optimizer.state_gather"):
+                fault_point("opt.state_gather")
+                return jnp.asarray(np.asarray(v))
         return v
 
     def set_state_dict(self, state_dict):

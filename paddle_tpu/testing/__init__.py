@@ -1,0 +1,13 @@
+"""Testing utilities: fault injection for robustness tests.
+
+Parity: the reference exercises its fault-tolerance paths with chaos
+tests under test/collective/fleet (kill-one-rank elastic relaunch) and
+the checkpoint layer's corruption unit tests; here the injection points
+are first-class so any test can script a failure scenario through
+``PADDLE_TPU_FAULT_SPEC``.
+"""
+from .faults import (FaultRule, FaultInjector, FaultError, fault_point,
+                     configure, active_spec, reset)
+
+__all__ = ["FaultRule", "FaultInjector", "FaultError", "fault_point",
+           "configure", "active_spec", "reset"]

@@ -1,0 +1,70 @@
+"""Subprocess trainer for the fault-tolerance tests.
+
+Runs a small deterministic Engine.fit with per-step checkpointing; the
+parent test injects faults via PADDLE_TPU_FAULT_SPEC (kill -9 mid-save)
+or signals (SIGTERM preemption) and then verifies the checkpoint
+directory + resume parity.
+
+Usage:
+    python ckpt_victim.py CKPT_DIR LOSS_OUT EPOCHS [SLEEP_MS]
+
+CKPT_DIR of "-" disables checkpointing (the uninterrupted baseline).
+Losses are appended to LOSS_OUT as one JSON list (written atomically on
+normal completion only — a killed run leaves no loss file, by design).
+SLEEP_MS slows each sample fetch so the parent can land a signal
+mid-run.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    loss_out = sys.argv[2]
+    epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    sleep_ms = float(sys.argv[4]) if len(sys.argv) > 4 else 0.0
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    W = rng.randn(8, 2).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+
+    class DS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            if sleep_ms:
+                time.sleep(sleep_ms / 1000.0)
+            return X[i], Y[i]
+
+        def __len__(self):
+            return len(X)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    eng = Engine(net, nn.MSELoss(), opt)
+    kwargs = {}
+    if ckpt_dir != "-":
+        kwargs = {"checkpoint_dir": ckpt_dir, "save_interval": 1,
+                  "keep_last_k": 3}
+    hist = eng.fit(DS(), batch_size=16, epochs=epochs, **kwargs)
+
+    tmp = loss_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(hist["loss"], f)
+    os.replace(tmp, loss_out)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,3 +14,10 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight E2E (subprocess fault drills etc.) excluded "
+        "from the tier-1 'not slow' run")

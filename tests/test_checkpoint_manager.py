@@ -1,0 +1,432 @@
+"""Fault-tolerant training: async atomic checkpoints + auto-resume.
+
+The acceptance contract of the robustness PR:
+
+- a kill -9 (REAL subprocess) at any instant during an async save
+  leaves the checkpoint directory containing only complete, loadable
+  checkpoints (commit = one ``os.replace`` of the tmp dir after the
+  CRC manifest landed);
+- ``Engine.fit`` auto-resume from the survivor reproduces the
+  uninterrupted run's loss trajectory to <= 1e-5;
+- ZeRO-sharded optimizer state saved shard-wise under dp=4 loads —
+  resharded — under dp=2 and dp=1, tensor-exact;
+- SIGTERM (preemption notice) takes a final synchronous checkpoint and
+  exits with the elastic launcher's restart code.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                               TrainState, assemble)
+from paddle_tpu.testing import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+VICTIM = os.path.join(HERE, "ckpt_victim.py")
+# the victim runs single-device (fast cold start): strip the 8-device
+# forcing this test process inherited from conftest
+_SUB_ENV = {k: v for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "PADDLE_TPU_FAULT_SPEC")}
+_SUB_ENV["JAX_PLATFORMS"] = "cpu"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# manager unit behavior
+# ---------------------------------------------------------------------------
+def test_roundtrip_async_and_keep_last_k(tmp_path):
+    import jax.numpy as jnp
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": jnp.full((3, 4), float(s)),
+                     "k": np.arange(4, dtype=np.uint32)},
+                 {"global_step": s})
+    mgr.wait()
+    steps = [s for s, _ in mgr.all_valid()]
+    assert steps == [2, 3]                   # GC kept the newest 2
+    st = mgr.load()
+    assert st.meta["global_step"] == 3
+    assert np.all(st.global_value("w") == 3.0)
+    assert st.global_value("k").dtype == np.uint32
+    # explicit step load
+    assert np.all(mgr.load(2).global_value("w") == 2.0)
+    with pytest.raises(FileNotFoundError):
+        mgr.load(1)                          # GC'd
+
+
+def test_scan_skips_partial_and_corrupt(tmp_path):
+    import jax.numpy as jnp
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=10)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": jnp.full((2,), float(s))}, sync=True)
+    # corrupt the newest payload (bit flip after commit)
+    p3 = os.path.join(str(tmp_path), "step_3", "shards_0.distcp")
+    blob = bytearray(open(p3, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(p3, "wb").write(bytes(blob))
+    # a partial save: tmp dir that never committed (fake dead pid)
+    os.makedirs(os.path.join(str(tmp_path), ".tmp.9.999999"))
+    # a final dir with NO manifest (crashed between mkdir and commit is
+    # impossible by construction, but a hand-rolled dir must not load)
+    os.makedirs(os.path.join(str(tmp_path), "step_9"))
+    fresh = CheckpointManager(str(tmp_path), keep_last_k=10)
+    assert [s for s, _ in fresh.all_valid()] == [1, 2]
+    assert fresh.load().meta.get("wall_time") is not None
+    assert fresh.latest_valid()[0] == 2      # CRC mismatch skipped
+    # stale tmp cleaned by the fresh manager
+    assert not any(n.startswith(".tmp.") for n in os.listdir(tmp_path))
+
+
+def test_async_write_failure_surfaces_on_wait(tmp_path):
+    import jax.numpy as jnp
+    mgr = CheckpointManager(str(tmp_path))
+    faults.configure("ioerror:ckpt.write")
+    mgr.save(1, {"w": jnp.zeros((2,))})
+    with pytest.raises(faults.FaultError):
+        mgr.wait()
+    faults.reset()
+    mgr.save(2, {"w": jnp.zeros((2,))})      # manager still usable
+    mgr.wait()
+    assert [s for s, _ in mgr.all_valid()] == [2]
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-async-save (real subprocess) + auto-resume parity
+# ---------------------------------------------------------------------------
+def _run_victim(ckpt_dir, loss_out, epochs=2, sleep_ms=0, spec=None,
+                check=True):
+    env = dict(_SUB_ENV)
+    if spec:
+        env["PADDLE_TPU_FAULT_SPEC"] = spec
+    proc = subprocess.run(
+        [sys.executable, VICTIM, ckpt_dir, loss_out, str(epochs),
+         str(sleep_ms)],
+        env=env, capture_output=True, text=True, timeout=240)
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"victim rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    return proc
+
+
+@pytest.fixture(scope="module")
+def baseline_losses(tmp_path_factory):
+    """One uninterrupted 8-step run (no checkpointing)."""
+    out = str(tmp_path_factory.mktemp("base") / "losses.json")
+    _run_victim("-", out)
+    losses = json.load(open(out))
+    assert len(losses) == 8
+    return losses
+
+
+# one representative kill point stays in tier-1 (the acceptance proof);
+# the other two write stages ride in the slow lane — same test body,
+# run with `pytest -m slow tests/test_checkpoint_manager.py`
+@pytest.mark.parametrize("spec", [
+    "kill:ckpt.write:after=3",      # mid payload write of the 2nd save
+    pytest.param("kill:ckpt.manifest:after=2",   # 2nd manifest unlanded
+                 marks=pytest.mark.slow),
+    pytest.param("kill:ckpt.commit:after=1",     # tmp written, no rename
+                 marks=pytest.mark.slow),
+])
+def test_kill9_leaves_only_complete_checkpoints_and_resume_matches(
+        tmp_path, baseline_losses, spec):
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "losses.json")
+    proc = _run_victim(ckpt, out, spec=spec, check=False)
+    assert proc.returncode == -signal.SIGKILL, \
+        f"victim survived its kill spec: rc={proc.returncode}"
+    assert not os.path.exists(out)           # died mid-run, by design
+
+    # EVERY final directory must be complete + loadable; partials may
+    # only exist as .tmp.* orphans
+    step_dirs = [n for n in os.listdir(ckpt) if n.startswith("step_")]
+    scan = CheckpointManager(ckpt, keep_last_k=0)
+    valid = scan.all_valid()
+    assert len(valid) == len(step_dirs)
+    for s, _ in valid:
+        st = scan.load(s)
+        assert isinstance(st, TrainState)
+        assert st.global_value("model.0.weight").shape == (8, 32)
+
+    survivor = valid[-1][0] if valid else 0
+    # auto-resume from the survivor: losses for steps survivor+1..8
+    # must match the uninterrupted trajectory
+    _run_victim(ckpt, out)
+    resumed = json.load(open(out))
+    assert len(resumed) == 8 - survivor
+    diff = max(abs(a - b) for a, b in
+               zip(baseline_losses[survivor:], resumed))
+    assert diff <= 1e-5, (survivor, diff)
+
+
+@pytest.mark.slow
+def test_sigterm_takes_final_checkpoint_and_exits_restart_code(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_RESTART_CODE
+    ckpt = str(tmp_path / "ckpt")
+    out = str(tmp_path / "losses.json")
+    env = dict(_SUB_ENV)
+    proc = subprocess.Popen(
+        [sys.executable, VICTIM, ckpt, out, "3", "25"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if os.path.isdir(ckpt) and any(
+                    n.startswith("step_") for n in os.listdir(ckpt)):
+                break
+            if proc.poll() is not None:
+                raise AssertionError("victim finished before signal")
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == ELASTIC_RESTART_CODE
+    scan = CheckpointManager(ckpt, keep_last_k=0)
+    found = scan.latest_valid()
+    assert found is not None                 # the preemption checkpoint
+    # and the job is resumable from it to the correct total step count
+    _run_victim(ckpt, out, epochs=3)
+    resumed = json.load(open(out))
+    assert len(resumed) == 12 - found[0]
+
+
+def test_preemption_in_process_checkpoints_and_requests_restart(tmp_path):
+    """The SIGTERM path without subprocess cost: a signal landing
+    mid-fit must produce ONE final synchronous checkpoint and a
+    SystemExit carrying the elastic restart code."""
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_RESTART_CODE
+    d = str(tmp_path / "ckpt")
+    ds = _RegDS()
+    calls = [0]
+
+    class TermDS(paddle.io.Dataset):
+        def __getitem__(self, i):
+            calls[0] += 1
+            if calls[0] == 20:          # during the 2nd batch fetch
+                os.kill(os.getpid(), signal.SIGTERM)
+            return ds[i]
+
+        def __len__(self):
+            return len(ds)
+
+    with pytest.raises(SystemExit) as ei:
+        _engine().fit(TermDS(), batch_size=16, epochs=2,
+                      checkpoint_dir=d, save_interval=10 ** 6)
+    assert ei.value.code == ELASTIC_RESTART_CODE
+    found = CheckpointManager(d).latest_valid()
+    assert found is not None and found[0] >= 1
+    # and SIGTERM behaves normally again after fit restored the handler
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler)
+
+
+# ---------------------------------------------------------------------------
+# in-process Engine resume parity (fast path; subprocess covered above)
+# ---------------------------------------------------------------------------
+rng = np.random.RandomState(0)
+
+
+class _RegDS(paddle.io.Dataset):
+    def __init__(self, n=64):
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8, 2).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _engine():
+    from paddle_tpu.distributed.auto_parallel import Engine
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    return Engine(net, nn.MSELoss(), opt)
+
+
+def test_dataloader_resume_state_roundtrip():
+    """state_dict after consuming k batches says position k; a fresh
+    loader fed that state resumes at batch k exactly (sampler-level
+    fast-forward, no replay and no skip-ahead)."""
+    from paddle_tpu.io import DataLoader
+    ds = _RegDS(n=32)
+    ref = [np.asarray(b[0]._value)
+           for b in DataLoader(ds, batch_size=8, drop_last=True)]
+    dl = DataLoader(ds, batch_size=8, drop_last=True)
+    it = iter(dl)
+    next(it), next(it)
+    assert dl.state_dict() == {"batches_yielded": 2}
+    dl2 = DataLoader(ds, batch_size=8, drop_last=True)
+    dl2.set_state_dict(dl.state_dict())
+    it2 = iter(dl2)
+    # position is visible IMMEDIATELY after iter(), before any next():
+    # a preemption landing here must not record position 0
+    assert dl2.state_dict() == {"batches_yielded": 2}
+    resumed = [np.asarray(b[0]._value) for b in it2]
+    assert len(resumed) == len(ref) - 2
+    for a, b in zip(resumed, ref[2:]):
+        assert np.array_equal(a, b)
+    assert dl2.state_dict() == {"batches_yielded": 4}
+
+
+def test_engine_mid_epoch_resume_bit_compat(tmp_path):
+    """Resume lands MID-epoch (save_interval=3, 4 steps/epoch): the
+    dataloader fast-forward + RNG/LR/optimizer restore must reproduce
+    the uninterrupted trajectory exactly."""
+    ds = _RegDS()
+    full = _engine().fit(ds, batch_size=16, epochs=2)["loss"]
+
+    d = str(tmp_path / "ckpt")
+    h1 = _engine().fit(ds, batch_size=16, epochs=1, checkpoint_dir=d,
+                       save_interval=3)["loss"]
+    # last save was at global step 3 == mid-epoch 0; a fresh engine
+    # must resume from there, not from the epoch boundary
+    h2 = _engine().fit(ds, batch_size=16, epochs=2, checkpoint_dir=d,
+                       save_interval=3)["loss"]
+    assert len(h2) == len(full) - 3
+    stitched = full[:3] + h2
+    assert max(abs(a - b) for a, b in zip(full, stitched)) <= 1e-5
+    # h1 ran the whole first epoch; its tail must also agree
+    assert max(abs(a - b) for a, b in zip(full[:4], h1)) <= 1e-5
+
+
+def test_engine_resume_restores_lr_scheduler_and_rng(tmp_path):
+    """Scheduler position and the RNG stream survive the round-trip
+    (meta + rng_state array in the checkpoint)."""
+    from paddle_tpu.distributed.auto_parallel import Engine
+    ds = _RegDS()
+
+    def make():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                            nn.Linear(32, 2))
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.01,
+                                              step_size=1, gamma=0.5)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameters=net.parameters())
+        return Engine(net, nn.MSELoss(), opt), sched
+
+    d = str(tmp_path / "ckpt")
+    e1, sched1 = make()
+    e1.fit(ds, batch_size=16, epochs=1, checkpoint_dir=d,
+           save_interval=2)
+    sched1.step()
+    e1.fit(ds, batch_size=16, epochs=1, checkpoint_dir=d,
+           save_interval=2, resume=False)
+    del e1
+
+    e2, sched2 = make()
+    state = CheckpointManager(d).load()
+    assert "lr_scheduler" in state.meta
+    assert "rng_state" in state.arrays
+    e2.fit(ds, batch_size=16, epochs=1, checkpoint_dir=d,
+           save_interval=10 ** 6)
+    # the restored scheduler carries the stepped position
+    assert sched2.last_epoch == sched1.last_epoch
+    assert abs(float(sched2()) - float(sched1())) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# checkpoint resharding: dp=4 ZeRO-2 save -> dp=2 / dp=1 load
+# ---------------------------------------------------------------------------
+def _mk_sharded(dp):
+    from paddle_tpu.jit.train_step import TrainStep, ShardingConfig
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    crit = nn.MSELoss()
+    if dp == 1:
+        return net, opt, TrainStep(net, crit, opt)
+    mesh = ProcessMesh(shape=[dp, 1], dim_names=["dp", "mp"])
+    return net, opt, TrainStep(net, crit, opt, mesh=mesh,
+                               sharding=ShardingConfig(stage=2))
+
+
+def _reshard_batches(n=6):
+    r = np.random.RandomState(7)
+    w = r.randn(8, 2).astype(np.float32)
+    out = []
+    for _ in range(n):
+        x = r.randn(16, 8).astype(np.float32)
+        out.append((x, (x @ w).astype(np.float32)))
+    return out
+
+
+def _ckpt_values(net, step):
+    vals = {f"model.{k}": t._value for k, t in net.state_dict().items()}
+    vals.update(step.opt_state_arrays())
+    return vals
+
+
+def _restore(net, step, state, opt, global_step):
+    import jax.numpy as jnp
+    for k, t in net.state_dict().items():
+        t._value = jnp.asarray(state.global_value(f"model.{k}")).astype(
+            t._value.dtype)
+    step.load_opt_state_arrays(
+        {k: state.global_value(k) for k in state.arrays
+         if k.startswith("opt.")})
+    opt._global_step = global_step
+
+
+@pytest.mark.parametrize("dp_load", [2, 1])
+def test_reshard_zero2_dp4_save_to_smaller_dp(tmp_path, dp_load):
+    batches = _reshard_batches()
+
+    # uninterrupted dp=4 ZeRO-2 reference
+    net, opt, step = _mk_sharded(4)
+    ref = [float(np.asarray(step(x, y)._value)) for x, y in batches]
+
+    # save at step 3 under dp=4 — state leaves are LIVE sharded arrays
+    net, opt, step = _mk_sharded(4)
+    head = [float(np.asarray(step(x, y)._value)) for x, y in batches[:3]]
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    live = _ckpt_values(net, step)
+    mgr.save(3, live, {"global_step": 3}, sync=True)
+    state = mgr.load()
+
+    # the sharded moments were saved SHARD-WISE: 4 shards with offsets
+    key = next(k for k in state.arrays
+               if k.startswith("opt.") and k.endswith(".moment1")
+               and len(state.arrays[k]) > 1)
+    assert len(state.arrays[key]) == 4
+    offsets = sorted(off[0] for off, _, _, _ in state.arrays[key])
+    assert offsets == [i * (offsets[1] - offsets[0]) for i in range(4)]
+    # tensor-exact round-trip vs the gathered live value
+    for k, v in live.items():
+        assert np.array_equal(state.global_value(k), np.asarray(v)), k
+
+    # load under a SMALLER dp degree: reassemble + device_put with the
+    # new mesh's shardings (the reshard path), then keep training
+    net2, opt2, step2 = _mk_sharded(dp_load)
+    _restore(net2, step2, state, opt2, 3)
+    tail = [float(np.asarray(step2(x, y)._value)) for x, y in batches[3:]]
+    diff = max(abs(a - b) for a, b in zip(ref[3:], tail))
+    assert diff <= 1e-5, (dp_load, diff)
+    # and the restored state really is sharded on the new mesh
+    if dp_load > 1:
+        v = step2._opt_states[[k for k in step2._trainable
+                               if step2._shardable[k]][0]]["moment1"]
+        assert len(v.sharding.device_set) == dp_load

@@ -1,0 +1,130 @@
+"""Fault-injection harness + the robustness paths it exercises.
+
+The harness itself (spec parsing, arming, modes) plus the satellite
+contracts: atomic ``framework_io.save`` with retry/backoff, and the comm
+watchdog catching a hung checkpoint-time collective gather.
+"""
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing import faults
+from paddle_tpu.testing.faults import (FaultError, FaultRule,
+                                       FaultInjector, fault_point)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- spec parsing / arming ---------------------------------------------------
+def test_rule_parse_and_defaults():
+    r = FaultRule.parse("ioerror:ckpt.write:after=3:times=2")
+    assert (r.mode, r.site, r.after, r.times) == \
+        ("ioerror", "ckpt.write", 3, 2)
+    assert FaultRule.parse("kill:io.*").times == 1     # kill fires once
+    assert FaultRule.parse("delay:x:ms=250").ms == 250.0
+    assert FaultRule.parse("hang:x").ms == 3.6e6       # default: forever
+    assert FaultRule.parse("hang:x:ms=100").ms == 100.0  # explicit wins
+    with pytest.raises(ValueError):
+        FaultRule.parse("explode:everything")
+    with pytest.raises(ValueError):
+        FaultRule.parse("ioerror")                     # no site
+    with pytest.raises(ValueError):
+        FaultRule.parse("ioerror:x:frequency=2")       # unknown key
+
+
+def test_after_and_times_counting():
+    inj = FaultInjector("ioerror:site.a:after=2:times=1")
+    inj.hit("site.a")                    # 1st hit: below 'after'
+    with pytest.raises(FaultError):
+        inj.hit("site.a")                # 2nd: armed, fires
+    inj.hit("site.a")                    # 3rd: 'times' exhausted
+    assert inj.log == ["ioerror:site.a"]
+
+
+def test_glob_matching_and_inert_by_default():
+    inj = FaultInjector("ioerror:ckpt.*")
+    inj.hit("io.save")                   # no match: silent
+    with pytest.raises(FaultError):
+        inj.hit("ckpt.commit")
+    # no spec installed anywhere: fault_point is a no-op
+    fault_point("ckpt.commit")
+
+
+def test_env_spec_picked_up(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "ioerror:env.site")
+    faults.reset()
+    with pytest.raises(FaultError):
+        fault_point("env.site")
+
+
+# -- satellite: atomic framework_io.save with retry/backoff ------------------
+def test_save_is_atomic_under_injected_crash(tmp_path):
+    """An interrupted save leaves the OLD file bit-intact — never a
+    truncated pickle (temp + os.replace)."""
+    path = str(tmp_path / "model.pdparams")
+    paddle.save({"w": paddle.to_tensor(np.ones(4, np.float32))}, path)
+    good = open(path, "rb").read()
+    faults.configure("ioerror:io.save")      # every attempt fails
+    with pytest.raises(OSError):
+        paddle.save({"w": paddle.to_tensor(
+            np.zeros(4, np.float32))}, path)
+    assert open(path, "rb").read() == good
+    # and no temp litter
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+
+def test_save_retries_transient_io_errors(tmp_path):
+    """times=2 makes the first two attempts fail; backoff + retry makes
+    the third succeed."""
+    path = str(tmp_path / "model.pdparams")
+    faults.configure("ioerror:io.save:times=2")
+    paddle.save({"w": paddle.to_tensor(np.full(3, 7.0, np.float32))},
+                path)
+    got = paddle.load(path)
+    assert np.allclose(got["w"].numpy(), 7.0)
+    assert faults.active_spec().log.count("ioerror:io.save") == 2
+
+
+# -- satellite: watchdogged checkpoint gather --------------------------------
+def test_comm_watchdog_catches_hung_checkpoint_gather(capsys):
+    """A delayed collective during the optimizer-state gather must trip
+    the comm watchdog's diagnostic instead of hanging silently."""
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.comm_watchdog import get_comm_task_manager
+
+    mgr = get_comm_task_manager()
+    before = len(mgr.timed_out_tasks)
+    aborted = []
+    old_abort = mgr.abort_handler
+    mgr.abort_handler = aborted.append
+    set_flags({"FLAGS_comm_task_timeout_s": 0.08})
+    faults.configure("delay:opt.state_gather:ms=400")
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec, Mesh
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs).reshape(len(devs)), ("dp",))
+        sharded = jax.device_put(
+            jnp.arange(float(8 * len(devs))).reshape(len(devs) * 8 // 8,
+                                                     8),
+            NamedSharding(mesh, PartitionSpec("dp")))
+        out = paddle.optimizer.Optimizer._unshard_state_value(sharded)
+        assert np.asarray(out).shape == sharded.shape
+    finally:
+        set_flags({"FLAGS_comm_task_timeout_s": 0.0})
+        mgr.abort_handler = old_abort
+    assert len(mgr.timed_out_tasks) > before
+    assert any(t.name == "optimizer.state_gather"
+               for t in mgr.timed_out_tasks[before:])
+    err = capsys.readouterr().err
+    assert "exceeded its timeout" in err and "stack" in err

@@ -452,3 +452,51 @@ def test_continuous_batching_eos_stops_early():
     rid = eng.add_request(p, max_new_tokens=8, eos_token_id=eos)
     eng.run_to_completion()
     assert eng.result(rid) == ref_toks[:3]
+
+
+def test_lazy_alloc_truncates_victim_instead_of_wedging_batch():
+    """Robustness: with lazy page allocation the pool CAN run dry
+    mid-decode.  The victim request must be finished early with
+    ``truncated=True`` — its pages recycled, the rest of the batch
+    decoding on — instead of an exception escaping step()."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    # 4 pages x 4 tokens = 16 cache positions; two prompt-3 requests
+    # each budgeting 12 new tokens CANNOT both finish
+    eng = ContinuousBatchingEngine(model, max_batch_size=2, num_blocks=4,
+                                   block_size=4, max_seq_len=32,
+                                   lazy_alloc=True)
+    r0 = eng.add_request(np.array([1, 2, 3], np.int64), max_new_tokens=12)
+    r1 = eng.add_request(np.array([4, 5, 6], np.int64), max_new_tokens=12)
+    eng.run_to_completion()                # must terminate, not raise
+    reqs = [eng.finished[r] for r in (r0, r1)]
+    assert any(r.truncated for r in reqs)
+    for r in reqs:
+        # a truncated request still returns every token it decoded
+        assert 0 < len(r.output_ids) <= 12
+        assert r.truncated or len(r.output_ids) == 12
+    # every page back in the pool; engine reusable afterwards
+    assert len(eng.caches[0]._free) == 4
+    r2 = eng.add_request(np.array([9], np.int64), max_new_tokens=3)
+    eng.run_to_completion()
+    assert len(eng.result(r2)) == 3
+    assert not eng.finished[r2].truncated
+
+
+@pytest.mark.slow
+def test_lazy_alloc_matches_eager_when_pool_suffices():
+    """Lazy growth is a capacity policy, not a math change: with enough
+    pages the tokens are byte-identical to the eager-allocation engine."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    prompts = [np.array([3, 1, 4], np.int64), np.array([1, 5], np.int64)]
+    outs = {}
+    for lazy in (False, True):
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       num_blocks=32, block_size=4,
+                                       lazy_alloc=lazy)
+        rids = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+        eng.run_to_completion()
+        outs[lazy] = [eng.result(r) for r in rids]
+        assert not any(eng.finished[r].truncated for r in rids)
+    assert outs[False] == outs[True]

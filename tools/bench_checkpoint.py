@@ -1,0 +1,197 @@
+"""Checkpoint stall benchmark (BENCH_CKPT_r08.json).
+
+On a forced 8-device CPU mesh (dp=8, ZeRO-2 so optimizer state is live
+sharded — the hard case for checkpointing), measure the train-step STALL
+added by per-step checkpointing of the full train state (params +
+sharded optimizer state + RNG) in two modes:
+
+- sync:  CheckpointManager.save(..., sync=True) — snapshot AND
+  pickle/fsync/rename on the train thread (what a naive save costs).
+- async: CheckpointManager.save(...) — only the device→host snapshot
+  stalls the train thread; the write commits on a background thread
+  while the next fused step runs.
+
+Gates (the ISSUE acceptance contract):
+- the async per-save stall is STRICTLY lower than the sync stall;
+- the final checkpoint of the async run is complete (CRC-validated)
+  and loads tensor-identical to the live state.
+
+Failure-marker contract: on any error ONE parseable JSON line
+(metric/value=0/unit=error) is emitted and the exit code is 1, so the
+driver still gets a record instead of a bare traceback.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_DEV = 8
+WARMUP = 2
+STEPS = 12
+SAVE_EVERY = 2     # checkpoint cadence: the async writer overlaps the
+                   # steps between saves (saving EVERY step would measure
+                   # the writer's own latency, not the train-thread stall)
+OUT = "BENCH_CKPT_r08.json"
+
+
+def _make_step():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (llama_tiny_config, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.train_step import TrainStep, ShardingConfig
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=4,
+                            intermediate_size=176, vocab_size=512)
+    model = LlamaForCausalLM(cfg)
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    mesh = ProcessMesh(shape=[N_DEV, 1], dim_names=["dp", "mp"])
+    step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt,
+                     mesh=mesh, sharding=ShardingConfig(stage=2))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32)
+    batch = (paddle.to_tensor(ids), paddle.to_tensor(ids.astype(np.int64)))
+    return model, opt, step, batch
+
+
+def _ckpt_values(model, step):
+    vals = {f"model.{k}": t._value
+            for k, t in model.state_dict().items()}
+    vals.update(step.opt_state_arrays())
+    return vals
+
+
+def _run_mode(mode: str):
+    """mode: 'none' | 'sync' | 'async'.  Returns (mean_step_ms,
+    mean_save_stall_ms, state_bytes, ckpt_dir|None)."""
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    model, opt, step, batch = _make_step()
+    ckpt_dir = None
+    mgr = None
+    if mode != "none":
+        ckpt_dir = tempfile.mkdtemp(prefix=f"bench-ckpt-{mode}-")
+        mgr = CheckpointManager(ckpt_dir, keep_last_k=2,
+                                async_save=(mode == "async"))
+    for _ in range(WARMUP):
+        loss = step(*batch)
+    float(np.asarray(loss._value))
+
+    state_bytes = sum(
+        int(np.prod(v.shape)) * v.dtype.itemsize
+        for v in _ckpt_values(model, step).values() if hasattr(v, "shape"))
+
+    step_times, stalls = [], []
+    for i in range(STEPS):
+        t0 = time.perf_counter()
+        loss = step(*batch)
+        float(np.asarray(loss._value))        # device barrier
+        t1 = time.perf_counter()
+        saved = mgr is not None and i % SAVE_EVERY == 0
+        if saved:
+            mgr.save(100 + i, _ckpt_values(model, step),
+                     {"global_step": 100 + i},
+                     sync=(mode == "sync"))
+        t2 = time.perf_counter()
+        step_times.append(t1 - t0)
+        if saved:
+            stalls.append(t2 - t1)
+    if mgr is not None:
+        # one final save of the end-of-run state (not timed): the
+        # validity gate compares THIS checkpoint against live arrays
+        mgr.save(100 + STEPS, _ckpt_values(model, step),
+                 {"global_step": 100 + STEPS}, sync=(mode == "sync"))
+        mgr.wait()
+    ms = lambda xs: round(1e3 * float(np.mean(xs)), 3) if xs else 0.0  # noqa: E731
+    return ms(step_times), ms(stalls), state_bytes, ckpt_dir, \
+        model, step, mgr
+
+
+def main():
+    out = {"n_devices": N_DEV, "dp": N_DEV, "zero_stage": 2,
+           "model": "llama_tiny(h=64,L=2,V=512)", "optimizer": "AdamW",
+           "steps": STEPS, "save_every": SAVE_EVERY}
+    dirs = []
+    try:
+        base_step, _, state_bytes, _, _, _, _ = _run_mode("none")
+        sync_step, sync_stall, _, d1, _, _, _ = _run_mode("sync")
+        dirs.append(d1)
+        async_step, async_stall, _, d2, model, step, mgr = \
+            _run_mode("async")
+        dirs.append(d2)
+
+        # validity gate: the async run's newest checkpoint is complete
+        # and tensor-identical to the live state
+        state = mgr.load()
+        live = _ckpt_values(model, step)
+        exact = all(
+            np.array_equal(state.global_value(k), np.asarray(v))
+            for k, v in live.items())
+        n_valid = len(mgr.all_valid())
+
+        passed = (async_stall < sync_stall) and exact and n_valid > 0
+        out.update({
+            "train_state_bytes": int(state_bytes),
+            "base_step_ms": base_step,
+            "sync": {"step_ms": sync_step, "save_stall_ms": sync_stall},
+            "async": {"step_ms": async_step,
+                      "save_stall_ms": async_stall},
+            "stall_ratio_async_over_sync": round(
+                async_stall / max(sync_stall, 1e-9), 4),
+            "async_final_checkpoint_exact": bool(exact),
+            "valid_checkpoints_after_async_run": n_valid,
+            "passed": bool(passed),
+        })
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), OUT)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({
+            "metric": "ckpt_async_save_stall_ms_dp8_zero2",
+            "value": async_stall,
+            "unit": "ms",
+            "vs_baseline": round(sync_stall / max(async_stall, 1e-9), 2),
+        }), flush=True)
+        print(f"# state={state_bytes}B stall sync/async="
+              f"{sync_stall}/{async_stall}ms step base/sync/async="
+              f"{base_step}/{sync_step}/{async_step}ms exact={exact} "
+              f"passed={passed}", file=sys.stderr)
+        if not passed:
+            sys.exit(1)
+    except SystemExit:
+        raise
+    except Exception as e:                            # noqa: BLE001
+        print(json.dumps({
+            "metric": "ckpt_async_save_stall_ms_dp8_zero2",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+        }), flush=True)
+        sys.exit(1)
+    finally:
+        for d in dirs:
+            if d:
+                shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
