@@ -26,18 +26,53 @@ import time
 
 import numpy as np
 
-# bf16 (or fp32 for pre-v4) dense peak FLOP/s per chip, by device_kind.
-_PEAK_FLOPS = {
-    "TPU v2": 46e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# --emit-metrics: mirror every bench line into the observability
+# registry and dump its JSON snapshot next to the artifact
+_EMIT_METRICS = False
+
+
+def _record_bench_metrics(metric_name, step_time, value, unit,
+                          mfu=None):
+    if not _EMIT_METRICS:
+        return
+    from paddle_tpu.observability import gauge
+    gauge("bench_step_time_seconds",
+          "measured per-step wall time of one bench line",
+          labels=("metric",)).labels(metric=metric_name).set(step_time)
+    gauge("bench_throughput",
+          "headline rate of one bench line (unit in the label)",
+          labels=("metric", "unit")).labels(
+        metric=metric_name, unit=unit).set(value)
+    if mfu is not None:
+        gauge("bench_mfu_ratio", "model FLOP/s utilization",
+              labels=("metric",)).labels(metric=metric_name).set(mfu)
+
+
+def _dump_bench_metrics():
+    """Registry JSON snapshot next to the bench artifact; the
+    established failure-marker contract on error."""
+    if not _EMIT_METRICS:
+        return
+    try:
+        from paddle_tpu.observability import dump_json
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_metrics.json")
+        dump_json(path)
+        print(f"# metrics snapshot -> {path}", file=sys.stderr)
+    except Exception as e:                            # noqa: BLE001
+        print(json.dumps({
+            "metric": "bench_emit_metrics",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+        }), flush=True)
+        sys.exit(1)
+
+
+# peak FLOP/s per chip: ONE table, shared with the runtime telemetry's
+# MFU gauge (observability.telemetry) so bench MFU and production MFU
+# can never disagree about the denominator
 
 
 def _init_backend(max_tries: int = 4, delay_s: float = 5.0):
@@ -75,11 +110,13 @@ def _init_backend(max_tries: int = 4, delay_s: float = 5.0):
 
 
 def _peak_flops(device) -> float:
+    from paddle_tpu.observability.telemetry import PEAK_FLOPS_BY_KIND
     kind = getattr(device, "device_kind", "")
-    for name, peak in _PEAK_FLOPS.items():
+    # longest prefix first ("TPU v5 lite" before "TPU v5")
+    for name in sorted(PEAK_FLOPS_BY_KIND, key=len, reverse=True):
         if kind.startswith(name):
-            return peak
-    return _PEAK_FLOPS["TPU v5 lite"]  # conservative default
+            return PEAK_FLOPS_BY_KIND[name]
+    return PEAK_FLOPS_BY_KIND["TPU v5 lite"]  # conservative default
 
 
 def _run_steps(step, batches, n, start=0):
@@ -156,6 +193,8 @@ def _measure_and_report(step_fn, batches, batch, seq, steps, cfg,
             f"synchronization is broken, refusing to report")
     assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
     pcount = param_count(cfg)
+    _record_bench_metrics(metric_name, step_time, tokens_per_sec,
+                          "tokens/s", mfu=mfu)
     print(json.dumps({
         "metric": metric_name,
         "value": round(tokens_per_sec, 1),
@@ -224,6 +263,7 @@ def _measure_generic(step_fn, batches, items_per_step, steps,
             f"physically impossible MFU {mfu:.3f} for {metric_name} — "
             "synchronization is broken, refusing to report")
     assert np.isfinite(loss_val), f"non-finite loss {loss_val}"
+    _record_bench_metrics(metric_name, step_time, ips, unit, mfu=mfu)
     print(json.dumps({
         "metric": metric_name,
         "value": round(ips, 1),
@@ -491,6 +531,11 @@ def _bench_sharded_update_mode():
         st = next(iter(step._opt_states.values()))
         frac = (np.prod(st["moment1"].sharding.shard_shape(
             st["moment1"].shape)) / np.prod(st["moment1"].shape))
+        if _EMIT_METRICS:
+            from paddle_tpu.observability import gauge
+            gauge("bench_sharded_state_shard_fraction",
+                  "optimizer-state bytes per replica over total "
+                  "(1/dp = full ZeRO sharding)").set(frac)
         print(json.dumps({
             "metric": "sharded_update_dryrun_dp8_stage1",
             "value": round(val, 4),
@@ -514,8 +559,12 @@ def _bench_sharded_update_mode():
 def main():
     from paddle_tpu.models import LlamaConfig
 
+    global _EMIT_METRICS
+    _EMIT_METRICS = "--emit-metrics" in sys.argv
+
     if "--sharded-update" in sys.argv:
-        return _bench_sharded_update_mode()
+        _bench_sharded_update_mode()
+        return _dump_bench_metrics()
 
     dev = _init_backend()
     on_tpu = dev.platform == "tpu"
@@ -593,6 +642,8 @@ def main():
         from paddle_tpu.models.llama import llama_tiny_config
         _bench_layerwise(llama_tiny_config(), 2, 128, 2, peak_flops,
                          on_tpu)
+
+    _dump_bench_metrics()
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ import importlib as _importlib
 _OPTIONAL_SUBMODULES = ["nn", "optimizer", "amp", "io", "jit", "static",
                         "distributed", "vision", "metric", "incubate",
                         "profiler", "device", "framework", "sparse",
+                        "observability",
                         "linalg_ns", "fft", "models", "text", "audio",
                         "signal", "hapi", "distribution", "quantization",
                         "onnx", "inference", "utils", "sysconfig", "hub", "geometric"]

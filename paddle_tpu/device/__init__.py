@@ -33,6 +33,7 @@ __all__ = ["set_device", "get_device", "get_all_device_type",
            "device_count", "synchronize", "memory_allocated",
            "max_memory_allocated", "memory_reserved",
            "max_memory_reserved", "reset_peak_memory_stats",
+           "memory_stats",
            "cuda", "CPUPlace", "TPUPlace", "CustomPlace",
            "Stream", "Event", "current_stream", "stream_guard"]
 
@@ -79,6 +80,27 @@ _PEAK_FALLBACK = {}     # device index -> peak bytes seen at query points
 _PEAK_BASELINE = {}     # device index -> PJRT peak counter at last reset
 
 
+def memory_stats(device=None) -> dict:
+    """The raw PJRT allocator counters for one device
+    (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` ... on
+    TPU) — SURVEY §5.5 memory-stat parity.  Backends without allocator
+    telemetry (XLA CPU) and failed/uninitialized backends return ``{}``
+    instead of raising, so telemetry code can poll unconditionally."""
+    try:
+        return _dev_stats(_device(device))
+    except Exception:                                 # noqa: BLE001
+        return {}
+
+
+def _dev_stats(d) -> dict:
+    """Stats for an already-resolved device; {} when none/failed."""
+    try:
+        stats = d.memory_stats()
+    except Exception:                                 # noqa: BLE001
+        return {}
+    return dict(stats) if stats else {}
+
+
 def _live_bytes(dev) -> int:
     total = 0
     for arr in jax.live_arrays():
@@ -93,9 +115,14 @@ def _live_bytes(dev) -> int:
 
 def memory_allocated(device=None) -> int:
     """Bytes currently allocated on the device (parity:
-    paddle.device.cuda.memory_allocated)."""
-    d = _device(device)
-    stats = d.memory_stats()
+    paddle.device.cuda.memory_allocated).  Never raises: a backend
+    without stats falls back to summing live arrays, and a missing/
+    broken backend reports 0."""
+    try:
+        d = _device(device)
+    except Exception:                                 # noqa: BLE001
+        return 0
+    stats = _dev_stats(d)
     if stats and "bytes_in_use" in stats:
         cur = int(stats["bytes_in_use"])
     else:
@@ -112,9 +139,13 @@ def max_memory_allocated(device=None) -> int:
     points — call memory_allocated() at the places you care about.  PJRT
     exposes no peak-reset, so after reset_peak_memory_stats() the device
     counter only counts if it rises above its value at reset; otherwise
-    current usage sampled at query points is the post-reset peak."""
-    d = _device(device)
-    stats = d.memory_stats()
+    current usage sampled at query points is the post-reset peak.
+    Never raises; 0 when no backend is available."""
+    try:
+        d = _device(device)
+    except Exception:                                 # noqa: BLE001
+        return 0
+    stats = _dev_stats(d)
     if stats and "peak_bytes_in_use" in stats:
         peak = int(stats["peak_bytes_in_use"])
         base = _PEAK_BASELINE.get(d.id)
@@ -129,8 +160,7 @@ def max_memory_allocated(device=None) -> int:
 
 
 def memory_reserved(device=None) -> int:
-    d = _device(device)
-    stats = d.memory_stats()
+    stats = memory_stats(device)
     if stats:
         for k in ("bytes_reserved", "pool_bytes", "bytes_limit"):
             if k in stats:
@@ -143,10 +173,13 @@ def max_memory_reserved(device=None) -> int:
 
 
 def reset_peak_memory_stats(device=None):
-    d = _device(device)
+    try:
+        d = _device(device)
+    except Exception:                                 # noqa: BLE001
+        return
     _PEAK_FALLBACK[d.id] = 0
-    stats = d.memory_stats()
-    if stats and "peak_bytes_in_use" in stats:
+    stats = _dev_stats(d)
+    if "peak_bytes_in_use" in stats:
         _PEAK_BASELINE[d.id] = int(stats["peak_bytes_in_use"])
 
 

@@ -14,6 +14,8 @@ IS the reshard pass.
 """
 from __future__ import annotations
 
+import os
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -128,7 +130,7 @@ class Engine:
             epochs=1, steps_per_epoch=None, log_freq=10, valid_data=None,
             collate_fn=None, verbose=0, checkpoint_dir=None,
             save_interval=None, keep_last_k=3, async_save=True,
-            resume=True):
+            resume=True, telemetry=True):
         """Train; optionally fault-tolerantly.
 
         With ``checkpoint_dir`` set, fit() becomes resumable: every
@@ -152,6 +154,20 @@ class Engine:
                                   collate_fn=collate_fn))
         step = self._build_step()
 
+        tel = None
+        ckpt_stall = resume_counter = None
+        if telemetry:
+            from ...observability import StepTelemetry, default_registry
+            tel = StepTelemetry()
+            reg = default_registry()
+            ckpt_stall = reg.gauge(
+                "train_checkpoint_stall_seconds",
+                "train-thread stall of the last checkpoint save "
+                "(device->host snapshot; the write is off-thread)")
+            resume_counter = reg.counter(
+                "train_resume_total",
+                "fit() entries that restored a checkpoint")
+
         mgr = None
         it = 0
         start_epoch = 0
@@ -166,6 +182,8 @@ class Engine:
                 if state is not None:
                     it, start_epoch, resume_batches = \
                         self._restore_train_state(step, state)
+                    if resume_counter is not None:
+                        resume_counter.inc()
                     if steps_per_epoch \
                             and resume_batches >= steps_per_epoch:
                         # the checkpoint landed exactly on a capped
@@ -199,9 +217,25 @@ class Engine:
                 # step k executes on device — the loss fetch (the sync
                 # point) comes only after the next transfer is in flight
                 arrays = self._next_device_batch(batch_it)
+                t_mark = time.perf_counter()
+                tel_attached = False
                 while arrays is not None:
                     if getattr(self, "_sample_arrays", None) is None:
                         self._sample_arrays = arrays
+                    bshape = is_tokens = None
+                    if tel is not None:
+                        b0 = arrays[0]
+                        bshape = np.shape(b0)
+                        # tokens/s only for token batches ([B, S] int
+                        # ids) — a [B,H,W,C] image batch must not
+                        # publish B*H as a "token" rate
+                        is_tokens = (len(bshape) == 2 and np.issubdtype(
+                            getattr(b0, "dtype", np.dtype(np.float32)),
+                            np.integer))
+                    # the first call of a fresh step traces+compiles:
+                    # telemetry records it as warmup, outside the
+                    # steady-state histogram/rates
+                    compiling = getattr(step, "_step_fn", None) is None
                     loss = step(*arrays)                 # async dispatch
                     epoch_steps += 1
                     last = bool(steps_per_epoch
@@ -213,6 +247,34 @@ class Engine:
                         else self._next_device_batch(batch_it)
                     history["loss"].append(float(np.asarray(loss)))
                     it += 1
+                    if tel is not None:
+                        # the loss host-fetch above is the device
+                        # barrier, so t_mark -> now spans the whole step
+                        now = time.perf_counter()
+                        tel.on_step(
+                            now - t_mark, loss=history["loss"][-1],
+                            examples=int(bshape[0]) if bshape else None,
+                            tokens=(int(bshape[0]) * int(bshape[1])
+                                    if is_tokens else None),
+                            step_index=it, warmup=compiling)
+                        if not tel_attached:
+                            # MFU's FLOPs source: cost_analysis of the
+                            # compiled step — ONE extra AOT compile,
+                            # after the first measured step (opt out
+                            # with PADDLE_TPU_MFU_COST_ANALYSIS=0 when
+                            # a second big-model compile is too dear)
+                            tel_attached = True
+                            if os.environ.get(
+                                    "PADDLE_TPU_MFU_COST_ANALYSIS",
+                                    "1") != "0":
+                                try:
+                                    tel.attach_train_step(
+                                        step, *self._sample_arrays)
+                                except Exception:     # noqa: BLE001
+                                    pass
+                            t_mark = time.perf_counter()
+                        else:
+                            t_mark = now
                     if verbose and it % log_freq == 0:
                         print(f"[AutoParallel Engine] epoch {epoch} "
                               f"step {it}: "
@@ -235,8 +297,13 @@ class Engine:
                         raise SystemExit(ELASTIC_RESTART_CODE)
                     if mgr is not None and save_interval \
                             and it % int(save_interval) == 0:
+                        t_save = time.perf_counter()
                         self._save_checkpoint(mgr, step, it, epoch,
                                               epoch_steps)
+                        if ckpt_stall is not None:
+                            ckpt_stall.set(
+                                time.perf_counter() - t_save)
+                            t_mark = time.perf_counter()
         finally:
             self._restore_sigterm(old_handler)
             if mgr is not None:

@@ -161,6 +161,24 @@ class CheckpointManager:
         self._write_error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self.saved_steps: List[int] = []       # committed by THIS manager
+        from ...observability import default_registry
+        r = default_registry()
+        self._m_duration = r.histogram(
+            "checkpoint_save_duration_seconds",
+            "full write (pickle+fsync+commit) of one checkpoint; "
+            "off-thread under async_save")
+        self._m_bytes = r.counter(
+            "checkpoint_written_bytes_total",
+            "payload bytes committed to checkpoint storage")
+        self._m_commits = r.counter(
+            "checkpoint_commits_total",
+            "checkpoints atomically committed (os.replace)")
+        self._m_gc = r.counter(
+            "checkpoint_gc_removed_total",
+            "committed checkpoints removed by keep_last_k GC")
+        self._m_failures = r.counter(
+            "checkpoint_failures_total",
+            "checkpoint writes that raised (sync or background)")
         self._clean_stale_tmp()
 
     # -- naming ---------------------------------------------------------------
@@ -227,6 +245,19 @@ class CheckpointManager:
             self._write_error = e
 
     def _write(self, step: int, snapshot, meta):
+        t0 = time.perf_counter()
+        try:
+            self._write_inner(step, snapshot, meta)
+        except BaseException:                         # noqa: BLE001
+            self._m_failures.inc()
+            raise
+        dt = time.perf_counter() - t0
+        self._m_duration.observe(dt)
+        from ...observability import record_span
+        record_span("ckpt_write", t0, t0 + dt, cat="checkpoint",
+                    step=int(step))
+
+    def _write_inner(self, step: int, snapshot, meta):
         tmp = self._tmp_dir(step)
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
@@ -265,6 +296,8 @@ class CheckpointManager:
             os.replace(tmp, final)                    # THE commit point
             self._fsync_dir(self.directory)
             self.saved_steps.append(int(step))
+        self._m_commits.inc()
+        self._m_bytes.inc(crc_f.size)
         self._gc()
 
     @staticmethod
@@ -391,6 +424,7 @@ class CheckpointManager:
                 for step, path in dirs[:-self.keep_last_k]:
                     if step < newest_valid:
                         shutil.rmtree(path, ignore_errors=True)
+                        self._m_gc.inc()
         self._clean_stale_tmp()
 
     def _clean_stale_tmp(self):

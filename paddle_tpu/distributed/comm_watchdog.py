@@ -105,6 +105,15 @@ class CommTaskManager:
                         del self._tasks[tid]
             for task in expired:
                 self._timed_out.append(task)
+                try:
+                    from ..observability import (counter, record_instant)
+                    counter("comm_timeouts_total",
+                            "collectives that exceeded the watchdog "
+                            "deadline").inc()
+                    record_instant(f"comm_timeout:{task.name}",
+                                   cat="comm", ranks=str(task.ranks))
+                except Exception:                     # noqa: BLE001
+                    pass        # the diagnostic below must still print
                 self._report(task)
                 try:
                     self.abort_handler(task)
@@ -123,6 +132,13 @@ class CommTaskManager:
 
     def _default_abort(self, task: CommTask):
         if get_flag("comm_abort_on_timeout"):
+            try:
+                from ..observability import counter
+                counter("comm_aborts_total",
+                        "processes killed by the comm watchdog "
+                        "(FLAGS_comm_abort_on_timeout)").inc()
+            except Exception:                         # noqa: BLE001
+                pass
             # the reference aborts the communicator; our analog is killing
             # the process so the launcher's --max_restarts supervision (or
             # the elastic manager) can relaunch a consistent world

@@ -24,6 +24,7 @@ next-token fetch.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -52,6 +53,10 @@ class GenerationRequest:
     slot: int = -1
     seq_len: int = 0
     block_ids: List[int] = field(default_factory=list)
+    # telemetry marks (perf_counter): admission -> first token = TTFT,
+    # first token -> done over n-1 tokens = TPOT
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
 
 
 class ContinuousBatchingEngine:
@@ -115,6 +120,45 @@ class ContinuousBatchingEngine:
         self.decode_step = DecodeStep(model, self.caches,
                                       use_pallas=use_pallas)
 
+        from ..observability import default_registry
+        r = default_registry()
+        self._m_queue = r.gauge(
+            "serving_queue_depth", "requests waiting for a free slot")
+        self._m_occupancy = r.gauge(
+            "serving_slot_occupancy_ratio",
+            "running slots / max_batch_size")
+        self._m_kv_util = r.gauge(
+            "serving_kv_page_utilization_ratio",
+            "allocated KV pages / pool size")
+        self._m_prefill = r.histogram(
+            "serving_prefill_duration_seconds",
+            "prompt prefill (dense forward + fused cache scatter)")
+        self._m_decode = r.histogram(
+            "serving_decode_step_duration_seconds",
+            "one fused batched decode step (all slots)")
+        self._m_ttft = r.histogram(
+            "serving_ttft_seconds", "admission wait + prefill to first "
+            "token (time-to-first-token)")
+        self._m_tpot = r.histogram(
+            "serving_tpot_seconds",
+            "mean per-token decode latency after the first token",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0))
+        self._m_requests = r.counter(
+            "serving_requests_total", "finished generation requests",
+            labels=("outcome",))
+        self._m_tokens = r.counter(
+            "serving_tokens_total", "tokens generated")
+        self._m_truncated = r.counter(
+            "serving_truncated_victims_total",
+            "requests finished early because the KV pool ran dry "
+            "(lazy_alloc victim contract)")
+        # compile warmup never lands in a latency histogram: the first
+        # decode call traces the fused step; the dense prefill path
+        # re-traces PER PROMPT LENGTH, so warmth is per-length
+        self._prefill_warm_lens = set()
+        self._decode_warm = False
+
     # ---- public API ----------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16,
                     eos_token_id=None) -> int:
@@ -139,7 +183,9 @@ class ContinuousBatchingEngine:
                 "request needs %d pages but the pool only has %d; "
                 "raise num_blocks" % (min_need, self.caches[0].num_blocks))
         self._next_id += 1
+        req.t_submit = time.perf_counter()
         self.waiting.append(req)
+        self._m_queue.set(len(self.waiting))
         return req.req_id
 
     def has_work(self) -> bool:
@@ -151,6 +197,13 @@ class ContinuousBatchingEngine:
         slot.  Returns req_ids finished this step."""
         self._admit()
         done = self._decode_batch()
+        self._m_queue.set(len(self.waiting))
+        self._m_occupancy.set(
+            sum(s is not None for s in self.slots)
+            / max(1, self.max_batch_size))
+        cache = self.caches[0]
+        self._m_kv_util.set(
+            1.0 - len(cache._free) / max(1, cache.num_blocks))
         return done
 
     def run_to_completion(self) -> Dict[int, List[int]]:
@@ -183,6 +236,7 @@ class ContinuousBatchingEngine:
         import paddle_tpu as paddle
         from ..autograd.tape import no_grad
         from ..jit.serving_step import prefill_scatter
+        t_prefill = time.perf_counter()
         L = len(req.prompt_ids)
         ids = paddle.to_tensor(req.prompt_ids[None, :].astype(np.int64))
         with no_grad():
@@ -208,6 +262,9 @@ class ContinuousBatchingEngine:
         self.slots[slot] = req
         last = np.asarray(logits[:, -1, :]._value, np.float32)
         first = int(last[0].argmax())
+        if L in self._prefill_warm_lens:
+            self._m_prefill.observe(time.perf_counter() - t_prefill)
+        self._prefill_warm_lens.add(L)
         self._append_token(req, first)
         if self.slots[slot] is req:         # still running after budget
             self._tokens[slot] = first
@@ -237,6 +294,7 @@ class ContinuousBatchingEngine:
                 r.block_ids.append(blk)
             if not grew:
                 r.truncated = True
+                self._m_truncated.inc()
                 self._finish(r)
                 truncated.append(r.req_id)
         return truncated
@@ -247,7 +305,13 @@ class ContinuousBatchingEngine:
             return done
         # ONE fused XLA call at the fixed slot count; masked slots ride
         # along (their writes hit the sink page, their token is ignored)
+        t_decode = time.perf_counter()
+        # DecodeStep returns np.asarray(...) — the host fetch inside
+        # the call is the device barrier, so this window is honest
         nxt = self.decode_step(self._tokens, self._seq_lens, self._bt)
+        if self._decode_warm:
+            self._m_decode.observe(time.perf_counter() - t_decode)
+        self._decode_warm = True
         for i, r in enumerate(list(self.slots)):
             if r is None:
                 continue
@@ -264,6 +328,10 @@ class ContinuousBatchingEngine:
     # ---- bookkeeping ----------------------------------------------------
     def _append_token(self, req: GenerationRequest, token: int):
         req.output_ids.append(token)
+        if len(req.output_ids) == 1:
+            req.t_first_token = time.perf_counter()
+            if req.t_submit:
+                self._m_ttft.observe(req.t_first_token - req.t_submit)
         hit_eos = (req.eos_token_id is not None
                    and token == req.eos_token_id)
         if len(req.output_ids) >= req.max_new_tokens or hit_eos:
@@ -271,6 +339,13 @@ class ContinuousBatchingEngine:
 
     def _finish(self, req: GenerationRequest):
         req.state = "done"
+        n_tok = len(req.output_ids)
+        self._m_requests.labels(
+            outcome="truncated" if req.truncated else "completed").inc()
+        self._m_tokens.inc(n_tok)
+        if n_tok > 1 and req.t_first_token:
+            self._m_tpot.observe(
+                (time.perf_counter() - req.t_first_token) / (n_tok - 1))
         if req.slot >= 0:
             s = req.slot
             self.slots[s] = None

@@ -37,6 +37,16 @@ def get_worker_info():
     return _worker_info
 
 
+def _queue_wait_histogram():
+    """Consumer-side wait for the next prefetched batch: ~0 means the
+    loader keeps ahead of the device; a fat tail means decode/augment
+    (or the shm ring) is the training bottleneck."""
+    from ..observability import histogram
+    return histogram(
+        "dataloader_queue_wait_seconds",
+        "time the consumer blocked waiting on the prefetch queue/ring")
+
+
 def default_collate_fn(batch):
     """Stack samples into batch arrays (parity:
     python/paddle/io/dataloader/collate.py)."""
@@ -197,6 +207,8 @@ class DataLoader:
             p.start()
             procs.append(p)
 
+        import time as _time
+        wait_hist = _queue_wait_histogram()
         alive = [True] * W
         try:
             w = 0
@@ -204,9 +216,11 @@ class DataLoader:
                 if not alive[w]:
                     w = (w + 1) % W
                     continue
+                t_wait = _time.perf_counter()
                 while True:
                     try:
                         msg = rings[w].recv_msg(timeout_us=1_000_000)
+                        wait_hist.observe(_time.perf_counter() - t_wait)
                         break
                     except ShmRing.Timeout:
                         # watchdog: a SIGKILL'd/segfaulted worker never
@@ -318,9 +332,13 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True,
                              name="pdtpu-dataloader-prefetch")
         t.start()
+        import time as _time
+        wait_hist = _queue_wait_histogram()
         try:
             while True:
+                t_wait = _time.perf_counter()
                 item = q.get()
+                wait_hist.observe(_time.perf_counter() - t_wait)
                 if item is sentinel:
                     break
                 if isinstance(item, Exception):

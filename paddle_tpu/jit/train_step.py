@@ -601,6 +601,44 @@ class TrainStep:
         return self._step_fn.lower(params, frozen_vals, self._opt_states,
                                    lr, key, *batch_vals)
 
+    def compiled_stats(self, *batch) -> Dict[str, Any]:
+        """FLOPs + static memory sizes of the compiled fused step —
+        the telemetry source for MFU (cost_analysis) and HBM headroom
+        (memory_analysis).  AOT lower+compile of the SAME traced body
+        (cached per instance: one extra compile, ever).  XLA reports
+        PER-DEVICE numbers: under dp=8 sharding the flops are 1/8 of
+        the global program — divide by per-chip peak for MFU, never by
+        peak * device_count."""
+        cached = getattr(self, "_compiled_stats", None)
+        if cached is not None:
+            return cached
+        compiled = self.lower(*batch).compile()
+        stats: Dict[str, Any] = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed")):
+                if ca.get(src):
+                    stats[dst] = float(ca[src])
+        except Exception:                             # noqa: BLE001
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            for attr, dst in (
+                    ("temp_size_in_bytes", "temp_bytes"),
+                    ("argument_size_in_bytes", "argument_bytes"),
+                    ("output_size_in_bytes", "output_bytes"),
+                    ("generated_code_size_in_bytes", "code_bytes")):
+                v = getattr(ma, attr, None)
+                if v:
+                    stats[dst] = int(v)
+        except Exception:                             # noqa: BLE001
+            pass
+        self._compiled_stats = stats
+        return stats
+
     def __call__(self, *batch):
         sd, params, frozen_vals, batch_vals = self._gather_inputs(batch)
         self._ensure_built(batch_vals)

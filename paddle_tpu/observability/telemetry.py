@@ -1,0 +1,214 @@
+"""Per-step training telemetry: wall time, throughput, MFU, HBM, and a
+NaN/Inf loss sentinel.
+
+Roofline-style efficiency accounting (Tensor Processing Primitives,
+arXiv:2104.05755) applied per step: MFU = achieved FLOP/s over the
+chip's peak, with the FLOPs numerator taken from the COMPILED step's
+``cost_analysis()`` (what XLA will actually execute — recompute,
+fusions and collectives included) rather than an analytic 6ND guess.
+HBM comes from the compiled module's ``memory_analysis()`` (static) and
+the live device memory stats (:mod:`paddle_tpu.device`, sampled every
+``hbm_sample_interval`` steps — the CPU fallback walks live arrays, so
+per-step sampling would not be free).
+
+The NaN/Inf sentinel is the reference's ``FLAGS_check_nan_inf``
+equivalent: opt in with env ``PADDLE_TPU_CHECK_NAN_INF=1`` (or
+``check_nan_inf=True``) and a non-finite loss raises
+``FloatingPointError`` after bumping ``train_nonfinite_loss_total`` —
+fail the job at the poisoned step instead of training garbage for hours.
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry, default_registry
+from .trace_merge import span_log
+
+__all__ = ["StepTelemetry", "device_peak_flops", "PEAK_FLOPS_BY_KIND",
+           "CHECK_NAN_ENV", "PEAK_FLOPS_ENV"]
+
+CHECK_NAN_ENV = "PADDLE_TPU_CHECK_NAN_INF"
+PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
+
+# bf16 (fp32 for pre-v4) dense peak FLOP/s per chip by device_kind
+# prefix — the MFU denominator (same table bench.py reports against)
+PEAK_FLOPS_BY_KIND = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> Optional[float]:
+    """Per-chip peak FLOP/s: the ``PADDLE_TPU_PEAK_FLOPS`` env override
+    if set, else the device_kind table; None when unknown (XLA CPU) —
+    MFU is then reported as 0 rather than a made-up number."""
+    env = os.environ.get(PEAK_FLOPS_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        if device is None:
+            device = jax.devices()[0]
+    except Exception:                                 # noqa: BLE001
+        return None
+    kind = getattr(device, "device_kind", "") or ""
+    # longest prefix first: "TPU v5 lite" must not match the "TPU v5"
+    # (v5p) row
+    for name in sorted(PEAK_FLOPS_BY_KIND, key=len, reverse=True):
+        if kind.startswith(name):
+            return PEAK_FLOPS_BY_KIND[name]
+    return None
+
+
+def _truthy_env(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+class StepTelemetry:
+    """Records one training step's telemetry into the metrics registry.
+
+    Usage (what ``Engine.fit`` does)::
+
+        tel = StepTelemetry()
+        tel.attach_train_step(step, *sample_batch)   # FLOPs/HBM, once
+        ...
+        t0 = time.perf_counter()
+        loss = step(*batch); loss_val = float(loss)  # host fetch
+        tel.on_step(time.perf_counter() - t0, loss=loss_val,
+                    examples=bs, tokens=bs * seq)
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 peak_flops: Optional[float] = None,
+                 check_nan_inf: Optional[bool] = None,
+                 hbm_sample_interval: int = 10,
+                 span_markers: bool = True):
+        r = registry or default_registry()
+        self.registry = r
+        self._steps = r.counter(
+            "train_steps_total", "optimizer steps applied")
+        self._duration = r.histogram(
+            "train_step_duration_seconds",
+            "wall time per fused train step (dispatch -> loss fetch)")
+        self._examples_rate = r.gauge(
+            "train_examples_per_second", "examples/s over the last step")
+        self._tokens_rate = r.gauge(
+            "train_tokens_per_second", "tokens/s over the last step")
+        self._mfu = r.gauge(
+            "train_mfu_ratio",
+            "achieved FLOP/s over peak; FLOPs from the compiled step's "
+            "cost_analysis (0 when peak or FLOPs are unknown)")
+        self._loss = r.gauge("train_loss", "last step's loss")
+        self._nonfinite = r.counter(
+            "train_nonfinite_loss_total",
+            "steps whose loss came back NaN/Inf "
+            "(PADDLE_TPU_CHECK_NAN_INF sentinel)")
+        self._flops_gauge = r.gauge(
+            "train_step_flops",
+            "FLOPs per compiled train step (cost_analysis)")
+        self._temp_bytes = r.gauge(
+            "train_step_temp_hbm_bytes",
+            "compiled step's XLA temp allocation (memory_analysis)")
+        self._hbm_in_use = r.gauge(
+            "hbm_in_use_bytes", "live device memory at last sample")
+        self._hbm_peak = r.gauge(
+            "hbm_peak_bytes", "peak device memory at last sample")
+
+        self.flops_per_step: Optional[float] = None
+        self.peak_flops = peak_flops if peak_flops is not None \
+            else device_peak_flops()
+        self.check_nan_inf = _truthy_env(CHECK_NAN_ENV) \
+            if check_nan_inf is None else bool(check_nan_inf)
+        self.hbm_sample_interval = max(1, int(hbm_sample_interval))
+        self.span_markers = bool(span_markers)
+        self._n = 0
+
+    # -- FLOPs / HBM source ---------------------------------------------------
+    def set_flops_per_step(self, flops: Optional[float]):
+        if flops:
+            self.flops_per_step = float(flops)
+            self._flops_gauge.set(float(flops))
+
+    def attach_train_step(self, train_step, *batch) -> Dict[str, Any]:
+        """Pull FLOPs + static memory sizes from the compiled step
+        (``TrainStep.compiled_stats`` — AOT lower/compile, cached on the
+        step).  One extra compile; returns the stats dict."""
+        stats = train_step.compiled_stats(*batch)
+        self.set_flops_per_step(stats.get("flops"))
+        temp = stats.get("temp_bytes")
+        if temp:
+            self._temp_bytes.set(float(temp))
+        return stats
+
+    def sample_hbm(self):
+        """Record live/peak device memory gauges now (device stats on
+        TPU, the live-array fallback on CPU — never raises)."""
+        try:
+            from .. import device as _device
+            self._hbm_in_use.set(float(_device.memory_allocated()))
+            self._hbm_peak.set(float(_device.max_memory_allocated()))
+        except Exception:                             # noqa: BLE001
+            pass
+
+    # -- per-step record ------------------------------------------------------
+    def on_step(self, duration_s: float, loss: Optional[float] = None,
+                examples: Optional[int] = None,
+                tokens: Optional[int] = None,
+                step_index: Optional[int] = None,
+                warmup: bool = False):
+        """Record one completed step; ``duration_s`` must span dispatch
+        through the loss host-fetch (the real device barrier).  Raises
+        ``FloatingPointError`` on a non-finite loss when the NaN/Inf
+        sentinel is enabled.
+
+        ``warmup=True`` marks a step whose wall time includes jit
+        trace+compile (the first call of a fresh step): it is counted
+        and loss-checked, but excluded from the duration histogram and
+        the rate/MFU gauges so one multi-second compile doesn't skew
+        the steady-state statistics forever."""
+        self._n += 1
+        dt = max(float(duration_s), 1e-9)
+        self._steps.inc()
+        if not warmup:
+            self._duration.observe(dt)
+            if examples:
+                self._examples_rate.set(examples / dt)
+            if tokens:
+                self._tokens_rate.set(tokens / dt)
+        if not warmup and self.flops_per_step and self.peak_flops:
+            # cost_analysis FLOPs are PER-DEVICE (XLA divides sharded
+            # work by the mesh size — verified: dp=8 reports 1/8 the
+            # unsharded count), so per-chip peak is the denominator;
+            # multiplying by device_count would under-report dp=8 by 8x
+            self._mfu.set(self.flops_per_step / dt / self.peak_flops)
+        if loss is not None:
+            self._loss.set(float(loss))
+            if not math.isfinite(float(loss)):
+                self._nonfinite.inc()
+                if self.check_nan_inf:
+                    raise FloatingPointError(
+                        f"non-finite loss {loss!r} at telemetry step "
+                        f"{self._n} ({CHECK_NAN_ENV} sentinel); "
+                        f"checkpoint + restart from the last finite "
+                        f"state")
+        if self._n % self.hbm_sample_interval == 0:
+            self.sample_hbm()
+        if self.span_markers:
+            now = time.perf_counter()
+            span_log.record("train_step", now - dt, now, cat="train",
+                            step=int(step_index if step_index is not None
+                                     else self._n))

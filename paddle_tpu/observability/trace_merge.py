@@ -1,0 +1,240 @@
+"""Merged host + device Chrome traces.
+
+The two-tier profiler (SURVEY §5.1) leaves three timeline sources lying
+around: host ``RecordEvent`` spans (paddle_tpu.profiler), the runtime
+span log this module keeps (step markers, checkpoint writes, comm
+timeouts), and the device trace ``jax.profiler`` writes under its trace
+dir — which on this jax build includes a ready-made chrome trace
+(``plugins/profile/<run>/<host>.trace.json.gz``).  ``merge_chrome_trace``
+folds all three into ONE chrome://tracing JSON so a single load shows
+the train loop, the checkpoint writer and the XLA device activity
+side by side.
+
+Clock domains: host spans are ``time.perf_counter`` based, the device
+trace has its own epoch; each source is shifted so its earliest event
+sits at t=0 (alignment at trace start — sub-trace ordering is exact,
+cross-trace skew is bounded by the capture window).
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["SpanLog", "span_log", "record_span", "record_instant",
+           "load_device_trace_events", "merge_chrome_trace"]
+
+_SPAN_LOG_CAP = 16384
+
+
+class SpanLog:
+    """Bounded in-memory log of runtime spans/instants (step markers,
+    checkpoint writes, watchdog timeouts).  Appends are one deque.append
+    — cheap enough for per-step use; the cap drops the OLDEST entries so
+    a week-long job keeps the recent window."""
+
+    def __init__(self, maxlen: int = _SPAN_LOG_CAP):
+        self._events: "collections.deque" = collections.deque(
+            maxlen=maxlen)
+
+    def record(self, name: str, start: float, end: float,
+               cat: str = "runtime", **args):
+        """A completed span; start/end are time.perf_counter seconds."""
+        self._events.append(("X", name, cat, start, end, args,
+                             threading.get_ident()))
+
+    def instant(self, name: str, ts: Optional[float] = None,
+                cat: str = "runtime", **args):
+        t = time.perf_counter() if ts is None else ts
+        self._events.append(("i", name, cat, t, t, args,
+                             threading.get_ident()))
+
+    def events(self) -> List[tuple]:
+        return list(self._events)
+
+    def clear(self):
+        self._events.clear()
+
+    def __len__(self):
+        return len(self._events)
+
+
+# process-wide log every wired subsystem appends to
+span_log = SpanLog()
+
+
+def record_span(name: str, start: float, end: float,
+                cat: str = "runtime", **args):
+    span_log.record(name, start, end, cat, **args)
+
+
+def record_instant(name: str, ts: Optional[float] = None,
+                   cat: str = "runtime", **args):
+    span_log.instant(name, ts, cat, **args)
+
+
+def _tid_map(idents: Iterable[int]) -> Dict[int, int]:
+    """Stable small thread ids (chrome renders 15-digit pthread idents
+    as separate unreadable lanes)."""
+    return {ident: i for i, ident in enumerate(sorted(set(idents)))}
+
+
+def load_device_trace_events(trace_dir: str) -> List[dict]:
+    """traceEvents from the chrome trace(s) jax.profiler wrote under
+    ``trace_dir`` (``**/*.trace.json[.gz]``); [] when the dir is missing
+    or holds no trace — a device-less CPU/host-only run merges cleanly
+    to host spans alone."""
+    if not trace_dir or not os.path.isdir(trace_dir):
+        return []
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(trace_dir, "**", "*.trace.json"),
+                    recursive=True))
+    events: List[dict] = []
+    for path in paths:
+        try:
+            if path.endswith(".gz"):
+                with gzip.open(path, "rt") as f:
+                    data = json.load(f)
+            else:
+                with open(path) as f:
+                    data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        evs = data.get("traceEvents", data) if isinstance(data, dict) \
+            else data
+        if isinstance(evs, list):
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def _host_events_json(host_events, pid: int,
+                      t0: Optional[float] = None) -> List[dict]:
+    """paddle_tpu.profiler _HostEvent list -> chrome 'X' events +
+    name metadata, normalized to t=0 at ``t0`` (defaults to the
+    earliest span)."""
+    if not host_events:
+        return []
+    if t0 is None:
+        t0 = min(e.start for e in host_events)
+    tids = _tid_map(e.tid for e in host_events)
+    # spans FIRST, name metadata after: tools that peek at
+    # traceEvents[0] (and the repo's own round-trip checks) see a real
+    # 'X' span, and chrome accepts metadata at any position
+    out = [{"name": e.name, "ph": "X", "pid": pid,
+            "tid": tids[e.tid], "ts": (e.start - t0) * 1e6,
+            "dur": (e.end - e.start) * 1e6,
+            "cat": getattr(e, "event_type", "UserDefined")}
+           for e in sorted(host_events, key=lambda e: e.start)]
+    out.append({"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": "host (RecordEvent)"}})
+    for ident, tid in tids.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"host-thread-{tid}"}})
+    return out
+
+
+def _span_log_events_json(entries, pid: int,
+                          t0: Optional[float] = None) -> List[dict]:
+    if not entries:
+        return []
+    if t0 is None:
+        t0 = min(e[3] for e in entries)
+    tids = _tid_map(e[6] for e in entries)
+    out = []
+    for ph, name, cat, start, end, args, ident in entries:
+        ev = {"name": name, "ph": ph, "pid": pid, "tid": tids[ident],
+              "ts": (start - t0) * 1e6, "cat": cat}
+        if ph == "X":
+            ev["dur"] = (end - start) * 1e6
+        else:
+            ev["s"] = "t"          # thread-scoped instant
+        if args:
+            ev["args"] = {k: v for k, v in args.items()}
+        out.append(ev)
+    out.append({"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": "runtime (steps/ckpt/comm)"}})
+    for ident, tid in tids.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"runtime-thread-{tid}"}})
+    return out
+
+
+def _device_events_json(events: List[dict], pid_base: int) -> List[dict]:
+    """Re-base the device trace: pids offset so they never collide with
+    the host groups, timestamps shifted to t=0 at the earliest event."""
+    if not events:
+        return []
+    ts_vals = []
+    for e in events:
+        try:
+            ts_vals.append(float(e["ts"]))
+        except (KeyError, TypeError, ValueError):
+            pass
+    ts0 = min(ts_vals) if ts_vals else 0.0   # metadata-only trace: keep
+    out = []
+    for e in events:
+        ev = dict(e)
+        if "pid" in ev:
+            try:
+                ev["pid"] = pid_base + int(ev["pid"])
+            except (TypeError, ValueError):
+                ev["pid"] = pid_base
+        else:
+            ev["pid"] = pid_base
+        try:
+            ev["ts"] = float(ev["ts"]) - ts0
+        except (KeyError, TypeError, ValueError):
+            pass                             # no/odd ts: pass through
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            args = dict(ev.get("args") or {})
+            args["name"] = f"device: {args.get('name', 'jax')}"
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def merge_chrome_trace(path: str, host_events=None,
+                       runtime_events=None,
+                       device_trace_dir: Optional[str] = None) -> str:
+    """Write one chrome://tracing JSON folding host RecordEvent spans,
+    the runtime span log, and the device trace (if any) — the
+    observability subsystem's single-timeline artifact.
+
+    host_events: ``Profiler.events`` list (or None).
+    runtime_events: a :class:`SpanLog` / its ``events()`` list; defaults
+    to the process-wide :data:`span_log`.
+    device_trace_dir: a ``jax.profiler`` trace dir; missing/empty dirs
+    degrade to a host-only trace (the device-less CPU contract).
+    """
+    if runtime_events is None:
+        runtime_events = span_log
+    if isinstance(runtime_events, SpanLog):
+        runtime_events = runtime_events.events()
+    pid = os.getpid()
+    host_events = list(host_events or [])
+    runtime_events = list(runtime_events or [])
+    # host spans and runtime spans share the perf_counter clock: ONE t0
+    # across both, or a checkpoint 45s into the profile would render at
+    # t=0 next to the first host span
+    starts = [e.start for e in host_events] \
+        + [e[3] for e in runtime_events]
+    t0 = min(starts) if starts else None
+    events: List[dict] = []
+    events.extend(_host_events_json(host_events, pid, t0))
+    events.extend(_span_log_events_json(runtime_events, pid + 1, t0))
+    events.extend(_device_events_json(
+        load_device_trace_events(device_trace_dir), 1_000_000))
+    out = {"displayTimeUnit": "ms", "traceEvents": events}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f)
+    return path

@@ -285,18 +285,34 @@ class Profiler:
         return list(self._events or self._last_round_events)
 
     def _export_chrome(self, path: str):
+        """Valid chrome://tracing JSON from the host spans ALONE when no
+        device trace exists (device-less CPU runs, timer_only) — plus
+        the runtime span log (step markers, checkpoint writes, comm
+        timeouts) and the jax device trace folded in when present.
+        ``load_profiler_result`` round-trips the output."""
+        from ..observability.trace_merge import (merge_chrome_trace,
+                                                 span_log)
         events = self._events or self._last_round_events
-        t0 = min((e.start for e in events), default=0.0)
-        out = {"traceEvents": [
-            {"name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
-             "ts": (e.start - t0) * 1e6, "dur": (e.end - e.start) * 1e6,
-             "cat": e.event_type}
-            for e in events]}
-        with open(path, "w") as f:
-            json.dump(out, f)
-        return path
+        trace_dir = self._trace_dir if not self._timer_only else None
+        # only runtime spans overlapping this profile window: the span
+        # log is process-lived, the profiler round is not
+        t_lo = min((e.start for e in events), default=None)
+        if t_lo is None:
+            # a round with no host spans has no window to clip to —
+            # exporting the whole process-lived span log instead would
+            # dump unrelated history
+            runtime = []
+        else:
+            t_hi = max(e.end for e in events)
+            runtime = [ev for ev in span_log.events()
+                       if ev[4] >= t_lo and ev[3] <= t_hi]
+        return merge_chrome_trace(path, host_events=events,
+                                  runtime_events=runtime,
+                                  device_trace_dir=trace_dir)
 
     def export(self, path: str, format: str = "json"):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
         return self._export_chrome(path)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,

@@ -1,0 +1,223 @@
+"""Observability overhead + scrape benchmark (BENCH_OBS_r09.json).
+
+Two gates (the ISSUE acceptance contract):
+
+1. **Overhead < 2%.**  The per-step wall time of a fused tiny-Llama
+   train step with full StepTelemetry enabled (duration histogram,
+   throughput gauges, MFU, loss gauge + NaN sentinel check, periodic
+   HBM sampling, span-log step markers) is compared against the same
+   loop with telemetry off; the median-over-steps overhead fraction
+   must stay under 0.02.  The one-time cost_analysis attach (an extra
+   AOT compile) happens outside the timed region, as it does in
+   Engine.fit (after the first step, once).
+2. **One scrape shows the whole stack.**  After also exercising the
+   continuous-batching serving engine and the checkpoint manager, one
+   HTTP GET of /metrics must contain step, serving AND checkpoint
+   metric families (plus a 200 /healthz).
+
+Failure-marker contract: on any error ONE parseable JSON line
+(metric/value=0/unit=error) is emitted and the exit code is 1.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+WARMUP = 3
+STEPS = 40
+OUT = "BENCH_OBS_r09.json"
+FAMILIES = ("train_step_duration_seconds",
+            "serving_decode_step_duration_seconds",
+            "checkpoint_commits_total")
+
+
+def _make_step():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (llama_tiny_config, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+    from paddle_tpu.jit.train_step import TrainStep
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=4,
+                            intermediate_size=176, vocab_size=512)
+    model = LlamaForCausalLM(cfg)
+    criterion = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    step = TrainStep(model, lambda lg, lb: criterion(lg, lb), opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (16, 32)).astype(np.int32)
+    batch = (paddle.to_tensor(ids),
+             paddle.to_tensor(ids.astype(np.int64)))
+    return model, step, batch
+
+
+def _timed_loop(step, batch, tel, n):
+    """Per-step wall times measured mark-to-mark, so the telemetry
+    calls themselves are INSIDE the measured window."""
+    marks = [time.perf_counter()]
+    for _ in range(n):
+        loss = step(*batch)
+        val = float(np.asarray(loss._value))      # device barrier
+        if tel is not None:
+            tel.on_step(time.perf_counter() - marks[-1], loss=val,
+                        examples=16, tokens=16 * 32)
+        marks.append(time.perf_counter())
+    return np.diff(np.asarray(marks))
+
+
+def _measure_overhead():
+    """Telemetry-on vs -off per-step times, INTERLEAVED in small blocks
+    over ONE compiled step: host clock drift / thermal noise on a shared
+    CPU dwarfs the telemetry cost, and back-to-back whole-run timing
+    measures the drift, not the overhead."""
+    from paddle_tpu.observability import StepTelemetry
+    model, step, batch = _make_step()
+    for _ in range(WARMUP):
+        loss = step(*batch)
+    float(np.asarray(loss._value))
+    tel = StepTelemetry()
+    tel.attach_train_step(step, *batch)       # one-time, outside timing
+    block = 5
+    t_off, t_on = [], []
+    for _ in range(STEPS // block):
+        t_off.extend(_timed_loop(step, batch, None, block))
+        t_on.extend(_timed_loop(step, batch, tel, block))
+    return np.asarray(t_off), np.asarray(t_on), tel
+
+
+def _exercise_serving():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=16, block_size=4)
+    eng.add_request(np.array([3, 14, 15], np.int64), max_new_tokens=4)
+    eng.add_request(np.array([1, 2], np.int64), max_new_tokens=4)
+    return eng.run_to_completion()
+
+
+def _exercise_checkpoint(model, step):
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    d = tempfile.mkdtemp(prefix="bench-obs-ckpt-")
+    try:
+        mgr = CheckpointManager(d, keep_last_k=2, async_save=False)
+        values = {f"model.{k}": t._value
+                  for k, t in model.state_dict().items()}
+        for s in (1, 2):
+            mgr.save(s, values, {"global_step": s}, sync=True)
+        return len(mgr.all_valid())
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main():
+    try:
+        t_off, t_on, tel = _measure_overhead()
+        med_off = float(np.median(t_off))
+        med_on = float(np.median(t_on))
+        overhead = (med_on - med_off) / med_off
+
+        _exercise_serving()
+        model, step, _batch = _make_step()
+        n_ckpt = _exercise_checkpoint(model, step)
+
+        from paddle_tpu.observability import (MetricsServer,
+                                              default_registry,
+                                              json_snapshot)
+        srv = MetricsServer(port=0, addr="127.0.0.1").start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            hz = urllib.request.urlopen(
+                base + "/healthz", timeout=10)
+            healthz_ok = hz.status == 200
+        finally:
+            srv.stop()
+        missing = [f for f in FAMILIES if f not in body]
+        flops = tel.flops_per_step
+
+        passed = (overhead < 0.02 and not missing and healthz_ok
+                  and n_ckpt == 2)
+        out = {
+            "model": "llama_tiny(h=64,L=2,V=512)", "steps": STEPS,
+            "step_ms_telemetry_off": {
+                "median": round(med_off * 1e3, 3),
+                "mean": round(float(np.mean(t_off)) * 1e3, 3),
+                "min": round(float(np.min(t_off)) * 1e3, 3)},
+            "step_ms_telemetry_on": {
+                "median": round(med_on * 1e3, 3),
+                "mean": round(float(np.mean(t_on)) * 1e3, 3),
+                "min": round(float(np.min(t_on)) * 1e3, 3)},
+            "overhead_frac_median": round(overhead, 5),
+            "flops_per_step_cost_analysis": flops,
+            "scrape_families_checked": list(FAMILIES),
+            "scrape_families_missing": missing,
+            "healthz_ok": bool(healthz_ok),
+            "valid_checkpoints": n_ckpt,
+            "metric_names_exported": sorted(
+                default_registry().names()),
+            # the full registry dump (the --emit-metrics twin), inside
+            # the artifact so the scrape contents are reviewable
+            "registry_snapshot": json_snapshot(),
+            "passed": bool(passed),
+        }
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), OUT)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({
+            "metric": "observability_telemetry_step_overhead_frac",
+            "value": round(overhead, 5),
+            "unit": "fraction",
+            # headroom vs the 2% budget; overhead below timing noise
+            # (±~1ms on shared CPU) floors at 1e-3 so the ratio stays
+            # meaningful
+            "vs_baseline": round(0.02 / max(overhead, 1e-3), 2),
+        }), flush=True)
+        print(f"# step median off/on={med_off*1e3:.2f}/"
+              f"{med_on*1e3:.2f}ms overhead={overhead*100:.2f}% "
+              f"families_missing={missing} healthz={healthz_ok} "
+              f"passed={passed}", file=sys.stderr)
+        if not passed:
+            sys.exit(1)
+    except SystemExit:
+        raise
+    except Exception as e:                            # noqa: BLE001
+        print(json.dumps({
+            "metric": "observability_telemetry_step_overhead_frac",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+        }), flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

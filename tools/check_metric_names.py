@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Metric-name lint (run in the verify flow; see tests/test_observability
+``test_metric_name_lint``).
+
+Statically scans every registration site — ``counter("...")`` /
+``gauge("...")`` / ``histogram("...")`` with a literal first argument —
+under ``paddle_tpu/``, ``tools/`` and ``bench.py``, and enforces the
+repo's metric-naming contract:
+
+1. names are snake_case (``[a-z][a-z0-9_]*``);
+2. counters end in ``_total``; gauges/histograms never do;
+3. base units only: no ``_ms``/``_us``/``_mb``/``_kb``/... suffixes —
+   durations are ``_seconds``, sizes are ``_bytes``;
+4. the unit is the SUFFIX: a name containing ``seconds``/``bytes``
+   anywhere else (before ``_total`` for counters) is malformed;
+5. one name, one type: the same name registered as two different kinds
+   anywhere in the tree is an error (the runtime registry would also
+   raise, but only when both sites actually execute).
+
+Pure stdlib + no jax import: safe to run anywhere, exits non-zero with
+one line per violation.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCAN = ["paddle_tpu", "tools", "bench.py"]
+
+# .counter(" / counter(' / r.histogram(  ... with a literal first arg
+# (possibly on the next line)
+_REG_RE = re.compile(
+    r"\b(counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_.\-]+)[\"']")
+
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+_BANNED_SUFFIXES = ("_ms", "_msec", "_millis", "_us", "_micros", "_ns",
+                    "_minutes", "_hours", "_kb", "_mb", "_gb", "_kib",
+                    "_mib", "_gib")
+
+
+def find_registrations() -> List[Tuple[str, int, str, str]]:
+    """[(relpath, lineno, kind, name)] for every literal registration."""
+    out = []
+    for top in SCAN:
+        path = os.path.join(REPO, top)
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = []
+            for root, _dirs, names in os.walk(path):
+                files += [os.path.join(root, n) for n in names
+                          if n.endswith(".py")]
+        for fpath in sorted(files):
+            if os.path.abspath(fpath) == os.path.abspath(__file__):
+                continue       # the docstring's own examples
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for m in _REG_RE.finditer(text):
+                kind, name = m.group(1), m.group(2)
+                line = text.count("\n", 0, m.start()) + 1
+                out.append((os.path.relpath(fpath, REPO), line, kind,
+                            name))
+    return out
+
+
+def lint(regs) -> List[str]:
+    errors = []
+
+    def err(where, msg):
+        errors.append(f"{where[0]}:{where[1]}: {msg}")
+
+    kinds: Dict[str, Tuple[str, Tuple[str, int]]] = {}
+    for rel, line, kind, name in regs:
+        where = (rel, line)
+        if not _SNAKE_RE.match(name):
+            err(where, f"{name!r} is not snake_case")
+            continue
+        if kind == "counter" and not name.endswith("_total"):
+            err(where, f"counter {name!r} must end in '_total'")
+        if kind != "counter" and name.endswith("_total"):
+            err(where, f"{kind} {name!r}: '_total' is reserved for "
+                       f"counters")
+        base = name[:-len("_total")] if name.endswith("_total") else name
+        for suf in _BANNED_SUFFIXES:
+            if base.endswith(suf):
+                err(where, f"{name!r} uses a non-base unit {suf!r}; "
+                           f"use '_seconds' / '_bytes'")
+        for unit in ("seconds", "bytes"):
+            if unit in base.split("_") and not base.endswith(unit):
+                err(where, f"{name!r}: unit '{unit}' must be the "
+                           f"suffix (before '_total' for counters)")
+        seen = kinds.get(name)
+        if seen is None:
+            kinds[name] = (kind, where)
+        elif seen[0] != kind:
+            err(where, f"{name!r} registered as {kind} here but as "
+                       f"{seen[0]} at {seen[1][0]}:{seen[1][1]}")
+    return errors
+
+
+def main() -> int:
+    regs = find_registrations()
+    errors = lint(regs)
+    uniq = sorted({name for _, _, _, name in regs})
+    if errors:
+        for e in errors:
+            print(f"check_metric_names: {e}", file=sys.stderr)
+        print(f"check_metric_names: FAILED — {len(errors)} violation(s) "
+              f"across {len(regs)} registration sites", file=sys.stderr)
+        return 1
+    print(f"check_metric_names: OK — {len(regs)} registration sites, "
+          f"{len(uniq)} metric names, 0 violations")
+    if "--list" in sys.argv:
+        for name in uniq:
+            print(f"  {name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
