@@ -1,0 +1,134 @@
+"""Copy-on-write prefix caching over refcounted KV pages.
+
+Parity intent: vLLM-style automatic prefix caching / the RadixAttention
+idea, mapped onto this repo's paged serving stack (Ragged Paged
+Attention, arXiv:2604.15464: TPU serving throughput hinges on keeping
+KV in reusable pages).  Two requests that share a system prompt should
+neither recompute nor duplicate the shared KV.
+
+Design: a hash table at BLOCK granularity.  For every full page of a
+finished prefill, the engine registers ``hash(prompt[:end]) -> block``
+(the key hashes the whole token prefix up to that page's end, so a hit
+chain is position-exact by construction).  An admitted request walks
+its own prompt's chain; every consecutive hit is shared into its block
+table (``PagedKVCache.share_blocks`` — refcount++) and only the suffix
+is prefilled.  The table holds its own reference on each registered
+page, so cached prefixes survive the request that created them.
+
+Copy-on-write: a hit that covers the WHOLE prompt is capped one token
+short (the last position must be re-run to produce the first sampled
+token), which lands the suffix write mid-page — the engine copies that
+one shared page to a private one (``serving_step.copy_block``) before
+writing.  Aligned hits write only fresh pages and never copy.
+
+Eviction honors refcounts: when the pool runs dry the engine asks for
+reclaim, and only table entries whose page has NO other holder
+(refcount == 1, the table's own) are dropped; a prefix page some live
+request still addresses is never recycled from under it.  Entries are
+dropped oldest-touch first (LRU); evicting a chain's parent merely
+makes longer entries unreachable for matching — they stay individually
+evictable.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+__all__ = ["PrefixPageCache"]
+
+
+def _prefix_key(prompt_ids: np.ndarray, end: int) -> bytes:
+    return hashlib.blake2b(
+        np.ascontiguousarray(prompt_ids[:end], dtype=np.int64).tobytes(),
+        digest_size=16).digest()
+
+
+class PrefixPageCache:
+    """Block-granularity prompt-prefix table over one ``PagedKVCache``
+    free-list authority (the engine's layer-0 cache: block ids are
+    shared across layers)."""
+
+    def __init__(self, cache, block_size: int):
+        self.cache = cache
+        self.block_size = block_size
+        self.table: "OrderedDict[bytes, int]" = OrderedDict()
+        self._registered: Set[int] = set()   # block ids the table refs
+        # host-side stats (the engine mirrors these into the metrics
+        # registry; kept here too so benches can read them directly)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+
+    # ---- lookup ---------------------------------------------------------
+    def match(self, prompt_ids: np.ndarray) -> List[int]:
+        """Longest consecutive chain of cached full-page prefixes of
+        ``prompt_ids``.  Side-effect free except LRU touch; the caller
+        decides whether to commit (share_blocks) the hit."""
+        bs = self.block_size
+        prompt_ids = np.asarray(prompt_ids)
+        blocks: List[int] = []
+        for i in range(len(prompt_ids) // bs):
+            key = _prefix_key(prompt_ids, (i + 1) * bs)
+            b = self.table.get(key)
+            if b is None:
+                break
+            self.table.move_to_end(key)
+            blocks.append(b)
+        return blocks
+
+    # ---- registration ---------------------------------------------------
+    def register(self, prompt_ids: np.ndarray, block_ids: List[int]):
+        """Publish a freshly prefilled prompt's FULL pages.  Keys already
+        present keep their existing page (first writer wins); the table
+        takes its own reference on each newly published page."""
+        bs = self.block_size
+        prompt_ids = np.asarray(prompt_ids)
+        for i in range(len(prompt_ids) // bs):
+            if i >= len(block_ids):
+                break
+            b = int(block_ids[i])
+            key = _prefix_key(prompt_ids, (i + 1) * bs)
+            if key in self.table or b in self._registered:
+                continue
+            self.cache.share_blocks([b])
+            self.table[key] = b
+            self._registered.add(b)
+            self.table.move_to_end(key)
+
+    # ---- eviction -------------------------------------------------------
+    def evictable_count(self, exclude: Optional[Set[int]] = None) -> int:
+        """Pages reclaimable right now: table entries no live request
+        holds (refcount == 1 — the table's own reference)."""
+        exclude = exclude or set()
+        return sum(1 for b in self.table.values()
+                   if b not in exclude and self.cache.refcount(b) == 1)
+
+    def evict(self, n: int = 1) -> int:
+        """Drop up to ``n`` LRU entries whose page has no other holder,
+        returning their pages to the free list.  Entries whose page is
+        still shared with a live request are SKIPPED (never reclaimed
+        from under a block table)."""
+        freed = 0
+        for key in list(self.table.keys()):
+            if freed >= n:
+                break
+            b = self.table[key]
+            if self.cache.refcount(b) != 1:
+                continue
+            del self.table[key]
+            self._registered.discard(b)
+            self.cache.free_sequence([b])
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    # ---- introspection --------------------------------------------------
+    def cached_blocks(self) -> Set[int]:
+        return set(self.table.values())
+
+    def __len__(self) -> int:
+        return len(self.table)

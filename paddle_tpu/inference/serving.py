@@ -14,13 +14,31 @@ pools donated so the append is an in-place HBM write.  Inactive slots
 are masked (token 0, seq_len 0, block table aimed at the cache's sink
 page), never dropped, so admission/eviction churn never changes a traced
 shape and the decode step compiles exactly once for the engine's
-lifetime.  Requests are admitted into free slots per step: the prompt is
-prefilled through the model's dense path and its per-layer K/V scattered
-into cache pages in one fused call per request; finished slots release
-their pages immediately, making room for waiting requests mid-flight.
+lifetime.
+
+Prefill (Ragged Paged Attention, arXiv:2604.15464: mixed-length prefill
+without per-shape recompilation) has three coordinated layers:
+
+- **Bucketed**: with ``prefill_buckets`` set, prompts pad to a small
+  geometric set of length buckets and admission runs ONE compiled
+  ``PrefillStep`` per bucket (masked forward + fused page scatter +
+  on-device first-token sample), so total prefill compiles are bounded
+  by the bucket count instead of the prompt-length distribution.
+- **Chunked**: prompts longer than ``prefill_chunk_size`` split into
+  fixed-size chunks processed one per ``step()`` interleaved with
+  decode, so a long prompt never stalls every running request's TPOT.
+  Chunk offset is a traced scalar — chunks reuse the bucket compiles.
+- **Prefix cached** (``enable_prefix_cache``): refcounted KV pages plus
+  a block-granularity prompt-prefix hash table
+  (inference/prefix_cache.PrefixPageCache); an admitted request whose
+  prefix hits shares those pages (refcount++, copy-on-write on the
+  first partial page) and only prefills the suffix.  Eviction honors
+  refcounts — a shared page is never reclaimed from under a live
+  request's block table.
+
 Admission/eviction is host control flow; all math is jitted device
 compute, and the only per-step host traffic is the [slots] int32
-next-token fetch.
+next-token fetch (plus one int32 scalar per prefill chunk).
 """
 from __future__ import annotations
 
@@ -44,7 +62,7 @@ class GenerationRequest:
     max_new_tokens: int = 16
     eos_token_id: Optional[int] = None
     output_ids: List[int] = field(default_factory=list)
-    state: str = "waiting"                 # waiting -> running -> done
+    state: str = "waiting"        # waiting -> [prefilling ->] running -> done
     # True when the engine ran out of KV pages mid-decode and finished
     # this request early instead of wedging the whole batch
     truncated: bool = False
@@ -53,6 +71,11 @@ class GenerationRequest:
     slot: int = -1
     seq_len: int = 0
     block_ids: List[int] = field(default_factory=list)
+    # chunked-prefill progress: prompt tokens already in cache pages
+    # (starts at the prefix-cache hit length)
+    prefill_pos: int = 0
+    # prompt tokens served from shared prefix pages instead of recompute
+    prefix_hit_tokens: int = 0
     # telemetry marks (perf_counter): admission -> first token = TTFT,
     # first token -> done over n-1 tokens = TPOT
     t_submit: float = 0.0
@@ -72,14 +95,24 @@ class ContinuousBatchingEngine:
     block-table width (the compiled decode step's shape); it defaults to
     the pool's fair share per slot, num_blocks * block_size //
     max_batch_size.
+
+    ``prefill_buckets``: None (default) keeps the legacy dense prefill
+    (one eager forward per prompt, re-traced per distinct length);
+    ``"auto"`` derives a geometric 32/64/.../top set from max_seq_len;
+    a tuple uses those widths.  ``prefill_chunk_size`` defaults to the
+    top bucket.  ``enable_prefix_cache`` requires buckets (suffix-only
+    prefill needs the offset-carrying compiled step).
     """
 
     def __init__(self, model, max_batch_size: int = 8,
                  num_blocks: int = 256, block_size: int = 16,
                  max_seq_len: Optional[int] = None,
                  use_pallas: Optional[bool] = None,
-                 lazy_alloc: bool = False):
-        from ..jit.serving_step import DecodeStep
+                 lazy_alloc: bool = False,
+                 prefill_buckets=None,
+                 prefill_chunk_size: Optional[int] = None,
+                 enable_prefix_cache: bool = False):
+        from ..jit.serving_step import DecodeStep, PrefillStep
         self.model = model
         # lazy_alloc: pages are allocated as a sequence actually grows
         # instead of reserving the full prompt+budget footprint at
@@ -120,6 +153,39 @@ class ContinuousBatchingEngine:
         self.decode_step = DecodeStep(model, self.caches,
                                       use_pallas=use_pallas)
 
+        # ---- bucketed / chunked prefill ------------------------------
+        if prefill_buckets == "auto":
+            buckets = self._auto_buckets(self.max_seq_len)
+        elif prefill_buckets:
+            buckets = tuple(sorted({int(b) for b in prefill_buckets}))
+        else:
+            buckets = None
+        self.prefill_buckets = buckets
+        if buckets:
+            self.chunk_size = int(prefill_chunk_size or buckets[-1])
+            if self.chunk_size > buckets[-1]:
+                raise ValueError(
+                    "prefill_chunk_size %d exceeds the top bucket %d — "
+                    "every chunk must map to a compiled bucket"
+                    % (self.chunk_size, buckets[-1]))
+            self.prefill_step = PrefillStep(model, self.caches,
+                                            self.bt_width)
+        else:
+            self.chunk_size = None
+            self.prefill_step = None
+        if enable_prefix_cache:
+            if not buckets:
+                raise ValueError(
+                    "enable_prefix_cache requires bucketed prefill "
+                    "(pass prefill_buckets='auto' or a tuple): suffix-"
+                    "only prefill runs through the offset-carrying "
+                    "compiled PrefillStep")
+            from .prefix_cache import PrefixPageCache
+            self.prefix_cache = PrefixPageCache(self.caches[0], block_size)
+        else:
+            self.prefix_cache = None
+        self._chunk_rr = 0           # round-robin cursor over chunk work
+
         from ..observability import default_registry
         r = default_registry()
         self._m_queue = r.gauge(
@@ -132,7 +198,8 @@ class ContinuousBatchingEngine:
             "allocated KV pages / pool size")
         self._m_prefill = r.histogram(
             "serving_prefill_duration_seconds",
-            "prompt prefill (dense forward + fused cache scatter)")
+            "prompt prefill (bucketed compiled chunk, or the legacy "
+            "dense forward + fused cache scatter)")
         self._m_decode = r.histogram(
             "serving_decode_step_duration_seconds",
             "one fused batched decode step (all slots)")
@@ -153,11 +220,48 @@ class ContinuousBatchingEngine:
             "serving_truncated_victims_total",
             "requests finished early because the KV pool ran dry "
             "(lazy_alloc victim contract)")
-        # compile warmup never lands in a latency histogram: the first
-        # decode call traces the fused step; the dense prefill path
-        # re-traces PER PROMPT LENGTH, so warmth is per-length
+        self._m_prefill_compiles = r.counter(
+            "serving_prefill_compiles_total",
+            "bucketed PrefillStep traces (bounded by the bucket count)")
+        self._m_prefix_lookups = r.counter(
+            "serving_prefix_cache_lookups_total",
+            "prompt admissions checked against the prefix table",
+            labels=("outcome",))
+        self._m_prefix_hit_tokens = r.counter(
+            "serving_prefix_cache_hit_tokens_total",
+            "prompt tokens served from shared prefix pages instead of "
+            "recompute")
+        self._m_prefix_evictions = r.counter(
+            "serving_prefix_cache_evictions_total",
+            "prefix table entries reclaimed under pool pressure")
+        self._m_chunk_queue = r.gauge(
+            "serving_prefill_chunk_queue_depth",
+            "prefill chunks still pending across admitted requests")
+        # compile warmup never lands in a latency histogram.  Bucketed
+        # prefill tracks warmth PER BUCKET via the step's own compile
+        # counters (a call that traced is cold, everything else is warm
+        # — chunk offset and raw prompt length don't retrace).  The
+        # legacy dense path re-traces per prompt length, so its warmth
+        # stays per-length.
         self._prefill_warm_lens = set()
         self._decode_warm = False
+
+    @staticmethod
+    def _auto_buckets(max_seq_len: int):
+        """Geometric 32/64/.../top, top = pow2 ceil of max_seq_len
+        capped at 512 (longer prompts prefill in chunks of the top
+        bucket)."""
+        top = 1
+        while top < max_seq_len:
+            top *= 2
+        top = min(top, 512)
+        out = []
+        b = 32
+        while b < top:
+            out.append(b)
+            b *= 2
+        out.append(top)
+        return tuple(sorted({x for x in out if x <= top}))
 
     # ---- public API ----------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16,
@@ -193,9 +297,11 @@ class ContinuousBatchingEngine:
                                          for s in self.slots)
 
     def step(self) -> List[int]:
-        """Admit waiting requests, decode one token for every running
-        slot.  Returns req_ids finished this step."""
+        """Admit waiting requests, advance at most one prefill chunk,
+        decode one token for every running slot.  Returns req_ids
+        finished this step."""
         self._admit()
+        self._prefill_chunks()
         done = self._decode_batch()
         self._m_queue.set(len(self.waiting))
         self._m_occupancy.set(
@@ -204,6 +310,8 @@ class ContinuousBatchingEngine:
         cache = self.caches[0]
         self._m_kv_util.set(
             1.0 - len(cache._free) / max(1, cache.num_blocks))
+        if self.prefill_step is not None:
+            self._m_chunk_queue.set(self._pending_chunks())
         return done
 
     def run_to_completion(self) -> Dict[int, List[int]]:
@@ -214,25 +322,114 @@ class ContinuousBatchingEngine:
     def result(self, req_id: int) -> List[int]:
         return self.finished[req_id].output_ids
 
+    # ---- page allocation ------------------------------------------------
+    def _try_alloc(self) -> Optional[int]:
+        """Pop a free page, reclaiming unreferenced prefix-cache pages
+        under pressure (eviction honors refcounts: only table entries
+        no live request holds are dropped)."""
+        c = self.caches[0]
+        if not c._free and self.prefix_cache is not None:
+            freed = self.prefix_cache.evict(1)
+            if freed:
+                self._m_prefix_evictions.inc(freed)
+        if not c._free:
+            return None
+        return c.allocate_block()
+
+    def _alloc_block(self) -> int:
+        blk = self._try_alloc()
+        if blk is None:
+            raise RuntimeError(
+                "PagedKVCache out of blocks (%d in pool) and nothing "
+                "evictable" % self.caches[0].num_blocks)
+        return blk
+
+    def _row_for(self, req: GenerationRequest) -> np.ndarray:
+        row = np.full((1, self.bt_width), self._sink, np.int32)
+        row[0, :len(req.block_ids)] = req.block_ids
+        return row
+
     # ---- admission (prefill) -------------------------------------------
     def _admit(self):
         for i in range(self.max_batch_size):
             if not self.waiting or self.slots[i] is not None:
                 continue
-            req = self.waiting[0]
-            L = len(req.prompt_ids)
-            need = (self.caches[0].blocks_needed(L + 1) if self.lazy_alloc
-                    else self.caches[0].blocks_needed(
-                        L + req.max_new_tokens))
-            if len(self.caches[0]._free) < need:
-                break                       # no room yet: keep waiting
+            if not self._try_admit(self.waiting[0], i):
+                break                   # no room yet: keep waiting (FIFO)
             self.waiting.pop(0)
-            self._prefill(req, i)
 
-    def _prefill(self, req: GenerationRequest, slot: int):
-        """Run the prompt through the model's dense path once, scatter
-        the per-layer K/V into cache pages with ONE fused call, sample
-        the first token."""
+    def _try_admit(self, req: GenerationRequest, slot: int) -> bool:
+        """Match the prompt against the prefix cache, reserve pages,
+        and start (or finish) the suffix prefill.  Returns False —
+        with NO side effects — when the pool cannot cover the request
+        yet."""
+        cache = self.caches[0]
+        L = len(req.prompt_ids)
+        matched: List[int] = []
+        hit_len = 0
+        cow = False
+        if self.prefix_cache is not None:
+            matched = self.prefix_cache.match(req.prompt_ids)
+            hit_len = len(matched) * self.block_size
+            if matched and hit_len >= L:
+                # whole-prompt hit: re-run the last position to sample
+                # the first token — the suffix write lands mid-page in
+                # the final shared block, which therefore needs a
+                # private copy (copy-on-write on the first partial page)
+                hit_len = L - 1
+                cow = True
+        total_need = cache.blocks_needed(
+            L + (1 if self.lazy_alloc else req.max_new_tokens))
+        new_needed = total_need - len(matched) + (1 if cow else 0)
+        available = len(cache._free)
+        if self.prefix_cache is not None:
+            available += self.prefix_cache.evictable_count(
+                exclude=set(matched))
+        if new_needed > available:
+            return False
+
+        # ---- commit ---------------------------------------------------
+        if self.prefix_cache is not None:
+            outcome = "hit" if matched else "miss"
+            self._m_prefix_lookups.labels(outcome=outcome).inc()
+            if matched:
+                self.prefix_cache.hits += 1
+                self.prefix_cache.hit_tokens += hit_len
+                self._m_prefix_hit_tokens.inc(hit_len)
+            else:
+                self.prefix_cache.misses += 1
+        cache.share_blocks(matched)
+        req.block_ids = list(matched)
+        if cow:
+            from ..jit.serving_step import copy_block
+            src = req.block_ids[-1]
+            dst = self._alloc_block()
+            copy_block(self.caches, src, dst)
+            cache.free_sequence([src])      # drop this request's share
+            req.block_ids[-1] = dst
+        while len(req.block_ids) < total_need:
+            req.block_ids.append(self._alloc_block())
+        req.prefill_pos = hit_len
+        req.prefix_hit_tokens = hit_len
+        req.slot = slot
+        req.state = "prefilling"
+        self.slots[slot] = req
+        if self.prefill_step is None:
+            self._prefill_dense(req)
+        elif L - hit_len <= self.chunk_size:
+            # suffix fits one bucket: prefill at admission (short
+            # prompts keep the old admit-then-decode-same-step timing)
+            self._prefill_chunk(req)
+        # else: long suffix — chunks advance one per step() interleaved
+        # with decode (_prefill_chunks)
+        return True
+
+    # ---- legacy dense prefill (prefill_buckets=None) --------------------
+    def _prefill_dense(self, req: GenerationRequest):
+        """Run the whole prompt through the model's dense path once,
+        scatter the per-layer K/V into cache pages with ONE fused call,
+        sample the first token.  Re-traces per distinct prompt length —
+        the bucketed path exists to bound exactly that."""
         import paddle_tpu as paddle
         from ..autograd.tape import no_grad
         from ..jit.serving_step import prefill_scatter
@@ -242,54 +439,113 @@ class ContinuousBatchingEngine:
         with no_grad():
             logits, kv = self.model.forward(
                 ids, caches=[(None, None)] * self.cfg.num_hidden_layers)
-        # allocate pages covering prompt + generation budget up front
-        # (lazy mode: prompt + the first decode position only; the rest
-        # are grown page-by-page in _decode_batch).  Pools share the
-        # free-list of cache 0 so one table serves every layer.
-        n_blocks = (self.caches[0].blocks_needed(L + 1) if self.lazy_alloc
-                    else self.caches[0].blocks_needed(
-                        L + req.max_new_tokens))
-        req.block_ids = [self.caches[0].allocate_block()
-                         for _ in range(n_blocks)]
-        row = np.full((1, self.bt_width), self._sink, np.int32)
-        row[0, :n_blocks] = req.block_ids
+        row = self._row_for(req)
         # k/v [1, L, Hkv, D] pre-GQA-repeat — one donated scatter over
         # ALL layers (not a Python loop of per-layer dispatches)
         prefill_scatter(self.caches, kv, row)
-        req.slot = slot
-        req.seq_len = L
-        req.state = "running"
-        self.slots[slot] = req
-        last = np.asarray(logits[:, -1, :]._value, np.float32)
-        first = int(last[0].argmax())
+        # first-token sample: argmax of the last position ON DEVICE —
+        # only one int32 scalar crosses the host link, never the
+        # [1, V] (let alone [1, L, V]) logits
+        first = int(jnp.argmax(
+            logits._value[0, -1, :].astype(jnp.float32)))
         if L in self._prefill_warm_lens:
             self._m_prefill.observe(time.perf_counter() - t_prefill)
         self._prefill_warm_lens.add(L)
+        req.prefill_pos = L
+        self._complete_prefill(req, first, row)
+
+    # ---- bucketed / chunked prefill -------------------------------------
+    def _bucket_for(self, size: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= size:
+                return b
+        raise AssertionError(
+            "chunk of %d tokens exceeds the top bucket %d"
+            % (size, self.prefill_buckets[-1]))
+
+    def _pending_chunks(self) -> int:
+        n = 0
+        for r in self.slots:
+            if r is not None and r.state == "prefilling":
+                rem = len(r.prompt_ids) - r.prefill_pos
+                n += -(-rem // self.chunk_size)
+        return n
+
+    def _prefill_chunks(self):
+        """Advance AT MOST one pending prefill chunk (round-robin over
+        slots): a long prompt pays its prefill one chunk per engine
+        step, interleaved with decode, instead of stalling every
+        running request's TPOT for its whole length."""
+        if self.prefill_step is None:
+            return
+        n = self.max_batch_size
+        for k in range(n):
+            i = (self._chunk_rr + k) % n
+            r = self.slots[i]
+            if r is not None and r.state == "prefilling":
+                self._prefill_chunk(r)
+                self._chunk_rr = (i + 1) % n
+                return
+
+    def _prefill_chunk(self, req: GenerationRequest):
+        """Run one bucket-padded chunk through the compiled PrefillStep;
+        on the final chunk, complete admission with the on-device
+        sampled first token."""
+        L = len(req.prompt_ids)
+        start = req.prefill_pos
+        size = min(self.chunk_size, L - start)
+        bucket = self._bucket_for(size)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :size] = req.prompt_ids[start:start + size]
+        row = self._row_for(req)
+        t0 = time.perf_counter()
+        pre = self.prefill_step.total_compiles
+        first = self.prefill_step(toks, start, size, row)
+        traced = self.prefill_step.total_compiles - pre
+        if traced:
+            # first compile of this bucket: count it, keep the warmup
+            # out of the latency histogram
+            self._m_prefill_compiles.inc(traced)
+        else:
+            self._m_prefill.observe(time.perf_counter() - t0)
+        req.prefill_pos += size
+        if req.prefill_pos >= L:
+            self._complete_prefill(req, first, row)
+
+    def _complete_prefill(self, req: GenerationRequest, first: int,
+                          row: np.ndarray):
+        slot = req.slot
+        req.seq_len = len(req.prompt_ids)
+        req.state = "running"
+        if self.prefix_cache is not None:
+            # publish this prompt's full pages for future admissions
+            self.prefix_cache.register(req.prompt_ids, req.block_ids)
         self._append_token(req, first)
         if self.slots[slot] is req:         # still running after budget
             self._tokens[slot] = first
-            self._seq_lens[slot] = L
+            self._seq_lens[slot] = req.seq_len
             self._bt[slot] = row[0]
 
     # ---- batched decode -------------------------------------------------
     def _grow_pages(self) -> List[int]:
         """Lazy mode: before the fused step runs, every running slot
         must own a real page for the position it writes this step
-        (seq_len).  A slot that needs a page the pool cannot supply is
-        the VICTIM: it is finished early with ``truncated=True`` — its
-        pages return to the pool (often unblocking the others) and the
-        batch keeps decoding.  step() never raises for pool exhaustion."""
+        (seq_len).  A slot that needs a page neither the pool nor
+        prefix-cache eviction can supply is the VICTIM: it is finished
+        early with ``truncated=True`` — its pages return to the pool
+        (often unblocking the others) and the batch keeps decoding.
+        step() never raises for pool exhaustion."""
         truncated = []
         for i, r in enumerate(list(self.slots)):
-            if r is None:
+            if r is None or r.state != "running":
                 continue
             need = self.caches[0].blocks_needed(r.seq_len + 1)
             grew = True
             while len(r.block_ids) < need:
-                if not self.caches[0]._free:
+                blk = self._try_alloc()
+                if blk is None:
                     grew = False
                     break
-                blk = self.caches[0].allocate_block()
                 self._bt[i, len(r.block_ids)] = blk
                 r.block_ids.append(blk)
             if not grew:
@@ -301,10 +557,12 @@ class ContinuousBatchingEngine:
 
     def _decode_batch(self) -> List[int]:
         done = self._grow_pages() if self.lazy_alloc else []
-        if all(r is None for r in self.slots):
+        if not any(r is not None and r.state == "running"
+                   for r in self.slots):
             return done
-        # ONE fused XLA call at the fixed slot count; masked slots ride
-        # along (their writes hit the sink page, their token is ignored)
+        # ONE fused XLA call at the fixed slot count; masked slots
+        # (empty OR still prefilling) ride along — their writes hit the
+        # sink page, their token is ignored
         t_decode = time.perf_counter()
         # DecodeStep returns np.asarray(...) — the host fetch inside
         # the call is the device barrier, so this window is honest
@@ -313,7 +571,7 @@ class ContinuousBatchingEngine:
             self._m_decode.observe(time.perf_counter() - t_decode)
         self._decode_warm = True
         for i, r in enumerate(list(self.slots)):
-            if r is None:
+            if r is None or r.state != "running":
                 continue
             r.seq_len += 1
             self._seq_lens[i] += 1
@@ -352,6 +610,8 @@ class ContinuousBatchingEngine:
             self._tokens[s] = 0
             self._seq_lens[s] = 0
             self._bt[s, :] = self._sink
+        # the SINGLE release path: refcounted — pages shared with the
+        # prefix table or another live request survive this drop
         self.caches[0].free_sequence(req.block_ids)
         req.block_ids = []
         self.finished[req.req_id] = req

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["DecodeStep", "prefill_scatter"]
+__all__ = ["DecodeStep", "PrefillStep", "prefill_scatter", "copy_block"]
 
 
 def _prefill_scatter_impl(ks, vs, kcs, vcs, block_tables, start):
@@ -75,6 +75,156 @@ def prefill_scatter(caches, kv, block_table_row):
     for c, kc, vc in zip(caches, new_k, new_v):
         c.key_cache = kc
         c.value_cache = vc
+
+
+def _copy_block_impl(kcs, vcs, src, dst):
+    return (tuple(kc.at[dst].set(kc[src]) for kc in kcs),
+            tuple(vc.at[dst].set(vc[src]) for vc in vcs))
+
+
+# copy-on-write for a shared prefix page: ONE donated dispatch copies the
+# page across every layer's pool; src/dst are traced scalars (no
+# recompile per page id)
+_copy_block_j = jax.jit(_copy_block_impl, donate_argnums=(0, 1))
+
+
+def copy_block(caches, src: int, dst: int):
+    """Copy physical page ``src`` to ``dst`` in every layer's K/V pool
+    (rebinds the PagedKVCache arrays in place)."""
+    kcs = tuple(c.key_cache for c in caches)
+    vcs = tuple(c.value_cache for c in caches)
+    new_k, new_v = _copy_block_j(kcs, vcs, jnp.asarray(src, jnp.int32),
+                                 jnp.asarray(dst, jnp.int32))
+    for c, kc, vc in zip(caches, new_k, new_v):
+        c.key_cache = kc
+        c.value_cache = vc
+
+
+class PrefillStep:
+    """Bucketed/chunked prefill compiled into one donated XLA module per
+    LENGTH BUCKET — the prefill analog of ``DecodeStep``.
+
+    ``__call__(tokens, start, n_valid, block_table_row)`` runs one
+    padded chunk of a prompt: embeds the [1, C] bucket-padded token
+    block, and per layer projects, applies RoPE at global positions
+    ``start + i``, scatters the chunk's K/V into cache pages (padding
+    routed to the sink page), and attends causally over everything
+    cached so far (earlier chunks / shared prefix pages included).  The
+    final hidden state is sliced to the LAST VALID position before the
+    LM head — the [C, V] logits block is never materialized — and the
+    next token is sampled (greedy) on device, so the step's only host
+    traffic is one int32 scalar.
+
+    Shape policy: chunk offset (``start``) and fill level (``n_valid``)
+    are traced scalars, so total prefill compiles are bounded by the
+    BUCKET COUNT — not the prompt-length distribution, not the chunk
+    position, not the prefix-hit split.  ``compile_counts`` maps bucket
+    width -> trace count (tests and the bench gate on it).
+    """
+
+    def __init__(self, model, caches: List, bt_width: int):
+        self.model = model
+        self.caches = caches
+        self.cfg = model.config
+        self.bt_width = bt_width
+        self.sink = caches[0].sink
+        if self.sink < 0:
+            raise ValueError("PrefillStep needs a sink page "
+                             "(PagedKVCache(sink_block=True)) to mask "
+                             "bucket padding writes")
+        self._param_tensors = dict(model.state_dict())
+        self._fns = {}                 # bucket width -> jitted step
+        self.compile_counts = {}       # bucket width -> trace count
+
+    @property
+    def total_compiles(self) -> int:
+        return sum(self.compile_counts.values())
+
+    def _build(self, C: int):
+        from ..autograd.tape import no_grad
+        from ..incubate.nn.functional import \
+            fused_rotary_position_embedding
+        from ..ops.paged_attention import (chunk_prefill_attention,
+                                           write_chunk_kv)
+        model = self.model
+        cfg = self.cfg
+        llama = model.llama
+        H = cfg.num_attention_heads
+        Hkv = cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        scale = 1.0 / math.sqrt(D)
+        sink = self.sink
+
+        def step(params, tokens, start, n_valid, bt, kcs, vcs):
+            self.compile_counts[C] = self.compile_counts.get(C, 0) + 1
+            new_kcs, new_vcs = [], []
+            with model.bind_state(params), no_grad():
+                x = llama.embed_tokens(Tensor._from_value(tokens))
+                if cfg.dtype == "bfloat16":
+                    x = x.astype("bfloat16")
+                pos = start + jnp.arange(C, dtype=jnp.int32)
+                pos_t = Tensor._from_value(pos[None, :])     # [1, C]
+                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                    h = layer.input_layernorm(x)
+                    attn = layer.self_attn
+                    q = attn.q_proj(h).reshape([1, C, H, D])
+                    k = attn.k_proj(h).reshape([1, C, Hkv, D])
+                    v = attn.v_proj(h).reshape([1, C, Hkv, D])
+                    q, k, _ = fused_rotary_position_embedding(
+                        q, k, position_ids=pos_t,
+                        rotary_emb_base=cfg.rope_theta)
+                    kc, vc = write_chunk_kv(
+                        k._value, v._value, kc, vc, bt, start, n_valid,
+                        sink)
+                    new_kcs.append(kc)
+                    new_vcs.append(vc)
+                    out = chunk_prefill_attention(
+                        q._value, kc, vc, bt, start, scale)
+                    out = Tensor._from_value(out.reshape(1, C, H * D))
+                    x = x + attn.o_proj(out)
+                    h2 = layer.post_attention_layernorm(x)
+                    x = x + layer.mlp(h2)
+                x = llama.norm(x)
+                # only the last VALID position reaches the LM head:
+                # [1, 1, h] @ [h, V], never the [C, V] logits block
+                last = jax.lax.dynamic_slice_in_dim(
+                    x._value, n_valid - 1, 1, axis=1)
+                last = Tensor._from_value(last)
+                if model.lm_head is None:
+                    from ..ops.linalg import matmul
+                    logits = matmul(last, llama.embed_tokens.weight,
+                                    transpose_y=True)
+                else:
+                    logits = model.lm_head(last)
+            nxt = jnp.argmax(
+                logits._value[0, 0].astype(jnp.float32)).astype(jnp.int32)
+            return nxt, tuple(new_kcs), tuple(new_vcs)
+
+        return jax.jit(step, donate_argnums=(5, 6))
+
+    def __call__(self, tokens, start: int, n_valid: int,
+                 block_table_row) -> int:
+        """tokens: [1, C] int32 bucket-padded; returns the greedy next
+        token after position start+n_valid-1 (meaningful on the final
+        chunk; earlier chunks' samples are discarded by the engine)."""
+        C = int(np.asarray(tokens).shape[1])
+        fn = self._fns.get(C)
+        if fn is None:
+            fn = self._fns[C] = self._build(C)
+        params = {k: t._value for k, t in self._param_tensors.items()}
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        nxt, new_kcs, new_vcs = fn(
+            params,
+            jnp.asarray(np.asarray(tokens, np.int32)),
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(np.asarray(block_table_row), jnp.int32),
+            kcs, vcs)
+        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
+            c.key_cache = kc
+            c.value_cache = vc
+        return int(nxt)
 
 
 class DecodeStep:

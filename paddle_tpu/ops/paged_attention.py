@@ -37,7 +37,8 @@ except Exception:  # pragma: no cover
 from ..core.tensor import Tensor
 
 __all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
-           "write_decode_kv", "write_prefill_kv",
+           "write_decode_kv", "write_prefill_kv", "write_chunk_kv",
+           "chunk_prefill_attention",
            "reconstruct_kv", "block_multihead_attention",
            "masked_multihead_attention"]
 
@@ -56,6 +57,16 @@ class PagedKVCache:
     One instance serves one transformer layer.  Arrays are jax arrays so
     updates stay on device; the free list is host state (allocation is
     control flow, not compute).
+
+    Pages are REFCOUNTED: ``allocate_block`` hands out a page with one
+    reference, ``share_blocks`` adds references (prefix caching — two
+    requests whose prompts share a prefix address the same physical
+    pages), and ``free_sequence`` is the single release path: it drops
+    one reference per page and only returns a page to the free list
+    when its count reaches zero.  A page shared by a prefix-cache table
+    or another live request's block table therefore survives any one
+    holder finishing (including pool-dry victim truncation and
+    lazy-alloc growth — both funnel through ``free_sequence``).
     """
 
     def __init__(self, num_blocks: int, block_size: int, num_kv_heads: int,
@@ -75,18 +86,45 @@ class PagedKVCache:
         self.key_cache = jnp.zeros(shape, dtype)
         self.value_cache = jnp.zeros(shape, dtype)
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._ref: dict = {}            # block id -> live reference count
 
     def allocate_block(self) -> int:
         if not self._free:
             raise RuntimeError(
                 "PagedKVCache out of blocks (%d in pool); raise num_blocks "
                 "or free finished sequences" % self.num_blocks)
-        return self._free.pop()
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    def share_blocks(self, block_ids):
+        """Add one reference to each page (prefix sharing)."""
+        for b in block_ids:
+            b = int(b)
+            if b < 0 or b == self.sink:
+                continue
+            if b not in self._ref:
+                raise RuntimeError(
+                    "share_blocks(%d): page is not allocated" % b)
+            self._ref[b] += 1
+
+    def refcount(self, block_id: int) -> int:
+        return self._ref.get(int(block_id), 0)
 
     def free_sequence(self, block_ids):
+        """Drop one reference per page; recycle pages that hit zero.
+        The ONLY release path — every finish/truncate/evict goes
+        through here, so a shared page is never recycled while another
+        holder's block table still references it."""
         for b in block_ids:
-            if b >= 0:
-                self._free.append(int(b))
+            b = int(b)
+            if b < 0 or b == self.sink:
+                continue
+            n = self._ref.pop(b, 1) - 1
+            if n > 0:
+                self._ref[b] = n
+            else:
+                self._free.append(b)
 
     def blocks_needed(self, seq_len: int) -> int:
         return -(-seq_len // self.block_size)
@@ -175,6 +213,68 @@ _write_prefill_donated = jax.jit(_write_prefill_impl, donate_argnums=(2, 3))
 # fusing the scatter into the surrounding module
 write_decode_kv = _write_decode_impl
 write_prefill_kv = _write_prefill_impl
+
+
+def write_chunk_kv(k_new, v_new, key_cache, value_cache, block_table_row,
+                   start, n_valid, sink):
+    """Scatter one PADDED prefill chunk into cache pages (traceable —
+    composed inside the bucketed ``PrefillStep`` trace).
+
+    k_new/v_new: [1, C, Hkv, D] where C is the bucket width; only the
+    first ``n_valid`` positions carry real tokens.  Position i lands at
+    sequence position ``start + i``; padded positions (i >= n_valid)
+    are routed to the ``sink`` page so one compile per bucket serves
+    every prompt length that rounds up to it without corrupting live
+    pages.  start/n_valid are traced scalars: chunk offset and fill
+    level never retrace.
+    """
+    C = k_new.shape[1]
+    bs = key_cache.shape[1]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = start.astype(jnp.int32) + idx                      # [C]
+    # OOB pos//bs for the padded tail clamps in the gather, then the
+    # where() routes those writes to the sink page anyway
+    blk = block_table_row[0, pos // bs]                      # [C]
+    valid = idx < n_valid
+    blk = jnp.where(valid, blk, jnp.int32(sink))
+    off = jnp.where(valid, pos % bs, 0)
+    key_cache = key_cache.at[blk, off].set(k_new[0])
+    value_cache = value_cache.at[blk, off].set(v_new[0])
+    return key_cache, value_cache
+
+
+def chunk_prefill_attention(q, key_cache, value_cache, block_table_row,
+                            start, scale):
+    """Causal attention for one padded prefill chunk over the paged
+    cache (traceable; the bucketed ``PrefillStep``'s attention body).
+
+    q: [1, C, H, D] — chunk queries at global positions start..start+C-1
+    (the chunk's own K/V must already be written to the pages).  Gathers
+    the row's full page window and masks keys to ``kpos <= qpos``, so
+    chunk offset stays a traced scalar: one compile per bucket covers
+    every chunk position, every prompt length in the bucket, and every
+    prefix-cache suffix offset.  Padded queries produce garbage rows the
+    caller never reads (the sampled token comes from position
+    n_valid-1).
+    """
+    B, C, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bs = key_cache.shape[1]
+    max_len = int(block_table_row.shape[1]) * bs
+    k, v = reconstruct_kv(key_cache, value_cache, block_table_row, max_len)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    kpos = jnp.arange(max_len, dtype=jnp.int32)
+    qpos = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)
+    causal = kpos[None, None, None, :] <= qpos[None, None, :, None]
+    s = jnp.where(causal, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,

@@ -483,6 +483,179 @@ def test_lazy_alloc_truncates_victim_instead_of_wedging_batch():
     assert not eng.finished[r2].truncated
 
 
+# ---------------------------------------------------------------------------
+# bucketed + chunked prefill with prefix caching (ISSUE round-10 tentpole)
+# ---------------------------------------------------------------------------
+def _ref_tokens(model, prompt, budget):
+    out = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0, len(prompt):].tolist()
+
+
+def test_bucketed_and_chunked_prefill_parity_and_compile_bound():
+    """Lengths straddling a bucket boundary (3,4 -> bucket 4; 5 ->
+    bucket 8) plus a prompt longer than the top bucket (10 -> chunks
+    8+2, interleaved with decode) must all match eager generate, with
+    total prefill compiles bounded by the BUCKET count — not the 4
+    distinct prompt lengths — and the decode step still compiling
+    once."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    prompts = [np.array([7, 9, 2], np.int64),            # 3 -> bucket 4
+               np.array([3, 14, 15, 92, 65], np.int64),  # 5 -> bucket 8
+               np.arange(1, 11, dtype=np.int64)]         # 10 -> chunked
+    budgets = [4, 4, 4]
+    want = [_ref_tokens(model, p, n) for p, n in zip(prompts, budgets)]
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=4,
+                                   prefill_buckets=(4, 8))
+    rids = [eng.add_request(p, n) for p, n in zip(prompts, budgets)]
+    eng.run_to_completion()
+    for rid, w in zip(rids, want):
+        assert eng.result(rid) == w
+    assert eng.prefill_step.total_compiles <= len(eng.prefill_buckets)
+    assert eng.decode_step.compile_count == 1
+    # chunk offsets reuse the bucket compile: the len-10 prompt's 8+2
+    # chunks added no trace beyond the two buckets
+    assert set(eng.prefill_step.compile_counts) <= {4, 8}
+    assert all(v == 1 for v in eng.prefill_step.compile_counts.values())
+
+
+def test_prefix_cache_cow_refcounts_and_leak_free():
+    """Shared prefix: request B reuses A's cached prompt pages and only
+    prefills its suffix; request C (identical prompt) takes the
+    whole-prompt-hit copy-on-write path.  All outputs byte-identical
+    to eager generate; after run_to_completion no page leaks — every
+    page is either free or held exactly once by the prefix table."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    P = np.array([5, 17, 42, 7, 99, 3, 11, 23], np.int64)   # 2 full blocks
+    B = np.concatenate([P, [77, 8]])
+    refA = _ref_tokens(model, P, 4)
+    refB = _ref_tokens(model, B, 4)
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=32, block_size=4,
+                                   prefill_buckets=(4, 8),
+                                   enable_prefix_cache=True)
+    ra = eng.add_request(P, 4)
+    eng.run_to_completion()
+    rb = eng.add_request(B, 4)          # hits both prompt pages of A
+    rc = eng.add_request(P, 4)          # whole-prompt hit -> COW
+    eng.run_to_completion()
+    assert eng.result(ra) == refA
+    assert eng.result(rb) == refB
+    assert eng.result(rc) == refA
+    pc = eng.prefix_cache
+    assert pc.misses == 1 and pc.hits == 2
+    # B reused 8 prefix tokens; C's whole-prompt hit is capped one
+    # short so the last position re-runs to sample the first token
+    assert pc.hit_tokens == 8 + 7
+    assert eng.finished[rb].prefix_hit_tokens == 8
+    assert eng.finished[rc].prefix_hit_tokens == 7
+    # refcount leak check: every page free or table-held exactly once
+    c0 = eng.caches[0]
+    cached = pc.cached_blocks()
+    assert all(c0.refcount(b) == 1 for b in cached)
+    assert len(c0._free) + len(cached) == c0.num_blocks
+    assert len(c0._free) < c0.num_blocks     # prefixes actually cached
+
+
+@pytest.mark.slow
+def test_prefix_eviction_honors_refcounts():
+    """Pool pressure evicts only table entries NO live request holds:
+    a prefix still referenced by a running request's block table
+    survives, and that request's tokens stay byte-identical."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    P = np.array([5, 17, 42, 7, 99, 3, 11, 23], np.int64)
+    Q = np.array([9, 9, 8, 1, 66, 4, 12, 30], np.int64)
+    B = np.concatenate([P, [77, 8]])                       # shares P
+    R = np.arange(2, 34, 2, dtype=np.int64)                # 16 tokens
+    refB = _ref_tokens(model, B, 6)
+    refR = _ref_tokens(model, R, 8)
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=10, block_size=4,
+                                   max_seq_len=24,
+                                   prefill_buckets=(4, 8),
+                                   enable_prefix_cache=True)
+    eng.add_request(P, 2)
+    eng.run_to_completion()              # P's 2 pages cached, ref==1
+    eng.add_request(Q, 2)
+    eng.run_to_completion()              # Q's 2 pages cached, ref==1
+    pc = eng.prefix_cache
+    assert len(pc) == 4
+    rb = eng.add_request(B, 6)           # shares P pages -> ref 2
+    eng.step()
+    assert eng.finished.get(rb) is None  # B still running
+    rr = eng.add_request(R, 8)           # needs 6 pages; free == 4 ->
+    eng.run_to_completion()              # must evict Q's (ref==1) pages
+    assert pc.evictions == 2
+    assert eng.result(rb) == refB        # shared P pages never reclaimed
+    assert eng.result(rr) == refR
+    # P's entries survived (they were shared while pressure hit)
+    assert pc.match(P) != []
+    c0 = eng.caches[0]
+    cached = pc.cached_blocks()
+    assert all(c0.refcount(b) == 1 for b in cached)
+    assert len(c0._free) + len(cached) == c0.num_blocks
+
+
+@pytest.mark.slow
+def test_prefill_bucket_sweep_many_lengths_few_compiles():
+    """Mixed-length sweep across three buckets: 9 distinct prompt
+    lengths, every output parity-exact, prefill compiles == buckets
+    actually used (3), vs one trace per distinct length before."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    rng_ = np.random.RandomState(3)
+    lengths = [2, 3, 4, 5, 7, 9, 11, 13, 16]
+    prompts = [rng_.randint(1, 128, (n,)).astype(np.int64)
+               for n in lengths]
+    want = [_ref_tokens(model, p, 3) for p in prompts]
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=96, block_size=4,
+                                   prefill_buckets=(4, 8, 16))
+    rids = [eng.add_request(p, 3) for p in prompts]
+    eng.run_to_completion()
+    for rid, w in zip(rids, want):
+        assert eng.result(rid) == w
+    assert eng.prefill_step.total_compiles == 3
+    assert eng.decode_step.compile_count == 1
+
+
+@pytest.mark.slow
+def test_concurrent_divergent_suffixes_share_prefix():
+    """Two requests sharing a prefix admitted TOGETHER (second hits the
+    pages the first published), divergent suffixes decoded
+    concurrently — plus a chunked long prompt whose prefix is itself a
+    cache hit.  All byte-identical to solo eager generate."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    P = np.array([5, 17, 42, 7, 99, 3, 11, 23], np.int64)
+    b1 = np.concatenate([P, [77, 8]])                     # 10 -> chunked
+    b2 = np.concatenate([P, [14, 50, 2]])
+    long = np.concatenate(
+        [P, [61, 5, 44, 9, 28, 33, 2, 71, 19, 90]])      # hit + 10-suffix
+    refs = [_ref_tokens(model, p, 5) for p in (b1, b2, long)]
+    eng = ContinuousBatchingEngine(model, max_batch_size=3,
+                                   num_blocks=64, block_size=4,
+                                   prefill_buckets=(4, 8),
+                                   enable_prefix_cache=True)
+    r1 = eng.add_request(b1, 5)         # miss; publishes P's pages
+    eng.run_to_completion()
+    r2 = eng.add_request(b2, 5)         # hit, short suffix
+    r3 = eng.add_request(long, 5)       # hit + CHUNKED suffix (8+2)
+    eng.run_to_completion()             # divergent suffixes concurrent
+    for rid, w in zip((r1, r2, r3), refs):
+        assert eng.result(rid) == w
+    pc = eng.prefix_cache
+    assert pc.hits == 2                 # b2 and the long prompt hit
+    c0 = eng.caches[0]
+    cached = pc.cached_blocks()
+    assert all(c0.refcount(b) == 1 for b in cached)
+    assert len(c0._free) + len(cached) == c0.num_blocks
+
+
 @pytest.mark.slow
 def test_lazy_alloc_matches_eager_when_pool_suffices():
     """Lazy growth is a capacity policy, not a math change: with enough

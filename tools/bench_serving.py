@@ -1,13 +1,23 @@
-"""Serving bench: prefill + decode tokens/s/chip for the continuous-
-batching engine's single-compile decode step (ISSUE round-6 tentpole).
+"""Serving bench: prefill + decode for the continuous-batching engine.
 
-Emits a driver-readable artifact (BENCH_SERVE_r06.json at the repo root,
-or the path in argv[1]) in the BENCH_ATTN_r05.json style: decode
-tokens/s/chip over a slot-occupancy sweep, prefill tokens/s, the decode
-step's compile count (must be 1 across the whole sweep — occupancy is
-masked, never re-shaped), and a correctness gate: engine tokens must be
-byte-identical to the model's eager ``generate`` before any number is
-trusted ("passed").
+Emits a driver-readable artifact (BENCH_SERVE_r10.json at the repo root,
+or the path in argv[1]):
+
+- decode tokens/s/chip over a slot-occupancy sweep for the
+  single-compile decode step (round-6 tentpole; compile count must stay
+  1 across the sweep — occupancy is masked, never re-shaped);
+- bucketed + chunked prefill over a MIXED-LENGTH workload (round-10
+  tentpole): total PrefillStep compiles must be bounded by the bucket
+  count — before bucketing the dense path re-traced once per distinct
+  prompt length — with chunked prompts longer than the top bucket
+  interleaving with decode;
+- copy-on-write prefix caching: shared-prefix TTFT must be strictly
+  better than cold-prefix TTFT at equal prompt length (buckets warmed
+  first, so the split is compute, not compile), plus hit/miss counts.
+
+Every number is parity-gated first: engine tokens must be byte-identical
+to the model's eager ``generate`` on the bucketed, chunked, and
+prefix-hit paths before anything is trusted ("passed").
 
 Model: the 1.1B-param bench config (bench.py's second line) on TPU; the
 tiny llama config on CPU so the artifact schema is CI-checkable.
@@ -19,6 +29,7 @@ per-token serving behavior (the scheduler needs the ids), so wall-clock
 per step IS the served step time.  Run from the repo root.
 """
 import json
+import statistics
 import sys
 import time
 
@@ -54,19 +65,21 @@ def build_model(on_tpu):
     return cfg, model
 
 
-def parity_gate(model, max_abs=0):
-    """Engine output must be byte-identical to eager generate for a
-    staggered 3-request mix before any throughput number is trusted."""
+def _ref(model, prompt, budget):
+    out = model.generate(paddle.to_tensor(prompt[None, :]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0, len(prompt):].tolist()
+
+
+def parity_gate(model):
+    """Default (legacy dense prefill) engine must stay byte-identical to
+    eager generate for a staggered 3-request mix."""
     vocab = model.config.vocab_size
     rng = np.random.RandomState(7)
     prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
                for n in (5, 3, 8)]
     budgets = [6, 8, 5]
-    want = []
-    for p, n in zip(prompts, budgets):
-        out = model.generate(paddle.to_tensor(p[None, :]),
-                             max_new_tokens=n)
-        want.append(np.asarray(out._value)[0, len(p):].tolist())
+    want = [_ref(model, p, n) for p, n in zip(prompts, budgets)]
     eng = ContinuousBatchingEngine(model, max_batch_size=4,
                                    num_blocks=64, block_size=16)
     r0 = eng.add_request(prompts[0], budgets[0])
@@ -75,9 +88,8 @@ def parity_gate(model, max_abs=0):
     eng.step()
     r2 = eng.add_request(prompts[2], budgets[2])
     eng.run_to_completion()
-    ok = (eng.result(r0) == want[0] and eng.result(r1) == want[1]
-          and eng.result(r2) == want[2])
-    return ok
+    return (eng.result(r0) == want[0] and eng.result(r1) == want[1]
+            and eng.result(r2) == want[2])
 
 
 def bench_decode(model, slots, occupancy, prompt_len, warm, steps,
@@ -116,26 +128,133 @@ def bench_decode(model, slots, occupancy, prompt_len, warm, steps,
     }
 
 
+def bench_prefill(model, buckets, block_size, num_blocks, slots,
+                  mixed_lengths, long_len, prefix_len, suffix_len,
+                  budget):
+    """Bucketed/chunked/prefix-cached prefill on one engine.  Returns
+    the artifact section + an all-parity flag."""
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(11)
+    eng = ContinuousBatchingEngine(
+        model, max_batch_size=slots, num_blocks=num_blocks,
+        block_size=block_size, prefill_buckets=buckets,
+        enable_prefix_cache=True)
+    seen_lengths = set()
+
+    # --- mixed-length bucketed workload (warms every bucket) ----------
+    prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+               for n in mixed_lengths]
+    want = [_ref(model, p, budget) for p in prompts]
+    t0 = time.perf_counter()
+    rids = [eng.add_request(p, budget) for p in prompts]
+    eng.run_to_completion()
+    dt_mixed = time.perf_counter() - t0
+    bucketed_ok = all(eng.result(r) == w for r, w in zip(rids, want))
+    seen_lengths |= set(mixed_lengths)
+
+    # --- chunked: one prompt longer than the top bucket ---------------
+    lp = rng.randint(1, vocab, (long_len,)).astype(np.int64)
+    want_lp = _ref(model, lp, budget)
+    rid = eng.add_request(lp, budget)
+    eng.run_to_completion()
+    chunked_ok = eng.result(rid) == want_lp
+    seen_lengths.add(long_len)
+
+    # --- prefix caching: shared vs cold TTFT at equal length ----------
+    P = rng.randint(1, vocab, (prefix_len,)).astype(np.int64)
+    first = np.concatenate(
+        [P, rng.randint(1, vocab, (suffix_len,)).astype(np.int64)])
+    eng.add_request(first, budget)       # publishes P's pages
+    eng.run_to_completion()
+    seen_lengths.add(prefix_len + suffix_len)
+    hit_ttft, miss_ttft, hit_ok = [], [], []
+    for _ in range(3):
+        bp = np.concatenate(
+            [P, rng.randint(1, vocab, (suffix_len,)).astype(np.int64)])
+        want_b = _ref(model, bp, budget)
+        rb = eng.add_request(bp, budget)
+        eng.run_to_completion()
+        hit_ok.append(eng.result(rb) == want_b)
+        r = eng.finished[rb]
+        hit_ttft.append(r.t_first_token - r.t_submit)
+        assert r.prefix_hit_tokens >= prefix_len - block_size, (
+            "expected a prefix hit")
+        cp = rng.randint(1, vocab,
+                         (prefix_len + suffix_len,)).astype(np.int64)
+        rc = eng.add_request(cp, budget)
+        eng.run_to_completion()
+        rr = eng.finished[rc]
+        miss_ttft.append(rr.t_first_token - rr.t_submit)
+    ttft_hit = statistics.median(hit_ttft)
+    ttft_miss = statistics.median(miss_ttft)
+    prefix_ok = all(hit_ok)
+
+    compiles = eng.prefill_step.total_compiles
+    assert compiles <= len(buckets), (
+        "prefill compiled %d times for %d buckets — the bucket bound "
+        "is broken" % (compiles, len(buckets)))
+    assert eng.decode_step.compile_count == 1
+    pc = eng.prefix_cache
+    lookups = pc.hits + pc.misses
+    section = {
+        "buckets": list(buckets),
+        "chunk_size": eng.chunk_size,
+        "distinct_prompt_lengths": len(seen_lengths),
+        "prefill_compile_count": compiles,
+        "compile_bound": len(buckets),
+        "compiles_without_bucketing": len(seen_lengths),
+        "mixed_workload_prefill_tokens_per_sec": round(
+            sum(mixed_lengths) / max(dt_mixed, 1e-9), 1),
+        "parity": {"bucketed": bool(bucketed_ok),
+                   "chunked": bool(chunked_ok),
+                   "prefix_hit": bool(prefix_ok)},
+        "prefix_cache": {
+            "hits": pc.hits, "misses": pc.misses,
+            "hit_rate": round(pc.hits / max(1, lookups), 3),
+            "hit_tokens": pc.hit_tokens,
+            "evictions": pc.evictions,
+            "ttft_hit_s": round(ttft_hit, 6),
+            "ttft_miss_s": round(ttft_miss, 6),
+            "ttft_speedup": round(ttft_miss / max(ttft_hit, 1e-9), 2),
+        },
+    }
+    ok = (bucketed_ok and chunked_ok and prefix_ok
+          and ttft_hit < ttft_miss)
+    print("# prefill: %d compiles for %d distinct lengths (bound %d); "
+          "TTFT hit %.1fms vs miss %.1fms; hit rate %.2f"
+          % (compiles, len(seen_lengths), len(buckets),
+             ttft_hit * 1e3, ttft_miss * 1e3,
+             section["prefix_cache"]["hit_rate"]), file=sys.stderr)
+    return section, ok
+
+
 def main():
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE_r06.json"
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE_r10.json"
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     cfg, model = build_model(on_tpu)
 
     ok = parity_gate(model)
-    print(f"# parity gate vs eager generate: {'OK' if ok else 'FAILED'}",
-          file=sys.stderr)
+    print(f"# parity gate (legacy dense prefill) vs eager generate: "
+          f"{'OK' if ok else 'FAILED'}", file=sys.stderr)
 
     if on_tpu:
         slots, prompt_len = 8, 128
         num_blocks, block_size = 8 * (-(-(128 + 64) // 16) + 2), 16
         occupancies = [1, 2, 4, 8]
         warm, steps = 4, 32
+        pf = dict(buckets=(32, 64, 128, 256), block_size=16,
+                  num_blocks=1024, slots=8,
+                  mixed_lengths=[20, 45, 70, 100, 130, 190, 250, 300],
+                  long_len=600, prefix_len=192, suffix_len=32, budget=8)
     else:
         slots, prompt_len = 4, 12
         num_blocks, block_size = 64, 4
         occupancies = [1, 2, 4]
         warm, steps = 2, 8
+        pf = dict(buckets=(8, 16), block_size=4, num_blocks=192, slots=4,
+                  mixed_lengths=[3, 5, 6, 7, 9, 10, 11, 13],
+                  long_len=36, prefix_len=24, suffix_len=4, budget=4)
 
     sweep = []
     for occ in occupancies:
@@ -147,14 +266,18 @@ def main():
               f"{r['prefill_tokens_per_sec']} tok/s prefill",
               file=sys.stderr)
 
+    prefill_section, prefill_ok = bench_prefill(model, **pf)
+    ok = bool(ok and prefill_ok)
+
     full = sweep[-1]
     artifact = {
         "metric": "serving_decode_tokens_per_sec_per_chip",
         "value": full["decode_tokens_per_sec"],
-        "passed": bool(ok),
+        "passed": ok,
         "prefill_tokens_per_sec": full["prefill_tokens_per_sec"],
         "decode_sweep": sweep,
         "decode_compile_count": 1,
+        "prefill": prefill_section,
         "config": {
             "params_m": round(param_count(cfg) / 1e6),
             "layers": cfg.num_hidden_layers,

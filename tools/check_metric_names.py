@@ -15,7 +15,11 @@ repo's metric-naming contract:
    anywhere else (before ``_total`` for counters) is malformed;
 5. one name, one type: the same name registered as two different kinds
    anywhere in the tree is an error (the runtime registry would also
-   raise, but only when both sites actually execute).
+   raise, but only when both sites actually execute);
+6. required families: the serving engine's contract metrics (the
+   bucketed-prefill/prefix-cache set the round-10 bench gates on) must
+   exist somewhere in the scan — a rename that silently drops one is an
+   error here, not a dashboard surprise.
 
 Pure stdlib + no jax import: safe to run anywhere, exits non-zero with
 one line per violation.
@@ -41,6 +45,19 @@ _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _BANNED_SUFFIXES = ("_ms", "_msec", "_millis", "_us", "_micros", "_ns",
                     "_minutes", "_hours", "_kb", "_mb", "_gb", "_kib",
                     "_mib", "_gib")
+
+# contract metrics external dashboards/benches key on: the serving
+# engine must keep registering these names (see BENCH_SERVE_r10.json
+# provenance; README "Observability" inventory)
+REQUIRED_NAMES = frozenset({
+    "serving_prefill_compiles_total",
+    "serving_prefill_chunk_queue_depth",
+    "serving_prefix_cache_lookups_total",
+    "serving_prefix_cache_hit_tokens_total",
+    "serving_prefix_cache_evictions_total",
+    "serving_prefill_duration_seconds",
+    "serving_ttft_seconds",
+})
 
 
 def find_registrations() -> List[Tuple[str, int, str, str]]:
@@ -103,6 +120,9 @@ def lint(regs) -> List[str]:
         elif seen[0] != kind:
             err(where, f"{name!r} registered as {kind} here but as "
                        f"{seen[0]} at {seen[1][0]}:{seen[1][1]}")
+    for name in sorted(REQUIRED_NAMES - set(kinds)):
+        errors.append(f"<scan>: required metric {name!r} is registered "
+                      f"nowhere under {SCAN}")
     return errors
 
 
