@@ -36,9 +36,21 @@ without per-shape recompilation) has three coordinated layers:
   refcounts — a shared page is never reclaimed from under a live
   request's block table.
 
+**Mixed single-step mode** (``mixed_step=True``) supersedes the
+prefill/decode module split entirely: every engine step packs the whole
+admission mix — each running slot as a length-1 decode span, each
+prefilling slot's next chunk as a length-C span, as many chunks as the
+budget holds — into ONE fused ``MixedStep`` launch over the ragged
+paged attention kernel (arXiv:2604.15464).  Total tokens pad to a small
+geometric budget set, so compiles are bounded by the budget count, long
+prompts no longer pay one engine round per chunk, and prefill never
+stalls running TPOT.  The bucketed PrefillStep and legacy dense paths
+remain for ``mixed_step=False`` (the default — existing engines are
+byte-identical).
+
 Admission/eviction is host control flow; all math is jitted device
 compute, and the only per-step host traffic is the [slots] int32
-next-token fetch (plus one int32 scalar per prefill chunk).
+next-token fetch (plus one int32 scalar per non-mixed prefill chunk).
 """
 from __future__ import annotations
 
@@ -80,6 +92,7 @@ class GenerationRequest:
     # first token -> done over n-1 tokens = TPOT
     t_submit: float = 0.0
     t_first_token: float = 0.0
+    t_done: float = 0.0
 
 
 class ContinuousBatchingEngine:
@@ -100,8 +113,15 @@ class ContinuousBatchingEngine:
     (one eager forward per prompt, re-traced per distinct length);
     ``"auto"`` derives a geometric 32/64/.../top set from max_seq_len;
     a tuple uses those widths.  ``prefill_chunk_size`` defaults to the
-    top bucket.  ``enable_prefix_cache`` requires buckets (suffix-only
-    prefill needs the offset-carrying compiled step).
+    top bucket.  ``enable_prefix_cache`` requires buckets or
+    ``mixed_step`` (suffix-only prefill needs an offset-carrying
+    compiled step).
+
+    ``mixed_step=True`` replaces BOTH the decode module and the
+    prefill buckets with one fused step per total-token budget
+    (``token_budgets``: ``"auto"`` geometric set covering all-decode up
+    to slots+chunk, or an explicit tuple whose top must fit an
+    all-decode pack).  ``prefill_chunk_size`` bounds a single span.
     """
 
     def __init__(self, model, max_batch_size: int = 8,
@@ -111,8 +131,10 @@ class ContinuousBatchingEngine:
                  lazy_alloc: bool = False,
                  prefill_buckets=None,
                  prefill_chunk_size: Optional[int] = None,
-                 enable_prefix_cache: bool = False):
-        from ..jit.serving_step import DecodeStep, PrefillStep
+                 enable_prefix_cache: bool = False,
+                 mixed_step: bool = False,
+                 token_budgets="auto"):
+        from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
         # lazy_alloc: pages are allocated as a sequence actually grows
         # instead of reserving the full prompt+budget footprint at
@@ -173,13 +195,44 @@ class ContinuousBatchingEngine:
         else:
             self.chunk_size = None
             self.prefill_step = None
+        # ---- fused mixed prefill+decode step -------------------------
+        # (Ragged Paged Attention): ONE compiled module per total-token
+        # budget advances decode slots AND prefill chunks together —
+        # no per-chunk engine round, no prefill/decode module split
+        if mixed_step:
+            if self.chunk_size is None:
+                self.chunk_size = int(prefill_chunk_size
+                                      or self._auto_buckets(
+                                          self.max_seq_len)[-1])
+            if token_budgets == "auto":
+                budgets = self._auto_budgets_mixed(max_batch_size,
+                                                   self.chunk_size)
+            else:
+                budgets = tuple(sorted({int(b) for b in token_budgets}))
+                if not budgets or budgets[-1] < max_batch_size:
+                    raise ValueError(
+                        "top token budget %r < max_batch_size %d: an "
+                        "all-decode step would not fit"
+                        % (token_budgets, max_batch_size))
+            self.token_budgets = budgets
+            self.mixed = MixedStep(model, self.caches, self.bt_width,
+                                   max_spans=max_batch_size,
+                                   span_q=min(self.chunk_size,
+                                              budgets[-1]),
+                                   use_pallas=use_pallas)
+            # padding tokens spread over the sink page's slots
+            self._dest_pad = (np.arange(budgets[-1], dtype=np.int32)
+                              % block_size)
+        else:
+            self.token_budgets = None
+            self.mixed = None
         if enable_prefix_cache:
-            if not buckets:
+            if not buckets and self.mixed is None:
                 raise ValueError(
                     "enable_prefix_cache requires bucketed prefill "
-                    "(pass prefill_buckets='auto' or a tuple): suffix-"
-                    "only prefill runs through the offset-carrying "
-                    "compiled PrefillStep")
+                    "(prefill_buckets='auto'/tuple) or mixed_step=True: "
+                    "suffix-only prefill needs an offset-carrying "
+                    "compiled step")
             from .prefix_cache import PrefixPageCache
             self.prefix_cache = PrefixPageCache(self.caches[0], block_size)
         else:
@@ -237,6 +290,20 @@ class ContinuousBatchingEngine:
         self._m_chunk_queue = r.gauge(
             "serving_prefill_chunk_queue_depth",
             "prefill chunks still pending across admitted requests")
+        self._m_mixed_compiles = r.counter(
+            "serving_mixed_step_compiles_total",
+            "fused MixedStep traces (bounded by the token-budget-set "
+            "size)")
+        self._m_mixed_span_tokens = r.counter(
+            "serving_mixed_span_tokens_total",
+            "tokens advanced by the fused mixed step, by span kind",
+            labels=("kind",))
+        # resolve the labeled children ONCE: .labels() is a lock + dict
+        # probe, and the mixed step pays it every engine round
+        self._m_mixed_tok_decode = \
+            self._m_mixed_span_tokens.labels(kind="decode")
+        self._m_mixed_tok_prefill = \
+            self._m_mixed_span_tokens.labels(kind="prefill")
         # compile warmup never lands in a latency histogram.  Bucketed
         # prefill tracks warmth PER BUCKET via the step's own compile
         # counters (a call that traced is cold, everything else is warm
@@ -262,6 +329,21 @@ class ContinuousBatchingEngine:
             b *= 2
         out.append(top)
         return tuple(sorted({x for x in out if x <= top}))
+
+    @staticmethod
+    def _auto_budgets_mixed(slots: int, chunk: int):
+        """Geometric total-token budgets for the mixed step: from the
+        pow2 ceil of the slot count (the all-decode pack) doubling up
+        past slots + chunk (every slot decoding while a full prefill
+        chunk rides along)."""
+        b = 1
+        while b < max(1, slots):
+            b *= 2
+        out = [b]
+        while b < slots + chunk:
+            b *= 2
+            out.append(b)
+        return tuple(out)
 
     # ---- public API ----------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16,
@@ -297,12 +379,18 @@ class ContinuousBatchingEngine:
                                          for s in self.slots)
 
     def step(self) -> List[int]:
-        """Admit waiting requests, advance at most one prefill chunk,
-        decode one token for every running slot.  Returns req_ids
-        finished this step."""
+        """Admit waiting requests, then advance the engine one round:
+        mixed mode packs every running slot's decode token AND as many
+        pending prefill chunks as the token budget holds into one fused
+        launch; the split mode advances at most one prefill chunk, then
+        decodes every running slot.  Returns req_ids finished this
+        step."""
         self._admit()
-        self._prefill_chunks()
-        done = self._decode_batch()
+        if self.mixed is not None:
+            done = self._run_mixed_step()
+        else:
+            self._prefill_chunks()
+            done = self._decode_batch()
         self._m_queue.set(len(self.waiting))
         self._m_occupancy.set(
             sum(s is not None for s in self.slots)
@@ -310,7 +398,9 @@ class ContinuousBatchingEngine:
         cache = self.caches[0]
         self._m_kv_util.set(
             1.0 - len(cache._free) / max(1, cache.num_blocks))
-        if self.prefill_step is not None:
+        if self.chunk_size is not None:
+            # mixed chunks no longer consume a dedicated engine round,
+            # but the backlog gauge still reports what is pending
             self._m_chunk_queue.set(self._pending_chunks())
         return done
 
@@ -414,7 +504,11 @@ class ContinuousBatchingEngine:
         req.slot = slot
         req.state = "prefilling"
         self.slots[slot] = req
-        if self.prefill_step is None:
+        if self.mixed is not None:
+            # chunks ride the fused mixed step packed this same step()
+            # — admission never runs a separate prefill dispatch
+            pass
+        elif self.prefill_step is None:
             self._prefill_dense(req)
         elif L - hit_len <= self.chunk_size:
             # suffix fits one bucket: prefill at admission (short
@@ -583,6 +677,134 @@ class ContinuousBatchingEngine:
                 done.append(r.req_id)
         return done
 
+    # ---- fused mixed prefill+decode step --------------------------------
+    def _pack_spans(self):
+        """Choose this step's ragged span set: every running slot's
+        decode token (all must advance), then pending prefill chunks
+        round-robin over prefilling slots while the TOP budget has room
+        — multiple chunks per step, the round-robin latency killer."""
+        top = self.token_budgets[-1]
+        spans = []                    # (req, kind, size, start)
+        total = 0
+        for r in self.slots:
+            if r is not None and r.state == "running":
+                spans.append((r, "decode", 1, r.seq_len))
+                total += 1
+        n = self.max_batch_size
+        advanced_first = None
+        for k in range(n):
+            i = (self._chunk_rr + k) % n
+            r = self.slots[i]
+            if r is None or r.state != "prefilling":
+                continue
+            room = top - total
+            if room <= 0:
+                break
+            size = min(self.chunk_size,
+                       len(r.prompt_ids) - r.prefill_pos, room)
+            if size <= 0:
+                continue
+            spans.append((r, "prefill", size, r.prefill_pos))
+            total += size
+            if advanced_first is None:
+                advanced_first = i
+        if advanced_first is not None:
+            self._chunk_rr = (advanced_first + 1) % n
+        return spans, total
+
+    def _run_mixed_step(self) -> List[int]:
+        """Pack the admission mix into ONE fused MixedStep launch: build
+        the per-token and per-span tables on the host (control flow),
+        pad to the smallest token budget, dispatch, then apply the same
+        bookkeeping the split decode/prefill paths used."""
+        done = self._grow_pages() if self.lazy_alloc else []
+        spans, total = self._pack_spans()
+        if not spans:
+            return done
+        B = next(b for b in self.token_budgets if b >= total)
+        bs = self.block_size
+        W = self.bt_width
+        # fill the step's single host buffer in place (the pack layout
+        # is MixedStep's; tok_tab/span_tab are views into it)
+        pack, tok_tab, span_tab = self.mixed.new_pack(B)
+        tokens, positions, dest_blocks, dest_offsets = tok_tab
+        tokens[:] = 0
+        positions[:] = 0
+        # padding tokens: distinct sink-page slots (garbage on garbage)
+        dest_blocks[:] = self._sink
+        dest_offsets[:] = self._dest_pad[:B]
+        # padding spans pin their offset past the last token so the
+        # traced span-of-token search never maps a real token to them
+        span_tab[:, :W] = self._sink
+        span_tab[:, W] = B          # q_offset
+        span_tab[:, W + 1] = 0      # q_len
+        span_tab[:, W + 2] = 1      # kv_len
+        span_tab[:, W + 3] = 0      # sample_row
+        off = 0
+        for si, (r, kind, size, start) in enumerate(spans):
+            row = span_tab[si]
+            row[W] = off
+            row[W + 1] = size
+            row[W + 2] = start + size
+            row[W + 3] = off + size - 1
+            row[:len(r.block_ids)] = r.block_ids
+            pos = np.arange(start, start + size, dtype=np.int32)
+            if kind == "decode":
+                tokens[off] = self._tokens[r.slot]
+            else:
+                tokens[off:off + size] = \
+                    r.prompt_ids[start:start + size].astype(np.int32)
+            positions[off:off + size] = pos
+            dest_blocks[off:off + size] = [
+                r.block_ids[p // bs] for p in pos]
+            dest_offsets[off:off + size] = pos % bs
+            off += size
+
+        t0 = time.perf_counter()
+        pre = self.mixed.total_compiles
+        nxt = self.mixed.call_packed(pack, B)
+        traced = self.mixed.total_compiles - pre
+        dt = time.perf_counter() - t0
+        n_dec = sum(1 for _, kind, _, _ in spans if kind == "decode")
+        n_pre = total - n_dec
+        if n_dec:
+            self._m_mixed_tok_decode.inc(n_dec)
+        if n_pre:
+            self._m_mixed_tok_prefill.inc(n_pre)
+        if traced:
+            # first trace of this budget: count it, keep the compile
+            # warmup out of every latency histogram
+            self._m_mixed_compiles.inc(traced)
+        else:
+            # the fused step IS both the decode round and the prefill
+            # round — classify its (warm) duration into whichever
+            # histograms the pack actually advanced
+            if n_dec:
+                self._m_decode.observe(dt)
+            if n_pre:
+                self._m_prefill.observe(dt)
+
+        for si, (r, kind, size, start) in enumerate(spans):
+            tok = int(nxt[si])
+            if kind == "decode":
+                i = r.slot
+                r.seq_len += 1
+                self._seq_lens[i] += 1
+                self._append_token(r, tok)
+                if self.slots[i] is r:
+                    self._tokens[i] = tok
+                if r.state == "done":
+                    done.append(r.req_id)
+            else:
+                r.prefill_pos += size
+                if r.prefill_pos >= len(r.prompt_ids):
+                    # final chunk: tok is the on-device-sampled first
+                    # token (earlier chunks' samples are discarded)
+                    self._complete_prefill(r, tok, self._row_for(r))
+                    if r.state == "done":
+                        done.append(r.req_id)
+        return done
+
     # ---- bookkeeping ----------------------------------------------------
     def _append_token(self, req: GenerationRequest, token: int):
         req.output_ids.append(token)
@@ -601,9 +823,10 @@ class ContinuousBatchingEngine:
         self._m_requests.labels(
             outcome="truncated" if req.truncated else "completed").inc()
         self._m_tokens.inc(n_tok)
+        req.t_done = time.perf_counter()
         if n_tok > 1 and req.t_first_token:
             self._m_tpot.observe(
-                (time.perf_counter() - req.t_first_token) / (n_tok - 1))
+                (req.t_done - req.t_first_token) / (n_tok - 1))
         if req.slot >= 0:
             s = req.slot
             self.slots[s] = None

@@ -35,7 +35,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["DecodeStep", "PrefillStep", "prefill_scatter", "copy_block"]
+__all__ = ["DecodeStep", "PrefillStep", "MixedStep", "prefill_scatter",
+           "copy_block"]
 
 
 def _prefill_scatter_impl(ks, vs, kcs, vcs, block_tables, start):
@@ -225,6 +226,207 @@ class PrefillStep:
             c.key_cache = kc
             c.value_cache = vc
         return int(nxt)
+
+
+class MixedStep:
+    """ONE compiled donated XLA module per TOTAL-TOKEN BUDGET that
+    advances ANY admission mix — active decode slots and pending prefill
+    chunks together — in a single launch (Ragged Paged Attention,
+    arXiv:2604.15464).
+
+    The engine packs its work into a ragged token batch: every running
+    slot contributes a length-1 decode span, every prefilling slot a
+    length-C chunk span, concatenated on the token axis and padded to
+    the smallest budget in a small geometric set.  The traced body
+    embeds the packed tokens, and per layer projects, applies RoPE at
+    each token's GLOBAL position, scatters K/V into cache pages (padding
+    routed to the sink page — ``write_ragged_kv``), and runs ragged
+    paged attention (Pallas kernel on TPU, XLA gather reference on CPU)
+    where each span attends causally over its own page list.  Each
+    span's LAST VALID row is gathered before the LM head — the [T, V]
+    logits block is never materialized — and greedy-sampled on device,
+    so the step's only host traffic is one [max_spans] int32 fetch.
+
+    Shape policy: every span descriptor (offset, length, kv length,
+    page table, sample row, per-token write destination) is TRACED DATA;
+    the only traced SHAPE is the token budget, so total compiles are
+    bounded by the budget-set size across any occupancy/admission churn
+    — there is no separate prefill/decode module split and no per-chunk
+    engine round.  ``compile_counts`` maps budget -> trace count (tests
+    and the bench gate on it).
+    """
+
+    def __init__(self, model, caches: List, bt_width: int,
+                 max_spans: int, span_q: int,
+                 use_pallas: Optional[bool] = None):
+        from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
+        self.model = model
+        self.caches = caches
+        self.cfg = model.config
+        self.bt_width = bt_width
+        self.max_spans = max_spans
+        self.span_q = max(1, int(span_q))   # static max span length
+        self.sink = caches[0].sink
+        if self.sink < 0:
+            raise ValueError("MixedStep needs a sink page "
+                             "(PagedKVCache(sink_block=True)) to mask "
+                             "budget-padding writes")
+        if use_pallas is None:
+            use_pallas = _HAS_PLTPU and _on_tpu()
+        self.use_pallas = use_pallas
+        self._param_tensors = dict(model.state_dict())
+        self._fns = {}                 # token budget -> jitted step
+        self.compile_counts = {}       # token budget -> trace count
+
+    @property
+    def total_compiles(self) -> int:
+        return sum(self.compile_counts.values())
+
+    def _build(self, T: int):
+        from ..autograd.tape import no_grad
+        from ..incubate.nn.functional import \
+            fused_rotary_position_embedding
+        from ..ops.paged_attention import (_ragged_attention_xla,
+                                           write_ragged_kv)
+        model = self.model
+        cfg = self.cfg
+        llama = model.llama
+        H = cfg.num_attention_heads
+        Hkv = cfg.num_key_value_heads
+        D = cfg.hidden_size // H
+        scale = 1.0 / math.sqrt(D)
+        span_q = min(self.span_q, T)
+        use_pallas = self.use_pallas
+        if use_pallas:
+            from ..ops.pallas_kernels import _ragged_paged_attention_pallas
+
+        def attn(q, kc, vc, bt, q_off, q_len, kv_len):
+            if use_pallas:
+                return _ragged_paged_attention_pallas(
+                    q, kc, vc, bt, q_off, q_len, kv_len, scale,
+                    span_q=span_q)
+            return _ragged_attention_xla(q, kc, vc, bt, q_off, q_len,
+                                         kv_len, scale)
+
+        W = self.bt_width
+        S = self.max_spans
+
+        def step(params, pack, kcs, vcs):
+            self.compile_counts[T] = self.compile_counts.get(T, 0) + 1
+            # unpack the single host buffer (free at trace level —
+            # slices of a constant layout): rows 0-3 of the leading
+            # [4, T] block are tokens / positions / dest block / dest
+            # offset; the trailing [S, W+4] block is the block table
+            # columns then q_offset / q_len / kv_len / sample_row.  ONE
+            # device_put per step instead of nine — transfer count, not
+            # byte count, is the decode-parity budget at low occupancy.
+            tok_tab = pack[:4 * T].reshape(4, T)
+            span_tab = pack[4 * T:].reshape(S, W + 4)
+            tokens = tok_tab[0]
+            positions = tok_tab[1]
+            dest_blocks = tok_tab[2]
+            dest_offsets = tok_tab[3]
+            bt = span_tab[:, :W]
+            q_offsets = span_tab[:, W]
+            q_lens = span_tab[:, W + 1]
+            kv_lens = span_tab[:, W + 2]
+            sample_rows = span_tab[:, W + 3]
+            new_kcs, new_vcs = [], []
+            with model.bind_state(params), no_grad():
+                x = llama.embed_tokens(
+                    Tensor._from_value(tokens[None, :]))       # [1, T, h]
+                if cfg.dtype == "bfloat16":
+                    x = x.astype("bfloat16")
+                pos_t = Tensor._from_value(positions[None, :])
+                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                    h = layer.input_layernorm(x)
+                    at = layer.self_attn
+                    q = at.q_proj(h).reshape([1, T, H, D])
+                    k = at.k_proj(h).reshape([1, T, Hkv, D])
+                    v = at.v_proj(h).reshape([1, T, Hkv, D])
+                    q, k, _ = fused_rotary_position_embedding(
+                        q, k, position_ids=pos_t,
+                        rotary_emb_base=cfg.rope_theta)
+                    kc, vc = write_ragged_kv(
+                        k._value[0], v._value[0], kc, vc, dest_blocks,
+                        dest_offsets)
+                    new_kcs.append(kc)
+                    new_vcs.append(vc)
+                    out = attn(q._value[0], kc, vc, bt, q_offsets,
+                               q_lens, kv_lens)
+                    out = Tensor._from_value(out.reshape(1, T, H * D))
+                    x = x + at.o_proj(out)
+                    h2 = layer.post_attention_layernorm(x)
+                    x = x + layer.mlp(h2)
+                x = llama.norm(x)
+                # only each span's last valid row reaches the LM head:
+                # [max_spans, 1, h] @ [h, V], never the [T, V] block
+                rows = Tensor._from_value(
+                    x._value[0][sample_rows][:, None, :])
+                if model.lm_head is None:
+                    from ..ops.linalg import matmul
+                    logits = matmul(rows, llama.embed_tokens.weight,
+                                    transpose_y=True)
+                else:
+                    logits = model.lm_head(rows)
+            nxt = jnp.argmax(
+                logits._value[:, 0, :].astype(jnp.float32),
+                axis=-1).astype(jnp.int32)
+            return nxt, tuple(new_kcs), tuple(new_vcs)
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    def __call__(self, tokens, positions, dest_blocks, dest_offsets,
+                 q_offsets, q_lens, kv_lens, block_tables,
+                 sample_rows) -> np.ndarray:
+        """tokens/positions/dest_*: [T] packed per-token arrays (T must
+        be a configured budget); q_offsets/q_lens/kv_lens/sample_rows:
+        [max_spans]; block_tables: [max_spans, bt_width].  Returns the
+        [max_spans] int32 greedy samples (row i = span i's next token;
+        padding spans and non-final chunks are discarded by the
+        engine)."""
+        T = int(np.asarray(tokens).shape[0])
+        pack, tok_tab, span_tab = self.new_pack(T)
+        tok_tab[0] = tokens
+        tok_tab[1] = positions
+        tok_tab[2] = dest_blocks
+        tok_tab[3] = dest_offsets
+        W = self.bt_width
+        span_tab[:, :W] = block_tables
+        span_tab[:, W] = q_offsets
+        span_tab[:, W + 1] = q_lens
+        span_tab[:, W + 2] = kv_lens
+        span_tab[:, W + 3] = sample_rows
+        return self.call_packed(pack, T)
+
+    def new_pack(self, T: int):
+        """Allocate the step's single host buffer: ``(pack, tok_tab,
+        span_tab)`` where tok_tab [4, T] (rows tokens / positions /
+        dest block / dest offset) and span_tab [max_spans, bt_width+4]
+        (block-table columns then q_offset / q_len / kv_len /
+        sample_row) are VIEWS into pack — fill them, then hand pack to
+        ``call_packed``."""
+        S, W = self.max_spans, self.bt_width
+        pack = np.empty(4 * T + S * (W + 4), np.int32)
+        return (pack, pack[:4 * T].reshape(4, T),
+                pack[4 * T:].reshape(S, W + 4))
+
+    def call_packed(self, pack: np.ndarray, T: int) -> np.ndarray:
+        """Dispatch one pre-packed step buffer (see ``new_pack``).  The
+        nine per-step operands cross the host link as ONE int32
+        device_put: transfer count, not byte count, is what decode
+        parity with the split DecodeStep is made of at low occupancy."""
+        fn = self._fns.get(T)
+        if fn is None:
+            fn = self._fns[T] = self._build(T)
+        params = {k: t._value for k, t in self._param_tensors.items()}
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        nxt, new_kcs, new_vcs = fn(params, jnp.asarray(pack), kcs, vcs)
+        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
+            c.key_cache = kc
+            c.value_cache = vc
+        return np.asarray(nxt)
 
 
 class DecodeStep:

@@ -38,7 +38,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
            "write_decode_kv", "write_prefill_kv", "write_chunk_kv",
-           "chunk_prefill_attention",
+           "write_ragged_kv", "chunk_prefill_attention",
+           "ragged_paged_attention",
            "reconstruct_kv", "block_multihead_attention",
            "masked_multihead_attention"]
 
@@ -249,32 +250,160 @@ def chunk_prefill_attention(q, key_cache, value_cache, block_table_row,
     cache (traceable; the bucketed ``PrefillStep``'s attention body).
 
     q: [1, C, H, D] — chunk queries at global positions start..start+C-1
-    (the chunk's own K/V must already be written to the pages).  Gathers
-    the row's full page window and masks keys to ``kpos <= qpos``, so
-    chunk offset stays a traced scalar: one compile per bucket covers
-    every chunk position, every prompt length in the bucket, and every
-    prefix-cache suffix offset.  Padded queries produce garbage rows the
-    caller never reads (the sampled token comes from position
-    n_valid-1).
+    (the chunk's own K/V must already be written to the pages).  Masks
+    keys to ``kpos <= qpos``, so chunk offset stays a traced scalar: one
+    compile per bucket covers every chunk position, every prompt length
+    in the bucket, and every prefix-cache suffix offset.  Padded queries
+    produce garbage rows the caller never reads (the sampled token comes
+    from position n_valid-1).
+
+    The page loop is CLAMPED to the chunk's used block count
+    ``ceil((start + C) / block_size)`` — a traced loop bound, so a short
+    sequence in a large pool pays attention FLOPs proportional to its
+    own fill, not the full table width.  Numerics: the row max is exact
+    over the used window (identical to the full-width masked max, since
+    every clamped-away key was -inf there), then the normalizer and the
+    weighted sum accumulate page by page in position order.
     """
     B, C, H, D = q.shape
     Hkv = key_cache.shape[2]
     bs = key_cache.shape[1]
-    max_len = int(block_table_row.shape[1]) * bs
-    k, v = reconstruct_kv(key_cache, value_cache, block_table_row, max_len)
+    W = int(block_table_row.shape[1])
+    rep = H // Hkv
+    qf = q[0].astype(jnp.float32) * jnp.float32(scale)   # [C, H, D]
+    qpos = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)
+    n_used = jnp.minimum(
+        (start.astype(jnp.int32) + C + bs - 1) // bs, jnp.int32(W))
+    bt = jnp.maximum(block_table_row[0].astype(jnp.int32), 0)
+
+    def page_scores(p_idx, k):
+        # k [bs, H, D] (GQA-repeated) -> scores [H, C, bs], causal-masked
+        s = jnp.einsum("qhd,khd->hqk", qf, k)
+        cols = p_idx * bs + jnp.arange(bs, dtype=jnp.int32)
+        ok = cols[None, None, :] <= qpos[None, :, None]
+        return jnp.where(ok, s, -jnp.inf)
+
+    def gather(p_idx, cache):
+        page = cache[bt[p_idx]].astype(jnp.float32)      # [bs, Hkv, D]
+        if rep != 1:
+            page = jnp.repeat(page, rep, axis=1)
+        return page
+
+    def max_body(p_idx, m):
+        s = page_scores(p_idx, gather(p_idx, key_cache))
+        return jnp.maximum(m, jnp.max(s, axis=-1))
+
+    m = jax.lax.fori_loop(jnp.int32(0), n_used, max_body,
+                          jnp.full((H, C), -jnp.inf, jnp.float32))
+
+    def acc_body(p_idx, carry):
+        l, acc = carry
+        s = page_scores(p_idx, gather(p_idx, key_cache))
+        p = jnp.exp(s - m[:, :, None])                   # -inf keys -> 0
+        l = l + jnp.sum(p, axis=-1)
+        acc = acc + jnp.einsum("hqk,khd->qhd", p,
+                               gather(p_idx, value_cache))
+        return l, acc
+
+    l, acc = jax.lax.fori_loop(
+        jnp.int32(0), n_used, acc_body,
+        (jnp.zeros((H, C), jnp.float32),
+         jnp.zeros((C, H, D), jnp.float32)))
+    out = acc / jnp.maximum(l, 1e-30).T[:, :, None]
+    return out[None].astype(q.dtype)
+
+
+def write_ragged_kv(k_new, v_new, key_cache, value_cache, dest_blocks,
+                    dest_offsets):
+    """Scatter a packed ragged token batch's K/V into cache pages
+    (traceable — composed inside the fused ``MixedStep`` trace).
+
+    k_new/v_new: [T, Hkv, D] — one row per packed token (decode slots
+    and prefill-chunk tokens interleaved).  Token t lands at
+    ``(dest_blocks[t], dest_offsets[t])``; the caller routes padding
+    tokens to the sink page, so one compile per token budget serves
+    every admission mix without corrupting live pages.
+    """
+    key_cache = key_cache.at[dest_blocks, dest_offsets].set(k_new)
+    value_cache = value_cache.at[dest_blocks, dest_offsets].set(v_new)
+    return key_cache, value_cache
+
+
+def _ragged_attention_xla(q, key_cache, value_cache, block_tables,
+                          q_offsets, q_lens, kv_lens, scale):
+    """Ragged paged attention, XLA reference path (CPU + parity tests).
+
+    q: [T, H, D] packed ragged tokens; block_tables [S, W]; q_offsets /
+    q_lens / kv_lens [S] describe the spans (q_offsets ascending, with
+    padding spans pinned past the last token so no token maps to them).
+    Token t of span s sits at global position
+    ``kv_lens[s] - q_lens[s] + (t - q_offsets[s])`` and attends keys at
+    positions <= that — the same mask decode (q_len=1) and chunked
+    prefill use, so one code path covers any admission mix.  Same
+    gather + fp32 masked softmax pattern as ``_paged_attention_xla``.
+    """
+    T, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bs = key_cache.shape[1]
+    W = block_tables.shape[1]
+    max_len = W * bs
+    tok = jnp.arange(T, dtype=jnp.int32)
+    sid = jnp.clip(
+        jnp.searchsorted(q_offsets.astype(jnp.int32), tok, side="right")
+        - 1, 0, q_offsets.shape[0] - 1).astype(jnp.int32)
+    qpos = (kv_lens[sid] - q_lens[sid] + (tok - q_offsets[sid]))
+    qpos = jnp.maximum(qpos, 0)       # padding tokens: finite garbage
+    bt = jnp.maximum(block_tables, 0)[sid]               # [T, W]
+    k = key_cache[bt].reshape(T, max_len, Hkv, D)
+    v = value_cache[bt].reshape(T, max_len, Hkv, D)
     if Hkv != H:
         rep = H // Hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+    s = jnp.einsum("thd,tlhd->thl",
+                   q.astype(jnp.float32) * jnp.float32(scale),
                    k.astype(jnp.float32))
-    kpos = jnp.arange(max_len, dtype=jnp.int32)
-    qpos = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)
-    causal = kpos[None, None, None, :] <= qpos[None, None, :, None]
-    s = jnp.where(causal, s, -jnp.inf)
+    cols = jnp.arange(max_len, dtype=jnp.int32)
+    valid = cols[None, None, :] <= qpos[:, None, None]
+    s = jnp.where(valid, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.einsum("thl,tlhd->thd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def ragged_paged_attention(q, key_cache, value_cache, block_tables,
+                           q_offsets, q_lens, kv_lens,
+                           use_pallas: Optional[bool] = None,
+                           interpret=False, span_q: Optional[int] = None):
+    """One fused attention launch over a packed ragged query batch
+    against the paged KV pool (arXiv:2604.15464).
+
+    q: [T, H, D] — decode slots contribute length-1 spans, prefill
+    chunks length-C spans, concatenated on the token axis.
+    block_tables: [S, W] int32 per-span page lists (-1/sink padded).
+    q_offsets/q_lens/kv_lens: [S] int32 span tables (kv_len INCLUDES the
+    span's own tokens, which must already be written to the pages).
+    Returns [T, H, D].
+    """
+    tensor_in = isinstance(q, Tensor)
+    qv = _val(q)
+    kc, vc = _val(key_cache), _val(value_cache)
+    bt = jnp.asarray(np.asarray(block_tables), jnp.int32)
+    qo = jnp.asarray(np.asarray(q_offsets), jnp.int32)
+    ql = jnp.asarray(np.asarray(q_lens), jnp.int32)
+    kl = jnp.asarray(np.asarray(kv_lens), jnp.int32)
+    scale = 1.0 / math.sqrt(qv.shape[-1])
+    if use_pallas is None:
+        use_pallas = _HAS_PLTPU and _on_tpu()
+    if use_pallas or interpret:
+        from .pallas_kernels import _ragged_paged_attention_pallas
+        sq = int(span_q) if span_q else int(np.max(np.asarray(q_lens)))
+        out = _ragged_paged_attention_pallas(
+            qv, kc, vc, bt, qo, ql, kl, scale, span_q=sq,
+            interpret=interpret)
+    else:
+        out = _ragged_attention_xla(qv, kc, vc, bt, qo, ql, kl, scale)
+    return Tensor._from_value(out) if tensor_in else out
 
 
 def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,

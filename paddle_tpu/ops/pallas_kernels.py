@@ -47,14 +47,29 @@ def _on_tpu() -> bool:
         return False
 
 
+def _x64_off():
+    """Context manager tracing with x64 disabled (mosaic cannot legalize
+    the i64 scalars python-int arithmetic produces under jax_enable_x64).
+    jax >= 0.5 spells it jax.enable_x64(False); 0.4.x only has the
+    experimental form."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    return jax.experimental.disable_x64()
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
 _DIMNUM_NT = (((1,), (1,)), ((), ()))    # x @ y.T
 _DIMNUM_NN = (((1,), (0,)), ((), ()))    # x @ y
 _DIMNUM_TN = (((0,), (0,)), ((), ()))    # x.T @ y
-_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
-_MASK_THRESH = 0.5 * _MASK_VALUE      # any real score is above this
+# np.float32 (not python float): a weak-typed scalar staged from inside
+# an OUTER x64 trace (ring attention's shard_map/cond around interpret-
+# mode pallas) lowers as tensor<f64> and fails MLIR verification
+_MASK_VALUE = np.float32(-0.7 * float(np.finfo(np.float32).max))
+_MASK_THRESH = np.float32(0.5) * _MASK_VALUE   # any real score is above this
+_F32_0 = np.float32(0.0)
+_F32_NEG_INF = np.float32(-np.inf)
 _LANES = 128
 # Scores are kept in exp2 space: scale*log2(e) is folded into the q (or k)
 # tile ONCE per VMEM tile, so the inner loop runs exp2 directly — saving
@@ -236,7 +251,7 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
             # nothing (a finite mask value would otherwise give
             # p = exp2(0) = 1).  Dead rows can only exist in blocks with
             # masked entries, so the guard lives in the masked body only.
-            p = jnp.where(_cols(m_next, block_k) > _MASK_THRESH, p, 0.0)
+            p = jnp.where(_cols(m_next, block_k) > _MASK_THRESH, p, _F32_0)
         alpha = jnp.exp2(m_prev - m_next)              # [bq, 128]
         m_s[...] = m_next
         l_s[...] = jnp.sum(p, axis=1)[:, None] + alpha * l_prev
@@ -263,15 +278,16 @@ def _flash_fwd_kernel(*refs, block_k: int, causal: bool, scale: float,
     @pl.when(kb == kv_blocks - 1)
     def _store():
         l_v = l_s[...]
-        l_inv = jnp.where(l_v > 0.0, 1.0 / l_v, 0.0)
+        l_inv = jnp.where(l_v > _F32_0, np.float32(1.0) / l_v, _F32_0)
         o_ref[0] = (acc_s[...] * _cols(l_inv, d)).astype(o_ref.dtype)
         if save_lse:
             # natural-log log-sum-exp residual for the backward (scores
             # live in exp2 space in-kernel: convert m back with ln2),
             # lane-broadcast to the mosaic-tileable 128-lane layout;
             # -inf marks rows that attended nothing
-            lse = jnp.where(l_v > 0.0,
-                            m_s[...] * _LN2 + jnp.log(l_v), -jnp.inf)
+            lse = jnp.where(l_v > _F32_0,
+                            m_s[...] * np.float32(_LN2) + jnp.log(l_v),
+                            _F32_NEG_INF)
             lse_ref[0] = lse.astype(jnp.float32)
 
 
@@ -331,7 +347,7 @@ def _flash_attention_value(q, k, v, causal: bool, block_q=512,
                                               jnp.float32))
     # Kernel body traced with x64 off: mosaic cannot legalize the i64
     # scalars that python-int arithmetic produces under jax_enable_x64.
-    with jax.enable_x64(False):
+    with _x64_off():
         res = pl.pallas_call(
             kernel,
             grid=(B * H, Sq // block_q, n_kb),
@@ -377,7 +393,7 @@ def _bwd_p_ds(q2, k, v, do, lse2, delta, *, mask, row_off, col_off):
         s = jnp.where(rows >= cols, s, _MASK_VALUE)
         # dead rows have lse = -inf: exp2(s - lse2) would be inf -> 0
         finite = jnp.isfinite(lse2[:, :1])
-        p = jnp.where(finite, jnp.exp2(s - _cols(lse2, bk)), 0.0)
+        p = jnp.where(finite, jnp.exp2(s - _cols(lse2, bk)), _F32_0)
     else:
         p = jnp.exp2(s - _cols(lse2, bk))
     dp = lax.dot_general(do, v, _DIMNUM_NT,
@@ -625,7 +641,7 @@ def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
             pl.BlockSpec((block_q, D), lambda b, i, j: (by_j(i, j), 0))]
         call_args += (cos, sin, cos, sin)
 
-    with jax.enable_x64(False):
+    with _x64_off():
         dq_part, dk, dv = pl.pallas_call(
             functools.partial(
                 _flash_bwd_kv_kernel, block_q=block_q, causal=causal,
@@ -746,7 +762,7 @@ def _flash_attention_bwd(q, k, v, out, lse, g, causal: bool,
         if (_HAS_PLTPU and not _INTERPRET[0]) else None,
         interpret=_INTERPRET[0])
 
-    with jax.enable_x64(False):
+    with _x64_off():
         dq_in_specs = [qs(by_i), ks(kb_j), ks(kb_j), qs(by_i), qs(by_i),
                        rows(by_i)]
         dq_args = (*args, lser)
@@ -1075,7 +1091,7 @@ def rms_norm_tpu(x, weight, eps=1e-6, block_rows=512):
         br = min(block_rows, rows)
         if rows % br:
             br = rows
-        with jax.enable_x64(False):
+        with _x64_off():
             out = pl.pallas_call(
                 functools.partial(_rms_kernel, eps=eps),
                 grid=(rows // br,),
@@ -1413,3 +1429,154 @@ def sdpa_ulysses(query, key, value, mesh, axis_name: str = "sep",
 
     return apply_op("ulysses_attention", fn,
                     (query, targ(key), targ(value)))
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention (serving: one launch for any prefill+decode mix)
+# ---------------------------------------------------------------------------
+def _ragged_paged_kernel(# scalar prefetch
+                         q_off_ref, q_len_ref, kv_len_ref, bt_ref,
+                         # operands (HBM/ANY)
+                         q_hbm, k_pages, v_pages,
+                         # output (HBM/ANY)
+                         o_hbm,
+                         # scratch
+                         q_vmem, o_vmem, k_vmem, v_vmem, sem,
+                         *, block_size: int, pages_per_span: int,
+                         span_q: int, scale: float, groups: int):
+    """Grid cell (s, h): one ragged query SPAN (a decode slot = length-1
+    span, or a prefill chunk = length-C span) against one kv head's
+    pages (arXiv:2604.15464 "Ragged Paged Attention").
+
+    The packed query batch lives flat on the token axis; each span's
+    rows are DMA'd HBM->VMEM as a fixed ``span_q`` window starting at
+    its (scalar-prefetched) offset, pages stream one DMA at a time with
+    the online-softmax state in fp32 registers, and the output window is
+    DMA'd back.  Rows past ``q_len`` inside the window compute garbage
+    that the NEXT span's cell overwrites (grid order is span-major and
+    sequential), so the packed buffer carries ``span_q`` padding rows at
+    the tail for the last span's overhang.
+
+    Causality is positional: row r of span s sits at global position
+    ``kv_len - q_len + r`` and sees keys at positions <= that, so decode
+    steps, mid-prompt chunks, and prefix-hit suffixes are all the same
+    span shape to this kernel.
+    """
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+    q_len = q_len_ref[s]
+
+    @pl.when(q_len > 0)
+    def _span():
+        off = q_off_ref[s]
+        kv_len = kv_len_ref[s]
+        cp = pltpu.make_async_copy(
+            q_hbm.at[pl.ds(off, span_q), h], q_vmem, sem)
+        cp.start()
+        cp.wait()
+        d = q_vmem.shape[-1]
+        g = span_q * groups
+        q = (q_vmem[...].astype(jnp.float32).reshape(g, d)
+             * np.float32(scale))
+        # row r of the span (each repeated over its q heads) sits at
+        # global position kv_len - q_len + r; garbage rows (r >= q_len)
+        # get qpos >= kv_len and attend the whole context — finite,
+        # never read
+        qpos = (kv_len - q_len + lax.broadcasted_iota(
+            jnp.int32, (span_q, groups), 0)).reshape(g, 1)
+
+        m0 = jnp.full((g, 1), _F32_NEG_INF, jnp.float32)
+        l0 = jnp.zeros((g, 1), jnp.float32)
+        acc0 = jnp.zeros((g, d), jnp.float32)
+        n_pages = jnp.minimum(
+            (kv_len + jnp.int32(block_size - 1)) // jnp.int32(block_size),
+            jnp.int32(pages_per_span))
+
+        def body(p_idx, carry):
+            m, l, acc = carry
+            page = bt_ref[s, p_idx]
+            kc = pltpu.make_async_copy(k_pages.at[h, page], k_vmem, sem)
+            kc.start()
+            kc.wait()
+            vc = pltpu.make_async_copy(v_pages.at[h, page], v_vmem, sem)
+            vc.start()
+            vc.wait()
+            k = k_vmem[...].astype(jnp.float32)        # [bs, D]
+            v = v_vmem[...].astype(jnp.float32)
+            sc = lax.dot_general(q, k, _DIMNUM_NT,
+                                 preferred_element_type=jnp.float32)
+            base = p_idx * jnp.int32(block_size)
+            cols = base + lax.broadcasted_iota(
+                jnp.int32, (g, block_size), 1)
+            ok = (cols <= qpos) & (cols < kv_len)
+            sc = jnp.where(ok, sc, _F32_NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.where(ok, jnp.exp(sc - m_new), _F32_0)
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = acc * alpha + lax.dot_general(
+                p, v, _DIMNUM_NN, preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m, l, acc = lax.fori_loop(jnp.int32(0), n_pages, body,
+                                  (m0, l0, acc0))
+        o_vmem[...] = (acc / jnp.maximum(l, np.float32(1e-30))).reshape(
+            span_q, groups, d).astype(o_vmem.dtype)
+        op = pltpu.make_async_copy(
+            o_vmem, o_hbm.at[pl.ds(off, span_q), h], sem)
+        op.start()
+        op.wait()
+
+
+def _ragged_paged_attention_pallas(q, key_cache, value_cache,
+                                   block_tables, q_offsets, q_lens,
+                                   kv_lens, scale, span_q: int,
+                                   interpret=False):
+    """q: [T, H, D] packed ragged tokens; block_tables [S, W]; span
+    tables [S].  span_q: static max span length (>= max(q_lens)).
+    Returns [T, H, D]."""
+    T, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bs = key_cache.shape[1]
+    groups = H // Hkv
+    S, W = block_tables.shape
+    span_q = max(1, int(span_q))
+    qg = q.reshape(T, Hkv, groups, D).astype(jnp.float32)
+    # span_q tail padding: the last span's fixed DMA window may overhang
+    qg = jnp.pad(qg, ((0, span_q), (0, 0), (0, 0), (0, 0)))
+    kp = jnp.moveaxis(key_cache, 2, 0).astype(jnp.float32)
+    vp = jnp.moveaxis(value_cache, 2, 0).astype(jnp.float32)
+    bt = jnp.maximum(block_tables, 0)
+
+    kernel = functools.partial(
+        _ragged_paged_kernel, block_size=bs, pages_per_span=W,
+        span_q=span_q, scale=scale, groups=groups)
+
+    with _x64_off():
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(S, Hkv),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((span_q, groups, D), jnp.float32),
+                pltpu.VMEM((span_q, groups, D), q.dtype),
+                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((T + span_q, Hkv, groups, D),
+                                           q.dtype),
+            interpret=interpret,
+        )(q_offsets.astype(jnp.int32), q_lens.astype(jnp.int32),
+          kv_lens.astype(jnp.int32), bt.astype(jnp.int32),
+          qg, kp, vp)
+    return out[:T].reshape(T, H, D)

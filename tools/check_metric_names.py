@@ -57,6 +57,9 @@ REQUIRED_NAMES = frozenset({
     "serving_prefix_cache_evictions_total",
     "serving_prefill_duration_seconds",
     "serving_ttft_seconds",
+    # fused mixed prefill+decode step (round-11; BENCH_SERVE_r11.json)
+    "serving_mixed_step_compiles_total",
+    "serving_mixed_span_tokens_total",
 })
 
 
