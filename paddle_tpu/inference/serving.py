@@ -122,6 +122,15 @@ class ContinuousBatchingEngine:
     (``token_budgets``: ``"auto"`` geometric set covering all-decode up
     to slots+chunk, or an explicit tuple whose top must fit an
     all-decode pack).  ``prefill_chunk_size`` bounds a single span.
+
+    ``mesh=`` (+ optional ``sharding=ShardingConfig(axis='tp')``)
+    makes the engine multi-chip: every fused step runs tensor-parallel
+    over the mesh's ``tp`` axis (see ``jit/spmd.py`` for the
+    per-weight-family spec layout), with KV pools sharded over kv
+    heads — per-chip pool HBM is 1/tp — and tokens byte-identical to
+    the single-chip engine (BENCH_SERVE_r12.json gates this).
+    Requires ``mixed_step=True`` or ``prefill_buckets`` (the legacy
+    dense prefill is eager, single-chip math).
     """
 
     def __init__(self, model, max_batch_size: int = 8,
@@ -133,9 +142,29 @@ class ContinuousBatchingEngine:
                  prefill_chunk_size: Optional[int] = None,
                  enable_prefix_cache: bool = False,
                  mixed_step: bool = False,
-                 token_budgets="auto"):
+                 token_budgets="auto",
+                 mesh=None, sharding=None):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
+        # ---- tensor-parallel serving (multi-chip) --------------------
+        # mesh + ShardingConfig(axis='tp') shard the fused steps over
+        # the tp axis (jit/spmd.py is the single source of the mesh /
+        # per-weight-family spec logic, shared with TrainStep — pass a
+        # co-located train mesh and its 'tp' axis resolves).  Head
+        # divisibility and pool shape are validated HERE, not as a
+        # shard_map shape failure deep in tracing.
+        if mesh is not None or sharding is not None:
+            from ..jit.spmd import tp_serving_context
+            self.tp = tp_serving_context(model, mesh, sharding)
+        else:
+            self.tp = None
+        self.tp_degree = self.tp.degree if self.tp is not None else 1
+        if self.tp is not None and not mixed_step and not prefill_buckets:
+            raise ValueError(
+                "tensor-parallel serving needs a compiled prefill path: "
+                "pass mixed_step=True or prefill_buckets='auto' (the "
+                "legacy dense prefill runs the model eagerly on one "
+                "chip and cannot feed head-sharded KV pools)")
         # lazy_alloc: pages are allocated as a sequence actually grows
         # instead of reserving the full prompt+budget footprint at
         # admission — higher occupancy for the same pool, at the cost
@@ -155,6 +184,16 @@ class ContinuousBatchingEngine:
                          cfg.num_key_value_heads, self.head_dim, dtype,
                          sink_block=True)
             for _ in range(cfg.num_hidden_layers)]
+        if self.tp is not None:
+            # re-check against the pool actually built (paranoia for
+            # subclasses that override cache construction), then place:
+            # each chip holds only its kv-head slice of every page
+            from ..jit.spmd import validate_tp_serving
+            validate_tp_serving(cfg, self.tp_degree,
+                                pool_kv_heads=self.caches[0].num_kv_heads)
+            pool_sh = self.tp.pool_sharding()
+            for c in self.caches:
+                c.place(pool_sh)
         if max_seq_len is None:
             max_seq_len = max(block_size,
                               num_blocks * block_size // max_batch_size)
@@ -173,7 +212,7 @@ class ContinuousBatchingEngine:
         self._bt = np.full((max_batch_size, self.bt_width), self._sink,
                            np.int32)
         self.decode_step = DecodeStep(model, self.caches,
-                                      use_pallas=use_pallas)
+                                      use_pallas=use_pallas, tp=self.tp)
 
         # ---- bucketed / chunked prefill ------------------------------
         if prefill_buckets == "auto":
@@ -191,7 +230,7 @@ class ContinuousBatchingEngine:
                     "every chunk must map to a compiled bucket"
                     % (self.chunk_size, buckets[-1]))
             self.prefill_step = PrefillStep(model, self.caches,
-                                            self.bt_width)
+                                            self.bt_width, tp=self.tp)
         else:
             self.chunk_size = None
             self.prefill_step = None
@@ -219,7 +258,7 @@ class ContinuousBatchingEngine:
                                    max_spans=max_batch_size,
                                    span_q=min(self.chunk_size,
                                               budgets[-1]),
-                                   use_pallas=use_pallas)
+                                   use_pallas=use_pallas, tp=self.tp)
             # padding tokens spread over the sink page's slots
             self._dest_pad = (np.arange(budgets[-1], dtype=np.int32)
                               % block_size)
@@ -304,6 +343,19 @@ class ContinuousBatchingEngine:
             self._m_mixed_span_tokens.labels(kind="decode")
         self._m_mixed_tok_prefill = \
             self._m_mixed_span_tokens.labels(kind="prefill")
+        self._m_tp_degree = r.gauge(
+            "serving_tp_degree",
+            "tensor-parallel degree of the most recently constructed "
+            "engine in this process (1 = single chip)")
+        self._m_tp_degree.set(self.tp_degree)
+        self._m_tp_collective = r.counter(
+            "serving_tp_collective_bytes_total",
+            "per-chip activation bytes moved through the sharded "
+            "step's collectives (psum per layer boundary, exact "
+            "embedding psum, exact logits all-gather)", labels=("op",))
+        self._m_tp_psum = self._m_tp_collective.labels(op="psum")
+        self._m_tp_all_gather = \
+            self._m_tp_collective.labels(op="all_gather")
         # compile warmup never lands in a latency histogram.  Bucketed
         # prefill tracks warmth PER BUCKET via the step's own compile
         # counters (a call that traced is cold, everything else is warm
@@ -596,6 +648,9 @@ class ContinuousBatchingEngine:
         pre = self.prefill_step.total_compiles
         first = self.prefill_step(toks, start, size, row)
         traced = self.prefill_step.total_compiles - pre
+        if self.tp is not None:
+            self._count_collectives(
+                self.prefill_step.collective_bytes(bucket))
         if traced:
             # first compile of this bucket: count it, keep the warmup
             # out of the latency histogram
@@ -664,6 +719,9 @@ class ContinuousBatchingEngine:
         if self._decode_warm:
             self._m_decode.observe(time.perf_counter() - t_decode)
         self._decode_warm = True
+        if self.tp is not None:
+            self._count_collectives(
+                self.decode_step.collective_bytes(self.max_batch_size))
         for i, r in enumerate(list(self.slots)):
             if r is None or r.state != "running":
                 continue
@@ -765,6 +823,8 @@ class ContinuousBatchingEngine:
         nxt = self.mixed.call_packed(pack, B)
         traced = self.mixed.total_compiles - pre
         dt = time.perf_counter() - t0
+        if self.tp is not None:
+            self._count_collectives(self.mixed.collective_bytes(B))
         n_dec = sum(1 for _, kind, _, _ in spans if kind == "decode")
         n_pre = total - n_dec
         if n_dec:
@@ -806,6 +866,15 @@ class ContinuousBatchingEngine:
         return done
 
     # ---- bookkeeping ----------------------------------------------------
+    def _count_collectives(self, by_op: Dict[str, int]):
+        """Publish one sharded dispatch's per-chip collective payload
+        (host-side accounting — the byte counts are static per compiled
+        shape, so nothing is fetched from the device)."""
+        if by_op.get("psum"):
+            self._m_tp_psum.inc(by_op["psum"])
+        if by_op.get("all_gather"):
+            self._m_tp_all_gather.inc(by_op["all_gather"])
+
     def _append_token(self, req: GenerationRequest, token: int):
         req.output_ids.append(token)
         if len(req.output_ids) == 1:

@@ -22,6 +22,21 @@ this in tests).
 The only per-step host traffic is the [slots] int32 next-token fetch —
 sampling runs on device, so the 1-token logits tensor never crosses the
 link.
+
+Tensor parallelism (multi-chip serving): every step accepts
+``mesh + ShardingConfig(axis='tp')`` (or a prebuilt
+:class:`~.spmd.TPContext`, which the engine shares across its steps so
+parameters are placed once).  The SAME traced body then runs as an
+explicit SPMD program (``shard_map`` over the tp axis): weights shard
+by the canonical per-family specs in ``jit/spmd.py`` (vocab-row
+embeddings, head-column QKV, head-row attention-out, ffn-column
+gate/up, ffn-row down, vocab-column LM head), the paged KV pools shard
+over kv heads (each chip's paged-attention launch sees only its head
+shard of every page), and activations cross chip boundaries through
+exactly one psum per layer boundary (attention out, MLP out) plus one
+exact embedding psum and one exact logits all-gather.  Donation, the
+compile-count invariants, and the single packed int32 host transfer
+all survive sharding unchanged.
 """
 from __future__ import annotations
 
@@ -32,11 +47,86 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 from ..core.tensor import Tensor
+from .spmd import (TPContext, tp_embed, tp_gather_logits,
+                   tp_serving_context)
 
 __all__ = ["DecodeStep", "PrefillStep", "MixedStep", "prefill_scatter",
            "copy_block"]
+
+
+def _resolve_tp(model, mesh, sharding, tp: Optional[TPContext]
+                ) -> Optional[TPContext]:
+    """Step-constructor tp plumbing: a prebuilt shared context wins;
+    otherwise resolve mesh+config here (standalone step construction).
+    None = single-chip."""
+    if tp is not None:
+        return tp
+    if mesh is None and sharding is None:
+        return None
+    return tp_serving_context(model, mesh, sharding)
+
+
+def _embed(llama, tokens, tp: Optional[TPContext]) -> Tensor:
+    """Embedding lookup shared by all three traced bodies: the module's
+    gather single-chip, the vocab-parallel masked lookup + exact psum
+    under tp.  ``tokens`` already carries the body's batch shape."""
+    if tp is None:
+        return llama.embed_tokens(Tensor._from_value(tokens))
+    return Tensor._from_value(tp_embed(
+        llama.embed_tokens.weight._value, tokens, tp.axis))
+
+
+def _tp_psum(t: Tensor, tp: Optional[TPContext]) -> Tensor:
+    """The layer-boundary collective: identity single-chip, psum of the
+    row-sharded projection's partial sums over the tp axis otherwise.
+    (The ONE place the per-layer collective lives — the spot a
+    quantized all-reduce would drop into.)"""
+    if tp is None:
+        return t
+    return Tensor._from_value(jax.lax.psum(t._value, tp.axis))
+
+
+def _tp_logits(logits: Tensor, tp: Optional[TPContext]) -> Tensor:
+    """Identity single-chip; the exact vocab-shard all-gather under tp,
+    so the on-device argmax sees the full vocab row."""
+    if tp is None:
+        return logits
+    return Tensor._from_value(tp_gather_logits(logits._value, tp.axis))
+
+
+def _step_params(param_tensors, tp: Optional[TPContext]):
+    """The params operand for one step call: plain values single-chip;
+    under tp the context's ONE placed (sharded) copy — so the jit's
+    in_shardings alias instead of resharding, and placement happens
+    once per engine, not per step or per call."""
+    vals = {k: t._value for k, t in param_tensors.items()}
+    if tp is None:
+        return vals
+    return tp.place_params(vals)
+
+
+def _wrap_sharded(step, tp: TPContext, param_tensors, n_layers: int,
+                  n_repl: int, donate):
+    """Wrap a serving-step body as the explicit SPMD program: shard_map
+    over the tp axis (params by family spec, the ``n_repl`` host
+    operands replicated, per-layer KV pools head-sharded) under a jit
+    whose in/out shardings pin the placed layouts — donation of the
+    pools carries through, so the cache append stays an in-place HBM
+    update on every chip."""
+    from ..core.jax_compat import shard_map_compat
+    repl = PartitionSpec()
+    pspecs = {k: tp.specs[k] for k in param_tensors}
+    pools = (tp.layout.kv_pool(),) * n_layers
+    in_specs = (pspecs,) + (repl,) * n_repl + (pools, pools)
+    out_specs = (repl, pools, pools)
+    fn = shard_map_compat(step, tp.mesh, in_specs=in_specs,
+                          out_specs=out_specs)
+    return jax.jit(fn, donate_argnums=donate,
+                   in_shardings=tp.named(in_specs),
+                   out_shardings=tp.named(out_specs))
 
 
 def _prefill_scatter_impl(ks, vs, kcs, vcs, block_tables, start):
@@ -123,7 +213,9 @@ class PrefillStep:
     width -> trace count (tests and the bench gate on it).
     """
 
-    def __init__(self, model, caches: List, bt_width: int):
+    def __init__(self, model, caches: List, bt_width: int,
+                 mesh=None, sharding=None,
+                 tp: Optional[TPContext] = None):
         self.model = model
         self.caches = caches
         self.cfg = model.config
@@ -133,6 +225,7 @@ class PrefillStep:
             raise ValueError("PrefillStep needs a sink page "
                              "(PagedKVCache(sink_block=True)) to mask "
                              "bucket padding writes")
+        self._tp = _resolve_tp(model, mesh, sharding, tp)
         self._param_tensors = dict(model.state_dict())
         self._fns = {}                 # bucket width -> jitted step
         self.compile_counts = {}       # bucket width -> trace count
@@ -140,6 +233,13 @@ class PrefillStep:
     @property
     def total_compiles(self) -> int:
         return sum(self.compile_counts.values())
+
+    def collective_bytes(self, C: int):
+        """Per-chip collective payload of one sharded chunk of bucket
+        width ``C`` ({} when single-chip; one logits row)."""
+        if self._tp is None:
+            return {}
+        return self._tp.collective_bytes(self.cfg, C, 1)
 
     def _build(self, C: int):
         from ..autograd.tape import no_grad
@@ -150,9 +250,11 @@ class PrefillStep:
         model = self.model
         cfg = self.cfg
         llama = model.llama
-        H = cfg.num_attention_heads
-        Hkv = cfg.num_key_value_heads
-        D = cfg.hidden_size // H
+        tp = self._tp
+        deg = tp.degree if tp is not None else 1
+        H = cfg.num_attention_heads // deg      # this chip's head shard
+        Hkv = cfg.num_key_value_heads // deg
+        D = cfg.hidden_size // cfg.num_attention_heads
         scale = 1.0 / math.sqrt(D)
         sink = self.sink
 
@@ -160,7 +262,7 @@ class PrefillStep:
             self.compile_counts[C] = self.compile_counts.get(C, 0) + 1
             new_kcs, new_vcs = [], []
             with model.bind_state(params), no_grad():
-                x = llama.embed_tokens(Tensor._from_value(tokens))
+                x = _embed(llama, tokens, tp)
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos = start + jnp.arange(C, dtype=jnp.int32)
@@ -182,9 +284,9 @@ class PrefillStep:
                     out = chunk_prefill_attention(
                         q._value, kc, vc, bt, start, scale)
                     out = Tensor._from_value(out.reshape(1, C, H * D))
-                    x = x + attn.o_proj(out)
+                    x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + layer.mlp(h2)
+                    x = x + _tp_psum(layer.mlp(h2), tp)
                 x = llama.norm(x)
                 # only the last VALID position reaches the LM head:
                 # [1, 1, h] @ [h, V], never the [C, V] logits block
@@ -197,11 +299,16 @@ class PrefillStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(last)
+                logits = _tp_logits(logits, tp)
             nxt = jnp.argmax(
                 logits._value[0, 0].astype(jnp.float32)).astype(jnp.int32)
             return nxt, tuple(new_kcs), tuple(new_vcs)
 
-        return jax.jit(step, donate_argnums=(5, 6))
+        if tp is None:
+            return jax.jit(step, donate_argnums=(5, 6))
+        return _wrap_sharded(step, tp, self._param_tensors,
+                             len(self.caches), n_repl=4,
+                             donate=(5, 6))
 
     def __call__(self, tokens, start: int, n_valid: int,
                  block_table_row) -> int:
@@ -212,7 +319,7 @@ class PrefillStep:
         fn = self._fns.get(C)
         if fn is None:
             fn = self._fns[C] = self._build(C)
-        params = {k: t._value for k, t in self._param_tensors.items()}
+        params = _step_params(self._param_tensors, self._tp)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         nxt, new_kcs, new_vcs = fn(
@@ -258,7 +365,9 @@ class MixedStep:
 
     def __init__(self, model, caches: List, bt_width: int,
                  max_spans: int, span_q: int,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 mesh=None, sharding=None,
+                 tp: Optional[TPContext] = None):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -274,6 +383,7 @@ class MixedStep:
         if use_pallas is None:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
+        self._tp = _resolve_tp(model, mesh, sharding, tp)
         self._param_tensors = dict(model.state_dict())
         self._fns = {}                 # token budget -> jitted step
         self.compile_counts = {}       # token budget -> trace count
@@ -281,6 +391,14 @@ class MixedStep:
     @property
     def total_compiles(self) -> int:
         return sum(self.compile_counts.values())
+
+    def collective_bytes(self, T: int):
+        """Per-chip collective payload of one sharded step at budget
+        ``T`` ({} when single-chip; see
+        ``spmd.TPContext.collective_bytes``)."""
+        if self._tp is None:
+            return {}
+        return self._tp.collective_bytes(self.cfg, T, self.max_spans)
 
     def _build(self, T: int):
         from ..autograd.tape import no_grad
@@ -291,9 +409,14 @@ class MixedStep:
         model = self.model
         cfg = self.cfg
         llama = model.llama
-        H = cfg.num_attention_heads
-        Hkv = cfg.num_key_value_heads
-        D = cfg.hidden_size // H
+        tp = self._tp
+        deg = tp.degree if tp is not None else 1
+        # under tensor parallelism the traced body sees this chip's
+        # LOCAL head shard: projections produce H/tp query and Hkv/tp
+        # kv heads, and the (head-sharded) page pools match
+        H = cfg.num_attention_heads // deg
+        Hkv = cfg.num_key_value_heads // deg
+        D = cfg.hidden_size // cfg.num_attention_heads
         scale = 1.0 / math.sqrt(D)
         span_q = min(self.span_q, T)
         use_pallas = self.use_pallas
@@ -333,8 +456,7 @@ class MixedStep:
             sample_rows = span_tab[:, W + 3]
             new_kcs, new_vcs = [], []
             with model.bind_state(params), no_grad():
-                x = llama.embed_tokens(
-                    Tensor._from_value(tokens[None, :]))       # [1, T, h]
+                x = _embed(llama, tokens[None, :], tp)         # [1, T, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos_t = Tensor._from_value(positions[None, :])
@@ -355,9 +477,9 @@ class MixedStep:
                     out = attn(q._value[0], kc, vc, bt, q_offsets,
                                q_lens, kv_lens)
                     out = Tensor._from_value(out.reshape(1, T, H * D))
-                    x = x + at.o_proj(out)
+                    x = x + _tp_psum(at.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + layer.mlp(h2)
+                    x = x + _tp_psum(layer.mlp(h2), tp)
                 x = llama.norm(x)
                 # only each span's last valid row reaches the LM head:
                 # [max_spans, 1, h] @ [h, V], never the [T, V] block
@@ -369,12 +491,17 @@ class MixedStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(rows)
+                logits = _tp_logits(logits, tp)
             nxt = jnp.argmax(
                 logits._value[:, 0, :].astype(jnp.float32),
                 axis=-1).astype(jnp.int32)
             return nxt, tuple(new_kcs), tuple(new_vcs)
 
-        return jax.jit(step, donate_argnums=(2, 3))
+        if tp is None:
+            return jax.jit(step, donate_argnums=(2, 3))
+        return _wrap_sharded(step, tp, self._param_tensors,
+                             len(self.caches), n_repl=1,
+                             donate=(2, 3))
 
     def __call__(self, tokens, positions, dest_blocks, dest_offsets,
                  q_offsets, q_lens, kv_lens, block_tables,
@@ -419,7 +546,7 @@ class MixedStep:
         fn = self._fns.get(T)
         if fn is None:
             fn = self._fns[T] = self._build(T)
-        params = {k: t._value for k, t in self._param_tensors.items()}
+        params = _step_params(self._param_tensors, self._tp)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         nxt, new_kcs, new_vcs = fn(params, jnp.asarray(pack), kcs, vcs)
@@ -441,7 +568,8 @@ class DecodeStep:
     """
 
     def __init__(self, model, caches: List, use_pallas: Optional[bool]
-                 = None):
+                 = None, mesh=None, sharding=None,
+                 tp: Optional[TPContext] = None):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -449,6 +577,7 @@ class DecodeStep:
         if use_pallas is None:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
+        self._tp = _resolve_tp(model, mesh, sharding, tp)
         # capture the param TENSORS once: per-step we only read their
         # current values, no module-tree walk in the serving hot loop
         self._param_tensors = dict(model.state_dict())
@@ -457,6 +586,13 @@ class DecodeStep:
         # tests can assert the decode step compiles exactly once across
         # admission/eviction churn
         self.compile_count = 0
+
+    def collective_bytes(self, slots: int):
+        """Per-chip collective payload of one sharded decode step over
+        ``slots`` slots ({} when single-chip)."""
+        if self._tp is None:
+            return {}
+        return self._tp.collective_bytes(self.cfg, slots, slots)
 
     def _build(self):
         from ..autograd.tape import no_grad
@@ -468,9 +604,11 @@ class DecodeStep:
         model = self.model
         cfg = self.cfg
         llama = model.llama
-        H = cfg.num_attention_heads
-        Hkv = cfg.num_key_value_heads
-        D = cfg.hidden_size // H
+        tp = self._tp
+        deg = tp.degree if tp is not None else 1
+        H = cfg.num_attention_heads // deg      # this chip's head shard
+        Hkv = cfg.num_key_value_heads // deg
+        D = cfg.hidden_size // cfg.num_attention_heads
         scale = 1.0 / math.sqrt(D)
         attn_fn = _paged_attention_pallas if self.use_pallas \
             else _paged_attention_xla
@@ -480,8 +618,7 @@ class DecodeStep:
             S = tokens.shape[0]
             new_kcs, new_vcs = [], []
             with model.bind_state(params), no_grad():
-                x = llama.embed_tokens(
-                    Tensor._from_value(tokens[:, None]))     # [S, 1, h]
+                x = _embed(llama, tokens[:, None], tp)        # [S, 1, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos = Tensor._from_value(seq_lens[:, None])
@@ -502,9 +639,9 @@ class DecodeStep:
                     out = attn_fn(q._value[:, 0], kc, vc, block_tables,
                                   seq_lens + 1, scale)   # incl. new token
                     out = Tensor._from_value(out.reshape(S, 1, H * D))
-                    x = x + attn.o_proj(out)
+                    x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + layer.mlp(h2)
+                    x = x + _tp_psum(layer.mlp(h2), tp)
                 x = llama.norm(x)
                 if model.lm_head is None:
                     from ..ops.linalg import matmul
@@ -512,6 +649,7 @@ class DecodeStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(x)
+                logits = _tp_logits(logits, tp)
             # greedy sampling ON DEVICE: only the [S] token ids cross
             # the link, never the [S, V] logits
             nxt = jnp.argmax(
@@ -519,12 +657,17 @@ class DecodeStep:
                 axis=-1).astype(jnp.int32)
             return nxt, tuple(new_kcs), tuple(new_vcs)
 
-        self._fn = jax.jit(step, donate_argnums=(4, 5))
+        if tp is None:
+            self._fn = jax.jit(step, donate_argnums=(4, 5))
+        else:
+            self._fn = _wrap_sharded(step, tp, self._param_tensors,
+                                     len(self.caches), n_repl=3,
+                                     donate=(4, 5))
 
     def __call__(self, tokens, seq_lens, block_tables) -> np.ndarray:
         if self._fn is None:
             self._build()
-        params = {k: t._value for k, t in self._param_tensors.items()}
+        params = _step_params(self._param_tensors, self._tp)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         nxt, new_kcs, new_vcs = self._fn(

@@ -1,0 +1,384 @@
+"""Shared SPMD machinery for the fused compiled steps.
+
+One home for everything both the training step (``jit/train_step.py``,
+ZeRO-1/2 weight-update sharding) and the serving steps
+(``jit/serving_step.py``, tensor-parallel multi-chip decode/prefill)
+need to agree on: mesh/axis resolution, the :class:`ShardingConfig`
+the callers hand in, the canonical per-weight-family
+:class:`SpecLayout` (the ``PartitionSpec`` table tensor-parallel
+serving shards the llama weight families by), and the small traced
+helpers (vocab-parallel embedding, logits all-gather) the sharded
+serving bodies compose under ``shard_map``.
+
+Weight-family layout (Megatron-style tensor parallelism over a ``tp``
+mesh axis; Linear weights are ``[in, out]``):
+
+====================  =======================  =========================
+family                spec                     collective it implies
+====================  =======================  =========================
+embed_tokens.weight   P(tp, None)  vocab-row   one psum after the masked
+                                               local lookup (exact: every
+                                               token's row lives on ONE
+                                               chip, the others add 0)
+q/k/v_proj.weight     P(None, tp)  head-col    none (activations stay
+                                               replicated; outputs are
+                                               this chip's head shard)
+o_proj.weight         P(tp, None)  head-row    one psum per layer
+gate/up_proj.weight   P(None, tp)  ffn-col     none
+down_proj.weight      P(tp, None)  ffn-row     one psum per layer
+lm_head.weight        P(None, tp)  vocab-col   one all-gather over the
+                                               vocab shards (exact)
+norms / biases(1-D    P() replicated           none
+  except qkv bias)
+KV page pools         P(None, None, tp, None)  none — each chip's paged
+                                               attention sees only its
+                                               kv-head shard of every
+                                               page
+====================  =======================  =========================
+
+So one fused serving step pays: 1 embedding psum + 2 psums per
+transformer layer (attention out, MLP out) + 1 logits all-gather —
+"one collective per layer boundary", the pattern EQuARX
+(arXiv:2506.17615) quantizes.  The psums split a contraction, so
+activations agree with the single-chip step to float addition order
+(ULPs); the embedding psum and logits all-gather are bit-exact.  The
+parity contract is therefore on the sampled TOKENS, which the serving
+benches gate byte-identically.
+
+SNIPPETS.md [3] ``SpecLayout`` (fsdp×tp, MaxText-style) is the exemplar
+this table specializes: serving has no fsdp axis (weights are read-only
+— replicating them across an fsdp axis buys nothing per step), so every
+family collapses to its tp entry.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ShardingConfig", "SpecLayout", "TPContext",
+           "resolve_mesh_axis", "llama_param_specs",
+           "validate_tp_serving", "tp_mesh", "tp_serving_context",
+           "tp_embed", "tp_gather_logits", "shard_arrays"]
+
+P = PartitionSpec
+
+
+class ShardingConfig:
+    """Sharded-step config shared by :class:`~.train_step.TrainStep`
+    (ZeRO weight-update sharding over a data-parallel axis) and the
+    serving steps (tensor parallelism over a ``tp`` axis).
+
+    stage: ZeRO stage for the TRAIN step — 1 (ZeRO-1 / 'os'): full-
+        gradient all-reduce, optimizer state + weight update sharded
+        over the dp axis; 2 (ZeRO-2 / 'os_g'): the grad sync itself
+        becomes one reduce-scatter per coalesced bucket.  Serving
+        ignores it.
+    degree: number of shards; -1 infers the mesh axis size (a positive
+        value must equal it — sub-axis sharding would need a mesh
+        reshape).
+    axis: mesh axis name to shard over ('dp' on the Engine mesh for
+        training, 'tp' for tensor-parallel serving).
+    bucket_mb: stage-2 coalesced reduce-scatter bucket size (train
+        only).
+    loss_reduction: how per-replica losses/grads combine (train only).
+    """
+
+    def __init__(self, stage: int = 1, degree: int = -1, axis: str = "dp",
+                 bucket_mb: float = 25.0, loss_reduction: str = "mean"):
+        if int(stage) not in (1, 2):
+            raise ValueError(
+                f"ShardingConfig stage must be 1 (os) or 2 (os_g), got "
+                f"{stage!r}; stage 3 stores the params themselves sharded "
+                f"(GroupShardedStage3)")
+        if loss_reduction not in ("mean", "sum"):
+            raise ValueError(
+                f"loss_reduction must be 'mean' or 'sum', got "
+                f"{loss_reduction!r}")
+        self.stage = int(stage)
+        self.degree = int(degree)
+        self.axis = axis
+        self.bucket_mb = float(bucket_mb)
+        self.loss_reduction = loss_reduction
+
+    def __repr__(self):
+        return (f"ShardingConfig(stage={self.stage}, degree={self.degree}, "
+                f"axis={self.axis!r}, bucket_mb={self.bucket_mb}, "
+                f"loss_reduction={self.loss_reduction!r})")
+
+
+def resolve_mesh_axis(mesh, axis: str,
+                      degree: int = -1,
+                      candidates: Sequence[str] = ("dp", "sharding",
+                                                   "data"),
+                      ) -> Tuple[Mesh, str, int]:
+    """Unwrap ``mesh`` to a jax Mesh and pick the axis to shard over.
+
+    ``axis`` wins when present; otherwise the first name in
+    ``candidates`` that exists on the mesh with size > 1.  ``degree``
+    must equal the axis size or be -1 (infer).  Returns
+    ``(jax_mesh, axis_name, axis_size)`` — size 1 means "degenerate:
+    run the unsharded step".
+    """
+    from ..distributed.process_mesh import as_jax_mesh
+    if mesh is None:
+        raise ValueError("ShardingConfig requires a mesh")
+    jmesh = as_jax_mesh(mesh)
+    if axis not in jmesh.axis_names:
+        axis = next((a for a in candidates
+                     if a in jmesh.axis_names and jmesh.shape[a] > 1),
+                    None)
+        if axis is None:
+            raise ValueError(
+                f"no shardable axis on mesh {tuple(jmesh.axis_names)} "
+                f"(wanted one of {tuple(candidates)})")
+    deg = jmesh.shape[axis]
+    if degree not in (-1, deg):
+        raise ValueError(
+            f"sharding degree {degree} must equal the '{axis}' axis "
+            f"size {deg} (or -1 to infer)")
+    return jmesh, axis, deg
+
+
+def tp_mesh(tp: int, axis: str = "tp"):
+    """A 1-D ``tp``-wide ProcessMesh over the first ``tp`` devices —
+    the standard serving mesh (benches, tests, single-host engines).
+    Reuse the train-step mesh instead when co-located (any mesh with a
+    ``tp`` axis resolves)."""
+    from ..distributed.process_mesh import ProcessMesh
+    n = jax.device_count()
+    if tp > n:
+        raise ValueError(
+            f"tp={tp} exceeds the {n} visible devices; for CPU dryruns "
+            f"call paddle_tpu.testing.dryrun.force_cpu_devices first")
+    return ProcessMesh(shape=[tp], dim_names=[axis])
+
+
+# ---------------------------------------------------------------------------
+# canonical per-weight-family specs
+# ---------------------------------------------------------------------------
+class SpecLayout:
+    """Canonical PartitionSpecs per llama weight family for
+    tensor-parallel serving (see the module docstring's table)."""
+
+    def __init__(self, tp_axis: str = "tp"):
+        self.tp_axis = tp_axis
+
+    def embeddings(self) -> PartitionSpec:
+        """[V, h] vocab-row sharded: masked local lookup + one exact
+        psum (Megatron vocab-parallel embedding)."""
+        return P(self.tp_axis, None)
+
+    def qkv_projection(self) -> PartitionSpec:
+        """[h, H*D] column (head) sharded: each chip projects only its
+        own query/kv heads."""
+        return P(None, self.tp_axis)
+
+    def qkv_bias(self) -> PartitionSpec:
+        """[H*D] follows its projection's column shard."""
+        return P(self.tp_axis)
+
+    def attn_output(self) -> PartitionSpec:
+        """[H*D, h] row sharded — the per-layer psum boundary."""
+        return P(self.tp_axis, None)
+
+    def ffn_up(self) -> PartitionSpec:
+        """gate/up [h, I] column sharded (SwiGLU is elementwise on the
+        shard)."""
+        return P(None, self.tp_axis)
+
+    def ffn_down(self) -> PartitionSpec:
+        """down [I, h] row sharded — the other per-layer psum."""
+        return P(self.tp_axis, None)
+
+    def lm_head(self) -> PartitionSpec:
+        """[h, V] vocab-column sharded: local [*, V/tp] logits, one
+        exact all-gather before the on-device argmax."""
+        return P(None, self.tp_axis)
+
+    def replicated(self) -> PartitionSpec:
+        return P()
+
+    def kv_pool(self) -> PartitionSpec:
+        """[phys_pages, block_size, Hkv, D] sharded over kv heads: each
+        chip's paged-attention launch sees only its head shard of every
+        page — per-chip pool HBM is exactly 1/tp."""
+        return P(None, None, self.tp_axis, None)
+
+
+def llama_param_specs(keys: Iterable[str],
+                      layout: Optional[SpecLayout] = None,
+                      ) -> Dict[str, PartitionSpec]:
+    """Classify llama state-dict keys into the canonical family specs.
+
+    Unknown families (norm weights, scalars) stay replicated — correct
+    for anything whose math runs identically on every chip.
+    """
+    layout = layout or SpecLayout()
+    specs: Dict[str, PartitionSpec] = {}
+    for k in keys:
+        if "embed_tokens" in k:
+            specs[k] = layout.embeddings()
+        elif any(p in k for p in ("q_proj", "k_proj", "v_proj")):
+            specs[k] = layout.qkv_bias() if k.endswith("bias") \
+                else layout.qkv_projection()
+        elif "o_proj" in k:
+            specs[k] = layout.attn_output()
+        elif "gate_proj" in k or "up_proj" in k:
+            specs[k] = layout.ffn_up()
+        elif "down_proj" in k:
+            specs[k] = layout.ffn_down()
+        elif "lm_head" in k:
+            specs[k] = layout.lm_head()
+        else:
+            specs[k] = layout.replicated()
+    return specs
+
+
+def shard_arrays(arrays: Dict[str, jnp.ndarray], mesh: Mesh,
+                 specs: Dict[str, PartitionSpec]) -> Dict[str, jnp.ndarray]:
+    """device_put each array with its spec's NamedSharding (the one-time
+    placement at sharded-step init; params never cross the link again)."""
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in arrays.items()}
+
+
+def validate_tp_serving(cfg, degree: int, pool_kv_heads: Optional[int]
+                        = None) -> None:
+    """Every divisibility constraint tensor-parallel serving needs,
+    checked at ENGINE CONSTRUCTION with one actionable message —
+    instead of a shard_map shape failure deep inside tracing."""
+    if degree <= 1:
+        return
+    problems = []
+    for name, val in (("num_attention_heads", cfg.num_attention_heads),
+                      ("num_key_value_heads", cfg.num_key_value_heads),
+                      ("vocab_size", cfg.vocab_size),
+                      ("intermediate_size", cfg.intermediate_size)):
+        if val % degree:
+            problems.append(f"{name}={val}")
+    if pool_kv_heads is not None \
+            and pool_kv_heads != cfg.num_key_value_heads:
+        problems.append(
+            f"KV page pool has {pool_kv_heads} kv heads but the model "
+            f"config says {cfg.num_key_value_heads}")
+    if problems:
+        raise ValueError(
+            "tensor-parallel serving with tp=%d requires every sharded "
+            "dimension to divide by tp; violated: %s.  Pick a tp that "
+            "divides the head/vocab/ffn dims (or pad the model)."
+            % (degree, ", ".join(problems)))
+
+
+class TPContext:
+    """Resolved tensor-parallel serving context, shared by every
+    serving step of one engine: the jax mesh, the axis name/degree, the
+    spec layout, the per-param specs, and the ONE placed copy of the
+    sharded parameters (placed lazily on first use; params are
+    read-only in serving, so they never cross the host link again)."""
+
+    def __init__(self, mesh: Mesh, axis: str, degree: int,
+                 layout: SpecLayout, specs: Dict[str, PartitionSpec]):
+        self.mesh = mesh
+        self.axis = axis
+        self.degree = degree
+        self.layout = layout
+        self.specs = specs
+        self._placed: Optional[Dict[str, jnp.ndarray]] = None
+        self._placed_src: Dict[str, jnp.ndarray] = {}
+
+    def place_params(self, arrays: Dict[str, jnp.ndarray]
+                     ) -> Dict[str, jnp.ndarray]:
+        """Sharded placement with staleness tracking: jax arrays are
+        immutable, so a weight update (checkpoint load, requantize)
+        rebinds the source array — detected per key by identity against
+        a HELD reference (a bare id() could be fooled by address reuse
+        after the old array is freed) and only the changed params are
+        re-placed.  Steady-state serving pays an `is` comparison per
+        param, never a transfer."""
+        if self._placed is None:
+            self._placed = shard_arrays(
+                arrays, self.mesh, {k: self.specs[k] for k in arrays})
+            self._placed_src = dict(arrays)
+            return self._placed
+        for k, v in arrays.items():
+            if self._placed_src.get(k) is not v:
+                self._placed[k] = jax.device_put(
+                    v, NamedSharding(self.mesh, self.specs[k]))
+                self._placed_src[k] = v
+        return self._placed
+
+    def collective_bytes(self, cfg, n_tokens: int,
+                         n_gather_rows: int) -> Dict[str, int]:
+        """Per-chip collective payload of ONE sharded serving dispatch:
+        (1 + 2L) psums of [n_tokens, hidden] (embedding + the two
+        per-layer boundaries) and one all-gather of the
+        [n_gather_rows, vocab/tp] logits shard — the static-per-shape
+        accounting behind ``serving_tp_collective_bytes_total`` and the
+        payload EQuARX-style quantized collectives would shrink."""
+        item = 2 if cfg.dtype == "bfloat16" else 4
+        return {
+            "psum": (2 * cfg.num_hidden_layers + 1) * n_tokens
+            * cfg.hidden_size * item,
+            "all_gather": n_gather_rows
+            * (cfg.vocab_size // self.degree) * item,
+        }
+
+    def pool_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.layout.kv_pool())
+
+    def named(self, spec_tree):
+        """PartitionSpec tree -> NamedSharding tree on this mesh (jit
+        in_shardings/out_shardings from shard_map in_specs/out_specs)."""
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), spec_tree,
+            is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+    def __repr__(self):
+        return (f"TPContext(axis={self.axis!r}, degree={self.degree}, "
+                f"mesh={tuple(self.mesh.shape.items())})")
+
+
+def tp_serving_context(model, mesh, sharding: Optional[ShardingConfig]
+                       = None) -> Optional[TPContext]:
+    """Resolve engine-construction arguments into a :class:`TPContext`
+    (or None when the axis degenerates to 1 — run the single-chip
+    step).  Validates every divisibility constraint up front."""
+    cfg = sharding or ShardingConfig(axis="tp")
+    jmesh, axis, deg = resolve_mesh_axis(
+        mesh, cfg.axis, cfg.degree, candidates=("tp", "model", "mp"))
+    if deg <= 1:
+        return None
+    validate_tp_serving(model.config, deg)
+    layout = SpecLayout(tp_axis=axis)
+    specs = llama_param_specs(model.state_dict().keys(), layout)
+    return TPContext(jmesh, axis, deg, layout, specs)
+
+
+# ---------------------------------------------------------------------------
+# traced helpers (composed inside the shard_map'd serving bodies)
+# ---------------------------------------------------------------------------
+def tp_embed(table_local, tokens, axis: str):
+    """Vocab-parallel embedding lookup (Megatron): ``table_local`` is
+    this chip's [V/tp, h] row shard; returns the REPLICATED [..., h]
+    embeddings.  Exact: each token's row lives on exactly one chip, so
+    the psum adds zeros from every other chip — bit-identical to the
+    single-chip gather."""
+    vs = table_local.shape[0]
+    start = jax.lax.axis_index(axis).astype(jnp.int32) * vs
+    local = tokens.astype(jnp.int32) - start
+    ok = (local >= 0) & (local < vs)
+    e = table_local[jnp.clip(local, 0, vs - 1)]
+    e = jnp.where(ok[..., None], e, jnp.zeros((), e.dtype))
+    return jax.lax.psum(e, axis)
+
+
+def tp_gather_logits(logits_local, axis: str):
+    """All-gather the [*, V/tp] vocab-sharded logits into the
+    replicated [*, V] block (exact — pure concatenation in chip order,
+    which IS vocab order under the column shard), so the on-device
+    argmax sees the same values as the single-chip step."""
+    return jax.lax.all_gather(logits_local, axis,
+                              axis=logits_local.ndim - 1, tiled=True)

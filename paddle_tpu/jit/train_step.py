@@ -43,47 +43,12 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer, Parameter
 from ..optimizer.optimizer import Optimizer
 from ..ops import random as _random
+# the mesh/axis/spec machinery is shared with the serving steps — one
+# SPMD module (jit/spmd.py) is the single source of both; ShardingConfig
+# is re-exported here for the existing import sites
+from .spmd import ShardingConfig, resolve_mesh_axis
 
-
-class ShardingConfig:
-    """ZeRO-style sharded-weight-update config for :class:`TrainStep`.
-
-    stage: 1 (ZeRO-1 / 'os'): full-gradient all-reduce, optimizer state
-        + weight update sharded over the dp axis.  2 (ZeRO-2 / 'os_g'):
-        the grad sync itself becomes one reduce-scatter per coalesced
-        bucket — each replica only ever receives its 1/dp grad shard.
-    degree: number of update shards; -1 infers the mesh axis size (a
-        positive value must equal it — sub-axis sharding would need a
-        mesh reshape).
-    axis: mesh axis name to shard over ('dp' on the Engine mesh,
-        'sharding'/'data' on fleet HCG meshes).
-    bucket_mb: stage-2 coalesced reduce-scatter bucket size (same role
-        as the DP-overlap pass's ``bucket_size_mb``).
-    loss_reduction: how per-replica losses/grads combine ('mean' for
-        mean-reduced criteria — the common case — or 'sum').
-    """
-
-    def __init__(self, stage: int = 1, degree: int = -1, axis: str = "dp",
-                 bucket_mb: float = 25.0, loss_reduction: str = "mean"):
-        if int(stage) not in (1, 2):
-            raise ValueError(
-                f"ShardingConfig stage must be 1 (os) or 2 (os_g), got "
-                f"{stage!r}; stage 3 stores the params themselves sharded "
-                f"(GroupShardedStage3)")
-        if loss_reduction not in ("mean", "sum"):
-            raise ValueError(
-                f"loss_reduction must be 'mean' or 'sum', got "
-                f"{loss_reduction!r}")
-        self.stage = int(stage)
-        self.degree = int(degree)
-        self.axis = axis
-        self.bucket_mb = float(bucket_mb)
-        self.loss_reduction = loss_reduction
-
-    def __repr__(self):
-        return (f"ShardingConfig(stage={self.stage}, degree={self.degree}, "
-                f"axis={self.axis!r}, bucket_mb={self.bucket_mb}, "
-                f"loss_reduction={self.loss_reduction!r})")
+__all__ = ["TrainStep", "ShardingConfig"]
 
 
 class _ParamShim:
@@ -151,24 +116,9 @@ class TrainStep:
 
     # -- sharded setup -------------------------------------------------------
     def _setup_sharded(self, mesh, cfg: ShardingConfig, sd):
-        from ..distributed.process_mesh import as_jax_mesh
-        if mesh is None:
-            raise ValueError("ShardingConfig requires a mesh")
-        jmesh = as_jax_mesh(mesh)
-        axis = cfg.axis
-        if axis not in jmesh.axis_names:
-            axis = next((a for a in ("dp", "sharding", "data")
-                         if a in jmesh.axis_names
-                         and jmesh.shape[a] > 1), None)
-            if axis is None:
-                raise ValueError(
-                    f"no data-parallel axis on mesh {jmesh.axis_names} "
-                    f"(wanted {cfg.axis!r})")
-        deg = jmesh.shape[axis]
-        if cfg.degree not in (-1, deg):
-            raise ValueError(
-                f"sharding degree {cfg.degree} must equal the '{axis}' "
-                f"axis size {deg} (or -1 to infer)")
+        jmesh, axis, deg = resolve_mesh_axis(
+            mesh, cfg.axis, cfg.degree,
+            candidates=("dp", "sharding", "data"))
         if deg <= 1:
             return     # degenerate: plain replicated step
         other = [a for a in jmesh.axis_names if a != axis

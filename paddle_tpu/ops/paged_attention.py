@@ -89,6 +89,29 @@ class PagedKVCache:
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._ref: dict = {}            # block id -> live reference count
 
+    def place(self, sharding):
+        """Place both pools with a ``NamedSharding`` — the
+        tensor-parallel serving engine head-shards them
+        (``P(None, None, 'tp', None)``): each chip physically holds
+        only its kv-head slice of every page, so per-chip pool HBM is
+        exactly 1/tp.  Free-list/refcount state is host bookkeeping and
+        needs no placement.  Call once at engine construction, before
+        any compiled step consumes (donates) the arrays."""
+        self.key_cache = jax.device_put(self.key_cache, sharding)
+        self.value_cache = jax.device_put(self.value_cache, sharding)
+
+    def per_chip_pool_bytes(self) -> int:
+        """Bytes of ONE chip's shard of this layer's K+V pools (the
+        whole pool when unsharded) — the capacity number the
+        multi-chip serving bench gates at ≈ pool/tp."""
+        total = 0
+        for arr in (self.key_cache, self.value_cache):
+            shape = arr.sharding.shard_shape(arr.shape) \
+                if getattr(arr, "sharding", None) is not None \
+                else arr.shape
+            total += int(np.prod(shape)) * arr.dtype.itemsize
+        return total
+
     def allocate_block(self) -> int:
         if not self._free:
             raise RuntimeError(

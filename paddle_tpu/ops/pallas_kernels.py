@@ -1534,10 +1534,26 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
                                    interpret=False):
     """q: [T, H, D] packed ragged tokens; block_tables [S, W]; span
     tables [S].  span_q: static max span length (>= max(q_lens)).
-    Returns [T, H, D]."""
+    Returns [T, H, D].
+
+    Head sharding (tensor-parallel serving): the kernel is
+    shard-oblivious — every head index here is LOCAL.  Each chip calls
+    it with its own head shard (H/tp queries, Hkv/tp kv heads) against
+    its head shard of every page, the grid is (span, local_kv_head),
+    and no global head id ever appears, so the same kernel serves
+    single-chip and per-chip-shard launches without index plumbing.
+    The only cross-shard invariant is that the GQA group size H/Hkv
+    survives the shard (both divide by tp) — checked below.
+    """
     T, H, D = q.shape
     Hkv = key_cache.shape[2]
     bs = key_cache.shape[1]
+    if Hkv <= 0 or H % Hkv:
+        raise ValueError(
+            "ragged paged attention: %d query heads do not group over "
+            "%d kv heads — under tensor parallelism shard both by the "
+            "same tp degree so the GQA group size is preserved"
+            % (H, Hkv))
     groups = H // Hkv
     S, W = block_tables.shape
     span_q = max(1, int(span_q))

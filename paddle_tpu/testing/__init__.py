@@ -1,4 +1,5 @@
-"""Testing utilities: fault injection for robustness tests.
+"""Testing utilities: fault injection for robustness tests, and the
+shared multichip CPU-dryrun setup.
 
 Parity: the reference exercises its fault-tolerance paths with chaos
 tests under test/collective/fleet (kill-one-rank elastic relaunch) and
@@ -6,8 +7,9 @@ the checkpoint layer's corruption unit tests; here the injection points
 are first-class so any test can script a failure scenario through
 ``PADDLE_TPU_FAULT_SPEC``.
 """
+from .dryrun import force_cpu_devices
 from .faults import (FaultRule, FaultInjector, FaultError, fault_point,
                      configure, active_spec, reset)
 
 __all__ = ["FaultRule", "FaultInjector", "FaultError", "fault_point",
-           "configure", "active_spec", "reset"]
+           "configure", "active_spec", "reset", "force_cpu_devices"]

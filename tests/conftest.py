@@ -6,20 +6,21 @@ test_dist_base.py) — here a single process with 8 virtual XLA CPU devices.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 # Engine.fit's MFU probe AOT-compiles the train step once more per fit;
 # ~0.4s x every Engine test would blow the suite's 870s budget.  The
 # probe itself is covered directly (test_observability
 # test_train_step_compiled_stats) and end-to-end by
 # tools/bench_observability.py.
 os.environ.setdefault("PADDLE_TPU_MFU_COST_ANALYSIS", "0")
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
 
-import jax
+# the shared multichip dryrun setup (paddle_tpu/testing/dryrun.py) —
+# sets JAX_PLATFORMS=cpu + the host-device-count flag before the CPU
+# client initializes (importing paddle_tpu does not initialize it)
+from paddle_tpu.testing.dryrun import force_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
+
+import jax  # noqa: E402,F401
 
 
 def pytest_configure(config):

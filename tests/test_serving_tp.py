@@ -1,0 +1,202 @@
+"""Tensor-parallel multi-chip serving (round-12 tentpole).
+
+Runs on the conftest-forced 8-device CPU mesh (the shared dryrun setup,
+paddle_tpu/testing/dryrun.py).  The sharded serving steps are explicit
+SPMD programs (shard_map over a 'tp' axis, specs from jit/spmd.py):
+weights shard per family, KV pools shard over kv heads, and the ONLY
+cross-chip traffic is one psum per layer boundary plus the exact
+embedding psum / logits all-gather.  The contract gated here:
+
+- tokens BYTE-IDENTICAL to the single-chip engine on the same workload
+  (tp=2 in tier-1; tp=4 and the split engine in the slow lane);
+- per-chip KV-pool bytes exactly 1/tp (head-sharded pages);
+- compile count still bounded by the token-budget-set size;
+- actionable construction-time errors for non-divisible head counts
+  and the eager-dense-prefill path.
+
+Budget note: the tier-1 suite runs AT the 870s timeout — only the tp=2
+parity test and the (sub-second) validation test are unmarked; every
+sweep is @slow.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing.dryrun import force_cpu_devices
+
+force_cpu_devices(8)     # no-op under conftest; the documented entry
+
+from paddle_tpu.distributed.process_mesh import ProcessMesh  # noqa: E402
+from paddle_tpu.inference.serving import (  # noqa: E402
+    ContinuousBatchingEngine)
+
+PROMPTS = [np.array([7, 9, 2], np.int64),
+           np.array([3, 14, 15, 92, 65], np.int64),
+           np.arange(1, 11, dtype=np.int64)]     # 10 -> chunked
+
+
+def _model(kv_heads=2, seed=0):
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(seed)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            num_attention_heads=4,
+                            num_key_value_heads=kv_heads,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _tp_mesh(tp):
+    return ProcessMesh(shape=[tp], dim_names=["tp"])
+
+
+def _run(model, mesh=None, mixed=True, budget=4, **kw):
+    if mixed:
+        kw.setdefault("mixed_step", True)
+        kw.setdefault("prefill_chunk_size", 4)
+    else:
+        kw.setdefault("prefill_buckets", (4, 8, 16))
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=4,
+                                   mesh=mesh, **kw)
+    rids = []
+    for i, p in enumerate(PROMPTS):
+        rids.append(eng.add_request(p, budget))
+        if i == 0:
+            eng.step()          # stagger: r0 decodes while r1/r2 admit
+    eng.run_to_completion()
+    return eng, [eng.result(r) for r in rids]
+
+
+def test_tp2_mixed_parity_pool_shard_and_compile_bound():
+    """tp=2 fused mixed step: tokens byte-identical to the single-chip
+    mixed engine under admission churn, per-chip KV-pool bytes exactly
+    half, compiles bounded by the budget-set size, the split decode
+    module never traced, and the tp metrics published."""
+    model = _model()
+    e1, t1 = _run(model)
+    e2, t2 = _run(model, mesh=_tp_mesh(2))
+    assert t2 == t1, "tp=2 tokens diverged from the single-chip step"
+    assert e2.tp_degree == 2
+    assert e2.mixed.total_compiles <= len(e2.token_budgets)
+    assert e2.decode_step.compile_count == 0
+    # head-sharded pools: per-chip bytes are EXACTLY 1/tp
+    b1 = e1.caches[0].per_chip_pool_bytes()
+    b2 = e2.caches[0].per_chip_pool_bytes()
+    assert b2 * 2 == b1, (b1, b2)
+    # no page leaks through the sharded path
+    assert len(e2.caches[0]._free) == 64
+    # metrics: degree gauge + per-op collective byte counters
+    from paddle_tpu.observability import default_registry
+    r = default_registry()
+    assert r.get("serving_tp_degree").value == 2.0
+    counter = r.get("serving_tp_collective_bytes_total")
+    assert counter.labels(op="psum").value > 0
+    assert counter.labels(op="all_gather").value > 0
+
+
+def test_tp_validation_errors_at_construction():
+    """Head-divisibility and pool-shape problems must fail engine
+    construction with an actionable message — not a shard_map shape
+    error deep in tracing; the eager dense-prefill path is rejected
+    under tp."""
+    model = _model()                       # 4 heads, 2 kv heads
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatchingEngine(model, max_batch_size=2, num_blocks=16,
+                                 block_size=4, mixed_step=True,
+                                 mesh=_tp_mesh(4))   # kv 2 % 4 != 0
+    with pytest.raises(ValueError, match="dense"):
+        ContinuousBatchingEngine(model, max_batch_size=2, num_blocks=16,
+                                 block_size=4, mesh=_tp_mesh(2))
+    # tp=1 degenerates to the plain single-chip engine
+    eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                   num_blocks=16, block_size=4,
+                                   mixed_step=True, mesh=_tp_mesh(1))
+    assert eng.tp is None and eng.tp_degree == 1
+
+
+@pytest.mark.slow
+def test_tp4_mixed_parity():
+    """tp=4 (kv heads lifted to 4 so every dim divides): byte parity +
+    compile bound + quarter pools."""
+    model = _model(kv_heads=4)
+    e1, t1 = _run(model)
+    e4, t4 = _run(model, mesh=_tp_mesh(4))
+    assert t4 == t1
+    assert e4.mixed.total_compiles <= len(e4.token_budgets)
+    assert e4.caches[0].per_chip_pool_bytes() * 4 == \
+        e1.caches[0].per_chip_pool_bytes()
+
+
+@pytest.mark.slow
+def test_tp_head_sharded_pool_audit():
+    """Each chip's pool shard must hold exactly its kv-head slice of
+    every page: layer-0 K/V (produced from bit-identical replicated
+    activations) matches the single-chip pool bitwise; deeper layers to
+    float tolerance (their inputs crossed a psum, which reorders the
+    contraction sum)."""
+    model = _model()
+    e1, _ = _run(model)
+    e2, _ = _run(model, mesh=_tp_mesh(2))
+    for li, (c1, c2) in enumerate(zip(e1.caches, e2.caches)):
+        for a1, a2 in ((c1.key_cache, c2.key_cache),
+                       (c1.value_cache, c2.value_cache)):
+            full = np.asarray(a1)
+            for shard in a2.addressable_shards:
+                want = full[tuple(shard.index)]
+                got = np.asarray(shard.data)
+                assert got.shape[2] == c2.num_kv_heads // 2, (
+                    "pool shard is not head-sharded")
+                if li == 0:
+                    np.testing.assert_array_equal(got, want)
+                else:
+                    np.testing.assert_allclose(got, want, rtol=2e-5,
+                                               atol=2e-6)
+
+
+@pytest.mark.slow
+def test_tp_prefix_cache_cow_parity_and_leak_free():
+    """Prefix-cache sharing and the whole-prompt-hit copy-on-write page
+    copy must survive head-sharded pools: byte parity, refcounts
+    settle, no page leaked."""
+    model = _model()
+    P = np.array([5, 17, 42, 7, 99, 3, 11, 23], np.int64)
+    B = np.concatenate([P, [77, 8]])
+
+    def run(mesh):
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=2, num_blocks=32, block_size=4,
+            mixed_step=True, prefill_chunk_size=4,
+            enable_prefix_cache=True, mesh=mesh)
+        ra = eng.add_request(P, 4)
+        eng.run_to_completion()
+        rb = eng.add_request(B, 4)
+        rc = eng.add_request(P, 4)       # whole-prompt hit -> COW
+        eng.run_to_completion()
+        return eng, [eng.result(r) for r in (ra, rb, rc)]
+
+    e1, t1 = run(None)
+    e2, t2 = run(_tp_mesh(2))
+    assert t2 == t1
+    assert e2.finished[2].prefix_hit_tokens == 7      # COW capped hit
+    pc = e2.prefix_cache
+    cached = pc.cached_blocks()
+    c0 = e2.caches[0]
+    assert all(c0.refcount(b) == 1 for b in cached)
+    assert len(c0._free) + len(cached) == c0.num_blocks
+
+
+@pytest.mark.slow
+def test_tp_split_engine_parity():
+    """The default split path (bucketed PrefillStep + DecodeStep) under
+    tp=2: byte parity with the single-chip split engine, prefill
+    compiles still bounded by the bucket count, decode still compiles
+    once."""
+    model = _model()
+    _, t1 = _run(model, mixed=False)
+    e2, t2 = _run(model, mesh=_tp_mesh(2), mixed=False)
+    assert t2 == t1
+    assert e2.decode_step.compile_count == 1
+    assert e2.prefill_step.total_compiles <= len(e2.prefill_buckets)

@@ -24,19 +24,17 @@ import os
 import sys
 import time
 
-# before ANY jax import: the forced host-device count only applies when
-# the CPU client initializes under these env vars
-os.environ["JAX_PLATFORMS"] = "cpu"
-if "xla_force_host_platform_device_count" not in \
-        os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8").strip()
-
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+# ONE shared dryrun setup (paddle_tpu/testing/dryrun.py) instead of the
+# old hand-rolled env block — safe before the first jax.devices() call
+# because importing paddle_tpu never initializes a jax backend
+from paddle_tpu.testing.dryrun import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
+import numpy as np  # noqa: E402
 
 N_DEV = 8
 STEPS = 12

@@ -60,6 +60,9 @@ REQUIRED_NAMES = frozenset({
     # fused mixed prefill+decode step (round-11; BENCH_SERVE_r11.json)
     "serving_mixed_step_compiles_total",
     "serving_mixed_span_tokens_total",
+    # tensor-parallel multichip serving (round-12; BENCH_SERVE_r12.json)
+    "serving_tp_degree",
+    "serving_tp_collective_bytes_total",
 })
 
 
