@@ -131,6 +131,29 @@ class ContinuousBatchingEngine:
     the single-chip engine (BENCH_SERVE_r12.json gates this).
     Requires ``mixed_step=True`` or ``prefill_buckets`` (the legacy
     dense prefill is eager, single-chip math).
+
+    Quantization (round 13; defaults off — the fp32/bf16 engine stays
+    byte-identical):
+
+    - ``kv_dtype="int8"``: the paged pools store int8 codes plus
+      per-page-per-head fp32 absmax scales — ~4× (fp32) / ~2× (bf16)
+      pages per HBM byte, scales counted.  Writes quantize inside the
+      compiled steps, every attention path dequantizes into the same
+      fp32 online-softmax, COW/prefix sharing carry scales with pages.
+    - ``weight_quant="int8"``: per-output-channel absmax PTQ over the
+      projection weights (``quantization.functional.
+      quantize_param_tree``); the steps dequantize on use, so HBM
+      holds the int8 tree (+ scale vectors) — ~4× smaller weights.
+    - ``quant_collectives=True`` (needs ``mesh``): the tp logits
+      all-gather moves int8 codes + per-shard scales (EQuARX-style,
+      arXiv:2506.17615) instead of fp words.
+
+    All three are TOLERANCE-gated, not parity-gated: the quantization
+    bench (BENCH_QUANT_r13.json) reports greedy token-match rate vs
+    the fp32 engine per workload against declared thresholds.  Both
+    quant modes need a compiled prefill path (``mixed_step=True`` or
+    ``prefill_buckets``) — the legacy dense prefill runs eager fp
+    math and is rejected at construction.
     """
 
     def __init__(self, model, max_batch_size: int = 8,
@@ -143,9 +166,37 @@ class ContinuousBatchingEngine:
                  enable_prefix_cache: bool = False,
                  mixed_step: bool = False,
                  token_budgets="auto",
-                 mesh=None, sharding=None):
+                 mesh=None, sharding=None,
+                 kv_dtype: Optional[str] = None,
+                 weight_quant: Optional[str] = None,
+                 quant_collectives: bool = False):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
+        # ---- quantization validation (construction-time, PR-7 norm:
+        # a clear error HERE, never a dtype/shape failure deep inside
+        # tracing) --------------------------------------------------
+        if kv_dtype not in (None, "float32", "bfloat16", "int8"):
+            raise ValueError(
+                "ContinuousBatchingEngine kv_dtype must be None (follow "
+                "the model dtype), 'float32', 'bfloat16' or 'int8'; got "
+                "%r" % (kv_dtype,))
+        if weight_quant not in (None, "int8"):
+            raise ValueError(
+                "ContinuousBatchingEngine weight_quant must be None or "
+                "'int8'; got %r" % (weight_quant,))
+        if (kv_dtype == "int8" or weight_quant == "int8") \
+                and not mixed_step and not prefill_buckets:
+            raise ValueError(
+                "quantized serving (kv_dtype='int8' / weight_quant="
+                "'int8') needs a compiled prefill path: pass "
+                "mixed_step=True or prefill_buckets='auto' — the legacy "
+                "dense prefill runs the model eagerly in fp and writes "
+                "unquantized K/V")
+        if quant_collectives and mesh is None and sharding is None:
+            raise ValueError(
+                "quant_collectives=True quantizes the tensor-parallel "
+                "logits all-gather; a single-chip engine has no "
+                "collectives — pass mesh= (tp >= 2) or drop the flag")
         # ---- tensor-parallel serving (multi-chip) --------------------
         # mesh + ShardingConfig(axis='tp') shard the fused steps over
         # the tp axis (jit/spmd.py is the single source of the mesh /
@@ -159,6 +210,11 @@ class ContinuousBatchingEngine:
         else:
             self.tp = None
         self.tp_degree = self.tp.degree if self.tp is not None else 1
+        if quant_collectives and self.tp is None:
+            raise ValueError(
+                "quant_collectives=True but the mesh's tp axis "
+                "degenerates to 1 chip — there is no logits all-gather "
+                "to quantize; use tp >= 2 or drop the flag")
         if self.tp is not None and not mixed_step and not prefill_buckets:
             raise ValueError(
                 "tensor-parallel serving needs a compiled prefill path: "
@@ -179,11 +235,21 @@ class ContinuousBatchingEngine:
         self.block_size = block_size
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.kv_quant = kv_dtype == "int8"
         self.caches = [
             PagedKVCache(num_blocks, block_size,
                          cfg.num_key_value_heads, self.head_dim, dtype,
-                         sink_block=True)
+                         sink_block=True, kv_dtype=kv_dtype)
             for _ in range(cfg.num_hidden_layers)]
+        # per-channel absmax PTQ: quantize ONCE at construction; every
+        # step consumes the same int8+scales tree via dequant-on-use
+        if weight_quant == "int8":
+            from ..quantization.functional import quantize_param_tree
+            self.weight_qtree = quantize_param_tree(
+                {k: t._value for k, t in model.state_dict().items()})
+        else:
+            self.weight_qtree = None
+        self.quant_collectives = bool(quant_collectives)
         if self.tp is not None:
             # re-check against the pool actually built (paranoia for
             # subclasses that override cache construction), then place:
@@ -192,8 +258,10 @@ class ContinuousBatchingEngine:
             validate_tp_serving(cfg, self.tp_degree,
                                 pool_kv_heads=self.caches[0].num_kv_heads)
             pool_sh = self.tp.pool_sharding()
+            scale_sh = self.tp.kv_scale_sharding() if self.kv_quant \
+                else None
             for c in self.caches:
-                c.place(pool_sh)
+                c.place(pool_sh, scale_sh)
         if max_seq_len is None:
             max_seq_len = max(block_size,
                               num_blocks * block_size // max_batch_size)
@@ -211,8 +279,10 @@ class ContinuousBatchingEngine:
         self._seq_lens = np.zeros((max_batch_size,), np.int32)
         self._bt = np.full((max_batch_size, self.bt_width), self._sink,
                            np.int32)
-        self.decode_step = DecodeStep(model, self.caches,
-                                      use_pallas=use_pallas, tp=self.tp)
+        self.decode_step = DecodeStep(
+            model, self.caches, use_pallas=use_pallas, tp=self.tp,
+            weight_qparams=self.weight_qtree,
+            quant_collectives=self.quant_collectives)
 
         # ---- bucketed / chunked prefill ------------------------------
         if prefill_buckets == "auto":
@@ -229,8 +299,10 @@ class ContinuousBatchingEngine:
                     "prefill_chunk_size %d exceeds the top bucket %d — "
                     "every chunk must map to a compiled bucket"
                     % (self.chunk_size, buckets[-1]))
-            self.prefill_step = PrefillStep(model, self.caches,
-                                            self.bt_width, tp=self.tp)
+            self.prefill_step = PrefillStep(
+                model, self.caches, self.bt_width, tp=self.tp,
+                weight_qparams=self.weight_qtree,
+                quant_collectives=self.quant_collectives)
         else:
             self.chunk_size = None
             self.prefill_step = None
@@ -258,7 +330,10 @@ class ContinuousBatchingEngine:
                                    max_spans=max_batch_size,
                                    span_q=min(self.chunk_size,
                                               budgets[-1]),
-                                   use_pallas=use_pallas, tp=self.tp)
+                                   use_pallas=use_pallas, tp=self.tp,
+                                   weight_qparams=self.weight_qtree,
+                                   quant_collectives=
+                                   self.quant_collectives)
             # padding tokens spread over the sink page's slots
             self._dest_pad = (np.arange(budgets[-1], dtype=np.int32)
                               % block_size)
@@ -356,6 +431,27 @@ class ContinuousBatchingEngine:
         self._m_tp_psum = self._m_tp_collective.labels(op="psum")
         self._m_tp_all_gather = \
             self._m_tp_collective.labels(op="all_gather")
+        self._m_kv_quant_dtype = r.gauge(
+            "serving_kv_quant_dtype",
+            "KV-cache element width in bits of the most recently "
+            "constructed engine (8 = int8 quantized pools, 16/32 = fp)")
+        # read the CONSTRUCTED pool's dtype (kv_dtype may explicitly
+        # override the model dtype, e.g. bfloat16 pools under fp32)
+        self._m_kv_quant_dtype.set(
+            self.caches[0].key_cache.dtype.itemsize * 8)
+        self._m_quant_collective = r.counter(
+            "serving_quant_collective_bytes_total",
+            "per-chip bytes moved through QUANTIZED collectives (the "
+            "EQuARX-style int8 logits all-gather: codes + per-shard "
+            "scales)", labels=("op",))
+        self._m_quant_all_gather = \
+            self._m_quant_collective.labels(op="all_gather")
+        self._m_quant_mismatch = r.counter(
+            "serving_quant_token_mismatch_total",
+            "greedy tokens that diverged from the fp32 reference "
+            "engine on a paired run (published by the quantization "
+            "bench/tests via record_token_mismatches — the tolerance "
+            "gate's numerator)")
         # compile warmup never lands in a latency histogram.  Bucketed
         # prefill tracks warmth PER BUCKET via the step's own compile
         # counters (a call that traced is cold, everything else is warm
@@ -869,11 +965,22 @@ class ContinuousBatchingEngine:
     def _count_collectives(self, by_op: Dict[str, int]):
         """Publish one sharded dispatch's per-chip collective payload
         (host-side accounting — the byte counts are static per compiled
-        shape, so nothing is fetched from the device)."""
+        shape, so nothing is fetched from the device).  When the logits
+        all-gather is quantized, its (already-int8-sized) payload is
+        additionally counted under the quantized-collective family."""
         if by_op.get("psum"):
             self._m_tp_psum.inc(by_op["psum"])
         if by_op.get("all_gather"):
             self._m_tp_all_gather.inc(by_op["all_gather"])
+            if self.quant_collectives:
+                self._m_quant_all_gather.inc(by_op["all_gather"])
+
+    def record_token_mismatches(self, n: int):
+        """Feed the quant token-mismatch counter (callers: the paired
+        fp32-vs-quant bench/test harnesses that actually know the
+        reference tokens)."""
+        if n:
+            self._m_quant_mismatch.inc(int(n))
 
     def _append_token(self, req: GenerationRequest, token: int):
         req.output_ids.append(token)

@@ -37,6 +37,18 @@ exactly one psum per layer boundary (attention out, MLP out) plus one
 exact embedding psum and one exact logits all-gather.  Donation, the
 compile-count invariants, and the single packed int32 host transfer
 all survive sharding unchanged.
+
+Quantization (round 13): when the engine's pools are int8
+(``PagedKVCache(kv_dtype="int8")``) the same traced bodies switch to
+the quantize-on-write/dequant-on-read ops and thread the per-layer
+scale tables through as extra donated operands (EMPTY tuples on the fp
+path, so the default trace — and compiled module — stays
+byte-identical); a serving-PTQ weight tree (int8 + ``::scale``
+vectors) replaces the fp params operand and ``_materialize_params``
+dequantizes it inside the trace; ``quant_collectives`` swaps the exact
+tp logits all-gather for the EQuARX-style int8 one.  All
+tolerance-gated by ``tools/bench_serving.py --quant``
+(BENCH_QUANT_r13.json).
 """
 from __future__ import annotations
 
@@ -51,7 +63,7 @@ from jax.sharding import PartitionSpec
 
 from ..core.tensor import Tensor
 from .spmd import (TPContext, tp_embed, tp_gather_logits,
-                   tp_serving_context)
+                   tp_gather_logits_q8, tp_serving_context)
 
 __all__ = ["DecodeStep", "PrefillStep", "MixedStep", "prefill_scatter",
            "copy_block"]
@@ -89,39 +101,109 @@ def _tp_psum(t: Tensor, tp: Optional[TPContext]) -> Tensor:
     return Tensor._from_value(jax.lax.psum(t._value, tp.axis))
 
 
-def _tp_logits(logits: Tensor, tp: Optional[TPContext]) -> Tensor:
-    """Identity single-chip; the exact vocab-shard all-gather under tp,
-    so the on-device argmax sees the full vocab row."""
+def _tp_logits(logits: Tensor, tp: Optional[TPContext],
+               q8: bool = False) -> Tensor:
+    """Identity single-chip; the vocab-shard all-gather under tp, so
+    the on-device argmax sees the full vocab row.  ``q8`` swaps in the
+    EQuARX-style int8 gather (``spmd.tp_gather_logits_q8``) — ~4× less
+    interconnect payload, tolerance-gated instead of exact."""
     if tp is None:
         return logits
+    if q8:
+        return Tensor._from_value(
+            tp_gather_logits_q8(logits._value, tp.axis))
     return Tensor._from_value(tp_gather_logits(logits._value, tp.axis))
 
 
-def _step_params(param_tensors, tp: Optional[TPContext]):
+def _materialize_params(params, dtype):
+    """Dequant-on-use prologue shared by every traced step body: a
+    serving-PTQ tree (int8 weights + ``::scale`` vectors) comes back as
+    the fp dict ``bind_state`` expects, with the dequant traced INTO
+    the step so XLA fuses it into the consuming matmuls and HBM keeps
+    only the int8 tree.  A plain fp tree passes through untouched (the
+    default path's trace is unchanged)."""
+    from ..quantization.functional import (dequantize_param_tree,
+                                           is_weight_scale_key)
+    if not any(is_weight_scale_key(k) for k in params):
+        return params
+    return dequantize_param_tree(params, dtype)
+
+
+def _step_params(param_tensors, tp: Optional[TPContext], qtree=None):
     """The params operand for one step call: plain values single-chip;
     under tp the context's ONE placed (sharded) copy — so the jit's
     in_shardings alias instead of resharding, and placement happens
-    once per engine, not per step or per call."""
-    vals = {k: t._value for k, t in param_tensors.items()}
+    once per engine, not per step or per call.  ``qtree`` (the
+    serving-PTQ int8+scales tree) replaces the live model values when
+    weight quantization is on — it is device-resident and immutable,
+    so steady state is pointer-identical."""
+    vals = qtree if qtree is not None \
+        else {k: t._value for k, t in param_tensors.items()}
     if tp is None:
         return vals
     return tp.place_params(vals)
 
 
-def _wrap_sharded(step, tp: TPContext, param_tensors, n_layers: int,
-                  n_repl: int, donate):
+def _cache_scales(caches, quant_kv: bool):
+    """The per-layer scale-table operands: empty tuples for fp pools,
+    so the default path's pytree — and therefore its compiled module —
+    is byte-identical to the pre-quantization steps."""
+    if not quant_kv:
+        return (), ()
+    return (tuple(c.key_scale for c in caches),
+            tuple(c.value_scale for c in caches))
+
+
+def _rebind_caches(caches, new_kcs, new_vcs, new_kss, new_vss):
+    """Rebind the donated pool (and scale, when quantized) arrays onto
+    their PagedKVCache owners after a step."""
+    for i, (c, kc, vc) in enumerate(zip(caches, new_kcs, new_vcs)):
+        c.key_cache = kc
+        c.value_cache = vc
+        if new_kss:
+            c.key_scale = new_kss[i]
+            c.value_scale = new_vss[i]
+
+
+def _ensure_quant_specs(tp: Optional[TPContext], qtree) -> None:
+    """Register the PTQ tree's ``::scale`` keys in the shared context's
+    spec table (idempotent — the engine's steps share one TPContext)
+    and reject an incompatible layout up front: a column-sharded
+    weight's scale vector must itself split by tp."""
+    if tp is None or qtree is None:
+        return
+    from .spmd import llama_param_specs
+    missing = [k for k in qtree if k not in tp.specs]
+    if missing:
+        tp.specs.update(llama_param_specs(missing, tp.layout))
+    for k, v in qtree.items():
+        spec = tp.specs[k]
+        if v.ndim == 1 and tuple(spec) and spec[0] is not None \
+                and v.shape[0] % tp.degree:
+            raise ValueError(
+                "quantized weights are incompatible with this tp spec: "
+                "scale vector %r has %d channels, not divisible by the "
+                "tp degree %d (spec %s)"
+                % (k, v.shape[0], tp.degree, spec))
+
+
+def _wrap_sharded(step, tp: TPContext, params_dict, n_layers: int,
+                  n_repl: int, donate, quant_kv: bool = False):
     """Wrap a serving-step body as the explicit SPMD program: shard_map
-    over the tp axis (params by family spec, the ``n_repl`` host
-    operands replicated, per-layer KV pools head-sharded) under a jit
-    whose in/out shardings pin the placed layouts — donation of the
-    pools carries through, so the cache append stays an in-place HBM
-    update on every chip."""
+    over the tp axis (params by family spec — including int8 weights
+    and their scale vectors, the ``n_repl`` host operands replicated,
+    per-layer KV pools head-sharded with their absmax tables when
+    quantized) under a jit whose in/out shardings pin the placed
+    layouts — donation of the pools carries through, so the cache
+    append stays an in-place HBM update on every chip."""
     from ..core.jax_compat import shard_map_compat
     repl = PartitionSpec()
-    pspecs = {k: tp.specs[k] for k in param_tensors}
+    pspecs = {k: tp.specs[k] for k in params_dict}
     pools = (tp.layout.kv_pool(),) * n_layers
-    in_specs = (pspecs,) + (repl,) * n_repl + (pools, pools)
-    out_specs = (repl, pools, pools)
+    spools = (tp.layout.kv_scale(),) * n_layers if quant_kv else ()
+    in_specs = (pspecs,) + (repl,) * n_repl + (pools, pools,
+                                               spools, spools)
+    out_specs = (repl, pools, pools, spools, spools)
     fn = shard_map_compat(step, tp.mesh, in_specs=in_specs,
                           out_specs=out_specs)
     return jax.jit(fn, donate_argnums=donate,
@@ -154,6 +236,12 @@ def prefill_scatter(caches, kv, block_table_row):
     kv: per-layer (k, v) Tensors/arrays [1, L, Hkv, D] from the model's
     dense prefill forward.  block_table_row: [1, W] int32.
     """
+    if getattr(caches[0], "quantized", False):
+        raise NotImplementedError(
+            "prefill_scatter is the legacy dense-prefill write and does "
+            "not quantize; int8 KV pools prefill through the compiled "
+            "PrefillStep/MixedStep paths (the engine rejects the combo "
+            "at construction)")
     ks = tuple(k._value if isinstance(k, Tensor) else jnp.asarray(k)
                for k, _ in kv)
     vs = tuple(v._value if isinstance(v, Tensor) else jnp.asarray(v)
@@ -173,17 +261,43 @@ def _copy_block_impl(kcs, vcs, src, dst):
             tuple(vc.at[dst].set(vc[src]) for vc in vcs))
 
 
+def _copy_block_q8_impl(kcs, vcs, kss, vss, src, dst):
+    """Quantized pools: the page's int8 codes AND its per-head absmax
+    row move together — a copied page dequantizes identically to its
+    source, so copy-on-write never changes what a reader sees."""
+    return (tuple(kc.at[dst].set(kc[src]) for kc in kcs),
+            tuple(vc.at[dst].set(vc[src]) for vc in vcs),
+            tuple(ks.at[dst].set(ks[src]) for ks in kss),
+            tuple(vs.at[dst].set(vs[src]) for vs in vss))
+
+
 # copy-on-write for a shared prefix page: ONE donated dispatch copies the
 # page across every layer's pool; src/dst are traced scalars (no
 # recompile per page id)
 _copy_block_j = jax.jit(_copy_block_impl, donate_argnums=(0, 1))
+_copy_block_q8_j = jax.jit(_copy_block_q8_impl,
+                           donate_argnums=(0, 1, 2, 3))
 
 
 def copy_block(caches, src: int, dst: int):
     """Copy physical page ``src`` to ``dst`` in every layer's K/V pool
-    (rebinds the PagedKVCache arrays in place)."""
+    (rebinds the PagedKVCache arrays in place; an int8 pool's scale
+    rows travel with their pages)."""
     kcs = tuple(c.key_cache for c in caches)
     vcs = tuple(c.value_cache for c in caches)
+    if getattr(caches[0], "quantized", False):
+        kss = tuple(c.key_scale for c in caches)
+        vss = tuple(c.value_scale for c in caches)
+        new_k, new_v, new_ks, new_vs = _copy_block_q8_j(
+            kcs, vcs, kss, vss, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+        for c, kc, vc, ks, vs in zip(caches, new_k, new_v, new_ks,
+                                     new_vs):
+            c.key_cache = kc
+            c.value_cache = vc
+            c.key_scale = ks
+            c.value_scale = vs
+        return
     new_k, new_v = _copy_block_j(kcs, vcs, jnp.asarray(src, jnp.int32),
                                  jnp.asarray(dst, jnp.int32))
     for c, kc, vc in zip(caches, new_k, new_v):
@@ -215,7 +329,8 @@ class PrefillStep:
 
     def __init__(self, model, caches: List, bt_width: int,
                  mesh=None, sharding=None,
-                 tp: Optional[TPContext] = None):
+                 tp: Optional[TPContext] = None,
+                 weight_qparams=None, quant_collectives: bool = False):
         self.model = model
         self.caches = caches
         self.cfg = model.config
@@ -226,6 +341,10 @@ class PrefillStep:
                              "(PagedKVCache(sink_block=True)) to mask "
                              "bucket padding writes")
         self._tp = _resolve_tp(model, mesh, sharding, tp)
+        self._quant_kv = bool(getattr(caches[0], "quantized", False))
+        self._wq = weight_qparams
+        self._q8_gather = bool(quant_collectives)
+        _ensure_quant_specs(self._tp, weight_qparams)
         self._param_tensors = dict(model.state_dict())
         self._fns = {}                 # bucket width -> jitted step
         self.compile_counts = {}       # bucket width -> trace count
@@ -239,14 +358,16 @@ class PrefillStep:
         width ``C`` ({} when single-chip; one logits row)."""
         if self._tp is None:
             return {}
-        return self._tp.collective_bytes(self.cfg, C, 1)
+        return self._tp.collective_bytes(self.cfg, C, 1,
+                                         quant_gather=self._q8_gather)
 
     def _build(self, C: int):
         from ..autograd.tape import no_grad
         from ..incubate.nn.functional import \
             fused_rotary_position_embedding
         from ..ops.paged_attention import (chunk_prefill_attention,
-                                           write_chunk_kv)
+                                           write_chunk_kv,
+                                           write_chunk_kv_q8)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -257,17 +378,23 @@ class PrefillStep:
         D = cfg.hidden_size // cfg.num_attention_heads
         scale = 1.0 / math.sqrt(D)
         sink = self.sink
+        quant_kv = self._quant_kv
+        q8_gather = self._q8_gather
+        pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        def step(params, tokens, start, n_valid, bt, kcs, vcs):
+        def step(params, tokens, start, n_valid, bt, kcs, vcs, kss, vss):
             self.compile_counts[C] = self.compile_counts.get(C, 0) + 1
+            params = _materialize_params(params, pdtype)
             new_kcs, new_vcs = [], []
+            new_kss, new_vss = [], []
             with model.bind_state(params), no_grad():
                 x = _embed(llama, tokens, tp)
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos = start + jnp.arange(C, dtype=jnp.int32)
                 pos_t = Tensor._from_value(pos[None, :])     # [1, C]
-                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                for li, (layer, kc, vc) in enumerate(
+                        zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
                     attn = layer.self_attn
                     q = attn.q_proj(h).reshape([1, C, H, D])
@@ -276,13 +403,22 @@ class PrefillStep:
                     q, k, _ = fused_rotary_position_embedding(
                         q, k, position_ids=pos_t,
                         rotary_emb_base=cfg.rope_theta)
-                    kc, vc = write_chunk_kv(
-                        k._value, v._value, kc, vc, bt, start, n_valid,
-                        sink)
+                    if quant_kv:
+                        kc, vc, ks, vs = write_chunk_kv_q8(
+                            k._value, v._value, kc, vc, kss[li],
+                            vss[li], bt, start, n_valid, sink)
+                        new_kss.append(ks)
+                        new_vss.append(vs)
+                    else:
+                        ks = vs = None
+                        kc, vc = write_chunk_kv(
+                            k._value, v._value, kc, vc, bt, start,
+                            n_valid, sink)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
                     out = chunk_prefill_attention(
-                        q._value, kc, vc, bt, start, scale)
+                        q._value, kc, vc, bt, start, scale,
+                        key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(1, C, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
@@ -299,16 +435,18 @@ class PrefillStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(last)
-                logits = _tp_logits(logits, tp)
+                logits = _tp_logits(logits, tp, q8=q8_gather)
             nxt = jnp.argmax(
                 logits._value[0, 0].astype(jnp.float32)).astype(jnp.int32)
-            return nxt, tuple(new_kcs), tuple(new_vcs)
+            return (nxt, tuple(new_kcs), tuple(new_vcs),
+                    tuple(new_kss), tuple(new_vss))
 
         if tp is None:
-            return jax.jit(step, donate_argnums=(5, 6))
-        return _wrap_sharded(step, tp, self._param_tensors,
+            return jax.jit(step, donate_argnums=(5, 6, 7, 8))
+        return _wrap_sharded(step, tp, self._wq or self._param_tensors,
                              len(self.caches), n_repl=4,
-                             donate=(5, 6))
+                             donate=(5, 6, 7, 8),
+                             quant_kv=quant_kv)
 
     def __call__(self, tokens, start: int, n_valid: int,
                  block_table_row) -> int:
@@ -319,19 +457,18 @@ class PrefillStep:
         fn = self._fns.get(C)
         if fn is None:
             fn = self._fns[C] = self._build(C)
-        params = _step_params(self._param_tensors, self._tp)
+        params = _step_params(self._param_tensors, self._tp, self._wq)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
-        nxt, new_kcs, new_vcs = fn(
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        nxt, new_kcs, new_vcs, new_kss, new_vss = fn(
             params,
             jnp.asarray(np.asarray(tokens, np.int32)),
             jnp.asarray(start, jnp.int32),
             jnp.asarray(n_valid, jnp.int32),
             jnp.asarray(np.asarray(block_table_row), jnp.int32),
-            kcs, vcs)
-        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
-            c.key_cache = kc
-            c.value_cache = vc
+            kcs, vcs, kss, vss)
+        _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
         return int(nxt)
 
 
@@ -367,7 +504,8 @@ class MixedStep:
                  max_spans: int, span_q: int,
                  use_pallas: Optional[bool] = None,
                  mesh=None, sharding=None,
-                 tp: Optional[TPContext] = None):
+                 tp: Optional[TPContext] = None,
+                 weight_qparams=None, quant_collectives: bool = False):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -384,6 +522,10 @@ class MixedStep:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
         self._tp = _resolve_tp(model, mesh, sharding, tp)
+        self._quant_kv = bool(getattr(caches[0], "quantized", False))
+        self._wq = weight_qparams
+        self._q8_gather = bool(quant_collectives)
+        _ensure_quant_specs(self._tp, weight_qparams)
         self._param_tensors = dict(model.state_dict())
         self._fns = {}                 # token budget -> jitted step
         self.compile_counts = {}       # token budget -> trace count
@@ -398,14 +540,16 @@ class MixedStep:
         ``spmd.TPContext.collective_bytes``)."""
         if self._tp is None:
             return {}
-        return self._tp.collective_bytes(self.cfg, T, self.max_spans)
+        return self._tp.collective_bytes(self.cfg, T, self.max_spans,
+                                         quant_gather=self._q8_gather)
 
     def _build(self, T: int):
         from ..autograd.tape import no_grad
         from ..incubate.nn.functional import \
             fused_rotary_position_embedding
         from ..ops.paged_attention import (_ragged_attention_xla,
-                                           write_ragged_kv)
+                                           write_ragged_kv,
+                                           write_ragged_kv_q8)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -420,21 +564,24 @@ class MixedStep:
         scale = 1.0 / math.sqrt(D)
         span_q = min(self.span_q, T)
         use_pallas = self.use_pallas
+        quant_kv = self._quant_kv
+        q8_gather = self._q8_gather
+        pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         if use_pallas:
             from ..ops.pallas_kernels import _ragged_paged_attention_pallas
 
-        def attn(q, kc, vc, bt, q_off, q_len, kv_len):
+        def attn(q, kc, vc, bt, q_off, q_len, kv_len, ks=None, vs=None):
             if use_pallas:
                 return _ragged_paged_attention_pallas(
                     q, kc, vc, bt, q_off, q_len, kv_len, scale,
-                    span_q=span_q)
+                    span_q=span_q, key_scale=ks, value_scale=vs)
             return _ragged_attention_xla(q, kc, vc, bt, q_off, q_len,
-                                         kv_len, scale)
+                                         kv_len, scale, ks, vs)
 
         W = self.bt_width
         S = self.max_spans
 
-        def step(params, pack, kcs, vcs):
+        def step(params, pack, kcs, vcs, kss, vss):
             self.compile_counts[T] = self.compile_counts.get(T, 0) + 1
             # unpack the single host buffer (free at trace level —
             # slices of a constant layout): rows 0-3 of the leading
@@ -454,13 +601,16 @@ class MixedStep:
             q_lens = span_tab[:, W + 1]
             kv_lens = span_tab[:, W + 2]
             sample_rows = span_tab[:, W + 3]
+            params = _materialize_params(params, pdtype)
             new_kcs, new_vcs = [], []
+            new_kss, new_vss = [], []
             with model.bind_state(params), no_grad():
                 x = _embed(llama, tokens[None, :], tp)         # [1, T, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos_t = Tensor._from_value(positions[None, :])
-                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                for li, (layer, kc, vc) in enumerate(
+                        zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
                     at = layer.self_attn
                     q = at.q_proj(h).reshape([1, T, H, D])
@@ -469,13 +619,21 @@ class MixedStep:
                     q, k, _ = fused_rotary_position_embedding(
                         q, k, position_ids=pos_t,
                         rotary_emb_base=cfg.rope_theta)
-                    kc, vc = write_ragged_kv(
-                        k._value[0], v._value[0], kc, vc, dest_blocks,
-                        dest_offsets)
+                    if quant_kv:
+                        kc, vc, ks, vs = write_ragged_kv_q8(
+                            k._value[0], v._value[0], kc, vc, kss[li],
+                            vss[li], dest_blocks, dest_offsets)
+                        new_kss.append(ks)
+                        new_vss.append(vs)
+                    else:
+                        ks = vs = None
+                        kc, vc = write_ragged_kv(
+                            k._value[0], v._value[0], kc, vc,
+                            dest_blocks, dest_offsets)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
                     out = attn(q._value[0], kc, vc, bt, q_offsets,
-                               q_lens, kv_lens)
+                               q_lens, kv_lens, ks, vs)
                     out = Tensor._from_value(out.reshape(1, T, H * D))
                     x = x + _tp_psum(at.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
@@ -491,17 +649,19 @@ class MixedStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(rows)
-                logits = _tp_logits(logits, tp)
+                logits = _tp_logits(logits, tp, q8=q8_gather)
             nxt = jnp.argmax(
                 logits._value[:, 0, :].astype(jnp.float32),
                 axis=-1).astype(jnp.int32)
-            return nxt, tuple(new_kcs), tuple(new_vcs)
+            return (nxt, tuple(new_kcs), tuple(new_vcs),
+                    tuple(new_kss), tuple(new_vss))
 
         if tp is None:
-            return jax.jit(step, donate_argnums=(2, 3))
-        return _wrap_sharded(step, tp, self._param_tensors,
+            return jax.jit(step, donate_argnums=(2, 3, 4, 5))
+        return _wrap_sharded(step, tp, self._wq or self._param_tensors,
                              len(self.caches), n_repl=1,
-                             donate=(2, 3))
+                             donate=(2, 3, 4, 5),
+                             quant_kv=self._quant_kv)
 
     def __call__(self, tokens, positions, dest_blocks, dest_offsets,
                  q_offsets, q_lens, kv_lens, block_tables,
@@ -546,13 +706,13 @@ class MixedStep:
         fn = self._fns.get(T)
         if fn is None:
             fn = self._fns[T] = self._build(T)
-        params = _step_params(self._param_tensors, self._tp)
+        params = _step_params(self._param_tensors, self._tp, self._wq)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
-        nxt, new_kcs, new_vcs = fn(params, jnp.asarray(pack), kcs, vcs)
-        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
-            c.key_cache = kc
-            c.value_cache = vc
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        nxt, new_kcs, new_vcs, new_kss, new_vss = fn(
+            params, jnp.asarray(pack), kcs, vcs, kss, vss)
+        _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
         return np.asarray(nxt)
 
 
@@ -569,7 +729,8 @@ class DecodeStep:
 
     def __init__(self, model, caches: List, use_pallas: Optional[bool]
                  = None, mesh=None, sharding=None,
-                 tp: Optional[TPContext] = None):
+                 tp: Optional[TPContext] = None,
+                 weight_qparams=None, quant_collectives: bool = False):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -578,6 +739,10 @@ class DecodeStep:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
         self._tp = _resolve_tp(model, mesh, sharding, tp)
+        self._quant_kv = bool(getattr(caches[0], "quantized", False))
+        self._wq = weight_qparams
+        self._q8_gather = bool(quant_collectives)
+        _ensure_quant_specs(self._tp, weight_qparams)
         # capture the param TENSORS once: per-step we only read their
         # current values, no module-tree walk in the serving hot loop
         self._param_tensors = dict(model.state_dict())
@@ -592,7 +757,8 @@ class DecodeStep:
         ``slots`` slots ({} when single-chip)."""
         if self._tp is None:
             return {}
-        return self._tp.collective_bytes(self.cfg, slots, slots)
+        return self._tp.collective_bytes(self.cfg, slots, slots,
+                                         quant_gather=self._q8_gather)
 
     def _build(self):
         from ..autograd.tape import no_grad
@@ -600,7 +766,8 @@ class DecodeStep:
             fused_rotary_position_embedding
         from ..ops.paged_attention import (_paged_attention_pallas,
                                            _paged_attention_xla,
-                                           write_decode_kv)
+                                           write_decode_kv,
+                                           write_decode_kv_q8)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -612,17 +779,24 @@ class DecodeStep:
         scale = 1.0 / math.sqrt(D)
         attn_fn = _paged_attention_pallas if self.use_pallas \
             else _paged_attention_xla
+        quant_kv = self._quant_kv
+        q8_gather = self._q8_gather
+        pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        def step(params, tokens, seq_lens, block_tables, kcs, vcs):
+        def step(params, tokens, seq_lens, block_tables, kcs, vcs,
+                 kss, vss):
             self.compile_count += 1
             S = tokens.shape[0]
+            params = _materialize_params(params, pdtype)
             new_kcs, new_vcs = [], []
+            new_kss, new_vss = [], []
             with model.bind_state(params), no_grad():
                 x = _embed(llama, tokens[:, None], tp)        # [S, 1, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos = Tensor._from_value(seq_lens[:, None])
-                for layer, kc, vc in zip(llama.layers, kcs, vcs):
+                for li, (layer, kc, vc) in enumerate(
+                        zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
                     attn = layer.self_attn
                     q = attn.q_proj(h).reshape([S, 1, H, D])
@@ -631,13 +805,22 @@ class DecodeStep:
                     q, k, _ = fused_rotary_position_embedding(
                         q, k, position_ids=pos,
                         rotary_emb_base=cfg.rope_theta)
-                    kc, vc = write_decode_kv(
-                        k._value[:, 0], v._value[:, 0], kc, vc,
-                        block_tables, seq_lens)
+                    if quant_kv:
+                        kc, vc, ks, vs = write_decode_kv_q8(
+                            k._value[:, 0], v._value[:, 0], kc, vc,
+                            kss[li], vss[li], block_tables, seq_lens)
+                        new_kss.append(ks)
+                        new_vss.append(vs)
+                    else:
+                        ks = vs = None
+                        kc, vc = write_decode_kv(
+                            k._value[:, 0], v._value[:, 0], kc, vc,
+                            block_tables, seq_lens)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
                     out = attn_fn(q._value[:, 0], kc, vc, block_tables,
-                                  seq_lens + 1, scale)   # incl. new token
+                                  seq_lens + 1, scale,   # incl. new token
+                                  key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(S, 1, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
@@ -649,34 +832,36 @@ class DecodeStep:
                                     transpose_y=True)
                 else:
                     logits = model.lm_head(x)
-                logits = _tp_logits(logits, tp)
+                logits = _tp_logits(logits, tp, q8=q8_gather)
             # greedy sampling ON DEVICE: only the [S] token ids cross
             # the link, never the [S, V] logits
             nxt = jnp.argmax(
                 logits._value[:, 0, :].astype(jnp.float32),
                 axis=-1).astype(jnp.int32)
-            return nxt, tuple(new_kcs), tuple(new_vcs)
+            return (nxt, tuple(new_kcs), tuple(new_vcs),
+                    tuple(new_kss), tuple(new_vss))
 
         if tp is None:
-            self._fn = jax.jit(step, donate_argnums=(4, 5))
+            self._fn = jax.jit(step, donate_argnums=(4, 5, 6, 7))
         else:
-            self._fn = _wrap_sharded(step, tp, self._param_tensors,
+            self._fn = _wrap_sharded(step, tp,
+                                     self._wq or self._param_tensors,
                                      len(self.caches), n_repl=3,
-                                     donate=(4, 5))
+                                     donate=(4, 5, 6, 7),
+                                     quant_kv=quant_kv)
 
     def __call__(self, tokens, seq_lens, block_tables) -> np.ndarray:
         if self._fn is None:
             self._build()
-        params = _step_params(self._param_tensors, self._tp)
+        params = _step_params(self._param_tensors, self._tp, self._wq)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
-        nxt, new_kcs, new_vcs = self._fn(
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        nxt, new_kcs, new_vcs, new_kss, new_vss = self._fn(
             params,
             jnp.asarray(np.asarray(tokens, np.int32)),
             jnp.asarray(np.asarray(seq_lens, np.int32)),
             jnp.asarray(np.asarray(block_tables, np.int32)),
-            kcs, vcs)
-        for c, kc, vc in zip(self.caches, new_kcs, new_vcs):
-            c.key_cache = kc
-            c.value_cache = vc
+            kcs, vcs, kss, vss)
+        _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
         return np.asarray(nxt)

@@ -61,7 +61,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = ["ShardingConfig", "SpecLayout", "TPContext",
            "resolve_mesh_axis", "llama_param_specs",
            "validate_tp_serving", "tp_mesh", "tp_serving_context",
-           "tp_embed", "tp_gather_logits", "shard_arrays"]
+           "tp_embed", "tp_gather_logits", "tp_gather_logits_q8",
+           "shard_arrays"]
 
 P = PartitionSpec
 
@@ -207,6 +208,24 @@ class SpecLayout:
         page — per-chip pool HBM is exactly 1/tp."""
         return P(None, None, self.tp_axis, None)
 
+    def kv_scale(self) -> PartitionSpec:
+        """An int8 pool's [phys_pages, Hkv] absmax tables follow the
+        pool's kv-head shard (quantize/dequantize/rescale are all
+        head-local math)."""
+        return P(None, self.tp_axis)
+
+    def col_weight_scale(self) -> PartitionSpec:
+        """Per-output-channel scale vector of a COLUMN-sharded weight
+        (qkv / gate / up / lm_head): the channel axis IS the sharded
+        output axis, so the scales shard with it."""
+        return P(self.tp_axis)
+
+    def row_weight_scale(self) -> PartitionSpec:
+        """Per-output-channel scale vector of a ROW-sharded weight
+        (o_proj / down): the output axis is the replicated hidden dim,
+        so every chip holds the full vector."""
+        return P()
+
 
 def llama_param_specs(keys: Iterable[str],
                       layout: Optional[SpecLayout] = None,
@@ -215,11 +234,30 @@ def llama_param_specs(keys: Iterable[str],
 
     Unknown families (norm weights, scalars) stay replicated — correct
     for anything whose math runs identically on every chip.
+
+    Serving-PTQ trees (``quantization.functional.quantize_param_tree``)
+    interleave per-channel scale vectors under ``<param>::scale`` keys;
+    those classify by their BASE weight's family — sharded with the
+    output axis for column-sharded weights (qkv / gate / up / lm_head),
+    replicated for row-sharded ones (o_proj / down) whose output axis
+    is the hidden dim.  int8 weights themselves keep their family's
+    2-D spec (quantization changes the dtype, not the layout).
     """
+    from ..quantization.functional import WEIGHT_SCALE_SUFFIX
     layout = layout or SpecLayout()
     specs: Dict[str, PartitionSpec] = {}
     for k in keys:
-        if "embed_tokens" in k:
+        if k.endswith(WEIGHT_SCALE_SUFFIX):
+            base = k[:-len(WEIGHT_SCALE_SUFFIX)]
+            if any(p in base for p in ("q_proj", "k_proj", "v_proj",
+                                       "gate_proj", "up_proj",
+                                       "lm_head")):
+                specs[k] = layout.col_weight_scale()
+            elif "o_proj" in base or "down_proj" in base:
+                specs[k] = layout.row_weight_scale()
+            else:
+                specs[k] = layout.replicated()
+        elif "embed_tokens" in k:
             specs[k] = layout.embeddings()
         elif any(p in k for p in ("q_proj", "k_proj", "v_proj")):
             specs[k] = layout.qkv_bias() if k.endswith("bias") \
@@ -311,23 +349,32 @@ class TPContext:
         return self._placed
 
     def collective_bytes(self, cfg, n_tokens: int,
-                         n_gather_rows: int) -> Dict[str, int]:
+                         n_gather_rows: int,
+                         quant_gather: bool = False) -> Dict[str, int]:
         """Per-chip collective payload of ONE sharded serving dispatch:
         (1 + 2L) psums of [n_tokens, hidden] (embedding + the two
         per-layer boundaries) and one all-gather of the
         [n_gather_rows, vocab/tp] logits shard — the static-per-shape
-        accounting behind ``serving_tp_collective_bytes_total`` and the
-        payload EQuARX-style quantized collectives would shrink."""
+        accounting behind ``serving_tp_collective_bytes_total``.
+
+        ``quant_gather=True`` accounts the EQuARX-style int8 logits
+        all-gather (``tp_gather_logits_q8``): one byte per logit plus
+        the 4-byte per-shard scale — the payload the quantized
+        collective actually moves (reported under
+        ``serving_quant_collective_bytes_total`` too)."""
         item = 2 if cfg.dtype == "bfloat16" else 4
+        shard = n_gather_rows * (cfg.vocab_size // self.degree)
         return {
             "psum": (2 * cfg.num_hidden_layers + 1) * n_tokens
             * cfg.hidden_size * item,
-            "all_gather": n_gather_rows
-            * (cfg.vocab_size // self.degree) * item,
+            "all_gather": shard + 4 if quant_gather else shard * item,
         }
 
     def pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.layout.kv_pool())
+
+    def kv_scale_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.layout.kv_scale())
 
     def named(self, spec_tree):
         """PartitionSpec tree -> NamedSharding tree on this mesh (jit
@@ -382,3 +429,30 @@ def tp_gather_logits(logits_local, axis: str):
     argmax sees the same values as the single-chip step."""
     return jax.lax.all_gather(logits_local, axis,
                               axis=logits_local.ndim - 1, tiled=True)
+
+
+def tp_gather_logits_q8(logits_local, axis: str):
+    """EQuARX-style (arXiv:2506.17615) quantized logits all-gather:
+    each chip quantizes its [*, V/tp] vocab shard to symmetric int8
+    with ONE per-shard absmax scale, the gather moves int8 codes (+ a
+    4-byte scale each) instead of fp words — ~4× (fp32) / ~2× (bf16)
+    less interconnect payload — and every chip dequantizes each shard
+    with its own gathered scale before the argmax.
+
+    NOT exact: two logits within ``absmax/127`` of each other can swap
+    order after the round trip, so engines enable this behind a
+    measured token-match-rate gate (a tolerance gate, not byte parity
+    — the serving quantization bench reports the rate per workload).
+    """
+    from ..quantization.functional import (dequantize_symmetric,
+                                           quantize_symmetric)
+    x = logits_local.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x))                              # per-shard
+    q = quantize_symmetric(x, s).astype(jnp.int8)
+    gq = jax.lax.all_gather(q, axis, axis=q.ndim - 1, tiled=True)
+    gs = jax.lax.all_gather(s, axis)                     # [tp]
+    tp = gs.shape[0]
+    lead, V = gq.shape[:-1], gq.shape[-1]
+    out = dequantize_symmetric(gq.reshape(lead + (tp, V // tp)),
+                               gs[:, None])
+    return out.reshape(lead + (V,)).astype(logits_local.dtype)

@@ -40,8 +40,17 @@ __all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
            "write_decode_kv", "write_prefill_kv", "write_chunk_kv",
            "write_ragged_kv", "chunk_prefill_attention",
            "ragged_paged_attention",
+           "write_decode_kv_q8", "write_chunk_kv_q8",
+           "write_ragged_kv_q8", "dequant_pages",
            "reconstruct_kv", "block_multihead_attention",
            "masked_multihead_attention"]
+
+# symmetric int8 bound == quantization.functional.symmetric_bound(8).
+# The quant/dequant math itself routes through that module (the ONE
+# clamp implementation); this constant exists only for the in-kernel
+# scale folds in the Pallas paths, where the float literal must be a
+# trace-time static (contract locked by tests/test_serving_quant.py).
+_KV_BNT = 127.0
 
 
 def _val(x):
@@ -68,14 +77,37 @@ class PagedKVCache:
     or another live request's block table therefore survives any one
     holder finishing (including pool-dry victim truncation and
     lazy-alloc growth — both funnel through ``free_sequence``).
+
+    ``kv_dtype="int8"`` quantizes the pools: K/V pages store symmetric
+    int8 codes plus per-PAGE-per-HEAD fp32 absmax scales
+    (``key_scale``/``value_scale`` [phys, Hkv]) — ~4× (vs fp32) /
+    ~2× (vs bf16) pages per HBM byte, scales included in the byte
+    accounting.  Every compiled write path (``write_*_kv_q8``)
+    quantizes on write with a running-max scale (existing codes are
+    rescaled in the same dispatch when a new token raises a page's
+    absmax), every attention path dequantizes into the same fp32
+    online-softmax, and because scales live per PHYSICAL page, prefix
+    sharing (``share_blocks``), copy-on-write (``serving_step.
+    copy_block`` copies the scale row with the page) and refcounted
+    release all carry scales with their pages for free.
     """
 
     def __init__(self, num_blocks: int, block_size: int, num_kv_heads: int,
-                 head_dim: int, dtype=jnp.float32, sink_block: bool = False):
+                 head_dim: int, dtype=jnp.float32, sink_block: bool = False,
+                 kv_dtype: Optional[str] = None):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
+        if kv_dtype not in (None, "float32", "bfloat16", "int8"):
+            raise ValueError(
+                "PagedKVCache kv_dtype must be one of None (use dtype), "
+                "'float32', 'bfloat16' or 'int8'; got %r" % (kv_dtype,))
+        self.quantized = kv_dtype == "int8"
+        if kv_dtype is not None:
+            dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                     "int8": jnp.int8}[kv_dtype]
+        self.kv_dtype = jnp.dtype(dtype).name
         # sink_block=True adds ONE extra physical page, never in the free
         # list, exposed as .sink: a fixed-shape compiled decode step
         # routes the writes of inactive (masked) batch slots there, so
@@ -86,26 +118,48 @@ class PagedKVCache:
         shape = (phys, block_size, num_kv_heads, head_dim)
         self.key_cache = jnp.zeros(shape, dtype)
         self.value_cache = jnp.zeros(shape, dtype)
+        if self.quantized:
+            # per-page-per-head absmax; 0 = "nothing written yet" (the
+            # quantized writes grow it monotonically per page lifetime)
+            self.key_scale = jnp.zeros((phys, num_kv_heads), jnp.float32)
+            self.value_scale = jnp.zeros((phys, num_kv_heads),
+                                         jnp.float32)
+        else:
+            self.key_scale = None
+            self.value_scale = None
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._ref: dict = {}            # block id -> live reference count
 
-    def place(self, sharding):
+    def place(self, sharding, scale_sharding=None):
         """Place both pools with a ``NamedSharding`` — the
         tensor-parallel serving engine head-shards them
         (``P(None, None, 'tp', None)``): each chip physically holds
         only its kv-head slice of every page, so per-chip pool HBM is
-        exactly 1/tp.  Free-list/refcount state is host bookkeeping and
-        needs no placement.  Call once at engine construction, before
-        any compiled step consumes (donates) the arrays."""
+        exactly 1/tp.  A quantized pool's scale tables follow with
+        ``scale_sharding`` (head axis: ``P(None, 'tp')``).  Free-list/
+        refcount state is host bookkeeping and needs no placement.
+        Call once at engine construction, before any compiled step
+        consumes (donates) the arrays."""
         self.key_cache = jax.device_put(self.key_cache, sharding)
         self.value_cache = jax.device_put(self.value_cache, sharding)
+        if self.quantized and scale_sharding is not None:
+            self.key_scale = jax.device_put(self.key_scale,
+                                            scale_sharding)
+            self.value_scale = jax.device_put(self.value_scale,
+                                              scale_sharding)
 
     def per_chip_pool_bytes(self) -> int:
         """Bytes of ONE chip's shard of this layer's K+V pools (the
         whole pool when unsharded) — the capacity number the
-        multi-chip serving bench gates at ≈ pool/tp."""
+        multi-chip serving bench gates at ≈ pool/tp, and the
+        quantization bench gates at ≥1.9× pages per HBM byte.  A
+        quantized pool COUNTS ITS SCALE TABLES, so the capacity claim
+        stays honest."""
         total = 0
-        for arr in (self.key_cache, self.value_cache):
+        arrs = [self.key_cache, self.value_cache]
+        if self.quantized:
+            arrs += [self.key_scale, self.value_scale]
+        for arr in arrs:
             shape = arr.sharding.shard_shape(arr.shape) \
                 if getattr(arr, "sharding", None) is not None \
                 else arr.shape
@@ -170,6 +224,12 @@ class PagedKVCache:
         """Donating in-place append: updates self.key_cache/value_cache
         (the old buffers are consumed — use this, not the functional
         write_kv_to_cache, when the pool object owns the arrays)."""
+        if self.quantized:
+            raise NotImplementedError(
+                "PagedKVCache.append is the legacy dense-cache API and "
+                "does not quantize; an int8 pool must be written through "
+                "the compiled serving steps (write_decode_kv_q8 / "
+                "write_chunk_kv_q8 / write_ragged_kv_q8)")
         self.key_cache, self.value_cache = _write_decode_donated(
             _val(k_new), _val(v_new), self.key_cache, self.value_cache,
             jnp.asarray(np.asarray(block_tables), jnp.int32),
@@ -268,7 +328,8 @@ def write_chunk_kv(k_new, v_new, key_cache, value_cache, block_table_row,
 
 
 def chunk_prefill_attention(q, key_cache, value_cache, block_table_row,
-                            start, scale):
+                            start, scale, key_scale=None,
+                            value_scale=None):
     """Causal attention for one padded prefill chunk over the paged
     cache (traceable; the bucketed ``PrefillStep``'s attention body).
 
@@ -306,14 +367,18 @@ def chunk_prefill_attention(q, key_cache, value_cache, block_table_row,
         ok = cols[None, None, :] <= qpos[None, :, None]
         return jnp.where(ok, s, -jnp.inf)
 
-    def gather(p_idx, cache):
-        page = cache[bt[p_idx]].astype(jnp.float32)      # [bs, Hkv, D]
+    def gather(p_idx, cache, cache_scale):
+        page = cache[bt[p_idx]]                          # [bs, Hkv, D]
+        if cache_scale is not None:
+            page = dequant_pages(page, cache_scale[bt[p_idx]])
+        else:
+            page = page.astype(jnp.float32)
         if rep != 1:
             page = jnp.repeat(page, rep, axis=1)
         return page
 
     def max_body(p_idx, m):
-        s = page_scores(p_idx, gather(p_idx, key_cache))
+        s = page_scores(p_idx, gather(p_idx, key_cache, key_scale))
         return jnp.maximum(m, jnp.max(s, axis=-1))
 
     m = jax.lax.fori_loop(jnp.int32(0), n_used, max_body,
@@ -321,11 +386,11 @@ def chunk_prefill_attention(q, key_cache, value_cache, block_table_row,
 
     def acc_body(p_idx, carry):
         l, acc = carry
-        s = page_scores(p_idx, gather(p_idx, key_cache))
+        s = page_scores(p_idx, gather(p_idx, key_cache, key_scale))
         p = jnp.exp(s - m[:, :, None])                   # -inf keys -> 0
         l = l + jnp.sum(p, axis=-1)
         acc = acc + jnp.einsum("hqk,khd->qhd", p,
-                               gather(p_idx, value_cache))
+                               gather(p_idx, value_cache, value_scale))
         return l, acc
 
     l, acc = jax.lax.fori_loop(
@@ -352,8 +417,140 @@ def write_ragged_kv(k_new, v_new, key_cache, value_cache, dest_blocks,
     return key_cache, value_cache
 
 
+# ---------------------------------------------------------------------------
+# quantized (int8) write paths: quantize ON WRITE inside the compiled step
+# ---------------------------------------------------------------------------
+def _quant_write_tokens(cache, scale, new_vals, blks, offs):
+    """Core of every int8 write path (traceable).
+
+    cache [phys, bs, Hkv, D] int8, scale [phys, Hkv] fp32 absmax,
+    new_vals [N, Hkv, D] float, blks/offs [N] int32 (token t lands at
+    ``(blks[t], offs[t])``; padding routed to the sink page by the
+    caller, exactly like the fp32 paths).
+
+    Per-page-per-head RUNNING-MAX scale: a scatter-max folds the new
+    tokens' absmax into each touched page's scale (duplicate pages in
+    one write accumulate correctly), then the touched pages' EXISTING
+    codes are rescaled by old/new in the same dispatch (ratio 1 —
+    bit-exact round trip — whenever the scale didn't move, which is the
+    steady state) and the new tokens are quantized with the final
+    scale.  Dequantization therefore always uses the exact scale each
+    code was (re)quantized with.  Scales are monotone per page
+    lifetime in the pool array; a recycled page keeps its last absmax
+    as the quantization floor — bounded coarseness, zero extra
+    dispatches in the hot loop (K/V magnitudes are stationary across
+    requests, so the floor tracks the data).
+    """
+    from ..quantization.functional import quantize_symmetric
+    f32 = jnp.float32
+    vals = new_vals.astype(f32)
+    amax = jnp.max(jnp.abs(vals), axis=-1)               # [N, Hkv]
+    new_scale = scale.at[blks].max(amax)                 # running max
+    ratio = jnp.where(new_scale > 0,
+                      scale / jnp.maximum(new_scale, 1e-30),
+                      jnp.ones((), f32))
+    # rescale the touched pages' existing codes (gather → scatter;
+    # duplicate blks write identical content, so order is irrelevant)
+    pages = cache[blks].astype(f32) * ratio[blks][:, None, :, None]
+    cache = cache.at[blks].set(jnp.round(pages).astype(cache.dtype))
+    q = quantize_symmetric(vals, new_scale[blks][:, :, None])
+    cache = cache.at[blks, offs].set(q.astype(cache.dtype))
+    return cache, new_scale
+
+
+def _quant_write_one_per_page(cache, scale, new_vals, blks, offs):
+    """``_quant_write_tokens`` specialized to AT MOST ONE token per
+    live page (the decode append: every slot writes its own sequence's
+    page; only sink duplicates, which hold garbage anyway).  The
+    rescaled page and its new token row merge into ONE scatter — half
+    the scatter traffic of the general path on the hottest write."""
+    from ..quantization.functional import quantize_symmetric
+    f32 = jnp.float32
+    bs = cache.shape[1]
+    vals = new_vals.astype(f32)
+    amax = jnp.max(jnp.abs(vals), axis=-1)               # [N, Hkv]
+    new_scale = scale.at[blks].max(amax)
+    ratio = jnp.where(new_scale > 0,
+                      scale / jnp.maximum(new_scale, 1e-30),
+                      jnp.ones((), f32))
+    pages = jnp.round(cache[blks].astype(f32)
+                      * ratio[blks][:, None, :, None])
+    q = quantize_symmetric(vals, new_scale[blks][:, :, None])
+    row = jnp.arange(bs, dtype=jnp.int32)[None, :] == offs[:, None]
+    pages = jnp.where(row[:, :, None, None], q[:, None], pages)
+    return cache.at[blks].set(pages.astype(cache.dtype)), new_scale
+
+
+def write_decode_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
+                       value_scale, block_tables, seq_lens):
+    """int8 variant of ``write_decode_kv`` (the fused decode append):
+    k_new/v_new [B, Hkv, D] quantized into position seq_lens[b]'s page
+    with per-page-per-head running-max scales.  Returns
+    ``(key_cache, value_cache, key_scale, value_scale)``.
+
+    PRECONDITION (stricter than the fp variant): at most one LIVE page
+    per batch row — the fast path merges each row's token into its
+    whole rescaled page and scatters page-wise, so two rows addressing
+    the same physical page would be last-writer-wins.  The decode
+    append satisfies this by construction (every slot appends to its
+    OWN sequence's tail page; only masked slots share the sink page,
+    whose content is garbage either way).  For multi-token-per-page
+    writes use ``write_ragged_kv_q8``/``write_chunk_kv_q8``."""
+    bs = key_cache.shape[1]
+    pos = seq_lens.astype(jnp.int32)
+    blk = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    off = pos % bs
+    key_cache, key_scale = _quant_write_one_per_page(
+        key_cache, key_scale, k_new, blk, off)
+    value_cache, value_scale = _quant_write_one_per_page(
+        value_cache, value_scale, v_new, blk, off)
+    return key_cache, value_cache, key_scale, value_scale
+
+
+def write_chunk_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
+                      value_scale, block_table_row, start, n_valid, sink):
+    """int8 variant of ``write_chunk_kv``: one bucket-padded prefill
+    chunk quantized into its pages (padding → sink, whose scale is
+    garbage-on-garbage, exactly like its codes)."""
+    C = k_new.shape[1]
+    bs = key_cache.shape[1]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    pos = start.astype(jnp.int32) + idx
+    blk = block_table_row[0, pos // bs]
+    valid = idx < n_valid
+    blk = jnp.where(valid, blk, jnp.int32(sink))
+    off = jnp.where(valid, pos % bs, 0)
+    key_cache, key_scale = _quant_write_tokens(
+        key_cache, key_scale, k_new[0], blk, off)
+    value_cache, value_scale = _quant_write_tokens(
+        value_cache, value_scale, v_new[0], blk, off)
+    return key_cache, value_cache, key_scale, value_scale
+
+
+def write_ragged_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
+                       value_scale, dest_blocks, dest_offsets):
+    """int8 variant of ``write_ragged_kv``: the packed ragged token
+    batch (decode spans + prefill chunks) quantized in ONE scatter
+    inside the fused MixedStep trace."""
+    key_cache, key_scale = _quant_write_tokens(
+        key_cache, key_scale, k_new, dest_blocks, dest_offsets)
+    value_cache, value_scale = _quant_write_tokens(
+        value_cache, value_scale, v_new, dest_blocks, dest_offsets)
+    return key_cache, value_cache, key_scale, value_scale
+
+
+def dequant_pages(pages, page_scale):
+    """Dequantize gathered int8 pages: ``pages [..., bs, Hkv, D]`` ×
+    their ``page_scale [..., Hkv]`` → fp32 (traceable; the read-side
+    inverse of ``_quant_write_tokens``)."""
+    from ..quantization.functional import dequantize_symmetric
+    return dequantize_symmetric(pages, page_scale[..., None, :, None])
+
+
 def _ragged_attention_xla(q, key_cache, value_cache, block_tables,
-                          q_offsets, q_lens, kv_lens, scale):
+                          q_offsets, q_lens, kv_lens, scale,
+                          key_scale=None, value_scale=None):
     """Ragged paged attention, XLA reference path (CPU + parity tests).
 
     q: [T, H, D] packed ragged tokens; block_tables [S, W]; q_offsets /
@@ -377,8 +574,16 @@ def _ragged_attention_xla(q, key_cache, value_cache, block_tables,
     qpos = (kv_lens[sid] - q_lens[sid] + (tok - q_offsets[sid]))
     qpos = jnp.maximum(qpos, 0)       # padding tokens: finite garbage
     bt = jnp.maximum(block_tables, 0)[sid]               # [T, W]
-    k = key_cache[bt].reshape(T, max_len, Hkv, D)
-    v = value_cache[bt].reshape(T, max_len, Hkv, D)
+    if key_scale is not None:
+        # int8 pool: dequantize the GATHERED pages (cast + one fused
+        # broadcast multiply — measured fastest of the CPU variants;
+        # the Pallas kernel dequantizes per DMA'd page instead)
+        k = dequant_pages(key_cache[bt], key_scale[bt])
+        v = dequant_pages(value_cache[bt], value_scale[bt])
+    else:
+        k, v = key_cache[bt], value_cache[bt]
+    k = k.reshape(T, max_len, Hkv, D)
+    v = v.reshape(T, max_len, Hkv, D)
     if Hkv != H:
         rep = H // Hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -397,7 +602,8 @@ def _ragged_attention_xla(q, key_cache, value_cache, block_tables,
 def ragged_paged_attention(q, key_cache, value_cache, block_tables,
                            q_offsets, q_lens, kv_lens,
                            use_pallas: Optional[bool] = None,
-                           interpret=False, span_q: Optional[int] = None):
+                           interpret=False, span_q: Optional[int] = None,
+                           key_scale=None, value_scale=None):
     """One fused attention launch over a packed ragged query batch
     against the paged KV pool (arXiv:2604.15464).
 
@@ -406,7 +612,9 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
     block_tables: [S, W] int32 per-span page lists (-1/sink padded).
     q_offsets/q_lens/kv_lens: [S] int32 span tables (kv_len INCLUDES the
     span's own tokens, which must already be written to the pages).
-    Returns [T, H, D].
+    key_scale/value_scale: per-page-per-head [phys, Hkv] fp32 absmax
+    tables of an int8 pool (dequantized into the fp32 online-softmax);
+    None for fp pools.  Returns [T, H, D].
     """
     tensor_in = isinstance(q, Tensor)
     qv = _val(q)
@@ -423,9 +631,11 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
         sq = int(span_q) if span_q else int(np.max(np.asarray(q_lens)))
         out = _ragged_paged_attention_pallas(
             qv, kc, vc, bt, qo, ql, kl, scale, span_q=sq,
-            interpret=interpret)
+            interpret=interpret, key_scale=key_scale,
+            value_scale=value_scale)
     else:
-        out = _ragged_attention_xla(qv, kc, vc, bt, qo, ql, kl, scale)
+        out = _ragged_attention_xla(qv, kc, vc, bt, qo, ql, kl, scale,
+                                    key_scale, value_scale)
     return Tensor._from_value(out) if tensor_in else out
 
 
@@ -449,11 +659,16 @@ def write_kv_to_cache(k_new, v_new, key_cache, value_cache, block_tables,
               seq_lens)
 
 
-def reconstruct_kv(key_cache, value_cache, block_tables, max_len):
-    """Gather pages back to dense [B, max_len, Hkv, D] (XLA path)."""
+def reconstruct_kv(key_cache, value_cache, block_tables, max_len,
+                   key_scale=None, value_scale=None):
+    """Gather pages back to dense [B, max_len, Hkv, D] (XLA path);
+    int8 pools dequantize through their per-page-per-head scales."""
     bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
     k = key_cache[bt]          # [B, max_blocks, bs, Hkv, D]
     v = value_cache[bt]
+    if key_scale is not None:
+        k = dequant_pages(k, key_scale[bt])
+        v = dequant_pages(v, value_scale[bt])
     B, nb, bs, H, D = k.shape
     k = k.reshape(B, nb * bs, H, D)[:, :max_len]
     v = v.reshape(B, nb * bs, H, D)[:, :max_len]
@@ -464,11 +679,13 @@ def reconstruct_kv(key_cache, value_cache, block_tables, max_len):
 # decode attention: XLA gather path (reference + CPU)
 # ---------------------------------------------------------------------------
 def _paged_attention_xla(q, key_cache, value_cache, block_tables, seq_lens,
-                         scale):
+                         scale, key_scale=None, value_scale=None):
     B, H, D = q.shape
     Hkv = key_cache.shape[2]
-    max_len = int(block_tables.shape[1]) * key_cache.shape[1]
-    k, v = reconstruct_kv(key_cache, value_cache, block_tables, max_len)
+    bs = key_cache.shape[1]
+    max_len = int(block_tables.shape[1]) * bs
+    k, v = reconstruct_kv(key_cache, value_cache, block_tables, max_len,
+                          key_scale, value_scale)
     if Hkv != H:
         rep = H // Hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -486,21 +703,31 @@ def _paged_attention_xla(q, key_cache, value_cache, block_tables, seq_lens,
 # ---------------------------------------------------------------------------
 # decode attention: Pallas TPU kernel
 # ---------------------------------------------------------------------------
-def _paged_decode_kernel(# scalar prefetch
-                         block_tables_ref, seq_lens_ref,
-                         # operands
-                         q_ref, k_pages_ref, v_pages_ref,
-                         # output
-                         o_ref,
-                         # scratch
-                         k_vmem, v_vmem, sem,
-                         *, block_size: int, pages_per_seq: int,
-                         scale: float, groups: int):
+def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
+                         # when quantized)
+                         *refs,
+                         block_size: int, pages_per_seq: int,
+                         scale: float, groups: int,
+                         quantized: bool = False):
     """Grid cell (b, hkv): one batch row, one kv head; q carries the
     `groups` query heads mapped to this kv head.
 
     Pages are copied HBM->VMEM one at a time with an async DMA, with the
-    online-softmax running state in fp32 registers."""
+    online-softmax running state in fp32 registers.  An int8 pool's
+    per-page-per-head fp32 scales ride as TWO EXTRA scalar-prefetch
+    tables bitcast to int32 ([Hkv, phys] — SMEM scalar reads with a
+    dynamic page index, the same mechanism as the block table), bitcast
+    back per page and folded into the fp32 page right after the DMA —
+    only int8 bytes ever cross HBM→VMEM."""
+    if quantized:
+        (block_tables_ref, seq_lens_ref, ks_bits_ref, vs_bits_ref,
+         q_ref, k_pages_ref, v_pages_ref, o_ref,
+         k_vmem, v_vmem, sem) = refs
+    else:
+        (block_tables_ref, seq_lens_ref,
+         q_ref, k_pages_ref, v_pages_ref, o_ref,
+         k_vmem, v_vmem, sem) = refs
+        ks_bits_ref = vs_bits_ref = None
     b = pl.program_id(0)
     h = pl.program_id(1)
     seq_len = seq_lens_ref[b]
@@ -528,6 +755,13 @@ def _paged_decode_kernel(# scalar prefetch
         v_copy.wait()
         k = k_vmem[...].astype(jnp.float32)            # [bs, D]
         v = v_vmem[...].astype(jnp.float32)
+        if quantized:
+            sk = jax.lax.bitcast_convert_type(ks_bits_ref[h, page],
+                                              jnp.float32)
+            sv = jax.lax.bitcast_convert_type(vs_bits_ref[h, page],
+                                              jnp.float32)
+            k = k * (sk / np.float32(_KV_BNT))
+            v = v * (sv / np.float32(_KV_BNT))
         s = q @ k.T                                    # [groups, bs]
         base = p_idx * jnp.int32(block_size)
         cols = base + jax.lax.broadcasted_iota(jnp.int32, (g, block_size), 1)
@@ -546,25 +780,38 @@ def _paged_decode_kernel(# scalar prefetch
 
 
 def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
-                            seq_lens, scale, interpret=False):
+                            seq_lens, scale, interpret=False,
+                            key_scale=None, value_scale=None):
     B, H, D = q.shape
     Hkv = key_cache.shape[2]
     bs = key_cache.shape[1]
     groups = H // Hkv
     pages_per_seq = block_tables.shape[1]
+    quantized = key_scale is not None
     # [B, H, D] -> [B, Hkv, groups, D]; pages -> [Hkv, nb, bs, D]
     qg = q.reshape(B, Hkv, groups, D)
     kp = jnp.moveaxis(key_cache, 2, 0)      # [Hkv, nb, bs, D]
     vp = jnp.moveaxis(value_cache, 2, 0)
+    if not quantized:
+        kp, vp = kp.astype(jnp.float32), vp.astype(jnp.float32)
     bt = jnp.maximum(block_tables, 0)
 
     kernel = functools.partial(
         _paged_decode_kernel, block_size=bs, pages_per_seq=pages_per_seq,
-        scale=scale, groups=groups)
+        scale=scale, groups=groups, quantized=quantized)
 
     with jax.experimental.disable_x64():
+        prefetch = [bt.astype(jnp.int32), seq_lens.astype(jnp.int32)]
+        if quantized:
+            # fp32 scales ride the int32 scalar-prefetch lane bitcast;
+            # [phys, Hkv] -> [Hkv, phys] so the kernel indexes [h, page]
+            prefetch += [
+                jax.lax.bitcast_convert_type(
+                    key_scale.astype(jnp.float32).T, jnp.int32),
+                jax.lax.bitcast_convert_type(
+                    value_scale.astype(jnp.float32).T, jnp.int32)]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(prefetch),
             grid=(B, Hkv),
             in_specs=[
                 pl.BlockSpec((1, 1, groups, D),
@@ -575,8 +822,8 @@ def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
             out_specs=pl.BlockSpec((1, 1, groups, D),
                                    lambda b, h, *_: (b, h, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((bs, D), jnp.float32),
-                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.VMEM((bs, D), kp.dtype),
+                pltpu.VMEM((bs, D), vp.dtype),
                 pltpu.SemaphoreType.DMA,
             ],
         )
@@ -585,8 +832,7 @@ def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B, Hkv, groups, D), q.dtype),
             interpret=interpret,
-        )(bt.astype(jnp.int32), seq_lens.astype(jnp.int32),
-          qg, kp.astype(jnp.float32), vp.astype(jnp.float32))
+        )(*prefetch, qg, kp, vp)
     return out.reshape(B, H, D)
 
 
@@ -598,14 +844,16 @@ def _on_tpu():
 
 
 def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
-                    use_pallas: Optional[bool] = None, interpret=False):
+                    use_pallas: Optional[bool] = None, interpret=False,
+                    key_scale=None, value_scale=None):
     """Decode-step attention over a paged KV cache.
 
     q: [B, H, D] (one query token per sequence)
     key_cache/value_cache: [num_blocks, block_size, Hkv, D]
     block_tables: [B, max_blocks] int32, -1 padded
     seq_lens: [B] int32 — number of valid tokens ALREADY in the cache
-    Returns [B, H, D].
+    key_scale/value_scale: [phys, Hkv] fp32 absmax tables of an int8
+    pool (None for fp pools).  Returns [B, H, D].
     """
     tensor_in = isinstance(q, Tensor)
     qv = _val(q)
@@ -617,9 +865,12 @@ def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
         use_pallas = _HAS_PLTPU and _on_tpu()
     if use_pallas or interpret:
         out = _paged_attention_pallas(qv, kc, vc, bt, sl, scale,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      key_scale=key_scale,
+                                      value_scale=value_scale)
     else:
-        out = _paged_attention_xla(qv, kc, vc, bt, sl, scale)
+        out = _paged_attention_xla(qv, kc, vc, bt, sl, scale,
+                                   key_scale, value_scale)
     return Tensor._from_value(out) if tensor_in else out
 
 

@@ -1434,16 +1434,13 @@ def sdpa_ulysses(query, key, value, mesh, axis_name: str = "sep",
 # ---------------------------------------------------------------------------
 # ragged paged attention (serving: one launch for any prefill+decode mix)
 # ---------------------------------------------------------------------------
-def _ragged_paged_kernel(# scalar prefetch
-                         q_off_ref, q_len_ref, kv_len_ref, bt_ref,
-                         # operands (HBM/ANY)
-                         q_hbm, k_pages, v_pages,
-                         # output (HBM/ANY)
-                         o_hbm,
-                         # scratch
-                         q_vmem, o_vmem, k_vmem, v_vmem, sem,
-                         *, block_size: int, pages_per_span: int,
-                         span_q: int, scale: float, groups: int):
+def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
+                         # when quantized), operands (HBM/ANY), output,
+                         # scratch — unpacked below
+                         *refs,
+                         block_size: int, pages_per_span: int,
+                         span_q: int, scale: float, groups: int,
+                         quantized: bool = False):
     """Grid cell (s, h): one ragged query SPAN (a decode slot = length-1
     span, or a prefill chunk = length-C span) against one kv head's
     pages (arXiv:2604.15464 "Ragged Paged Attention").
@@ -1461,7 +1458,24 @@ def _ragged_paged_kernel(# scalar prefetch
     ``kv_len - q_len + r`` and sees keys at positions <= that, so decode
     steps, mid-prompt chunks, and prefix-hit suffixes are all the same
     span shape to this kernel.
+
+    int8 pools (``quantized=True``): the pages arrive as int8 and the
+    per-page-per-head fp32 absmax scales ride as two extra
+    scalar-prefetch tables bitcast to int32 ([Hkv, phys] — the same
+    SMEM dynamic-index mechanism as the block table), bitcast back per
+    page and folded into the fp32 page right after its DMA, so only
+    int8 bytes cross HBM→VMEM and the online-softmax math is unchanged.
     """
+    if quantized:
+        (q_off_ref, q_len_ref, kv_len_ref, bt_ref,
+         ks_bits_ref, vs_bits_ref,
+         q_hbm, k_pages, v_pages, o_hbm,
+         q_vmem, o_vmem, k_vmem, v_vmem, sem) = refs
+    else:
+        (q_off_ref, q_len_ref, kv_len_ref, bt_ref,
+         q_hbm, k_pages, v_pages, o_hbm,
+         q_vmem, o_vmem, k_vmem, v_vmem, sem) = refs
+        ks_bits_ref = vs_bits_ref = None
     s = pl.program_id(0)
     h = pl.program_id(1)
     q_len = q_len_ref[s]
@@ -1503,6 +1517,13 @@ def _ragged_paged_kernel(# scalar prefetch
             vc.wait()
             k = k_vmem[...].astype(jnp.float32)        # [bs, D]
             v = v_vmem[...].astype(jnp.float32)
+            if quantized:
+                sk = lax.bitcast_convert_type(ks_bits_ref[h, page],
+                                              jnp.float32)
+                sv = lax.bitcast_convert_type(vs_bits_ref[h, page],
+                                              jnp.float32)
+                k = k * (sk / np.float32(127.0))
+                v = v * (sv / np.float32(127.0))
             sc = lax.dot_general(q, k, _DIMNUM_NT,
                                  preferred_element_type=jnp.float32)
             base = p_idx * jnp.int32(block_size)
@@ -1531,7 +1552,8 @@ def _ragged_paged_kernel(# scalar prefetch
 def _ragged_paged_attention_pallas(q, key_cache, value_cache,
                                    block_tables, q_offsets, q_lens,
                                    kv_lens, scale, span_q: int,
-                                   interpret=False):
+                                   interpret=False,
+                                   key_scale=None, value_scale=None):
     """q: [T, H, D] packed ragged tokens; block_tables [S, W]; span
     tables [S].  span_q: static max span length (>= max(q_lens)).
     Returns [T, H, D].
@@ -1557,20 +1579,33 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
     groups = H // Hkv
     S, W = block_tables.shape
     span_q = max(1, int(span_q))
+    quantized = key_scale is not None
     qg = q.reshape(T, Hkv, groups, D).astype(jnp.float32)
     # span_q tail padding: the last span's fixed DMA window may overhang
     qg = jnp.pad(qg, ((0, span_q), (0, 0), (0, 0), (0, 0)))
-    kp = jnp.moveaxis(key_cache, 2, 0).astype(jnp.float32)
-    vp = jnp.moveaxis(value_cache, 2, 0).astype(jnp.float32)
+    kp = jnp.moveaxis(key_cache, 2, 0)
+    vp = jnp.moveaxis(value_cache, 2, 0)
+    if not quantized:
+        kp, vp = kp.astype(jnp.float32), vp.astype(jnp.float32)
     bt = jnp.maximum(block_tables, 0)
 
     kernel = functools.partial(
         _ragged_paged_kernel, block_size=bs, pages_per_span=W,
-        span_q=span_q, scale=scale, groups=groups)
+        span_q=span_q, scale=scale, groups=groups, quantized=quantized)
 
     with _x64_off():
+        prefetch = [q_offsets.astype(jnp.int32), q_lens.astype(jnp.int32),
+                    kv_lens.astype(jnp.int32), bt.astype(jnp.int32)]
+        if quantized:
+            # fp32 scales ride the int32 scalar-prefetch lane bitcast;
+            # [phys, Hkv] -> [Hkv, phys] so the kernel indexes [h, page]
+            prefetch += [
+                jax.lax.bitcast_convert_type(
+                    key_scale.astype(jnp.float32).T, jnp.int32),
+                jax.lax.bitcast_convert_type(
+                    value_scale.astype(jnp.float32).T, jnp.int32)]
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=len(prefetch),
             grid=(S, Hkv),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
@@ -1581,8 +1616,8 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
             scratch_shapes=[
                 pltpu.VMEM((span_q, groups, D), jnp.float32),
                 pltpu.VMEM((span_q, groups, D), q.dtype),
-                pltpu.VMEM((bs, D), jnp.float32),
-                pltpu.VMEM((bs, D), jnp.float32),
+                pltpu.VMEM((bs, D), kp.dtype),
+                pltpu.VMEM((bs, D), vp.dtype),
                 pltpu.SemaphoreType.DMA,
             ],
         )
@@ -1592,7 +1627,5 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
             out_shape=jax.ShapeDtypeStruct((T + span_q, Hkv, groups, D),
                                            q.dtype),
             interpret=interpret,
-        )(q_offsets.astype(jnp.int32), q_lens.astype(jnp.int32),
-          kv_lens.astype(jnp.int32), bt.astype(jnp.int32),
-          qg, kp, vp)
+        )(*prefetch, qg, kp, vp)
     return out[:T].reshape(T, H, D)

@@ -16,12 +16,14 @@ from typing import Dict, List, Optional, Type, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core.dispatch import apply_op
 from ..nn.layer_base import Layer
 from .. import nn
+from .functional import fake_quantize
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory",
            "BaseObserver", "BaseQuanter", "AbsmaxObserver",
@@ -31,13 +33,15 @@ __all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory",
 
 
 def _fake_quant(x, scale, bit_length=8):
-    """Symmetric fake quantization with STE gradient."""
-    import jax
-    bnt = (1 << (bit_length - 1)) - 1
+    """Symmetric fake quantization with STE gradient.
+
+    The forward math is ``quantization.functional.fake_quantize`` — the
+    SAME symmetric-absmax clamp (round-half-even into [-bnt, bnt]) the
+    serving PTQ path (``quantize_param_tree``) and the int8 KV cache
+    use, so QAT training simulates exactly what deployment runs."""
 
     def fn(v, s):
-        s = jnp.maximum(s, 1e-9)
-        q = jnp.clip(jnp.round(v / s * bnt), -bnt, bnt) * s / bnt
+        q = fake_quantize(v, s, bit_length).astype(v.dtype)
         # straight-through estimator: identity gradient w.r.t. v
         return v + jax.lax.stop_gradient(q - v)
 

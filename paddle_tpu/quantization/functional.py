@@ -1,0 +1,147 @@
+"""Symmetric-absmax quantization primitives — the ONE implementation.
+
+Every quantizer in the repo routes through these four functions: the
+QAT fake-quant path (``paddle_tpu.quantization._fake_quant``, straight-
+through estimator around :func:`fake_quantize`), the serving
+post-training weight quantizer (:func:`quantize_param_tree`, consumed
+by the fused serving steps via dequant-on-use), and the int8 paged KV
+cache (``ops/paged_attention``'s quantized write paths).  One clamp
+convention everywhere: symmetric around zero, ``bnt = 2**(bits-1) - 1``
+levels per side (so int8 uses [-127, 127]; -128 is never produced and a
+negated tensor quantizes to the negated codes), round-half-even
+(``jnp.round``), and a floor on the scale so a zero tensor quantizes to
+zeros instead of NaN.
+
+``scale`` is always the ABSMAX of the data being quantized (codes are
+``x / scale * bnt``), never the per-level step — matching the
+convention of ``AbsmaxObserver`` / the channel-wise observers in
+``quantization/``.
+
+This module imports only jax/numpy (no ``paddle_tpu.nn``), so the ops
+and jit layers can use it without pulling the full quantization API;
+the heavy layer-wrapping machinery stays in ``quantization/__init__``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["symmetric_bound", "absmax_scale", "quantize_symmetric",
+           "dequantize_symmetric", "fake_quantize",
+           "WEIGHT_SCALE_SUFFIX", "is_weight_scale_key",
+           "ptq_quantizable", "quantize_param_tree",
+           "dequantize_param_tree"]
+
+# the serving PTQ tree stores each quantized weight's per-channel absmax
+# next to it under this suffixed key ("<param>::scale"); jit/spmd.py
+# classifies these keys into 1-D PartitionSpecs for tensor parallelism
+WEIGHT_SCALE_SUFFIX = "::scale"
+
+# weight families eligible for serving PTQ: the 2-D projection matmuls.
+# Embeddings stay fp (the lookup is memory-bound, not matmul-bound, and
+# a tied lm_head must keep the fp table the untied path samples from);
+# norms/biases are 1-D and replicated.
+_PTQ_FAMILIES = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+                 "up_proj", "down_proj", "lm_head")
+
+
+def symmetric_bound(bits: int = 8) -> int:
+    """Largest code magnitude: 127 for int8."""
+    return (1 << (int(bits) - 1)) - 1
+
+
+def absmax_scale(x, axis=None, keepdims: bool = False):
+    """Absmax over ``axis`` (None = whole tensor) in fp32 — the
+    symmetric scale.  No epsilon here; the quant/dequant pair floors
+    the scale itself so absmax stays exact for observers."""
+    return jnp.max(jnp.abs(jnp.asarray(x).astype(jnp.float32)),
+                   axis=axis, keepdims=keepdims)
+
+
+def quantize_symmetric(x, scale, bits: int = 8):
+    """Codes in [-bnt, bnt] (float dtype — cast at the storage site).
+
+    ``scale`` is the absmax and must broadcast against ``x``."""
+    bnt = symmetric_bound(bits)
+    s = jnp.maximum(jnp.asarray(scale).astype(jnp.float32), 1e-30)
+    return jnp.clip(jnp.round(x.astype(jnp.float32) / s * bnt),
+                    -bnt, bnt)
+
+
+def dequantize_symmetric(q, scale, bits: int = 8):
+    """Codes (+ their absmax scale) back to fp32 values."""
+    bnt = symmetric_bound(bits)
+    return (q.astype(jnp.float32)
+            * (jnp.asarray(scale).astype(jnp.float32) / bnt))
+
+
+def fake_quantize(x, scale, bits: int = 8):
+    """quantize→dequantize round trip (QAT forward math; wrap with a
+    straight-through estimator for the gradient)."""
+    return dequantize_symmetric(quantize_symmetric(x, scale, bits),
+                                scale, bits)
+
+
+# ---------------------------------------------------------------------------
+# serving PTQ: per-channel int8 weight tree
+# ---------------------------------------------------------------------------
+def is_weight_scale_key(key: str) -> bool:
+    return key.endswith(WEIGHT_SCALE_SUFFIX)
+
+
+def ptq_quantizable(key: str, value) -> bool:
+    """2-D projection weights only (see ``_PTQ_FAMILIES``)."""
+    if not key.endswith("weight") or is_weight_scale_key(key):
+        return False
+    if getattr(value, "ndim", 0) != 2:
+        return False
+    return any(f in key for f in _PTQ_FAMILIES)
+
+
+def quantize_param_tree(values: Dict[str, jnp.ndarray],
+                        bits: int = 8) -> Dict[str, jnp.ndarray]:
+    """Per-output-channel absmax PTQ over a serving state dict.
+
+    Linear weights are ``[in, out]``; each output channel gets its own
+    absmax scale (axis-0 reduction → ``[out]`` fp32 vector stored at
+    ``key + WEIGHT_SCALE_SUFFIX``), and the weight itself is replaced
+    by its int8 codes.  Everything else (embeddings, norms, biases)
+    passes through untouched, so the tree keeps every key the model's
+    ``bind_state`` expects plus the scale vectors the steps dequantize
+    with.
+    """
+    out: Dict[str, jnp.ndarray] = {}
+    for k, v in values.items():
+        v = jnp.asarray(v)
+        if not ptq_quantizable(k, v):
+            out[k] = v
+            continue
+        scale = absmax_scale(v, axis=0, keepdims=True)     # [1, out]
+        q = quantize_symmetric(v, scale, bits).astype(jnp.int8)
+        out[k] = q
+        out[k + WEIGHT_SCALE_SUFFIX] = scale[0]            # [out]
+    return out
+
+
+def dequantize_param_tree(params: Dict[str, jnp.ndarray], dtype,
+                          bits: int = 8) -> Dict[str, jnp.ndarray]:
+    """Traceable dequant-on-use prologue for the fused serving steps:
+    int8 weights × their scale vectors back to ``dtype``, scale keys
+    dropped, everything else passed through.  Composed INSIDE the
+    compiled step, so HBM holds the int8 tree and XLA fuses the
+    dequant into the consuming matmuls."""
+    out: Dict[str, jnp.ndarray] = {}
+    for k, v in params.items():
+        if is_weight_scale_key(k):
+            continue
+        s = params.get(k + WEIGHT_SCALE_SUFFIX)
+        if s is None:
+            out[k] = v
+        else:
+            out[k] = dequantize_symmetric(v, s[None, :],
+                                          bits).astype(dtype)
+    return out

@@ -283,24 +283,43 @@ def _run_workload(eng, model, prompts, budget, check=True):
 
 
 def bench_mixed_decode(model, slots, occupancy, prompt_len, warm, steps,
-                       num_blocks, block_size, chunk, mesh=None):
+                       num_blocks, block_size, chunk, mesh=None,
+                       **engine_kw):
     """Occupancy-matched decode tokens/s through the fused MixedStep
     (mirror of bench_decode so the split/mixed split is apples to
-    apples); ``mesh`` shards it over the tp axis (the --tp curve)."""
+    apples); ``mesh`` shards it over the tp axis (the --tp curve);
+    ``engine_kw`` passes quantization flags through (the --quant
+    overhead guard)."""
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
     vocab = model.config.vocab_size
     rng = np.random.RandomState(0)
+    budget = warm + steps + 8
     eng = ContinuousBatchingEngine(model, max_batch_size=slots,
                                    num_blocks=num_blocks,
                                    block_size=block_size,
                                    mixed_step=True,
                                    prefill_chunk_size=chunk,
-                                   mesh=mesh)
-    budget = warm + steps + 8
+                                   # size the block table to the
+                                   # workload: the compiled attention
+                                   # gathers the full table width, so
+                                   # dead width is dead work for BOTH
+                                   # engines being compared
+                                   max_seq_len=prompt_len + budget
+                                   + block_size,
+                                   mesh=mesh, **engine_kw)
     for _ in range(occupancy):
         eng.add_request(rng.randint(1, vocab, (prompt_len,))
                         .astype(np.int64), max_new_tokens=budget)
-    for _ in range(warm + 2):           # prefill + budget compiles land
+    # drain every prefill chunk first (prompts longer than the chunk
+    # size take several packed steps; the first step also runs
+    # admission, so the prefilling states are visible), then the decode
+    # warm window — so the measured steps are pure decode packs with
+    # the all-decode budget's compile already landed
+    eng.step()
+    while any(r is not None and r.state == "prefilling"
+              for r in eng.slots):
+        eng.step()
+    for _ in range(warm + 2):           # budget compiles land
         eng.step()
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -500,6 +519,298 @@ def main_mixed(out_path):
         "unit": "tokens/s",
         "vs_baseline": round(mixed_prefill / max(base_prefill, 1e-9), 2)
         if ok else 0.0,
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+QUANT_THRESHOLDS = {
+    # declared greedy token-match-rate gates vs the fp32 engine, per
+    # quant config (the tolerance-gate contract: quantization is
+    # allowed to flip a token only this often across the gated
+    # decode-only / mixed / chunked / prefix-hit workloads)
+    "kv8": 0.90,
+    "w8": 0.90,
+    "kv8_w8": 0.85,
+    "tp2_q8_collectives": 0.90,
+    # decode throughput guard (int8-KV engine / fp32 engine).  TPU:
+    # 0.9 — the Pallas kernel dequantizes in-register off 1/4 the HBM
+    # traffic, so int8 should never cost 10%.  CPU dryrun: 0.85 — the
+    # XLA reference path pays XLA-CPU's slow int8->f32 converts on the
+    # gathered pages (~12% of a dispatch-bound tiny-model step), an
+    # artifact with no TPU counterpart; the guard still catches real
+    # regressions (an accidental extra pool pass shows up as >15%).
+    "decode_ratio_tpu": 0.90,
+    "decode_ratio_cpu_dryrun": 0.85,
+}
+
+
+def _quant_workloads(cfg, wl):
+    """The four gated workloads (token lists compared positionally)."""
+    vocab = cfg.vocab_size
+    rng = np.random.RandomState(7)
+    dec_prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+                   for n in (5, 3, 8)]
+    rng = np.random.RandomState(11)
+    mixed = [rng.randint(1, vocab, (n,)).astype(np.int64)
+             for n in wl["mixed_lengths"]]
+    long_p = rng.randint(1, vocab, (wl["long_len"],)).astype(np.int64)
+    P = rng.randint(1, vocab, (wl["prefix_len"],)).astype(np.int64)
+    hit_p = np.concatenate(
+        [P, rng.randint(1, vocab, (wl["suffix_len"],)).astype(np.int64)])
+    return {
+        "decode_only": (dec_prompts, [6, 8, 5]),
+        "mixed": (mixed, [wl["budget"]] * len(mixed)),
+        "chunked": ([long_p], [wl["budget"]]),
+        # two requests: the first publishes the prefix pages, the
+        # second admits against a warm table (hit + copy-on-write)
+        "prefix_hit": ([np.concatenate([P, long_p[:wl["suffix_len"]]]),
+                        hit_p], [wl["budget"]] * 2),
+    }
+
+
+def _run_quant_workload(model, wl, prompts, budgets, sequential,
+                        mesh=None, **quant_kw):
+    """One fresh mixed-step engine over one workload; returns the
+    per-request token lists (and the engine, for accounting)."""
+    eng = ContinuousBatchingEngine(
+        model, max_batch_size=wl["slots"], num_blocks=wl["num_blocks"],
+        block_size=wl["block_size"], mixed_step=True,
+        prefill_chunk_size=wl["chunk"], enable_prefix_cache=True,
+        mesh=mesh, **quant_kw)
+    rids = []
+    for i, (p, b) in enumerate(zip(prompts, budgets)):
+        rids.append(eng.add_request(p, b))
+        if sequential:
+            eng.run_to_completion()   # prefix publisher finishes first
+        elif i % 2 == 0:
+            eng.step()                # staggered admission churn
+    eng.run_to_completion()
+    return [eng.result(r) for r in rids], eng
+
+
+def _match_stats(ref, got):
+    tot = sum(len(a) for a in ref)
+    hit = sum(x == y for a, b in zip(ref, got) for x, y in zip(a, b))
+    return hit / max(1, tot), tot - hit
+
+
+def _max_logit_error(model, qtree, n_tokens=16):
+    """Dense-forward probe: max |logits_fp - logits_dequant(int8 PTQ)|
+    on one fixed random batch (weight-quant error in isolation)."""
+    import jax.numpy as jnp
+    from paddle_tpu.autograd.tape import no_grad
+    from paddle_tpu.quantization.functional import dequantize_param_tree
+    cfg = model.config
+    rng = np.random.RandomState(23)
+    ids = paddle.to_tensor(
+        rng.randint(1, cfg.vocab_size, (1, n_tokens)).astype(np.int64))
+    caches = [(None, None)] * cfg.num_hidden_layers
+    with no_grad():
+        ref, _ = model.forward(ids, caches=caches)
+        dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        with model.bind_state(dequantize_param_tree(qtree, dt)):
+            got, _ = model.forward(ids, caches=caches)
+    return float(np.max(np.abs(np.asarray(ref._value, np.float32)
+                               - np.asarray(got._value, np.float32))))
+
+
+def main_quant(out_path):
+    from paddle_tpu.testing.dryrun import force_cpu_devices
+    on_tpu = _tpu_available()
+    if not on_tpu:
+        force_cpu_devices(8)       # the tp=2 section needs virtual chips
+    dev = jax.devices()[0]
+    cfg, model = build_model_tp(on_tpu)
+
+    if on_tpu:
+        wl = dict(slots=8, block_size=16, num_blocks=1024,
+                  mixed_lengths=[20, 45, 70, 100, 130, 190, 250, 300],
+                  long_len=600, prefix_len=192, suffix_len=32, budget=8,
+                  chunk=256)
+        dec = dict(slots=8, occupancy=8, prompt_len=128, warm=4,
+                   steps=32, num_blocks=8 * (-(-(128 + 64) // 16) + 2),
+                   block_size=16)
+    else:
+        wl = dict(slots=4, block_size=4, num_blocks=192,
+                  mixed_lengths=[3, 5, 6, 7, 9, 10, 11, 13],
+                  long_len=36, prefix_len=24, suffix_len=4, budget=4,
+                  chunk=16)
+        dec = dict(slots=4, occupancy=4, prompt_len=12, warm=2,
+                   steps=32, num_blocks=64, block_size=4)
+    workloads = _quant_workloads(cfg, wl)
+
+    configs = {
+        "kv8": dict(kv_dtype="int8"),
+        "w8": dict(weight_quant="int8"),
+        "kv8_w8": dict(kv_dtype="int8", weight_quant="int8"),
+    }
+    # fp32 reference tokens per workload (same engine shape, no quant)
+    ref_tokens = {}
+    pool_bytes_fp = None
+    for name, (prompts, budgets) in workloads.items():
+        toks, eng = _run_quant_workload(
+            model, wl, prompts, budgets, sequential=(name == "prefix_hit"))
+        ref_tokens[name] = toks
+        pool_bytes_fp = eng.caches[0].per_chip_pool_bytes()
+
+    # the r12 contract: the fp32 default path stays byte-identical to
+    # eager generate (provenance: r12 = fp32, r13 = quant)
+    fp32_parity = parity_gate_mixed(model, wl)
+
+    sections = {}
+    rates_all = {}
+    pool_bytes_q = None
+    for cname, qkw in configs.items():
+        rates = {}
+        mismatches = 0
+        for name, (prompts, budgets) in workloads.items():
+            toks, eng = _run_quant_workload(
+                model, wl, prompts, budgets,
+                sequential=(name == "prefix_hit"), **qkw)
+            rate, miss = _match_stats(ref_tokens[name], toks)
+            eng.record_token_mismatches(miss)
+            rates[name] = round(rate, 4)
+            mismatches += miss
+            if cname == "kv8":
+                pool_bytes_q = eng.caches[0].per_chip_pool_bytes()
+        rates_all[cname] = rates
+        sections[cname] = {"token_match_rate": rates,
+                           "token_mismatches": mismatches}
+
+    capacity_ratio = pool_bytes_fp / max(pool_bytes_q, 1)
+    sections["kv8"]["kv_pool_bytes_fp32"] = pool_bytes_fp
+    sections["kv8"]["kv_pool_bytes_int8_with_scales"] = pool_bytes_q
+    sections["kv8"]["pages_per_hbm_byte_ratio"] = round(capacity_ratio, 3)
+    qtree_probe = None
+    from paddle_tpu.quantization.functional import quantize_param_tree
+    qtree_probe = quantize_param_tree(
+        {k: t._value for k, t in model.state_dict().items()})
+    sections["w8"]["max_logit_abs_error"] = round(
+        _max_logit_error(model, qtree_probe), 6)
+    int8_w_bytes = sum(
+        int(np.prod(v.shape)) * v.dtype.itemsize
+        for v in qtree_probe.values())
+    fp_w_bytes = sum(
+        int(np.prod(t._value.shape)) * t._value.dtype.itemsize
+        for t in model.state_dict().values())
+    sections["w8"]["weight_bytes_ratio_vs_fp"] = round(
+        int8_w_bytes / fp_w_bytes, 4)
+
+    # decode throughput: the int8-KV engine (the capacity lever) must
+    # stay within 0.9x of fp32 on the standard occupancy-matched decode
+    # config.  On the CPU dryrun this is an OVERHEAD GUARD — the tiny
+    # model is dispatch-bound, so it bounds the quant write/dequant op
+    # cost, not real-silicon speed.  Best-of-5: the per-step window is
+    # sub-ms and one loaded scheduler quantum would otherwise decide
+    # the gate.
+    def _best(fn, *a, **k):
+        return max((fn(*a, **k) for _ in range(5)),
+                   key=lambda r: r["decode_tokens_per_sec"])
+
+    dargs = (model, dec["slots"], dec["occupancy"], dec["prompt_len"],
+             dec["warm"], dec["steps"], dec["num_blocks"],
+             dec["block_size"], wl["chunk"])
+    fp_dec = _best(bench_mixed_decode, *dargs)
+    q_dec = _best(bench_mixed_decode, *dargs, kv_dtype="int8")
+    qw_dec = _best(bench_mixed_decode, *dargs, kv_dtype="int8",
+                   weight_quant="int8")
+    fp_tps = max(fp_dec["decode_tokens_per_sec"], 1e-9)
+    sections["decode"] = {
+        "fp32": fp_dec, "kv8": q_dec, "kv8_w8": qw_dec,
+        "ratio_kv8": round(
+            q_dec["decode_tokens_per_sec"] / fp_tps, 3),
+        "ratio_kv8_w8": round(
+            qw_dec["decode_tokens_per_sec"] / fp_tps, 3)}
+
+    # tp=2 + EQuARX-style int8 logits all-gather (quantized collective)
+    tp2 = {"skipped": True}
+    tp2_rate = 1.0
+    if jax.device_count() >= 2 and cfg.num_key_value_heads % 2 == 0:
+        from paddle_tpu.jit.spmd import tp_mesh
+        prompts, budgets = workloads["decode_only"]
+        toks, eng = _run_quant_workload(
+            model, wl, prompts, budgets, sequential=False,
+            mesh=tp_mesh(2), kv_dtype="int8", quant_collectives=True)
+        tp2_rate, miss = _match_stats(ref_tokens["decode_only"], toks)
+        eng.record_token_mismatches(miss)
+        top = eng.token_budgets[-1]
+        exact = eng.mixed._tp.collective_bytes(cfg, top,
+                                               eng.max_batch_size)
+        quant = eng.mixed.collective_bytes(top)
+        tp2 = {
+            "skipped": False,
+            "token_match_rate_vs_fp32_tp1": round(tp2_rate, 4),
+            "all_gather_bytes_exact": exact["all_gather"],
+            "all_gather_bytes_quantized": quant["all_gather"],
+            "all_gather_shrink": round(
+                exact["all_gather"] / max(quant["all_gather"], 1), 2),
+        }
+    sections["tp2_q8_collectives"] = tp2
+
+    gated = {
+        "kv8": rates_all["kv8"],
+        "w8": rates_all["w8"],
+        "kv8_w8": rates_all["kv8_w8"],
+    }
+    gates = {
+        "fp32_default_byte_parity": bool(fp32_parity),
+        "capacity_ratio_ge_1p9": bool(capacity_ratio >= 1.9),
+        "decode_within_threshold": bool(
+            q_dec["decode_tokens_per_sec"]
+            >= QUANT_THRESHOLDS[
+                "decode_ratio_tpu" if on_tpu
+                else "decode_ratio_cpu_dryrun"] * fp_tps),
+        "token_match_all_workloads": all(
+            r >= QUANT_THRESHOLDS[c]
+            for c, rs in gated.items() for r in rs.values()),
+        "tp2_quant_collectives": bool(
+            tp2.get("skipped")
+            or tp2_rate >= QUANT_THRESHOLDS["tp2_q8_collectives"]),
+    }
+    ok = all(gates.values())
+    artifact = {
+        "metric": "serving_quant_kv_pages_per_hbm_byte_ratio",
+        "value": round(capacity_ratio, 3),
+        "passed": ok,
+        "gates": gates,
+        "thresholds": QUANT_THRESHOLDS,
+        "provenance": "r12 = fp32 serving (BENCH_SERVE_r12.json); "
+                      "r13 = quantized (this artifact); fp32 default "
+                      "path byte-parity re-gated live above",
+        "sections": sections,
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "heads": cfg.num_attention_heads,
+            "kv_heads": cfg.num_key_value_heads,
+            "slots": wl["slots"],
+            "block_size": wl["block_size"],
+            "num_blocks": wl["num_blocks"],
+            "chunk": wl["chunk"],
+            "dtype": cfg.dtype,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "cpu_dryrun": not on_tpu,
+        "note": ("CPU dryrun: throughput gate is an overhead guard "
+                 "(dispatch-bound); capacity + token-match gates are "
+                 "platform-independent" if not on_tpu else
+                 "TPU: all gates live"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print("# quant: capacity %.2fx, decode ratio kv8 %.3f (w8 %.3f), "
+          "match rates %s, tp2 %s, gates=%s"
+          % (capacity_ratio, sections["decode"]["ratio_kv8"],
+             sections["decode"]["ratio_kv8_w8"], rates_all,
+             tp2, gates), file=sys.stderr)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "x",
+        "vs_baseline": artifact["value"] if ok else 0.0,
     }), flush=True)
     if not ok:
         sys.exit(1)
@@ -715,6 +1026,29 @@ def parity_gate_mixed(model, wl):
 
 
 def main():
+    if "--quant" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--quant"]
+        stray = [a for a in argv if a.startswith("-")]
+        if stray:
+            print("bench_serving: --quant cannot combine with %s — run "
+                  "the modes separately" % ", ".join(stray),
+                  file=sys.stderr)
+            sys.exit(2)
+        out_path = argv[0] if argv else "BENCH_QUANT_r13.json"
+        try:
+            main_quant(out_path)
+        except SystemExit:
+            raise
+        except Exception as e:                        # noqa: BLE001
+            print(json.dumps({
+                "metric": "serving_quant_kv_pages_per_hbm_byte_ratio",
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            }), flush=True)
+            sys.exit(1)
+        return
     if "--tp" in sys.argv[1:]:
         args = sys.argv[1:]
         i = args.index("--tp")

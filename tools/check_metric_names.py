@@ -63,6 +63,10 @@ REQUIRED_NAMES = frozenset({
     # tensor-parallel multichip serving (round-12; BENCH_SERVE_r12.json)
     "serving_tp_degree",
     "serving_tp_collective_bytes_total",
+    # quantized serving (round-13; BENCH_QUANT_r13.json)
+    "serving_kv_quant_dtype",
+    "serving_quant_collective_bytes_total",
+    "serving_quant_token_mismatch_total",
 })
 
 
