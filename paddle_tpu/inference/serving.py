@@ -48,9 +48,36 @@ stalls running TPOT.  The bucketed PrefillStep and legacy dense paths
 remain for ``mixed_step=False`` (the default — existing engines are
 byte-identical).
 
+Sampling + speculative decoding (round 14, both OFF by default):
+
+- **Stochastic sampling** (``sampling=True``): per-request temperature
+  / top-k / top-p / seed ride ``add_request`` and reach the fused
+  steps as traced data (the mixed pack grows four bitcast columns, the
+  split steps one [.., 4] int32 operand), sampled on device with a
+  counter-based PRNG keyed on (request seed, token position) — so a
+  sampled request's tokens are identical alone or batched, split or
+  mixed, single-chip or tp, and changing knobs/seeds never retraces.
+  ``temperature=0`` requests take the exact greedy argmax.
+- **Speculative decoding** (``draft_model=``, needs ``mixed_step``): a
+  small draft model with its OWN per-layer paged pools — addressed by
+  the SAME page ids, so allocation/refcount/COW bookkeeping is shared
+  and prefix-cache hits carry draft KV for free — proposes ``spec_k``
+  tokens per engine round (k fused draft launches; prefill chunks
+  mirror into the draft pool in the same launches), and the target
+  verifies every slot's k+1 positions in ONE MixedStep launch using
+  length-(k+1) ragged spans.  Standard accept/reject with
+  rejection-resampling keeps the sampled output distribution exact;
+  greedy speculative output is BYTE-IDENTICAL to non-speculative
+  greedy (the CPU-checkable gate in ``bench_serving.py
+  --speculative``).  Pages grown for rejected draft positions roll
+  back through the refcounted release path (lazy mode).
+
 Admission/eviction is host control flow; all math is jitted device
 compute, and the only per-step host traffic is the [slots] int32
-next-token fetch (plus one int32 scalar per non-mixed prefill chunk).
+next-token fetch (plus one int32 scalar per non-mixed prefill chunk;
+a speculative round adds the k [slots] draft-token fetches and the
+verifier's [slots] accepted-count row — draft DISTRIBUTIONS stay on
+device).
 """
 from __future__ import annotations
 
@@ -88,6 +115,19 @@ class GenerationRequest:
     prefill_pos: int = 0
     # prompt tokens served from shared prefix pages instead of recompute
     prefix_hit_tokens: int = 0
+    # stochastic sampling (round 14): temperature <= 0 is exact greedy;
+    # seed feeds the per-position counter-based PRNG
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
+    # n>1 generation groups: a child admits only after its parent's
+    # prefill published the shared prefix pages (COW machinery)
+    parent_req: Optional["GenerationRequest"] = field(default=None,
+                                                     repr=False)
+    # speculative decoding: positions [0, draft_len) hold draft-model
+    # KV for the ACCEPTED token sequence
+    draft_len: int = 0
     # telemetry marks (perf_counter): admission -> first token = TTFT,
     # first token -> done over n-1 tokens = TPOT
     t_submit: float = 0.0
@@ -169,9 +209,44 @@ class ContinuousBatchingEngine:
                  mesh=None, sharding=None,
                  kv_dtype: Optional[str] = None,
                  weight_quant: Optional[str] = None,
-                 quant_collectives: bool = False):
+                 quant_collectives: bool = False,
+                 sampling: bool = False,
+                 draft_model=None, spec_k: int = 2):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
+        # ---- sampling / speculative validation (construction-time) --
+        self.sampling = bool(sampling)
+        if self.sampling and not mixed_step and not prefill_buckets:
+            raise ValueError(
+                "stochastic sampling needs a compiled prefill path: "
+                "pass mixed_step=True or prefill_buckets='auto' — the "
+                "legacy dense prefill argmaxes its first token eagerly "
+                "and cannot apply per-request temperature/top-k/top-p")
+        if draft_model is not None:
+            if not mixed_step:
+                raise ValueError(
+                    "speculative decoding (draft_model=) needs "
+                    "mixed_step=True: the target verifies all slots' "
+                    "k+1 positions as length-(k+1) ragged spans in one "
+                    "MixedStep launch")
+            if mesh is not None or sharding is not None:
+                raise ValueError(
+                    "speculative decoding is single-chip for now: the "
+                    "draft engine runs unsharded, so a tensor-parallel "
+                    "target would mix placements — drop mesh/sharding "
+                    "or drop draft_model")
+            if int(spec_k) < 1:
+                raise ValueError(
+                    "spec_k must be >= 1 (the draft proposes at least "
+                    "one token per round); got %r" % (spec_k,))
+            if draft_model.config.vocab_size != model.config.vocab_size:
+                raise ValueError(
+                    "draft and target models must share one vocabulary "
+                    "(%d vs %d): accept/reject compares token ids"
+                    % (draft_model.config.vocab_size,
+                       model.config.vocab_size))
+        self.draft_model = draft_model
+        self.spec_k = int(spec_k) if draft_model is not None else 0
         # ---- quantization validation (construction-time, PR-7 norm:
         # a clear error HERE, never a dtype/shape failure deep inside
         # tracing) --------------------------------------------------
@@ -279,10 +354,14 @@ class ContinuousBatchingEngine:
         self._seq_lens = np.zeros((max_batch_size,), np.int32)
         self._bt = np.full((max_batch_size, self.bt_width), self._sink,
                            np.int32)
+        # per-slot packed sampling knobs (temperature bits, top_k,
+        # top_p bits, seed); all-zero = greedy, the masked-slot default
+        self._samp = np.zeros((max_batch_size, 4), np.int32)
         self.decode_step = DecodeStep(
             model, self.caches, use_pallas=use_pallas, tp=self.tp,
             weight_qparams=self.weight_qtree,
-            quant_collectives=self.quant_collectives)
+            quant_collectives=self.quant_collectives,
+            sampling=self.sampling)
 
         # ---- bucketed / chunked prefill ------------------------------
         if prefill_buckets == "auto":
@@ -302,7 +381,8 @@ class ContinuousBatchingEngine:
             self.prefill_step = PrefillStep(
                 model, self.caches, self.bt_width, tp=self.tp,
                 weight_qparams=self.weight_qtree,
-                quant_collectives=self.quant_collectives)
+                quant_collectives=self.quant_collectives,
+                sampling=self.sampling)
         else:
             self.chunk_size = None
             self.prefill_step = None
@@ -315,31 +395,85 @@ class ContinuousBatchingEngine:
                 self.chunk_size = int(prefill_chunk_size
                                       or self._auto_buckets(
                                           self.max_seq_len)[-1])
+            # a speculative all-decode pack is slots x (k+1) verify
+            # tokens, not slots x 1 — size the budget base to it
+            base_spans = max_batch_size * (self.spec_k + 1)
             if token_budgets == "auto":
-                budgets = self._auto_budgets_mixed(max_batch_size,
+                budgets = self._auto_budgets_mixed(base_spans,
                                                    self.chunk_size)
             else:
                 budgets = tuple(sorted({int(b) for b in token_budgets}))
-                if not budgets or budgets[-1] < max_batch_size:
+                if not budgets or budgets[-1] < base_spans:
                     raise ValueError(
-                        "top token budget %r < max_batch_size %d: an "
-                        "all-decode step would not fit"
-                        % (token_budgets, max_batch_size))
+                        "top token budget %r < %d (max_batch_size x "
+                        "(spec_k+1)): an all-decode step would not fit"
+                        % (token_budgets, base_spans))
             self.token_budgets = budgets
             self.mixed = MixedStep(model, self.caches, self.bt_width,
                                    max_spans=max_batch_size,
-                                   span_q=min(self.chunk_size,
+                                   # a verify span is spec_k+1 tokens —
+                                   # the kernel's static span window
+                                   # must cover it as well as a chunk
+                                   span_q=min(max(self.chunk_size,
+                                                  self.spec_k + 1),
                                               budgets[-1]),
                                    use_pallas=use_pallas, tp=self.tp,
                                    weight_qparams=self.weight_qtree,
                                    quant_collectives=
-                                   self.quant_collectives)
+                                   self.quant_collectives,
+                                   sampling=self.sampling,
+                                   spec_k=self.spec_k)
             # padding tokens spread over the sink page's slots
             self._dest_pad = (np.arange(budgets[-1], dtype=np.int32)
                               % block_size)
         else:
             self.token_budgets = None
             self.mixed = None
+        # ---- speculative draft engine --------------------------------
+        # the draft model's OWN per-layer pools, addressed by the SAME
+        # page ids as the target's (caches[0] stays the one free-list /
+        # refcount authority) — prefix sharing, COW and release carry
+        # the draft KV for free.  The draft runs as a MixedStep too:
+        # catch-up spans are ragged (1-2 tokens) and prefill chunks
+        # mirror straight into the draft pool.
+        if draft_model is not None:
+            dcfg = draft_model.config
+            d_dtype = jnp.bfloat16 if dcfg.dtype == "bfloat16" \
+                else jnp.float32
+            self.draft_caches = [
+                PagedKVCache(num_blocks, block_size,
+                             dcfg.num_key_value_heads,
+                             dcfg.hidden_size // dcfg.num_attention_heads,
+                             d_dtype, sink_block=True)
+                for _ in range(dcfg.num_hidden_layers)]
+            self.draft_step = MixedStep(
+                draft_model, self.draft_caches, self.bt_width,
+                max_spans=max_batch_size,
+                span_q=min(self.chunk_size, self.token_budgets[-1]),
+                use_pallas=use_pallas, sampling=self.sampling,
+                return_probs=self.sampling)
+            # draft packs are SMALL (proposal launches carry one token
+            # per slot, catch-up at most two) — give the draft set
+            # tight small bases so a 1-token-per-slot launch never pads
+            # to the verify-sized budget, and carry the target's set on
+            # top so chunk mirrors always fit.  Both modules' compiles
+            # stay bounded by their (static) budget-set sizes.
+            small = []
+            b = 1
+            while b < max(1, max_batch_size):
+                b *= 2
+            small.append(b)
+            small.append(b * 2)                  # catch-up: <= 2 tokens
+            self.draft_budgets = tuple(sorted(
+                set(small) | set(self.token_budgets)))
+            self._zero_q = (jnp.zeros((max_batch_size, cfg.vocab_size),
+                                      jnp.float32)
+                            if self.sampling else None)
+        else:
+            self.draft_caches = []
+            self.draft_step = None
+            self.draft_budgets = None
+            self._zero_q = None
         if enable_prefix_cache:
             if not buckets and self.mixed is None:
                 raise ValueError(
@@ -452,6 +586,25 @@ class ContinuousBatchingEngine:
             "engine on a paired run (published by the quantization "
             "bench/tests via record_token_mismatches — the tolerance "
             "gate's numerator)")
+        self._m_sampling_mode = r.gauge(
+            "serving_sampling_mode",
+            "1 = the stochastic sampling epilogue is compiled into "
+            "this process's most recently constructed engine, 0 = "
+            "greedy-only")
+        self._m_sampling_mode.set(1 if self.sampling else 0)
+        self._m_spec_proposed = r.counter(
+            "serving_spec_proposed_tokens_total",
+            "draft tokens proposed to the speculative verifier")
+        self._m_spec_accepted = r.counter(
+            "serving_spec_accepted_tokens_total",
+            "proposed draft tokens the target verifier accepted "
+            "(acceptance rate = accepted / proposed)")
+        self._m_draft_step = r.histogram(
+            "serving_spec_draft_step_duration_seconds",
+            "one fused draft-model launch (catch-up + proposal or "
+            "chunk mirror; compile warmup excluded)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0))
         # compile warmup never lands in a latency histogram.  Bucketed
         # prefill tracks warmth PER BUCKET via the step's own compile
         # counters (a call that traced is cold, everything else is warm
@@ -495,20 +648,41 @@ class ContinuousBatchingEngine:
 
     # ---- public API ----------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens=16,
-                    eos_token_id=None) -> int:
-        req = GenerationRequest(
-            req_id=self._next_id,
-            prompt_ids=np.asarray(prompt_ids, np.int64).reshape(-1),
-            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
-        need = self.caches[0].blocks_needed(
-            len(req.prompt_ids) + max_new_tokens)
+                    eos_token_id=None, temperature: float = 0.0,
+                    top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                    n: int = 1):
+        """Queue one prompt.  ``temperature``/``top_k``/``top_p``/
+        ``seed`` select stochastic sampling (engine must be built with
+        ``sampling=True``; temperature 0 = greedy).  ``n>1`` queues n
+        generations of the SAME prompt that share one prefilled prefix
+        through the copy-on-write prefix-page machinery (requires
+        ``enable_prefix_cache=True``): generation i samples with
+        ``seed + i``, children admit only after the first generation's
+        prefill publishes the shared pages (ref++ on every shared
+        page, per-generation divergent suffixes).  Returns the req_id,
+        or the list of n req_ids when ``n > 1``."""
+        if (temperature or top_k or top_p or seed) and not self.sampling:
+            raise ValueError(
+                "per-request sampling parameters need a sampling "
+                "engine: construct ContinuousBatchingEngine("
+                "sampling=True, ...) — the greedy engine's compiled "
+                "steps have no sampling epilogue")
+        if n < 1:
+            raise ValueError("add_request n must be >= 1, got %r" % n)
+        if n > 1 and self.prefix_cache is None:
+            raise ValueError(
+                "add_request(n=%d) shares one prefilled prefix across "
+                "generations via the prefix-page cache: construct the "
+                "engine with enable_prefix_cache=True" % n)
+        prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+        need = self.caches[0].blocks_needed(len(prompt) + max_new_tokens)
         if need > self.bt_width:
             raise ValueError(
                 "request needs %d pages but the engine's block-table "
                 "width is %d (max_seq_len=%d); raise max_seq_len"
                 % (need, self.bt_width, self.max_seq_len))
         min_need = need if not self.lazy_alloc else \
-            self.caches[0].blocks_needed(len(req.prompt_ids) + 1)
+            self.caches[0].blocks_needed(len(prompt) + 1)
         if min_need > self.caches[0].num_blocks:
             # would never admit: _admit waits for pages that can't exist
             # (lazy mode only needs the prompt to fit — the tail may be
@@ -516,11 +690,23 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "request needs %d pages but the pool only has %d; "
                 "raise num_blocks" % (min_need, self.caches[0].num_blocks))
-        self._next_id += 1
-        req.t_submit = time.perf_counter()
-        self.waiting.append(req)
+        ids = []
+        parent = None
+        for i in range(n):
+            req = GenerationRequest(
+                req_id=self._next_id, prompt_ids=prompt,
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                temperature=float(temperature), top_k=int(top_k),
+                top_p=float(top_p), seed=int(seed) + i,
+                parent_req=parent)
+            if parent is None:
+                parent = req
+            self._next_id += 1
+            req.t_submit = time.perf_counter()
+            self.waiting.append(req)
+            ids.append(req.req_id)
         self._m_queue.set(len(self.waiting))
-        return req.req_id
+        return ids[0] if n == 1 else ids
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None
@@ -601,6 +787,12 @@ class ContinuousBatchingEngine:
         and start (or finish) the suffix prefill.  Returns False —
         with NO side effects — when the pool cannot cover the request
         yet."""
+        if req.parent_req is not None \
+                and req.parent_req.state in ("waiting", "prefilling"):
+            # n>1 group: wait for the parent generation's prefill to
+            # publish the shared prefix pages, so this child admits as
+            # a whole-prompt hit (ref++ + COW) instead of recomputing
+            return False
         cache = self.caches[0]
         L = len(req.prompt_ids)
         matched: List[int] = []
@@ -643,15 +835,25 @@ class ContinuousBatchingEngine:
             src = req.block_ids[-1]
             dst = self._alloc_block()
             copy_block(self.caches, src, dst)
+            if self.draft_caches:
+                # the draft pool shares page ids: its copy of the
+                # shared page moves with the target's
+                copy_block(self.draft_caches, src, dst)
             cache.free_sequence([src])      # drop this request's share
             req.block_ids[-1] = dst
         while len(req.block_ids) < total_need:
             req.block_ids.append(self._alloc_block())
         req.prefill_pos = hit_len
         req.prefix_hit_tokens = hit_len
+        # a prefix hit fills the draft pool too (same page ids, written
+        # by the publisher's mirrored chunks); the suffix chunks mirror
+        # from prefill_pos on
+        req.draft_len = hit_len
         req.slot = slot
         req.state = "prefilling"
         self.slots[slot] = req
+        if self.sampling:
+            self._samp[slot] = self._samp_row(req)
         if self.mixed is not None:
             # chunks ride the fused mixed step packed this same step()
             # — admission never runs a separate prefill dispatch
@@ -742,7 +944,9 @@ class ContinuousBatchingEngine:
         row = self._row_for(req)
         t0 = time.perf_counter()
         pre = self.prefill_step.total_compiles
-        first = self.prefill_step(toks, start, size, row)
+        first = self.prefill_step(
+            toks, start, size, row,
+            self._samp_row(req) if self.sampling else None)
         traced = self.prefill_step.total_compiles - pre
         if self.tp is not None:
             self._count_collectives(
@@ -761,6 +965,7 @@ class ContinuousBatchingEngine:
                           row: np.ndarray):
         slot = req.slot
         req.seq_len = len(req.prompt_ids)
+        req.draft_len = req.seq_len        # draft pool mirrored the prompt
         req.state = "running"
         if self.prefix_cache is not None:
             # publish this prompt's full pages for future admissions
@@ -811,7 +1016,8 @@ class ContinuousBatchingEngine:
         t_decode = time.perf_counter()
         # DecodeStep returns np.asarray(...) — the host fetch inside
         # the call is the device barrier, so this window is honest
-        nxt = self.decode_step(self._tokens, self._seq_lens, self._bt)
+        nxt = self.decode_step(self._tokens, self._seq_lens, self._bt,
+                               self._samp if self.sampling else None)
         if self._decode_warm:
             self._m_decode.observe(time.perf_counter() - t_decode)
         self._decode_warm = True
@@ -832,55 +1038,33 @@ class ContinuousBatchingEngine:
         return done
 
     # ---- fused mixed prefill+decode step --------------------------------
-    def _pack_spans(self):
-        """Choose this step's ragged span set: every running slot's
-        decode token (all must advance), then pending prefill chunks
-        round-robin over prefilling slots while the TOP budget has room
-        — multiple chunks per step, the round-robin latency killer."""
-        top = self.token_budgets[-1]
-        spans = []                    # (req, kind, size, start)
-        total = 0
-        for r in self.slots:
-            if r is not None and r.state == "running":
-                spans.append((r, "decode", 1, r.seq_len))
-                total += 1
-        n = self.max_batch_size
-        advanced_first = None
-        for k in range(n):
-            i = (self._chunk_rr + k) % n
-            r = self.slots[i]
-            if r is None or r.state != "prefilling":
-                continue
-            room = top - total
-            if room <= 0:
-                break
-            size = min(self.chunk_size,
-                       len(r.prompt_ids) - r.prefill_pos, room)
-            if size <= 0:
-                continue
-            spans.append((r, "prefill", size, r.prefill_pos))
-            total += size
-            if advanced_first is None:
-                advanced_first = i
-        if advanced_first is not None:
-            self._chunk_rr = (advanced_first + 1) % n
-        return spans, total
+    @staticmethod
+    def _samp_row(req: GenerationRequest, seed_xor: int = 0) -> np.ndarray:
+        """The request's packed sampling knobs: (temperature bits,
+        top_k, top_p bits, seed) — fp knobs bitcast into the int32
+        lane.  ``seed_xor`` derives the draft engine's independent
+        proposal stream from the same request seed."""
+        row = np.empty(4, np.int32)
+        row[0] = np.float32(req.temperature).view(np.int32)
+        row[1] = req.top_k
+        row[2] = np.float32(req.top_p).view(np.int32)
+        row[3] = (req.seed ^ seed_xor) & 0x7FFFFFFF
+        return row
 
-    def _run_mixed_step(self) -> List[int]:
-        """Pack the admission mix into ONE fused MixedStep launch: build
-        the per-token and per-span tables on the host (control flow),
-        pad to the smallest token budget, dispatch, then apply the same
-        bookkeeping the split decode/prefill paths used."""
-        done = self._grow_pages() if self.lazy_alloc else []
-        spans, total = self._pack_spans()
-        if not spans:
-            return done
-        B = next(b for b in self.token_budgets if b >= total)
+    def _fill_mixed_pack(self, mx, budgets, spans):
+        """Fill one MixedStep pack from span tuples
+        ``(req, tokens, start, n_draft, seed_xor, masked)``: the span's
+        tokens land at global positions ``start..start+m-1`` (kv_len =
+        start+m), pages from the request's block table, sampling-knob
+        columns when the step compiles them.  ``masked`` spans keep the
+        padding descriptor (writes to the sink page, all-sink block
+        table) but still occupy their span row, so output/probs rows
+        stay slot-aligned across launches.  Returns ``(pack, B)``."""
+        total = sum(len(t) for _, t, _, _, _, _ in spans)
+        B = next(b for b in budgets if b >= total)
         bs = self.block_size
         W = self.bt_width
-        # fill the step's single host buffer in place (the pack layout
-        # is MixedStep's; tok_tab/span_tab are views into it)
-        pack, tok_tab, span_tab = self.mixed.new_pack(B)
+        pack, tok_tab, span_tab = mx.new_pack(B)
         tokens, positions, dest_blocks, dest_offsets = tok_tab
         tokens[:] = 0
         positions[:] = 0
@@ -894,25 +1078,77 @@ class ContinuousBatchingEngine:
         span_tab[:, W + 1] = 0      # q_len
         span_tab[:, W + 2] = 1      # kv_len
         span_tab[:, W + 3] = 0      # sample_row
+        nd_col = W + 4 if mx.spec_k else -1
+        sc = W + 4 + (1 if mx.spec_k else 0)
         off = 0
-        for si, (r, kind, size, start) in enumerate(spans):
+        for si, (r, toks, start, nd, sxor, masked) in enumerate(spans):
+            m = len(toks)
             row = span_tab[si]
             row[W] = off
-            row[W + 1] = size
-            row[W + 2] = start + size
-            row[W + 3] = off + size - 1
+            row[W + 1] = m
+            row[W + 3] = off + m - 1
+            if masked:
+                # keep the slot-aligned row but touch nothing live:
+                # block table stays all-sink, writes stay on the sink
+                # page, kv_len covers only the span itself
+                row[W + 2] = m
+                tokens[off:off + m] = toks
+                positions[off:off + m] = np.arange(m, dtype=np.int32)
+                off += m
+                continue
+            row[W + 2] = start + m
             row[:len(r.block_ids)] = r.block_ids
-            pos = np.arange(start, start + size, dtype=np.int32)
-            if kind == "decode":
-                tokens[off] = self._tokens[r.slot]
-            else:
-                tokens[off:off + size] = \
-                    r.prompt_ids[start:start + size].astype(np.int32)
-            positions[off:off + size] = pos
-            dest_blocks[off:off + size] = [
-                r.block_ids[p // bs] for p in pos]
-            dest_offsets[off:off + size] = pos % bs
-            off += size
+            if nd_col >= 0:
+                row[nd_col] = nd
+            if mx.sampling:
+                row[sc:sc + 4] = self._samp_row(r, sxor)
+            pos = np.arange(start, start + m, dtype=np.int32)
+            tokens[off:off + m] = toks
+            positions[off:off + m] = pos
+            dest_blocks[off:off + m] = [r.block_ids[p // bs]
+                                        for p in pos]
+            dest_offsets[off:off + m] = pos % bs
+            off += m
+        return pack, B
+
+    def _pack_spans(self):
+        """Choose this step's ragged span set: every running slot's
+        decode token (all must advance), then pending prefill chunks
+        round-robin over prefilling slots while the TOP budget has room
+        — multiple chunks per step, the round-robin latency killer.
+        The chunk half is ``_pick_chunks`` — the ONE chunk-selection
+        policy, shared with the speculative round's draft mirror."""
+        spans = []                    # (req, kind, size, start)
+        total = 0
+        for r in self.slots:
+            if r is not None and r.state == "running":
+                spans.append((r, "decode", 1, r.seq_len))
+                total += 1
+        for r, size, start in self._pick_chunks(
+                self.token_budgets[-1] - total):
+            spans.append((r, "prefill", size, start))
+            total += size
+        return spans, total
+
+    def _run_mixed_step(self) -> List[int]:
+        """Pack the admission mix into ONE fused MixedStep launch: build
+        the per-token and per-span tables on the host (control flow),
+        pad to the smallest token budget, dispatch, then apply the same
+        bookkeeping the split decode/prefill paths used."""
+        if self.draft_step is not None:
+            return self._run_spec_round()
+        done = self._grow_pages() if self.lazy_alloc else []
+        spans, total = self._pack_spans()
+        if not spans:
+            return done
+        fill = [(r,
+                 np.asarray([self._tokens[r.slot]], np.int32)
+                 if kind == "decode"
+                 else r.prompt_ids[start:start + size].astype(np.int32),
+                 start, 0, 0, False)
+                for r, kind, size, start in spans]
+        pack, B = self._fill_mixed_pack(self.mixed, self.token_budgets,
+                                        fill)
 
         t0 = time.perf_counter()
         pre = self.mixed.total_compiles
@@ -959,6 +1195,233 @@ class ContinuousBatchingEngine:
                     self._complete_prefill(r, tok, self._row_for(r))
                     if r.state == "done":
                         done.append(r.req_id)
+        return done
+
+    # ---- speculative decoding (draft_model=) ----------------------------
+    def _spec_k_eff(self, req: GenerationRequest) -> int:
+        """Draft depth for this request this round: never propose past
+        the generation budget (a round emits at most k_eff+1 tokens)."""
+        remaining = req.max_new_tokens - len(req.output_ids)
+        return max(0, min(self.spec_k, remaining - 1))
+
+    def _grow_spec_pages(self, keff: Dict[int, int]):
+        """Lazy mode: pages for the k_eff draft positions past the
+        mandatory seq_len write are OPPORTUNISTIC — when the pool can't
+        cover a slot's full draft depth, the depth shrinks instead of
+        truncating the request (the mandatory page was grown by
+        ``_grow_pages`` already)."""
+        c = self.caches[0]
+        for r in self.slots:
+            if r is None or r.state != "running":
+                continue
+            k = keff.get(r.slot, 0)
+            while k > 0:
+                need = c.blocks_needed(r.seq_len + 1 + k)
+                ok = True
+                while len(r.block_ids) < need:
+                    blk = self._try_alloc()
+                    if blk is None:
+                        ok = False
+                        break
+                    self._bt[r.slot, len(r.block_ids)] = blk
+                    r.block_ids.append(blk)
+                if ok:
+                    break
+                k -= 1
+            keff[r.slot] = k
+
+    def _pick_chunks(self, room: int):
+        """Pending prefill chunks for this round, round-robin over
+        prefilling slots while ``room`` holds (the same policy as
+        ``_pack_spans``; shared by the draft mirror and the verify
+        pack, which must see identical chunk work)."""
+        spans = []
+        n = self.max_batch_size
+        advanced_first = None
+        for k in range(n):
+            i = (self._chunk_rr + k) % n
+            r = self.slots[i]
+            if r is None or r.state != "prefilling":
+                continue
+            if room <= 0:
+                break
+            size = min(self.chunk_size,
+                       len(r.prompt_ids) - r.prefill_pos, room)
+            if size <= 0:
+                continue
+            spans.append((r, size, r.prefill_pos))
+            room -= size
+            if advanced_first is None:
+                advanced_first = i
+        if advanced_first is not None:
+            self._chunk_rr = (advanced_first + 1) % n
+        return spans
+
+    def _run_draft_round(self, run_spans, chunk_spans, drafts):
+        """The round's ``spec_k`` fused draft-model launches.  Launch 0
+        packs every running slot's catch-up span (the 1-2 accepted
+        tokens the draft pool hasn't seen, ending at the current token)
+        TOGETHER with the round's prefill-chunk mirrors, so the draft
+        pool prefills the same prompts in the same rounds; launches
+        1..k-1 feed each freshly proposed token back.  A slot whose
+        draft depth is capped below the launch index rides along
+        MASKED (sink writes), keeping output rows slot-aligned.  Fills
+        ``drafts[slot] = [d1..]``; returns the per-launch filtered
+        proposal distributions (device-resident) for the verifier's
+        rejection-resampling."""
+        from ..ops.sampling import DRAFT_SEED_XOR
+        q_list = []
+        for i in range(self.spec_k):
+            spans = []
+            for r, k_eff in run_spans:
+                # depth-capped slots stop feeding live pages (their
+                # later proposals are never verified) — and a masked
+                # span only needs ONE placeholder token to keep the
+                # output/probs rows slot-aligned
+                masked = i >= k_eff
+                if i == 0 and not masked:
+                    cu = r.seq_len + 1 - r.draft_len
+                    toks = np.asarray(r.output_ids[-cu:], np.int32)
+                    start = r.draft_len
+                elif masked:
+                    toks = np.asarray([r.output_ids[-1]], np.int32)
+                    start = r.seq_len + i
+                else:
+                    toks = np.asarray([drafts[r.slot][i - 1]], np.int32)
+                    start = r.seq_len + i
+                spans.append((r, toks, start, 0, DRAFT_SEED_XOR,
+                              masked))
+            if i == 0:
+                for r, size, start in chunk_spans:
+                    spans.append(
+                        (r, r.prompt_ids[start:start + size]
+                         .astype(np.int32), start, 0, DRAFT_SEED_XOR,
+                         False))
+            if not spans:
+                break
+            t0 = time.perf_counter()
+            pre = self.draft_step.total_compiles
+            pack, B = self._fill_mixed_pack(self.draft_step,
+                                            self.draft_budgets, spans)
+            out = self.draft_step.call_packed(pack, B)
+            if self.sampling:
+                toks_np, probs = out
+                q_list.append(probs)
+            else:
+                toks_np = out
+            if self.draft_step.total_compiles == pre:
+                self._m_draft_step.observe(time.perf_counter() - t0)
+            for si, (r, _k) in enumerate(run_spans):
+                drafts[r.slot].append(int(toks_np[si]))
+            if not run_spans:
+                break               # chunk mirror only, nothing to feed
+        return q_list
+
+    def _run_spec_round(self) -> List[int]:
+        """One speculative engine round: k fused draft launches propose
+        per-slot token chains, ONE fused MixedStep launch verifies all
+        slots' k+1 positions (and advances prefill chunks riding the
+        same pack), and the host applies the accepted prefix + the
+        corrected/bonus token.  Greedy output is byte-identical to the
+        non-speculative engine; sampled output is distribution-exact
+        (rejection-resampling on device)."""
+        done = self._grow_pages() if self.lazy_alloc else []
+        keff: Dict[int, int] = {}
+        for r in self.slots:
+            if r is not None and r.state == "running":
+                keff[r.slot] = self._spec_k_eff(r)
+        if self.lazy_alloc:
+            self._grow_spec_pages(keff)
+        run_spans = [(r, keff[r.slot]) for r in self.slots
+                     if r is not None and r.state == "running"]
+        total_v = sum(k + 1 for _, k in run_spans)
+        # chunk room must fit BOTH packs that carry the chunks: the
+        # verify pack (k_eff+1 tokens per running slot) and the draft's
+        # launch 0 (at most 2 catch-up tokens per running slot)
+        chunk_spans = self._pick_chunks(
+            min(self.token_budgets[-1] - total_v,
+                self.draft_budgets[-1] - 2 * len(run_spans)))
+        if not run_spans and not chunk_spans:
+            return done
+
+        drafts: Dict[int, List[int]] = {r.slot: [] for r, _ in run_spans}
+        q_list = self._run_draft_round(run_spans, chunk_spans, drafts)
+
+        v_spans = []
+        for r, k_eff in run_spans:
+            toks = np.empty(k_eff + 1, np.int32)
+            toks[0] = self._tokens[r.slot]
+            if k_eff:
+                toks[1:] = drafts[r.slot][:k_eff]
+            v_spans.append((r, toks, r.seq_len, k_eff, 0, False))
+        for r, size, start in chunk_spans:
+            v_spans.append((r, r.prompt_ids[start:start + size]
+                            .astype(np.int32), start, 0, 0, False))
+        pack, B = self._fill_mixed_pack(self.mixed, self.token_budgets,
+                                        v_spans)
+        q_probs = None
+        if self.sampling:
+            while len(q_list) < self.spec_k:
+                q_list.append(self._zero_q)
+            q_probs = tuple(q_list)
+
+        t0 = time.perf_counter()
+        pre = self.mixed.total_compiles
+        nxt, n_acc = self.mixed.call_packed(pack, B, q_probs=q_probs)
+        traced = self.mixed.total_compiles - pre
+        dt = time.perf_counter() - t0
+        n_pre = sum(size for _, size, _ in chunk_spans)
+        if traced:
+            self._m_mixed_compiles.inc(traced)
+        else:
+            if run_spans:
+                self._m_decode.observe(dt)
+            if n_pre:
+                self._m_prefill.observe(dt)
+        if n_pre:
+            self._m_mixed_tok_prefill.inc(n_pre)
+
+        emitted = 0
+        for si, (r, toks, start, nd, _x, _m) in enumerate(v_spans):
+            if r.state == "prefilling":
+                r.prefill_pos += len(toks)
+                if r.prefill_pos >= len(r.prompt_ids):
+                    self._complete_prefill(r, int(nxt[si]),
+                                           self._row_for(r))
+                    if r.state == "done":
+                        done.append(r.req_id)
+                continue
+            na = int(n_acc[si])
+            k_eff = nd
+            self._m_spec_proposed.inc(k_eff)
+            self._m_spec_accepted.inc(na)
+            # draft-pool correctness mark BEFORE advancing seq_len:
+            # the slot's live launches fed cur@s and d1..d_{k_eff-1},
+            # and the correct prefix ends at the last ACCEPTED fed
+            # position — next round's catch-up span starts there
+            if k_eff >= 1:
+                r.draft_len = r.seq_len + 1 + min(na, k_eff - 1)
+            out_toks = drafts[r.slot][:na] + [int(nxt[si])]
+            for t in out_toks:
+                r.seq_len += 1
+                self._seq_lens[r.slot] += 1
+                emitted += 1
+                self._append_token(r, t)
+                if r.state == "done":
+                    done.append(r.req_id)
+                    break
+            if self.slots[r.slot] is r:
+                self._tokens[r.slot] = r.output_ids[-1]
+                if self.lazy_alloc:
+                    # roll back pages grown for rejected draft
+                    # positions through the refcounted release path
+                    c = self.caches[0]
+                    keep = len(c.trim_blocks(r.block_ids,
+                                             r.seq_len + 1))
+                    del r.block_ids[keep:]
+                    self._bt[r.slot, keep:] = self._sink
+        if emitted:
+            self._m_mixed_tok_decode.inc(emitted)
         return done
 
     # ---- bookkeeping ----------------------------------------------------
@@ -1009,6 +1472,7 @@ class ContinuousBatchingEngine:
             self._tokens[s] = 0
             self._seq_lens[s] = 0
             self._bt[s, :] = self._sink
+            self._samp[s, :] = 0
         # the SINGLE release path: refcounted — pages shared with the
         # prefix table or another live request survive this drop
         self.caches[0].free_sequence(req.block_ids)

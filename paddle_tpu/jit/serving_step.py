@@ -49,6 +49,27 @@ dequantizes it inside the trace; ``quant_collectives`` swaps the exact
 tp logits all-gather for the EQuARX-style int8 one.  All
 tolerance-gated by ``tools/bench_serving.py --quant``
 (BENCH_QUANT_r13.json).
+
+Sampling + speculative decoding (round 14): ``sampling=True`` swaps
+the greedy argmax for the ``ops/sampling`` epilogue — per-request
+temperature / top-k / top-p with a per-slot seeded counter-based PRNG
+(``fold_in`` on the request seed + the sampled token's global
+position).  Every knob and seed is traced DATA: the split steps take
+one extra ``[..., 4]`` int32 operand (fp knobs BITCAST into the int32
+lane), the mixed step grows its packed buffer's span rows by four
+columns — so changing a temperature or a seed never retraces, and
+``temperature=0`` rows take the exact greedy argmax.  Under tp the
+epilogue runs AFTER the exact logits all-gather on replicated data, so
+tp sampling is byte-identical to single-chip.  ``spec_k=K`` puts the
+speculative VERIFY epilogue into the mixed step: spans may carry up to
+K draft tokens (an ``n_draft`` pack column), the LM head sees each
+span's K+2 gathered rows instead of 1, and the standard accept/reject
++ rejection-resampling scan (``ops/sampling.spec_verify``) emits
+``(token, n_acc)`` per span.  ``return_probs=True`` (the draft
+model's role) additionally returns each span's filtered proposal
+distribution, device-resident, for the verifier's residual.  All off
+by default — a default-config step's operand pytree and traced body
+are byte-identical to round 13.
 """
 from __future__ import annotations
 
@@ -113,6 +134,18 @@ def _tp_logits(logits: Tensor, tp: Optional[TPContext],
         return Tensor._from_value(
             tp_gather_logits_q8(logits._value, tp.axis))
     return Tensor._from_value(tp_gather_logits(logits._value, tp.axis))
+
+
+def _samp_knobs(samp):
+    """Decode a packed per-row sampling operand ``[..., 4]`` int32 into
+    ``(temps f32, top_ks i32, top_ps f32, seeds i32)``.  Temperature
+    and top-p ride BITCAST in the int32 lane (the same trick the quant
+    scales use on the scalar-prefetch path), so one dtype-uniform
+    buffer carries every knob and the packed host transfer stays a
+    single int32 array."""
+    t = jax.lax.bitcast_convert_type(samp[..., 0], jnp.float32)
+    p = jax.lax.bitcast_convert_type(samp[..., 2], jnp.float32)
+    return t, samp[..., 1], p, samp[..., 3]
 
 
 def _materialize_params(params, dtype):
@@ -330,11 +363,13 @@ class PrefillStep:
     def __init__(self, model, caches: List, bt_width: int,
                  mesh=None, sharding=None,
                  tp: Optional[TPContext] = None,
-                 weight_qparams=None, quant_collectives: bool = False):
+                 weight_qparams=None, quant_collectives: bool = False,
+                 sampling: bool = False):
         self.model = model
         self.caches = caches
         self.cfg = model.config
         self.bt_width = bt_width
+        self.sampling = bool(sampling)
         self.sink = caches[0].sink
         if self.sink < 0:
             raise ValueError("PrefillStep needs a sink page "
@@ -382,7 +417,12 @@ class PrefillStep:
         q8_gather = self._q8_gather
         pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        def step(params, tokens, start, n_valid, bt, kcs, vcs, kss, vss):
+        sampling = self.sampling
+        if sampling:
+            from ..ops.sampling import sample_logits
+
+        def step(params, tokens, start, n_valid, bt, samp, kcs, vcs,
+                 kss, vss):
             self.compile_counts[C] = self.compile_counts.get(C, 0) + 1
             params = _materialize_params(params, pdtype)
             new_kcs, new_vcs = [], []
@@ -436,23 +476,40 @@ class PrefillStep:
                 else:
                     logits = model.lm_head(last)
                 logits = _tp_logits(logits, tp, q8=q8_gather)
-            nxt = jnp.argmax(
-                logits._value[0, 0].astype(jnp.float32)).astype(jnp.int32)
+            if samp is None:
+                nxt = jnp.argmax(logits._value[0, 0]
+                                 .astype(jnp.float32)).astype(jnp.int32)
+            else:
+                # first-token sample: counter = the prompt length
+                # start + n_valid (= the sampled token's position)
+                t, k, p, sd = _samp_knobs(samp[None, :])
+                toks = sample_logits(logits._value[:, 0, :], t, k,
+                                        p, sd, (start + n_valid)[None])
+                nxt = toks[0]
             return (nxt, tuple(new_kcs), tuple(new_vcs),
                     tuple(new_kss), tuple(new_vss))
 
+        if sampling:
+            fn, donate, n_repl = step, (6, 7, 8, 9), 5
+        else:
+            def fn(params, tokens, start, n_valid, bt, kcs, vcs, kss,
+                   vss):
+                return step(params, tokens, start, n_valid, bt, None,
+                            kcs, vcs, kss, vss)
+            donate, n_repl = (5, 6, 7, 8), 4
         if tp is None:
-            return jax.jit(step, donate_argnums=(5, 6, 7, 8))
-        return _wrap_sharded(step, tp, self._wq or self._param_tensors,
-                             len(self.caches), n_repl=4,
-                             donate=(5, 6, 7, 8),
+            return jax.jit(fn, donate_argnums=donate)
+        return _wrap_sharded(fn, tp, self._wq or self._param_tensors,
+                             len(self.caches), n_repl=n_repl,
+                             donate=donate,
                              quant_kv=quant_kv)
 
     def __call__(self, tokens, start: int, n_valid: int,
-                 block_table_row) -> int:
-        """tokens: [1, C] int32 bucket-padded; returns the greedy next
-        token after position start+n_valid-1 (meaningful on the final
-        chunk; earlier chunks' samples are discarded by the engine)."""
+                 block_table_row, samp=None) -> int:
+        """tokens: [1, C] int32 bucket-padded; returns the next token
+        after position start+n_valid-1 (meaningful on the final chunk;
+        earlier chunks' samples are discarded by the engine).  samp
+        (sampling steps): [4] int32 knobs for the request."""
         C = int(np.asarray(tokens).shape[1])
         fn = self._fns.get(C)
         if fn is None:
@@ -461,13 +518,17 @@ class PrefillStep:
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         kss, vss = _cache_scales(self.caches, self._quant_kv)
+        args = [params,
+                jnp.asarray(np.asarray(tokens, np.int32)),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32),
+                jnp.asarray(np.asarray(block_table_row), jnp.int32)]
+        if self.sampling:
+            if samp is None:
+                samp = np.zeros((4,), np.int32)        # greedy default
+            args.append(jnp.asarray(np.asarray(samp, np.int32)))
         nxt, new_kcs, new_vcs, new_kss, new_vss = fn(
-            params,
-            jnp.asarray(np.asarray(tokens, np.int32)),
-            jnp.asarray(start, jnp.int32),
-            jnp.asarray(n_valid, jnp.int32),
-            jnp.asarray(np.asarray(block_table_row), jnp.int32),
-            kcs, vcs, kss, vss)
+            *args, kcs, vcs, kss, vss)
         _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
         return int(nxt)
 
@@ -505,7 +566,9 @@ class MixedStep:
                  use_pallas: Optional[bool] = None,
                  mesh=None, sharding=None,
                  tp: Optional[TPContext] = None,
-                 weight_qparams=None, quant_collectives: bool = False):
+                 weight_qparams=None, quant_collectives: bool = False,
+                 sampling: bool = False, spec_k: int = 0,
+                 return_probs: bool = False):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -513,6 +576,32 @@ class MixedStep:
         self.bt_width = bt_width
         self.max_spans = max_spans
         self.span_q = max(1, int(span_q))   # static max span length
+        self.sampling = bool(sampling)
+        self.spec_k = int(spec_k)
+        self.return_probs = bool(return_probs)
+        if self.return_probs and not self.sampling:
+            raise ValueError(
+                "MixedStep return_probs=True exists for the SAMPLED "
+                "draft role (the verifier's residual needs the draft's "
+                "filtered distribution); a greedy draft is a delta — "
+                "construct with sampling=True or drop return_probs")
+        if self.spec_k and self.return_probs:
+            raise ValueError(
+                "MixedStep cannot be verifier (spec_k) and draft "
+                "(return_probs) at once")
+        if self.spec_k and self.span_q < self.spec_k + 1:
+            raise ValueError(
+                "span_q=%d cannot cover a length-%d verify span "
+                "(spec_k=%d): the Pallas kernel's static span window "
+                "must be >= every q_len" % (self.span_q,
+                                            self.spec_k + 1,
+                                            self.spec_k))
+        # span-row tail past the block-table columns: the 4 standard
+        # descriptors, +1 n_draft column under spec, +4 bitcast
+        # sampling-knob columns under sampling.  4 == the round-13
+        # layout, so default packs are byte-identical.
+        self.row_extra = (4 + (1 if self.spec_k else 0)
+                          + (4 if self.sampling else 0))
         self.sink = caches[0].sink
         if self.sink < 0:
             raise ValueError("MixedStep needs a sink page "
@@ -522,6 +611,12 @@ class MixedStep:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
         self._tp = _resolve_tp(model, mesh, sharding, tp)
+        if self.spec_k and self._tp is not None:
+            raise ValueError(
+                "speculative verification (spec_k) is single-chip: the "
+                "draft engine runs unsharded, so a tensor-parallel "
+                "verifier would mix placements — drop mesh/sharding or "
+                "drop the draft")
         self._quant_kv = bool(getattr(caches[0], "quantized", False))
         self._wq = weight_qparams
         self._q8_gather = bool(quant_collectives)
@@ -580,18 +675,27 @@ class MixedStep:
 
         W = self.bt_width
         S = self.max_spans
+        EX = self.row_extra
+        sampling = self.sampling
+        spec_k = self.spec_k
+        return_probs = self.return_probs
+        if sampling or spec_k:
+            from ..ops.sampling import (filtered_probs, sample_logits,
+                                        spec_verify)
 
-        def step(params, pack, kcs, vcs, kss, vss):
+        def step(params, pack, q_probs, kcs, vcs, kss, vss):
             self.compile_counts[T] = self.compile_counts.get(T, 0) + 1
             # unpack the single host buffer (free at trace level —
             # slices of a constant layout): rows 0-3 of the leading
             # [4, T] block are tokens / positions / dest block / dest
-            # offset; the trailing [S, W+4] block is the block table
-            # columns then q_offset / q_len / kv_len / sample_row.  ONE
-            # device_put per step instead of nine — transfer count, not
-            # byte count, is the decode-parity budget at low occupancy.
+            # offset; the trailing [S, W+EX] block is the block table
+            # columns then q_offset / q_len / kv_len / sample_row
+            # (+ n_draft under spec, + the 4 bitcast sampling-knob
+            # columns under sampling).  ONE device_put per step instead
+            # of nine — transfer count, not byte count, is the
+            # decode-parity budget at low occupancy.
             tok_tab = pack[:4 * T].reshape(4, T)
-            span_tab = pack[4 * T:].reshape(S, W + 4)
+            span_tab = pack[4 * T:].reshape(S, W + EX)
             tokens = tok_tab[0]
             positions = tok_tab[1]
             dest_blocks = tok_tab[2]
@@ -601,6 +705,12 @@ class MixedStep:
             q_lens = span_tab[:, W + 1]
             kv_lens = span_tab[:, W + 2]
             sample_rows = span_tab[:, W + 3]
+            col = W + 4
+            if spec_k:
+                n_draft = span_tab[:, col]
+                col += 1
+            if sampling:
+                s_t, s_k, s_p, s_sd = _samp_knobs(span_tab[:, col:col + 4])
             params = _materialize_params(params, pdtype)
             new_kcs, new_vcs = [], []
             new_kss, new_vss = [], []
@@ -639,10 +749,24 @@ class MixedStep:
                     h2 = layer.post_attention_layernorm(x)
                     x = x + _tp_psum(layer.mlp(h2), tp)
                 x = llama.norm(x)
-                # only each span's last valid row reaches the LM head:
-                # [max_spans, 1, h] @ [h, V], never the [T, V] block
+                # only each span's sampled rows reach the LM head:
+                # one row per span normally ([max_spans, 1, h] @
+                # [h, V]); under spec_k each span's K+1 verify rows
+                # plus its last-valid row ([S*(K+2), 1, h]) — the
+                # [T, V] logits block is never materialized either way
+                if spec_k:
+                    vrow = (q_offsets[:, None]
+                            + jnp.arange(spec_k + 1,
+                                         dtype=jnp.int32)[None, :])
+                    last = q_offsets + jnp.maximum(q_lens - 1, 0)
+                    vrow = jnp.minimum(vrow, last[:, None])
+                    rows_idx = jnp.clip(
+                        jnp.concatenate([vrow, sample_rows[:, None]],
+                                        axis=1).reshape(-1), 0, T - 1)
+                else:
+                    rows_idx = sample_rows
                 rows = Tensor._from_value(
-                    x._value[0][sample_rows][:, None, :])
+                    x._value[0][rows_idx][:, None, :])
                 if model.lm_head is None:
                     from ..ops.linalg import matmul
                     logits = matmul(rows, llama.embed_tokens.weight,
@@ -650,17 +774,63 @@ class MixedStep:
                 else:
                     logits = model.lm_head(rows)
                 logits = _tp_logits(logits, tp, q8=q8_gather)
-            nxt = jnp.argmax(
-                logits._value[:, 0, :].astype(jnp.float32),
-                axis=-1).astype(jnp.int32)
+            lv = logits._value[:, 0, :].astype(jnp.float32)
+            if spec_k:
+                # speculative verify: rows [:, :K+1] feed the
+                # accept/reject scan, row K+1 is the plain-span sample
+                lv3 = lv.reshape(S, spec_k + 2, -1)
+                didx = jnp.clip(
+                    q_offsets[:, None] + 1
+                    + jnp.arange(spec_k, dtype=jnp.int32)[None, :],
+                    0, T - 1)
+                d_toks = tokens[didx]          # the spans' fed drafts
+                base_pos = kv_lens - q_lens + 1
+                if sampling:
+                    q = jnp.stack(q_probs, axis=1)        # [S, K, V]
+                    n_acc, e_v = spec_verify(
+                        lv3[:, :spec_k + 1], d_toks, n_draft, s_t, s_k,
+                        s_p, s_sd, base_pos, q)
+                    e_p = sample_logits(lv3[:, spec_k + 1], s_t,
+                                           s_k, s_p, s_sd, kv_lens)
+                else:
+                    zf = jnp.zeros((S,), jnp.float32)
+                    zi = jnp.zeros((S,), jnp.int32)
+                    n_acc, e_v = spec_verify(
+                        lv3[:, :spec_k + 1], d_toks, n_draft, zf, zi,
+                        zf, zi, base_pos)
+                    e_p = jnp.argmax(lv3[:, spec_k + 1],
+                                     axis=-1).astype(jnp.int32)
+                nxt = jnp.where(n_draft > 0, e_v, e_p)
+                return (nxt, n_acc, tuple(new_kcs), tuple(new_vcs),
+                        tuple(new_kss), tuple(new_vss))
+            if sampling:
+                # counter = kv_len — the sampled token's global
+                # position, the SAME counter the split steps use, so
+                # seeded tokens agree across engines
+                nxt = sample_logits(lv, s_t, s_k, s_p, s_sd,
+                                       kv_lens)
+            else:
+                nxt = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+            if return_probs:
+                return (nxt, filtered_probs(lv, s_t, s_k, s_p),
+                        tuple(new_kcs), tuple(new_vcs),
+                        tuple(new_kss), tuple(new_vss))
             return (nxt, tuple(new_kcs), tuple(new_vcs),
                     tuple(new_kss), tuple(new_vss))
 
+        if spec_k and sampling:
+            fn, donate = step, (3, 4, 5, 6)
+        else:
+            # no draft-probs operand: same pytree as round 13 when
+            # sampling/spec are both off
+            def fn(params, pack, kcs, vcs, kss, vss):
+                return step(params, pack, None, kcs, vcs, kss, vss)
+            donate = (2, 3, 4, 5)
         if tp is None:
-            return jax.jit(step, donate_argnums=(2, 3, 4, 5))
-        return _wrap_sharded(step, tp, self._wq or self._param_tensors,
+            return jax.jit(fn, donate_argnums=donate)
+        return _wrap_sharded(fn, tp, self._wq or self._param_tensors,
                              len(self.caches), n_repl=1,
-                             donate=(2, 3, 4, 5),
+                             donate=donate,
                              quant_kv=self._quant_kv)
 
     def __call__(self, tokens, positions, dest_blocks, dest_offsets,
@@ -689,20 +859,31 @@ class MixedStep:
     def new_pack(self, T: int):
         """Allocate the step's single host buffer: ``(pack, tok_tab,
         span_tab)`` where tok_tab [4, T] (rows tokens / positions /
-        dest block / dest offset) and span_tab [max_spans, bt_width+4]
-        (block-table columns then q_offset / q_len / kv_len /
-        sample_row) are VIEWS into pack — fill them, then hand pack to
-        ``call_packed``."""
+        dest block / dest offset) and span_tab
+        [max_spans, bt_width+row_extra] (block-table columns then
+        q_offset / q_len / kv_len / sample_row, + n_draft under spec,
+        + the 4 bitcast sampling-knob columns under sampling) are VIEWS
+        into pack — fill them, then hand pack to ``call_packed``.  The
+        extra tail columns come pre-zeroed (greedy, no drafts), so a
+        caller that only fills the round-13 layout stays correct."""
         S, W = self.max_spans, self.bt_width
-        pack = np.empty(4 * T + S * (W + 4), np.int32)
-        return (pack, pack[:4 * T].reshape(4, T),
-                pack[4 * T:].reshape(S, W + 4))
+        pack = np.empty(4 * T + S * (W + self.row_extra), np.int32)
+        span_tab = pack[4 * T:].reshape(S, W + self.row_extra)
+        if self.row_extra > 4:
+            span_tab[:, W + 4:] = 0
+        return pack, pack[:4 * T].reshape(4, T), span_tab
 
-    def call_packed(self, pack: np.ndarray, T: int) -> np.ndarray:
+    def call_packed(self, pack: np.ndarray, T: int, q_probs=None):
         """Dispatch one pre-packed step buffer (see ``new_pack``).  The
         nine per-step operands cross the host link as ONE int32
         device_put: transfer count, not byte count, is what decode
-        parity with the split DecodeStep is made of at low occupancy."""
+        parity with the split DecodeStep is made of at low occupancy.
+
+        Returns the [max_spans] int32 sample array; a verifier
+        (``spec_k``) returns ``(tokens, n_acc)`` and takes ``q_probs``
+        (a tuple of K device-resident [max_spans, V] draft
+        distributions) when sampled; a draft (``return_probs``)
+        returns ``(tokens, probs)`` with probs left ON DEVICE."""
         fn = self._fns.get(T)
         if fn is None:
             fn = self._fns[T] = self._build(T)
@@ -710,10 +891,24 @@ class MixedStep:
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         kss, vss = _cache_scales(self.caches, self._quant_kv)
-        nxt, new_kcs, new_vcs, new_kss, new_vss = fn(
-            params, jnp.asarray(pack), kcs, vcs, kss, vss)
-        _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
-        return np.asarray(nxt)
+        args = [params, jnp.asarray(pack)]
+        if self.spec_k and self.sampling:
+            if q_probs is None:
+                raise ValueError(
+                    "sampled speculative verify needs the draft's "
+                    "q_probs tuple (zeros when no span drafts)")
+            args.append(tuple(q_probs))
+        out = fn(*args, kcs, vcs, kss, vss)
+        if self.spec_k:
+            nxt, n_acc = out[0], out[1]
+            _rebind_caches(self.caches, *out[2:])
+            return np.asarray(nxt), np.asarray(n_acc)
+        if self.return_probs:
+            nxt, probs = out[0], out[1]
+            _rebind_caches(self.caches, *out[2:])
+            return np.asarray(nxt), probs
+        _rebind_caches(self.caches, *out[1:])
+        return np.asarray(out[0])
 
 
 class DecodeStep:
@@ -730,7 +925,8 @@ class DecodeStep:
     def __init__(self, model, caches: List, use_pallas: Optional[bool]
                  = None, mesh=None, sharding=None,
                  tp: Optional[TPContext] = None,
-                 weight_qparams=None, quant_collectives: bool = False):
+                 weight_qparams=None, quant_collectives: bool = False,
+                 sampling: bool = False):
         from ..ops.paged_attention import _HAS_PLTPU, _on_tpu
         self.model = model
         self.caches = caches
@@ -738,6 +934,7 @@ class DecodeStep:
         if use_pallas is None:
             use_pallas = _HAS_PLTPU and _on_tpu()
         self.use_pallas = use_pallas
+        self.sampling = bool(sampling)
         self._tp = _resolve_tp(model, mesh, sharding, tp)
         self._quant_kv = bool(getattr(caches[0], "quantized", False))
         self._wq = weight_qparams
@@ -783,7 +980,11 @@ class DecodeStep:
         q8_gather = self._q8_gather
         pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        def step(params, tokens, seq_lens, block_tables, kcs, vcs,
+        sampling = self.sampling
+        if sampling:
+            from ..ops.sampling import sample_logits
+
+        def step(params, tokens, seq_lens, block_tables, samp, kcs, vcs,
                  kss, vss):
             self.compile_count += 1
             S = tokens.shape[0]
@@ -833,35 +1034,61 @@ class DecodeStep:
                 else:
                     logits = model.lm_head(x)
                 logits = _tp_logits(logits, tp, q8=q8_gather)
-            # greedy sampling ON DEVICE: only the [S] token ids cross
-            # the link, never the [S, V] logits
-            nxt = jnp.argmax(
-                logits._value[:, 0, :].astype(jnp.float32),
-                axis=-1).astype(jnp.int32)
+            # sampling ON DEVICE: only the [S] token ids cross the
+            # link, never the [S, V] logits.  samp=None is the greedy
+            # default path — the exact argmax, trace unchanged.
+            if samp is None:
+                nxt = jnp.argmax(
+                    logits._value[:, 0, :].astype(jnp.float32),
+                    axis=-1).astype(jnp.int32)
+            else:
+                t, k, p, sd = _samp_knobs(samp)
+                # counter = the sampled token's global position
+                nxt = sample_logits(logits._value[:, 0, :], t, k, p,
+                                       sd, seq_lens + 1)
             return (nxt, tuple(new_kcs), tuple(new_vcs),
                     tuple(new_kss), tuple(new_vss))
 
-        if tp is None:
-            self._fn = jax.jit(step, donate_argnums=(4, 5, 6, 7))
+        if sampling:
+            fn, donate, n_repl = step, (5, 6, 7, 8), 4
         else:
-            self._fn = _wrap_sharded(step, tp,
+            # greedy default: same operand pytree (and therefore the
+            # same compiled module) as the pre-sampling step
+            def fn(params, tokens, seq_lens, block_tables, kcs, vcs,
+                   kss, vss):
+                return step(params, tokens, seq_lens, block_tables,
+                            None, kcs, vcs, kss, vss)
+            donate, n_repl = (4, 5, 6, 7), 3
+        if tp is None:
+            self._fn = jax.jit(fn, donate_argnums=donate)
+        else:
+            self._fn = _wrap_sharded(fn, tp,
                                      self._wq or self._param_tensors,
-                                     len(self.caches), n_repl=3,
-                                     donate=(4, 5, 6, 7),
+                                     len(self.caches), n_repl=n_repl,
+                                     donate=donate,
                                      quant_kv=quant_kv)
 
-    def __call__(self, tokens, seq_lens, block_tables) -> np.ndarray:
+    def __call__(self, tokens, seq_lens, block_tables,
+                 samp=None) -> np.ndarray:
+        """samp (sampling steps only): [slots, 4] int32 per-slot knobs
+        — (temperature bits, top_k, top_p bits, seed)."""
         if self._fn is None:
             self._build()
         params = _step_params(self._param_tensors, self._tp, self._wq)
         kcs = tuple(c.key_cache for c in self.caches)
         vcs = tuple(c.value_cache for c in self.caches)
         kss, vss = _cache_scales(self.caches, self._quant_kv)
+        args = [params,
+                jnp.asarray(np.asarray(tokens, np.int32)),
+                jnp.asarray(np.asarray(seq_lens, np.int32)),
+                jnp.asarray(np.asarray(block_tables, np.int32))]
+        if self.sampling:
+            if samp is None:
+                raise ValueError(
+                    "sampling DecodeStep needs the per-slot knob array "
+                    "(engine fills it; greedy slots are temperature 0)")
+            args.append(jnp.asarray(np.asarray(samp, np.int32)))
         nxt, new_kcs, new_vcs, new_kss, new_vss = self._fn(
-            params,
-            jnp.asarray(np.asarray(tokens, np.int32)),
-            jnp.asarray(np.asarray(seq_lens, np.int32)),
-            jnp.asarray(np.asarray(block_tables, np.int32)),
-            kcs, vcs, kss, vss)
+            *args, kcs, vcs, kss, vss)
         _rebind_caches(self.caches, new_kcs, new_vcs, new_kss, new_vss)
         return np.asarray(nxt)

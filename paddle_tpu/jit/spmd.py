@@ -45,6 +45,16 @@ activations agree with the single-chip step to float addition order
 parity contract is therefore on the sampled TOKENS, which the serving
 benches gate byte-identically.
 
+Stochastic sampling under tp (round 14) adds NO collective: the
+``ops/sampling`` epilogue runs AFTER the exact logits all-gather, on
+replicated logits with replicated knob/seed operands, and the
+counter-based threefry draw is pure deterministic math — every chip
+computes the identical token, byte-equal to the single-chip sampled
+engine (gated in tests).  ``collective_bytes`` is therefore unchanged
+by sampling.  Speculative verification stays single-chip for now (the
+draft engine is unsharded); engines reject ``draft_model + mesh`` at
+construction.
+
 SNIPPETS.md [3] ``SpecLayout`` (fsdp×tp, MaxText-style) is the exemplar
 this table specializes: serving has no fsdp axis (weights are read-only
 — replicating them across an fsdp axis buys nothing per step), so every

@@ -422,6 +422,31 @@ def shard_llama(model: LlamaForCausalLM, mesh, tp_axis="model",
     return model
 
 
+def llama_truncated_draft(model: LlamaForCausalLM,
+                          num_layers: int = 1) -> LlamaForCausalLM:
+    """Layer-truncated self-speculative draft: the SAME config cut to
+    the first ``num_layers`` decoder layers, with the embedding, those
+    layers, the final norm and the LM head COPIED from the target
+    (early-exit drafting).  Residual blocks are near-identity, so the
+    truncated model's argmax tracks the full model closely — a cheap,
+    training-free draft whose acceptance rate the speculative-decoding
+    bench measures (``tools/bench_serving.py --speculative``)."""
+    from dataclasses import replace
+    cfg = model.config
+    if not (0 < num_layers < cfg.num_hidden_layers):
+        raise ValueError(
+            "draft must be a strict layer truncation: 0 < num_layers="
+            "%d < %d" % (num_layers, cfg.num_hidden_layers))
+    draft = LlamaForCausalLM(replace(cfg, num_hidden_layers=num_layers))
+    if cfg.dtype == "bfloat16":
+        draft.bfloat16()
+    draft.eval()
+    src = model.state_dict()
+    keep = set(draft.state_dict())
+    draft.set_state_dict({k: v for k, v in src.items() if k in keep})
+    return draft
+
+
 def llama_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     """6*N + attention correction (BASELINE.md convention)."""
     n_params = param_count(config)

@@ -207,6 +207,19 @@ class PagedKVCache:
     def blocks_needed(self, seq_len: int) -> int:
         return -(-seq_len // self.block_size)
 
+    def trim_blocks(self, block_ids, n_tokens: int):
+        """Speculative-decode rollback: release the TAIL pages past
+        what ``n_tokens`` needs (pages grown for draft positions the
+        verifier rejected) through the refcounted release path, and
+        return the kept prefix.  A trimmed page shared with the prefix
+        table or another request survives, exactly like any other
+        ``free_sequence`` drop."""
+        keep = self.blocks_needed(max(int(n_tokens), 1))
+        if keep >= len(block_ids):
+            return list(block_ids)
+        self.free_sequence(block_ids[keep:])
+        return list(block_ids[:keep])
+
     def build_block_table(self, seq_lens, max_blocks=None) -> np.ndarray:
         """Allocate pages for new sequences; returns [B, max_blocks]
         int32 table (-1 padded)."""

@@ -1,0 +1,202 @@
+"""On-device stochastic sampling + speculative-decode verification.
+
+The sampling epilogue of the fused serving steps (``jit/serving_step``):
+per-request temperature / top-k / top-p with a seeded COUNTER-BASED
+PRNG, all traceable, so every knob and seed is plain traced DATA riding
+the steps' packed int32 operand buffer — changing a temperature or a
+seed never retraces a module, and ``temperature <= 0`` reduces to the
+exact greedy argmax the pre-sampling engines shipped (the fast path the
+default engines stay byte-identical through).
+
+Determinism contract: the key for every random draw is
+``fold_in(fold_in(PRNGKey(seed), position), stream_tag)`` where
+``position`` is the GLOBAL sequence index of the token being sampled
+and ``seed`` is the request's.  The counter depends on nothing but the
+request's own progress, so a sampled request produces the same tokens
+whether it decodes alone, batched with churn, through the split or the
+mixed engine, or under tensor parallelism (the logits all-gather is
+exact, the threefry math replicated) — the serving analog of the greedy
+byte-parity contract.
+
+Speculative decoding (``spec_verify``): standard accept/reject with
+rejection-resampling (Leviathan et al.) — draft token ``d_j`` with
+draft probability ``q_j(d_j)`` is accepted iff
+``u_j < p_j(d_j) / q_j(d_j)`` against the target's filtered
+distribution ``p_j``; the first rejection resamples from
+``normalize(max(p_j - q_j, 0))`` and a fully-accepted chain samples the
+bonus token from ``p_k``.  The output distribution is exactly ``p`` per
+position.  Greedy rows (``temperature <= 0``) use the argmax-match rule
+instead, which makes greedy speculative output BYTE-IDENTICAL to
+non-speculative greedy — the CPU-checkable parity gate.
+
+All math is fp32 regardless of the model dtype (like every other
+logits-side reduction in the serving steps).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_logits", "filtered_probs", "spec_verify",
+           "DRAFT_SEED_XOR"]
+
+# RNG stream tags: one counter (= token position) feeds three
+# independent streams so the draft's proposal draw, the verifier's
+# accept draw and the rejection-resample draw never correlate.
+_TAG_PROPOSE = 0
+_TAG_ACCEPT = 1
+_TAG_RESIDUAL = 2
+
+# the engine XORs draft-span seeds with this (host-side, int32-safe) so
+# a self-speculative draft (same weights) still proposes from an RNG
+# stream independent of the target's
+DRAFT_SEED_XOR = 0x5EED
+
+
+def _row_key(seed, counter, tag: int):
+    key = jax.random.PRNGKey(seed)
+    key = jax.random.fold_in(key, counter)
+    return jax.random.fold_in(key, jnp.int32(tag))
+
+
+def _filter_row(l, t, k, p):
+    """One [V] fp32 logits row -> tempered, top-k / top-p masked row
+    (-inf outside the kept set).  ``k <= 0`` disables top-k; ``p`` out
+    of (0, 1) disables top-p.  The best token is always kept, so a row
+    is never fully masked.  ONE sort serves both filters: the top-k
+    mask removes a SUFFIX of the descending order, so the masked array
+    is still sorted and the nucleus cumsum reads it directly."""
+    V = l.shape[0]
+    lt = l / jnp.maximum(t, jnp.float32(1e-6))
+    desc = jnp.sort(lt)[::-1]
+    kk = jnp.clip(k, 1, V)
+    use_k = (k > 0) & (k < V)
+    k_thr = jnp.where(use_k, desc[kk - 1], -jnp.inf)
+    rank = jnp.arange(V, dtype=jnp.int32)
+    desc_m = jnp.where(use_k & (rank >= kk), -jnp.inf, desc)
+    # nucleus over the tempered+top-k-masked distribution: keep the
+    # smallest prefix (in descending-prob order) whose mass reaches p
+    probs = jax.nn.softmax(desc_m)
+    keep = (jnp.cumsum(probs) - probs) < p
+    use_p = (p > jnp.float32(0.0)) & (p < jnp.float32(1.0))
+    p_thr = jnp.where(use_p,
+                      jnp.min(jnp.where(keep, desc_m, jnp.inf)),
+                      -jnp.inf)
+    return jnp.where(lt < jnp.maximum(k_thr, p_thr), -jnp.inf, lt)
+
+
+def sample_logits(logits, temps, top_ks, top_ps, seeds, counters):
+    """Sample one token per row (traceable; the steps' epilogue).
+
+    logits [S, V]; temps/top_ps [S] fp32; top_ks/seeds/counters [S]
+    int32 (``counters`` = the global position of the token being
+    sampled).  Returns the [S] int32 tokens.  Rows with
+    ``temperature <= 0`` take the exact greedy argmax.  The top-k /
+    top-p sort pass is skipped at RUN time (one ``lax.cond`` around
+    the whole batch) when no row filters — temperature-only sampling
+    pays just the gumbel draw on top of the argmax."""
+    lf = logits.astype(jnp.float32)
+    V = lf.shape[-1]
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    any_filter = jnp.any(((top_ks > 0) & (top_ks < V))
+                         | ((top_ps > 0.0) & (top_ps < 1.0)))
+    # both branches temper with the SAME division expression — a
+    # reciprocal-multiply shortcut here would differ by 1 ulp from the
+    # filtered branch and break the byte-identical replay contract
+    # when a co-batched request toggles top-k/top-p
+    lt = jax.lax.cond(
+        any_filter,
+        lambda x: jax.vmap(_filter_row)(x, temps, top_ks, top_ps),
+        lambda x: x / jnp.maximum(temps, jnp.float32(1e-6))[:, None],
+        lf)
+    g = jax.vmap(lambda seed, ctr: jax.random.gumbel(
+        _row_key(seed, ctr, _TAG_PROPOSE), (V,), jnp.float32)
+    )(seeds, counters)
+    samp = jnp.argmax(lt + g, axis=-1).astype(jnp.int32)
+    return jnp.where(temps > 0, samp, greedy)
+
+
+def filtered_probs(logits, temps, top_ks, top_ps):
+    """[S, V] logits -> [S, V] fp32 probabilities of each row's
+    filtered (tempered / top-k / top-p) distribution — the draft
+    model's full proposal distribution ``q``, kept device-resident for
+    the verifier's rejection-resampling."""
+    lf = logits.astype(jnp.float32)
+    return jax.vmap(
+        lambda l, t, k, p: jax.nn.softmax(_filter_row(l, t, k, p))
+    )(lf, temps, top_ks, top_ps)
+
+
+def spec_verify(logits_rows, draft_tokens, n_draft, temps, top_ks,
+                top_ps, seeds, base_pos, q_rows=None):
+    """Vectorized speculative accept/reject + resample (traceable; the
+    MixedStep verify epilogue).
+
+    logits_rows [S, K+1, V]: the target's logits at each span's K+1
+    verify rows (row j predicts the token at position
+    ``base_pos[s] + j``).  draft_tokens [S, K] int32 (garbage beyond
+    ``n_draft``); n_draft [S] int32 in [0, K] — 0 marks a plain decode
+    span that just samples row 0.  q_rows: [S, K, V] draft filtered
+    probabilities (None = greedy-only verification).  Returns
+    ``(n_acc [S] int32, token [S] int32)``: the count of accepted
+    draft tokens and the emitted correction/bonus token sampled from
+    the residual (rejection) or from ``p_{n_acc}`` (full acceptance) —
+    the same formula, since a bonus row has ``q = 0``.
+    """
+    lf = logits_rows.astype(jnp.float32)
+    S, K1, V = lf.shape
+    K = K1 - 1
+    tgt_arg = jnp.argmax(lf, axis=-1).astype(jnp.int32)        # [S, K+1]
+    jidx = jnp.arange(K, dtype=jnp.int32)
+    in_range = jidx[None, :] < n_draft[:, None]
+    ok_greedy = tgt_arg[:, :K] == draft_tokens
+
+    if q_rows is not None:
+        # target filtered distributions, one per verify row
+        pf = jax.vmap(lambda rows, t, k, p: jax.vmap(
+            lambda l: jax.nn.softmax(_filter_row(l, t, k, p)))(rows)
+        )(lf, temps, top_ks, top_ps)                           # [S,K+1,V]
+        q = q_rows
+        d_idx = jnp.clip(draft_tokens, 0, V - 1)[..., None]
+        p_d = jnp.take_along_axis(pf[:, :K], d_idx, -1)[..., 0]
+        q_d = jnp.take_along_axis(q, d_idx, -1)[..., 0]
+
+        def u_row(seed, bp):
+            return jax.vmap(lambda j: jax.random.uniform(
+                _row_key(seed, bp + j, _TAG_ACCEPT)))(jidx)
+
+        u = jax.vmap(u_row)(seeds, base_pos)                   # [S, K]
+        ok_samp = u * jnp.maximum(q_d, jnp.float32(1e-30)) < p_d
+        ok = jnp.where((temps > 0)[:, None], ok_samp, ok_greedy)
+    else:
+        ok = ok_greedy
+    ok = ok & in_range
+    chain = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(chain, axis=1).astype(jnp.int32)           # [S]
+
+    e_idx = n_acc[:, None]
+    e_greedy = jnp.take_along_axis(tgt_arg, e_idx, 1)[:, 0]
+    if q_rows is None:
+        return n_acc, e_greedy
+
+    row_idx = jnp.broadcast_to(n_acc[:, None, None], (S, 1, V))
+    p_row = jnp.take_along_axis(pf, row_idx, 1)[:, 0]          # [S, V]
+    # bonus rows (n_acc == n_draft) resample from p directly: pad q
+    # with a zero row so the residual formula covers both cases, and
+    # zero any row whose index would alias the NEXT round's q
+    q_pad = jnp.concatenate([q, jnp.zeros((S, 1, V), jnp.float32)], 1)
+    q_row = jnp.take_along_axis(q_pad, row_idx[:, :, :V], 1)[:, 0]
+    q_row = jnp.where((n_acc >= n_draft)[:, None], jnp.float32(0.0),
+                      q_row)
+    w = jnp.maximum(p_row - q_row, jnp.float32(0.0))
+    w_sum = jnp.sum(w, axis=-1, keepdims=True)
+    w = jnp.where(w_sum > 0, w, p_row)     # numeric guard: p==q exactly
+
+    def g_row(seed, bp, na):
+        return jax.random.gumbel(_row_key(seed, bp + na, _TAG_RESIDUAL),
+                                 (V,), jnp.float32)
+
+    g = jax.vmap(g_row)(seeds, base_pos, n_acc)
+    logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-38)), -jnp.inf)
+    e_samp = jnp.argmax(logw + g, axis=-1).astype(jnp.int32)
+    return n_acc, jnp.where(temps > 0, e_samp, e_greedy)

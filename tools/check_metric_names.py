@@ -67,6 +67,11 @@ REQUIRED_NAMES = frozenset({
     "serving_kv_quant_dtype",
     "serving_quant_collective_bytes_total",
     "serving_quant_token_mismatch_total",
+    # sampling + speculative decoding (round-14; BENCH_SPEC_r14.json)
+    "serving_sampling_mode",
+    "serving_spec_proposed_tokens_total",
+    "serving_spec_accepted_tokens_total",
+    "serving_spec_draft_step_duration_seconds",
 })
 
 
