@@ -189,6 +189,12 @@ def create_predictor(config: Config) -> Predictor:
 
 from .serving import (ContinuousBatchingEngine,      # noqa: E402,F401
                       GenerationRequest)
+from .router import (ServingRouter, EngineHandle,    # noqa: E402,F401
+                     RouterRequest, RouterQueueFull)
+
+__all__ += ["ContinuousBatchingEngine", "GenerationRequest",
+            "ServingRouter", "EngineHandle", "RouterRequest",
+            "RouterQueueFull"]
 
 
 # ---------------------------------------------------------------------------

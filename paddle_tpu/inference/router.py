@@ -1,0 +1,813 @@
+"""Multi-engine serving router: one admission plane over N engines.
+
+The "heavy traffic from millions of users" tier (ROADMAP item 5): a
+:class:`ServingRouter` owns a pool of :class:`ContinuousBatchingEngine`
+instances — heterogeneous configs allowed (mixed-step, tensor-parallel,
+quantized, speculative; anything satisfying the small engine protocol
+below) — and multiplies the per-engine work of rounds 6-14 by N engines
+behind one front door.  Three responsibilities:
+
+**Prefix-affinity routing.**  A request's routing key is the SAME chain
+of block-granularity blake2b prompt-prefix digests the engines'
+``PrefixPageCache`` registers pages under (``routing_keys``), so the
+router can steer a request to the engine whose prefix set has the
+longest match — its shared pages are ALREADY there, and admission turns
+into a refcount bump + suffix-only prefill instead of a full prompt
+recompute.  The match consults the engine's LIVE prefix table (ground
+truth, eviction included) plus a bounded router-side record of prompts
+recently routed there (so two same-prefix requests co-locate even while
+the first is still prefilling).  No match -> least-loaded fallback: the
+load score folds slot occupancy, KV-page utilization and prefill
+chunk-queue depth — the same stats the observability gauges read,
+scraped either in-process (``engine.health_payload()``) or over HTTP
+from the round-9 ``/healthz`` endpoint (whose body now carries them as
+JSON).
+
+**SLO-aware admission.**  ``submit`` takes a per-request ``priority``
+plus optional TTFT/TPOT targets — the TTFT target orders the queue
+(earliest deadline first among equal priorities) and lets an
+affinity-held request spill once its deadline passes; the TPOT target
+shields a running request from preemption while an equal-priority
+victim without one exists; the pending queue is BOUNDED
+(``max_pending``, overflow raises :class:`RouterQueueFull` and counts
+``outcome="rejected"``) and drains highest-priority-first (ties: the
+earliest TTFT deadline, then FIFO).  When every healthy engine is full
+and a pending request outranks some running one, the router preempts
+the cheapest strictly-lower-priority victim through the engine's public
+``preempt_request`` API — the refcounted ``free_sequence`` release
+path, NOT victim truncation — and requeues it: the victim resumes on
+whatever engine next has room, its already-generated tokens re-prefixed
+onto the prompt.  Greedy decoding makes the resumed stream byte-
+identical to an uninterrupted run (the bench gate).
+
+**Failure handling.**  Every engine is probed each ``step()`` (payload
+fetch by default, pluggable per handle); ``probe_failure_threshold``
+consecutive failures — or an exception escaping ``engine.step()`` —
+marks the engine unhealthy and DRAINS it: every in-flight request is
+pulled off (via ``preempt_request`` while the engine's host state still
+answers, else the router's own last-known token record) and requeued,
+zero drops.  A recovered engine re-admits via ``recover_engine``.
+
+Engine protocol (what a pool member must provide): ``add_request(
+prompt_ids, max_new_tokens=, eos_token_id=)`` appending to ``waiting``,
+``step() -> finished req_ids``, ``has_work()``, ``finished`` dict,
+``preempt_request(req_id)``, ``health_payload()``, ``block_size``, and
+optionally ``prefix_cache``/``engine_id`` — i.e. the public surface of
+``ContinuousBatchingEngine``.
+
+All router state is host control flow: no device math, no new compiled
+modules — the engines' one-compile invariants are untouched.
+"""
+from __future__ import annotations
+
+import itertools
+import json as _json
+import time
+import urllib.request
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .prefix_cache import _prefix_key
+
+__all__ = ["ServingRouter", "EngineHandle", "RouterRequest",
+           "RouterQueueFull", "routing_keys", "load_score"]
+
+
+class RouterQueueFull(RuntimeError):
+    """``submit`` refused: the bounded pending queue is at capacity."""
+
+
+# fallback ids for pool members that don't carry an ``engine_id``
+# attribute (the protocol lists it as optional): drawn from a high
+# base so they never collide with explicit small ids
+_FALLBACK_ENGINE_IDS = itertools.count(1 << 30)
+
+
+def routing_keys(prompt_ids, block_size: int) -> List[bytes]:
+    """The request's routing-key chain: blake2b digests of the token
+    prefix up to each full page boundary — EXACTLY the keys
+    ``PrefixPageCache`` registers pages under, so a key present in an
+    engine's table means that engine already holds the KV pages for
+    that prefix."""
+    prompt_ids = np.asarray(prompt_ids, np.int64).reshape(-1)
+    return [_prefix_key(prompt_ids, (i + 1) * block_size)
+            for i in range(len(prompt_ids) // block_size)]
+
+
+def load_score(payload: Dict) -> float:
+    """Scalar load from a health payload — lower is better::
+
+        (occupancy + waiting) / slots        # slot pressure
+        + 1 - free_pages / total_pages       # KV-page utilization
+        + chunk_queue_depth / slots          # prefill backlog
+
+    Each term is O(1)-ish in [0, ~1] so no single axis dominates;
+    missing fields read as unloaded (a thin healthz responder still
+    routes sanely)."""
+    slots = max(1, int(payload.get("slots", 1)))
+    total = max(1, int(payload.get("total_pages", 1)))
+    free = float(payload.get("free_pages", total))
+    return ((float(payload.get("occupancy", 0))
+             + float(payload.get("waiting", 0))) / slots
+            + 1.0 - free / total
+            + float(payload.get("chunk_queue_depth", 0)) / slots)
+
+
+@dataclass
+class RouterRequest:
+    """One request as the ROUTER tracks it — the authoritative record
+    that survives engine loss: the original prompt, every token any
+    engine generated for it (``base_output`` after a requeue), and the
+    SLO fields admission orders on."""
+    rid: int
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    priority: int = 0
+    ttft_target: Optional[float] = None
+    tpot_target: Optional[float] = None
+    state: str = "pending"          # pending -> dispatched -> done
+    engine_id: int = -1
+    engine_req_id: int = -1
+    engine_req: object = field(default=None, repr=False)
+    # tokens generated on PREVIOUS engines (re-prefixed on requeue);
+    # output_ids is the final base + last engine's stream
+    base_output: List[int] = field(default_factory=list)
+    output_ids: List[int] = field(default_factory=list)
+    requeues: int = 0
+    truncated: bool = False
+    routed_by_prefix: bool = False
+    # router rounds this request was HELD for a full affinity target
+    # (bounded by affinity_wait_steps before spilling to least-loaded)
+    affinity_waited: int = 0
+    # engines whose add_request rejected this request (ValueError:
+    # pages / block-table geometry).  The rejection is static for a
+    # given prompt length and only tightens as the resume prompt
+    # grows, so these engines are excluded from ranking AND from
+    # preemption — preempting a victim on an engine that cannot hold
+    # this request would be pure churn
+    rejected_engines: set = field(default_factory=set)
+    # routing-key chains memoized per block size (hashing the prompt
+    # prefix chain is O(L^2/bs) bytes — computing it once per resume
+    # prompt instead of per engine per round keeps ranking cheap);
+    # cleared on requeue, when the resume prompt grows
+    key_cache: Dict[int, List[bytes]] = field(default_factory=dict,
+                                              repr=False)
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+
+    def resume_prompt(self) -> np.ndarray:
+        """Prompt for (re-)admission: original tokens plus everything
+        already generated — a greedy engine prefilling this emits the
+        exact continuation the preempted stream would have."""
+        if not self.base_output:
+            return self.prompt_ids
+        return np.concatenate(
+            [self.prompt_ids,
+             np.asarray(self.base_output, np.int64)])
+
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.base_output)
+
+    def deadline(self) -> float:
+        # `is not None`: ttft_target=0.0 is the MOST urgent deadline
+        # (now), not the absence of one
+        return self.t_submit + (self.ttft_target
+                                if self.ttft_target is not None
+                                else float("inf"))
+
+    def routing_keys_for(self, block_size: int) -> List[bytes]:
+        keys = self.key_cache.get(block_size)
+        if keys is None:
+            keys = routing_keys(self.resume_prompt(), block_size)
+            self.key_cache[block_size] = keys
+        return keys
+
+
+class EngineHandle:
+    """One pool member: the engine (or a URL to scrape it), health
+    state, and the router-side prefix-affinity record."""
+
+    # bounded complement of the engine's live prefix table: keys of
+    # prompts ROUTED here whose prefill hasn't registered pages yet
+    MAX_ROUTED_KEYS = 4096
+
+    def __init__(self, engine, engine_id: Optional[int] = None,
+                 health_url: Optional[str] = None,
+                 probe: Optional[Callable[["EngineHandle"], bool]] = None,
+                 probe_timeout: float = 1.0):
+        self.engine = engine
+        if engine_id is None:
+            engine_id = getattr(engine, "engine_id", None)
+        if engine_id is None:
+            engine_id = next(_FALLBACK_ENGINE_IDS)
+        self.engine_id = int(engine_id)
+        self.health_url = health_url
+        self._probe = probe
+        # remote scrapes run INSIDE the router step loop, serialized:
+        # a partitioned endpoint stalls every healthy engine's round
+        # for this long per probe, so keep it tight (a slow-but-alive
+        # engine that misses it just accrues probe_failures and drains
+        # — requests resume elsewhere, nothing is lost)
+        self.probe_timeout = float(probe_timeout)
+        self.healthy = True
+        self.probe_failures = 0
+        self.routed_keys: "OrderedDict[bytes, None]" = OrderedDict()
+        # refreshed once per router step; dispatch adjusts it locally
+        # as it places work so later picks in the same step see the load
+        self.last_payload: Dict = {}
+
+    # ---- load ----------------------------------------------------------
+    def payload(self) -> Dict:
+        """Fresh health/load stats: scraped from ``health_url``'s
+        ``/healthz`` JSON body when remote, else read in-process."""
+        if self.health_url:
+            with urllib.request.urlopen(
+                    self.health_url, timeout=self.probe_timeout) as resp:
+                return _json.loads(resp.read().decode("utf-8"))
+        return self.engine.health_payload()
+
+    def refresh(self) -> Dict:
+        self.last_payload = self.payload()
+        return self.last_payload
+
+    def load(self) -> float:
+        return load_score(self.last_payload)
+
+    def has_capacity(self) -> bool:
+        p = self.last_payload
+        slots = max(1, int(p.get("slots", 1)))
+        return (int(p.get("occupancy", 0))
+                + int(p.get("waiting", 0))) < slots
+
+    def note_dispatched(self):
+        self.last_payload["waiting"] = \
+            int(self.last_payload.get("waiting", 0)) + 1
+
+    # ---- health --------------------------------------------------------
+    def probe(self) -> bool:
+        """One liveness/health check.  Default: the payload fetch
+        itself — an engine whose stats cannot be read cannot be routed
+        to.  Pluggable per handle for deployments with richer checks;
+        a passing custom probe still refreshes the load payload (the
+        ranking/capacity signals live there — a routable engine must
+        also be readable)."""
+        if self._probe is not None:
+            try:
+                if not self._probe(self):
+                    return False
+            except Exception:                         # noqa: BLE001
+                return False
+        try:
+            self.refresh()
+            return True
+        except Exception:                             # noqa: BLE001
+            return False
+
+    # ---- prefix affinity -----------------------------------------------
+    def prefix_match_tokens(self, prompt_ids, keys=None) -> int:
+        """Longest consecutive run of the prompt's routing keys present
+        on this engine, in TOKENS (block sizes differ across a
+        heterogeneous pool, so token counts are the comparable unit).
+        Engines without a prefix cache never match — affinity would buy
+        nothing where pages cannot be shared.  ``keys`` takes a
+        precomputed chain for this engine's block size (the router
+        memoizes it per request — hashing is O(L^2/bs) bytes)."""
+        bs = getattr(self.engine, "block_size", 0)
+        pc = getattr(self.engine, "prefix_cache", None)
+        if not bs or pc is None:
+            return 0
+        live = pc.table
+        n = 0
+        for key in (keys if keys is not None
+                    else routing_keys(prompt_ids, bs)):
+            if key in live or key in self.routed_keys:
+                n += 1
+            else:
+                break
+        return n * bs
+
+    def note_routed(self, prompt_ids, keys=None):
+        """Record the routed prompt's keys so same-prefix requests
+        co-locate before the first prefill registers pages (the live
+        table takes over once it does; stale records age out FIFO)."""
+        bs = getattr(self.engine, "block_size", 0)
+        if not bs or getattr(self.engine, "prefix_cache", None) is None:
+            return
+        if keys is None:
+            keys = routing_keys(prompt_ids, bs)
+        for key in keys:
+            self.routed_keys[key] = None
+            self.routed_keys.move_to_end(key)
+        while len(self.routed_keys) > self.MAX_ROUTED_KEYS:
+            self.routed_keys.popitem(last=False)
+
+
+class ServingRouter:
+    """N continuous-batching engines behind one admission plane.
+
+    ``engines``: iterable of engines or pre-built :class:`EngineHandle`
+    (build handles yourself to attach ``health_url``/custom probes).
+    ``route_policy``: ``"affinity"`` (default: prefix match, then
+    least-loaded) or ``"random"`` (seeded uniform over engines with
+    capacity — the bench's control arm).  ``preempt=False`` disables
+    priority preemption (pending requests then only wait).
+
+    The driving loop mirrors a single engine's: ``submit`` any time,
+    ``step()`` advances every healthy engine one round, ``result`` after
+    the rid shows up in a step's finished list (or ``run_to_completion``
+    for batch use).
+    """
+
+    def __init__(self, engines, max_pending: int = 256,
+                 preempt: bool = True,
+                 probe_failure_threshold: int = 1,
+                 route_policy: str = "affinity",
+                 route_seed: int = 0,
+                 affinity_wait_steps: int = 8,
+                 max_finished: int = 4096):
+        if route_policy not in ("affinity", "random"):
+            raise ValueError(
+                "route_policy must be 'affinity' or 'random'; got %r"
+                % (route_policy,))
+        self.handles: "OrderedDict[int, EngineHandle]" = OrderedDict()
+        for e in engines:
+            h = e if isinstance(e, EngineHandle) else EngineHandle(e)
+            if h.engine_id in self.handles:
+                raise ValueError(
+                    "duplicate engine_id %d in the pool — pass distinct "
+                    "engine_id= to the engines (or handles)"
+                    % h.engine_id)
+            self.handles[h.engine_id] = h
+        if not self.handles:
+            raise ValueError("ServingRouter needs at least one engine")
+        self.max_pending = int(max_pending)
+        self.preempt = bool(preempt)
+        self.probe_failure_threshold = max(1, int(probe_failure_threshold))
+        self.route_policy = route_policy
+        self._route_rng = np.random.RandomState(route_seed)
+        # a request whose longest prefix match sits on a FULL engine is
+        # HELD (its pages are there; waiting one slot-drain usually
+        # beats recomputing the prefix elsewhere) — but only this many
+        # router rounds, then it spills to least-loaded, recomputes,
+        # and REGISTERS the prefix there too (a hot family replicates
+        # itself across the pool instead of head-of-line blocking)
+        self.affinity_wait_steps = max(0, int(affinity_wait_steps))
+        self.pending: List[RouterRequest] = []
+        # bounded completed-request record (a long-running admission
+        # plane must not grow without bound): oldest completions are
+        # evicted past ``max_finished`` — batch callers either keep
+        # a wave under that, consume via pop_result, or raise the cap
+        self.max_finished = max(1, int(max_finished))
+        self.finished: "OrderedDict[int, RouterRequest]" = OrderedDict()
+        # (engine_id, engine_req_id) -> RouterRequest for every
+        # dispatched, unfinished request — the drain walks this
+        self._inflight: Dict[Tuple[int, int], RouterRequest] = {}
+        # every _complete lands its rid here; step() drains it as the
+        # return value, so completions that happen OUT OF BAND (a
+        # requeue that already met its budget, a mark_unhealthy drain
+        # between steps) surface in the next step's list instead of
+        # going missing
+        self._done_backlog: List[int] = []
+        self._next_rid = 0
+
+        from ..observability import default_registry
+        r = default_registry()
+        self._m_requests = r.counter(
+            "router_requests_total",
+            "requests leaving the router, by outcome (completed / "
+            "truncated / rejected-at-the-bounded-queue)",
+            labels=("outcome",))
+        self._m_prefix_hits = r.counter(
+            "router_prefix_route_hits_total",
+            "dispatches steered by prefix affinity (the routed engine "
+            "already held a nonzero prefix of the prompt)")
+        self._m_requeues = r.counter(
+            "router_requeues_total",
+            "requests pulled off one engine and requeued, by reason "
+            "(preempt / engine_lost)", labels=("reason",))
+        self._m_healthy = r.gauge(
+            "router_engine_healthy",
+            "1 while the router considers the engine routable, 0 after "
+            "mark-unhealthy (probe failures or a step exception)",
+            labels=("engine",))
+        self._m_pending = r.gauge(
+            "router_pending_depth",
+            "requests admitted by the router but not yet dispatched "
+            "to an engine")
+        for h in self.handles.values():
+            self._m_healthy.labels(engine=str(h.engine_id)).set(1)
+
+    # ---- public API -----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               eos_token_id: Optional[int] = None, priority: int = 0,
+               ttft_target: Optional[float] = None,
+               tpot_target: Optional[float] = None) -> int:
+        """Queue one prompt with its SLO envelope; returns the router
+        request id.  ``ttft_target`` (seconds) orders the pending
+        queue (earliest deadline first among equal priorities) and
+        releases an affinity hold once the deadline passes;
+        ``tpot_target`` marks the request preempt-last among
+        equal-priority victims (a preemption is what blows a per-token
+        SLO).  Raises :class:`RouterQueueFull` when the bounded
+        pending queue is at ``max_pending`` (counted as
+        ``outcome="rejected"`` — shed load at the front door instead
+        of growing an unbounded backlog)."""
+        if len(self.pending) >= self.max_pending:
+            self._m_requests.labels(outcome="rejected").inc()
+            raise RouterQueueFull(
+                "pending queue at max_pending=%d" % self.max_pending)
+        rr = RouterRequest(
+            rid=self._next_rid,
+            prompt_ids=np.asarray(prompt_ids, np.int64).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, priority=int(priority),
+            ttft_target=ttft_target, tpot_target=tpot_target)
+        self._next_rid += 1
+        rr.t_submit = time.perf_counter()
+        self.pending.append(rr)
+        self._m_pending.set(len(self.pending))
+        return rr.rid
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or bool(self._inflight)
+
+    def step(self) -> List[int]:
+        """One router round: probe every engine, dispatch pending work
+        (preempting when priorities demand), then advance every healthy
+        engine one ``step()``.  Returns every rid that completed since
+        the last call — including out-of-band completions (a requeue
+        whose tokens already met the budget, a ``mark_unhealthy`` drain
+        between steps): callers keying on the returned ids must never
+        have one go missing."""
+        self._probe_all()
+        self._dispatch_pending()
+        for h in list(self.handles.values()):
+            if not h.healthy:
+                continue
+            try:
+                if h.engine.has_work():
+                    for erid in h.engine.step():
+                        rr = self._inflight.pop((h.engine_id, erid),
+                                                None)
+                        if rr is not None:
+                            # pop, don't read: the router holds the
+                            # authoritative copy, and the engine-side
+                            # record would otherwise grow per request
+                            # forever in a long-running deployment
+                            self._complete(
+                                rr, h.engine.finished.pop(erid))
+            except Exception:                         # noqa: BLE001
+                self._lose_engine(h)
+                continue
+            # defensive sweep — OUTSIDE the has_work gate: anything of
+            # ours in the engine's finished dict that a step() return
+            # ever missed (an engine implementation quirk must degrade
+            # to a late completion, never to a request the router
+            # waits on forever, even once the engine has gone idle)
+            for key in [k for k in self._inflight
+                        if k[0] == h.engine_id
+                        and k[1] in h.engine.finished]:
+                rr = self._inflight.pop(key)
+                self._complete(rr, h.engine.finished.pop(key[1]))
+            self._sync_first_tokens(h)
+        self._m_pending.set(len(self.pending))
+        done, self._done_backlog = self._done_backlog, []
+        return done
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        stalled = 0
+        while self.has_work():
+            if not any(h.healthy for h in self.handles.values()):
+                raise RuntimeError(
+                    "ServingRouter: no healthy engines left with %d "
+                    "request(s) outstanding — recover_engine() or add "
+                    "capacity" % (len(self.pending) + len(self._inflight)))
+            n_pending = len(self.pending)
+            self.step()
+            if (self.pending and not self._inflight
+                    and len(self.pending) == n_pending
+                    and not any(h.healthy and h.engine.has_work()
+                                for h in self.handles.values())):
+                # nothing in flight, every engine idle, dispatch placed
+                # nothing.  One such step is normal (the engine drained
+                # DURING it, after dispatch ran); two in a row means
+                # these requests fit NO engine in the pool
+                # (pages/block-table limits) — fail loudly, don't spin
+                stalled += 1
+                if stalled >= 2:
+                    raise RuntimeError(
+                        "ServingRouter: %d pending request(s) fit no "
+                        "engine in the pool (add_request rejected them "
+                        "everywhere)" % len(self.pending))
+            else:
+                stalled = 0
+        return {rid: r.output_ids for rid, r in self.finished.items()}
+
+    def result(self, rid: int) -> List[int]:
+        return self.finished[rid].output_ids
+
+    def pop_result(self, rid: int) -> List[int]:
+        """Consume one finished request's tokens (the streaming-driver
+        API: read each rid from ``step()``'s return, pop it, and the
+        finished record stays flat regardless of run length)."""
+        return self.finished.pop(rid).output_ids
+
+    # ---- health ---------------------------------------------------------
+    def mark_unhealthy(self, engine_id: int):
+        """Operator/test hook: take an engine out of rotation NOW and
+        drain-and-requeue everything in flight on it (the same path a
+        failed probe or step exception takes)."""
+        self._lose_engine(self.handles[engine_id])
+
+    def recover_engine(self, engine_id: int):
+        """Re-admit an engine (restarted, or past a transient probe
+        blip).  Its router-side affinity record was cleared on loss;
+        matching restarts from its LIVE prefix table, which is exactly
+        right for both a fresh restart (empty) and a survivor (intact)."""
+        h = self.handles[engine_id]
+        h.healthy = True
+        h.probe_failures = 0
+        self._m_healthy.labels(engine=str(h.engine_id)).set(1)
+
+    def _probe_all(self):
+        for h in self.handles.values():
+            if not h.healthy:
+                continue
+            if h.probe():
+                h.probe_failures = 0
+            else:
+                h.probe_failures += 1
+                if h.probe_failures >= self.probe_failure_threshold:
+                    self._lose_engine(h)
+
+    def _lose_engine(self, h: EngineHandle):
+        """Mark unhealthy + drain: every in-flight request comes off
+        through ``preempt_request`` when the engine's host state still
+        answers (refcounted release — a later recovery finds a clean
+        pool), else from the router's own record; all requeue with
+        reason="engine_lost".  Zero drops by construction: every
+        dispatched request is in ``_inflight`` until completed."""
+        if not h.healthy:
+            return
+        h.healthy = False
+        h.probe_failures = 0
+        h.routed_keys.clear()
+        self._m_healthy.labels(engine=str(h.engine_id)).set(0)
+        for (eid, erid) in [k for k in self._inflight
+                            if k[0] == h.engine_id]:
+            rr = self._inflight.pop((eid, erid))
+            gen: List[int] = []
+            try:
+                _prompt, gen = h.engine.preempt_request(erid)
+            except Exception:                         # noqa: BLE001
+                # the request finished INSIDE the failing step, or the
+                # engine is too far gone: consume the engine-side
+                # finished record if there is one (popping it — a
+                # recovered engine must not strand it forever), else
+                # fall back to the live request object's token list
+                ereq = None
+                try:
+                    ereq = h.engine.finished.pop(erid, None)
+                except Exception:                     # noqa: BLE001
+                    pass
+                try:
+                    gen = list((ereq or rr.engine_req).output_ids)
+                except Exception:                     # noqa: BLE001
+                    gen = []
+            self._requeue(rr, gen, reason="engine_lost")
+
+    # ---- requeue / preemption -------------------------------------------
+    def _requeue(self, rr: RouterRequest, gen: List[int], reason: str):
+        """Fold the tokens the lost/preempted engine generated into the
+        router-side record and put the request back in the pending
+        queue (or finish it, if those tokens already met the budget or
+        hit EOS)."""
+        rr.base_output.extend(int(t) for t in gen)
+        rr.key_cache.clear()            # resume prompt just grew
+        rr.engine_id = -1
+        rr.engine_req_id = -1
+        rr.engine_req = None
+        rr.requeues += 1
+        self._m_requeues.labels(reason=reason).inc()
+        hit_eos = (rr.eos_token_id is not None and rr.base_output
+                   and rr.base_output[-1] == rr.eos_token_id)
+        if rr.remaining_budget() <= 0 or hit_eos:
+            self._complete(rr, None)
+            return
+        rr.state = "pending"
+        self.pending.append(rr)
+
+    def _preempt_and_place(self, rr: RouterRequest,
+                           only: Optional[EngineHandle] = None) -> bool:
+        """Every engine ``rr`` may use is full and it outranks someone:
+        place ``rr`` by preempting the cheapest strictly-lower-priority
+        running request (lowest priority first; among equals, requests
+        WITHOUT a TPOT target before those with one — a preemption is
+        exactly what blows a per-token-latency SLO — then fewest total
+        tokens, the smallest re-prefix bill).  ``rr`` is dispatched to
+        the victim's engine FIRST (engine queues accept regardless of
+        slot occupancy — capacity gating is the router's own notion),
+        and the victim is pulled only once that succeeds: an engine
+        whose geometry rejects ``rr`` costs a recorded rejection, never
+        a pointless preemption.  ``only`` restricts victims to one
+        engine — when ``rr`` is holding for its affinity target, a
+        preemption anywhere else would not place it."""
+        victims = []
+        for key, vr in self._inflight.items():
+            h = self.handles[key[0]]
+            if not h.healthy or vr.priority >= rr.priority:
+                continue
+            if h.engine_id in rr.rejected_engines:
+                continue          # freeing a slot there cannot place rr
+            if only is not None and h is not only:
+                continue
+            if getattr(vr.engine_req, "slot", 0) < 0:
+                # dispatched but still in the engine's waiting queue:
+                # pulling it frees NO slot — preempting it would strand
+                # rr behind the same full slots
+                continue
+            try:
+                n_tok = (len(vr.prompt_ids) + len(vr.base_output)
+                         + len(vr.engine_req.output_ids))
+            except Exception:                         # noqa: BLE001
+                n_tok = len(vr.prompt_ids)
+            victims.append(((vr.priority,
+                             vr.tpot_target is not None, n_tok,
+                             vr.rid), key, vr, h))
+        tried = set()
+        for _rank, key, vr, h in sorted(victims, key=lambda v: v[0]):
+            if h.engine_id in tried:
+                continue          # geometry already rejected rr there
+            tried.add(h.engine_id)
+            if not self._dispatch(rr, h, self._match(h, rr)):
+                continue
+            try:
+                _prompt, gen = h.engine.preempt_request(vr.engine_req_id)
+            except KeyError:
+                # raced with completion inside the engine: the slot is
+                # free anyway and rr is already queued there
+                return True
+            self._inflight.pop(key, None)
+            self._requeue(vr, gen, reason="preempt")
+            try:
+                h.refresh()
+            except Exception:                         # noqa: BLE001
+                # scrape died mid-round: take the engine-lost path
+                # (rr just landed there and drains right back off)
+                self._lose_engine(h)
+            return True
+        return False
+
+    # ---- dispatch -------------------------------------------------------
+    def _match(self, h: EngineHandle, rr: RouterRequest) -> int:
+        """Prefix-match tokens of ``rr`` on ``h``, through the
+        request's memoized per-block-size key chain."""
+        bs = getattr(h.engine, "block_size", 0)
+        if not bs or getattr(h.engine, "prefix_cache", None) is None:
+            return 0
+        return h.prefix_match_tokens(None,
+                                     keys=rr.routing_keys_for(bs))
+
+    def _rank_engines(self, rr: RouterRequest
+                      ) -> Tuple[List[Tuple[int, EngineHandle]],
+                                 Optional[EngineHandle]]:
+        """``(candidates best-first as (match_tokens, handle), hold)``.
+
+        Affinity policy: the longest prefix match over every HEALTHY
+        engine decides.  Match on an engine with capacity -> dispatch
+        there (ties: load, then engine id).  Match only on FULL engines
+        and the request hasn't exhausted its wait budget -> no
+        candidates, ``hold`` names the engine worth waiting (or
+        preempting) for.  No match (or wait exhausted, or TTFT deadline
+        passed) -> least-loaded over capacity-holding engines.
+        ``random`` policy shuffles the capacity-holding engines — the
+        bench's control arm."""
+        healthy = [h for h in self.handles.values()
+                   if h.healthy and h.engine_id not in rr.rejected_engines]
+        cands = [h for h in healthy if h.has_capacity()]
+        if self.route_policy == "random":
+            order = self._route_rng.permutation(len(cands))
+            return [(0, cands[i]) for i in order], None
+        scored = [(self._match(h, rr), h) for h in healthy]
+        best = max((m for m, _ in scored), default=0)
+        if best > 0:
+            matching = sorted(
+                ((m, h) for m, h in scored if m == best),
+                key=lambda mh: (mh[1].load(), mh[1].engine_id))
+            with_cap = [(m, h) for m, h in matching
+                        if h.has_capacity()]
+            if with_cap:
+                return with_cap, None
+            if (rr.affinity_waited < self.affinity_wait_steps
+                    and time.perf_counter() < rr.deadline()):
+                return [], matching[0][1]
+            # wait budget spent: spill below — the recompute registers
+            # the prefix on the spill engine, replicating a hot family
+        ranked = sorted(
+            ((m, h) for m, h in scored if h.has_capacity()),
+            key=lambda mh: (-mh[0], mh[1].load(), mh[1].engine_id))
+        return ranked, None
+
+    def _dispatch_pending(self):
+        """Drain the pending queue highest-priority-first onto ranked
+        engines; requests no engine can hold (or that are holding for
+        a full affinity target) stay pending.  Preemption (when
+        enabled) triggers for a request that outranks a running one
+        once every engine it may use is full."""
+        if not self.pending:
+            return
+        queue, self.pending = self.pending, []
+        queue.sort(key=lambda rr: (-rr.priority, rr.deadline(), rr.rid))
+        leftover: List[RouterRequest] = []
+        for rr in queue:
+            placed = False
+            hold = None
+            while not placed:
+                # re-rank after a geometry rejection: the rejected
+                # engine just left the candidate set, which can turn a
+                # match-only ranking into a least-loaded fallback with
+                # FREE capacity — preemption must stay the last resort
+                n_rej = len(rr.rejected_engines)
+                ranked, hold = self._rank_engines(rr)
+                for match, h in ranked:
+                    if self._dispatch(rr, h, match):
+                        placed = True
+                        break
+                if placed or len(rr.rejected_engines) == n_rej:
+                    break        # no new rejections: re-ranking is moot
+            if not placed and self.preempt:
+                placed = self._preempt_and_place(rr, only=hold)
+            if not placed:
+                if hold is not None:
+                    rr.affinity_waited += 1
+                leftover.append(rr)
+        # preemption victims appended themselves to self.pending
+        self.pending = leftover + self.pending
+
+    def _dispatch(self, rr: RouterRequest, h: EngineHandle,
+                  match: int) -> bool:
+        """Hand one request to one engine.  A ValueError from
+        ``add_request`` means THIS engine cannot hold the request
+        (heterogeneous pools: too few pages, narrow block table) — the
+        caller tries the next candidate."""
+        try:
+            erid = h.engine.add_request(
+                rr.resume_prompt(),
+                max_new_tokens=rr.remaining_budget(),
+                eos_token_id=rr.eos_token_id)
+        except ValueError:
+            rr.rejected_engines.add(h.engine_id)
+            return False
+        rr.state = "dispatched"
+        rr.engine_id = h.engine_id
+        rr.engine_req_id = erid
+        # add_request APPENDS to the engine's waiting queue — grab the
+        # live request object for host-side sync (first-token marks,
+        # drain fallback)
+        rr.engine_req = h.engine.waiting[-1] if h.engine.waiting else None
+        rr.routed_by_prefix = match > 0
+        if match > 0:
+            self._m_prefix_hits.inc()
+        bs = getattr(h.engine, "block_size", 0)
+        if bs and getattr(h.engine, "prefix_cache", None) is not None:
+            h.note_routed(None, keys=rr.routing_keys_for(bs))
+        h.note_dispatched()
+        self._inflight[(h.engine_id, erid)] = rr
+        return True
+
+    # ---- completion -----------------------------------------------------
+    def _sync_first_tokens(self, h: EngineHandle):
+        """TTFT marks for requests whose first token just landed on
+        this engine (pure host-side reads of the live request object)."""
+        for key, rr in self._inflight.items():
+            if key[0] != h.engine_id or rr.t_first_token:
+                continue
+            if rr.base_output:
+                # a requeued request's first token predates this engine
+                continue
+            ereq = rr.engine_req
+            if ereq is not None and ereq.output_ids:
+                rr.t_first_token = (ereq.t_first_token
+                                    or time.perf_counter())
+
+    def _complete(self, rr: RouterRequest, ereq) -> None:
+        rr.output_ids = rr.base_output + (list(ereq.output_ids)
+                                          if ereq is not None else [])
+        rr.truncated = bool(getattr(ereq, "truncated", False))
+        rr.state = "done"
+        rr.t_done = time.perf_counter()
+        if not rr.t_first_token:
+            rr.t_first_token = (getattr(ereq, "t_first_token", 0.0)
+                                or rr.t_done)
+        rr.engine_req = None
+        self.finished[rr.rid] = rr
+        while len(self.finished) > self.max_finished:
+            self.finished.popitem(last=False)
+        self._done_backlog.append(rr.rid)
+        self._m_requests.labels(
+            outcome="truncated" if rr.truncated else "completed").inc()

@@ -81,15 +81,21 @@ device).
 """
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax.numpy as jnp
 
 from ..ops.paged_attention import PagedKVCache
+
+# process-wide engine-id sequence: a multi-engine router needs a stable
+# identity per engine for health gauges / the /healthz payload, and an
+# explicit engine_id= keeps ids meaningful across processes
+_ENGINE_IDS = itertools.count()
 
 
 @dataclass
@@ -211,9 +217,15 @@ class ContinuousBatchingEngine:
                  weight_quant: Optional[str] = None,
                  quant_collectives: bool = False,
                  sampling: bool = False,
-                 draft_model=None, spec_k: int = 2):
+                 draft_model=None, spec_k: int = 2,
+                 engine_id: Optional[int] = None):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
+        # identity for multi-engine deployments (the ServingRouter's
+        # health gauge + the /healthz payload key on it); defaults to a
+        # process-wide sequence so standalone engines need no plumbing
+        self.engine_id = int(next(_ENGINE_IDS) if engine_id is None
+                             else engine_id)
         # ---- sampling / speculative validation (construction-time) --
         self.sampling = bool(sampling)
         if self.sampling and not mixed_step and not prefill_buckets:
@@ -613,6 +625,10 @@ class ContinuousBatchingEngine:
         # stays per-length.
         self._prefill_warm_lens = set()
         self._decode_warm = False
+        # step()-scoped collection of requests _finish'd during
+        # admission/prefill (None outside a step: direct _admit calls,
+        # e.g. benches, skip it)
+        self._finished_this_step = None
 
     @staticmethod
     def _auto_buckets(max_seq_len: int):
@@ -718,13 +734,25 @@ class ContinuousBatchingEngine:
         pending prefill chunks as the token budget holds into one fused
         launch; the split mode advances at most one prefill chunk, then
         decodes every running slot.  Returns req_ids finished this
-        step."""
-        self._admit()
-        if self.mixed is not None:
-            done = self._run_mixed_step()
-        else:
-            self._prefill_chunks()
-            done = self._decode_batch()
+        step — including requests that completed DURING admission
+        (a one-token budget or EOS on the first sampled token ends a
+        request inside the prefill itself; multi-engine callers key on
+        the returned ids, so those must not go missing)."""
+        self._finished_this_step = fts = []
+        try:
+            self._admit()
+            if self.mixed is not None:
+                done = self._run_mixed_step()
+            else:
+                self._prefill_chunks()
+                done = self._decode_batch()
+        finally:
+            # restore the documented outside-a-step invariant (None)
+            # even on a raising step, so direct _admit/_finish callers
+            # between steps don't feed a stale list
+            self._finished_this_step = None
+        seen = set(done)
+        done += [rid for rid in fts if rid not in seen]
         self._m_queue.set(len(self.waiting))
         self._m_occupancy.set(
             sum(s is not None for s in self.slots)
@@ -745,6 +773,60 @@ class ContinuousBatchingEngine:
 
     def result(self, req_id: int) -> List[int]:
         return self.finished[req_id].output_ids
+
+    def preempt_request(self, req_id: int) -> Tuple[np.ndarray, List[int]]:
+        """Pull a waiting or running request OUT of the engine and
+        return ``(prompt_ids, generated_ids)`` so an admission plane can
+        re-admit it elsewhere (preempt-and-requeue: the request resumes
+        on another engine with its generated tokens re-prefixed onto the
+        prompt — NOT the lazy-alloc victim-truncation path, which ends a
+        request early).
+
+        A running slot is released through the refcounted
+        ``free_sequence`` path — the ONLY release path — so pages shared
+        with the prefix table or another live request survive, COW
+        copies return to the pool, and an int8 pool's per-page scale
+        rows stay consistent (scales live per PHYSICAL page and carry no
+        per-request state).  The request is NOT finished: no outcome
+        counter fires, nothing lands in ``finished``.  Raises KeyError
+        when ``req_id`` is neither waiting nor on a slot (already
+        finished requests are not preemptible)."""
+        for i, r in enumerate(self.waiting):
+            if r.req_id == req_id:
+                self.waiting.pop(i)
+                self._m_queue.set(len(self.waiting))
+                r.state = "preempted"
+                return r.prompt_ids, list(r.output_ids)
+        for r in self.slots:
+            if r is None or r.req_id != req_id:
+                continue
+            self._release_slot(r)
+            r.slot = -1
+            r.state = "preempted"
+            return r.prompt_ids, list(r.output_ids)
+        raise KeyError(
+            "preempt_request(%r): request is neither waiting nor "
+            "running on this engine" % (req_id,))
+
+    def health_payload(self) -> Dict[str, int]:
+        """Load/health snapshot for admission planes: the same stats
+        the observability gauges read (occupancy, KV-page utilization,
+        chunk-queue depth), as one host-side dict — the body
+        ``/healthz`` serves when this engine is installed as the
+        process's health provider (``observability.set_health_provider(
+        engine.health_payload)``), so a router scrapes load without
+        parsing Prometheus text."""
+        cache = self.caches[0]
+        return {
+            "engine_id": self.engine_id,
+            "occupancy": sum(s is not None for s in self.slots),
+            "slots": self.max_batch_size,
+            "waiting": len(self.waiting),
+            "free_pages": len(cache._free),
+            "total_pages": cache.num_blocks,
+            "chunk_queue_depth": (self._pending_chunks()
+                                  if self.chunk_size is not None else 0),
+        }
 
     # ---- page allocation ------------------------------------------------
     def _try_alloc(self) -> Optional[int]:
@@ -1456,16 +1538,13 @@ class ContinuousBatchingEngine:
         if len(req.output_ids) >= req.max_new_tokens or hit_eos:
             self._finish(req)
 
-    def _finish(self, req: GenerationRequest):
-        req.state = "done"
-        n_tok = len(req.output_ids)
-        self._m_requests.labels(
-            outcome="truncated" if req.truncated else "completed").inc()
-        self._m_tokens.inc(n_tok)
-        req.t_done = time.perf_counter()
-        if n_tok > 1 and req.t_first_token:
-            self._m_tpot.observe(
-                (req.t_done - req.t_first_token) / (n_tok - 1))
+    def _release_slot(self, req: GenerationRequest):
+        """Mask the request's slot back to the sink page and release
+        its pages through the ONE refcounted path.  Shared by
+        ``_finish`` and ``preempt_request`` — every per-slot state
+        field (tokens, seq_lens, block table, sampling knobs) must be
+        cleared HERE and nowhere else, so the two release sites cannot
+        drift as new fields are added."""
         if req.slot >= 0:
             s = req.slot
             self.slots[s] = None
@@ -1477,4 +1556,20 @@ class ContinuousBatchingEngine:
         # prefix table or another live request survive this drop
         self.caches[0].free_sequence(req.block_ids)
         req.block_ids = []
+
+    def _finish(self, req: GenerationRequest):
+        req.state = "done"
+        # surface admission-time completions in this step()'s return
+        # (the decode/mixed loops build their own lists; step() dedupes)
+        if getattr(self, "_finished_this_step", None) is not None:
+            self._finished_this_step.append(req.req_id)
+        n_tok = len(req.output_ids)
+        self._m_requests.labels(
+            outcome="truncated" if req.truncated else "completed").inc()
+        self._m_tokens.inc(n_tok)
+        req.t_done = time.perf_counter()
+        if n_tok > 1 and req.t_first_token:
+            self._m_tpot.observe(
+                (req.t_done - req.t_first_token) / (n_tok - 1))
+        self._release_slot(req)
         self.finished[req.req_id] = req

@@ -25,6 +25,9 @@ ContinuousBatchingEngine  serving_queue_depth, serving_slot_occupancy_ratio,
                           serving_ttft_seconds, serving_tpot_seconds,
                           serving_requests_total, serving_tokens_total,
                           serving_truncated_victims_total
+ServingRouter             router_requests_total, router_pending_depth,
+                          router_prefix_route_hits_total,
+                          router_requeues_total, router_engine_healthy
 CheckpointManager         checkpoint_save_duration_seconds,
                           checkpoint_written_bytes_total,
                           checkpoint_commits_total,
@@ -39,7 +42,8 @@ from .metrics import (MetricsRegistry, Counter, Gauge, Histogram,
                       counter, gauge, histogram)
 from .exporters import (generate_latest, json_snapshot, dump_json,
                         MetricsServer, start_metrics_server,
-                        METRICS_PORT_ENV)
+                        METRICS_PORT_ENV, set_health_provider,
+                        healthz_payload)
 from .telemetry import (StepTelemetry, device_peak_flops,
                         PEAK_FLOPS_BY_KIND, CHECK_NAN_ENV,
                         PEAK_FLOPS_ENV)
@@ -52,6 +56,7 @@ __all__ = [
     "histogram",
     "generate_latest", "json_snapshot", "dump_json", "MetricsServer",
     "start_metrics_server", "METRICS_PORT_ENV",
+    "set_health_provider", "healthz_payload",
     "StepTelemetry", "device_peak_flops", "PEAK_FLOPS_BY_KIND",
     "CHECK_NAN_ENV", "PEAK_FLOPS_ENV",
     "SpanLog", "span_log", "record_span", "record_instant",

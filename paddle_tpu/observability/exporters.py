@@ -24,11 +24,48 @@ from typing import Optional
 from .metrics import (MetricsRegistry, _HistogramChild, default_registry)
 
 __all__ = ["generate_latest", "json_snapshot", "dump_json",
-           "MetricsServer", "start_metrics_server", "METRICS_PORT_ENV"]
+           "MetricsServer", "start_metrics_server", "METRICS_PORT_ENV",
+           "set_health_provider", "healthz_payload"]
 
 METRICS_PORT_ENV = "PADDLE_TPU_METRICS_PORT"
 
 CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+# process-wide /healthz payload provider (e.g. a serving engine's
+# ``health_payload`` bound method): its dict is merged into the healthz
+# JSON body so an admission plane scrapes load (occupancy, free pages,
+# chunk-queue depth, engine id) without parsing Prometheus text
+_health_provider = None
+
+
+def set_health_provider(provider) -> None:
+    """Install (or clear, with ``None``) the process-wide callable whose
+    dict enriches every ``/healthz`` response.  Typical use::
+
+        set_health_provider(engine.health_payload)
+    """
+    global _health_provider
+    _health_provider = provider
+
+
+def healthz_payload(provider=None) -> dict:
+    """The ``/healthz`` JSON body.  Always contains ``status: "ok"`` —
+    the bare 200-with-"ok" contract existing callers probe — plus the
+    provider's load fields when one is installed.  A raising or
+    non-dict provider degrades to the bare payload: a liveness probe
+    must never 500 because a stats callback broke."""
+    payload = {"status": "ok"}
+    provider = provider or _health_provider
+    if provider is not None:
+        try:
+            extra = provider()
+            if isinstance(extra, dict):
+                extra = dict(extra)         # never mutate the
+                extra.pop("status", None)   # provider's own dict;
+                payload.update(extra)       # liveness field is ours
+        except Exception:                             # noqa: BLE001
+            pass
+    return payload
 
 
 def _escape_help(text: str) -> str:
@@ -139,7 +176,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._send(200, body, CONTENT_TYPE_LATEST)
         elif path == "/healthz":
-            self._send(200, b'{"status": "ok"}\n', "application/json")
+            try:
+                # default=str: numpy scalars (this codebase's natural
+                # numeric type) serialize as digit strings, which
+                # scrapers int()/float() fine
+                body = json.dumps(
+                    healthz_payload(
+                        getattr(self.server, "_health_provider", None)),
+                    default=str) + "\n"
+            except Exception:                         # noqa: BLE001
+                # the liveness contract outranks the stats payload
+                body = '{"status": "ok"}\n'
+            self._send(200, body.encode("utf-8"), "application/json")
         else:
             self._send(404, b"not found\n", "text/plain")
 
@@ -155,12 +203,16 @@ class MetricsServer:
     """
 
     def __init__(self, port: Optional[int] = None, addr: str = "0.0.0.0",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 health_provider=None):
         if port is None:
             port = int(os.environ.get(METRICS_PORT_ENV, "0") or 0)
         self.addr = addr
         self._requested_port = int(port)
         self.registry = registry or default_registry()
+        # per-server /healthz enrichment (falls back to the
+        # process-wide set_health_provider when None)
+        self.health_provider = health_provider
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -179,6 +231,7 @@ class MetricsServer:
                                     _Handler)
         httpd.daemon_threads = True
         httpd._registry = self.registry
+        httpd._health_provider = self.health_provider
         self._httpd = httpd
         self._thread = threading.Thread(
             target=httpd.serve_forever, kwargs={"poll_interval": 0.2},
@@ -204,7 +257,8 @@ class MetricsServer:
 
 def start_metrics_server(port: Optional[int] = None,
                          addr: str = "0.0.0.0",
-                         registry: Optional[MetricsRegistry] = None
-                         ) -> MetricsServer:
+                         registry: Optional[MetricsRegistry] = None,
+                         health_provider=None) -> MetricsServer:
     """Convenience: construct + start a :class:`MetricsServer`."""
-    return MetricsServer(port=port, addr=addr, registry=registry).start()
+    return MetricsServer(port=port, addr=addr, registry=registry,
+                         health_provider=health_provider).start()

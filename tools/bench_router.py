@@ -1,0 +1,338 @@
+"""Router bench: prefix-affinity admission plane over N engines.
+
+Round-15 tentpole artifact (BENCH_ROUTER_r15.json):
+
+1. **Affinity vs random routing** on a shared-prefix workload across
+   2 and 4 engines: a seed wave registers one prompt per prefix family
+   somewhere in the pool, then a measured wave of same-family requests
+   is routed either by prefix affinity (the tentpole policy: longest
+   block-granularity blake2b prefix match, least-loaded fallback) or
+   uniformly at random over engines with capacity (the control arm).
+   Reported per arm: pool-wide prefix-cache hit rate and mean/median
+   TTFT.  Gates: affinity hit-rate STRICTLY beats random at every pool
+   size, and affinity mean TTFT beats random at every pool size.
+
+2. **Kill-one-engine drill**: requests mid-flight on 2 engines, one
+   engine's ``step()`` starts raising (the router marks it unhealthy
+   and drains it through the engine's refcounted ``preempt_request``
+   path).  Gates: ZERO dropped requests (every rid finishes with its
+   full budget), every request's tokens BYTE-IDENTICAL to the eager
+   greedy reference (the requeued ones resumed elsewhere with their
+   generated tokens re-prefixed), at least one request actually
+   requeued, and the drained engine's pool leak-free (every page free
+   or held once by its prefix table).
+
+Every arm is parity-gated: engine outputs must equal eager
+``generate`` byte-for-byte before any number is trusted.
+
+Model: the tiny llama config on CPU (artifact schema CI-checkable);
+the 1.1B bench line on TPU.  Run from the repo root; artifact path in
+argv[1] (default BENCH_ROUTER_r15.json).  On any error ONE parseable
+failure-marker JSON line is emitted and the run exits 1.
+"""
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models import LlamaConfig  # noqa: E402
+from paddle_tpu.models.llama import (LlamaForCausalLM,  # noqa: E402
+                                     llama_tiny_config, param_count)
+from paddle_tpu.inference.serving import (  # noqa: E402
+    ContinuousBatchingEngine)
+from paddle_tpu.inference.router import ServingRouter  # noqa: E402
+
+
+def build_model(on_tpu):
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=20, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16")
+    else:
+        cfg = llama_tiny_config()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.bfloat16()
+    model.eval()
+    return cfg, model
+
+
+def _ref(model, prompt, budget):
+    out = model.generate(paddle.to_tensor(np.asarray(prompt)[None, :]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0, len(prompt):].tolist()
+
+
+def make_engines(model, n, knobs):
+    return [ContinuousBatchingEngine(
+        model, max_batch_size=knobs["slots"],
+        num_blocks=knobs["num_blocks"], block_size=knobs["block_size"],
+        mixed_step=True, prefill_chunk_size=knobs["chunk"],
+        enable_prefix_cache=True) for _ in range(n)]
+
+
+def warm_engines(model, engines, knobs, vocab):
+    """Compile warmup per ENGINE (each engine owns its own MixedStep
+    modules): run a couple of staggered requests shaped like the
+    measured workload straight through each engine, with token values
+    from a DISJOINT range so nothing lands in the measured prefix
+    families.  Cold budget compiles land here, not in a TTFT window."""
+    rng = np.random.RandomState(99)
+    L = knobs["prefix_len"] + knobs["suffix_len"]
+    for eng in engines:
+        r0 = eng.add_request(rng.randint(1, vocab, (L,)).astype(np.int64),
+                             max_new_tokens=knobs["budget"])
+        eng.step()
+        eng.add_request(
+            rng.randint(1, vocab, (knobs["suffix_len"],)).astype(np.int64),
+            max_new_tokens=knobs["budget"])
+        eng.run_to_completion()
+        del r0
+
+
+def shared_prefix_workload(knobs, vocab, families, per_family):
+    """[(prompt, family)] — `families` prefix families, each with one
+    seed prompt and `per_family` measured same-prefix suffix variants."""
+    rng = np.random.RandomState(17)
+    out = []
+    for f in range(families):
+        prefix = rng.randint(1, vocab,
+                             (knobs["prefix_len"],)).astype(np.int64)
+        for _ in range(per_family + 1):          # +1 = the seed wave
+            suffix = rng.randint(1, vocab,
+                                 (knobs["suffix_len"],)).astype(np.int64)
+            out.append((np.concatenate([prefix, suffix]), f))
+    return out
+
+
+def pool_prefix_stats(engines):
+    hits = sum(e.prefix_cache.hits for e in engines)
+    misses = sum(e.prefix_cache.misses for e in engines)
+    return hits, misses
+
+
+def bench_routing_arm(model, n_engines, policy, knobs, budget):
+    """One (pool size, policy) arm: seed wave registers the prefix
+    families, measured wave reports hit-rate + TTFT.  Outputs parity-
+    checked against eager generate."""
+    vocab = model.config.vocab_size
+    engines = make_engines(model, n_engines, knobs)
+    warm_engines(model, engines, knobs, vocab)
+    router = ServingRouter(engines, route_policy=policy, route_seed=23)
+    work = shared_prefix_workload(knobs, vocab, knobs["families"],
+                                  knobs["per_family"])
+    # one seed request per family first, so the measured wave can hit
+    seen = set()
+    seed_items, measured_items = [], []
+    for prompt, fam in work:
+        if fam not in seen:
+            seen.add(fam)
+            seed_items.append((prompt, fam))
+        else:
+            measured_items.append((prompt, fam))
+    for prompt, _f in seed_items:
+        router.submit(prompt, max_new_tokens=budget)
+    router.run_to_completion()
+    h0, m0 = pool_prefix_stats(engines)
+
+    rids = []
+    for prompt, _f in measured_items:
+        rids.append((router.submit(prompt, max_new_tokens=budget),
+                     prompt))
+    router.run_to_completion()
+    h1, m1 = pool_prefix_stats(engines)
+
+    parity = True
+    ttfts = []
+    for rid, prompt in rids:
+        rr = router.finished[rid]
+        if rr.output_ids != _ref(model, prompt, budget):
+            parity = False
+        ttfts.append(rr.t_first_token - rr.t_submit)
+    hits, misses = h1 - h0, m1 - m0
+    return {
+        "policy": policy,
+        "n_engines": n_engines,
+        "requests": len(rids),
+        "prefix_hit_rate": round(hits / max(1, hits + misses), 4),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "mean_ttft_ms": round(statistics.mean(ttfts) * 1e3, 3),
+        "median_ttft_ms": round(statistics.median(ttfts) * 1e3, 3),
+        "parity_vs_eager": parity,
+    }
+
+
+def bench_kill_drill(model, knobs, budget, n_requests):
+    """Mid-run engine loss: one engine's step() starts raising; the
+    router must drain-and-requeue with zero drops and byte-identical
+    tokens vs the eager reference."""
+    vocab = model.config.vocab_size
+    engines = make_engines(model, 2, knobs)
+    warm_engines(model, engines, knobs, vocab)
+    router = ServingRouter(engines)
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(
+        1, vocab, (knobs["prefix_len"] + knobs["suffix_len"],))
+        .astype(np.int64) for _ in range(n_requests)]
+    rids = [router.submit(p, max_new_tokens=budget) for p in prompts]
+    for _ in range(3):
+        router.step()
+    # kill the engine currently holding the most in-flight requests —
+    # the failure injection is a raising step(), the path a real engine
+    # loss takes through the router
+    per_engine = {eid: 0 for eid in router.handles}
+    for (eid, _erid) in router._inflight:
+        per_engine[eid] += 1
+    victim_id = max(per_engine, key=lambda e: (per_engine[e], -e))
+    victim = router.handles[victim_id].engine
+    inflight_on_victim = per_engine[victim_id]
+
+    def _dead_step():
+        raise RuntimeError("injected engine loss")
+    victim.step = _dead_step
+    requeues_before = sum(router.finished[r].requeues
+                          for r in router.finished)
+    out = router.run_to_completion()
+
+    zero_drops = all(rid in out for rid in rids)
+    full_budget = all(len(out[rid]) == budget for rid in rids if rid in out)
+    # the eager greedy reference IS the unkilled run's tokens
+    parity = all(out.get(rid) == _ref(model, p, budget)
+                 for rid, p in zip(rids, prompts))
+    requeued = sum(router.finished[r].requeues for r in rids)
+    # drained pool leak audit: every page free or held exactly once by
+    # the prefix table (preempt_request released through free_sequence)
+    c0 = victim.caches[0]
+    cached = victim.prefix_cache.cached_blocks()
+    leak_free = (len(c0._free) + len(cached) == c0.num_blocks
+                 and all(c0.refcount(b) == 1 for b in cached))
+    return {
+        "requests": n_requests,
+        "inflight_on_killed_engine": inflight_on_victim,
+        "zero_drops": bool(zero_drops),
+        "full_budget": bool(full_budget),
+        "token_parity": bool(parity),
+        "requeued_requests": int(requeued),
+        "killed_engine_leak_free": bool(leak_free),
+        "requeues_before_kill": int(requeues_before),
+    }
+
+
+def main(out_path):
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg, model = build_model(on_tpu)
+    if on_tpu:
+        knobs = dict(slots=4, num_blocks=512, block_size=16, chunk=64,
+                     prefix_len=192, suffix_len=32, families=6,
+                     per_family=4)
+        budget, kill_requests = 16, 12
+    else:
+        knobs = dict(slots=2, num_blocks=96, block_size=4, chunk=8,
+                     prefix_len=24, suffix_len=4, families=5,
+                     per_family=3)
+        budget, kill_requests = 4, 8
+    knobs["budget"] = budget
+
+    arms = []
+    ok = True
+    gate_notes = []
+    for n in (2, 4):
+        aff = bench_routing_arm(model, n, "affinity", knobs, budget)
+        rnd = bench_routing_arm(model, n, "random", knobs, budget)
+        arms += [aff, rnd]
+        for a in (aff, rnd):
+            print("# n=%d %s: hit_rate=%.3f mean_ttft=%.2fms "
+                  "parity=%s" % (n, a["policy"], a["prefix_hit_rate"],
+                                 a["mean_ttft_ms"], a["parity_vs_eager"]),
+                  file=sys.stderr)
+        if not (aff["parity_vs_eager"] and rnd["parity_vs_eager"]):
+            ok = False
+            gate_notes.append("parity failed at n=%d" % n)
+        if aff["prefix_hit_rate"] <= rnd["prefix_hit_rate"]:
+            ok = False
+            gate_notes.append(
+                "hit-rate gate failed at n=%d (%.3f <= %.3f)"
+                % (n, aff["prefix_hit_rate"], rnd["prefix_hit_rate"]))
+        if aff["mean_ttft_ms"] >= rnd["mean_ttft_ms"]:
+            ok = False
+            gate_notes.append(
+                "TTFT gate failed at n=%d (%.2f >= %.2f)"
+                % (n, aff["mean_ttft_ms"], rnd["mean_ttft_ms"]))
+
+    drill = bench_kill_drill(model, knobs, budget * 2, kill_requests)
+    print("# kill drill: drops=%s parity=%s requeued=%d leak_free=%s"
+          % (not drill["zero_drops"], drill["token_parity"],
+             drill["requeued_requests"], drill["killed_engine_leak_free"]),
+          file=sys.stderr)
+    if not (drill["zero_drops"] and drill["full_budget"]
+            and drill["token_parity"]
+            and drill["requeued_requests"] >= 1
+            and drill["killed_engine_leak_free"]):
+        ok = False
+        gate_notes.append("kill drill failed: %r" % (drill,))
+
+    aff2 = next(a for a in arms
+                if a["policy"] == "affinity" and a["n_engines"] == 2)
+    rnd2 = next(a for a in arms
+                if a["policy"] == "random" and a["n_engines"] == 2)
+    artifact = {
+        "metric": "router_prefix_affinity_hit_rate",
+        "value": aff2["prefix_hit_rate"],
+        "passed": ok,
+        "gate_notes": gate_notes,
+        "ttft_uplift_vs_random": round(
+            rnd2["mean_ttft_ms"] / max(1e-9, aff2["mean_ttft_ms"]), 3),
+        "routing_arms": arms,
+        "kill_drill": drill,
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "dtype": cfg.dtype,
+            **knobs,
+            "budget": budget,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "hit_rate",
+        "vs_baseline": (aff2["prefix_hit_rate"]
+                        / max(1e-9, rnd2["prefix_hit_rate"])
+                        if ok else 0.0),
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "BENCH_ROUTER_r15.json"
+    try:
+        main(out)
+    except SystemExit:
+        raise
+    except Exception as e:                            # noqa: BLE001
+        print(json.dumps({
+            "metric": "router_prefix_affinity_hit_rate",
+            "value": 0.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+        }), flush=True)
+        sys.exit(1)

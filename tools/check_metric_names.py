@@ -72,6 +72,12 @@ REQUIRED_NAMES = frozenset({
     "serving_spec_proposed_tokens_total",
     "serving_spec_accepted_tokens_total",
     "serving_spec_draft_step_duration_seconds",
+    # multi-engine serving router (round-15; BENCH_ROUTER_r15.json)
+    "router_requests_total",
+    "router_prefix_route_hits_total",
+    "router_requeues_total",
+    "router_engine_healthy",
+    "router_pending_depth",
 })
 
 
