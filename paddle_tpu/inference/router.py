@@ -48,12 +48,33 @@ pulled off (via ``preempt_request`` while the engine's host state still
 answers, else the router's own last-known token record) and requeued,
 zero drops.  A recovered engine re-admits via ``recover_engine``.
 
+**Request tracing + SLO attainment (round 16).**  The router owns a
+bounded :class:`~paddle_tpu.observability.RequestTracer` (default ON;
+``tracer=False`` drops to the no-op stub) recording every request's
+typed phase chain — enqueue, affinity-hold rounds, the route decision
+(engine + prefix/least-loaded/spilled/random outcome), dispatch,
+first token, preempt/requeue/engine-lost hops with the destination
+engine, finish — and keeps per-hop ``(engine, engine_req_id,
+t_dispatch, t_leave)`` records so
+:func:`~paddle_tpu.observability.fleet_trace` can merge the router's
+and every engine's spans into ONE chrome trace with flow arrows
+across engines.  At completion the measured TTFT (submit -> first
+token, across requeues) and mean TPOT are judged against the request's
+declared targets — ``router_slo_attained_total{kind,outcome}`` — and
+fed into bounded reservoirs whose p50/p95/p99 digests surface in
+:meth:`ServingRouter.health_payload` (wire it to ``/healthz`` via
+``set_health_provider``) and the
+``router_latency_quantile_seconds{kind,q}`` gauges; the same summary
+is attached to each finished record (``RouterRequest.summary``) so
+streaming drivers read the numbers off ``pop_record`` without
+scraping metrics.
+
 Engine protocol (what a pool member must provide): ``add_request(
 prompt_ids, max_new_tokens=, eos_token_id=)`` appending to ``waiting``,
 ``step() -> finished req_ids``, ``has_work()``, ``finished`` dict,
 ``preempt_request(req_id)``, ``health_payload()``, ``block_size``, and
-optionally ``prefix_cache``/``engine_id`` — i.e. the public surface of
-``ContinuousBatchingEngine``.
+optionally ``prefix_cache``/``engine_id``/``tracer`` — i.e. the public
+surface of ``ContinuousBatchingEngine``.
 
 All router state is host control flow: no device math, no new compiled
 modules — the engines' one-compile invariants are untouched.
@@ -156,9 +177,25 @@ class RouterRequest:
     # cleared on requeue, when the resume prompt grows
     key_cache: Dict[int, List[bytes]] = field(default_factory=dict,
                                               repr=False)
+    # one entry per dispatch: [engine_id, engine_req_id, t_dispatch,
+    # t_leave] (t_leave None while the segment is live) — the hop
+    # record fleet_trace draws cross-engine flow arrows from and the
+    # summary's engines_visited reads
+    hops: List[list] = field(default_factory=list, repr=False)
+    # final per-request numbers (ttft, mean_tpot, requeues,
+    # engines_visited, slo outcomes), set at completion — streaming
+    # drivers read these off the finished record instead of scraping
+    # process-wide metrics
+    summary: Optional[Dict] = None
     t_submit: float = 0.0
+    # pending-phase start: t_submit, then each requeue mark (the
+    # tracer's pending spans must tile requeue waits too)
+    t_requeued: float = 0.0
     t_first_token: float = 0.0
     t_done: float = 0.0
+
+    def engines_visited(self) -> List[int]:
+        return [h[0] for h in self.hops]
 
     def resume_prompt(self) -> np.ndarray:
         """Prompt for (re-)admission: original tokens plus everything
@@ -329,7 +366,8 @@ class ServingRouter:
                  route_policy: str = "affinity",
                  route_seed: int = 0,
                  affinity_wait_steps: int = 8,
-                 max_finished: int = 4096):
+                 max_finished: int = 4096,
+                 tracer=None):
         if route_policy not in ("affinity", "random"):
             raise ValueError(
                 "route_policy must be 'affinity' or 'random'; got %r"
@@ -376,6 +414,22 @@ class ServingRouter:
         self._next_rid = 0
 
         from ..observability import default_registry
+        from ..observability.request_trace import (LatencyReservoir,
+                                                   resolve_tracer)
+        # bounded per-request phase tracer (round 16): default ON —
+        # host-side appends only; tracer=False drops to the no-op stub
+        self.tracer = resolve_tracer(tracer)
+        # measured-latency reservoirs behind the p50/p95/p99 digests in
+        # health_payload() and the quantile gauges
+        self._ttft_res = LatencyReservoir(1024, seed=1)
+        self._tpot_res = LatencyReservoir(1024, seed=2)
+        # per-ROUTER attainment counts (the Prometheus counters are
+        # process-wide series shared across routers; the completeness
+        # gate sums THESE against this router's own admissions)
+        self._slo_counts: Dict[Tuple[str, str], int] = {
+            (k, o): 0 for k in ("ttft", "tpot")
+            for o in ("attained", "missed", "no_target")}
+        self._completions = 0
         r = default_registry()
         self._m_requests = r.counter(
             "router_requests_total",
@@ -399,6 +453,27 @@ class ServingRouter:
             "router_pending_depth",
             "requests admitted by the router but not yet dispatched "
             "to an engine")
+        self._m_slo = r.counter(
+            "router_slo_attained_total",
+            "completed requests judged against their declared SLO "
+            "targets, by kind (ttft / tpot) and outcome (attained / "
+            "missed / no_target) — for each kind the outcomes sum to "
+            "completed admissions",
+            labels=("kind", "outcome"))
+        # resolve the six children once (completion-path, but labels()
+        # is a lock + probe and the label sets are closed anyway)
+        self._slo_children = {
+            (k, o): self._m_slo.labels(kind=k, outcome=o)
+            for k in ("ttft", "tpot")
+            for o in ("attained", "missed", "no_target")}
+        self._m_latency_q = r.gauge(
+            "router_latency_quantile_seconds",
+            "bounded-reservoir latency digests over completed requests "
+            "(kind: ttft / tpot; q: p50 / p95 / p99)",
+            labels=("kind", "q"))
+        self._latq_children = {
+            (k, q): self._m_latency_q.labels(kind=k, q=q)
+            for k in ("ttft", "tpot") for q in ("p50", "p95", "p99")}
         for h in self.handles.values():
             self._m_healthy.labels(engine=str(h.engine_id)).set(1)
 
@@ -429,8 +504,13 @@ class ServingRouter:
             ttft_target=ttft_target, tpot_target=tpot_target)
         self._next_rid += 1
         rr.t_submit = time.perf_counter()
+        rr.t_requeued = rr.t_submit
         self.pending.append(rr)
         self._m_pending.set(len(self.pending))
+        self.tracer.event(
+            rr.rid, "enqueue", ts=rr.t_submit, priority=rr.priority,
+            prompt_tokens=len(rr.prompt_ids),
+            ttft_target=rr.ttft_target, tpot_target=rr.tpot_target)
         return rr.rid
 
     def has_work(self) -> bool:
@@ -514,8 +594,62 @@ class ServingRouter:
     def pop_result(self, rid: int) -> List[int]:
         """Consume one finished request's tokens (the streaming-driver
         API: read each rid from ``step()``'s return, pop it, and the
-        finished record stays flat regardless of run length)."""
+        finished record stays flat regardless of run length).  Drivers
+        that also want the latency numbers use :meth:`pop_record`."""
         return self.finished.pop(rid).output_ids
+
+    def pop_record(self, rid: int) -> RouterRequest:
+        """Consume one finished request's FULL record: tokens in
+        ``.output_ids`` plus the final per-request summary in
+        ``.summary`` (measured ttft, mean tpot, requeue count, engines
+        visited, SLO outcomes) — streaming drivers get the numbers
+        without scraping process-wide metrics.  Same bounded-`finished`
+        eviction semantics as :meth:`pop_result`."""
+        return self.finished.pop(rid)
+
+    def _publish_latency_gauges(self, digests: Optional[Dict] = None):
+        """Push the reservoir digests into the
+        ``router_latency_quantile_seconds{kind,q}`` gauges."""
+        for kind, res in (("ttft", self._ttft_res),
+                          ("tpot", self._tpot_res)):
+            d = (digests or {}).get(kind) or res.digest()
+            for tag in ("p50", "p95", "p99"):
+                if d[tag] is not None:
+                    self._latq_children[(kind, tag)].set(d[tag])
+
+    def slo_snapshot(self) -> Dict[str, Dict]:
+        """Per-kind attainment counts + bounded-reservoir latency
+        digests (p50/p95/p99) over THIS router's completed requests —
+        the ``health_payload()``/``/healthz`` SLO block, and the
+        completeness gate's arithmetic source (for each kind the
+        outcome counts sum to completed admissions).  Reading a
+        snapshot also refreshes the quantile gauges, so a Prometheus
+        scrape taken through any health path is exact."""
+        out = {}
+        for kind, res in (("ttft", self._ttft_res),
+                          ("tpot", self._tpot_res)):
+            d = {o: self._slo_counts[(kind, o)]
+                 for o in ("attained", "missed", "no_target")}
+            d.update(res.digest())
+            out[kind] = d
+        self._publish_latency_gauges(out)
+        return out
+
+    def health_payload(self) -> Dict:
+        """Fleet-level load/health snapshot (the router-side twin of
+        the engine's ``health_payload``): queue depths, healthy-engine
+        count, and the SLO attainment digests.  Install as the
+        process's health provider (``observability.set_health_provider(
+        router.health_payload)``) and ``/healthz`` serves it."""
+        return {
+            "router": 1,
+            "pending": len(self.pending),
+            "inflight": len(self._inflight),
+            "engines": len(self.handles),
+            "engines_healthy": sum(1 for h in self.handles.values()
+                                   if h.healthy),
+            "slo": self.slo_snapshot(),
+        }
 
     # ---- health ---------------------------------------------------------
     def mark_unhealthy(self, engine_id: int):
@@ -587,12 +721,33 @@ class ServingRouter:
         router-side record and put the request back in the pending
         queue (or finish it, if those tokens already met the budget or
         hit EOS)."""
+        # the first token may have landed on the engine we are leaving
+        # without a _sync_first_tokens pass seeing it (preempt/loss
+        # between steps): capture its mark off the live engine request
+        # BEFORE dropping it, or the measured TTFT would drift to the
+        # completion fallback
+        if not rr.t_first_token and (gen or rr.base_output):
+            t_ft = getattr(rr.engine_req, "t_first_token", 0.0) or 0.0
+            rr.t_first_token = t_ft or time.perf_counter()
+            self.tracer.event(rr.rid, "first_token",
+                              ts=rr.t_first_token,
+                              ttft=rr.t_first_token - rr.t_submit)
+        now = time.perf_counter()
+        left_engine = -1
+        if rr.hops and rr.hops[-1][3] is None:
+            rr.hops[-1][3] = now
+            left_engine = rr.hops[-1][0]
+            self.tracer.span(rr.rid, "on_engine", rr.hops[-1][2], now,
+                             engine=left_engine)
+        rr.t_requeued = now
         rr.base_output.extend(int(t) for t in gen)
         rr.key_cache.clear()            # resume prompt just grew
         rr.engine_id = -1
         rr.engine_req_id = -1
         rr.engine_req = None
         rr.requeues += 1
+        self.tracer.event(rr.rid, "requeue", ts=now, reason=reason,
+                          engine=left_engine, tokens=len(gen))
         self._m_requeues.labels(reason=reason).inc()
         hit_eos = (rr.eos_token_id is not None and rr.base_output
                    and rr.base_output[-1] == rr.eos_token_id)
@@ -745,6 +900,10 @@ class ServingRouter:
             if not placed:
                 if hold is not None:
                     rr.affinity_waited += 1
+                    self.tracer.event(
+                        rr.rid, "affinity_hold",
+                        engine=hold.engine_id,
+                        hold_round=rr.affinity_waited)
                 leftover.append(rr)
         # preemption victims appended themselves to self.pending
         self.pending = leftover + self.pending
@@ -771,6 +930,20 @@ class ServingRouter:
         # drain fallback)
         rr.engine_req = h.engine.waiting[-1] if h.engine.waiting else None
         rr.routed_by_prefix = match > 0
+        now = time.perf_counter()
+        rr.hops.append([h.engine_id, erid, now, None])
+        if self.tracer.enabled:
+            # ONE record: a "dispatch" SPAN covering the pending wait
+            # (submit..dispatch / requeue..re-dispatch — the tile the
+            # chain validator checks) whose args carry the route
+            # decision and its affinity outcome
+            outcome = ("prefix" if match > 0 else
+                       "random" if self.route_policy == "random" else
+                       "spilled" if rr.affinity_waited else
+                       "least_loaded")
+            self.tracer.span(rr.rid, "dispatch", rr.t_requeued, now,
+                             engine=h.engine_id, match_tokens=match,
+                             route=outcome, requeues=rr.requeues)
         if match > 0:
             self._m_prefix_hits.inc()
         bs = getattr(h.engine, "block_size", 0)
@@ -794,6 +967,9 @@ class ServingRouter:
             if ereq is not None and ereq.output_ids:
                 rr.t_first_token = (ereq.t_first_token
                                     or time.perf_counter())
+                self.tracer.event(rr.rid, "first_token",
+                                  ts=rr.t_first_token,
+                                  ttft=rr.t_first_token - rr.t_submit)
 
     def _complete(self, rr: RouterRequest, ereq) -> None:
         rr.output_ids = rr.base_output + (list(ereq.output_ids)
@@ -804,10 +980,78 @@ class ServingRouter:
         if not rr.t_first_token:
             rr.t_first_token = (getattr(ereq, "t_first_token", 0.0)
                                 or rr.t_done)
+            self.tracer.event(rr.rid, "first_token",
+                              ts=rr.t_first_token,
+                              ttft=rr.t_first_token - rr.t_submit)
+        if rr.hops and rr.hops[-1][3] is None:
+            # close the final engine segment (a request finishing
+            # through the requeue path closed it there already)
+            rr.hops[-1][3] = rr.t_done
+            self.tracer.span(rr.rid, "on_engine", rr.hops[-1][2],
+                             rr.t_done, engine=rr.hops[-1][0])
         rr.engine_req = None
+        self._account_slo(rr)
         self.finished[rr.rid] = rr
         while len(self.finished) > self.max_finished:
             self.finished.popitem(last=False)
         self._done_backlog.append(rr.rid)
-        self._m_requests.labels(
-            outcome="truncated" if rr.truncated else "completed").inc()
+        outcome = "truncated" if rr.truncated else "completed"
+        self._m_requests.labels(outcome=outcome).inc()
+        self.tracer.event(
+            rr.rid, "finish", ts=rr.t_done, outcome=outcome,
+            tokens=len(rr.output_ids), requeues=rr.requeues,
+            ttft_outcome=rr.summary["slo"]["ttft"],
+            tpot_outcome=rr.summary["slo"]["tpot"])
+
+    def _account_slo(self, rr: RouterRequest) -> None:
+        """Judge the finished request's MEASURED latencies against its
+        declared targets, feed the reservoirs/quantile gauges, and
+        attach the per-request summary to the record.  Every completion
+        contributes exactly one outcome per kind, so for each kind the
+        attainment counters sum to completed admissions (the bench's
+        arithmetic gate)."""
+        n = len(rr.output_ids)
+        ttft = rr.t_first_token - rr.t_submit
+        if ttft < 0 or not n:
+            ttft = None                      # nothing ever streamed
+        mean_tpot = ((rr.t_done - rr.t_first_token) / (n - 1)
+                     if n > 1 and rr.t_first_token else None)
+        if ttft is None or rr.ttft_target is None:
+            ttft_out = "no_target" if rr.ttft_target is None else "missed"
+        else:
+            ttft_out = ("attained" if ttft <= rr.ttft_target
+                        else "missed")
+        if rr.tpot_target is None or mean_tpot is None:
+            # an unmeasurable TPOT (0/1-token output) has no per-token
+            # stream to judge — it counts as untargeted, keeping the
+            # per-kind sum equal to completions
+            tpot_out = "no_target"
+        else:
+            tpot_out = ("attained" if mean_tpot <= rr.tpot_target
+                        else "missed")
+        self._slo_counts[("ttft", ttft_out)] += 1
+        self._slo_counts[("tpot", tpot_out)] += 1
+        self._slo_children[("ttft", ttft_out)].inc()
+        self._slo_children[("tpot", tpot_out)].inc()
+        if ttft is not None:
+            self._ttft_res.add(ttft)
+        if mean_tpot is not None:
+            self._tpot_res.add(mean_tpot)
+        # quantile gauges are published every 16th completion (and on
+        # every slo_snapshot/health_payload read, which recomputes
+        # exactly): completions stay O(1) reservoir adds instead of
+        # six sorted-window passes each
+        self._completions += 1
+        if self._completions % 16 == 1:
+            self._publish_latency_gauges()
+        rr.summary = {
+            "tokens": n,
+            "ttft": ttft,
+            "mean_tpot": mean_tpot,
+            "requeues": rr.requeues,
+            "engines_visited": rr.engines_visited(),
+            "outcome": "truncated" if rr.truncated else "completed",
+            "ttft_target": rr.ttft_target,
+            "tpot_target": rr.tpot_target,
+            "slo": {"ttft": ttft_out, "tpot": tpot_out},
+        }

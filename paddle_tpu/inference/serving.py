@@ -200,6 +200,14 @@ class ContinuousBatchingEngine:
     quant modes need a compiled prefill path (``mixed_step=True`` or
     ``prefill_buckets``) — the legacy dense prefill runs eager fp
     math and is rejected at construction.
+
+    Request tracing (round 16): the engine owns a bounded
+    ``RequestTracer`` (``tracer=`` kwarg; default ON, ``False`` = the
+    no-op stub) recording typed per-request phase spans: enqueue,
+    admit (+prefix hit), per-chunk prefill, sampled decode steps,
+    first token, preempt, finish.  Host-side appends only, on the
+    shared ``perf_counter`` clock; a ``ServingRouter`` merges every
+    pool engine's spans into one fleet chrome trace (``fleet_trace``).
     """
 
     def __init__(self, model, max_batch_size: int = 8,
@@ -218,7 +226,8 @@ class ContinuousBatchingEngine:
                  quant_collectives: bool = False,
                  sampling: bool = False,
                  draft_model=None, spec_k: int = 2,
-                 engine_id: Optional[int] = None):
+                 engine_id: Optional[int] = None,
+                 tracer=None):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
         # identity for multi-engine deployments (the ServingRouter's
@@ -500,6 +509,17 @@ class ContinuousBatchingEngine:
         self._chunk_rr = 0           # round-robin cursor over chunk work
 
         from ..observability import default_registry
+        from ..observability.request_trace import resolve_tracer
+        # bounded per-request phase tracer (round 16): typed spans for
+        # admission, per-chunk prefill, sampled decode steps, first
+        # token, preempt and finish — host-side appends only, keyed by
+        # this engine's req_ids (a router merges them fleet-wide via
+        # fleet_trace).  Default ON; tracer=False is the no-op stub.
+        self.tracer = resolve_tracer(tracer)
+        # decode spans are SAMPLED (every Nth step per request) so a
+        # long generation neither floods the trace nor hits the
+        # per-request event cap
+        self.trace_decode_every = 8
         r = default_registry()
         self._m_queue = r.gauge(
             "serving_queue_depth", "requests waiting for a free slot")
@@ -796,6 +816,8 @@ class ContinuousBatchingEngine:
                 self.waiting.pop(i)
                 self._m_queue.set(len(self.waiting))
                 r.state = "preempted"
+                self.tracer.event(req_id, "preempt", from_state="waiting",
+                                  tokens=len(r.output_ids))
                 return r.prompt_ids, list(r.output_ids)
         for r in self.slots:
             if r is None or r.req_id != req_id:
@@ -803,6 +825,8 @@ class ContinuousBatchingEngine:
             self._release_slot(r)
             r.slot = -1
             r.state = "preempted"
+            self.tracer.event(req_id, "preempt", from_state="running",
+                              tokens=len(r.output_ids))
             return r.prompt_ids, list(r.output_ids)
         raise KeyError(
             "preempt_request(%r): request is neither waiting nor "
@@ -902,8 +926,9 @@ class ContinuousBatchingEngine:
 
         # ---- commit ---------------------------------------------------
         if self.prefix_cache is not None:
-            outcome = "hit" if matched else "miss"
-            self._m_prefix_lookups.labels(outcome=outcome).inc()
+            # literal label values: the metric lint pins label domains
+            self._m_prefix_lookups.labels(
+                outcome="hit" if matched else "miss").inc()
             if matched:
                 self.prefix_cache.hits += 1
                 self.prefix_cache.hit_tokens += hit_len
@@ -934,6 +959,12 @@ class ContinuousBatchingEngine:
         req.slot = slot
         req.state = "prefilling"
         self.slots[slot] = req
+        # ONE admission record (enqueue ts rides as an arg — the
+        # tracer is on the admission path, so records are budgeted)
+        self.tracer.event(req.req_id, "admit", slot=slot,
+                          prefix_hit_tokens=hit_len,
+                          prompt_tokens=L,
+                          enqueue_ts=req.t_submit)
         if self.sampling:
             self._samp[slot] = self._samp_row(req)
         if self.mixed is not None:
@@ -974,9 +1005,12 @@ class ContinuousBatchingEngine:
         # [1, V] (let alone [1, L, V]) logits
         first = int(jnp.argmax(
             logits._value[0, -1, :].astype(jnp.float32)))
+        t_end = time.perf_counter()
         if L in self._prefill_warm_lens:
-            self._m_prefill.observe(time.perf_counter() - t_prefill)
+            self._m_prefill.observe(t_end - t_prefill)
         self._prefill_warm_lens.add(L)
+        self.tracer.span(req.req_id, "prefill_dense", t_prefill, t_end,
+                         tokens=L)
         req.prefill_pos = L
         self._complete_prefill(req, first, row)
 
@@ -1033,12 +1067,16 @@ class ContinuousBatchingEngine:
         if self.tp is not None:
             self._count_collectives(
                 self.prefill_step.collective_bytes(bucket))
+        t_end = time.perf_counter()
         if traced:
             # first compile of this bucket: count it, keep the warmup
             # out of the latency histogram
             self._m_prefill_compiles.inc(traced)
         else:
-            self._m_prefill.observe(time.perf_counter() - t0)
+            self._m_prefill.observe(t_end - t0)
+        self.tracer.span(req.req_id, "prefill_chunk", t0, t_end,
+                         offset=start, tokens=size,
+                         warm=not traced)
         req.prefill_pos += size
         if req.prefill_pos >= L:
             self._complete_prefill(req, first, row)
@@ -1100,12 +1138,19 @@ class ContinuousBatchingEngine:
         # the call is the device barrier, so this window is honest
         nxt = self.decode_step(self._tokens, self._seq_lens, self._bt,
                                self._samp if self.sampling else None)
+        t_end = time.perf_counter()
         if self._decode_warm:
-            self._m_decode.observe(time.perf_counter() - t_decode)
+            self._m_decode.observe(t_end - t_decode)
         self._decode_warm = True
         if self.tp is not None:
             self._count_collectives(
                 self.decode_step.collective_bytes(self.max_batch_size))
+        if self.tracer.enabled:
+            for r in self.slots:
+                if r is not None and r.state == "running":
+                    self.tracer.sample_span(
+                        r.req_id, "decode_step", t_decode, t_end,
+                        every=self.trace_decode_every)
         for i, r in enumerate(list(self.slots)):
             if r is None or r.state != "running":
                 continue
@@ -1257,6 +1302,18 @@ class ContinuousBatchingEngine:
                 self._m_decode.observe(dt)
             if n_pre:
                 self._m_prefill.observe(dt)
+        if self.tracer.enabled:
+            # every span in the pack shares the one launch window
+            t1 = t0 + dt
+            for r, kind, size, start in spans:
+                if kind == "decode":
+                    self.tracer.sample_span(
+                        r.req_id, "decode_step", t0, t1,
+                        every=self.trace_decode_every)
+                else:
+                    self.tracer.span(r.req_id, "prefill_chunk", t0, t1,
+                                     offset=start, tokens=size,
+                                     warm=not traced)
 
         for si, (r, kind, size, start) in enumerate(spans):
             tok = int(nxt[si])
@@ -1462,6 +1519,19 @@ class ContinuousBatchingEngine:
                 self._m_prefill.observe(dt)
         if n_pre:
             self._m_mixed_tok_prefill.inc(n_pre)
+        if self.tracer.enabled:
+            # one verify launch advanced every slot (and the chunk
+            # mirrors): sampled decode spans + chunk spans share its
+            # window, exactly like the non-speculative mixed step
+            t1 = t0 + dt
+            for r, _k in run_spans:
+                self.tracer.sample_span(
+                    r.req_id, "decode_step", t0, t1,
+                    every=self.trace_decode_every, speculative=True)
+            for r, size, start in chunk_spans:
+                self.tracer.span(r.req_id, "prefill_chunk", t0, t1,
+                                 offset=start, tokens=size,
+                                 warm=not traced)
 
         emitted = 0
         for si, (r, toks, start, nd, _x, _m) in enumerate(v_spans):
@@ -1533,6 +1603,10 @@ class ContinuousBatchingEngine:
             req.t_first_token = time.perf_counter()
             if req.t_submit:
                 self._m_ttft.observe(req.t_first_token - req.t_submit)
+            self.tracer.event(
+                req.req_id, "first_token", ts=req.t_first_token,
+                ttft=(req.t_first_token - req.t_submit
+                      if req.t_submit else 0.0))
         hit_eos = (req.eos_token_id is not None
                    and token == req.eos_token_id)
         if len(req.output_ids) >= req.max_new_tokens or hit_eos:
@@ -1571,5 +1645,8 @@ class ContinuousBatchingEngine:
         if n_tok > 1 and req.t_first_token:
             self._m_tpot.observe(
                 (req.t_done - req.t_first_token) / (n_tok - 1))
+        self.tracer.event(
+            req.req_id, "finish", ts=req.t_done, tokens=n_tok,
+            outcome="truncated" if req.truncated else "completed")
         self._release_slot(req)
         self.finished[req.req_id] = req

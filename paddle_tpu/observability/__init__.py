@@ -27,7 +27,11 @@ ContinuousBatchingEngine  serving_queue_depth, serving_slot_occupancy_ratio,
                           serving_truncated_victims_total
 ServingRouter             router_requests_total, router_pending_depth,
                           router_prefix_route_hits_total,
-                          router_requeues_total, router_engine_healthy
+                          router_requeues_total, router_engine_healthy,
+                          router_slo_attained_total,
+                          router_latency_quantile_seconds
+RequestTracer             request_trace_spans_total,
+                          request_trace_dropped_spans_total
 CheckpointManager         checkpoint_save_duration_seconds,
                           checkpoint_written_bytes_total,
                           checkpoint_commits_total,
@@ -49,6 +53,10 @@ from .telemetry import (StepTelemetry, device_peak_flops,
                         PEAK_FLOPS_ENV)
 from .trace_merge import (SpanLog, span_log, record_span, record_instant,
                           merge_chrome_trace, load_device_trace_events)
+from .request_trace import (RequestTracer, NullRequestTracer,
+                            NULL_TRACER, resolve_tracer,
+                            LatencyReservoir, validate_span_chain,
+                            fleet_trace)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "MetricError",
@@ -61,4 +69,7 @@ __all__ = [
     "CHECK_NAN_ENV", "PEAK_FLOPS_ENV",
     "SpanLog", "span_log", "record_span", "record_instant",
     "merge_chrome_trace", "load_device_trace_events",
+    "RequestTracer", "NullRequestTracer", "NULL_TRACER",
+    "resolve_tracer", "LatencyReservoir", "validate_span_chain",
+    "fleet_trace",
 ]

@@ -126,6 +126,33 @@ class _HistogramChild:
             self._sum += v
             self._count += 1
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile by linear interpolation over the fixed
+        buckets (``histogram_quantile`` semantics): find the bucket the
+        rank ``q * count`` falls in, interpolate linearly inside it.
+        The +Inf bucket has no upper edge — mass there reports the
+        highest finite boundary (the estimate saturates, it never
+        invents values).  NaN when nothing was observed."""
+        q = min(1.0, max(0.0, float(q)))
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return float("nan")
+        rank = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            prev, acc = acc, acc + c
+            if c and acc >= rank:
+                if i >= len(self._bounds):          # +Inf bucket
+                    return float(self._bounds[-1])
+                hi = float(self._bounds[i])
+                lo = float(self._bounds[i - 1]) if i > 0 \
+                    else min(0.0, hi)
+                frac = min(1.0, max(0.0, (rank - prev) / c))
+                return lo + (hi - lo) * frac
+        return float(self._bounds[-1])
+
     # prometheus exposition is CUMULATIVE per bucket
     def cumulative(self) -> List[int]:
         out, acc = [], 0
@@ -256,6 +283,12 @@ class Histogram(_Metric):
 
     def observe(self, value: float):
         self._need_default().observe(value)
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile from the fixed buckets (linear
+        interpolation inside the containing bucket; labeled metrics:
+        ``.labels(...).quantile(q)``)."""
+        return self._need_default().quantile(q)
 
     @property
     def sum(self) -> float:

@@ -34,34 +34,47 @@ _SPAN_LOG_CAP = 16384
 
 class SpanLog:
     """Bounded in-memory log of runtime spans/instants (step markers,
-    checkpoint writes, watchdog timeouts).  Appends are one deque.append
-    — cheap enough for per-step use; the cap drops the OLDEST entries so
-    a week-long job keeps the recent window."""
+    checkpoint writes, watchdog timeouts).  An append is one lock + one
+    deque.append — cheap enough for per-step use; the cap drops the
+    OLDEST entries so a week-long job keeps the recent window.  The
+    append AND the eviction run under one lock: concurrent writers
+    (train thread + checkpoint writer + watchdog) can never race the
+    bound past ``maxlen`` or drop each other's fresh entries."""
 
     def __init__(self, maxlen: int = _SPAN_LOG_CAP):
-        self._events: "collections.deque" = collections.deque(
-            maxlen=maxlen)
+        self._maxlen = max(1, int(maxlen))
+        self._events: "collections.deque" = collections.deque()
+        self._lock = threading.Lock()
+
+    def _append(self, entry: tuple):
+        with self._lock:
+            self._events.append(entry)
+            while len(self._events) > self._maxlen:
+                self._events.popleft()
 
     def record(self, name: str, start: float, end: float,
                cat: str = "runtime", **args):
         """A completed span; start/end are time.perf_counter seconds."""
-        self._events.append(("X", name, cat, start, end, args,
-                             threading.get_ident()))
+        self._append(("X", name, cat, start, end, args,
+                      threading.get_ident()))
 
     def instant(self, name: str, ts: Optional[float] = None,
                 cat: str = "runtime", **args):
         t = time.perf_counter() if ts is None else ts
-        self._events.append(("i", name, cat, t, t, args,
-                             threading.get_ident()))
+        self._append(("i", name, cat, t, t, args,
+                      threading.get_ident()))
 
     def events(self) -> List[tuple]:
-        return list(self._events)
+        with self._lock:
+            return list(self._events)
 
     def clear(self):
-        self._events.clear()
+        with self._lock:
+            self._events.clear()
 
     def __len__(self):
-        return len(self._events)
+        with self._lock:
+            return len(self._events)
 
 
 # process-wide log every wired subsystem appends to
@@ -201,9 +214,33 @@ def _device_events_json(events: List[dict], pid_base: int) -> List[dict]:
     return out
 
 
+def _extra_group_json(name: str, group_events: List[dict], pid: int,
+                      t0: Optional[float]) -> List[dict]:
+    """One caller-built track group (e.g. a request tracer's events):
+    chrome dicts whose ``ts``/``dur`` are ABSOLUTE perf_counter seconds
+    — this shifts them onto the shared t0 and scales to µs, assigns the
+    group's pid, and appends its process_name metadata."""
+    if not group_events:
+        return []
+    base = t0 or 0.0
+    out = []
+    for e in group_events:
+        ev = dict(e)
+        ev["pid"] = pid
+        if "ts" in ev:
+            ev["ts"] = (float(ev["ts"]) - base) * 1e6
+        if "dur" in ev:
+            ev["dur"] = float(ev["dur"]) * 1e6
+        out.append(ev)
+    out.append({"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": name}})
+    return out
+
+
 def merge_chrome_trace(path: str, host_events=None,
                        runtime_events=None,
-                       device_trace_dir: Optional[str] = None) -> str:
+                       device_trace_dir: Optional[str] = None,
+                       extra_groups=None) -> str:
     """Write one chrome://tracing JSON folding host RecordEvent spans,
     the runtime span log, and the device trace (if any) — the
     observability subsystem's single-timeline artifact.
@@ -213,6 +250,16 @@ def merge_chrome_trace(path: str, host_events=None,
     to the process-wide :data:`span_log`.
     device_trace_dir: a ``jax.profiler`` trace dir; missing/empty dirs
     degrade to a host-only trace (the device-less CPU contract).
+    extra_groups: ``[(process_name, chrome_event_dicts)]`` — additional
+    track groups (one pid each) whose ``ts``/``dur`` are ABSOLUTE
+    perf_counter seconds on the same clock as the host/runtime spans;
+    the fleet request tracer (``request_trace.fleet_trace``) feeds the
+    router's and every engine's request lanes through this.
+
+    Output ordering is DETERMINISTIC: non-metadata events sort by
+    ``(ts, pid, tid, name)`` — two spans sharing a timestamp always
+    serialize in the same order, so traces diff cleanly across runs —
+    with metadata after (the first traceEvent stays a real span).
     """
     if runtime_events is None:
         runtime_events = span_log
@@ -221,18 +268,48 @@ def merge_chrome_trace(path: str, host_events=None,
     pid = os.getpid()
     host_events = list(host_events or [])
     runtime_events = list(runtime_events or [])
-    # host spans and runtime spans share the perf_counter clock: ONE t0
-    # across both, or a checkpoint 45s into the profile would render at
-    # t=0 next to the first host span
+    extra_groups = [(str(n), list(evs or []))
+                    for n, evs in (extra_groups or [])]
+    # host spans, runtime spans and extra groups share the perf_counter
+    # clock: ONE t0 across all of them, or a checkpoint 45s into the
+    # profile would render at t=0 next to the first host span
     starts = [e.start for e in host_events] \
         + [e[3] for e in runtime_events]
+    for _name, evs in extra_groups:
+        starts += [float(e["ts"]) for e in evs if "ts" in e]
     t0 = min(starts) if starts else None
     events: List[dict] = []
     events.extend(_host_events_json(host_events, pid, t0))
     events.extend(_span_log_events_json(runtime_events, pid + 1, t0))
+    for i, (name, evs) in enumerate(extra_groups):
+        events.extend(_extra_group_json(name, evs, pid + 2 + i, t0))
     events.extend(_device_events_json(
         load_device_trace_events(device_trace_dir), 1_000_000))
-    out = {"displayTimeUnit": "ms", "traceEvents": events}
+    # deterministic serialization: spans by (ts, pid, tid, name) —
+    # ties included — then metadata (tools that peek at traceEvents[0]
+    # must still see a real span)
+    spans = [e for e in events if e.get("ph") != "M"]
+    meta = [e for e in events if e.get("ph") == "M"]
+
+    def _num(v):
+        # device traces may carry non-numeric ids: numbers sort
+        # numerically, anything else sorts after them as text — the
+        # key never raises and stays deterministic either way
+        try:
+            return (0, float(v), "")
+        except (TypeError, ValueError):
+            return (1, 0.0, str(v))
+
+    def _order(e):
+        try:
+            ts = float(e.get("ts", 0.0))
+        except (TypeError, ValueError):
+            ts = 0.0
+        return (ts, _num(e.get("pid", 0)), _num(e.get("tid", 0)),
+                str(e.get("name", "")))
+
+    spans.sort(key=_order)
+    out = {"displayTimeUnit": "ms", "traceEvents": spans + meta}
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:

@@ -245,6 +245,131 @@ def test_merge_chrome_trace_host_only_roundtrip(tmp_path):
     assert all(e["pid"] < 1_000_000 for e in evs)
 
 
+def test_histogram_quantile_pins_against_numpy():
+    """Satellite (round 16): Histogram.quantile — linear interpolation
+    over the fixed buckets — tracks numpy within one bucket width on a
+    known sample, is monotone in q, and saturates at the top finite
+    boundary for +Inf-bucket mass."""
+    reg = MetricsRegistry()
+    buckets = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+    h = reg.histogram("q_seconds", "", buckets=buckets)
+    rng = np.random.RandomState(7)
+    sample = rng.gamma(2.0, 0.05, size=2000)       # latency-shaped
+    for v in sample:
+        h.observe(float(v))
+    bounds = (0.0,) + buckets
+    for q in (0.1, 0.5, 0.9, 0.95, 0.99):
+        est = h.quantile(q)
+        true = float(np.quantile(sample, q))
+        # tolerance: the width of the bucket containing the true value
+        i = int(np.searchsorted(buckets, true))
+        i = min(i, len(buckets) - 1)
+        width = buckets[i] - bounds[i]
+        assert abs(est - true) <= width, (q, est, true, width)
+    qs = [h.quantile(q) for q in (0.05, 0.25, 0.5, 0.75, 0.95)]
+    assert qs == sorted(qs)                        # monotone
+    # empty histogram -> NaN; all-overflow mass saturates at the top
+    h2 = reg.histogram("q2_seconds", "", buckets=(1.0, 2.0))
+    assert h2.quantile(0.5) != h2.quantile(0.5)    # NaN
+    for _ in range(5):
+        h2.observe(100.0)
+    assert h2.quantile(0.99) == 2.0
+    # labeled children estimate independently
+    hl = reg.histogram("q3_seconds", "", labels=("kind",),
+                       buckets=(1.0, 2.0, 4.0))
+    for _ in range(10):
+        hl.labels(kind="decode").observe(0.5)
+        hl.labels(kind="prefill").observe(3.0)
+    assert hl.labels(kind="decode").quantile(0.5) <= 1.0
+    assert hl.labels(kind="prefill").quantile(0.5) > 2.0
+
+
+def test_span_log_bound_holds_under_concurrent_writers():
+    """Satellite (round 16): the append+evict runs under one lock —
+    hammering a small SpanLog from several threads never overshoots
+    the bound and never corrupts entries."""
+    log = SpanLog(maxlen=64)
+    n_threads, per_thread = 8, 500
+    errs = []
+
+    def writer(tid):
+        try:
+            for i in range(per_thread):
+                log.record("w%d" % tid, float(i), float(i) + 0.5,
+                           idx=i)
+                if i % 7 == 0:
+                    log.instant("i%d" % tid, ts=float(i))
+        except Exception as e:                    # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(log) == 64                          # exactly the bound
+    evs = log.events()
+    assert len(evs) == 64
+    # entries are intact tuples (no torn writes)
+    for ph, name, cat, start, end, args, ident in evs:
+        assert ph in ("X", "i") and isinstance(args, dict)
+    log.clear()
+    assert len(log) == 0
+
+
+def test_merge_chrome_trace_deterministic_tie_order(tmp_path):
+    """Satellite (round 16): two spans sharing a timestamp serialize in
+    (pid, tid, name) order — byte-identical output across runs."""
+    from paddle_tpu.profiler import _HostEvent
+    t = time.perf_counter()
+    host = [_HostEvent("zeta", t, t + 0.1, 5),
+            _HostEvent("alpha", t, t + 0.1, 3)]   # same ts, two tids
+    log = SpanLog()
+    log.record("mid", t, t + 0.05)                # same ts, higher pid
+    out1 = merge_chrome_trace(str(tmp_path / "a.json"),
+                              host_events=host, runtime_events=log)
+    out2 = merge_chrome_trace(str(tmp_path / "b.json"),
+                              host_events=list(reversed(host)),
+                              runtime_events=log)
+    d1, d2 = json.load(open(out1)), json.load(open(out2))
+    # identical content regardless of input order
+    assert d1["traceEvents"] == d2["traceEvents"]
+    spans = [e for e in d1["traceEvents"] if e["ph"] != "M"]
+    keys = [(e["ts"], e["pid"], e["tid"], e["name"]) for e in spans]
+    assert keys == sorted(keys)
+    # metadata still trails, first event is a real span
+    assert d1["traceEvents"][0]["ph"] != "M"
+    assert d1["traceEvents"][-1]["ph"] == "M"
+
+
+def test_merge_chrome_trace_extra_groups(tmp_path):
+    """extra_groups render as their own pids on the SHARED clock (the
+    fleet_trace transport)."""
+    t = time.perf_counter()
+    log = SpanLog()
+    log.record("runtime_span", t, t + 0.01)
+    group = [{"name": "req 0", "cat": "request", "ph": "X",
+              "tid": 0, "ts": t + 1.0, "dur": 0.5},
+             {"name": "thread_name", "ph": "M", "tid": 0,
+              "args": {"name": "req 0"}}]
+    out = merge_chrome_trace(str(tmp_path / "g.json"),
+                             runtime_events=log,
+                             extra_groups=[("engine 9", group)])
+    data = json.load(open(out))
+    names = {e["args"]["name"] for e in data["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert "engine 9" in names
+    span = next(e for e in data["traceEvents"] if e["name"] == "req 0")
+    rt = next(e for e in data["traceEvents"]
+              if e["name"] == "runtime_span")
+    # one clock: the request span sits 1s after the runtime span
+    assert abs((span["ts"] - rt["ts"]) - 1.0 * 1e6) < 1e3
+    assert span["dur"] == pytest.approx(0.5 * 1e6)
+    assert span["pid"] != rt["pid"]
+
+
 def test_merge_chrome_trace_with_runtime_spans(tmp_path):
     from paddle_tpu.profiler import _HostEvent
     log = SpanLog()
@@ -308,6 +433,38 @@ def test_metric_name_lint():
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "0 violations" in proc.stdout
+
+
+def test_metric_label_cardinality_lint_rejects_bad_sites():
+    """Round-16 satellite: the label-cardinality rule — undeclared
+    label names, out-of-domain literal values, and per-request-id
+    value expressions are all violations; declared-dynamic labels
+    (engine ids) pass."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from check_metric_names import lint_label_sites, _split_kwargs
+    finally:
+        sys.path.pop(0)
+    ok_sites = [
+        ("a.py", 1, "outcome", '"completed"'),
+        ("a.py", 2, "outcome", '"truncated" if x else "completed"'),
+        ("a.py", 3, "engine", "str(h.engine_id)"),
+        ("a.py", 4, "reason", "reason"),       # declared, no literal
+    ]
+    assert lint_label_sites(ok_sites) == []
+    bad = lint_label_sites([
+        ("b.py", 1, "request", "str(rr.rid)"),        # undeclared name
+        ("b.py", 2, "outcome", '"exploded"'),         # out of domain
+        ("b.py", 3, "engine", "str(req.req_id)"),     # per-request id
+        ("b.py", 4, "kind", "str(uuid.uuid4())"),     # uuid value
+    ])
+    assert len(bad) == 4
+    assert "not declared" in bad[0]
+    assert "outside its declared domain" in bad[1]
+    assert "per-request identifier" in bad[2]
+    # the kwarg splitter handles nesting + quoted commas
+    assert _split_kwargs('a="x,y", b=str(f(1, 2)), c=3') == [
+        ("a", '"x,y"'), ("b", "str(f(1, 2))"), ("c", "3")]
 
 
 # ---------------------------------------------------------------------------

@@ -41,63 +41,15 @@ sys.path.insert(0, ".")
 
 import jax  # noqa: E402
 
-import paddle_tpu as paddle  # noqa: E402
-from paddle_tpu.models import LlamaConfig  # noqa: E402
-from paddle_tpu.models.llama import (LlamaForCausalLM,  # noqa: E402
-                                     llama_tiny_config, param_count)
-from paddle_tpu.inference.serving import (  # noqa: E402
-    ContinuousBatchingEngine)
+from paddle_tpu.models.llama import param_count  # noqa: E402
 from paddle_tpu.inference.router import ServingRouter  # noqa: E402
+from tools.bench_common import (build_bench_model,  # noqa: E402
+                                eager_reference, make_engines,
+                                warm_engines)
 
-
-def build_model(on_tpu):
-    if on_tpu:
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
-            num_hidden_layers=20, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
-            dtype="bfloat16")
-    else:
-        cfg = llama_tiny_config()
-    paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
-    if cfg.dtype == "bfloat16":
-        model.bfloat16()
-    model.eval()
-    return cfg, model
-
-
-def _ref(model, prompt, budget):
-    out = model.generate(paddle.to_tensor(np.asarray(prompt)[None, :]),
-                         max_new_tokens=budget)
-    return np.asarray(out._value)[0, len(prompt):].tolist()
-
-
-def make_engines(model, n, knobs):
-    return [ContinuousBatchingEngine(
-        model, max_batch_size=knobs["slots"],
-        num_blocks=knobs["num_blocks"], block_size=knobs["block_size"],
-        mixed_step=True, prefill_chunk_size=knobs["chunk"],
-        enable_prefix_cache=True) for _ in range(n)]
-
-
-def warm_engines(model, engines, knobs, vocab):
-    """Compile warmup per ENGINE (each engine owns its own MixedStep
-    modules): run a couple of staggered requests shaped like the
-    measured workload straight through each engine, with token values
-    from a DISJOINT range so nothing lands in the measured prefix
-    families.  Cold budget compiles land here, not in a TTFT window."""
-    rng = np.random.RandomState(99)
-    L = knobs["prefix_len"] + knobs["suffix_len"]
-    for eng in engines:
-        r0 = eng.add_request(rng.randint(1, vocab, (L,)).astype(np.int64),
-                             max_new_tokens=knobs["budget"])
-        eng.step()
-        eng.add_request(
-            rng.randint(1, vocab, (knobs["suffix_len"],)).astype(np.int64),
-            max_new_tokens=knobs["budget"])
-        eng.run_to_completion()
-        del r0
+# one model/reference contract shared with tools/bench_trace.py (r16)
+build_model = build_bench_model
+_ref = eager_reference
 
 
 def shared_prefix_workload(knobs, vocab, families, per_family):
@@ -127,7 +79,7 @@ def bench_routing_arm(model, n_engines, policy, knobs, budget):
     checked against eager generate."""
     vocab = model.config.vocab_size
     engines = make_engines(model, n_engines, knobs)
-    warm_engines(model, engines, knobs, vocab)
+    warm_engines(engines, knobs, vocab)
     router = ServingRouter(engines, route_policy=policy, route_seed=23)
     work = shared_prefix_workload(knobs, vocab, knobs["families"],
                                   knobs["per_family"])
@@ -179,7 +131,7 @@ def bench_kill_drill(model, knobs, budget, n_requests):
     tokens vs the eager reference."""
     vocab = model.config.vocab_size
     engines = make_engines(model, 2, knobs)
-    warm_engines(model, engines, knobs, vocab)
+    warm_engines(engines, knobs, vocab)
     router = ServingRouter(engines)
     rng = np.random.RandomState(31)
     prompts = [rng.randint(

@@ -1,0 +1,403 @@
+"""Request-tracing bench: overhead + trace completeness + SLO sums.
+
+Round-16 tentpole artifact (BENCH_TRACE_r16.json):
+
+1. **Tracer overhead** on the r15 router bench workload (shared-prefix
+   families over a 2-engine mixed+prefix pool, affinity routing): ONE
+   warmed pool, the tracer TOGGLED between the real (default-ON)
+   instances and the no-op stub across interleaved waves
+   (on/off/off/on/...); gated on the trimmed mean of PER-WAVE paired
+   wall ratios (the arms run back-to-back within a wave, sharing its
+   machine-load phase; trimming drops bursty-neighbor waves; the
+   stub-vs-stub A/A floor measures ~0.2%).  Gate: overhead < 2%.
+
+2. **Kill-one-engine completeness drill**: requests with a mix of
+   declared TTFT/TPOT targets mid-flight on 2 engines; one engine's
+   ``step()`` starts raising.  Gates: zero drops + full budgets +
+   byte parity vs eager generate (the r15 contract still holds with
+   tracing on); EVERY dispatched request's span chain validates
+   gap-free (``validate_span_chain``) INCLUDING the cross-engine
+   requeue hop (>=1 request visited 2 engines); for each SLO kind the
+   attainment outcomes sum exactly to completed admissions.
+
+3. **Fleet trace artifact**: ``fleet_trace()`` over the drill's router
+   writes chrome JSON that parses, carries >= 2 engine track groups
+   (process_name metadata) and >= 1 cross-engine flow link (an s/f
+   pair spanning two engine pids).
+
+Model: the tiny llama config on CPU (artifact schema CI-checkable);
+the 1.1B bench line on TPU.  Run from the repo root; artifact path in
+argv[1] (default BENCH_TRACE_r16.json).  On any error ONE parseable
+failure-marker JSON line is emitted and the run exits 1.
+"""
+import gc
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+from paddle_tpu.models.llama import param_count  # noqa: E402
+from paddle_tpu.inference.router import ServingRouter  # noqa: E402
+from paddle_tpu.observability import (fleet_trace,  # noqa: E402
+                                      validate_span_chain)
+from tools.bench_common import (build_bench_model,  # noqa: E402
+                                eager_reference, make_engines,
+                                warm_engines)
+
+OVERHEAD_GATE = 0.02
+OVERHEAD_BUDGET = 32          # decode tokens/request in the overhead arm
+
+build_model = build_bench_model
+_ref = eager_reference
+
+
+def prefix_families(knobs, vocab, families, seed=17):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, (knobs["prefix_len"],))
+            .astype(np.int64) for _ in range(families)]
+
+
+def shared_prefix_wave(knobs, vocab, families, per_family, seed,
+                       fams=None):
+    """One wave of same-family requests: ``per_family`` fresh-suffix
+    variants of each prefix family.  Passing ``fams`` reuses a fixed
+    family set (the overhead arms must hit the SAME pre-seeded
+    prefixes — a wave that registers new families hands whichever arm
+    runs second a ~30% prefix-hit head start)."""
+    rng = np.random.RandomState(seed)
+    if fams is None:
+        fams = [rng.randint(1, vocab, (knobs["prefix_len"],))
+                .astype(np.int64) for _ in range(families)]
+    out = []
+    for prefix in fams:
+        for _ in range(per_family):
+            suffix = rng.randint(1, vocab,
+                                 (knobs["suffix_len"],)).astype(np.int64)
+            out.append(np.concatenate([prefix, suffix]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. overhead
+# ---------------------------------------------------------------------------
+def bench_overhead(model, knobs, budget, waves=9):
+    # NOTE on the budget: the overhead arm generates OVERHEAD_BUDGET
+    # tokens per request (2x the r15 bench's TPU budget) on every
+    # platform — at the CPU arm's 4-token budget a request is almost
+    # all admission, so the tracer's FIXED per-request records (~12:
+    # enqueue/route/dispatch/chunks/finish on two layers) measure
+    # against almost no decode, the one regime no real deployment
+    # runs.  Decode-heavy is what serving does; overhead is gated
+    # there, with per-record cost also bounded by the unit tests.
+    """ONE warmed 2-engine pool; the tracer toggles between the real
+    (default-ON) instances and the no-op stub across interleaved
+    waves — the r9 bench_observability design.  Toggling on the SAME
+    pool isolates exactly what the gate is about (the cost of
+    recording), instead of folding in compile-luck differences between
+    two separately-built pools (~3% wall on the tiny CPU model, an
+    order of magnitude above the tracer's own cost).  Reports median
+    wall per arm and the ratio."""
+    from paddle_tpu.observability import NULL_TRACER
+    vocab = model.config.vocab_size
+    engines = make_engines(model, 2, knobs, id_base=0)
+    warm_engines(engines, knobs, vocab)
+    router = ServingRouter(engines)
+    real = (router.tracer, [e.tracer for e in engines])
+
+    def set_arm(on: bool):
+        router.tracer = real[0] if on else NULL_TRACER
+        for e, tr in zip(engines, real[1]):
+            e.tracer = tr if on else NULL_TRACER
+
+    # pre-seed the prefix families once so EVERY measured run — either
+    # arm, either within-wave position — serves the same mostly-hit
+    # steady state; each run then gets FRESH suffixes on those families
+    # (a wave introducing new families would hand whichever arm runs
+    # second its registration work for free)
+    fams = prefix_families(knobs, vocab, knobs["families"])
+    for p in shared_prefix_wave(knobs, vocab, knobs["families"], 1,
+                                seed=39, fams=fams):
+        router.submit(p, max_new_tokens=knobs["budget"])
+    router.run_to_completion()
+    for rid in list(router.finished):
+        router.pop_record(rid)
+    # double-length waves: per-wave scheduler jitter is an absolute
+    # few-ms cost, so longer waves shrink it RELATIVE to the signal
+    per_family = 2 * knobs["per_family"]
+    times = {"on": [], "off": []}
+    for w in range(waves):
+        # strict within-wave alternation of who goes first: warm-drift
+        # across waves cancels between the arms
+        for pos, arm in enumerate(("on", "off") if w % 2 == 0
+                                  else ("off", "on")):
+            prompts = shared_prefix_wave(
+                knobs, vocab, knobs["families"], per_family,
+                seed=100 + 2 * w + pos, fams=fams)
+            set_arm(arm == "on")
+            # start every timed window at the same GC state: a gen2
+            # collection scans the whole jax-laden heap (~50ms, far
+            # above the tracer's own cost) and would otherwise land in
+            # a random arm's window; the tracer's OWN allocation churn
+            # (gen0/1 pauses) still lands inside the window — honest
+            gc.collect()
+            t0 = time.perf_counter()
+            rids = [router.submit(p, max_new_tokens=OVERHEAD_BUDGET)
+                    for p in prompts]
+            router.run_to_completion()
+            times[arm].append(time.perf_counter() - t0)
+            for rid in rids:
+                router.pop_record(rid)       # keep `finished` flat
+    set_arm(True)
+    # the gated estimator is the TRIMMED MEAN of per-wave paired
+    # ratios: within a wave the two arms run back-to-back, sharing
+    # that wave's machine-load phase; trimming the top/bottom quarter
+    # drops the bursty-neighbor waves a shared CI box produces in
+    # either direction (the stub-vs-stub A/A floor measures ~0.2%);
+    # arm medians/mins reported for context
+    ratios = sorted(a / max(1e-12, b)
+                    for a, b in zip(times["on"], times["off"]))
+    trim = len(ratios) // 4
+    kept = ratios[trim:len(ratios) - trim] or ratios
+    overhead = sum(kept) / len(kept) - 1.0
+    med_on = statistics.median(times["on"])
+    med_off = statistics.median(times["off"])
+    min_on, min_off = min(times["on"]), min(times["off"])
+    # the traced waves actually recorded full chains
+    traced_reqs = len(real[0].request_ids())
+    return {
+        "waves": waves,
+        "budget": OVERHEAD_BUDGET,
+        "requests_per_wave": knobs["families"] * per_family,
+        "median_wall_on_s": round(med_on, 4),
+        "median_wall_off_s": round(med_off, 4),
+        "min_wall_on_s": round(min_on, 4),
+        "min_wall_off_s": round(min_off, 4),
+        "min_overhead_ratio": round(min_on / max(1e-12, min_off)
+                                    - 1.0, 4),
+        "arm_median_overhead_ratio": round(
+            med_on / max(1e-12, med_off) - 1.0, 4),
+        "per_wave_ratios": [round(r - 1.0, 4) for r in ratios],
+        "wall_on_s": [round(t, 4) for t in times["on"]],
+        "wall_off_s": [round(t, 4) for t in times["off"]],
+        "overhead_ratio": round(overhead, 4),
+        "overhead_gate": OVERHEAD_GATE,
+        "traced_requests": traced_reqs,
+        "method": "same-pool tracer toggle, waves interleaved; "
+                  "gate on trimmed mean of per-wave paired ratios",
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. kill-drill completeness + fleet trace
+# ---------------------------------------------------------------------------
+def bench_kill_drill_completeness(model, knobs, budget, n_requests,
+                                  trace_path):
+    vocab = model.config.vocab_size
+    engines = make_engines(model, 2, knobs, id_base=20)
+    warm_engines(engines, knobs, vocab)
+    router = ServingRouter(engines)
+    rng = np.random.RandomState(31)
+    prompts = [rng.randint(
+        1, vocab, (knobs["prefix_len"] + knobs["suffix_len"],))
+        .astype(np.int64) for _ in range(n_requests)]
+    rids = []
+    for i, p in enumerate(prompts):
+        # mix of SLO envelopes so every outcome bucket is exercised:
+        # generous targets (attained), impossible ones (missed), none
+        ttft = (60.0, 1e-9, None)[i % 3]
+        tpot = (60.0, 1e-9, None)[(i + 1) % 3]
+        rids.append(router.submit(p, max_new_tokens=budget,
+                                  ttft_target=ttft, tpot_target=tpot))
+    for _ in range(3):
+        router.step()
+    per_engine = {eid: 0 for eid in router.handles}
+    for (eid, _erid) in router._inflight:
+        per_engine[eid] += 1
+    victim_id = max(per_engine, key=lambda e: (per_engine[e], -e))
+    victim = router.handles[victim_id].engine
+
+    def _dead_step():
+        raise RuntimeError("injected engine loss")
+    victim.step = _dead_step
+    out = router.run_to_completion()
+
+    zero_drops = all(rid in out for rid in rids)
+    full_budget = all(len(out.get(rid, ())) == budget for rid in rids)
+    parity = all(out.get(rid) == _ref(model, p, budget)
+                 for rid, p in zip(rids, prompts))
+    # --- span-chain completeness -------------------------------------
+    chain_failures = []
+    for rid in rids:
+        ok, why = validate_span_chain(router.tracer.events(rid))
+        if not ok:
+            chain_failures.append({"rid": rid, "why": why})
+    hopped = [rid for rid in rids
+              if len(set(router.finished[rid].engines_visited())) > 1]
+    # --- SLO attainment arithmetic -----------------------------------
+    snap = router.slo_snapshot()
+    completions = len(rids)
+    slo_sums_ok = all(
+        sum(snap[kind][o] for o in ("attained", "missed", "no_target"))
+        == completions for kind in ("ttft", "tpot"))
+    outcomes_exercised = (snap["ttft"]["attained"] > 0
+                          and snap["ttft"]["missed"] > 0
+                          and snap["ttft"]["no_target"] > 0)
+    # --- fleet trace --------------------------------------------------
+    stats = fleet_trace(trace_path, router)
+    with open(trace_path) as f:
+        data = json.load(f)
+    evs = data.get("traceEvents", [])
+    groups = {e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "process_name"
+              and isinstance(e.get("args"), dict)}
+    engine_groups = sum(1 for g in groups if g.startswith("engine "))
+    flows = {}
+    for e in evs:
+        if e.get("cat") == "flow":
+            flows.setdefault(e["id"], []).append(e)
+    cross_flow_links = sum(
+        1 for fs in flows.values()
+        if {f["ph"] for f in fs} == {"s", "f"}
+        and len({f["pid"] for f in fs}) == 2)
+    chrome_valid = (data.get("displayTimeUnit") == "ms" and evs
+                    and evs[0].get("ph") != "M")
+    return {
+        "requests": n_requests,
+        "zero_drops": bool(zero_drops),
+        "full_budget": bool(full_budget),
+        "token_parity": bool(parity),
+        "requeued_requests": int(sum(router.finished[r].requeues
+                                     for r in rids)),
+        "cross_engine_requests": len(hopped),
+        "chain_failures": chain_failures,
+        "slo_snapshot": snap,
+        "slo_sums_equal_admissions": bool(slo_sums_ok),
+        "slo_outcomes_exercised": bool(outcomes_exercised),
+        "fleet_trace": {**stats,
+                        "chrome_valid": bool(chrome_valid),
+                        "engine_track_groups": engine_groups,
+                        "cross_engine_flow_links": cross_flow_links,
+                        "trace_events": len(evs)},
+    }
+
+
+def main(out_path):
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg, model = build_model(on_tpu)
+    if on_tpu:
+        knobs = dict(slots=4, num_blocks=512, block_size=16, chunk=64,
+                     prefix_len=192, suffix_len=32, families=6,
+                     per_family=4)
+        budget, kill_requests, waves = 16, 12, 21
+    else:
+        knobs = dict(slots=2, num_blocks=96, block_size=4, chunk=8,
+                     prefix_len=24, suffix_len=4, families=5,
+                     per_family=3)
+        # strict on/off alternation within each wave; per-wave paired
+        # ratios cancel warm-drift and load phases across arms — the
+        # A/A (stub-vs-stub) floor measures ~0.2%
+        budget, kill_requests, waves = 4, 9, 21
+    knobs["budget"] = budget
+
+    ok = True
+    gate_notes = []
+
+    overhead = bench_overhead(model, knobs, budget, waves=waves)
+    print("# overhead: median on=%.3fs off=%.3fs ratio=%.4f "
+          "(min ratio %.4f; gate < %.2f)"
+          % (overhead["median_wall_on_s"], overhead["median_wall_off_s"],
+             overhead["overhead_ratio"],
+             overhead["min_overhead_ratio"], OVERHEAD_GATE),
+          file=sys.stderr)
+    if overhead["overhead_ratio"] >= OVERHEAD_GATE:
+        ok = False
+        gate_notes.append("tracer overhead %.4f >= %.2f"
+                          % (overhead["overhead_ratio"], OVERHEAD_GATE))
+
+    trace_path = os.path.join(tempfile.gettempdir(),
+                              "fleet_trace_r16.json")
+    drill = bench_kill_drill_completeness(model, knobs, budget * 2,
+                                          kill_requests, trace_path)
+    ft = drill["fleet_trace"]
+    print("# drill: drops=%s parity=%s chains_ok=%s cross=%d "
+          "slo_sums=%s groups=%d flow_links=%d"
+          % (not drill["zero_drops"], drill["token_parity"],
+             not drill["chain_failures"], drill["cross_engine_requests"],
+             drill["slo_sums_equal_admissions"],
+             ft["engine_track_groups"], ft["cross_engine_flow_links"]),
+          file=sys.stderr)
+    if not (drill["zero_drops"] and drill["full_budget"]
+            and drill["token_parity"]):
+        ok = False
+        gate_notes.append("kill drill lost the r15 contract")
+    if drill["chain_failures"]:
+        ok = False
+        gate_notes.append("span chains incomplete: %r"
+                          % drill["chain_failures"][:3])
+    if drill["cross_engine_requests"] < 1:
+        ok = False
+        gate_notes.append("no request hopped engines in the drill")
+    if not (drill["slo_sums_equal_admissions"]
+            and drill["slo_outcomes_exercised"]):
+        ok = False
+        gate_notes.append("SLO attainment arithmetic failed: %r"
+                          % drill["slo_snapshot"])
+    if not (ft["chrome_valid"] and ft["engine_track_groups"] >= 2
+            and ft["cross_engine_flow_links"] >= 1):
+        ok = False
+        gate_notes.append("fleet trace gates failed: %r" % ft)
+
+    artifact = {
+        "metric": "tracer_overhead_ratio",
+        "value": overhead["overhead_ratio"],
+        "passed": ok,
+        "gate_notes": gate_notes,
+        "overhead": overhead,
+        "kill_drill": drill,
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "dtype": cfg.dtype,
+            **knobs,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "overhead_ratio",
+        "vs_baseline": (OVERHEAD_GATE - overhead["overhead_ratio"]
+                        if ok else 0.0),
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "BENCH_TRACE_r16.json"
+    try:
+        main(out)
+    except SystemExit:
+        raise
+    except Exception as e:                            # noqa: BLE001
+        print(json.dumps({
+            "metric": "tracer_overhead_ratio",
+            "value": 1.0,
+            "unit": "error",
+            "vs_baseline": 0.0,
+            "error": repr(e)[:300],
+        }), flush=True)
+        sys.exit(1)

@@ -19,7 +19,16 @@ repo's metric-naming contract:
 6. required families: the serving engine's contract metrics (the
    bucketed-prefill/prefix-cache set the round-10 bench gates on) must
    exist somewhere in the scan — a rename that silently drops one is an
-   error here, not a dashboard surprise.
+   error here, not a dashboard surprise;
+7. label CARDINALITY (round 16): every label name used at a
+   ``.labels(...)`` call site must be declared in ``LABEL_DOMAINS``
+   with a finite value set (or the DYNAMIC sentinel for label values
+   that are bounded by deployment shape, e.g. engine ids); literal
+   values must be members of the declared set, and any value
+   expression that smells of a per-request identifier (``req_id`` /
+   ``rid`` / ``request_id`` / ``uuid``) is rejected outright — a
+   per-request label value is an unbounded time-series leak, the one
+   mistake a metrics registry cannot survive in production.
 
 Pure stdlib + no jax import: safe to run anywhere, exits non-zero with
 one line per violation.
@@ -78,7 +87,139 @@ REQUIRED_NAMES = frozenset({
     "router_requeues_total",
     "router_engine_healthy",
     "router_pending_depth",
+    # request tracing + SLO attainment (round-16; BENCH_TRACE_r16.json)
+    "router_slo_attained_total",
+    "router_latency_quantile_seconds",
+    "request_trace_spans_total",
+    "request_trace_dropped_spans_total",
 })
+
+# ---------------------------------------------------------------------------
+# label-cardinality contract (round 16)
+# ---------------------------------------------------------------------------
+# sentinel: values are dynamic expressions but drawn from a set bounded
+# by deployment shape (engine ids = the pool size), never per-request
+DYNAMIC = object()
+
+# the ONE declaration of every label name's finite value domain; a
+# label name not in this table may not appear at any .labels() site
+LABEL_DOMAINS = {
+    "outcome": frozenset({"completed", "truncated", "rejected",
+                          "hit", "miss",
+                          "attained", "missed", "no_target"}),
+    "reason": frozenset({"preempt", "engine_lost"}),
+    "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
+    "op": frozenset({"psum", "all_gather"}),
+    "q": frozenset({"p50", "p95", "p99"}),
+    "engine": DYNAMIC,              # engine ids: bounded by pool size
+    "metric": DYNAMIC,              # bench line names: bounded by the
+                                    # bench's own mode set
+    "unit": DYNAMIC,                # bench units: one per bench line
+}
+
+# expressions that smell of per-request identity: unbounded cardinality
+_FORBIDDEN_VALUE_RE = re.compile(
+    r"\breq_id\b|\brequest_id\b|\brid\b|\buuid\b|\breq\.req_id\b",
+    re.IGNORECASE)
+
+# .labels( ... ) with one nesting level of parens inside (str(...) etc.)
+_LABELS_RE = re.compile(
+    r"\.labels\(\s*([^()]*(?:\([^()]*\)[^()]*)*)\)", re.DOTALL)
+
+_STR_LIT_RE = re.compile(r"""["']([^"']*)["']""")
+
+
+def _split_kwargs(arglist: str):
+    """Split a .labels(...) argument string on top-level commas,
+    yielding (name, expr) pairs; tolerant of nested parens/quotes."""
+    parts, depth, quote, cur = [], 0, None, []
+    for ch in arglist:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch in "([{":
+            depth += 1
+            cur.append(ch)
+        elif ch in ")]}":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    out = []
+    for p in parts:
+        if "=" not in p:
+            continue                       # positional/odd: skip
+        name, expr = p.split("=", 1)
+        out.append((name.strip(), expr.strip()))
+    return out
+
+
+def find_label_sites():
+    """[(relpath, lineno, label_name, value_expr)] for every kwarg of
+    every ``.labels(...)`` call under the scan roots."""
+    out = []
+    for top in SCAN:
+        path = os.path.join(REPO, top)
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = []
+            for root, _dirs, names in os.walk(path):
+                files += [os.path.join(root, n) for n in names
+                          if n.endswith(".py")]
+        for fpath in sorted(files):
+            if os.path.abspath(fpath) == os.path.abspath(__file__):
+                continue
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            rel = os.path.relpath(fpath, REPO)
+            for m in _LABELS_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                for name, expr in _split_kwargs(m.group(1)):
+                    out.append((rel, line, name, expr))
+    return out
+
+
+def lint_label_sites(sites):
+    """Violations of the label-cardinality contract (rule 7)."""
+    errors = []
+    for rel, line, name, expr in sites:
+        where = f"{rel}:{line}"
+        domain = LABEL_DOMAINS.get(name)
+        if domain is None:
+            errors.append(
+                f"{where}: label {name!r} is not declared in "
+                f"LABEL_DOMAINS — declare its finite value set (or "
+                f"DYNAMIC with a boundedness argument)")
+            continue
+        if _FORBIDDEN_VALUE_RE.search(expr):
+            errors.append(
+                f"{where}: label {name!r} value {expr!r} is derived "
+                f"from a per-request identifier — unbounded series "
+                f"cardinality")
+            continue
+        if domain is DYNAMIC:
+            continue
+        literals = _STR_LIT_RE.findall(expr)
+        for lit in literals:
+            if lit not in domain:
+                errors.append(
+                    f"{where}: label {name!r} value {lit!r} is outside "
+                    f"its declared domain {sorted(domain)}")
+    return errors
 
 
 def find_registrations() -> List[Tuple[str, int, str, str]]:
@@ -149,7 +290,7 @@ def lint(regs) -> List[str]:
 
 def main() -> int:
     regs = find_registrations()
-    errors = lint(regs)
+    errors = lint(regs) + lint_label_sites(find_label_sites())
     uniq = sorted({name for _, _, _, name in regs})
     if errors:
         for e in errors:
