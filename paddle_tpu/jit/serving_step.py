@@ -70,6 +70,18 @@ model's role) additionally returns each span's filtered proposal
 distribution, device-resident, for the verifier's residual.  All off
 by default — a default-config step's operand pytree and traced body
 are byte-identical to round 13.
+
+Kernel performance pass (round 17): every traced body routes the
+per-layer pre-attention transforms through the fused RoPE+QKV
+epilogue (``ops/pallas_kernels.rope_qkv_epilogue`` — rope(q), rope(k)
+and, on int8 pools, the per-token K/V absmax rows in ONE pass over
+the projection outputs; one Pallas kernel on TPU, a bit-identical XLA
+reference on CPU), with the cos/sin tables built once per step
+(``rope_tables_for_positions``) instead of once per layer.  The
+quantized writes consume the epilogue's absmax rows instead of
+re-reading k/v.  fp32 outputs are byte-identical to the round-16
+wiring; the attention kernels underneath gained double-buffered page
+DMA and the int8 MXU path (see BASELINE.md "round 17").
 """
 from __future__ import annotations
 
@@ -398,11 +410,11 @@ class PrefillStep:
 
     def _build(self, C: int):
         from ..autograd.tape import no_grad
-        from ..incubate.nn.functional import \
-            fused_rotary_position_embedding
         from ..ops.paged_attention import (chunk_prefill_attention,
                                            write_chunk_kv,
                                            write_chunk_kv_q8)
+        from ..ops.pallas_kernels import (rope_qkv_epilogue,
+                                          rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -432,7 +444,8 @@ class PrefillStep:
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
                 pos = start + jnp.arange(C, dtype=jnp.int32)
-                pos_t = Tensor._from_value(pos[None, :])     # [1, C]
+                cos_t, sin_t = rope_tables_for_positions(
+                    pos, D, cfg.rope_theta)
                 for li, (layer, kc, vc) in enumerate(
                         zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
@@ -440,24 +453,25 @@ class PrefillStep:
                     q = attn.q_proj(h).reshape([1, C, H, D])
                     k = attn.k_proj(h).reshape([1, C, Hkv, D])
                     v = attn.v_proj(h).reshape([1, C, Hkv, D])
-                    q, k, _ = fused_rotary_position_embedding(
-                        q, k, position_ids=pos_t,
-                        rotary_emb_base=cfg.rope_theta)
+                    qv, kv_, k_amax, v_amax = rope_qkv_epilogue(
+                        q._value[0], k._value[0], v._value[0],
+                        cos_t, sin_t, with_amax=quant_kv)
                     if quant_kv:
                         kc, vc, ks, vs = write_chunk_kv_q8(
-                            k._value, v._value, kc, vc, kss[li],
-                            vss[li], bt, start, n_valid, sink)
+                            kv_[None], v._value, kc, vc, kss[li],
+                            vss[li], bt, start, n_valid, sink,
+                            k_amax=k_amax, v_amax=v_amax)
                         new_kss.append(ks)
                         new_vss.append(vs)
                     else:
                         ks = vs = None
                         kc, vc = write_chunk_kv(
-                            k._value, v._value, kc, vc, bt, start,
+                            kv_[None], v._value, kc, vc, bt, start,
                             n_valid, sink)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
                     out = chunk_prefill_attention(
-                        q._value, kc, vc, bt, start, scale,
+                        qv[None], kc, vc, bt, start, scale,
                         key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(1, C, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
@@ -640,11 +654,11 @@ class MixedStep:
 
     def _build(self, T: int):
         from ..autograd.tape import no_grad
-        from ..incubate.nn.functional import \
-            fused_rotary_position_embedding
         from ..ops.paged_attention import (_ragged_attention_xla,
                                            write_ragged_kv,
                                            write_ragged_kv_q8)
+        from ..ops.pallas_kernels import (rope_qkv_epilogue,
+                                          rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -718,7 +732,11 @@ class MixedStep:
                 x = _embed(llama, tokens[None, :], tp)         # [1, T, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
-                pos_t = Tensor._from_value(positions[None, :])
+                # rope tables built ONCE per step (positions are
+                # layer-invariant) and consumed by the fused epilogue
+                # in every layer
+                cos_t, sin_t = rope_tables_for_positions(
+                    positions, D, cfg.rope_theta)
                 for li, (layer, kc, vc) in enumerate(
                         zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
@@ -726,23 +744,27 @@ class MixedStep:
                     q = at.q_proj(h).reshape([1, T, H, D])
                     k = at.k_proj(h).reshape([1, T, Hkv, D])
                     v = at.v_proj(h).reshape([1, T, Hkv, D])
-                    q, k, _ = fused_rotary_position_embedding(
-                        q, k, position_ids=pos_t,
-                        rotary_emb_base=cfg.rope_theta)
+                    # fused RoPE+QKV epilogue: rope(q), rope(k) and the
+                    # quantize-on-write absmax rows in ONE pass over
+                    # the projection outputs
+                    qv, kv_, k_amax, v_amax = rope_qkv_epilogue(
+                        q._value[0], k._value[0], v._value[0],
+                        cos_t, sin_t, with_amax=quant_kv)
                     if quant_kv:
                         kc, vc, ks, vs = write_ragged_kv_q8(
-                            k._value[0], v._value[0], kc, vc, kss[li],
-                            vss[li], dest_blocks, dest_offsets)
+                            kv_, v._value[0], kc, vc, kss[li],
+                            vss[li], dest_blocks, dest_offsets,
+                            k_amax=k_amax, v_amax=v_amax)
                         new_kss.append(ks)
                         new_vss.append(vs)
                     else:
                         ks = vs = None
                         kc, vc = write_ragged_kv(
-                            k._value[0], v._value[0], kc, vc,
+                            kv_, v._value[0], kc, vc,
                             dest_blocks, dest_offsets)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
-                    out = attn(q._value[0], kc, vc, bt, q_offsets,
+                    out = attn(qv, kc, vc, bt, q_offsets,
                                q_lens, kv_lens, ks, vs)
                     out = Tensor._from_value(out.reshape(1, T, H * D))
                     x = x + _tp_psum(at.o_proj(out), tp)
@@ -959,12 +981,12 @@ class DecodeStep:
 
     def _build(self):
         from ..autograd.tape import no_grad
-        from ..incubate.nn.functional import \
-            fused_rotary_position_embedding
         from ..ops.paged_attention import (_paged_attention_pallas,
                                            _paged_attention_xla,
                                            write_decode_kv,
                                            write_decode_kv_q8)
+        from ..ops.pallas_kernels import (rope_qkv_epilogue,
+                                          rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
         llama = model.llama
@@ -995,7 +1017,10 @@ class DecodeStep:
                 x = _embed(llama, tokens[:, None], tp)        # [S, 1, h]
                 if cfg.dtype == "bfloat16":
                     x = x.astype("bfloat16")
-                pos = Tensor._from_value(seq_lens[:, None])
+                # one-token-per-slot rows: positions = seq_lens; rope
+                # tables built once per step, shared by every layer
+                cos_t, sin_t = rope_tables_for_positions(
+                    seq_lens, D, cfg.rope_theta)
                 for li, (layer, kc, vc) in enumerate(
                         zip(llama.layers, kcs, vcs)):
                     h = layer.input_layernorm(x)
@@ -1003,23 +1028,24 @@ class DecodeStep:
                     q = attn.q_proj(h).reshape([S, 1, H, D])
                     k = attn.k_proj(h).reshape([S, 1, Hkv, D])
                     v = attn.v_proj(h).reshape([S, 1, Hkv, D])
-                    q, k, _ = fused_rotary_position_embedding(
-                        q, k, position_ids=pos,
-                        rotary_emb_base=cfg.rope_theta)
+                    qv, kv_, k_amax, v_amax = rope_qkv_epilogue(
+                        q._value[:, 0], k._value[:, 0], v._value[:, 0],
+                        cos_t, sin_t, with_amax=quant_kv)
                     if quant_kv:
                         kc, vc, ks, vs = write_decode_kv_q8(
-                            k._value[:, 0], v._value[:, 0], kc, vc,
-                            kss[li], vss[li], block_tables, seq_lens)
+                            kv_, v._value[:, 0], kc, vc,
+                            kss[li], vss[li], block_tables, seq_lens,
+                            k_amax=k_amax, v_amax=v_amax)
                         new_kss.append(ks)
                         new_vss.append(vs)
                     else:
                         ks = vs = None
                         kc, vc = write_decode_kv(
-                            k._value[:, 0], v._value[:, 0], kc, vc,
+                            kv_, v._value[:, 0], kc, vc,
                             block_tables, seq_lens)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
-                    out = attn_fn(q._value[:, 0], kc, vc, block_tables,
+                    out = attn_fn(qv, kc, vc, block_tables,
                                   seq_lens + 1, scale,   # incl. new token
                                   key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(S, 1, H * D))

@@ -52,6 +52,25 @@ __all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
 # trace-time static (contract locked by tests/test_serving_quant.py).
 _KV_BNT = 127.0
 
+# Round-17 declared tolerance (r13 convention: int8 paths are
+# tolerance-gated, never byte-gated) for the int8 MXU kernels vs the
+# dequantizing XLA reference: the pipelined kernels quantize the q rows
+# to int8 in-kernel (per-row absmax), so scores pick up one extra
+# quantization (<= q_absmax/254 per element before the dot) the
+# reference doesn't have.  The bound is RELATIVE to the pool's
+# dequantized value magnitude because attention outputs are convex
+# combinations of V rows — measured max deviation on the parity sweep
+# is ~5e-3 at unit-variance data; 0.02 carries ~4x headroom.  Validity
+# regime: the q-quant error perturbs the SOFTMAX EXPONENT by up to
+# softmax_scale * (q_absmax/254) * sum|k_row| per score, so the bound
+# holds while that perturbation stays well under 1 (K magnitudes up to
+# a few tens at D=16..128 — comfortably covering rope'd projection
+# outputs); beyond it, softmax exponentiation amplifies without bound
+# and the meaningful gate is the engine-level token-match rate, not a
+# tensor atol.  The legacy (pipelined=False) kernels keep the r13
+# dequant math and stay within 1e-5 of the reference.
+KERNEL_INT8_REL_TOL = 0.02
+
 
 def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
@@ -433,7 +452,7 @@ def write_ragged_kv(k_new, v_new, key_cache, value_cache, dest_blocks,
 # ---------------------------------------------------------------------------
 # quantized (int8) write paths: quantize ON WRITE inside the compiled step
 # ---------------------------------------------------------------------------
-def _quant_write_tokens(cache, scale, new_vals, blks, offs):
+def _quant_write_tokens(cache, scale, new_vals, blks, offs, amax=None):
     """Core of every int8 write path (traceable).
 
     cache [phys, bs, Hkv, D] int8, scale [phys, Hkv] fp32 absmax,
@@ -453,11 +472,17 @@ def _quant_write_tokens(cache, scale, new_vals, blks, offs):
     as the quantization floor — bounded coarseness, zero extra
     dispatches in the hot loop (K/V magnitudes are stationary across
     requests, so the floor tracks the data).
+
+    ``amax`` (round 17): the fused RoPE+QKV epilogue already computed
+    each token's per-head absmax in its single pass over the
+    projection outputs — pass it here to skip the re-read (it is
+    bit-identical to what this function would recompute).
     """
     from ..quantization.functional import quantize_symmetric
     f32 = jnp.float32
     vals = new_vals.astype(f32)
-    amax = jnp.max(jnp.abs(vals), axis=-1)               # [N, Hkv]
+    if amax is None:
+        amax = jnp.max(jnp.abs(vals), axis=-1)           # [N, Hkv]
     new_scale = scale.at[blks].max(amax)                 # running max
     ratio = jnp.where(new_scale > 0,
                       scale / jnp.maximum(new_scale, 1e-30),
@@ -471,7 +496,8 @@ def _quant_write_tokens(cache, scale, new_vals, blks, offs):
     return cache, new_scale
 
 
-def _quant_write_one_per_page(cache, scale, new_vals, blks, offs):
+def _quant_write_one_per_page(cache, scale, new_vals, blks, offs,
+                              amax=None):
     """``_quant_write_tokens`` specialized to AT MOST ONE token per
     live page (the decode append: every slot writes its own sequence's
     page; only sink duplicates, which hold garbage anyway).  The
@@ -481,7 +507,8 @@ def _quant_write_one_per_page(cache, scale, new_vals, blks, offs):
     f32 = jnp.float32
     bs = cache.shape[1]
     vals = new_vals.astype(f32)
-    amax = jnp.max(jnp.abs(vals), axis=-1)               # [N, Hkv]
+    if amax is None:
+        amax = jnp.max(jnp.abs(vals), axis=-1)           # [N, Hkv]
     new_scale = scale.at[blks].max(amax)
     ratio = jnp.where(new_scale > 0,
                       scale / jnp.maximum(new_scale, 1e-30),
@@ -495,7 +522,8 @@ def _quant_write_one_per_page(cache, scale, new_vals, blks, offs):
 
 
 def write_decode_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
-                       value_scale, block_tables, seq_lens):
+                       value_scale, block_tables, seq_lens,
+                       k_amax=None, v_amax=None):
     """int8 variant of ``write_decode_kv`` (the fused decode append):
     k_new/v_new [B, Hkv, D] quantized into position seq_lens[b]'s page
     with per-page-per-head running-max scales.  Returns
@@ -515,14 +543,15 @@ def write_decode_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
                               axis=1)[:, 0]
     off = pos % bs
     key_cache, key_scale = _quant_write_one_per_page(
-        key_cache, key_scale, k_new, blk, off)
+        key_cache, key_scale, k_new, blk, off, amax=k_amax)
     value_cache, value_scale = _quant_write_one_per_page(
-        value_cache, value_scale, v_new, blk, off)
+        value_cache, value_scale, v_new, blk, off, amax=v_amax)
     return key_cache, value_cache, key_scale, value_scale
 
 
 def write_chunk_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
-                      value_scale, block_table_row, start, n_valid, sink):
+                      value_scale, block_table_row, start, n_valid, sink,
+                      k_amax=None, v_amax=None):
     """int8 variant of ``write_chunk_kv``: one bucket-padded prefill
     chunk quantized into its pages (padding → sink, whose scale is
     garbage-on-garbage, exactly like its codes)."""
@@ -535,21 +564,24 @@ def write_chunk_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
     blk = jnp.where(valid, blk, jnp.int32(sink))
     off = jnp.where(valid, pos % bs, 0)
     key_cache, key_scale = _quant_write_tokens(
-        key_cache, key_scale, k_new[0], blk, off)
+        key_cache, key_scale, k_new[0], blk, off, amax=k_amax)
     value_cache, value_scale = _quant_write_tokens(
-        value_cache, value_scale, v_new[0], blk, off)
+        value_cache, value_scale, v_new[0], blk, off, amax=v_amax)
     return key_cache, value_cache, key_scale, value_scale
 
 
 def write_ragged_kv_q8(k_new, v_new, key_cache, value_cache, key_scale,
-                       value_scale, dest_blocks, dest_offsets):
+                       value_scale, dest_blocks, dest_offsets,
+                       k_amax=None, v_amax=None):
     """int8 variant of ``write_ragged_kv``: the packed ragged token
     batch (decode spans + prefill chunks) quantized in ONE scatter
     inside the fused MixedStep trace."""
     key_cache, key_scale = _quant_write_tokens(
-        key_cache, key_scale, k_new, dest_blocks, dest_offsets)
+        key_cache, key_scale, k_new, dest_blocks, dest_offsets,
+        amax=k_amax)
     value_cache, value_scale = _quant_write_tokens(
-        value_cache, value_scale, v_new, dest_blocks, dest_offsets)
+        value_cache, value_scale, v_new, dest_blocks, dest_offsets,
+        amax=v_amax)
     return key_cache, value_cache, key_scale, value_scale
 
 
@@ -616,7 +648,8 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
                            q_offsets, q_lens, kv_lens,
                            use_pallas: Optional[bool] = None,
                            interpret=False, span_q: Optional[int] = None,
-                           key_scale=None, value_scale=None):
+                           key_scale=None, value_scale=None,
+                           pipelined: bool = True):
     """One fused attention launch over a packed ragged query batch
     against the paged KV pool (arXiv:2604.15464).
 
@@ -645,7 +678,7 @@ def ragged_paged_attention(q, key_cache, value_cache, block_tables,
         out = _ragged_paged_attention_pallas(
             qv, kc, vc, bt, qo, ql, kl, scale, span_q=sq,
             interpret=interpret, key_scale=key_scale,
-            value_scale=value_scale)
+            value_scale=value_scale, pipelined=pipelined)
     else:
         out = _ragged_attention_xla(qv, kc, vc, bt, qo, ql, kl, scale,
                                     key_scale, value_scale)
@@ -721,17 +754,31 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
                          *refs,
                          block_size: int, pages_per_seq: int,
                          scale: float, groups: int,
-                         quantized: bool = False):
+                         quantized: bool = False,
+                         pipelined: bool = True):
     """Grid cell (b, hkv): one batch row, one kv head; q carries the
     `groups` query heads mapped to this kv head.
 
-    Pages are copied HBM->VMEM one at a time with an async DMA, with the
-    online-softmax running state in fp32 registers.  An int8 pool's
-    per-page-per-head fp32 scales ride as TWO EXTRA scalar-prefetch
-    tables bitcast to int32 ([Hkv, phys] — SMEM scalar reads with a
-    dynamic page index, the same mechanism as the block table), bitcast
-    back per page and folded into the fp32 page right after the DMA —
-    only int8 bytes ever cross HBM→VMEM."""
+    Pages stream HBM->VMEM through two buffers per operand (round 17,
+    ``pipelined=True``): page i+1's async copy is issued before the
+    attention math on page i, the wait lands at the buffer swap, and
+    the prefetch is clamped to the sequence's used page count so the
+    block table is never read past ``seq_len``'s coverage.
+    ``pipelined=False`` keeps the r16 issue-then-wait loop for
+    old-vs-new benching.  Online-softmax state stays in fp32 registers.
+
+    An int8 pool's per-page-per-head fp32 scales ride as TWO EXTRA
+    scalar-prefetch tables bitcast to int32 ([Hkv, phys] — SMEM scalar
+    reads with a dynamic page index, the same mechanism as the block
+    table).  Pipelined, the q heads are quantized once per cell to
+    per-row int8 and ``q·Kᵀ`` runs int8×int8 on the MXU with the q/k/
+    softmax scales folded into the int32-accumulated scores
+    (``quantization.functional.fold_int8_scores``); the v scale folds
+    into the [groups, D] ``p·V`` product.  Legacy (non-pipelined)
+    dequantizes each page right after its DMA, exactly the r13 math.
+    Only int8 bytes ever cross HBM→VMEM on either path."""
+    from ..quantization.functional import (fold_int8_scores,
+                                           quantize_rows_symmetric)
     if quantized:
         (block_tables_ref, seq_lens_ref, ks_bits_ref, vs_bits_ref,
          q_ref, k_pages_ref, v_pages_ref, o_ref,
@@ -744,8 +791,14 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
     b = pl.program_id(0)
     h = pl.program_id(1)
     seq_len = seq_lens_ref[b]
-    q = q_ref[0, 0].astype(jnp.float32) * scale        # [groups, D]
-    g, d = q.shape
+    int8_mxu = quantized and pipelined
+    if int8_mxu:
+        q_codes, q_s = quantize_rows_symmetric(q_ref[0, 0])
+        g, d = q_codes.shape
+        q = None
+    else:
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # [groups, D]
+        g, d = q.shape
 
     m0 = jnp.full((g, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((g, 1), jnp.float32)
@@ -755,27 +808,23 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
         (seq_len + jnp.int32(block_size - 1)) // jnp.int32(block_size),
         jnp.int32(pages_per_seq))
 
-    def body(p_idx, carry):
+    def page_math(p_idx, page, kbuf, vbuf, carry):
         m, l, acc = carry
-        page = block_tables_ref[b, p_idx]
-        k_copy = pltpu.make_async_copy(
-            k_pages_ref.at[h, page], k_vmem, sem)
-        k_copy.start()
-        k_copy.wait()
-        v_copy = pltpu.make_async_copy(
-            v_pages_ref.at[h, page], v_vmem, sem)
-        v_copy.start()
-        v_copy.wait()
-        k = k_vmem[...].astype(jnp.float32)            # [bs, D]
-        v = v_vmem[...].astype(jnp.float32)
         if quantized:
             sk = jax.lax.bitcast_convert_type(ks_bits_ref[h, page],
                                               jnp.float32)
             sv = jax.lax.bitcast_convert_type(vs_bits_ref[h, page],
                                               jnp.float32)
-            k = k * (sk / np.float32(_KV_BNT))
-            v = v * (sv / np.float32(_KV_BNT))
-        s = q @ k.T                                    # [groups, bs]
+        if int8_mxu:
+            si = jax.lax.dot_general(
+                q_codes, kbuf, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            s = fold_int8_scores(si, q_s, sk, scale)
+        else:
+            k = kbuf.astype(jnp.float32)               # [bs, D]
+            if quantized:
+                k = k * (sk / np.float32(_KV_BNT))
+            s = q @ k.T                                # [groups, bs]
         base = p_idx * jnp.int32(block_size)
         cols = base + jax.lax.broadcasted_iota(jnp.int32, (g, block_size), 1)
         s = jnp.where(cols < seq_len, s, -jnp.inf)
@@ -784,8 +833,65 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
         p = jnp.where(cols < seq_len, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + p @ v
+        if int8_mxu:
+            # p·V as int8×int8 as well: per-row p scales + the page's
+            # v scale fold into the [groups, D] product, so the page
+            # never materializes in fp32
+            p_codes, p_s = quantize_rows_symmetric(p)
+            pvi = jax.lax.dot_general(
+                p_codes, vbuf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            pv = fold_int8_scores(pvi, p_s, sv)
+        else:
+            v = vbuf.astype(jnp.float32)
+            if quantized:
+                v = v * (sv / np.float32(_KV_BNT))
+            pv = p @ v
+        acc_new = acc * alpha + pv
         return m_new, l_new, acc_new
+
+    if pipelined:
+        def start_page(p_idx, slot):
+            page = block_tables_ref[b, p_idx]
+            pltpu.make_async_copy(k_pages_ref.at[h, page],
+                                  k_vmem.at[slot], sem.at[slot, 0]).start()
+            pltpu.make_async_copy(v_pages_ref.at[h, page],
+                                  v_vmem.at[slot], sem.at[slot, 1]).start()
+
+        def wait_page(p_idx, slot):
+            page = block_tables_ref[b, p_idx]
+            pltpu.make_async_copy(k_pages_ref.at[h, page],
+                                  k_vmem.at[slot], sem.at[slot, 0]).wait()
+            pltpu.make_async_copy(v_pages_ref.at[h, page],
+                                  v_vmem.at[slot], sem.at[slot, 1]).wait()
+
+        # a masked slot (seq_len 0) has NO used page: nothing to warm
+        @pl.when(n_pages > 0)
+        def _warm():
+            start_page(jnp.int32(0), jnp.int32(0))
+
+        def body(p_idx, carry):
+            slot = jax.lax.rem(p_idx, jnp.int32(2))
+            # prefetch clamp: the last used page issues no copy, so the
+            # block table is never read past the used page count
+            @pl.when(p_idx + 1 < n_pages)
+            def _prefetch():
+                start_page(p_idx + 1, jnp.int32(1) - slot)
+            wait_page(p_idx, slot)
+            return page_math(p_idx, block_tables_ref[b, p_idx],
+                             k_vmem[slot], v_vmem[slot], carry)
+    else:
+        def body(p_idx, carry):
+            page = block_tables_ref[b, p_idx]
+            k_copy = pltpu.make_async_copy(
+                k_pages_ref.at[h, page], k_vmem, sem)
+            k_copy.start()
+            k_copy.wait()
+            v_copy = pltpu.make_async_copy(
+                v_pages_ref.at[h, page], v_vmem, sem)
+            v_copy.start()
+            v_copy.wait()
+            return page_math(p_idx, page, k_vmem[...], v_vmem[...], carry)
 
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), n_pages, body,
                                   (m0, l0, acc0))
@@ -794,7 +900,8 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
 
 def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
                             seq_lens, scale, interpret=False,
-                            key_scale=None, value_scale=None):
+                            key_scale=None, value_scale=None,
+                            pipelined: bool = True):
     B, H, D = q.shape
     Hkv = key_cache.shape[2]
     bs = key_cache.shape[1]
@@ -811,7 +918,16 @@ def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
 
     kernel = functools.partial(
         _paged_decode_kernel, block_size=bs, pages_per_seq=pages_per_seq,
-        scale=scale, groups=groups, quantized=quantized)
+        scale=scale, groups=groups, quantized=quantized,
+        pipelined=pipelined)
+    if pipelined:
+        page_scratch = [pltpu.VMEM((2, bs, D), kp.dtype),
+                        pltpu.VMEM((2, bs, D), vp.dtype),
+                        pltpu.SemaphoreType.DMA((2, 2))]
+    else:
+        page_scratch = [pltpu.VMEM((bs, D), kp.dtype),
+                        pltpu.VMEM((bs, D), vp.dtype),
+                        pltpu.SemaphoreType.DMA]
 
     with jax.experimental.disable_x64():
         prefetch = [bt.astype(jnp.int32), seq_lens.astype(jnp.int32)]
@@ -834,11 +950,7 @@ def _paged_attention_pallas(q, key_cache, value_cache, block_tables,
             ],
             out_specs=pl.BlockSpec((1, 1, groups, D),
                                    lambda b, h, *_: (b, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((bs, D), kp.dtype),
-                pltpu.VMEM((bs, D), vp.dtype),
-                pltpu.SemaphoreType.DMA,
-            ],
+            scratch_shapes=page_scratch,
         )
         out = pl.pallas_call(
             kernel,
@@ -858,7 +970,8 @@ def _on_tpu():
 
 def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
                     use_pallas: Optional[bool] = None, interpret=False,
-                    key_scale=None, value_scale=None):
+                    key_scale=None, value_scale=None,
+                    pipelined: bool = True):
     """Decode-step attention over a paged KV cache.
 
     q: [B, H, D] (one query token per sequence)
@@ -880,7 +993,8 @@ def paged_attention(q, key_cache, value_cache, block_tables, seq_lens,
         out = _paged_attention_pallas(qv, kc, vc, bt, sl, scale,
                                       interpret=interpret,
                                       key_scale=key_scale,
-                                      value_scale=value_scale)
+                                      value_scale=value_scale,
+                                      pipelined=pipelined)
     else:
         out = _paged_attention_xla(qv, kc, vc, bt, sl, scale,
                                    key_scale, value_scale)

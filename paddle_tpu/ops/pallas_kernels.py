@@ -675,6 +675,10 @@ def _flash_attention_bwd_fused(q, k, v, out, lse, g, causal: bool,
 # n_kb (block_k grows with Sk) and beyond this Sk hand off to the
 # two-kernel scheme whose memory stays O(S*D + S) regardless
 _FUSED_BWD_MAX_SK = 8192
+# block_q=512 measured ~7-11% faster than 256 on v5e at both D=64 and
+# D=128 (tools/attn_sweep.py; BENCH_ATTN artifact).  Module constant so
+# the VMEM audit (tools/check_vmem_budget.py) sees tile edits.
+_FUSED_BWD_BLOCK_Q = 512
 
 
 def _flash_bwd_auto(q, k, v, out, lse, g, causal, rope=None):
@@ -688,10 +692,9 @@ def _flash_bwd_auto(q, k, v, out, lse, g, causal, rope=None):
         # lengths can snap to a much smaller divisor (e.g. Sk=2176 ->
         # bk=128, n_kb=17), where the partials buffer would dwarf dq
         if bk and Sk // bk <= 4:
-            # block_q=512 measured ~7-11% faster than 256 on v5e at both
-            # D=64 and D=128 (tools/attn_sweep.py; BENCH_ATTN artifact)
             return _flash_attention_bwd_fused(q, k, v, out, lse, g,
-                                              causal, 512, bk, rope=rope)
+                                              causal, _FUSED_BWD_BLOCK_Q,
+                                              bk, rope=rope)
     return _flash_attention_bwd(q, k, v, out, lse, g, causal, rope=rope)
 
 
@@ -1440,19 +1443,30 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
                          *refs,
                          block_size: int, pages_per_span: int,
                          span_q: int, scale: float, groups: int,
-                         quantized: bool = False):
+                         quantized: bool = False,
+                         pipelined: bool = True):
     """Grid cell (s, h): one ragged query SPAN (a decode slot = length-1
     span, or a prefill chunk = length-C span) against one kv head's
     pages (arXiv:2604.15464 "Ragged Paged Attention").
 
     The packed query batch lives flat on the token axis; each span's
     rows are DMA'd HBM->VMEM as a fixed ``span_q`` window starting at
-    its (scalar-prefetched) offset, pages stream one DMA at a time with
-    the online-softmax state in fp32 registers, and the output window is
-    DMA'd back.  Rows past ``q_len`` inside the window compute garbage
-    that the NEXT span's cell overwrites (grid order is span-major and
-    sequential), so the packed buffer carries ``span_q`` padding rows at
-    the tail for the last span's overhang.
+    its (scalar-prefetched) offset, pages stream through TWO VMEM
+    buffers per operand (round 17, ``pipelined=True``): page *i+1*'s
+    async copy is issued before attention on page *i* runs, and the
+    only stall is the wait at the buffer swap — the TPP pipelining
+    argument (arXiv:2104.05755) applied to the page stream.  The
+    prefetch is CLAMPED to the span's used block count: page *i+1* is
+    fetched only when ``i+1 < n_pages``, so the kernel never reads the
+    block table — let alone a page — past what ``kv_len`` covers (the
+    r11 poisoned-unused-pages invariant survives the pipeline).
+    ``pipelined=False`` keeps the r16 issue-then-wait single-buffer
+    loop for old-vs-new benching.  The online-softmax state lives in
+    fp32 registers either way, and the output window is DMA'd back.
+    Rows past ``q_len`` inside the window compute garbage that the NEXT
+    span's cell overwrites (grid order is span-major and sequential),
+    so the packed buffer carries ``span_q`` padding rows at the tail
+    for the last span's overhang.
 
     Causality is positional: row r of span s sits at global position
     ``kv_len - q_len + r`` and sees keys at positions <= that, so decode
@@ -1462,10 +1476,23 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
     int8 pools (``quantized=True``): the pages arrive as int8 and the
     per-page-per-head fp32 absmax scales ride as two extra
     scalar-prefetch tables bitcast to int32 ([Hkv, phys] — the same
-    SMEM dynamic-index mechanism as the block table), bitcast back per
-    page and folded into the fp32 page right after its DMA, so only
-    int8 bytes cross HBM→VMEM and the online-softmax math is unchanged.
+    SMEM dynamic-index mechanism as the block table).  Pipelined, the
+    MXU consumes the int8 codes DIRECTLY: the span's q window is
+    quantized once per cell to per-row int8
+    (``quantize_rows_symmetric``), ``q·Kᵀ`` runs as an int8×int8
+    matmul with int32 accumulate, and ``fold_int8_scores`` folds the
+    per-row q scale, the per-page-per-head k scale and the softmax
+    scale into the accumulated scores — no fp32 page ever materializes
+    in VMEM, so each page buffer is 1/4 the fp32 footprint and the
+    matmul runs at the MXU's native int8 rate.  ``p·V`` is int8×int8
+    too (probability rows quantized per row, p/v scales folded into
+    the [g, D] product — measured ≤1% of value magnitude vs the
+    declared 2% tolerance).  The legacy path dequantizes each page
+    after its DMA (the r13/r16 behavior), kept under
+    ``pipelined=False``.
     """
+    from ..quantization.functional import (fold_int8_scores,
+                                           quantize_rows_symmetric)
     if quantized:
         (q_off_ref, q_len_ref, kv_len_ref, bt_ref,
          ks_bits_ref, vs_bits_ref,
@@ -1479,19 +1506,31 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
     s = pl.program_id(0)
     h = pl.program_id(1)
     q_len = q_len_ref[s]
+    int8_mxu = quantized and pipelined
 
     @pl.when(q_len > 0)
     def _span():
         off = q_off_ref[s]
         kv_len = kv_len_ref[s]
+        # pipelined, the page slots own sem rows 0/1; the q/o window
+        # copies use row 2 (strictly before/after the page loop, so
+        # reuse would also be safe — separate rows keep it legible)
+        qo_sem = sem.at[2, 0] if pipelined else sem
         cp = pltpu.make_async_copy(
-            q_hbm.at[pl.ds(off, span_q), h], q_vmem, sem)
+            q_hbm.at[pl.ds(off, span_q), h], q_vmem, qo_sem)
         cp.start()
         cp.wait()
         d = q_vmem.shape[-1]
         g = span_q * groups
-        q = (q_vmem[...].astype(jnp.float32).reshape(g, d)
-             * np.float32(scale))
+        if int8_mxu:
+            # one quantization per span window; padded rows are zeros,
+            # so the floored per-row scale keeps them zero codes
+            q_codes, q_s = quantize_rows_symmetric(
+                q_vmem[...].reshape(g, d))
+            q = None
+        else:
+            q = (q_vmem[...].astype(jnp.float32).reshape(g, d)
+                 * np.float32(scale))
         # row r of the span (each repeated over its q heads) sits at
         # global position kv_len - q_len + r; garbage rows (r >= q_len)
         # get qpos >= kv_len and attend the whole context — finite,
@@ -1506,26 +1545,26 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
             (kv_len + jnp.int32(block_size - 1)) // jnp.int32(block_size),
             jnp.int32(pages_per_span))
 
-        def body(p_idx, carry):
+        def page_math(p_idx, page, kbuf, vbuf, carry):
+            """Online-softmax update for one resident page (shared by
+            the pipelined and legacy loops; kbuf/vbuf are the page's
+            VMEM values, int8 when quantized)."""
             m, l, acc = carry
-            page = bt_ref[s, p_idx]
-            kc = pltpu.make_async_copy(k_pages.at[h, page], k_vmem, sem)
-            kc.start()
-            kc.wait()
-            vc = pltpu.make_async_copy(v_pages.at[h, page], v_vmem, sem)
-            vc.start()
-            vc.wait()
-            k = k_vmem[...].astype(jnp.float32)        # [bs, D]
-            v = v_vmem[...].astype(jnp.float32)
             if quantized:
                 sk = lax.bitcast_convert_type(ks_bits_ref[h, page],
                                               jnp.float32)
                 sv = lax.bitcast_convert_type(vs_bits_ref[h, page],
                                               jnp.float32)
-                k = k * (sk / np.float32(127.0))
-                v = v * (sv / np.float32(127.0))
-            sc = lax.dot_general(q, k, _DIMNUM_NT,
-                                 preferred_element_type=jnp.float32)
+            if int8_mxu:
+                si = lax.dot_general(q_codes, kbuf, _DIMNUM_NT,
+                                     preferred_element_type=jnp.int32)
+                sc = fold_int8_scores(si, q_s, sk, scale)
+            else:
+                k = kbuf.astype(jnp.float32)           # [bs, D]
+                if quantized:
+                    k = k * (sk / np.float32(127.0))
+                sc = lax.dot_general(q, k, _DIMNUM_NT,
+                                     preferred_element_type=jnp.float32)
             base = p_idx * jnp.int32(block_size)
             cols = base + lax.broadcasted_iota(
                 jnp.int32, (g, block_size), 1)
@@ -1535,16 +1574,80 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
             p = jnp.where(ok, jnp.exp(sc - m_new), _F32_0)
             alpha = jnp.exp(m - m_new)
             l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-            acc_new = acc * alpha + lax.dot_general(
-                p, v, _DIMNUM_NN, preferred_element_type=jnp.float32)
+            if int8_mxu:
+                # p·V runs int8×int8 too: the probability rows are
+                # quantized per row (max p per row is the scale) and
+                # the p/v scales fold into the [g, d] product — the
+                # page NEVER materializes in fp32 (measured ≤1% of
+                # value magnitude vs the declared 2% tolerance)
+                p_codes, p_s = quantize_rows_symmetric(p)
+                pvi = lax.dot_general(p_codes, vbuf, _DIMNUM_NN,
+                                      preferred_element_type=jnp.int32)
+                pv = fold_int8_scores(pvi, p_s, sv)
+            else:
+                v = vbuf.astype(jnp.float32)
+                if quantized:
+                    v = v * (sv / np.float32(127.0))
+                pv = lax.dot_general(p, v, _DIMNUM_NN,
+                                     preferred_element_type=jnp.float32)
+            acc_new = acc * alpha + pv
             return m_new, l_new, acc_new
+
+        if pipelined:
+            def start_page(p_idx, slot):
+                page = bt_ref[s, p_idx]
+                pltpu.make_async_copy(k_pages.at[h, page],
+                                      k_vmem.at[slot],
+                                      sem.at[slot, 0]).start()
+                pltpu.make_async_copy(v_pages.at[h, page],
+                                      v_vmem.at[slot],
+                                      sem.at[slot, 1]).start()
+
+            def wait_page(p_idx, slot):
+                page = bt_ref[s, p_idx]
+                pltpu.make_async_copy(k_pages.at[h, page],
+                                      k_vmem.at[slot],
+                                      sem.at[slot, 0]).wait()
+                pltpu.make_async_copy(v_pages.at[h, page],
+                                      v_vmem.at[slot],
+                                      sem.at[slot, 1]).wait()
+
+            @pl.when(n_pages > 0)
+            def _warm():
+                start_page(jnp.int32(0), jnp.int32(0))
+
+            def body(p_idx, carry):
+                slot = lax.rem(p_idx, jnp.int32(2))
+                # prefetch clamp: the last used page issues NO copy —
+                # bt_ref[s, n_pages] (and anything past the span's
+                # block count) is never read
+                @pl.when(p_idx + 1 < n_pages)
+                def _prefetch():
+                    start_page(p_idx + 1, jnp.int32(1) - slot)
+                wait_page(p_idx, slot)
+                return page_math(p_idx, bt_ref[s, p_idx],
+                                 k_vmem[slot], v_vmem[slot], carry)
+        else:
+            def body(p_idx, carry):
+                page = bt_ref[s, p_idx]
+                kc = pltpu.make_async_copy(k_pages.at[h, page], k_vmem,
+                                           sem)
+                kc.start()
+                kc.wait()
+                vc = pltpu.make_async_copy(v_pages.at[h, page], v_vmem,
+                                           sem)
+                vc.start()
+                vc.wait()
+                return page_math(p_idx, page, k_vmem[...], v_vmem[...],
+                                 carry)
 
         m, l, acc = lax.fori_loop(jnp.int32(0), n_pages, body,
                                   (m0, l0, acc0))
         o_vmem[...] = (acc / jnp.maximum(l, np.float32(1e-30))).reshape(
             span_q, groups, d).astype(o_vmem.dtype)
         op = pltpu.make_async_copy(
-            o_vmem, o_hbm.at[pl.ds(off, span_q), h], sem)
+            o_vmem, o_hbm.at[pl.ds(off, span_q), h],
+            sem.at[2, 1] if pipelined else sem)
         op.start()
         op.wait()
 
@@ -1553,7 +1656,8 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
                                    block_tables, q_offsets, q_lens,
                                    kv_lens, scale, span_q: int,
                                    interpret=False,
-                                   key_scale=None, value_scale=None):
+                                   key_scale=None, value_scale=None,
+                                   pipelined: bool = True):
     """q: [T, H, D] packed ragged tokens; block_tables [S, W]; span
     tables [S].  span_q: static max span length (>= max(q_lens)).
     Returns [T, H, D].
@@ -1591,7 +1695,18 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
 
     kernel = functools.partial(
         _ragged_paged_kernel, block_size=bs, pages_per_span=W,
-        span_q=span_q, scale=scale, groups=groups, quantized=quantized)
+        span_q=span_q, scale=scale, groups=groups, quantized=quantized,
+        pipelined=pipelined)
+    if pipelined:
+        # double-buffered page stream: 2 VMEM slots per operand, one
+        # DMA sem row per slot (k col 0 / v col 1) + a q/o row
+        page_scratch = [pltpu.VMEM((2, bs, D), kp.dtype),
+                        pltpu.VMEM((2, bs, D), vp.dtype),
+                        pltpu.SemaphoreType.DMA((3, 2))]
+    else:
+        page_scratch = [pltpu.VMEM((bs, D), kp.dtype),
+                        pltpu.VMEM((bs, D), vp.dtype),
+                        pltpu.SemaphoreType.DMA]
 
     with _x64_off():
         prefetch = [q_offsets.astype(jnp.int32), q_lens.astype(jnp.int32),
@@ -1616,10 +1731,7 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
             scratch_shapes=[
                 pltpu.VMEM((span_q, groups, D), jnp.float32),
                 pltpu.VMEM((span_q, groups, D), q.dtype),
-                pltpu.VMEM((bs, D), kp.dtype),
-                pltpu.VMEM((bs, D), vp.dtype),
-                pltpu.SemaphoreType.DMA,
-            ],
+            ] + page_scratch,
         )
         out = pl.pallas_call(
             kernel,
@@ -1629,3 +1741,335 @@ def _ragged_paged_attention_pallas(q, key_cache, value_cache,
             interpret=interpret,
         )(*prefetch, qg, kp, vp)
     return out[:T].reshape(T, H, D)
+
+
+# ---------------------------------------------------------------------------
+# fused RoPE + QKV epilogue (serving: one HBM round trip per layer's
+# pre-attention transforms instead of three)
+# ---------------------------------------------------------------------------
+def rope_tables_for_positions(positions, dim, base=10000.0):
+    """Neox cos/sin tables for a TOKEN-INDEXED position vector:
+    positions [N] int32 (each token's GLOBAL position) -> (cos, sin)
+    [N, dim] f32.  Bit-identical to the tables
+    ``incubate.nn.functional.fused_rotary_position_embedding`` builds
+    from ``position_ids`` (same inv-frequency expression, same f32
+    order of operations), so swapping the serving steps onto the fused
+    epilogue keeps fp32 engines byte-identical end-to-end.  Traceable;
+    the serving steps call it ONCE per step and reuse the tables across
+    every layer (the per-layer rebuild was pure waste — positions do
+    not change between layers)."""
+    inv = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rope_rows(t, cos, sin):
+    """Neox rotation of token-major rows: t [N, Hx, D] x cos/sin [N, D]
+    (broadcast over the head axis).  The SAME op order as
+    ``fused_rotary_position_embedding``'s rope_one, so the values are
+    bit-identical; shared by the XLA reference and the kernel body."""
+    tf = t.astype(jnp.float32)
+    half = tf.shape[-1] // 2
+    rot = jnp.concatenate([-tf[..., half:], tf[..., :half]], axis=-1)
+    return tf * cos[:, None, :] + rot * sin[:, None, :]
+
+
+def _rope_qkv_kernel(*refs, with_amax: bool):
+    """One row tile of the fused pre-attention epilogue: rope(q),
+    rope(k), and (quantized pools) the per-token per-head K/V absmax
+    rows the quantize-on-write scatter needs — one read of the
+    projection outputs and one write, where the graph-level path cost
+    a rope pass over q, a rope pass over k, and an abs-max pass over
+    k/v (three HBM round trips of the same data)."""
+    if with_amax:
+        (q_ref, k_ref, v_ref, cos_ref, sin_ref,
+         qo_ref, ko_ref, ka_ref, va_ref) = refs
+    else:
+        q_ref, k_ref, cos_ref, sin_ref, qo_ref, ko_ref = refs
+        v_ref = ka_ref = va_ref = None
+    cos = cos_ref[...]
+    sin = sin_ref[...]
+    qo_ref[...] = _rope_rows(q_ref[...], cos, sin).astype(qo_ref.dtype)
+    ko = _rope_rows(k_ref[...], cos, sin).astype(ko_ref.dtype)
+    ko_ref[...] = ko
+    if with_amax:
+        # absmax of the STORED values (post-cast), bit-matching what
+        # _quant_write_tokens would recompute from the scattered rows
+        ka_ref[...] = jnp.max(jnp.abs(ko.astype(jnp.float32)), axis=-1)
+        va_ref[...] = jnp.max(jnp.abs(v_ref[...].astype(jnp.float32)),
+                              axis=-1)
+
+
+def _rope_qkv_epilogue_xla(q, k, v, cos, sin, with_amax):
+    """Graph-level reference (CPU serving path + parity tests): the
+    exact same f32 expressions as the kernel, so interpret-vs-XLA
+    parity is byte-level and the CPU engines keep their end-to-end
+    byte identity with eager generate."""
+    q_rot = _rope_rows(q, cos, sin).astype(q.dtype)
+    k_rot = _rope_rows(k, cos, sin).astype(k.dtype)
+    if not with_amax:
+        return q_rot, k_rot, None, None
+    k_amax = jnp.max(jnp.abs(k_rot.astype(jnp.float32)), axis=-1)
+    v_amax = jnp.max(jnp.abs(v.astype(jnp.float32)), axis=-1)
+    return q_rot, k_rot, k_amax, v_amax
+
+
+def _rope_epilogue_tile(heads: int, head_dim: int, itemsize: int,
+                        cap_rows: int = 512) -> int:
+    """Row-tile chooser shared by the epilogue wrapper and the VMEM
+    audit: the widest operand's tile stays under ~1 MiB so the kernel
+    fits the 16 MiB serving budget at any head count (64 q heads ×
+    D=128 would need 109 MiB at a fixed 512-row tile — the audit
+    caught exactly that)."""
+    cap = max(1, (1 << 20) // max(1, heads * head_dim * itemsize))
+    tile = min(cap_rows, cap)
+    if tile > 8:
+        tile = (tile // 8) * 8
+    return max(1, tile)
+
+
+def rope_qkv_epilogue(q, k, v, cos, sin, with_amax: bool = False,
+                      use_pallas=None, interpret=False, block_rows=512):
+    """Fused pre-attention epilogue for the serving steps (round 17).
+
+    q: [N, H, D], k/v: [N, Hkv, D] token-major projection outputs;
+    cos/sin: [N, D] from :func:`rope_tables_for_positions`.  Applies
+    neox RoPE to q and k at each token's global position and, for int8
+    KV pools (``with_amax``), also emits the per-token per-head K/V
+    absmax rows consumed by the quantize-on-write scatter — ONE Pallas
+    pass over the projection outputs on TPU, replacing the separate
+    rope-q / rope-k / absmax graph passes.  v itself is returned
+    untouched by the caller (never copied here).
+
+    Returns ``(q_rot, k_rot, k_amax, v_amax)`` (amaxes None unless
+    ``with_amax``).  The XLA fallback is bit-identical to the kernel's
+    math, so CPU dryrun engines stay byte-identical end-to-end.
+    """
+    if use_pallas is None:
+        use_pallas = _HAS_PLTPU and _on_tpu()
+    if not (use_pallas or interpret):
+        return _rope_qkv_epilogue_xla(q, k, v, cos, sin, with_amax)
+
+    N, H, D = q.shape
+    Hkv = k.shape[1]
+    tile = min(_rope_epilogue_tile(H, D, q.dtype.itemsize, block_rows),
+               N)
+    pad = (-N) % tile
+    if pad:
+        widths = ((0, pad), (0, 0), (0, 0))
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        if with_amax:
+            v = jnp.pad(v, widths)
+        cos = jnp.pad(cos, ((0, pad), (0, 0)))
+        sin = jnp.pad(sin, ((0, pad), (0, 0)))
+    rows = N + pad
+
+    def spec(hx):
+        return pl.BlockSpec((tile, hx, D), lambda i: (i, 0, 0))
+
+    cs_spec = pl.BlockSpec((tile, D), lambda i: (i, 0))
+    amax_spec = pl.BlockSpec((tile, Hkv), lambda i: (i, 0))
+    in_specs = [spec(H), spec(Hkv)]
+    args = [q, k]
+    if with_amax:
+        in_specs.append(spec(Hkv))
+        args.append(v)
+    in_specs += [cs_spec, cs_spec]
+    args += [cos, sin]
+    out_specs = [spec(H), spec(Hkv)]
+    out_shape = [jax.ShapeDtypeStruct((rows, H, D), q.dtype),
+                 jax.ShapeDtypeStruct((rows, Hkv, D), k.dtype)]
+    if with_amax:
+        out_specs += [amax_spec, amax_spec]
+        out_shape += [jax.ShapeDtypeStruct((rows, Hkv), jnp.float32),
+                      jax.ShapeDtypeStruct((rows, Hkv), jnp.float32)]
+
+    with _x64_off():
+        res = pl.pallas_call(
+            functools.partial(_rope_qkv_kernel, with_amax=with_amax),
+            grid=(rows // tile,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args)
+    q_rot, k_rot = res[0][:N], res[1][:N]
+    if with_amax:
+        return q_rot, k_rot, res[2][:N], res[3][:N]
+    return q_rot, k_rot, None, None
+
+
+# ---------------------------------------------------------------------------
+# VMEM footprint audit (consumed by tools/check_vmem_budget.py)
+# ---------------------------------------------------------------------------
+# Mosaic tiles every VMEM-resident buffer to (sublane, 128) vregs; the
+# sublane count depends on itemsize (f32: 8, bf16: 16, int8: 32).  The
+# audit pads every tile the way the hardware will, so a "small" [g, 1]
+# running-max column is honestly counted as the [g, 128] lane broadcast
+# it occupies on silicon.
+_VMEM_LANE = 128
+
+
+def _tile_bytes(shape, itemsize: int) -> int:
+    """Lane/sublane-padded bytes of one VMEM-resident tile."""
+    shape = tuple(int(s) for s in shape)
+    if not shape:
+        shape = (1, 1)
+    elif len(shape) == 1:
+        shape = (1,) + shape
+    sub = 8 * (4 // max(1, min(itemsize, 4)))  # f32:8, bf16:16, int8:32
+    lead = 1
+    for s in shape[:-2]:
+        lead *= s
+    rows = -(-shape[-2] // sub) * sub
+    cols = -(-shape[-1] // _VMEM_LANE) * _VMEM_LANE
+    return lead * rows * cols * itemsize
+
+
+def ragged_kernel_vmem_bytes(*, span_q: int, groups: int, head_dim: int,
+                             block_size: int, q_itemsize: int = 4,
+                             kv_itemsize: int = 4, pipelined: bool = True,
+                             quantized: bool = False) -> int:
+    """Worst-case VMEM bytes of ONE _ragged_paged_kernel grid cell:
+    the span_q query window (f32 scratch + output-dtype staging), the
+    page buffers (×2 per operand when pipelined — the round-17 double
+    buffering), and the live compute tiles (online-softmax m/l/acc,
+    the [g, block_size] score/probability tile, and the int8 q codes
+    + per-row scales on the quantized MXU path).  Mirrors the
+    scratch_shapes in _ragged_paged_attention_pallas — edit both or
+    tools/check_vmem_budget.py fails."""
+    g = span_q * groups
+    d = head_dim
+    bufs = 2 if pipelined else 1
+    total = _tile_bytes((span_q, groups, d), 4)           # q window f32
+    total += _tile_bytes((span_q, groups, d), q_itemsize)  # o staging
+    total += 2 * bufs * _tile_bytes((block_size, d), kv_itemsize)  # k+v
+    total += _tile_bytes((g, d), 4)                       # acc
+    total += 2 * _tile_bytes((g, 1), 4)                   # m, l
+    total += 2 * _tile_bytes((g, block_size), 4)          # scores + p
+    if quantized and pipelined:
+        total += _tile_bytes((g, d), 1)                   # q int8 codes
+        total += _tile_bytes((g, 1), 4)                   # q row scales
+        total += _tile_bytes((g, block_size), 4)          # i32 scores
+    return total
+
+
+def decode_kernel_vmem_bytes(*, groups: int, head_dim: int,
+                             block_size: int, q_itemsize: int = 4,
+                             kv_itemsize: int = 4, pipelined: bool = True,
+                             quantized: bool = False) -> int:
+    """Worst-case VMEM bytes of ONE _paged_decode_kernel grid cell.
+    The q/o operands are BlockSpec-streamed (Mosaic double-buffers
+    them: ×2); pages go through the manual 2-slot DMA buffers."""
+    return ragged_kernel_vmem_bytes(
+        span_q=1, groups=groups, head_dim=head_dim,
+        block_size=block_size, q_itemsize=q_itemsize,
+        kv_itemsize=kv_itemsize, pipelined=pipelined,
+        quantized=quantized) \
+        + _tile_bytes((groups, head_dim), q_itemsize) * 2  # q+o 2nd buf
+
+
+def rope_epilogue_vmem_bytes(*, heads: int, kv_heads: int,
+                             head_dim: int, itemsize: int = 4,
+                             with_amax: bool = True) -> int:
+    """One _rope_qkv_kernel row tile: q/k (+v) in, q/k (+amax) out —
+    every operand BlockSpec-streamed, so ×2 for Mosaic's pipeline —
+    plus the f32 rotation temporaries for the widest operand.  Rows
+    come from the SAME chooser the wrapper uses, so a tile-cap edit is
+    audited automatically."""
+    rows = _rope_epilogue_tile(heads, head_dim, itemsize)
+    per_buf = (_tile_bytes((rows, heads, head_dim), itemsize)
+               + _tile_bytes((rows, kv_heads, head_dim), itemsize))
+    n_v = _tile_bytes((rows, kv_heads, head_dim), itemsize) \
+        if with_amax else 0
+    amax = 2 * _tile_bytes((rows, kv_heads), 4) if with_amax else 0
+    rot = 2 * _tile_bytes((rows, heads, head_dim), 4)     # tf + rot f32
+    return 2 * (2 * per_buf + n_v + amax) + rot
+
+
+def flash_fwd_vmem_bytes(*, block_q: int, block_k: int, head_dim: int,
+                         itemsize: int = 4, with_lse: bool = True,
+                         with_rope: bool = False) -> int:
+    """One _flash_fwd_kernel grid cell: BlockSpec-streamed q/k/v/out
+    (×2 each), the m/l/acc/qs scratch, and the [bq, bk] score tile."""
+    d = head_dim
+    blocks = _tile_bytes((block_q, d), itemsize) * 2 \
+        + 2 * _tile_bytes((block_k, d), itemsize) * 2 \
+        + _tile_bytes((block_q, d), itemsize) * 2            # out
+    if with_lse:
+        blocks += _tile_bytes((block_q, _VMEM_LANE), 4) * 2
+    if with_rope:
+        blocks += 4 * _tile_bytes((max(block_q, block_k), d), 4) * 2
+    scratch = 2 * _tile_bytes((block_q, _VMEM_LANE), 4) \
+        + _tile_bytes((block_q, d), 4) \
+        + _tile_bytes((block_q, d), itemsize)
+    tiles = 2 * _tile_bytes((block_q, block_k), 4)           # s + p
+    return blocks + scratch + tiles
+
+
+def flash_bwd_fused_vmem_bytes(*, block_q: int, block_k: int,
+                               head_dim: int, itemsize: int = 4,
+                               with_rope: bool = False) -> int:
+    """One _flash_bwd_kv_kernel (emit_dq) grid cell — the largest
+    kernel in the tree: streamed q/o/do/lse blocks, resident k/v
+    blocks, dq/dk/dv outputs, dk/dv/ks scratch, and the [bq, bk]
+    p/ds/dp tiles."""
+    d = head_dim
+    blocks = 3 * _tile_bytes((block_q, d), itemsize) * 2 \
+        + _tile_bytes((block_q, _VMEM_LANE), 4) * 2 \
+        + 2 * _tile_bytes((block_k, d), itemsize) * 2 \
+        + _tile_bytes((block_q, d), 4) * 2 \
+        + 2 * _tile_bytes((block_k, d), itemsize) * 2
+    if with_rope:
+        blocks += 4 * _tile_bytes((max(block_q, block_k), d), 4) * 2
+    scratch = 2 * _tile_bytes((block_k, d), 4) \
+        + _tile_bytes((block_k, d), itemsize)
+    tiles = 3 * _tile_bytes((block_q, block_k), 4)           # p, dp, ds
+    return blocks + scratch + tiles
+
+
+def kernel_vmem_report(envelope=None):
+    """name -> worst-case per-core VMEM bytes for every Pallas kernel
+    family, at the declared serving/training ENVELOPE (the largest
+    configuration the repo's engines and benches actually launch).
+    tools/check_vmem_budget.py gates this against the per-core budget;
+    grow the envelope here FIRST when a new config is introduced."""
+    env = {
+        # serving envelope: the TPU bench line (bench_serving.py) —
+        # chunk/span_q 256, 16-token pages, head_dim 128, and GQA
+        # grouping up to 8 q heads per kv head
+        "span_q": 256, "groups": 8, "head_dim": 128, "block_size": 16,
+        # training envelope: the default/autotuned flash tiles
+        "block_q": 512, "block_k": 512,
+        "bwd_block_q": _FUSED_BWD_BLOCK_Q,
+        "bwd_block_k": _FUSED_BWD_MAX_SK // 4,
+    }
+    if envelope:
+        env.update(envelope)
+    return {
+        "ragged_paged_fp32": ragged_kernel_vmem_bytes(
+            span_q=env["span_q"], groups=env["groups"],
+            head_dim=env["head_dim"], block_size=env["block_size"]),
+        "ragged_paged_int8": ragged_kernel_vmem_bytes(
+            span_q=env["span_q"], groups=env["groups"],
+            head_dim=env["head_dim"], block_size=env["block_size"],
+            kv_itemsize=1, quantized=True),
+        "paged_decode_fp32": decode_kernel_vmem_bytes(
+            groups=env["groups"], head_dim=env["head_dim"],
+            block_size=env["block_size"]),
+        "paged_decode_int8": decode_kernel_vmem_bytes(
+            groups=env["groups"], head_dim=env["head_dim"],
+            block_size=env["block_size"], kv_itemsize=1,
+            quantized=True),
+        "rope_qkv_epilogue": rope_epilogue_vmem_bytes(
+            heads=8 * env["groups"], kv_heads=env["groups"],
+            head_dim=env["head_dim"]),
+        "flash_fwd": flash_fwd_vmem_bytes(
+            block_q=env["block_q"], block_k=env["block_k"],
+            head_dim=env["head_dim"], with_rope=True),
+        "flash_bwd_fused": flash_bwd_fused_vmem_bytes(
+            block_q=env["bwd_block_q"], block_k=env["bwd_block_k"],
+            head_dim=env["head_dim"], with_rope=True),
+    }

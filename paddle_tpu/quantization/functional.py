@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 __all__ = ["symmetric_bound", "absmax_scale", "quantize_symmetric",
            "dequantize_symmetric", "fake_quantize",
+           "quantize_rows_symmetric", "fold_int8_scores",
            "WEIGHT_SCALE_SUFFIX", "is_weight_scale_key",
            "ptq_quantizable", "quantize_param_tree",
            "dequantize_param_tree"]
@@ -77,6 +78,46 @@ def dequantize_symmetric(q, scale, bits: int = 8):
     bnt = symmetric_bound(bits)
     return (q.astype(jnp.float32)
             * (jnp.asarray(scale).astype(jnp.float32) / bnt))
+
+
+def quantize_rows_symmetric(x, bits: int = 8):
+    """Per-ROW symmetric int8 codes + their absmax scales — the
+    in-kernel MXU-operand quantizer (round 17).
+
+    ``x``: [rows, d] fp values (one attention-kernel q row per query
+    head × span position).  Returns ``(codes int8 [rows, d],
+    scale f32 [rows, 1])`` with the same clamp convention as
+    :func:`quantize_symmetric` (codes in [-bnt, bnt], scale floored so
+    an all-zero row — a padded span tail — quantizes to zeros, never
+    NaN).  Traceable inside Pallas kernel bodies: jnp ops only, and the
+    int8 cast happens here so the caller can feed the codes straight
+    into an int8×int8 ``dot_general``."""
+    bnt = symmetric_bound(bits)
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.maximum(scale, 1e-30)
+    codes = jnp.clip(jnp.round(xf / s * bnt), -bnt, bnt).astype(jnp.int8)
+    return codes, s
+
+
+def fold_int8_scores(acc, q_scale, k_scale, softmax_scale=1.0,
+                     bits: int = 8):
+    """Fold the two absmax scales (and the softmax 1/sqrt(D)) into an
+    int8×int8 matmul's int32-accumulated scores — the round-17
+    replacement for dequantizing whole KV pages into fp32 VMEM.
+
+    ``acc``: [rows, cols] int32 accumulator of ``q_codes · k_codesᵀ``;
+    ``q_scale``: [rows, 1] per-row q absmax (from
+    :func:`quantize_rows_symmetric`); ``k_scale``: the page's scalar
+    per-page-per-head absmax.  Exact identity being approximated:
+    ``(q/qs·bnt)·(k/ks·bnt)ᵀ · qs·ks/bnt² ≈ q·kᵀ`` — the only error is
+    the two quantizations, never the fold (scalar multiplies commute
+    with the dot).  Returns fp32 scores ready for the online softmax."""
+    bnt = symmetric_bound(bits)
+    mult = q_scale.astype(jnp.float32) * (
+        jnp.asarray(k_scale, jnp.float32)
+        * np.float32(float(softmax_scale) / (bnt * bnt)))
+    return acc.astype(jnp.float32) * mult
 
 
 def fake_quantize(x, scale, bits: int = 8):

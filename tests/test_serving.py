@@ -15,7 +15,8 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.ops.paged_attention import (
-    PagedKVCache, paged_attention, write_kv_to_cache, reconstruct_kv,
+    PagedKVCache, paged_attention, ragged_paged_attention,
+    write_kv_to_cache, reconstruct_kv,
     block_multihead_attention, masked_multihead_attention,
     _paged_attention_xla, _paged_attention_pallas)
 
@@ -911,3 +912,169 @@ def test_mixed_matches_split_engine_tokens():
     split = run(prefill_buckets=(4, 8), prefill_chunk_size=8)
     mixed = run(mixed_step=True, prefill_chunk_size=8)
     assert split == mixed
+
+
+# ---------------------------------------------------------------------------
+# round 17: double-buffered page DMA + fused RoPE+QKV epilogue
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_ragged_pipelined_prefetch_clamp_poisoned_pages():
+    """r11 poison invariant, extended to the double-buffered kernel:
+    prefetching page i+1 while attending page i must NEVER touch a
+    page past the span's used block count — including the last-page
+    boundary (a span whose used count fills the whole table, where an
+    unclamped prefetch would read bt[s, W]).  Every unused page (and
+    the poison page the padded table entries point at) is NaN'd; the
+    kernel's output must be BYTE-IDENTICAL to its clean-pool run, for
+    both the pipelined and the legacy sync-DMA kernel, and match the
+    XLA reference on the clean pool."""
+    from paddle_tpu.ops.paged_attention import _ragged_attention_xla
+    bs, Hkv, H, D, nb = 4, 2, 4, 16, 32
+    rng_ = np.random.RandomState(3)
+    kc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    cache = PagedKVCache(nb, bs, Hkv, D)
+    # last span uses ALL W=4 pages: the prefetch-clamp boundary case
+    spans = [(1, 5), (4, 12), (8, 8), (1, 16), (2, 16)]
+    W = 4
+    poison = cache.allocate_block()
+    rows, used_pages = [], {poison}
+    for q_len, kv_len in spans:
+        used = -(-kv_len // bs)
+        tab = cache.build_block_table([kv_len], max_blocks=W)[0]
+        used_pages.update(int(b) for b in tab[:used])
+        tab[used:] = poison          # padded entries -> the poison page
+        rows.append(tab)
+    bt = np.stack(rows)
+    T = sum(q for q, _ in spans)
+    q = rng_.randn(T, H, D).astype(np.float32)
+    q_offsets = np.cumsum([0] + [q for q, _ in spans[:-1]]).astype(np.int32)
+    q_lens = np.asarray([q for q, _ in spans], np.int32)
+    kv_lens = np.asarray([kv for _, kv in spans], np.int32)
+    unused = np.asarray(sorted(set(range(nb)) - used_pages)
+                        + [poison], np.int32)
+    kc_p = kc.at[unused].set(np.float32(np.nan))
+    vc_p = vc.at[unused].set(np.float32(np.nan))
+    args = (bt, q_offsets, q_lens, kv_lens)
+    want = _ragged_attention_xla(
+        jnp.asarray(q), kc, vc, jnp.asarray(bt), jnp.asarray(q_offsets),
+        jnp.asarray(q_lens), jnp.asarray(kv_lens), 1.0 / np.sqrt(D))
+    for pipelined in (True, False):
+        clean = np.asarray(ragged_paged_attention(
+            q, kc, vc, *args, interpret=True, span_q=8,
+            pipelined=pipelined))
+        poisoned = np.asarray(ragged_paged_attention(
+            q, kc_p, vc_p, *args, interpret=True, span_q=8,
+            pipelined=pipelined))
+        assert np.isfinite(poisoned).all()
+        np.testing.assert_array_equal(clean, poisoned)
+        np.testing.assert_allclose(clean, np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+    # decode kernel: same invariant (full-table sequence included)
+    sl = np.asarray([5, 16], np.int32)
+    bt2 = np.stack([rows[0], rows[3]])
+    for pipelined in (True, False):
+        clean = np.asarray(paged_attention(
+            q[:2], kc, vc, bt2, sl, interpret=True,
+            pipelined=pipelined))
+        poisoned = np.asarray(paged_attention(
+            q[:2], kc_p, vc_p, bt2, sl, interpret=True,
+            pipelined=pipelined))
+        assert np.isfinite(poisoned).all()
+        np.testing.assert_array_equal(clean, poisoned)
+
+
+@pytest.mark.slow
+def test_ragged_pipelined_matches_sync_fp32_byte_identical():
+    """Double buffering only reorders DMA issue/wait — the fp32
+    compute stream is the SAME ops on the same values, so the
+    pipelined kernel must be byte-identical to the r16 sync-DMA
+    kernel (interpret mode)."""
+    bs, Hkv, H, D, nb = 4, 2, 4, 16, 64
+    rng_ = np.random.RandomState(11)
+    kc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    vc = jnp.asarray(rng_.randn(nb, bs, Hkv, D).astype(np.float32))
+    cache = PagedKVCache(nb, bs, Hkv, D)
+    spans = [(3, 11), (1, 13), (5, 5), (2, 10), (1, 1)]
+    W = 4
+    bt = np.stack([cache.build_block_table([kv], max_blocks=W)[0]
+                   for _, kv in spans])
+    T = sum(q for q, _ in spans)
+    q = rng_.randn(T, H, D).astype(np.float32)
+    q_offsets = np.cumsum([0] + [q for q, _ in spans[:-1]]).astype(np.int32)
+    q_lens = np.asarray([q for q, _ in spans], np.int32)
+    kv_lens = np.asarray([kv for _, kv in spans], np.int32)
+    outs = [np.asarray(ragged_paged_attention(
+        q, kc, vc, bt, q_offsets, q_lens, kv_lens, interpret=True,
+        span_q=5, pipelined=p)) for p in (True, False)]
+    np.testing.assert_array_equal(outs[0], outs[1])
+    sl = np.asarray([7, 12], np.int32)
+    d_outs = [np.asarray(paged_attention(
+        q[:2], kc, vc, bt[:2], sl, interpret=True, pipelined=p))
+        for p in (True, False)]
+    np.testing.assert_array_equal(d_outs[0], d_outs[1])
+
+
+def test_rope_qkv_epilogue_xla_matches_incubate_bytewise():
+    """The serving steps' fused epilogue (XLA path — what every CPU
+    dryrun engine compiles) must be BYTE-identical to the
+    fused_rotary_position_embedding path it replaced, and its absmax
+    rows bit-identical to what the quantized write paths recompute —
+    that identity is what keeps fp32 engines byte-identical end-to-end
+    across the round-17 rewiring."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.nn.functional import \
+        fused_rotary_position_embedding
+    from paddle_tpu.ops.pallas_kernels import (rope_qkv_epilogue,
+                                               rope_tables_for_positions)
+    rng_ = np.random.RandomState(2)
+    T, H, Hkv, D = 9, 4, 2, 16
+    q = rng_.randn(1, T, H, D).astype(np.float32)
+    k = rng_.randn(1, T, Hkv, D).astype(np.float32)
+    v = rng_.randn(1, T, Hkv, D).astype(np.float32)
+    pos = rng_.randint(0, 900, (T,)).astype(np.int32)
+    qt, kt, _ = fused_rotary_position_embedding(
+        Tensor._from_value(jnp.asarray(q)),
+        Tensor._from_value(jnp.asarray(k)),
+        position_ids=Tensor._from_value(jnp.asarray(pos[None, :])),
+        rotary_emb_base=10000.0)
+    cos, sin = rope_tables_for_positions(jnp.asarray(pos), D, 10000.0)
+    q2, k2, ka, va = rope_qkv_epilogue(
+        jnp.asarray(q[0]), jnp.asarray(k[0]), jnp.asarray(v[0]),
+        cos, sin, with_amax=True, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(qt._value)[0],
+                                  np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(kt._value)[0],
+                                  np.asarray(k2))
+    np.testing.assert_array_equal(
+        np.asarray(ka),
+        np.max(np.abs(np.asarray(k2, np.float32)), -1))
+    np.testing.assert_array_equal(
+        np.asarray(va),
+        np.max(np.abs(np.asarray(v[0], np.float32)), -1))
+
+
+@pytest.mark.slow
+def test_rope_qkv_epilogue_interpret_matches_xla():
+    """The Pallas epilogue kernel (interpret mode, incl. the row-tile
+    padding path) agrees with the XLA reference at ULP level for the
+    rotation and BITWISE for the absmax rows."""
+    from paddle_tpu.ops.pallas_kernels import (rope_qkv_epilogue,
+                                               rope_tables_for_positions)
+    rng_ = np.random.RandomState(4)
+    for T in (8, 13):                     # aligned + padded row tiles
+        H, Hkv, D = 4, 2, 16
+        q = jnp.asarray(rng_.randn(T, H, D).astype(np.float32))
+        k = jnp.asarray(rng_.randn(T, Hkv, D).astype(np.float32))
+        v = jnp.asarray(rng_.randn(T, Hkv, D).astype(np.float32))
+        pos = jnp.asarray(rng_.randint(0, 100, (T,)).astype(np.int32))
+        cos, sin = rope_tables_for_positions(pos, D, 10000.0)
+        ref = rope_qkv_epilogue(q, k, v, cos, sin, with_amax=True,
+                                use_pallas=False)
+        got = rope_qkv_epilogue(q, k, v, cos, sin, with_amax=True,
+                                interpret=True)
+        for r, g in zip(ref[:3], got[:3]):
+            np.testing.assert_allclose(np.asarray(r), np.asarray(g),
+                                       rtol=4e-6, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ref[3]),
+                                      np.asarray(got[3]))
