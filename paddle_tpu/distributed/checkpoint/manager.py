@@ -222,6 +222,7 @@ class CheckpointManager:
         if sync or not self.async_save:
             self._write(step, snapshot, meta)
             return
+        # graftlint: waive[conc-unguarded-write] -- assigned before Thread.start(); start() is the happens-before edge to the writer's reads
         self._thread = threading.Thread(
             target=self._write_guard, args=(step, snapshot, meta),
             name=f"ckpt-writer-{step}", daemon=True)
@@ -233,8 +234,10 @@ class CheckpointManager:
         t = self._thread
         if t is not None:
             t.join()
+            # graftlint: waive[conc-unguarded-write] -- runs after join(); the dead writer cannot race this write
             self._thread = None
         if self._write_error is not None:
+            # graftlint: waive[conc-unguarded-write] -- join() above ordered the writer's _write_error store before this clear
             err, self._write_error = self._write_error, None
             raise err
 
@@ -242,6 +245,7 @@ class CheckpointManager:
         try:
             self._write(step, snapshot, meta)
         except BaseException as e:                    # noqa: BLE001
+            # graftlint: waive[conc-unguarded-write] -- only read by wait() after join(), which orders this store
             self._write_error = e
 
     def _write(self, step: int, snapshot, meta):

@@ -90,7 +90,8 @@ class CommTaskManager:
 
     @property
     def timed_out_tasks(self) -> List[CommTask]:
-        return list(self._timed_out)
+        with self._lock:
+            return list(self._timed_out)
 
     # -- monitor -------------------------------------------------------------
     def _monitor_loop(self):
@@ -103,8 +104,11 @@ class CommTaskManager:
                     if now > task.deadline:
                         expired.append(task)
                         del self._tasks[tid]
+                # same locked section: timed_out_tasks may snapshot
+                # from any thread (no join ordering), and extending
+                # here closes the expired-but-not-yet-recorded window
+                self._timed_out.extend(expired)
             for task in expired:
-                self._timed_out.append(task)
                 try:
                     from ..observability import (counter, record_instant)
                     counter("comm_timeouts_total",

@@ -115,11 +115,16 @@ class MessageBus:
 
     # -- registration --------------------------------------------------------
     def register(self, interceptor: "Interceptor"):
-        self._local[interceptor.task_id] = interceptor
-        self._task_rank[interceptor.task_id] = self.rank
+        # registry writes under the registry lock: _recv_loop/send read
+        # these maps from peer-connection threads while carriers can
+        # still be registering tasks
+        with self._lock:
+            self._local[interceptor.task_id] = interceptor
+            self._task_rank[interceptor.task_id] = self.rank
 
     def set_task_rank(self, task_id: int, rank: int):
-        self._task_rank[task_id] = rank
+        with self._lock:
+            self._task_rank[task_id] = rank
 
     # -- sending -------------------------------------------------------------
     def send(self, msg: InterceptorMessage) -> bool:

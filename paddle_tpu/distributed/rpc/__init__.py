@@ -90,10 +90,13 @@ class _Agent:
     # -- registry (rank 0) -----------------------------------------------------
     def _start_registry(self):
         host, port = self.master_endpoint.rsplit(":", 1)
+        # graftlint: waive[conc-unguarded-write] -- every write below precedes the registry thread's start(), the happens-before edge
         self._registry = socket.create_server((host, int(port)))
         self._registry.settimeout(0.2)
+        # graftlint: waive[conc-unguarded-write] -- precedes the registry thread's start()
         self._reg_table: Dict[str, tuple] = {}
         self._reg_lock = threading.Lock()
+        # graftlint: waive[conc-unguarded-write] -- precedes the registry thread's start()
         self._alldone_acks = 0
         threading.Thread(target=self._registry_loop, daemon=True).start()
 
@@ -161,6 +164,7 @@ class _Agent:
         while time.time() < deadline:
             resp = self._master_call(("table",))
             if resp and resp[1]:
+                # graftlint: waive[conc-unguarded-write] -- single atomic reference swap before _ready.set(); serving threads wait on _ready
                 self.workers = {name: WorkerInfo(*info)
                                 for name, info in resp[2].items()}
                 return

@@ -518,6 +518,27 @@ class PrefillStep:
                              donate=donate,
                              quant_kv=quant_kv)
 
+    def aot_lower(self, C: int):
+        """AOT-lower (never execute) one bucket-``C`` prefill module
+        with zero host operands — the graftlint hlo-contract artifact
+        (donation aliases the pools, no f64, the chunk host-operand
+        count stays pinned at 4)."""
+        fn = self._fns.get(C)
+        if fn is None:
+            fn = self._fns[C] = self._build(C)
+        params = _step_params(self._param_tensors, self._tp, self._wq)
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        args = [params,
+                jnp.zeros((1, C), jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(1, jnp.int32),
+                jnp.zeros((1, self.bt_width), jnp.int32)]
+        if self.sampling:
+            args.append(jnp.zeros((4,), jnp.int32))
+        return fn.lower(*args, kcs, vcs, kss, vss)
+
     def __call__(self, tokens, start: int, n_valid: int,
                  block_table_row, samp=None) -> int:
         """tokens: [1, C] int32 bucket-padded; returns the next token
@@ -895,6 +916,30 @@ class MixedStep:
             span_tab[:, W + 4:] = 0
         return pack, pack[:4 * T].reshape(4, T), span_tab
 
+    def aot_lower(self, T: int):
+        """AOT-lower (never execute) one budget-``T`` module with a
+        zero pack and the caches' current pools — the artifact the
+        graftlint hlo-contract pass asserts over (donation aliases the
+        pools, no f64 op, ONE packed int32 host operand of the pinned
+        length).  Uses the same cached jit as ``call_packed``, so a
+        subsequent real call does not re-trace."""
+        fn = self._fns.get(T)
+        if fn is None:
+            fn = self._fns[T] = self._build(T)
+        pack, _tok, _span = self.new_pack(T)
+        pack[:] = 0
+        params = _step_params(self._param_tensors, self._tp, self._wq)
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        args = [params, jnp.asarray(pack)]
+        if self.spec_k and self.sampling:
+            V = self.cfg.vocab_size
+            args.append(tuple(
+                jnp.zeros((self.max_spans, V), jnp.float32)
+                for _ in range(self.spec_k)))
+        return fn.lower(*args, kcs, vcs, kss, vss)
+
     def call_packed(self, pack: np.ndarray, T: int, q_probs=None):
         """Dispatch one pre-packed step buffer (see ``new_pack``).  The
         nine per-step operands cross the host link as ONE int32
@@ -1093,6 +1138,26 @@ class DecodeStep:
                                      len(self.caches), n_repl=n_repl,
                                      donate=donate,
                                      quant_kv=quant_kv)
+
+    def aot_lower(self, slots: int):
+        """AOT-lower (never execute) the decode module at ``slots``
+        slots with zero host operands — the graftlint hlo-contract
+        artifact (donation aliases the pools, no f64, the split-step
+        host-operand count stays pinned at 3)."""
+        if self._fn is None:
+            self._build()
+        W = self.caches[0].num_blocks      # any width works for lint
+        params = _step_params(self._param_tensors, self._tp, self._wq)
+        kcs = tuple(c.key_cache for c in self.caches)
+        vcs = tuple(c.value_cache for c in self.caches)
+        kss, vss = _cache_scales(self.caches, self._quant_kv)
+        args = [params,
+                jnp.zeros((slots,), jnp.int32),
+                jnp.zeros((slots,), jnp.int32),
+                jnp.zeros((slots, W), jnp.int32)]
+        if self.sampling:
+            args.append(jnp.zeros((slots, 4), jnp.int32))
+        return self._fn.lower(*args, kcs, vcs, kss, vss)
 
     def __call__(self, tokens, seq_lens, block_tables,
                  samp=None) -> np.ndarray:

@@ -1249,6 +1249,7 @@ def ring_attention(q, k, v, axis_name: str, is_causal=False):
     Pallas flash kernel (fwd with lse, FlashAttention-2 bwd against the
     total lse — see _ring_flash); otherwise the einsum online-softmax
     fallback below runs (CPU mesh tests, odd shapes)."""
+    # graftlint: waive[trace-shape-branch] -- static kernel dispatch (Pallas flash vs einsum fallback), two variants per shape, not a compile-budget leak
     if _ring_flash_ok(q.shape[1], q.shape[-1]):
         qh_ = jnp.swapaxes(q, 1, 2)
         out = _ring_flash(qh_, jnp.swapaxes(k, 1, 2).astype(qh_.dtype),
@@ -1386,6 +1387,7 @@ def ulysses_attention(q, k, v, axis_name: str, is_causal=False):
     qh = jnp.swapaxes(qf, 1, 2)
     kh = jnp.swapaxes(kf, 1, 2)
     vh = jnp.swapaxes(vf, 1, 2)
+    # graftlint: waive[trace-shape-branch] -- static kernel dispatch (flash vs chunked fallback), two variants per shape, not a compile-budget leak
     if _ring_flash_ok(qh.shape[2], qh.shape[3]):
         out = _flash_sdpa(qh, kh, vh, is_causal)
     else:

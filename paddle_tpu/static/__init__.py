@@ -563,6 +563,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     caps = [t._value for (t, _) in ir.captures]
 
     def fn(*feed_vals):
+        # graftlint: waive[trace-prngkey] -- deterministic export: the serialized inference program pins its key by design
         return replay(jax.random.PRNGKey(0), *caps, *feed_vals)
 
     specs = [jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)

@@ -1,0 +1,23 @@
+"""NEGATIVE: one global acquisition order (commit before index), and
+the reentrant path uses an RLock."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._commit_lock = threading.RLock()
+        self._index_lock = threading.Lock()
+
+    def commit(self):
+        with self._commit_lock:
+            with self._index_lock:            # the one global order
+                pass
+
+    def reindex(self):
+        with self._commit_lock:               # same order as commit
+            with self._index_lock:
+                pass
+
+    def flush(self):
+        with self._commit_lock:
+            self.commit()                     # RLock: reentrant, fine

@@ -1,0 +1,23 @@
+"""POSITIVE: two inverted nested acquisitions (AB/BA cycle) plus a
+plain-Lock self-deadlock through a sibling call."""
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._commit_lock = threading.Lock()
+        self._index_lock = threading.Lock()
+
+    def commit(self):
+        with self._commit_lock:
+            with self._index_lock:            # commit -> index
+                pass
+
+    def reindex(self):
+        with self._index_lock:
+            with self._commit_lock:           # index -> commit: cycle
+                pass
+
+    def flush(self):
+        with self._commit_lock:
+            self.commit()                     # re-acquires a plain Lock

@@ -1,0 +1,27 @@
+"""NEGATIVE: every shared mutation under the lock; __init__ and
+non-shared attributes stay lock-free."""
+import threading
+
+
+class PoolMonitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = {}
+        self.timed_out = []
+        self.label = "pool"                   # never touched by thread
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.timed_out.append(1)
+
+    def rename(self, label):
+        self.label = label                    # not shared: fine
+
+    def reset(self):
+        with self._lock:
+            self.inflight = {}
+            self.timed_out.clear()

@@ -1,0 +1,23 @@
+"""POSITIVE: attribute shared with the monitor thread mutated without
+the class's lock."""
+import threading
+
+
+class PoolMonitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inflight = {}
+        self.timed_out = []
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            if self.inflight:                 # thread reads inflight
+                self.timed_out.append(1)      # thread write, no lock
+
+    def reset(self):
+        self.inflight = {}                    # races the monitor
+        with self._lock:
+            self.timed_out.clear()            # guarded: fine

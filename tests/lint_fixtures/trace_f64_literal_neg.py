@@ -1,0 +1,15 @@
+"""NEGATIVE: explicit f32 staging; f64 in host-side code is fine."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return x.astype(jnp.float32) * jnp.float32(2.0)
+
+
+def host_stats(arr):
+    # f64 on the host (reductions for reporting) is not the rule's
+    # business — only traced bodies stage ops
+    return np.float64(arr).mean()

@@ -1,0 +1,10 @@
+"""POSITIVE: f64 staged inside a traced body (x64 is globally on)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    acc = x.astype(jnp.float64)       # explicit f64
+    acc = acc.astype(float)           # builtin float == f64 under x64
+    return jnp.asarray(acc, dtype="float64")

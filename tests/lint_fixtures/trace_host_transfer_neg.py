@@ -1,0 +1,20 @@
+"""NEGATIVE: trace-time numpy on CONSTANTS is fine (it folds into the
+module); host pulls outside the traced body are fine too."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = np.arange(16)                # module-level constant: fine
+
+
+@jax.jit
+def step(params, tokens):
+    scale = np.float32(0.5)           # trace-time constant: folds
+    table = jnp.asarray(_TABLE)       # constant staging, not a pull
+    return params["embed"][tokens] * scale + table[0]
+
+
+def host_loop(out):
+    # OUTSIDE any trace: asarray/item are the normal host epilogue
+    arr = np.asarray(out)
+    return int(arr.sum().item())

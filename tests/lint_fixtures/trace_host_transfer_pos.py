@@ -1,0 +1,13 @@
+"""POSITIVE: host transfers on traced values inside a jit body."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(params, tokens):
+    x = params["embed"][tokens]
+    host = np.asarray(x)              # traced value pulled to host
+    n = tokens.sum().item()           # sync scalar fetch in-trace
+    y = jax.device_put(host)          # placement inside the trace
+    y.block_until_ready()             # device sync inside the trace
+    return y * n

@@ -1,0 +1,14 @@
+"""NEGATIVE: the key is an operand; per-step streams come from
+fold_in on traced counters (the round-14 counter-based design)."""
+import jax
+
+
+@jax.jit
+def step(x, key, counter):
+    k = jax.random.fold_in(key, counter)
+    return x + jax.random.uniform(k, x.shape)
+
+
+def make_key(seed):
+    # host-side construction is exactly where PRNGKey belongs
+    return jax.random.PRNGKey(seed)

@@ -1,0 +1,9 @@
+"""POSITIVE: PRNGKey constructed inside the traced body (seed baked
+into the module; retrace per seed)."""
+import jax
+
+
+@jax.jit
+def step(x, seed):
+    key = jax.random.PRNGKey(0)
+    return x + jax.random.uniform(key, x.shape)

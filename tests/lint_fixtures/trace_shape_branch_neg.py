@@ -1,0 +1,18 @@
+"""NEGATIVE: branching on static closure config is fine; descriptors
+are traced data selected with jnp.where."""
+import jax
+import jax.numpy as jnp
+
+USE_FUSED = True
+
+
+def build(span_q):
+    @jax.jit
+    def step(x, q_lens):
+        if USE_FUSED:                 # static config, not an operand
+            x = x * 2
+        if span_q > 8:                # static closure int
+            x = x + 1
+        # value-dependent selection stays traced data:
+        return jnp.where(q_lens[:, None] > 0, x, 0.0)
+    return step

@@ -1,0 +1,12 @@
+"""POSITIVE: Python control flow on a traced operand's shape — every
+distinct shape compiles another variant."""
+import jax
+
+
+@jax.jit
+def step(x, table):
+    if x.shape[0] > 4:                # shape-specialized variant
+        x = x * 2
+    while len(table) > x.size:        # and another one
+        table = table[:-1]
+    return x, table

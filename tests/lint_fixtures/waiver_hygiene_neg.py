@@ -1,0 +1,10 @@
+"""NEGATIVE: a well-formed waiver — named rule, real reason — both
+passes hygiene and suppresses its finding."""
+import jax
+
+
+@jax.jit
+def export_step(x):
+    # graftlint: waive[trace-prngkey] -- deterministic export fixture: the pinned key is the point
+    key = jax.random.PRNGKey(0)
+    return x + jax.random.uniform(key, x.shape)

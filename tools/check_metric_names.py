@@ -1,310 +1,30 @@
 #!/usr/bin/env python
-"""Metric-name lint (run in the verify flow; see tests/test_observability
-``test_metric_name_lint``).
+"""Metric-name lint — thin shim over the graftlint rule registry.
 
-Statically scans every registration site — ``counter("...")`` /
-``gauge("...")`` / ``histogram("...")`` with a literal first argument —
-under ``paddle_tpu/``, ``tools/`` and ``bench.py``, and enforces the
-repo's metric-naming contract:
-
-1. names are snake_case (``[a-z][a-z0-9_]*``);
-2. counters end in ``_total``; gauges/histograms never do;
-3. base units only: no ``_ms``/``_us``/``_mb``/``_kb``/... suffixes —
-   durations are ``_seconds``, sizes are ``_bytes``;
-4. the unit is the SUFFIX: a name containing ``seconds``/``bytes``
-   anywhere else (before ``_total`` for counters) is malformed;
-5. one name, one type: the same name registered as two different kinds
-   anywhere in the tree is an error (the runtime registry would also
-   raise, but only when both sites actually execute);
-6. required families: the serving engine's contract metrics (the
-   bucketed-prefill/prefix-cache set the round-10 bench gates on) must
-   exist somewhere in the scan — a rename that silently drops one is an
-   error here, not a dashboard surprise;
-7. label CARDINALITY (round 16): every label name used at a
-   ``.labels(...)`` call site must be declared in ``LABEL_DOMAINS``
-   with a finite value set (or the DYNAMIC sentinel for label values
-   that are bounded by deployment shape, e.g. engine ids); literal
-   values must be members of the declared set, and any value
-   expression that smells of a per-request identifier (``req_id`` /
-   ``rid`` / ``request_id`` / ``uuid``) is rejected outright — a
-   per-request label value is an unbounded time-series leak, the one
-   mistake a metrics registry cannot survive in production.
-
-Pure stdlib + no jax import: safe to run anywhere, exits non-zero with
-one line per violation.
+The implementation moved to ``tools/graftlint/metric_names.py`` (the
+``metric-names`` rule of ``tools/lint.py``); this CLI keeps its exact
+behavior — exit 0 with "... 0 violations" when clean, exit 1 with one
+line per violation, ``--list`` prints every registered metric name —
+for the verify flow and tests/test_observability.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-SCAN = ["paddle_tpu", "tools", "bench.py"]
-
-# .counter(" / counter(' / r.histogram(  ... with a literal first arg
-# (possibly on the next line)
-_REG_RE = re.compile(
-    r"\b(counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_.\-]+)[\"']")
-
-_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-
-_BANNED_SUFFIXES = ("_ms", "_msec", "_millis", "_us", "_micros", "_ns",
-                    "_minutes", "_hours", "_kb", "_mb", "_gb", "_kib",
-                    "_mib", "_gib")
-
-# contract metrics external dashboards/benches key on: the serving
-# engine must keep registering these names (see BENCH_SERVE_r10.json
-# provenance; README "Observability" inventory)
-REQUIRED_NAMES = frozenset({
-    "serving_prefill_compiles_total",
-    "serving_prefill_chunk_queue_depth",
-    "serving_prefix_cache_lookups_total",
-    "serving_prefix_cache_hit_tokens_total",
-    "serving_prefix_cache_evictions_total",
-    "serving_prefill_duration_seconds",
-    "serving_ttft_seconds",
-    # fused mixed prefill+decode step (round-11; BENCH_SERVE_r11.json)
-    "serving_mixed_step_compiles_total",
-    "serving_mixed_span_tokens_total",
-    # tensor-parallel multichip serving (round-12; BENCH_SERVE_r12.json)
-    "serving_tp_degree",
-    "serving_tp_collective_bytes_total",
-    # quantized serving (round-13; BENCH_QUANT_r13.json)
-    "serving_kv_quant_dtype",
-    "serving_quant_collective_bytes_total",
-    "serving_quant_token_mismatch_total",
-    # sampling + speculative decoding (round-14; BENCH_SPEC_r14.json)
-    "serving_sampling_mode",
-    "serving_spec_proposed_tokens_total",
-    "serving_spec_accepted_tokens_total",
-    "serving_spec_draft_step_duration_seconds",
-    # multi-engine serving router (round-15; BENCH_ROUTER_r15.json)
-    "router_requests_total",
-    "router_prefix_route_hits_total",
-    "router_requeues_total",
-    "router_engine_healthy",
-    "router_pending_depth",
-    # request tracing + SLO attainment (round-16; BENCH_TRACE_r16.json)
-    "router_slo_attained_total",
-    "router_latency_quantile_seconds",
-    "request_trace_spans_total",
-    "request_trace_dropped_spans_total",
-})
-
-# ---------------------------------------------------------------------------
-# label-cardinality contract (round 16)
-# ---------------------------------------------------------------------------
-# sentinel: values are dynamic expressions but drawn from a set bounded
-# by deployment shape (engine ids = the pool size), never per-request
-DYNAMIC = object()
-
-# the ONE declaration of every label name's finite value domain; a
-# label name not in this table may not appear at any .labels() site
-LABEL_DOMAINS = {
-    "outcome": frozenset({"completed", "truncated", "rejected",
-                          "hit", "miss",
-                          "attained", "missed", "no_target"}),
-    "reason": frozenset({"preempt", "engine_lost"}),
-    "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
-    "op": frozenset({"psum", "all_gather"}),
-    "q": frozenset({"p50", "p95", "p99"}),
-    "engine": DYNAMIC,              # engine ids: bounded by pool size
-    "metric": DYNAMIC,              # bench line names: bounded by the
-                                    # bench's own mode set
-    "unit": DYNAMIC,                # bench units: one per bench line
-}
-
-# expressions that smell of per-request identity: unbounded cardinality
-_FORBIDDEN_VALUE_RE = re.compile(
-    r"\breq_id\b|\brequest_id\b|\brid\b|\buuid\b|\breq\.req_id\b",
-    re.IGNORECASE)
-
-# .labels( ... ) with one nesting level of parens inside (str(...) etc.)
-_LABELS_RE = re.compile(
-    r"\.labels\(\s*([^()]*(?:\([^()]*\)[^()]*)*)\)", re.DOTALL)
-
-_STR_LIT_RE = re.compile(r"""["']([^"']*)["']""")
-
-
-def _split_kwargs(arglist: str):
-    """Split a .labels(...) argument string on top-level commas,
-    yielding (name, expr) pairs; tolerant of nested parens/quotes."""
-    parts, depth, quote, cur = [], 0, None, []
-    for ch in arglist:
-        if quote:
-            cur.append(ch)
-            if ch == quote:
-                quote = None
-            continue
-        if ch in "\"'":
-            quote = ch
-            cur.append(ch)
-        elif ch in "([{":
-            depth += 1
-            cur.append(ch)
-        elif ch in ")]}":
-            depth -= 1
-            cur.append(ch)
-        elif ch == "," and depth == 0:
-            parts.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    if cur:
-        parts.append("".join(cur))
-    out = []
-    for p in parts:
-        if "=" not in p:
-            continue                       # positional/odd: skip
-        name, expr = p.split("=", 1)
-        out.append((name.strip(), expr.strip()))
-    return out
-
-
-def find_label_sites():
-    """[(relpath, lineno, label_name, value_expr)] for every kwarg of
-    every ``.labels(...)`` call under the scan roots."""
-    out = []
-    for top in SCAN:
-        path = os.path.join(REPO, top)
-        if os.path.isfile(path):
-            files = [path]
-        else:
-            files = []
-            for root, _dirs, names in os.walk(path):
-                files += [os.path.join(root, n) for n in names
-                          if n.endswith(".py")]
-        for fpath in sorted(files):
-            if os.path.abspath(fpath) == os.path.abspath(__file__):
-                continue
-            try:
-                with open(fpath, encoding="utf-8") as f:
-                    text = f.read()
-            except OSError:
-                continue
-            rel = os.path.relpath(fpath, REPO)
-            for m in _LABELS_RE.finditer(text):
-                line = text.count("\n", 0, m.start()) + 1
-                for name, expr in _split_kwargs(m.group(1)):
-                    out.append((rel, line, name, expr))
-    return out
-
-
-def lint_label_sites(sites):
-    """Violations of the label-cardinality contract (rule 7)."""
-    errors = []
-    for rel, line, name, expr in sites:
-        where = f"{rel}:{line}"
-        domain = LABEL_DOMAINS.get(name)
-        if domain is None:
-            errors.append(
-                f"{where}: label {name!r} is not declared in "
-                f"LABEL_DOMAINS — declare its finite value set (or "
-                f"DYNAMIC with a boundedness argument)")
-            continue
-        if _FORBIDDEN_VALUE_RE.search(expr):
-            errors.append(
-                f"{where}: label {name!r} value {expr!r} is derived "
-                f"from a per-request identifier — unbounded series "
-                f"cardinality")
-            continue
-        if domain is DYNAMIC:
-            continue
-        literals = _STR_LIT_RE.findall(expr)
-        for lit in literals:
-            if lit not in domain:
-                errors.append(
-                    f"{where}: label {name!r} value {lit!r} is outside "
-                    f"its declared domain {sorted(domain)}")
-    return errors
-
-
-def find_registrations() -> List[Tuple[str, int, str, str]]:
-    """[(relpath, lineno, kind, name)] for every literal registration."""
-    out = []
-    for top in SCAN:
-        path = os.path.join(REPO, top)
-        if os.path.isfile(path):
-            files = [path]
-        else:
-            files = []
-            for root, _dirs, names in os.walk(path):
-                files += [os.path.join(root, n) for n in names
-                          if n.endswith(".py")]
-        for fpath in sorted(files):
-            if os.path.abspath(fpath) == os.path.abspath(__file__):
-                continue       # the docstring's own examples
-            try:
-                with open(fpath, encoding="utf-8") as f:
-                    text = f.read()
-            except OSError:
-                continue
-            for m in _REG_RE.finditer(text):
-                kind, name = m.group(1), m.group(2)
-                line = text.count("\n", 0, m.start()) + 1
-                out.append((os.path.relpath(fpath, REPO), line, kind,
-                            name))
-    return out
-
-
-def lint(regs) -> List[str]:
-    errors = []
-
-    def err(where, msg):
-        errors.append(f"{where[0]}:{where[1]}: {msg}")
-
-    kinds: Dict[str, Tuple[str, Tuple[str, int]]] = {}
-    for rel, line, kind, name in regs:
-        where = (rel, line)
-        if not _SNAKE_RE.match(name):
-            err(where, f"{name!r} is not snake_case")
-            continue
-        if kind == "counter" and not name.endswith("_total"):
-            err(where, f"counter {name!r} must end in '_total'")
-        if kind != "counter" and name.endswith("_total"):
-            err(where, f"{kind} {name!r}: '_total' is reserved for "
-                       f"counters")
-        base = name[:-len("_total")] if name.endswith("_total") else name
-        for suf in _BANNED_SUFFIXES:
-            if base.endswith(suf):
-                err(where, f"{name!r} uses a non-base unit {suf!r}; "
-                           f"use '_seconds' / '_bytes'")
-        for unit in ("seconds", "bytes"):
-            if unit in base.split("_") and not base.endswith(unit):
-                err(where, f"{name!r}: unit '{unit}' must be the "
-                           f"suffix (before '_total' for counters)")
-        seen = kinds.get(name)
-        if seen is None:
-            kinds[name] = (kind, where)
-        elif seen[0] != kind:
-            err(where, f"{name!r} registered as {kind} here but as "
-                       f"{seen[0]} at {seen[1][0]}:{seen[1][1]}")
-    for name in sorted(REQUIRED_NAMES - set(kinds)):
-        errors.append(f"<scan>: required metric {name!r} is registered "
-                      f"nowhere under {SCAN}")
-    return errors
-
-
-def main() -> int:
-    regs = find_registrations()
-    errors = lint(regs) + lint_label_sites(find_label_sites())
-    uniq = sorted({name for _, _, _, name in regs})
-    if errors:
-        for e in errors:
-            print(f"check_metric_names: {e}", file=sys.stderr)
-        print(f"check_metric_names: FAILED — {len(errors)} violation(s) "
-              f"across {len(regs)} registration sites", file=sys.stderr)
-        return 1
-    print(f"check_metric_names: OK — {len(regs)} registration sites, "
-          f"{len(uniq)} metric names, 0 violations")
-    if "--list" in sys.argv:
-        for name in uniq:
-            print(f"  {name}")
-    return 0
-
+# balanced path shim: importers (tests) may manage sys.path themselves
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+try:
+    from graftlint.metric_names import (      # noqa: E402,F401
+        DYNAMIC, LABEL_DOMAINS, REQUIRED_NAMES, SCAN, _split_kwargs,
+        find_label_sites, find_registrations, lint, lint_label_sites,
+        main)
+finally:
+    try:
+        sys.path.remove(_TOOLS)
+    except ValueError:                        # pragma: no cover
+        pass
 
 if __name__ == "__main__":
     sys.exit(main())

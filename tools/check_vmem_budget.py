@@ -1,102 +1,29 @@
 #!/usr/bin/env python
-"""Static VMEM-budget check for the hand-written Pallas kernels (round
-17; wired like ``check_metric_names.py`` — runs in the verify flow via
-``tests/test_attention.py::test_vmem_budget_lint``).
+"""Static VMEM-budget check — thin shim over the graftlint rule
+registry.
 
-Every kernel's worst-case per-core VMEM footprint is computed from its
-TILE SHAPES (``ops/pallas_kernels.kernel_vmem_report``: span_q query
-window + 2× double-buffered page DMA buffers + online-softmax
-accumulators + score tiles, lane/sublane-padded the way Mosaic pads
-them) at the declared serving/training envelope, and gated against the
-per-core budget below.  A tile-size edit — a wider span window, a
-bigger flash block, a third DMA slot — that blows the budget fails HERE
-with one line per violation instead of as a Mosaic allocation error on
-the first TPU run.
-
-Budgets: the bench hardware (TPU v5e) has 128 MiB of VMEM per core;
-the compiler needs headroom for spills and its own operand pipelining,
-so each kernel is capped at HALF the core (64 MiB) and the serving
-kernels — which must coexist with the fused step's other fusions — at
-an eighth (16 MiB, the classic per-core figure older generations
-actually have).
-
-Exit: 0 with a one-line OK summary; 1 with one line per violation.
+The implementation moved to ``tools/graftlint/vmem.py`` (the
+``vmem-budget`` rule of ``tools/lint.py``); this CLI keeps its exact
+behavior — exit 0 with a one-line OK summary, exit 1 with one line per
+violation, ``--list`` prints the per-kernel table — for the verify flow
+and tests/test_attention.
 """
 from __future__ import annotations
 
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-
-MIB = 1 << 20
-
-# per-core VMEM of the bench target (v5e); older parts have 16 MiB
-VMEM_PER_CORE = 128 * MIB
-
-# kernel family -> declared cap.  The serving kernels get the
-# conservative 16 MiB cap (they must also run on 16 MiB parts and
-# coexist with the fused serving step); the training flash kernels are
-# v5e-class and get half a core.
-BUDGETS = {
-    "ragged_paged_fp32": 16 * MIB,
-    "ragged_paged_int8": 16 * MIB,
-    "paged_decode_fp32": 16 * MIB,
-    "paged_decode_int8": 16 * MIB,
-    "rope_qkv_epilogue": 16 * MIB,
-    "flash_fwd": 64 * MIB,
-    "flash_bwd_fused": 64 * MIB,
-}
-
-
-def check(report=None):
-    """[(kernel, bytes, budget, ok)] rows + [violation strings]."""
-    if report is None:
-        from paddle_tpu.ops.pallas_kernels import kernel_vmem_report
-        report = kernel_vmem_report()
-    rows, errors = [], []
-    for name in sorted(report):
-        used = int(report[name])
-        budget = BUDGETS.get(name)
-        if budget is None:
-            errors.append(
-                "%s: kernel family has no declared budget — add it to "
-                "tools/check_vmem_budget.py BUDGETS" % name)
-            continue
-        ok = used <= budget
-        rows.append((name, used, budget, ok))
-        if not ok:
-            errors.append(
-                "%s: worst-case VMEM %.2f MiB exceeds the declared "
-                "%.0f MiB budget — shrink the tile (or, for a new "
-                "hardware target, raise the budget with a comment)"
-                % (name, used / MIB, budget / MIB))
-    for name in sorted(set(BUDGETS) - set(report)):
-        errors.append(
-            "%s: declared budget has no kernel in kernel_vmem_report — "
-            "remove it or fix the report" % name)
-    return rows, errors
-
-
-def main() -> int:
-    rows, errors = check()
-    if errors:
-        for e in errors:
-            print(f"check_vmem_budget: {e}", file=sys.stderr)
-        print(f"check_vmem_budget: FAILED — {len(errors)} violation(s)",
-              file=sys.stderr)
-        return 1
-    worst = max(rows, key=lambda r: r[1] / r[2])
-    print("check_vmem_budget: OK — %d kernels within budget, 0 "
-          "violations (worst: %s at %.2f/%.0f MiB)"
-          % (len(rows), worst[0], worst[1] / MIB, worst[2] / MIB))
-    if "--list" in sys.argv:
-        for name, used, budget, _ok in rows:
-            print("  %-20s %8.2f MiB / %3.0f MiB"
-                  % (name, used / MIB, budget / MIB))
-    return 0
-
+# balanced path shim: importers (tests) may manage sys.path themselves
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+try:
+    from graftlint.vmem import (              # noqa: E402,F401
+        BUDGETS, MIB, VMEM_PER_CORE, check, main)
+finally:
+    try:
+        sys.path.remove(_TOOLS)
+    except ValueError:                        # pragma: no cover
+        pass
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,626 @@
+"""Host-plane concurrency rules (AST family 3).
+
+The repo's serving/observability/distributed control plane is ~14
+threaded modules (router + tracer + metrics + checkpoint manager +
+watchdogs + stores ...), each guarding shared state with a per-object
+``threading.Lock``.  The runtime tests exercise the happy paths; these
+rules check the *discipline* statically:
+
+- **conc-unguarded-write**: per class that owns a lock, build the
+  field-access map — which attributes are touched from thread-spawning
+  or callback contexts (``Thread(target=...)`` methods and their
+  transitive self-call closure, ``threading.Thread`` subclass ``run``,
+  executor ``submit`` / ``add_done_callback`` / ``Timer`` targets,
+  and thread-target closures) — and flag every mutation of such a
+  shared attribute that is not under a ``with self.<lock>`` block (or
+  a manual ``acquire()``).  Mutations are assignments, augmented
+  assignments, ``del``, subscript stores and the standard container
+  mutators (``append``/``update``/``pop``/...).  ``__init__`` is
+  exempt (construction happens-before sharing).
+- **conc-lock-order**: build the lock-acquisition-order graph — a
+  ``with`` on lock B nested inside a ``with`` on lock A is an A→B
+  edge, and calling a method (of this class or of a composed
+  lock-owning class) that may acquire B while holding A is also an
+  A→B edge — and flag every cycle.  A self-edge on a plain
+  (non-reentrant) ``Lock`` is the classic self-deadlock: holding
+  ``self._lock`` while calling a sibling method that takes
+  ``self._lock`` again.
+
+The analysis is flow-insensitive and intentionally over-approximate;
+real-but-benign races get a reasoned ``waive[...]`` at the site, which
+doubles as documentation of the happens-before argument.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Rule, SourceFile, register
+
+__all__ = ["analyze_classes", "findings_for_snippet"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_REENTRANT = {"RLock", "Condition"}   # Condition wraps an RLock by default
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
+             "appendleft", "clear", "update", "add", "discard",
+             "setdefault", "popitem", "sort", "reverse"}
+_CALLBACK_SINKS = {"add_done_callback", "submit", "Timer",
+                   "call_later", "register"}
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' for the exact expression ``self.x``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _call_tail(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+@dataclass
+class Write:
+    attr: str
+    line: int
+    guarded: bool
+    desc: str                      # "assign" / ".append()" / ...
+    in_closure: bool = False
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    writes: List[Write] = field(default_factory=list)
+    reads: Set[str] = field(default_factory=set)
+    closure_touched: Set[str] = field(default_factory=set)
+    direct_acquires: List[Tuple[str, int]] = field(default_factory=list)
+    held_calls: List[Tuple[str, ast.Call, int]] = field(
+        default_factory=list)       # (held lock id, call node, line)
+    self_calls: Set[str] = field(default_factory=set)
+    thread_targets: Set[str] = field(default_factory=set)  # method names
+    spawns_closure_thread: bool = False
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    node: ast.ClassDef
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> ctor
+    methods: Dict[str, MethodInfo] = field(default_factory=dict)
+    composed: Dict[str, str] = field(default_factory=dict)  # attr -> cls
+    thread_subclass: bool = False
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+def _is_thread_base(base: ast.expr) -> bool:
+    tail = _call_tail(base) or (base.id if isinstance(base, ast.Name)
+                                else None)
+    return tail == "Thread"
+
+
+def _find_locks_and_composition(ci: ClassInfo,
+                                known_classes: Set[str]) -> None:
+    for node in ast.walk(ci.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        tail = _call_tail(node.value.func)
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            if tail in _LOCK_CTORS:
+                ci.locks[attr] = tail
+            elif tail in known_classes:
+                ci.composed[attr] = tail
+
+
+class _MethodScanner:
+    """One method's field-access map: writes (with guard state), reads,
+    lock acquisitions, calls made while holding a lock, thread/callback
+    targets.  Closures (nested defs/lambdas) are scanned with guard
+    state RESET — they run later, outside the enclosing ``with``."""
+
+    def __init__(self, ci: ClassInfo, mi: MethodInfo,
+                 module_locks: Set[str]):
+        self.ci = ci
+        self.mi = mi
+        self.module_locks = module_locks
+
+    def _lock_of(self, expr: ast.expr) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.ci.locks:
+            return self.ci.lock_id(attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+    # -- statement walk -----------------------------------------------------
+    def scan(self) -> None:
+        self._scan_block(self.mi.node.body, held=[], closure=False)
+
+    def _scan_block(self, stmts, held: List[str], closure: bool) -> None:
+        manual: List[str] = []         # self._lock.acquire() in this block
+        for stmt in stmts:
+            self._scan_stmt(stmt, held + manual, closure, manual)
+
+    def _scan_stmt(self, stmt, held: List[str], closure: bool,
+                   manual: List[str]) -> None:
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            entered = list(held)
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.mi.direct_acquires.append(
+                        (lock, stmt.lineno))
+                    for h in entered:
+                        # h == lock is the direct self-deadlock edge
+                        _EDGES.append((h, lock, self.ci.rel,
+                                       stmt.lineno))
+                    entered = entered + [lock]
+            self._scan_block(stmt.body, entered, closure)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closure: runs later — guard state does not carry in
+            self._note_closure(stmt)
+            self._scan_block(stmt.body, held=[], closure=True)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, held, closure)
+            self._scan_block(stmt.body, held, closure)
+            self._scan_block(stmt.orelse, held, closure)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_target_write(stmt.target, held, closure)
+            self._scan_expr(stmt.iter, held, closure)
+            self._scan_block(stmt.body, held, closure)
+            self._scan_block(stmt.orelse, held, closure)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_block(stmt.body, held, closure)
+            for h in stmt.handlers:
+                self._scan_block(h.body, held, closure)
+            self._scan_block(stmt.orelse, held, closure)
+            self._scan_block(stmt.finalbody, held, closure)
+            return
+        # -- leaf statements --------------------------------------------
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._scan_target_write(tgt, held, closure)
+            self._scan_expr(stmt.value, held, closure)
+            return
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            self._scan_target_write(stmt.target, held, closure,
+                                    aug=True)
+            if getattr(stmt, "value", None) is not None:
+                self._scan_expr(stmt.value, held, closure)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    self._add_write(attr, tgt.lineno, held, closure,
+                                    "del")
+            return
+        if isinstance(stmt, ast.Expr):
+            call = stmt.value
+            if isinstance(call, ast.Call):
+                tail = _call_tail(call.func)
+                if tail == "acquire" and isinstance(call.func,
+                                                    ast.Attribute):
+                    lock = self._lock_of(call.func.value)
+                    if lock is not None:
+                        self.mi.direct_acquires.append(
+                            (lock, stmt.lineno))
+                        for h in held:
+                            _EDGES.append((h, lock, self.ci.rel,
+                                           stmt.lineno))
+                        manual.append(lock)
+                        return
+                if tail == "release" and isinstance(call.func,
+                                                    ast.Attribute):
+                    lock = self._lock_of(call.func.value)
+                    if lock is not None and lock in manual:
+                        manual.remove(lock)
+                        return
+            self._scan_expr(stmt.value, held, closure)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value, held, closure)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held, closure)
+
+    def _scan_target_write(self, tgt: ast.expr, held: List[str],
+                           closure: bool, aug: bool = False) -> None:
+        attr = _self_attr(tgt)
+        if attr is not None:
+            self._add_write(attr, tgt.lineno, held, closure,
+                            "augassign" if aug else "assign")
+            return
+        if isinstance(tgt, ast.Subscript):
+            attr = _self_attr(tgt.value)
+            if attr is not None:
+                self._add_write(attr, tgt.lineno, held, closure,
+                                "item-assign")
+            else:
+                self._scan_expr(tgt.value, held, closure)
+            self._scan_expr(tgt.slice, held, closure)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._scan_target_write(el, held, closure, aug=aug)
+            return
+        self._scan_expr(tgt, held, closure)
+
+    def _scan_expr(self, expr: ast.expr, held: List[str],
+                   closure: bool) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                self._note_closure(node)
+            if isinstance(node, ast.Call):
+                self._scan_call(node, held, closure)
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                attr = _self_attr(node)
+                if attr is not None:
+                    self.mi.reads.add(attr)
+                    if closure:
+                        self.mi.closure_touched.add(attr)
+
+    def _scan_call(self, call: ast.Call, held: List[str],
+                   closure: bool) -> None:
+        func = call.func
+        tail = _call_tail(func)
+        # container mutation through self.<attr>.<mutator>(...)
+        if tail in _MUTATORS and isinstance(func, ast.Attribute):
+            attr = _self_attr(func.value)
+            if attr is not None:
+                self._add_write(attr, call.lineno, held, closure,
+                                f".{tail}()")
+        # thread spawn / callback registration
+        if tail == "Thread" or tail in _CALLBACK_SINKS:
+            cands = list(call.args) + [kw.value for kw in call.keywords
+                                       if kw.arg in (None, "target",
+                                                     "function")]
+            if tail in ("submit", "add_done_callback", "register",
+                        "call_later", "Timer"):
+                cands = list(call.args) + [kw.value
+                                           for kw in call.keywords]
+            for arg in cands:
+                m = _self_attr(arg)
+                if m is not None:
+                    self.mi.thread_targets.add(m)
+                elif isinstance(arg, (ast.Lambda, ast.Name)):
+                    # local closure / lambda target: its touches are
+                    # thread-context touches of this class
+                    self.mi.spawns_closure_thread = True
+        # method call while holding a lock (self-deadlock / lock order)
+        if isinstance(func, ast.Attribute):
+            m = _self_attr(func)
+            if m is not None:
+                self.mi.self_calls.add(m)
+                if held:
+                    for h in held:
+                        self.mi.held_calls.append((h, call, call.lineno))
+            else:
+                # composed-object call while holding: self.<attr>.m()
+                owner = _self_attr(func.value)
+                if owner is not None and held:
+                    for h in held:
+                        self.mi.held_calls.append((h, call, call.lineno))
+
+    def _note_closure(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            attr = _self_attr(sub) if isinstance(sub, ast.Attribute) \
+                else None
+            if attr is not None:
+                self.mi.closure_touched.add(attr)
+
+    def _add_write(self, attr: str, line: int, held: List[str],
+                   closure: bool, desc: str) -> None:
+        if attr in self.ci.locks:
+            return                 # re-binding the lock itself: not data
+        self.mi.writes.append(Write(attr, line, bool(held), desc,
+                                    in_closure=closure))
+        if closure:
+            self.mi.closure_touched.add(attr)
+
+
+# module-global edge sink, reset per analysis run
+_EDGES: List[Tuple[str, str, str, int]] = []
+
+
+def _collect_classes(sources: List[SourceFile]) -> List[ClassInfo]:
+    # pass 1: class names with locks anywhere in the tree (for
+    # composition edges across modules)
+    prelim: Dict[str, ast.ClassDef] = {}
+    per_file: List[Tuple[SourceFile, List[ast.ClassDef],
+                         Set[str]]] = []
+    for src in sources:
+        tree = src.tree
+        if tree is None:
+            continue
+        classes = [n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef)]
+        module_locks = set()
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _call_tail(node.value.func) in _LOCK_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_locks.add(tgt.id)
+        per_file.append((src, classes, module_locks))
+        for c in classes:
+            for n in ast.walk(c):
+                if isinstance(n, ast.Assign) and \
+                        isinstance(n.value, ast.Call) and \
+                        _call_tail(n.value.func) in _LOCK_CTORS and \
+                        any(_self_attr(t) for t in n.targets):
+                    prelim[c.name] = c
+                    break
+    known = set(prelim)
+    out: List[ClassInfo] = []
+    for src, classes, module_locks in per_file:
+        for c in classes:
+            ci = ClassInfo(c.name, src.rel, c)
+            ci.thread_subclass = any(_is_thread_base(b) for b in c.bases)
+            _find_locks_and_composition(ci, known)
+            if not ci.locks and not ci.thread_subclass:
+                continue
+            for item in c.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    mi = MethodInfo(item.name, item)
+                    _MethodScanner(ci, mi, module_locks).scan()
+                    ci.methods[item.name] = mi
+            out.append(ci)
+    return out
+
+
+def _thread_context_methods(ci: ClassInfo) -> Set[str]:
+    ctx: Set[str] = set()
+    for mi in ci.methods.values():
+        ctx |= {t for t in mi.thread_targets if t in ci.methods}
+    if ci.thread_subclass and "run" in ci.methods:
+        ctx.add("run")
+    # transitive self-call closure: a helper invoked from the monitor
+    # loop runs on the monitor thread
+    changed = True
+    while changed:
+        changed = False
+        for name in list(ctx):
+            for callee in ci.methods[name].self_calls:
+                if callee in ci.methods and callee not in ctx:
+                    ctx.add(callee)
+                    changed = True
+    return ctx
+
+
+def _shared_attrs(ci: ClassInfo, ctx: Set[str]) -> Set[str]:
+    shared: Set[str] = set()
+    for name in ctx:
+        mi = ci.methods[name]
+        shared |= mi.reads
+        shared |= {w.attr for w in mi.writes}
+    for mi in ci.methods.values():
+        if mi.spawns_closure_thread:
+            shared |= mi.closure_touched
+    return shared - set(ci.locks) - set(ci.methods)
+
+
+def _unguarded_write_findings(classes: List[ClassInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for ci in classes:
+        if not ci.locks:
+            continue               # Thread subclass without a lock:
+                                   # nothing declared to check against
+        ctx = _thread_context_methods(ci)
+        has_threads = bool(ctx) or any(
+            m.spawns_closure_thread or m.thread_targets
+            for m in ci.methods.values())
+        if not has_threads:
+            continue               # lock may guard external callers
+                                   # only; without an in-class thread
+                                   # context the map has no other side
+        shared = _shared_attrs(ci, ctx)
+        lock_names = "/".join(sorted(ci.locks))
+        for mname, mi in ci.methods.items():
+            if mname == "__init__":
+                continue
+            for w in mi.writes:
+                if w.attr not in shared or w.guarded:
+                    continue
+                whence = "thread context" if mname in ctx else \
+                    "a method racing the thread context"
+                out.append(Finding(
+                    "conc-unguarded-write", ci.rel, w.line,
+                    f"{ci.name}.{mname}: unguarded {w.desc} to "
+                    f"self.{w.attr}, which is shared with this "
+                    f"class's thread/callback context "
+                    f"({', '.join(sorted(ctx)) or 'closure thread'}) "
+                    f"— write it under self.{lock_names} ({whence})"))
+    return out
+
+
+def _may_acquire(ci: ClassInfo) -> Dict[str, Set[str]]:
+    """method -> every lock id it may take, transitively through
+    same-class self calls."""
+    acq = {name: {l for l, _ in mi.direct_acquires}
+           for name, mi in ci.methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, mi in ci.methods.items():
+            for callee in mi.self_calls:
+                extra = acq.get(callee, set()) - acq[name]
+                if extra:
+                    acq[name] |= extra
+                    changed = True
+    return acq
+
+
+def _lock_order_findings(classes: List[ClassInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    by_name = {ci.name: ci for ci in classes}
+    acq = {ci.name: _may_acquire(ci) for ci in classes}
+    lock_kind: Dict[str, str] = {}
+    for ci in classes:
+        for attr, ctor in ci.locks.items():
+            lock_kind[ci.lock_id(attr)] = ctor
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for a, b, rel, line in _EDGES:
+        edges.setdefault((a, b), (rel, line))
+    # held-call expansion: holding A and calling a method that may
+    # acquire B adds A -> B
+    for ci in classes:
+        for mi in ci.methods.values():
+            for held, call, line in mi.held_calls:
+                func = call.func
+                callee = _self_attr(func)
+                if callee is not None:
+                    for lock in acq[ci.name].get(callee, ()):
+                        edges.setdefault((held, lock), (ci.rel, line))
+                    continue
+                owner = _self_attr(func.value) if \
+                    isinstance(func, ast.Attribute) else None
+                if owner is None:
+                    continue
+                other = ci.composed.get(owner)
+                if other is None or other not in by_name:
+                    continue
+                m = func.attr
+                for lock in acq[other].get(m, ()):
+                    edges.setdefault((held, lock), (ci.rel, line))
+    # self-deadlock: A -> A on a non-reentrant lock
+    for (a, b), (rel, line) in sorted(edges.items()):
+        if a == b and lock_kind.get(a, "Lock") not in _REENTRANT:
+            out.append(Finding(
+                "conc-lock-order", rel, line,
+                f"self-deadlock: a method acquires {a} (a plain "
+                f"Lock) while it is already held on this path — "
+                f"split the locked section or use an RLock"))
+    # cycles across distinct locks
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key in seen_cycles:
+                        continue
+                    seen_cycles.add(key)
+                    rel, line = edges[(path[-1], start)]
+                    out.append(Finding(
+                        "conc-lock-order", rel, line,
+                        "lock-order cycle: "
+                        + " -> ".join(path + [start])
+                        + " — establish one global acquisition order "
+                        "or collapse to a single lock"))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+def analyze_classes(sources: List[SourceFile]) -> List[ClassInfo]:
+    global _EDGES
+    _EDGES = []
+    return _collect_classes(sources)
+
+
+_CACHE: dict = {}
+
+
+def _analysis(sources: List[SourceFile]):
+    # content-keyed (str hashes cache per object): id()/len() keys
+    # would alias distinct or edited scans
+    key = tuple((s.rel, hash(s.text)) for s in sources)
+    if _CACHE.get("key") != key:
+        classes = analyze_classes(sources)
+        _CACHE["key"] = key
+        _CACHE["unguarded"] = _unguarded_write_findings(classes)
+        _CACHE["order"] = _lock_order_findings(classes)
+    return _CACHE
+
+
+def findings_for_snippet(code: str) -> List[Finding]:
+    sources = [SourceFile("<snippet>", code)]
+    classes = analyze_classes(sources)
+    return (_unguarded_write_findings(classes)
+            + _lock_order_findings(classes))
+
+
+def _selftest_unguarded() -> List[Finding]:
+    found = findings_for_snippet(
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+        "    def reset(self):\n"
+        "        self.count = 0\n")   # unguarded shared write
+    return [f for f in found if f.rule == "conc-unguarded-write"]
+
+
+def _selftest_order() -> List[Finding]:
+    found = findings_for_snippet(
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._lb:\n"
+        "            with self._la:\n"
+        "                pass\n")
+    return [f for f in found if f.rule == "conc-lock-order"]
+
+
+register(Rule(
+    id="conc-unguarded-write",
+    family="concurrency",
+    contract="attributes shared with a class's thread/callback context "
+             "are only mutated under the class's lock (__init__ exempt)",
+    check=lambda sources: list(_analysis(sources)["unguarded"]),
+    selftest=_selftest_unguarded,
+))
+
+register(Rule(
+    id="conc-lock-order",
+    family="concurrency",
+    contract="the cross-module lock-acquisition graph is acyclic, and "
+             "no plain Lock is re-acquired on a path that already "
+             "holds it",
+    check=lambda sources: list(_analysis(sources)["order"]),
+    selftest=_selftest_order,
+))

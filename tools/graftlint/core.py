@@ -1,0 +1,259 @@
+"""graftlint core: findings, the rule registry, source scanning and the
+waiver protocol.
+
+Everything here is pure stdlib — importing the core (and the AST rule
+families) must never pull in jax, so the fast lanes of ``tools/lint.py``
+run anywhere in well under a second.  Only the ``hlo-*`` and
+``vmem-budget`` rules import the framework, and they do it inside their
+check functions.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Finding", "Rule", "SourceFile", "register", "get_rule",
+           "iter_rules", "run_rules", "repo_root", "scan_sources",
+           "apply_waivers", "waiver_hygiene_findings", "WAIVE_RE"]
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# roots the AST families scan (tests are fixtures/consumers, not
+# subjects; tools/graftlint itself would self-match its own examples)
+SCAN_ROOTS = ("paddle_tpu", "tools", "bench.py", "__graft_entry__.py")
+SCAN_EXCLUDE = (os.path.join("tools", "graftlint"),)
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site.
+
+    ``path`` is repo-relative for source findings, or an artifact name
+    in angle brackets (``<mixed_step@T8>``) for compiled-artifact
+    findings — those have no source line and cannot be waived inline
+    (fix the contract or the code, there is no third option).
+    """
+    rule: str
+    path: str
+    line: int
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        tag = " [waived: %s]" % self.waive_reason if self.waived else ""
+        return f"{loc}: [{self.rule}] {self.message}{tag}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "waived": self.waived,
+                "waive_reason": self.waive_reason}
+
+
+@dataclass
+class Rule:
+    """One registered contract.
+
+    ``check`` takes the shared list of :class:`SourceFile` and returns
+    findings; ``selftest`` injects one known defect (a synthetic source
+    snippet, a doctored HLO text, a doctored report) and returns the
+    findings the rule produced for it — the runner asserts they are
+    non-empty, so a pass that goes blind fails the suite, not silently.
+    ``slow`` marks rules that build/compile artifacts (skippable via
+    ``--skip hlo-contracts`` for sub-second editor loops).
+    """
+    id: str
+    family: str
+    contract: str
+    check: Callable[[List["SourceFile"]], List[Finding]]
+    selftest: Callable[[], List[Finding]]
+    slow: bool = False
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate graftlint rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return rule
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY[rule_id]
+
+
+def iter_rules() -> List[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# ---------------------------------------------------------------------------
+# source scanning
+# ---------------------------------------------------------------------------
+class SourceFile:
+    """One scanned file: text, split lines and a lazily-parsed AST
+    (shared by every AST rule so each file is read and parsed once per
+    run)."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._tree_err: Optional[str] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self._tree_err is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:          # pragma: no cover
+                self._tree_err = str(e)
+        return self._tree
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def scan_sources(root: Optional[str] = None) -> List[SourceFile]:
+    root = root or repo_root()
+    out: List[SourceFile] = []
+    for top in SCAN_ROOTS:
+        path = os.path.join(root, top)
+        if os.path.isfile(path):
+            files = [path]
+        elif os.path.isdir(path):
+            files = []
+            for dirpath, _dirs, names in os.walk(path):
+                files += [os.path.join(dirpath, n) for n in names
+                          if n.endswith(".py")]
+        else:
+            continue
+        for fpath in sorted(files):
+            rel = os.path.relpath(fpath, root)
+            if any(rel.startswith(ex) for ex in SCAN_EXCLUDE):
+                continue
+            try:
+                with open(fpath, encoding="utf-8") as f:
+                    out.append(SourceFile(rel, f.read()))
+            except OSError:                   # pragma: no cover
+                continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+# `# graftlint: waive[rule-a,rule-b] -- reason`; the reason is REQUIRED
+# (a bare waiver is itself a finding — see waiver_hygiene_findings)
+WAIVE_RE = re.compile(
+    r"#\s*graftlint:\s*waive\[([A-Za-z0-9_.,\-\s]*)\]"
+    r"(?:\s*--\s*(\S.*))?")
+
+
+def _waiver_at(src: SourceFile, lineno: int) -> Optional[Tuple[set, str]]:
+    m = WAIVE_RE.search(src.line_at(lineno))
+    if not m:
+        return None
+    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return rules, (m.group(2) or "").strip()
+
+
+def apply_waivers(findings: List[Finding],
+                  sources: List[SourceFile]) -> None:
+    """Mark findings covered by a reasoned waiver on the finding line or
+    the line directly above.  Reasonless waivers never suppress — they
+    surface through :func:`waiver_hygiene_findings` instead."""
+    by_rel = {s.rel: s for s in sources}
+    for f in findings:
+        src = by_rel.get(f.path)
+        if src is None or not f.line:
+            continue
+        for lineno in (f.line, f.line - 1):
+            got = _waiver_at(src, lineno)
+            if got is None:
+                continue
+            rules, reason = got
+            if f.rule in rules and reason:
+                f.waived = True
+                f.waive_reason = reason
+                break
+
+
+def waiver_hygiene_findings(sources: List[SourceFile]) -> List[Finding]:
+    """Every waiver must carry a rule list and a reason: a bare
+    ``waive[...]`` silences nothing and is flagged here, so "I'll
+    explain later" can never ship."""
+    out = []
+    for src in sources:
+        for i, line in enumerate(src.lines, 1):
+            m = WAIVE_RE.search(line)
+            if m is None:
+                continue
+            rules = [r.strip() for r in m.group(1).split(",")
+                     if r.strip()]
+            reason = (m.group(2) or "").strip()
+            if not rules:
+                out.append(Finding(
+                    "waiver-hygiene", src.rel, i,
+                    "waiver names no rule — use "
+                    "`# graftlint: waive[rule-id] -- reason`"))
+            elif not reason:
+                out.append(Finding(
+                    "waiver-hygiene", src.rel, i,
+                    "bare waiver (no reason) — append "
+                    "`-- <why this is safe here>`"))
+    return out
+
+
+def _hygiene_selftest() -> List[Finding]:
+    src = SourceFile("<selftest>", "x = 1  # graftlint: waive[conc-unguarded-write]\n")
+    return waiver_hygiene_findings([src])
+
+
+register(Rule(
+    id="waiver-hygiene",
+    family="core",
+    contract="every waiver names its rule(s) and carries a non-empty "
+             "`-- reason`; bare waivers are findings, not suppressions",
+    check=lambda sources: waiver_hygiene_findings(sources),
+    selftest=_hygiene_selftest,
+))
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+def run_rules(rule_ids: Optional[Iterable[str]] = None,
+              root: Optional[str] = None,
+              sources: Optional[List[SourceFile]] = None,
+              ) -> Tuple[List[Finding], List[str]]:
+    """Run the selected rules (default: all) over one shared source
+    scan.  Returns ``(findings, internal_errors)`` — an internal error
+    (a rule crashing) is the exit-code-2 path, never a silent skip."""
+    rules = [get_rule(r) for r in rule_ids] if rule_ids is not None \
+        else iter_rules()
+    if sources is None:
+        sources = scan_sources(root)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for rule in rules:
+        try:
+            findings.extend(rule.check(sources))
+        except Exception as e:                # noqa: BLE001
+            import traceback
+            errors.append("rule %s crashed: %s\n%s"
+                          % (rule.id, e, traceback.format_exc()))
+    apply_waivers(findings, sources)
+    return findings, errors

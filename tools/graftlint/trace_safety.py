@@ -1,0 +1,385 @@
+"""Trace-safety rules (AST family 1).
+
+A *traced context* is any function that jax traces instead of running:
+``@jax.jit``-decorated functions, functions handed to ``jax.jit`` /
+``shard_map`` / ``pl.pallas_call`` / the ``lax`` control-flow
+combinators, and — transitively — same-file functions they call by
+name.  Inside those bodies the rules flag the four ways this repo has
+historically broken its serving invariants:
+
+- **trace-host-transfer**: ``np.asarray``/``np.array``/``.item()``/
+  ``jax.device_put``/``.block_until_ready()`` on a *traced value* (an
+  operand or anything dataflow-derived from one).  The round-11 parity
+  work established that transfer COUNT is the decode-latency budget;
+  one stray host pull inside a step body silently serializes the
+  device.  NumPy calls on trace-time *constants* are legitimate
+  (they fold into the module) and are not flagged — taint tracking is
+  what separates the two.
+- **trace-f64-literal**: x64 is globally on (paddle int64 parity), so
+  a ``float64`` dtype string, ``np.float64``/``np.double``, or
+  ``astype(float)`` inside a trace stages a silent f64 op — double the
+  HBM and off the MXU fast path.  The compiled-artifact rule
+  (``hlo-f64``) proves the shipped steps are clean; this rule catches
+  the regression at the line that introduces it.
+- **trace-prngkey**: ``jax.random.PRNGKey`` construction inside a
+  trace bakes the seed into the module — byte-identical "randomness"
+  every call and a retrace per seed change.  Keys are step operands
+  (the round-14 counter-based design); construct them on the host.
+- **trace-shape-branch**: Python ``if``/``while`` on a traced
+  operand's ``.shape``/``.size``/``.ndim``/``len()``.  Shape-dependent
+  control flow specializes the module per shape — the compile-budget
+  invariant (compiles bounded by the declared budget SET) only
+  survives when every descriptor is traced data and the one traced
+  shape is the budget itself.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Rule, SourceFile, register
+
+__all__ = ["analyze_source", "findings_for_snippet"]
+
+# call targets that receive functions to trace (positional or keyword)
+_TRACE_SINKS = {"jit", "pallas_call", "shard_map", "shard_map_compat",
+                "scan", "while_loop", "fori_loop", "cond", "switch",
+                "checkify", "remat", "checkpoint", "named_call"}
+
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_HOST_NP_FUNCS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+
+def _dotted_tail(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    """True for @jit / @jax.jit / @partial(jax.jit, ...) /
+    @jax.jit(...) — any decorator expression that mentions a jit."""
+    for node in ast.walk(dec):
+        if isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit"):
+            return True
+        if isinstance(node, ast.Name) and node.id in ("jit", "pjit"):
+            return True
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """All function defs in a file, by simple name (over-approximate:
+    same-named defs in different scopes alias — acceptable for a
+    lint that errs toward flagging, with waivers as the out)."""
+
+    def __init__(self):
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.funcs: List[ast.AST] = []
+
+    def _add(self, node):
+        self.funcs.append(node)
+        self.by_name.setdefault(node.name, []).append(node)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _add
+    visit_AsyncFunctionDef = _add
+
+
+def _traced_roots(tree: ast.AST, index: _FuncIndex) -> Set[ast.AST]:
+    roots: Set[ast.AST] = set()
+    for fn in index.funcs:
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            roots.add(fn)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _dotted_tail(node.func)
+        if tail not in _TRACE_SINKS:
+            continue
+        cands = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in cands:
+            # walk the whole arg expression: partial(kernel, ...) and
+            # similar wrappers still hand `kernel` to the tracer
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    for fn in index.by_name.get(sub.id, ()):
+                        roots.add(fn)
+    return roots
+
+
+def _propagate(roots: Set[ast.AST], index: _FuncIndex) -> Set[ast.AST]:
+    """Transitive closure: a same-file function called by name from a
+    traced body is itself traced."""
+    traced = set(roots)
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    for callee in index.by_name.get(node.func.id, ()):
+                        if callee not in traced:
+                            traced.add(callee)
+                            changed = True
+    return traced
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Operand taint: the traced function's parameters plus every name
+    assigned from an expression that mentions a tainted name (fixpoint
+    over simple assignments — deliberately flow-insensitive)."""
+    args = fn.args
+    tainted = {a.arg for a in (args.posonlyargs + args.args
+                               + args.kwonlyargs)}
+    if args.vararg:
+        tainted.add(args.vararg.arg)
+    if args.kwarg:
+        tainted.add(args.kwarg.arg)
+    tainted.discard("self")
+    tainted.discard("cls")
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if getattr(node, "value", None) is None:
+                    continue
+                targets, value = [node.target], node.value
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets, value = [node.target], node.iter
+            else:
+                continue
+            if not (_names_in(value) & tainted):
+                continue
+            for tgt in targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name) and n.id not in tainted:
+                        tainted.add(n.id)
+                        changed = True
+    return tainted
+
+
+def _mentions_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    return bool(_names_in(node) & tainted)
+
+
+def _walk_own_body(fn: ast.AST):
+    """Walk a function body without descending into nested defs (each
+    traced function reports its own lines exactly once)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _check_traced_fn(src: SourceFile, fn: ast.AST,
+                     out: List[Finding]) -> None:
+    tainted = _tainted_names(fn)
+    for node in _walk_own_body(fn):
+        # -- trace-host-transfer ----------------------------------------
+        if isinstance(node, ast.Call):
+            func = node.func
+            tail = _dotted_tail(func)
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in _NUMPY_MODULES
+                    and func.attr in _HOST_NP_FUNCS
+                    and any(_mentions_tainted(a, tainted)
+                            for a in node.args)):
+                out.append(Finding(
+                    "trace-host-transfer", src.rel, node.lineno,
+                    f"np.{func.attr}() on a traced value inside a "
+                    f"traced body — a host transfer on the hot path "
+                    f"(transfer COUNT is the decode budget; keep the "
+                    f"value on device or pack it into the step's one "
+                    f"host operand)"))
+            elif tail == "item" and not node.args and \
+                    isinstance(func, ast.Attribute) and \
+                    _mentions_tainted(func.value, tainted):
+                out.append(Finding(
+                    "trace-host-transfer", src.rel, node.lineno,
+                    ".item() on a traced value inside a traced body — "
+                    "synchronous device→host pull on the hot path"))
+            elif tail == "device_put":
+                out.append(Finding(
+                    "trace-host-transfer", src.rel, node.lineno,
+                    "jax.device_put inside a traced body — placement "
+                    "belongs to the caller (in_shardings/donation), "
+                    "not the trace"))
+            elif tail == "block_until_ready":
+                out.append(Finding(
+                    "trace-host-transfer", src.rel, node.lineno,
+                    ".block_until_ready() inside a traced body — a "
+                    "device sync can never belong in the trace"))
+            # -- trace-prngkey ------------------------------------------
+            if tail == "PRNGKey":
+                out.append(Finding(
+                    "trace-prngkey", src.rel, node.lineno,
+                    "PRNGKey construction inside a traced body bakes "
+                    "the seed into the compiled module (and retraces "
+                    "per seed) — thread keys in as operands and "
+                    "fold_in the per-step counter (round-14 design)"))
+            # -- astype(float) under global x64 -------------------------
+            if tail == "astype" and any(
+                    isinstance(a, ast.Name) and a.id == "float"
+                    for a in node.args):
+                out.append(Finding(
+                    "trace-f64-literal", src.rel, node.lineno,
+                    "astype(float) stages float64 (x64 is globally on "
+                    "for paddle parity) — name the dtype: "
+                    "jnp.float32 / the config dtype"))
+        # -- trace-f64-literal ------------------------------------------
+        if isinstance(node, ast.Attribute) and \
+                node.attr in ("float64", "double"):
+            out.append(Finding(
+                "trace-f64-literal", src.rel, node.lineno,
+                f"{node.attr} inside a traced body — x64 is globally "
+                f"on, so this stages a real f64 op (2× HBM, off the "
+                f"MXU path); the compiled steps assert f64-free "
+                f"(hlo-f64)"))
+        if isinstance(node, ast.Constant) and \
+                node.value in ("float64", "double"):
+            out.append(Finding(
+                "trace-f64-literal", src.rel, node.lineno,
+                "dtype string %r inside a traced body — stages f64 "
+                "under global x64" % node.value))
+        if isinstance(node, ast.keyword) and node.arg == "dtype" and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "float":
+            out.append(Finding(
+                "trace-f64-literal", src.rel, node.value.lineno,
+                "dtype=float is float64 under global x64 — name the "
+                "width explicitly"))
+        # -- trace-shape-branch -----------------------------------------
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            hit = False
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr in ("shape", "size", "ndim") and \
+                        _mentions_tainted(sub.value, tainted):
+                    hit = True
+                elif isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and \
+                        sub.func.id == "len" and sub.args and \
+                        _mentions_tainted(sub.args[0], tainted):
+                    hit = True
+            if hit:
+                out.append(Finding(
+                    "trace-shape-branch", src.rel, node.lineno,
+                    "Python control flow on a traced operand's shape — "
+                    "each shape specializes another compiled variant, "
+                    "breaking the budget-bounded compile invariant "
+                    "(compiles are bounded by the declared budget set, "
+                    "nothing else); make the descriptor traced data or "
+                    "hoist the branch to the caller"))
+
+
+def analyze_source(src: SourceFile) -> List[Finding]:
+    tree = src.tree
+    if tree is None:
+        return []
+    index = _FuncIndex()
+    index.visit(tree)
+    traced = _propagate(_traced_roots(tree, index), index)
+    out: List[Finding] = []
+    for fn in sorted(traced, key=lambda f: f.lineno):
+        _check_traced_fn(src, fn, out)
+    return out
+
+
+_CACHE: dict = {}
+
+
+def _check_all(sources: List[SourceFile]) -> List[Finding]:
+    # one AST sweep shared by the family's four registered rules
+    # (content-keyed — str hashes are cached per object, so this is
+    # cheap; id()/len() keys would alias distinct or edited scans)
+    key = tuple((s.rel, hash(s.text)) for s in sources)
+    if _CACHE.get("key") != key:
+        out: List[Finding] = []
+        for src in sources:
+            out.extend(analyze_source(src))
+        _CACHE["key"], _CACHE["findings"] = key, out
+    return _CACHE["findings"]
+
+
+def findings_for_snippet(code: str) -> List[Finding]:
+    """Run the family over one synthetic snippet (self-tests and the
+    fixture sweep)."""
+    return analyze_source(SourceFile("<snippet>", code))
+
+
+def _check_only(rule_id: str):
+    def check(sources: List[SourceFile]) -> List[Finding]:
+        return [f for f in _check_all(sources) if f.rule == rule_id]
+    return check
+
+
+_SELFTEST_SNIPPETS = {
+    "trace-host-transfer": (
+        "import jax, numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return np.asarray(x).sum()\n"),
+    "trace-f64-literal": (
+        "import jax, jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x.astype(jnp.float64)\n"),
+    "trace-prngkey": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    key = jax.random.PRNGKey(0)\n"
+        "    return jax.random.uniform(key, x.shape)\n"),
+    "trace-shape-branch": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x.shape[0] > 4:\n"
+        "        return x * 2\n"
+        "    return x\n"),
+}
+
+
+def _selftest(rule_id: str):
+    def run() -> List[Finding]:
+        found = findings_for_snippet(_SELFTEST_SNIPPETS[rule_id])
+        return [f for f in found if f.rule == rule_id]
+    return run
+
+
+_CONTRACTS = {
+    "trace-host-transfer":
+        "no np.asarray/np.array/.item()/device_put/block_until_ready "
+        "on traced values inside jit/pallas/lax-traced bodies",
+    "trace-f64-literal":
+        "no float64/double dtype staging inside traced bodies (x64 is "
+        "globally on; f64 is 2x HBM and off the MXU path)",
+    "trace-prngkey":
+        "no PRNGKey construction inside traced bodies — keys are step "
+        "operands, folded in on-device",
+    "trace-shape-branch":
+        "no Python if/while on a traced operand's shape/size/len — "
+        "compiles stay bounded by the declared budget set",
+}
+
+for _rid, _contract in _CONTRACTS.items():
+    register(Rule(id=_rid, family="trace-safety", contract=_contract,
+                  check=_check_only(_rid), selftest=_selftest(_rid)))
