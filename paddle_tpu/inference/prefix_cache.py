@@ -27,7 +27,22 @@ reclaim, and only table entries whose page has NO other holder
 request still addresses is never recycled from under it.  Entries are
 dropped oldest-touch first (LRU); evicting a chain's parent merely
 makes longer entries unreachable for matching — they stay individually
-evictable.
+evictable.  Entries SKIPPED because a live request still pins their
+page are counted (``skipped_pinned``) so cache-pressure stalls are
+diagnosable from the eviction metric's outcome label.
+
+Host-RAM spill tier (round 19): with a :class:`HostPageTier` attached,
+an evicted-but-hot prefix page doesn't die — its KV (int8 codes plus
+per-page scale rows, 3.9× denser than fp32) is serialized to a
+bounded, byte-capped host LRU in ONE batched device→host copy
+(``jit/serving_step.extract_blocks``) before the device page returns
+to the free list.  A later ``match`` whose device chain breaks probes
+the tier for the continuation and restores every consecutive spilled
+page with ONE ``inject_blocks`` dispatch — the pages re-enter the
+table under the SAME blake2b digest chain, so prefix capacity is
+bounded by host RAM instead of one engine's HBM.  Restores never evict
+(they only consume already-free device pages), so a full pool degrades
+to plain misses instead of thrashing spill↔restore.
 """
 from __future__ import annotations
 
@@ -37,7 +52,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-__all__ = ["PrefixPageCache"]
+__all__ = ["PrefixPageCache", "HostPageTier"]
 
 
 def _prefix_key(prompt_ids: np.ndarray, end: int) -> bytes:
@@ -46,14 +61,77 @@ def _prefix_key(prompt_ids: np.ndarray, end: int) -> bytes:
         digest_size=16).digest()
 
 
+class HostPageTier:
+    """Bounded host-RAM LRU of spilled prefix pages: digest key → a
+    1-page :class:`~paddle_tpu.ops.paged_attention.KVPageBuffer`.
+    Byte-capped (``capacity_bytes``), oldest-touch evicted — the spill
+    tier is a cache of a cache, so dropping an entry is always safe."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity_bytes = int(capacity_bytes)
+        self.entries: "OrderedDict[bytes, object]" = OrderedDict()
+        self.bytes = 0
+        # spilled-then-aged-out entries (distinct from device eviction)
+        self.tier_evictions = 0
+
+    def put(self, key: bytes, buf) -> bool:
+        """Insert/replace one spilled page; evicts LRU entries until
+        the tier fits its byte cap.  Returns False (and stores
+        nothing) when the single entry alone exceeds the cap."""
+        if buf.nbytes > self.capacity_bytes:
+            return False
+        old = self.entries.pop(key, None)
+        if old is not None:
+            self.bytes -= old.nbytes
+        self.entries[key] = buf
+        self.bytes += buf.nbytes
+        while self.bytes > self.capacity_bytes and self.entries:
+            _k, dropped = self.entries.popitem(last=False)
+            self.bytes -= dropped.nbytes
+            self.tier_evictions += 1
+        return True
+
+    def get(self, key: bytes):
+        buf = self.entries.get(key)
+        if buf is not None:
+            self.entries.move_to_end(key)
+        return buf
+
+    def pop(self, key: bytes):
+        buf = self.entries.pop(key, None)
+        if buf is not None:
+            self.bytes -= buf.nbytes
+        return buf
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self.entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
 class PrefixPageCache:
     """Block-granularity prompt-prefix table over one ``PagedKVCache``
     free-list authority (the engine's layer-0 cache: block ids are
-    shared across layers)."""
+    shared across layers).
 
-    def __init__(self, cache, block_size: int):
+    ``all_caches`` (the engine's full per-layer cache list) plus
+    ``host_tier`` arm the round-19 spill tier: eviction serializes the
+    dropped pages to host RAM, ``match`` restores them on a later hit
+    — both as single batched transfers."""
+
+    def __init__(self, cache, block_size: int, all_caches=None,
+                 host_tier: Optional[HostPageTier] = None):
         self.cache = cache
         self.block_size = block_size
+        self.all_caches = all_caches
+        self.host_tier = host_tier
+        if host_tier is not None and not all_caches:
+            raise ValueError(
+                "PrefixPageCache host_tier needs all_caches (the "
+                "engine's full per-layer cache list): spill/restore "
+                "moves every layer's copy of a page, not just the "
+                "free-list authority's")
         self.table: "OrderedDict[bytes, int]" = OrderedDict()
         self._registered: Set[int] = set()   # block ids the table refs
         # host-side stats (the engine mirrors these into the metrics
@@ -62,23 +140,84 @@ class PrefixPageCache:
         self.misses = 0
         self.hit_tokens = 0
         self.evictions = 0
+        self.skipped_pinned = 0     # evict() passes over a pinned entry
+        self.spills = 0             # pages serialized to the host tier
+        self.host_hits = 0          # lookups that found a spilled page
+        self.restores = 0           # spilled pages injected back
 
     # ---- lookup ---------------------------------------------------------
-    def match(self, prompt_ids: np.ndarray) -> List[int]:
+    def match(self, prompt_ids: np.ndarray,
+              restore: bool = True) -> List[int]:
         """Longest consecutive chain of cached full-page prefixes of
-        ``prompt_ids``.  Side-effect free except LRU touch; the caller
-        decides whether to commit (share_blocks) the hit."""
+        ``prompt_ids``.  With a host tier attached (and ``restore``),
+        a chain that breaks on the device table continues into the
+        spill tier: every consecutive spilled page is restored with ONE
+        batched inject and re-registered — bounded by the free list
+        (restores never evict).  Otherwise side-effect free except LRU
+        touch; the caller decides whether to commit (share_blocks) the
+        hit."""
         bs = self.block_size
         prompt_ids = np.asarray(prompt_ids)
         blocks: List[int] = []
-        for i in range(len(prompt_ids) // bs):
+        n_full = len(prompt_ids) // bs
+        for i in range(n_full):
             key = _prefix_key(prompt_ids, (i + 1) * bs)
             b = self.table.get(key)
             if b is None:
                 break
             self.table.move_to_end(key)
             blocks.append(b)
+        if restore and self.host_tier is not None:
+            blocks.extend(
+                self._restore_chain(prompt_ids, len(blocks), n_full))
         return blocks
+
+    def _restore_chain(self, prompt_ids, start: int,
+                       n_full: int) -> List[int]:
+        """Continue a broken device chain out of the host tier: probe
+        keys ``start..``, restore every consecutive hit (capped by the
+        free list) with one ``inject_blocks`` dispatch, re-register
+        each page under its digest (the table takes the allocated
+        reference, exactly like a registered page)."""
+        bs = self.block_size
+        pending = []
+        for i in range(start, n_full):
+            key = _prefix_key(prompt_ids, (i + 1) * bs)
+            ent = self.host_tier.get(key)
+            if ent is None:
+                break
+            pending.append((key, ent))
+        if not pending:
+            return []
+        self.host_hits += len(pending)
+        # restores never evict: only already-free device pages are used
+        pending = pending[:len(self.cache._free)]
+        if not pending:
+            return []
+        from ..jit.serving_step import inject_blocks
+        from ..ops.paged_attention import KVPageBuffer
+        first = pending[0][1]
+        combined = KVPageBuffer(
+            codes=np.concatenate([e.codes for _, e in pending], axis=1),
+            scales=(np.concatenate([e.scales for _, e in pending],
+                                   axis=1)
+                    if first.scales is not None else None),
+            n_pages=len(pending),
+            n_tokens=len(pending) * self.block_size,
+            block_size=first.block_size,
+            num_kv_heads=first.num_kv_heads, head_dim=first.head_dim,
+            num_layers=first.num_layers, kv_dtype=first.kv_dtype)
+        dest = [self.cache.allocate_block() for _ in pending]
+        inject_blocks(self.all_caches, combined, dest)
+        out: List[int] = []
+        for (key, _ent), b in zip(pending, dest):
+            self.host_tier.pop(key)
+            self.table[key] = b
+            self._registered.add(b)
+            self.table.move_to_end(key)
+            out.append(b)
+        self.restores += len(pending)
+        return out
 
     # ---- registration ---------------------------------------------------
     def register(self, prompt_ids: np.ndarray, block_ids: List[int]):
@@ -111,20 +250,50 @@ class PrefixPageCache:
         """Drop up to ``n`` LRU entries whose page has no other holder,
         returning their pages to the free list.  Entries whose page is
         still shared with a live request are SKIPPED (never reclaimed
-        from under a block table)."""
-        freed = 0
+        from under a block table) and counted in ``skipped_pinned`` —
+        the engine surfaces both outcomes on the eviction counter's
+        label so cache-pressure stalls are diagnosable.
+
+        With a host tier attached, the victims' pages are serialized
+        to host RAM FIRST — all of them in ONE batched device→host
+        extract — then released; a later ``match`` restores them."""
+        victims = []
         for key in list(self.table.keys()):
-            if freed >= n:
+            if len(victims) >= n:
                 break
             b = self.table[key]
             if self.cache.refcount(b) != 1:
+                self.skipped_pinned += 1
                 continue
+            victims.append((key, b))
+        if victims and self.host_tier is not None:
+            self._spill(victims)
+        for key, b in victims:
             del self.table[key]
             self._registered.discard(b)
             self.cache.free_sequence([b])
             self.evictions += 1
-            freed += 1
-        return freed
+        return len(victims)
+
+    def _spill(self, victims) -> None:
+        """Serialize the victim pages to the host tier: ONE batched
+        extract over all of them, split host-side into per-key 1-page
+        entries (so any subset restores independently)."""
+        from ..jit.serving_step import extract_blocks
+        from ..ops.paged_attention import KVPageBuffer
+        bs = self.block_size
+        buf = extract_blocks(self.all_caches, [b for _, b in victims],
+                             n_tokens=len(victims) * bs)
+        for i, (key, _b) in enumerate(victims):
+            entry = KVPageBuffer(
+                codes=np.ascontiguousarray(buf.codes[:, i:i + 1]),
+                scales=(np.ascontiguousarray(buf.scales[:, i:i + 1])
+                        if buf.scales is not None else None),
+                n_pages=1, n_tokens=bs, block_size=buf.block_size,
+                num_kv_heads=buf.num_kv_heads, head_dim=buf.head_dim,
+                num_layers=buf.num_layers, kv_dtype=buf.kv_dtype)
+            if self.host_tier.put(key, entry):
+                self.spills += 1
 
     # ---- introspection --------------------------------------------------
     def cached_blocks(self) -> Set[int]:

@@ -69,12 +69,28 @@ is attached to each finished record (``RouterRequest.summary``) so
 streaming drivers read the numbers off ``pop_record`` without
 scraping metrics.
 
+**KV page migration + disaggregation (round 19).**  Requests no longer
+lose their KV when they move: every preempt/drain path tries the
+engine's ``extract_request`` first — the sequence's physical pages
+(int8 codes + per-page scale rows) serialize to ONE host buffer per
+dtype — and the next dispatch tries ``inject_request``, scattering
+them into the target pool in one donated dispatch so the stream
+resumes with ZERO re-prefill (geometry mismatch degrades to the r15
+re-prefill resume).  Pools mixing engine ``role``\\ s get disaggregated
+dispatch: fresh prompts route to ``role="prefill"`` specialists (big
+token budgets), and once a request's prefill completes there the
+``_migrate_ready`` sweep moves its pages to a ``role="decode"``
+specialist (high slot counts, int8 KV) — TTFT is paid on the prefill
+tier, TPOT is isolated on the decode tier.  All-"mixed" pools (the
+default) behave exactly as in r15.
+
 Engine protocol (what a pool member must provide): ``add_request(
 prompt_ids, max_new_tokens=, eos_token_id=)`` appending to ``waiting``,
 ``step() -> finished req_ids``, ``has_work()``, ``finished`` dict,
 ``preempt_request(req_id)``, ``health_payload()``, ``block_size``, and
-optionally ``prefix_cache``/``engine_id``/``tracer`` — i.e. the public
-surface of ``ContinuousBatchingEngine``.
+optionally ``prefix_cache``/``engine_id``/``tracer``/``role``/
+``extract_request``/``inject_request`` — i.e. the public surface of
+``ContinuousBatchingEngine``.
 
 All router state is host control flow: no device math, no new compiled
 modules — the engines' one-compile invariants are untouched.
@@ -171,6 +187,14 @@ class RouterRequest:
     # preemption — preempting a victim on an engine that cannot hold
     # this request would be pure churn
     rejected_engines: set = field(default_factory=set)
+    # round 19: the KV pages extracted off the engine this request
+    # last ran on (a host KVPageBuffer) — the next dispatch tries
+    # inject_request first, resuming with ZERO re-prefill; dropped
+    # after any successful dispatch (tokens then outgrow its coverage)
+    kv_buffer: object = field(default=None, repr=False)
+    # prefill→decode page migrations (the disaggregated-serving hop;
+    # these also count one requeue each, reason="migrated")
+    migrations: int = 0
     # routing-key chains memoized per block size (hashing the prompt
     # prefix chain is O(L^2/bs) bytes — computing it once per resume
     # prompt instead of per engine per round keeps ranking cheap);
@@ -395,6 +419,17 @@ class ServingRouter:
         # and REGISTERS the prefix there too (a hot family replicates
         # itself across the pool instead of head-of-line blocking)
         self.affinity_wait_steps = max(0, int(affinity_wait_steps))
+        # disaggregated serving (round 19): pools mixing engine roles
+        # get role-aware ranking (fresh prompts avoid decode
+        # specialists, resumed/migrated requests avoid prefill
+        # specialists), and a prefill+decode pool runs the
+        # prefill→decode page-migration sweep each step.  All-"mixed"
+        # pools (the default) see neither — r15 behavior untouched.
+        roles = [getattr(h.engine, "role", "mixed")
+                 for h in self.handles.values()]
+        self._role_pool = any(r != "mixed" for r in roles)
+        self._disagg = ("prefill" in roles
+                        and any(r != "prefill" for r in roles))
         self.pending: List[RouterRequest] = []
         # bounded completed-request record (a long-running admission
         # plane must not grow without bound): oldest completions are
@@ -443,7 +478,16 @@ class ServingRouter:
         self._m_requeues = r.counter(
             "router_requeues_total",
             "requests pulled off one engine and requeued, by reason "
-            "(preempt / engine_lost)", labels=("reason",))
+            "(preempt / engine_lost / migrated — the prefill→decode "
+            "disaggregation hop)", labels=("reason",))
+        self._m_role_dispatch = r.counter(
+            "router_role_dispatch_total",
+            "dispatches by the target engine's role (prefill / decode "
+            "/ mixed) — the disaggregated-serving placement split",
+            labels=("role",))
+        self._role_children = {
+            role: self._m_role_dispatch.labels(role=role)
+            for role in ("prefill", "decode", "mixed")}
         self._m_healthy = r.gauge(
             "router_engine_healthy",
             "1 while the router considers the engine routable, 0 after "
@@ -555,6 +599,8 @@ class ServingRouter:
                 rr = self._inflight.pop(key)
                 self._complete(rr, h.engine.finished.pop(key[1]))
             self._sync_first_tokens(h)
+        if self._disagg:
+            self._migrate_ready()
         self._m_pending.set(len(self.pending))
         done, self._done_backlog = self._done_backlog, []
         return done
@@ -696,8 +742,18 @@ class ServingRouter:
                             if k[0] == h.engine_id]:
             rr = self._inflight.pop((eid, erid))
             gen: List[int] = []
+            vbuf = None
             try:
-                _prompt, gen = h.engine.preempt_request(erid)
+                # extract the victim's KV pages while the engine's
+                # device state still answers — the requeued request
+                # then resumes elsewhere with ZERO re-prefill; the
+                # engine degrades extraction to buffer=None itself
+                # when its pools can't travel
+                ext = getattr(h.engine, "extract_request", None)
+                if ext is not None:
+                    _prompt, gen, vbuf = ext(erid)
+                else:
+                    _prompt, gen = h.engine.preempt_request(erid)
             except Exception:                         # noqa: BLE001
                 # the request finished INSIDE the failing step, or the
                 # engine is too far gone: consume the engine-side
@@ -713,14 +769,19 @@ class ServingRouter:
                     gen = list((ereq or rr.engine_req).output_ids)
                 except Exception:                     # noqa: BLE001
                     gen = []
-            self._requeue(rr, gen, reason="engine_lost")
+            self._requeue(rr, gen, reason="engine_lost", buffer=vbuf)
 
     # ---- requeue / preemption -------------------------------------------
-    def _requeue(self, rr: RouterRequest, gen: List[int], reason: str):
+    def _requeue(self, rr: RouterRequest, gen: List[int], reason: str,
+                 buffer=None):
         """Fold the tokens the lost/preempted engine generated into the
         router-side record and put the request back in the pending
         queue (or finish it, if those tokens already met the budget or
-        hit EOS)."""
+        hit EOS).  ``buffer`` carries the KV pages extracted off the
+        engine being left (a host ``KVPageBuffer``): the next dispatch
+        injects them into the target pool and the request resumes with
+        zero re-prefill; None (extraction unsupported or failed)
+        degrades to the r15 re-prefill resume."""
         # the first token may have landed on the engine we are leaving
         # without a _sync_first_tokens pass seeing it (preempt/loss
         # between steps): capture its mark off the live engine request
@@ -742,6 +803,10 @@ class ServingRouter:
         rr.t_requeued = now
         rr.base_output.extend(int(t) for t in gen)
         rr.key_cache.clear()            # resume prompt just grew
+        # a fresh extraction replaces any stale buffer; extraction
+        # failure (None) must also clear it — old pages no longer
+        # cover the grown resume prompt
+        rr.kv_buffer = buffer
         rr.engine_id = -1
         rr.engine_req_id = -1
         rr.engine_req = None
@@ -799,16 +864,28 @@ class ServingRouter:
             if h.engine_id in tried:
                 continue          # geometry already rejected rr there
             tried.add(h.engine_id)
+            preempted_first = False
+            if self._buffer_fits(rr, h):
+                # rr carries extracted KV that fits this engine:
+                # inject_request needs the slot FREE at dispatch time,
+                # so pull the victim FIRST — otherwise every
+                # preemption-path placement would burn the buffer on
+                # the no-free-slot fallback and re-prefill anyway.
+                # The geometry pre-check keeps the no-pointless-
+                # preemption rule: the buffer is known to fit before
+                # anyone is disturbed (a residual add_request
+                # rejection after this can still waste one victim —
+                # bounded by the rejected_engines memo)
+                # a victim that raced to completion left its slot free
+                # anyway — either way rr still needs the dispatch below
+                self._pull_victim(key, vr, h)
+                preempted_first = True
             if not self._dispatch(rr, h, self._match(h, rr)):
                 continue
-            try:
-                _prompt, gen = h.engine.preempt_request(vr.engine_req_id)
-            except KeyError:
-                # raced with completion inside the engine: the slot is
-                # free anyway and rr is already queued there
-                return True
-            self._inflight.pop(key, None)
-            self._requeue(vr, gen, reason="preempt")
+            if not preempted_first:
+                if not self._pull_victim(key, vr, h):
+                    return True   # raced with completion: slot free
+                                  # anyway and rr is already queued
             try:
                 h.refresh()
             except Exception:                         # noqa: BLE001
@@ -817,6 +894,95 @@ class ServingRouter:
                 self._lose_engine(h)
             return True
         return False
+
+    def _pull_victim(self, key, vr: RouterRequest,
+                     h: EngineHandle) -> bool:
+        """Preempt one victim off its engine and requeue it —
+        extract-first, so its pages travel with it and its resume
+        elsewhere skips the re-prefill bill that made preemption
+        expensive.  Returns False when the victim raced to completion
+        inside the engine (its slot is free regardless)."""
+        try:
+            ext = getattr(h.engine, "extract_request", None)
+            if ext is not None:
+                _prompt, gen, vbuf = ext(vr.engine_req_id)
+            else:
+                _prompt, gen = h.engine.preempt_request(
+                    vr.engine_req_id)
+                vbuf = None
+        except KeyError:
+            return False
+        self._inflight.pop(key, None)
+        self._requeue(vr, gen, reason="preempt", buffer=vbuf)
+        return True
+
+    def _buffer_fits(self, rr: RouterRequest, h: EngineHandle) -> bool:
+        """Does ``rr``'s extracted KV buffer match ``h``'s pool
+        geometry?  The cheap pre-check behind preempt-before-dispatch
+        and the disaggregation sweep — never extract or preempt for an
+        inject that is known to fail."""
+        buf = rr.kv_buffer
+        if buf is None or not hasattr(h.engine, "inject_request"):
+            return False
+        geo = getattr(h.engine, "migration_geometry", None)
+        if geo is None:
+            return False
+        try:
+            return geo() == buf.geometry()
+        except Exception:                             # noqa: BLE001
+            return False
+
+    # ---- disaggregated prefill→decode migration -------------------------
+    def _migrate_ready(self):
+        """The disaggregation sweep (pools mixing ``role="prefill"``
+        and decode-side engines): any request whose prefill COMPLETED
+        on a prefill specialist — it is decoding, its first token is
+        out — has its KV pages extracted and requeues with
+        ``reason="migrated"``; the next dispatch injects them into a
+        decode-side engine (role-aware ranking steers it there) and
+        the stream continues with zero re-prefill.  TTFT was already
+        paid on the prefill specialist, so the move isolates decode
+        TPOT from prefill interference without restarting anything.
+        Only fires when a decode-side target currently has capacity —
+        a full decode tier leaves the request where it runs."""
+        for key in list(self._inflight.keys()):
+            rr = self._inflight.get(key)
+            if rr is None:
+                continue
+            h = self.handles.get(key[0])
+            if h is None or not h.healthy:
+                continue
+            if getattr(h.engine, "role", "mixed") != "prefill":
+                continue
+            ereq = rr.engine_req
+            if ereq is None or getattr(ereq, "state", "") != "running":
+                continue
+            if not getattr(ereq, "output_ids", None):
+                continue
+            # geometry pre-flight: only extract when the source CAN
+            # produce a buffer and some decode-side target can take it
+            # — otherwise the "migration" degrades to paying the
+            # prefill twice (extract fails or inject rejects and the
+            # resume re-prefills the whole prompt on the decode tier)
+            src_geo = getattr(h.engine, "migration_geometry",
+                              lambda: None)()
+            if src_geo is None:
+                continue
+            if not any(t.healthy and t is not h
+                       and getattr(t.engine, "role", "mixed") != "prefill"
+                       and t.engine_id not in rr.rejected_engines
+                       and t.has_capacity()
+                       and getattr(t.engine, "migration_geometry",
+                                   lambda: None)() == src_geo
+                       for t in self.handles.values()):
+                continue
+            try:
+                _prompt, gen, buf = h.engine.extract_request(key[1])
+            except Exception:                         # noqa: BLE001
+                continue
+            self._inflight.pop(key, None)
+            rr.migrations += 1
+            self._requeue(rr, gen, reason="migrated", buffer=buf)
 
     # ---- dispatch -------------------------------------------------------
     def _match(self, h: EngineHandle, rr: RouterRequest) -> int:
@@ -844,6 +1010,23 @@ class ServingRouter:
         bench's control arm."""
         healthy = [h for h in self.handles.values()
                    if h.healthy and h.engine_id not in rr.rejected_engines]
+        if self._role_pool:
+            # disaggregated dispatch: fresh prompts go to prefill
+            # specialists (and mixed), resumed/migrated requests to
+            # decode specialists (and mixed).  Soft preference: when no
+            # preferred engine has capacity the full healthy set stays
+            # eligible — role policy must never strand a request a
+            # mis-roled engine could serve
+            # "fresh" = has no resumable state, so it needs a FULL
+            # prefill wherever it lands (a victim preempted while
+            # still waiting requeues with no tokens and no KV — it
+            # belongs on the prefill tier despite its requeue count)
+            fresh = not rr.base_output and rr.kv_buffer is None
+            avoid = "decode" if fresh else "prefill"
+            preferred = [h for h in healthy
+                         if getattr(h.engine, "role", "mixed") != avoid]
+            if any(h.has_capacity() for h in preferred):
+                healthy = preferred
         cands = [h for h in healthy if h.has_capacity()]
         if self.route_policy == "random":
             order = self._route_rng.permutation(len(cands))
@@ -913,22 +1096,52 @@ class ServingRouter:
         """Hand one request to one engine.  A ValueError from
         ``add_request`` means THIS engine cannot hold the request
         (heterogeneous pools: too few pages, narrow block table) — the
-        caller tries the next candidate."""
-        try:
-            erid = h.engine.add_request(
-                rr.resume_prompt(),
-                max_new_tokens=rr.remaining_budget(),
-                eos_token_id=rr.eos_token_id)
-        except ValueError:
-            rr.rejected_engines.add(h.engine_id)
-            return False
+        caller tries the next candidate.
+
+        A request carrying extracted KV pages (``rr.kv_buffer``) tries
+        ``inject_request`` FIRST — migrated resume, zero re-prefill;
+        an engine that cannot take the buffer (geometry/kv_dtype
+        mismatch, no free slot) falls back to ``add_request`` on the
+        same engine (re-prefill resume, the r15 path).  Either way a
+        successful dispatch consumes the buffer — the request's tokens
+        outgrow its coverage from here on."""
+        injected = False
+        erid = None
+        if rr.kv_buffer is not None:
+            inject = getattr(h.engine, "inject_request", None)
+            if inject is not None:
+                try:
+                    erid = inject(rr.resume_prompt(), rr.kv_buffer,
+                                  max_new_tokens=rr.remaining_budget(),
+                                  eos_token_id=rr.eos_token_id)
+                    injected = True
+                except (ValueError, RuntimeError):
+                    erid = None     # fall through to re-prefill resume
+        if not injected:
+            try:
+                erid = h.engine.add_request(
+                    rr.resume_prompt(),
+                    max_new_tokens=rr.remaining_budget(),
+                    eos_token_id=rr.eos_token_id)
+            except ValueError:
+                rr.rejected_engines.add(h.engine_id)
+                return False
+        rr.kv_buffer = None
         rr.state = "dispatched"
         rr.engine_id = h.engine_id
         rr.engine_req_id = erid
-        # add_request APPENDS to the engine's waiting queue — grab the
-        # live request object for host-side sync (first-token marks,
-        # drain fallback)
-        rr.engine_req = h.engine.waiting[-1] if h.engine.waiting else None
+        if injected:
+            # inject_request lands straight on a slot, not the waiting
+            # queue — find the live request object there
+            rr.engine_req = next(
+                (r for r in getattr(h.engine, "slots", [])
+                 if r is not None and r.req_id == erid), None)
+        else:
+            # add_request APPENDS to the engine's waiting queue — grab
+            # the live request object for host-side sync (first-token
+            # marks, drain fallback)
+            rr.engine_req = (h.engine.waiting[-1]
+                             if h.engine.waiting else None)
         rr.routed_by_prefix = match > 0
         now = time.perf_counter()
         rr.hops.append([h.engine_id, erid, now, None])
@@ -943,9 +1156,12 @@ class ServingRouter:
                        "least_loaded")
             self.tracer.span(rr.rid, "dispatch", rr.t_requeued, now,
                              engine=h.engine_id, match_tokens=match,
-                             route=outcome, requeues=rr.requeues)
+                             route=outcome, requeues=rr.requeues,
+                             migrated=injected)
         if match > 0:
             self._m_prefix_hits.inc()
+        role = getattr(h.engine, "role", "mixed")
+        self._role_children.get(role, self._role_children["mixed"]).inc()
         bs = getattr(h.engine, "block_size", 0)
         if bs and getattr(h.engine, "prefix_cache", None) is not None:
             h.note_routed(None, keys=rr.routing_keys_for(bs))
@@ -990,6 +1206,7 @@ class ServingRouter:
             self.tracer.span(rr.rid, "on_engine", rr.hops[-1][2],
                              rr.t_done, engine=rr.hops[-1][0])
         rr.engine_req = None
+        rr.kv_buffer = None     # finished records must not pin page KV
         self._account_slo(rr)
         self.finished[rr.rid] = rr
         while len(self.finished) > self.max_finished:
@@ -1049,6 +1266,7 @@ class ServingRouter:
             "ttft": ttft,
             "mean_tpot": mean_tpot,
             "requeues": rr.requeues,
+            "migrations": rr.migrations,
             "engines_visited": rr.engines_visited(),
             "outcome": "truncated" if rr.truncated else "completed",
             "ttft_target": rr.ttft_target,

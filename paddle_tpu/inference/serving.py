@@ -208,6 +208,18 @@ class ContinuousBatchingEngine:
     first token, preempt, finish.  Host-side appends only, on the
     shared ``perf_counter`` clock; a ``ServingRouter`` merges every
     pool engine's spans into one fleet chrome trace (``fleet_trace``).
+
+    KV page migration + disaggregation (round 19, defaults off):
+    ``extract_request``/``inject_request`` move a running request's
+    physical KV pages between engines as ONE batched host buffer per
+    dtype (int8 scale rows travel free), so a preempted or
+    engine-lost request resumes elsewhere with ZERO re-prefill;
+    ``role="prefill"|"decode"|"mixed"`` labels this engine for the
+    router's disaggregated dispatch (fresh prompts → prefill
+    specialists, whose finished pages migrate to decode specialists);
+    ``host_tier_bytes=N`` stacks a bounded host-RAM spill tier on the
+    prefix cache — evicted-but-hot prefix pages spill to host instead
+    of dying and restore on a later hit with one batched inject.
     """
 
     def __init__(self, model, max_batch_size: int = 8,
@@ -227,9 +239,21 @@ class ContinuousBatchingEngine:
                  sampling: bool = False,
                  draft_model=None, spec_k: int = 2,
                  engine_id: Optional[int] = None,
-                 tracer=None):
+                 tracer=None,
+                 role: str = "mixed",
+                 host_tier_bytes: int = 0):
         from ..jit.serving_step import DecodeStep, MixedStep, PrefillStep
         self.model = model
+        # disaggregated serving (round 19): a router routes fresh
+        # prompts to "prefill" specialists (big token budgets, chunked)
+        # and migrates their finished pages to "decode" specialists
+        # (high slot counts, int8 KV); "mixed" engines take anything —
+        # the default, so single-engine users never see role policy
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                "ContinuousBatchingEngine role must be 'prefill', "
+                "'decode' or 'mixed'; got %r" % (role,))
+        self.role = role
         # identity for multi-engine deployments (the ServingRouter's
         # health gauge + the /healthz payload key on it); defaults to a
         # process-wide sequence so standalone engines need no plumbing
@@ -495,6 +519,25 @@ class ContinuousBatchingEngine:
             self.draft_step = None
             self.draft_budgets = None
             self._zero_q = None
+        # ---- host-RAM prefix spill tier (round 19) -------------------
+        if host_tier_bytes and not enable_prefix_cache:
+            raise ValueError(
+                "host_tier_bytes is the prefix cache's spill tier: "
+                "pass enable_prefix_cache=True (there is nothing to "
+                "spill without a prefix table)")
+        if host_tier_bytes and self.tp is not None:
+            raise ValueError(
+                "the host spill tier is single-chip for now: a "
+                "tensor-parallel engine's pools are head-sharded and "
+                "the batched extract/inject path moves whole pages — "
+                "drop host_tier_bytes or drop mesh/sharding")
+        if host_tier_bytes and draft_model is not None:
+            raise ValueError(
+                "a speculative engine cannot spill/restore prefix "
+                "pages: a restored page carries only target-model KV, "
+                "and the draft pool (addressed by the same page ids) "
+                "cannot be reconstructed from it — drop "
+                "host_tier_bytes or drop draft_model")
         if enable_prefix_cache:
             if not buckets and self.mixed is None:
                 raise ValueError(
@@ -502,10 +545,20 @@ class ContinuousBatchingEngine:
                     "(prefill_buckets='auto'/tuple) or mixed_step=True: "
                     "suffix-only prefill needs an offset-carrying "
                     "compiled step")
-            from .prefix_cache import PrefixPageCache
-            self.prefix_cache = PrefixPageCache(self.caches[0], block_size)
+            from .prefix_cache import HostPageTier, PrefixPageCache
+            self.host_tier = (HostPageTier(int(host_tier_bytes))
+                              if host_tier_bytes else None)
+            self.prefix_cache = PrefixPageCache(
+                self.caches[0], block_size, all_caches=self.caches,
+                host_tier=self.host_tier)
         else:
+            self.host_tier = None
             self.prefix_cache = None
+        # published-so-far snapshot of the prefix cache's host-side
+        # stat counters (evictions by outcome, spills/hits/restores);
+        # _sync_prefix_stats diffs against it so the process-wide
+        # metric counters see each increment exactly once
+        self._pc_published: Dict[str, int] = {}
         self._chunk_rr = 0           # round-robin cursor over chunk work
 
         from ..observability import default_registry
@@ -566,7 +619,43 @@ class ContinuousBatchingEngine:
             "recompute")
         self._m_prefix_evictions = r.counter(
             "serving_prefix_cache_evictions_total",
-            "prefix table entries reclaimed under pool pressure")
+            "prefix table entries visited by eviction under pool "
+            "pressure, by outcome (reclaimed = page returned to the "
+            "free list, spilled first when a host tier is attached; "
+            "skipped_pinned = a live request still holds the page, so "
+            "the entry was passed over — sustained skips explain "
+            "cache-pressure stalls)", labels=("outcome",))
+        self._m_evict_reclaimed = \
+            self._m_prefix_evictions.labels(outcome="reclaimed")
+        self._m_evict_skipped = \
+            self._m_prefix_evictions.labels(outcome="skipped_pinned")
+        self._m_migrations = r.counter(
+            "serving_page_migrations_total",
+            "KV page-set migrations through this engine, by direction "
+            "(out = extract_request serialized a sequence's pages to "
+            "host; in = inject_request scattered a migrated buffer "
+            "into this pool)", labels=("direction",))
+        self._m_migrations_out = \
+            self._m_migrations.labels(direction="out")
+        self._m_migrations_in = self._m_migrations.labels(direction="in")
+        self._m_migrated_bytes = r.counter(
+            "serving_migrated_bytes_total",
+            "payload bytes moved across the host link by page "
+            "migration (each migration counts its buffer once on "
+            "extract — device-to-host — and once on inject — "
+            "host-to-device)")
+        self._m_host_spills = r.counter(
+            "serving_host_tier_spills_total",
+            "evicted prefix pages serialized into the host-RAM spill "
+            "tier instead of dying")
+        self._m_host_hits = r.counter(
+            "serving_host_tier_hits_total",
+            "prefix lookups whose chain continued into the host tier "
+            "(spilled pages found for the prompt)")
+        self._m_host_restores = r.counter(
+            "serving_host_tier_restores_total",
+            "spilled pages injected back into the device pool and "
+            "re-registered under their digest keys")
         self._m_chunk_queue = r.gauge(
             "serving_prefill_chunk_queue_depth",
             "prefill chunks still pending across admitted requests")
@@ -784,6 +873,7 @@ class ContinuousBatchingEngine:
             # mixed chunks no longer consume a dedicated engine round,
             # but the backlog gauge still reports what is pending
             self._m_chunk_queue.set(self._pending_chunks())
+        self._sync_prefix_stats()
         return done
 
     def run_to_completion(self) -> Dict[int, List[int]]:
@@ -832,6 +922,199 @@ class ContinuousBatchingEngine:
             "preempt_request(%r): request is neither waiting nor "
             "running on this engine" % (req_id,))
 
+    # ---- KV page migration (round 19) -----------------------------------
+    def migration_geometry(self):
+        """The pool geometry ``(layers, block_size, kv_heads, head_dim,
+        kv_dtype)`` page buffers extracted from / injected into this
+        engine must match — or None when this engine cannot migrate
+        pages at all (tensor-parallel pools are head-sharded; a
+        speculative engine's draft KV cannot travel).  Admission planes
+        pre-check this so they never extract a buffer no target can
+        take (a failed migration degrades to paying the prefill
+        twice)."""
+        if self.tp is not None or self.draft_step is not None:
+            return None
+        return (len(self.caches),) + self.caches[0].page_geometry()
+
+    def extract_request(self, req_id: int):
+        """``preempt_request`` plus page extraction: pull the request
+        out AND serialize its KV pages to one host
+        :class:`~paddle_tpu.ops.paged_attention.KVPageBuffer` (one
+        batched device→host copy per dtype) BEFORE the refcounted
+        release, so an admission plane can resume it on another engine
+        with ZERO re-prefill (``inject_request``).  Returns
+        ``(prompt_ids, generated_ids, buffer)``; ``buffer`` is None
+        when the request holds no resumable KV (still waiting, or
+        mid-prefill) or when this engine cannot extract (tensor-
+        parallel pools are head-sharded; a speculative engine's draft
+        KV cannot travel) — the caller then falls back to the r15
+        re-prefill resume."""
+        buf = None
+        if self.migration_geometry() is not None:
+            for r in self.slots:
+                if (r is not None and r.req_id == req_id
+                        and r.state == "running" and r.seq_len > 0):
+                    from ..jit.serving_step import extract_blocks
+                    n_cov = self.caches[0].blocks_needed(r.seq_len)
+                    buf = extract_blocks(self.caches,
+                                         r.block_ids[:n_cov],
+                                         n_tokens=r.seq_len)
+                    break
+        prompt, gen = self.preempt_request(req_id)
+        if buf is not None:
+            self._m_migrations_out.inc()
+            self._m_migrated_bytes.inc(buf.nbytes)
+        return prompt, gen, buf
+
+    def inject_request(self, prompt_ids, buffer, max_new_tokens=16,
+                       eos_token_id=None, temperature: float = 0.0,
+                       top_k: int = 0, top_p: float = 0.0,
+                       seed: int = 0) -> int:
+        """Admit a MIGRATED request straight into a decode slot: the
+        buffer's pages scatter into freshly allocated pool pages in ONE
+        donated dispatch, the request starts in state "running" with
+        its last prompt token pending, and the next engine step
+        advances it as a plain decode span — zero re-prefill.  The
+        covered full pages re-register under the same blake2b digest
+        chain the prefix cache keys on, so affinity and COW sharing
+        work on the target exactly as if it had prefilled the prompt
+        itself.
+
+        ``prompt_ids`` is the RESUME prompt (original prompt plus every
+        token already generated); ``buffer.n_tokens`` must equal
+        ``len(prompt_ids) - 1`` — the KV of everything but the last
+        token, whose forward pass produces the next one.
+
+        The buffer carries KV, NOT sampling state: a stochastic
+        request must re-pass its ``temperature``/``top_k``/``top_p``/
+        ``seed`` here (exactly ``add_request``'s contract — defaults
+        are greedy).  The r14 counter-based PRNG keys on (seed, token
+        position), so a re-seeded migrated stream samples the same
+        distribution path it would have on the source engine.
+
+        Raises ``ValueError`` for a request this engine can never hold
+        (geometry/kv_dtype mismatch, block-table width) and
+        ``RuntimeError`` for transient capacity (no free slot, pool
+        cannot cover the pages) — both BEFORE any side effect, so the
+        caller can fall back to ``add_request`` (re-prefill resume)."""
+        if buffer is None:
+            raise ValueError(
+                "inject_request needs a KVPageBuffer — use add_request "
+                "for a fresh (un-migrated) prompt")
+        if self.tp is not None:
+            raise ValueError(
+                "page migration is single-chip for now: a tensor-"
+                "parallel engine's pools are head-sharded and the "
+                "batched inject moves whole pages")
+        if self.draft_step is not None:
+            raise ValueError(
+                "a speculative engine cannot accept migrated pages: "
+                "the buffer carries only target-model KV and the draft "
+                "pool (addressed by the same page ids) cannot be "
+                "reconstructed from it")
+        here = (len(self.caches),) + self.caches[0].page_geometry()
+        if here != buffer.geometry():
+            raise ValueError(
+                "inject_request: pool geometry mismatch — buffer was "
+                "extracted from (layers, block_size, kv_heads, "
+                "head_dim, kv_dtype)=%r but this engine's pools are "
+                "%r; KV pages only migrate between engines with "
+                "identical pool geometry (including kv_dtype)"
+                % (buffer.geometry(), here))
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                "inject_request max_new_tokens must be >= 1; a "
+                "migrated request with no remaining budget should "
+                "complete at the router, not resume")
+        if (temperature or top_k or top_p or seed) and not self.sampling:
+            raise ValueError(
+                "per-request sampling parameters need a sampling "
+                "engine: construct ContinuousBatchingEngine("
+                "sampling=True, ...) — the greedy engine's compiled "
+                "steps have no sampling epilogue")
+        prompt = np.asarray(prompt_ids, np.int64).reshape(-1)
+        L = len(prompt)
+        if buffer.n_tokens != L - 1:
+            raise ValueError(
+                "inject_request: buffer covers %d token(s) of KV but "
+                "the resume prompt has %d — a migrated request resumes "
+                "with exactly its last token pending (n_tokens == "
+                "len(prompt_ids) - 1)" % (buffer.n_tokens, L))
+        cache = self.caches[0]
+        n_cov = cache.blocks_needed(buffer.n_tokens)
+        if buffer.n_pages != n_cov:
+            raise ValueError(
+                "inject_request: buffer holds %d page(s) but %d cover "
+                "its %d token(s) at block_size=%d"
+                % (buffer.n_pages, n_cov, buffer.n_tokens,
+                   self.block_size))
+        total_need = cache.blocks_needed(
+            L + (1 if self.lazy_alloc else int(max_new_tokens)))
+        if total_need > self.bt_width:
+            raise ValueError(
+                "request needs %d pages but the engine's block-table "
+                "width is %d (max_seq_len=%d); raise max_seq_len"
+                % (total_need, self.bt_width, self.max_seq_len))
+        slot = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError(
+                "inject_request: no free slot — inject only into "
+                "engines with slot capacity (migrated requests do not "
+                "queue; their pages would pin pool pages while "
+                "waiting)")
+        available = len(cache._free)
+        if self.prefix_cache is not None:
+            available += self.prefix_cache.evictable_count()
+        if total_need > available:
+            raise RuntimeError(
+                "inject_request: pool cannot cover %d page(s) "
+                "(%d free + %d evictable)"
+                % (total_need, len(cache._free), available
+                   - len(cache._free)))
+
+        # ---- commit ---------------------------------------------------
+        from ..jit.serving_step import inject_blocks
+        # one batched spill for the whole deficit (see _try_admit)
+        short = total_need - len(cache._free)
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+            self._sync_prefix_stats()
+        req = GenerationRequest(
+            req_id=self._next_id, prompt_ids=prompt,
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id,
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), seed=int(seed))
+        self._next_id += 1
+        req.t_submit = time.perf_counter()
+        req.block_ids = [self._alloc_block() for _ in range(total_need)]
+        inject_blocks(self.caches, buffer, req.block_ids[:n_cov])
+        req.slot = slot
+        req.state = "running"
+        req.seq_len = buffer.n_tokens
+        req.prefill_pos = L
+        req.prefix_hit_tokens = 0
+        self.slots[slot] = req
+        self._tokens[slot] = int(prompt[-1])
+        self._seq_lens[slot] = req.seq_len
+        self._bt[slot] = self._row_for(req)[0]
+        if self.sampling:
+            self._samp[slot] = self._samp_row(req)
+        if self.prefix_cache is not None:
+            # re-register the COVERED full pages under the same digest
+            # chain (truncate the prompt to them: pages past n_tokens
+            # hold no KV yet and must not be published)
+            full = (buffer.n_tokens // self.block_size) * self.block_size
+            if full:
+                self.prefix_cache.register(prompt[:full], req.block_ids)
+        self._m_migrations_in.inc()
+        self._m_migrated_bytes.inc(buffer.nbytes)
+        self.tracer.event(req.req_id, "admit", slot=slot,
+                          prefix_hit_tokens=0, prompt_tokens=L,
+                          enqueue_ts=req.t_submit, migrated=True)
+        return req.req_id
+
     def health_payload(self) -> Dict[str, int]:
         """Load/health snapshot for admission planes: the same stats
         the observability gauges read (occupancy, KV-page utilization,
@@ -843,6 +1126,7 @@ class ContinuousBatchingEngine:
         cache = self.caches[0]
         return {
             "engine_id": self.engine_id,
+            "role": self.role,
             "occupancy": sum(s is not None for s in self.slots),
             "slots": self.max_batch_size,
             "waiting": len(self.waiting),
@@ -850,6 +1134,13 @@ class ContinuousBatchingEngine:
             "total_pages": cache.num_blocks,
             "chunk_queue_depth": (self._pending_chunks()
                                   if self.chunk_size is not None else 0),
+            # round 19: the host spill tier's footprint rides the same
+            # payload the router's load_score and the r16 SLO plane
+            # already scrape — no extra endpoint
+            "host_tier_bytes": (self.host_tier.bytes
+                                if self.host_tier is not None else 0),
+            "host_tier_entries": (len(self.host_tier)
+                                  if self.host_tier is not None else 0),
         }
 
     # ---- page allocation ------------------------------------------------
@@ -859,12 +1150,32 @@ class ContinuousBatchingEngine:
         no live request holds are dropped)."""
         c = self.caches[0]
         if not c._free and self.prefix_cache is not None:
-            freed = self.prefix_cache.evict(1)
-            if freed:
-                self._m_prefix_evictions.inc(freed)
+            self.prefix_cache.evict(1)
+            self._sync_prefix_stats()
         if not c._free:
             return None
         return c.allocate_block()
+
+    def _sync_prefix_stats(self):
+        """Publish the prefix cache's host-side stat counters (evictions
+        by outcome, host-tier spills/hits/restores) into the
+        process-wide metrics — diffed against the last published
+        snapshot so every increment lands exactly once."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        pub = self._pc_published
+        for attr, metric in (
+                ("evictions", self._m_evict_reclaimed),
+                ("skipped_pinned", self._m_evict_skipped),
+                ("spills", self._m_host_spills),
+                ("host_hits", self._m_host_hits),
+                ("restores", self._m_host_restores)):
+            cur = getattr(pc, attr)
+            delta = cur - pub.get(attr, 0)
+            if delta:
+                metric.inc(delta)
+                pub[attr] = cur
 
     def _alloc_block(self) -> int:
         blk = self._try_alloc()
@@ -937,6 +1248,15 @@ class ContinuousBatchingEngine:
                 self.prefix_cache.misses += 1
         cache.share_blocks(matched)
         req.block_ids = list(matched)
+        # evict the whole page deficit UP FRONT: one evict() call
+        # spills every victim in ONE batched extract (the r11
+        # transfer-count rule) — _alloc_block's evict(1) stays only as
+        # the safety net.  Safe only AFTER share_blocks: the matched
+        # pages now hold a second reference, so eviction skips them
+        short = new_needed - len(cache._free)
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+            self._sync_prefix_stats()
         if cow:
             from ..jit.serving_step import copy_block
             src = req.block_ids[-1]

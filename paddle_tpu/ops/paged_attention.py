@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import functools
 import math
+from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -36,7 +37,8 @@ except Exception:  # pragma: no cover
 
 from ..core.tensor import Tensor
 
-__all__ = ["PagedKVCache", "paged_attention", "write_kv_to_cache",
+__all__ = ["PagedKVCache", "KVPageBuffer",
+           "paged_attention", "write_kv_to_cache",
            "write_decode_kv", "write_prefill_kv", "write_chunk_kv",
            "write_ragged_kv", "chunk_prefill_attention",
            "ragged_paged_attention",
@@ -74,6 +76,52 @@ KERNEL_INT8_REL_TOL = 0.02
 
 def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# page-migration wire format (round 19)
+# ---------------------------------------------------------------------------
+@dataclass
+class KVPageBuffer:
+    """A sequence's physical KV pages serialized to host RAM — the unit
+    both page MIGRATION (engine → engine) and the host-RAM prefix-cache
+    spill tier move around.
+
+    Wire format: ``codes`` is ONE contiguous host array
+    ``[2*num_layers, n_pages, block_size, num_kv_heads, head_dim]`` in
+    the pool dtype — rows ``0..L-1`` are the K pages of layers
+    ``0..L-1``, rows ``L..2L-1`` the V pages (the per-layer extents).
+    An int8 pool additionally carries its per-page-per-head fp32 absmax
+    rows as ``scales [2L, n_pages, num_kv_heads]`` in the same layer
+    order — scales live per PHYSICAL page, so they travel with their
+    pages for free and an injected page dequantizes bit-identically to
+    its source.  The header fields pin the pool geometry; ``inject``
+    into a pool with a different geometry (including a different
+    ``kv_dtype``) is rejected with a construction-time ValueError, never
+    a shape failure inside a trace.
+
+    ``n_tokens`` records how many tokens of KV the pages actually cover
+    (the last page may be partial) — the resume seq_len on the target
+    engine."""
+    codes: np.ndarray
+    scales: Optional[np.ndarray]
+    n_pages: int
+    n_tokens: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    num_layers: int
+    kv_dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.codes.nbytes
+                   + (self.scales.nbytes if self.scales is not None
+                      else 0))
+
+    def geometry(self) -> tuple:
+        return (self.num_layers, self.block_size, self.num_kv_heads,
+                self.head_dim, self.kv_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -184,6 +232,13 @@ class PagedKVCache:
                 else arr.shape
             total += int(np.prod(shape)) * arr.dtype.itemsize
         return total
+
+    def page_geometry(self) -> tuple:
+        """One layer-pool's page geometry ``(block_size, num_kv_heads,
+        head_dim, kv_dtype)`` — the per-layer part of the migration
+        wire-format header (``KVPageBuffer`` adds the layer count)."""
+        return (self.block_size, self.num_kv_heads, self.head_dim,
+                self.kv_dtype)
 
     def allocate_block(self) -> int:
         if not self._free:

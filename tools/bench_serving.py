@@ -1729,7 +1729,440 @@ def main_kernel(out_path):
         sys.exit(1)
 
 
+# ---------------------------------------------------------------------------
+# --disagg: KV page migration + host-RAM prefix tier (round 19)
+# ---------------------------------------------------------------------------
+def _disagg_engine(model, knobs, **kw):
+    kw.setdefault("max_batch_size", knobs["slots"])
+    kw.setdefault("num_blocks", knobs["num_blocks"])
+    kw.setdefault("block_size", knobs["block_size"])
+    kw.setdefault("max_seq_len", knobs["max_seq_len"])
+    kw.setdefault("prefill_chunk_size", knobs["chunk"])
+    return ContinuousBatchingEngine(model, mixed_step=True,
+                                    enable_prefix_cache=True, **kw)
+
+
+def _warm_resume_engine(model, knobs, resume_len, budget, kv_dtype=None):
+    """A target engine with its compiles warm for BOTH resume paths:
+    one request shaped like the re-prefill resume (warms every chunk /
+    budget compile that prompt length touches) and the decode budget.
+    Warm tokens come from a disjoint range so nothing the measured
+    resume touches registers as a prefix hit."""
+    eng = _disagg_engine(model, knobs, kv_dtype=kv_dtype)
+    rng = np.random.RandomState(97)
+    vocab = model.config.vocab_size
+    warm_prompt = rng.randint(vocab - 17, vocab,
+                              (resume_len,)).astype(np.int64)
+    eng.add_request(warm_prompt, max_new_tokens=4)
+    eng.run_to_completion()
+    return eng
+
+
+def _run_one(model, knobs, prompt, budget, stop_at, kv_dtype=None):
+    """Run one request on a fresh source engine until it has generated
+    ``stop_at`` tokens; returns the live engine + req id."""
+    eng = _disagg_engine(model, knobs, kv_dtype=kv_dtype)
+    rid = eng.add_request(prompt, max_new_tokens=budget)
+    while True:
+        eng.step()
+        req = next(r for r in list(eng.slots) + list(eng.waiting)
+                   if r is not None and r.req_id == rid)
+        if len(req.output_ids) >= stop_at:
+            return eng, rid
+        assert req.state != "done", "source finished before the preempt"
+
+
+def _resume_ttft_pair(model, knobs, prompt, budget, stop_at,
+                      kv_dtype=None):
+    """One paired measurement: the SAME preempted state resumed via
+    page migration (extract→inject→decode step) vs via re-prefill
+    (r15: resume prompt through add_request).  Both windows cover the
+    full resume bill, starting at the preempt and ending when the
+    first post-resume token exists.  Targets are pre-warmed; the two
+    arms run back-to-back off identical source states (greedy decode
+    makes the two source runs byte-identical)."""
+    resume_len = len(prompt) + stop_at
+    remaining = budget - stop_at
+
+    # --- migrated arm ---------------------------------------------------
+    tgt = _warm_resume_engine(model, knobs, resume_len, budget, kv_dtype)
+    src, rid = _run_one(model, knobs, prompt, budget, stop_at, kv_dtype)
+    t0 = time.perf_counter()
+    p, gen, buf = src.extract_request(rid)
+    resume = np.concatenate([p, np.asarray(gen, np.int64)])
+    rid2 = tgt.inject_request(resume, buf, max_new_tokens=remaining)
+    req = next(r for r in tgt.slots if r is not None
+               and r.req_id == rid2)
+    while not req.output_ids:
+        tgt.step()
+    t_mig = time.perf_counter() - t0
+    tgt.run_to_completion()
+    mig_tokens = gen + tgt.finished[rid2].output_ids
+
+    # --- re-prefill arm -------------------------------------------------
+    tgt2 = _warm_resume_engine(model, knobs, resume_len, budget,
+                               kv_dtype)
+    src2, rid = _run_one(model, knobs, prompt, budget, stop_at,
+                         kv_dtype)
+    t0 = time.perf_counter()
+    p, gen2 = src2.preempt_request(rid)
+    resume2 = np.concatenate([p, np.asarray(gen2, np.int64)])
+    rid3 = tgt2.add_request(resume2, max_new_tokens=remaining)
+    while rid3 not in tgt2.finished and not any(
+            r is not None and r.req_id == rid3 and r.output_ids
+            for r in tgt2.slots):
+        tgt2.step()
+    t_pre = time.perf_counter() - t0
+    tgt2.run_to_completion()
+    pre_tokens = gen2 + tgt2.finished[rid3].output_ids
+
+    leak_free = all(
+        len(e.caches[0]._free) + len(e.prefix_cache.cached_blocks())
+        == e.caches[0].num_blocks
+        for e in (src, tgt, src2, tgt2))
+    return t_mig, t_pre, mig_tokens, pre_tokens, leak_free, buf
+
+
+def bench_migrated_resume(model, knobs, kv_dtype=None, reps=3):
+    """The tentpole gate: migrated-resume TTFT strictly beats
+    re-prefill TTFT at a >=64-token generation, streams byte-identical
+    to the uninterrupted single-engine reference."""
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(41)
+    prompt = rng.randint(1, vocab,
+                         (knobs["prompt_len"],)).astype(np.int64)
+    budget, stop_at = knobs["budget"], knobs["gen_before_move"]
+
+    ref_eng = _disagg_engine(model, knobs, kv_dtype=kv_dtype)
+    rr = ref_eng.add_request(prompt, max_new_tokens=budget)
+    ref = ref_eng.run_to_completion()[rr]
+
+    mig_ts, pre_ts = [], []
+    parity = True
+    leaks = True
+    buf_bytes = 0
+    for _ in range(reps):
+        t_mig, t_pre, mig_tokens, pre_tokens, leak_free, buf = \
+            _resume_ttft_pair(model, knobs, prompt, budget, stop_at,
+                              kv_dtype)
+        mig_ts.append(t_mig)
+        pre_ts.append(t_pre)
+        parity = parity and mig_tokens == ref and pre_tokens == ref
+        leaks = leaks and leak_free
+        buf_bytes = buf.nbytes
+    mig, pre = statistics.median(mig_ts), statistics.median(pre_ts)
+    return {
+        "kv_dtype": kv_dtype or "float32",
+        "generated_before_move": stop_at,
+        "migrated_resume_ttft_ms": round(mig * 1e3, 3),
+        "reprefill_resume_ttft_ms": round(pre * 1e3, 3),
+        "speedup": round(pre / max(1e-9, mig), 3),
+        "stream_parity_vs_unmigrated": bool(parity),
+        "pools_leak_free": bool(leaks),
+        "buffer_bytes": int(buf_bytes),
+    }
+
+
+def bench_transfer_count(model, knobs):
+    """The one-transfer rule on the wire: host payload copies per
+    migration must be O(1) — identical for a small and a large page
+    count."""
+    from paddle_tpu.jit.serving_step import migration_transfers
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(43)
+    counts = {}
+    for tag, gen_n in (("small", 2), ("large", knobs["gen_before_move"])):
+        prompt = rng.randint(1, vocab,
+                             (knobs["prompt_len"],)).astype(np.int64)
+        src, rid = _run_one(model, knobs, prompt, knobs["budget"], gen_n)
+        tgt = _disagg_engine(model, knobs)
+        t0 = migration_transfers()
+        p, gen, buf = src.extract_request(rid)
+        resume = np.concatenate([p, np.asarray(gen, np.int64)])
+        tgt.inject_request(resume, buf,
+                           max_new_tokens=knobs["budget"] - gen_n)
+        t1 = migration_transfers()
+        counts[tag] = {
+            "pages": buf.n_pages,
+            "d2h": t1["d2h"] - t0["d2h"],
+            "h2d": t1["h2d"] - t0["h2d"],
+        }
+    small, large = counts["small"], counts["large"]
+    return {
+        **counts,
+        "transfer_count_o1": bool(
+            small["d2h"] == large["d2h"]
+            and small["h2d"] == large["h2d"]
+            and large["pages"] > small["pages"]),
+    }
+
+
+def bench_host_tier(model, knobs):
+    """Prefix hit-rate under memory pressure, host tier vs none: the
+    same two-wave shared-prefix workload on the same (deliberately
+    tiny) HBM page budget."""
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(47)
+    hk = knobs["host_tier"]
+    families = [rng.randint(1, vocab,
+                            (hk["prefix_len"],)).astype(np.int64)
+                for _ in range(hk["families"])]
+    suffixes = [
+        [rng.randint(1, vocab, (hk["suffix_len"],)).astype(np.int64)
+         for _ in range(hk["families"])] for _ in range(2)]
+
+    def run_wave(eng, wave):
+        outs = []
+        for i, fam in enumerate(families):
+            prompt = np.concatenate([fam, suffixes[wave][i]])
+            rid = eng.add_request(prompt, max_new_tokens=hk["budget"])
+            eng.run_to_completion()
+            outs.append((prompt, eng.finished[rid].output_ids))
+        return outs
+
+    arms = {}
+    parity = True
+    for tag, tier in (("with_tier", hk["tier_bytes"]), ("no_tier", 0)):
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=knobs["slots"],
+            num_blocks=hk["num_blocks"],
+            block_size=knobs["block_size"],
+            max_seq_len=hk["max_seq_len"],
+            prefill_chunk_size=knobs["chunk"], mixed_step=True,
+            enable_prefix_cache=True, host_tier_bytes=tier)
+        run_wave(eng, 0)
+        h0, m0 = eng.prefix_cache.hits, eng.prefix_cache.misses
+        outs = run_wave(eng, 1)
+        h1, m1 = eng.prefix_cache.hits, eng.prefix_cache.misses
+        hits, misses = h1 - h0, m1 - m0
+        for prompt, out in outs:
+            parity = parity and out == _ref(model, prompt, hk["budget"])
+        arms[tag] = {
+            "hit_rate": round(hits / max(1, hits + misses), 4),
+            "hits": hits, "misses": misses,
+            "spills": eng.prefix_cache.spills,
+            "host_hits": eng.prefix_cache.host_hits,
+            "restores": eng.prefix_cache.restores,
+            "skipped_pinned": eng.prefix_cache.skipped_pinned,
+            "tier_bytes_end": (eng.host_tier.bytes
+                               if eng.host_tier else 0),
+            "leak_free": bool(
+                len(eng.caches[0]._free)
+                + len(eng.prefix_cache.cached_blocks())
+                == eng.caches[0].num_blocks),
+        }
+    arms["parity_vs_eager"] = bool(parity)
+    return arms
+
+
+def bench_disagg_roles(model, knobs):
+    """The prefill→decode disaggregation drill through the router:
+    fresh prompts land on the prefill specialist, pages migrate to the
+    decode specialist after the first token, streams byte-identical."""
+    from paddle_tpu.inference.router import ServingRouter
+    from paddle_tpu.observability.request_trace import validate_span_chain
+    vocab = model.config.vocab_size
+    rng = np.random.RandomState(53)
+    pe = _disagg_engine(model, knobs, role="prefill", engine_id=1930)
+    de = _disagg_engine(model, knobs, role="decode", engine_id=1931,
+                        max_batch_size=knobs["slots"] * 2)
+    router = ServingRouter([pe, de])
+    n_req = knobs["disagg_requests"]
+    prompts = [rng.randint(1, vocab,
+                           (knobs["prompt_len"],)).astype(np.int64)
+               for _ in range(n_req)]
+    budget = knobs["disagg_budget"]
+    rids = [router.submit(p, max_new_tokens=budget) for p in prompts]
+    out = router.run_to_completion()
+    parity = all(out[rid] == _ref(model, p, budget)
+                 for rid, p in zip(rids, prompts))
+    started_prefill = [r for r in rids
+                       if router.finished[r].engines_visited()
+                       and router.finished[r].engines_visited()[0]
+                       == 1930]
+    migrated = [r for r in started_prefill
+                if router.finished[r].migrations >= 1
+                and router.finished[r].engines_visited()[-1] == 1931]
+    chains_ok = all(validate_span_chain(router.tracer.events(r))[0]
+                    for r in rids)
+    leak_free = all(
+        len(e.caches[0]._free) + len(e.prefix_cache.cached_blocks())
+        == e.caches[0].num_blocks for e in (pe, de))
+    return {
+        "requests": n_req,
+        "started_on_prefill_tier": len(started_prefill),
+        "migrated_to_decode_tier": len(migrated),
+        "parity_vs_eager": bool(parity),
+        "span_chains_valid": bool(chains_ok),
+        "pools_leak_free": bool(leak_free),
+        "disagg_ok": bool(started_prefill
+                          and len(migrated) == len(started_prefill)),
+    }
+
+
+def main_disagg(out_path):
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    cfg, model = build_model(on_tpu)
+    if on_tpu:
+        knobs = dict(slots=4, num_blocks=1024, block_size=16,
+                     max_seq_len=512, chunk=64, prompt_len=128,
+                     budget=96, gen_before_move=64,
+                     disagg_requests=8, disagg_budget=16,
+                     host_tier=dict(num_blocks=48, max_seq_len=256,
+                                    prefix_len=128, suffix_len=32,
+                                    families=6, budget=8,
+                                    tier_bytes=1 << 28))
+    else:
+        knobs = dict(slots=2, num_blocks=128, block_size=4,
+                     max_seq_len=128, chunk=8, prompt_len=9,
+                     budget=72, gen_before_move=64,
+                     disagg_requests=3, disagg_budget=8,
+                     host_tier=dict(num_blocks=6, max_seq_len=16,
+                                    prefix_len=8, suffix_len=3,
+                                    families=4, budget=4,
+                                    tier_bytes=1 << 22))
+
+    ok = True
+    gate_notes = []
+
+    # default engines untouched: the r10 staggered parity gate must
+    # still hold with zero migration/host-tier config
+    defaults_ok = parity_gate(model)
+    if not defaults_ok:
+        ok = False
+        gate_notes.append("default-engine parity vs eager failed")
+    print("# defaults parity: %s" % defaults_ok, file=sys.stderr)
+
+    resume_arms = []
+    for kv_dtype in (None, "int8"):
+        arm = bench_migrated_resume(model, knobs, kv_dtype=kv_dtype)
+        resume_arms.append(arm)
+        print("# resume[%s]: migrated %.2fms vs re-prefill %.2fms "
+              "(%.2fx) parity=%s" % (
+                  arm["kv_dtype"], arm["migrated_resume_ttft_ms"],
+                  arm["reprefill_resume_ttft_ms"], arm["speedup"],
+                  arm["stream_parity_vs_unmigrated"]), file=sys.stderr)
+        if not arm["stream_parity_vs_unmigrated"]:
+            ok = False
+            gate_notes.append("stream parity failed (%s)"
+                              % arm["kv_dtype"])
+        if not (arm["migrated_resume_ttft_ms"]
+                < arm["reprefill_resume_ttft_ms"]):
+            ok = False
+            gate_notes.append(
+                "migrated TTFT did not beat re-prefill (%s)"
+                % arm["kv_dtype"])
+        if not arm["pools_leak_free"]:
+            ok = False
+            gate_notes.append("pool leak (%s)" % arm["kv_dtype"])
+
+    transfers = bench_transfer_count(model, knobs)
+    print("# transfers: small=%r large=%r o1=%s" % (
+        transfers["small"], transfers["large"],
+        transfers["transfer_count_o1"]), file=sys.stderr)
+    if not transfers["transfer_count_o1"]:
+        ok = False
+        gate_notes.append("host-transfer count not O(1) in pages")
+
+    tier = bench_host_tier(model, knobs)
+    print("# host tier: with=%.2f no=%.2f spills=%d restores=%d "
+          "parity=%s" % (
+              tier["with_tier"]["hit_rate"], tier["no_tier"]["hit_rate"],
+              tier["with_tier"]["spills"],
+              tier["with_tier"]["restores"],
+              tier["parity_vs_eager"]), file=sys.stderr)
+    if not (tier["with_tier"]["hit_rate"]
+            > tier["no_tier"]["hit_rate"]):
+        ok = False
+        gate_notes.append(
+            "host-tier hit rate not strictly above the no-tier arm")
+    if not (tier["parity_vs_eager"]
+            and tier["with_tier"]["leak_free"]
+            and tier["no_tier"]["leak_free"]
+            and tier["with_tier"]["restores"] > 0):
+        ok = False
+        gate_notes.append("host-tier arm failed: %r" % (tier,))
+
+    disagg = bench_disagg_roles(model, knobs)
+    print("# disagg: started_prefill=%d migrated=%d parity=%s "
+          "chains=%s" % (
+              disagg["started_on_prefill_tier"],
+              disagg["migrated_to_decode_tier"],
+              disagg["parity_vs_eager"], disagg["span_chains_valid"]),
+          file=sys.stderr)
+    if not (disagg["disagg_ok"] and disagg["parity_vs_eager"]
+            and disagg["span_chains_valid"]
+            and disagg["pools_leak_free"]):
+        ok = False
+        gate_notes.append("disagg role drill failed: %r" % (disagg,))
+
+    fp_arm = resume_arms[0]
+    artifact = {
+        "metric": "serving_migrated_resume_ttft_speedup",
+        "value": fp_arm["speedup"],
+        "passed": ok,
+        "gate_notes": gate_notes,
+        "defaults_parity_vs_eager": bool(defaults_ok),
+        "migrated_resume": resume_arms,
+        "transfer_count": transfers,
+        "host_tier": tier,
+        "disagg_roles": disagg,
+        "provenance": {
+            "r15": "request routing only — a preempted/lost request "
+                   "re-prefills every generated token on the target "
+                   "engine (BENCH_ROUTER_r15.json)",
+            "r19": "page migration — the same preemption resumes via "
+                   "extract_blocks/inject_blocks with zero re-prefill "
+                   "(this artifact)",
+        },
+        "config": {
+            "params_m": round(param_count(cfg) / 1e6),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "dtype": cfg.dtype,
+            **{k: v for k, v in knobs.items() if k != "host_tier"},
+            "host_tier_knobs": knobs["host_tier"],
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "x",
+        "vs_baseline": artifact["value"] if ok else 0.0,
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
 def main():
+    if "--disagg" in sys.argv[1:]:
+        argv = [a for a in sys.argv[1:] if a != "--disagg"]
+        stray = [a for a in argv if a.startswith("-")]
+        if stray:
+            print("bench_serving: --disagg cannot combine with %s — "
+                  "run the modes separately" % ", ".join(stray),
+                  file=sys.stderr)
+            sys.exit(2)
+        out_path = argv[0] if argv else "BENCH_DISAGG_r19.json"
+        try:
+            main_disagg(out_path)
+        except SystemExit:
+            raise
+        except Exception as e:                        # noqa: BLE001
+            print(json.dumps({
+                "metric": "serving_migrated_resume_ttft_speedup",
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            }), flush=True)
+            sys.exit(1)
+        return
     if "--kernel" in sys.argv[1:]:
         argv = [a for a in sys.argv[1:] if a != "--kernel"]
         stray = [a for a in argv if a.startswith("-")]

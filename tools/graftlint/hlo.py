@@ -245,6 +245,23 @@ def _build_artifacts_seeded() -> Dict[str, Artifact]:
         n_pool=2 * L, psig=pool_sig, expect_i32=4, packed_len=None,
         min_aliases=2 * L)
 
+    # round 19: the page-migration inject dispatch — every pool
+    # parameter donated (the scatter is an in-place HBM write) and
+    # exactly ONE int32 host operand (the destination page ids; the
+    # page payload is the single buffer operand per dtype), so the
+    # one-transfer migration rule is machine-checked like the steps'
+    from paddle_tpu.jit.serving_step import _inject_j
+    mig_pools = caches()
+    kcs = tuple(c.key_cache for c in mig_pools)
+    vcs = tuple(c.value_cache for c in mig_pools)
+    n_pages = 2
+    codes = np.zeros((2 * L, n_pages, BLOCK_SIZE, Hkv, D), np.float32)
+    ids = np.zeros((n_pages,), np.int32)
+    art(f"inject_blocks@P{n_pages}",
+        _inject_j.lower(kcs, vcs, codes, ids),
+        n_pool=2 * L, psig=pool_sig, expect_i32=1, packed_len=None,
+        min_aliases=2 * L)
+
     import paddle_tpu.nn as nn
     from paddle_tpu.jit.train_step import TrainStep
     net = nn.Linear(8, 4)
@@ -281,9 +298,10 @@ def _doctored(name: str, **kw) -> Artifact:
 register(Rule(
     id="hlo-donation",
     family="hlo-contracts",
-    contract="the compiled train + serving steps' input_output_alias "
-             "tables cover every donated KV pool (and the train "
-             "params) — in-place updates never silently become copies",
+    contract="the compiled train + serving steps' (and the migration "
+             "inject dispatch's) input_output_alias tables cover every "
+             "donated KV pool (and the train params) — in-place "
+             "updates never silently become copies",
     check=lambda sources: _run(check_donation),
     # defect: a module whose alias table is empty
     selftest=lambda: check_donation(_doctored("inj-donation")),
@@ -306,7 +324,9 @@ register(Rule(
     family="hlo-contracts",
     contract="the mixed step carries exactly ONE int32 host operand of "
              "the pinned 4*T+max_spans*(bt_width+4) length; split "
-             "steps stay at their pinned 3/4 int32 operands",
+             "steps stay at their pinned 3/4 int32 operands; the "
+             "migration inject dispatch carries exactly one (the "
+             "destination ids — payload is one buffer per dtype)",
     check=lambda sources: _run(check_packed_layout),
     # defect: a second int32 host operand rides along
     selftest=lambda: check_packed_layout(_doctored("inj-packed")),

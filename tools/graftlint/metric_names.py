@@ -98,6 +98,14 @@ REQUIRED_NAMES = frozenset({
     "router_latency_quantile_seconds",
     "request_trace_spans_total",
     "request_trace_dropped_spans_total",
+    # KV page migration + host-RAM prefix tier + disaggregated
+    # serving (round-19; BENCH_DISAGG_r19.json)
+    "serving_page_migrations_total",
+    "serving_migrated_bytes_total",
+    "serving_host_tier_hits_total",
+    "serving_host_tier_restores_total",
+    "serving_host_tier_spills_total",
+    "router_role_dispatch_total",
 })
 
 # ---------------------------------------------------------------------------
@@ -112,11 +120,18 @@ DYNAMIC = object()
 LABEL_DOMAINS = {
     "outcome": frozenset({"completed", "truncated", "rejected",
                           "hit", "miss",
-                          "attained", "missed", "no_target"}),
-    "reason": frozenset({"preempt", "engine_lost"}),
+                          "attained", "missed", "no_target",
+                          # prefix-cache eviction outcomes (round 19)
+                          "reclaimed", "skipped_pinned"}),
+    "reason": frozenset({"preempt", "engine_lost", "migrated"}),
     "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
     "op": frozenset({"psum", "all_gather"}),
     "q": frozenset({"p50", "p95", "p99"}),
+    # page migration direction: out = extract (device→host), in =
+    # inject (host→device)
+    "direction": frozenset({"out", "in"}),
+    # disaggregated-serving engine roles
+    "role": frozenset({"prefill", "decode", "mixed"}),
     "engine": DYNAMIC,              # engine ids: bounded by pool size
     "metric": DYNAMIC,              # bench line names: bounded by the
                                     # bench's own mode set
