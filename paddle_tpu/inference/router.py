@@ -84,6 +84,23 @@ specialist (high slot counts, int8 KV) — TTFT is paid on the prefill
 tier, TPOT is isolated on the decode tier.  All-"mixed" pools (the
 default) behave exactly as in r15.
 
+**Capacity & efficiency plane (round 20, ``capacity=``).**  A router
+built with ``capacity=True`` (or a
+:class:`~paddle_tpu.observability.CapacityConfig`) samples every
+probe-refreshed engine payload into bounded per-engine
+``SignalWindow``\\ s once per step — rolling tokens/s, admission and
+preempt rates, queue-depth growth, prefix-hit-rate drift, host-tier
+spill/restore pressure, saturation EWMA — and folds the fleet rollup
+through a hysteresis + minimum-dwell planner into an advisory action
+(``scale_up`` / ``scale_down`` / ``rebalance`` / ``steady``) exposed
+via :meth:`ServingRouter.capacity_plan`,
+``health_payload()["capacity"]`` (hence ``/healthz``) and the
+``router_capacity_*`` metrics; per-engine serving-step MFU and
+HBM-bytes/token gauges ride the same plane off the cached compiled
+steps' ``cost_analysis``.  Pure advisory — the actuation (admit/drain
+engines, live resharding) is ROADMAP item 5's next PR.  Default off:
+an unconfigured router runs the exact r19 step loop.
+
 Engine protocol (what a pool member must provide): ``add_request(
 prompt_ids, max_new_tokens=, eos_token_id=)`` appending to ``waiting``,
 ``step() -> finished req_ids``, ``has_work()``, ``finished`` dict,
@@ -391,7 +408,8 @@ class ServingRouter:
                  route_seed: int = 0,
                  affinity_wait_steps: int = 8,
                  max_finished: int = 4096,
-                 tracer=None):
+                 tracer=None,
+                 capacity=None):
         if route_policy not in ("affinity", "random"):
             raise ValueError(
                 "route_policy must be 'affinity' or 'random'; got %r"
@@ -449,8 +467,17 @@ class ServingRouter:
         self._next_rid = 0
 
         from ..observability import default_registry
+        from ..observability.capacity import resolve_capacity_monitor
         from ..observability.request_trace import (LatencyReservoir,
                                                    resolve_tracer)
+        # fleet capacity & efficiency plane (round 20): OFF by default
+        # — an unconfigured router runs the exact r19 step loop (the
+        # bench's defaults-parity gate).  capacity=True (or a
+        # CapacityConfig / prebuilt FleetCapacityMonitor) samples every
+        # probe-refreshed payload into per-engine SignalWindows once
+        # per step and ticks the hysteresis+dwell planner behind
+        # ``capacity_plan()`` / ``health_payload()["capacity"]``.
+        self.capacity = resolve_capacity_monitor(capacity)
         # bounded per-request phase tracer (round 16): default ON —
         # host-side appends only; tracer=False drops to the no-op stub
         self.tracer = resolve_tracer(tracer)
@@ -601,6 +628,11 @@ class ServingRouter:
             self._sync_first_tokens(h)
         if self._disagg:
             self._migrate_ready()
+        if self.capacity is not None:
+            # one sampling + planner tick per router round, fed from
+            # the payloads _probe_all already refreshed (zero extra
+            # scrapes; O(1) window appends per engine)
+            self.capacity.observe_router(self)
         self._m_pending.set(len(self.pending))
         done, self._done_backlog = self._done_backlog, []
         return done
@@ -681,13 +713,31 @@ class ServingRouter:
         self._publish_latency_gauges(out)
         return out
 
+    def capacity_plan(self) -> Dict:
+        """The committed fleet capacity recommendation — windowed
+        per-engine signals, fleet rollup, and the advisory action
+        (``scale_up`` / ``scale_down`` / ``rebalance`` / ``steady``)
+        with the declared hysteresis bands + minimum dwell already
+        applied, so an actuator can follow it verbatim without its own
+        debouncing (ROADMAP item 5's consumer).  Requires capacity
+        monitoring: construct with ``capacity=True`` (or a
+        ``CapacityConfig`` / prebuilt ``FleetCapacityMonitor``)."""
+        if self.capacity is None:
+            raise ValueError(
+                "capacity monitoring is off: construct ServingRouter("
+                "capacity=True) (or pass a CapacityConfig / "
+                "FleetCapacityMonitor) to enable capacity_plan()")
+        return self.capacity.capacity_plan()
+
     def health_payload(self) -> Dict:
         """Fleet-level load/health snapshot (the router-side twin of
         the engine's ``health_payload``): queue depths, healthy-engine
-        count, and the SLO attainment digests.  Install as the
-        process's health provider (``observability.set_health_provider(
-        router.health_payload)``) and ``/healthz`` serves it."""
-        return {
+        count, the SLO attainment digests, and — when capacity
+        monitoring is configured — the committed capacity plan.
+        Install as the process's health provider
+        (``observability.set_health_provider(router.health_payload)``)
+        and ``/healthz`` serves it."""
+        payload = {
             "router": 1,
             "pending": len(self.pending),
             "inflight": len(self._inflight),
@@ -696,6 +746,9 @@ class ServingRouter:
                                    if h.healthy),
             "slo": self.slo_snapshot(),
         }
+        if self.capacity is not None:
+            payload["capacity"] = self.capacity.capacity_plan()
+        return payload
 
     # ---- health ---------------------------------------------------------
     def mark_unhealthy(self, engine_id: int):

@@ -738,6 +738,19 @@ class ContinuousBatchingEngine:
         # admission/prefill (None outside a step: direct _admit calls,
         # e.g. benches, skip it)
         self._finished_this_step = None
+        # per-ENGINE cumulative host counters (round 20): the
+        # process-wide prometheus counters aggregate across every
+        # engine in the process, so the capacity plane's per-engine
+        # windowed rates read THESE off health_payload instead
+        self.counters: Dict[str, int] = {
+            "tokens_generated": 0, "requests_received": 0,
+            "requests_admitted": 0, "preempts": 0}
+        # lazily computed serving-step cost_analysis block (round 20
+        # capacity plane); stays None until efficiency_stats(
+        # compute=True) runs — a health scrape must never compile —
+        # and a FAILED probe latches too (one compile attempt ever)
+        self._efficiency_stats: Optional[Dict] = None
+        self._efficiency_failed = False
 
     @staticmethod
     def _auto_buckets(max_seq_len: int):
@@ -830,6 +843,7 @@ class ContinuousBatchingEngine:
             req.t_submit = time.perf_counter()
             self.waiting.append(req)
             ids.append(req.req_id)
+        self.counters["requests_received"] += n
         self._m_queue.set(len(self.waiting))
         return ids[0] if n == 1 else ids
 
@@ -906,6 +920,7 @@ class ContinuousBatchingEngine:
                 self.waiting.pop(i)
                 self._m_queue.set(len(self.waiting))
                 r.state = "preempted"
+                self.counters["preempts"] += 1
                 self.tracer.event(req_id, "preempt", from_state="waiting",
                                   tokens=len(r.output_ids))
                 return r.prompt_ids, list(r.output_ids)
@@ -915,6 +930,7 @@ class ContinuousBatchingEngine:
             self._release_slot(r)
             r.slot = -1
             r.state = "preempted"
+            self.counters["preempts"] += 1
             self.tracer.event(req_id, "preempt", from_state="running",
                               tokens=len(r.output_ids))
             return r.prompt_ids, list(r.output_ids)
@@ -1110,6 +1126,8 @@ class ContinuousBatchingEngine:
                 self.prefix_cache.register(prompt[:full], req.block_ids)
         self._m_migrations_in.inc()
         self._m_migrated_bytes.inc(buffer.nbytes)
+        self.counters["requests_received"] += 1
+        self.counters["requests_admitted"] += 1
         self.tracer.event(req.req_id, "admit", slot=slot,
                           prefix_hit_tokens=0, prompt_tokens=L,
                           enqueue_ts=req.t_submit, migrated=True)
@@ -1122,9 +1140,18 @@ class ContinuousBatchingEngine:
         ``/healthz`` serves when this engine is installed as the
         process's health provider (``observability.set_health_provider(
         engine.health_payload)``), so a router scrapes load without
-        parsing Prometheus text."""
+        parsing Prometheus text.
+
+        Round 20: the payload also carries ``counters`` — this
+        engine's cumulative host-side counts (tokens, admissions,
+        preempts, prefix lookups/hits, host-tier spills/restores) —
+        which the capacity plane's ``SignalWindow``\\ s turn into
+        rolling rates and drifts, and ``efficiency`` once (and only
+        once) ``efficiency_stats(compute=True)`` has run — a health
+        scrape itself never triggers a compile."""
+        pc = self.prefix_cache
         cache = self.caches[0]
-        return {
+        payload = {
             "engine_id": self.engine_id,
             "role": self.role,
             "occupancy": sum(s is not None for s in self.slots),
@@ -1134,6 +1161,12 @@ class ContinuousBatchingEngine:
             "total_pages": cache.num_blocks,
             "chunk_queue_depth": (self._pending_chunks()
                                   if self.chunk_size is not None else 0),
+            # round 20: pages the prefix cache could reclaim RIGHT NOW
+            # (table entries no live request holds) — the capacity
+            # plane's saturation must not read a cache-warm idle
+            # engine as full (those pages free under pressure)
+            "evictable_pages": (pc.evictable_count()
+                                if pc is not None else 0),
             # round 19: the host spill tier's footprint rides the same
             # payload the router's load_score and the r16 SLO plane
             # already scrape — no extra endpoint
@@ -1142,6 +1175,82 @@ class ContinuousBatchingEngine:
             "host_tier_entries": (len(self.host_tier)
                                   if self.host_tier is not None else 0),
         }
+        payload["counters"] = {
+            **self.counters,
+            "prefix_lookups": (pc.hits + pc.misses) if pc is not None
+            else 0,
+            "prefix_hits": pc.hits if pc is not None else 0,
+            "host_tier_spills": pc.spills if pc is not None else 0,
+            "host_tier_restores": pc.restores if pc is not None else 0,
+        }
+        if self._efficiency_stats is not None:
+            payload["efficiency"] = self._efficiency_stats
+        return payload
+
+    def efficiency_stats(self, compute: bool = False) -> Optional[Dict]:
+        """Serving-step device-efficiency numbers off the COMPILED
+        step's ``cost_analysis`` — the serving twin of the round-9
+        train MFU probe, with the same contract: lazy, cached for the
+        engine's lifetime, one extra AOT compile ever, opt out with
+        ``PADDLE_TPU_MFU_COST_ANALYSIS=0`` (tests/conftest.py sets it,
+        so the tier-1 budget never pays this).  ``compute=False`` (the
+        health-payload read) returns the cached block or None — it
+        NEVER compiles.
+
+        The probed launch is the engine's steady-state decode shape:
+        the SMALLEST mixed token budget (an all-decode pack fits it)
+        or the split decode step at the slot count.  Per-token numbers
+        amortize over the launch's packed token capacity — padding
+        spans do sink-page work the device genuinely executes.  The
+        numbers describe the compiled XLA module, which on CPU is the
+        XLA reference attention, not the interpret-mode Pallas kernel
+        (BASELINE round-17 honesty note)."""
+        if self._efficiency_stats is not None:
+            return self._efficiency_stats
+        if not compute:
+            return None
+        if self._efficiency_failed:
+            # a failed probe is cached too — the 'one extra AOT
+            # compile ever' contract also covers the failure path (a
+            # periodic refresh must not re-pay a multi-second failing
+            # compile every sweep); the env gate is NOT a failure
+            return None
+        from ..observability.capacity import _cost_analysis_enabled
+        if not _cost_analysis_enabled():
+            return None
+        try:
+            if self.mixed is not None:
+                # the steady-state all-decode launch shape: the
+                # SMALLEST budget an all-decode pack fits (explicit
+                # budget sets only validate their TOP against it, so
+                # budgets[0] can be far smaller — probing it would
+                # amortize the weights over too few tokens and inflate
+                # the per-token numbers)
+                base = self.max_batch_size * (self.spec_k + 1)
+                T = min((b for b in self.token_budgets if b >= base),
+                        default=self.token_budgets[-1])
+                stats = self.mixed.compiled_stats(T)
+                kind = "mixed"
+            else:
+                stats = self.decode_step.compiled_stats(
+                    self.max_batch_size)
+                kind = "decode"
+        except Exception:                             # noqa: BLE001
+            self._efficiency_failed = True
+            return None
+        if not stats.get("flops_per_token"):
+            self._efficiency_failed = True
+            return None
+        self._efficiency_stats = {
+            "step": kind,
+            "tokens_per_launch": int(stats["tokens"]),
+            "flops_per_token": float(stats["flops_per_token"]),
+            "hbm_bytes_per_token": float(
+                stats.get("hbm_bytes_per_token", 0.0)),
+            "flops_per_launch": float(stats.get("flops", 0.0)),
+            "source": "cost_analysis",
+        }
+        return self._efficiency_stats
 
     # ---- page allocation ------------------------------------------------
     def _try_alloc(self) -> Optional[int]:
@@ -1279,6 +1388,7 @@ class ContinuousBatchingEngine:
         req.slot = slot
         req.state = "prefilling"
         self.slots[slot] = req
+        self.counters["requests_admitted"] += 1
         # ONE admission record (enqueue ts rides as an arg — the
         # tracer is on the admission path, so records are budgeted)
         self.tracer.event(req.req_id, "admit", slot=slot,
@@ -1919,6 +2029,7 @@ class ContinuousBatchingEngine:
 
     def _append_token(self, req: GenerationRequest, token: int):
         req.output_ids.append(token)
+        self.counters["tokens_generated"] += 1
         if len(req.output_ids) == 1:
             req.t_first_token = time.perf_counter()
             if req.t_submit:

@@ -547,6 +547,48 @@ def inject_blocks(caches, buf, dest_blocks):
         c.value_cache = vc
 
 
+def compiled_cost_stats(lowered, tokens: int) -> dict:
+    """FLOPs + byte traffic of ONE compiled serving-step module — the
+    serving twin of ``TrainStep.compiled_stats`` (the round-9 MFU
+    source), shared by all three step classes.  ``tokens`` is the
+    launch's packed token capacity (a budget-``T`` mixed launch
+    advances up to T real tokens; padding spans do sink-page work the
+    device genuinely executes, so per-token numbers are the honest
+    full-launch amortization).  XLA reports PER-DEVICE numbers, so the
+    consumer divides by per-chip peak — never peak x device_count.
+    Every field is best-effort: a backend without cost_analysis just
+    yields fewer keys."""
+    stats = {"tokens": int(tokens), "source": "cost_analysis"}
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed")):
+            if ca.get(src):
+                stats[dst] = float(ca[src])
+    except Exception:                                 # noqa: BLE001
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr, dst in (("temp_size_in_bytes", "temp_bytes"),
+                          ("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes")):
+            v = getattr(ma, attr, None)
+            if v:
+                stats[dst] = int(v)
+    except Exception:                                 # noqa: BLE001
+        pass
+    if tokens > 0:
+        if stats.get("flops"):
+            stats["flops_per_token"] = stats["flops"] / tokens
+        if stats.get("bytes_accessed"):
+            stats["hbm_bytes_per_token"] = \
+                stats["bytes_accessed"] / tokens
+    return stats
+
+
 class PrefillStep:
     """Bucketed/chunked prefill compiled into one donated XLA module per
     LENGTH BUCKET — the prefill analog of ``DecodeStep``.
@@ -735,6 +777,17 @@ class PrefillStep:
         if self.sampling:
             args.append(jnp.zeros((4,), jnp.int32))
         return fn.lower(*args, kcs, vcs, kss, vss)
+
+    def compiled_stats(self, C: int) -> dict:
+        """Cached ``cost_analysis`` of one bucket-``C`` compiled chunk
+        (see :func:`compiled_cost_stats`; same cached jit as the real
+        call, so a later dispatch does not re-trace)."""
+        cache = getattr(self, "_cost_stats", None)
+        if cache is None:
+            cache = self._cost_stats = {}
+        if C not in cache:
+            cache[C] = compiled_cost_stats(self.aot_lower(C), C)
+        return cache[C]
 
     def __call__(self, tokens, start: int, n_valid: int,
                  block_table_row, samp=None) -> int:
@@ -1137,6 +1190,18 @@ class MixedStep:
                 for _ in range(self.spec_k)))
         return fn.lower(*args, kcs, vcs, kss, vss)
 
+    def compiled_stats(self, T: int) -> dict:
+        """Cached ``cost_analysis`` of one budget-``T`` compiled mixed
+        launch (see :func:`compiled_cost_stats`) — the capacity plane's
+        per-token FLOPs/HBM source.  Reuses the ``call_packed`` jit
+        cache, so a later real call does not re-trace."""
+        cache = getattr(self, "_cost_stats", None)
+        if cache is None:
+            cache = self._cost_stats = {}
+        if T not in cache:
+            cache[T] = compiled_cost_stats(self.aot_lower(T), T)
+        return cache[T]
+
     def call_packed(self, pack: np.ndarray, T: int, q_probs=None):
         """Dispatch one pre-packed step buffer (see ``new_pack``).  The
         nine per-step operands cross the host link as ONE int32
@@ -1355,6 +1420,18 @@ class DecodeStep:
         if self.sampling:
             args.append(jnp.zeros((slots, 4), jnp.int32))
         return self._fn.lower(*args, kcs, vcs, kss, vss)
+
+    def compiled_stats(self, slots: int) -> dict:
+        """Cached ``cost_analysis`` of the compiled decode launch at
+        ``slots`` slots (one token per slot per launch — see
+        :func:`compiled_cost_stats`)."""
+        cache = getattr(self, "_cost_stats", None)
+        if cache is None:
+            cache = self._cost_stats = {}
+        if slots not in cache:
+            cache[slots] = compiled_cost_stats(self.aot_lower(slots),
+                                               slots)
+        return cache[slots]
 
     def __call__(self, tokens, seq_lens, block_tables,
                  samp=None) -> np.ndarray:

@@ -57,6 +57,10 @@ from .request_trace import (RequestTracer, NullRequestTracer,
                             NULL_TRACER, resolve_tracer,
                             LatencyReservoir, validate_span_chain,
                             fleet_trace)
+from .capacity import (SignalWindow, EngineCapacityMonitor,
+                       CapacityConfig, CapacityPlanner,
+                       FleetCapacityMonitor, resolve_capacity_monitor,
+                       CAPACITY_ACTIONS)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "MetricError",
@@ -72,4 +76,7 @@ __all__ = [
     "RequestTracer", "NullRequestTracer", "NULL_TRACER",
     "resolve_tracer", "LatencyReservoir", "validate_span_chain",
     "fleet_trace",
+    "SignalWindow", "EngineCapacityMonitor", "CapacityConfig",
+    "CapacityPlanner", "FleetCapacityMonitor",
+    "resolve_capacity_monitor", "CAPACITY_ACTIONS",
 ]

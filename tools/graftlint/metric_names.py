@@ -12,14 +12,18 @@ repo's metric-naming contract:
 3. base units only: no ``_ms``/``_us``/``_mb``/``_kb``/... suffixes —
    durations are ``_seconds``, sizes are ``_bytes``;
 4. the unit is the SUFFIX: a name containing ``seconds``/``bytes``
-   anywhere else (before ``_total`` for counters) is malformed;
+   anywhere else (before ``_total`` for counters) is malformed —
+   except inside a trailing ``<unit>_per_<x>`` ratio (round 20:
+   ``serving_hbm_bytes_per_token``), which is still a base unit;
 5. one name, one type: the same name registered as two different kinds
    anywhere in the tree is an error (the runtime registry would also
    raise, but only when both sites actually execute);
-6. required families: the serving engine's contract metrics (the
-   bucketed-prefill/prefix-cache set the round-10 bench gates on) must
-   exist somewhere in the scan — a rename that silently drops one is an
-   error here, not a dashboard surprise;
+6. required families + PACKAGE COVERAGE (tightened round 20): every
+   contract metric (the set external dashboards/benches key on) must
+   have at least one registration site INSIDE ``paddle_tpu/`` — a
+   rename that silently drops one is an error here, not a dashboard
+   surprise, and a bench/tools script re-registering the name no
+   longer masks the serving code renaming it away;
 7. label CARDINALITY (round 16): every label name used at a
    ``.labels(...)`` call site must be declared in ``LABEL_DOMAINS``
    with a finite value set (or the DYNAMIC sentinel for label values
@@ -60,6 +64,12 @@ _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _BANNED_SUFFIXES = ("_ms", "_msec", "_millis", "_us", "_micros", "_ns",
                     "_minutes", "_hours", "_kb", "_mb", "_gb", "_kib",
                     "_mib", "_gib")
+
+# base-unit RATIOS: a unit may also sit inside a trailing
+# '<unit>_per_<x>' compound (round 20: serving_hbm_bytes_per_token) —
+# still a base unit, still machine-greppable
+_PER_UNIT_RE = {u: re.compile(rf"{u}_per_[a-z0-9_]+$")
+                for u in ("seconds", "bytes")}
 
 # contract metrics external dashboards/benches key on: the serving
 # engine must keep registering these names (see BENCH_SERVE_r10.json
@@ -106,6 +116,15 @@ REQUIRED_NAMES = frozenset({
     "serving_host_tier_restores_total",
     "serving_host_tier_spills_total",
     "router_role_dispatch_total",
+    # fleet capacity & efficiency plane (round-20; BENCH_CAP_r20.json)
+    "router_capacity_recommendation",
+    "router_capacity_transitions_total",
+    "router_capacity_saturation_ratio",
+    "router_capacity_headroom_ratio",
+    "router_capacity_tokens_per_second",
+    "serving_step_mfu",
+    "serving_hbm_bytes_per_token",
+    "serving_model_flops_per_token",
 })
 
 # ---------------------------------------------------------------------------
@@ -132,6 +151,9 @@ LABEL_DOMAINS = {
     "direction": frozenset({"out", "in"}),
     # disaggregated-serving engine roles
     "role": frozenset({"prefill", "decode", "mixed"}),
+    # capacity-plane advisory actions (round 20)
+    "action": frozenset({"scale_up", "scale_down", "rebalance",
+                         "steady"}),
     "engine": DYNAMIC,              # engine ids: bounded by pool size
     "metric": DYNAMIC,              # bench line names: bounded by the
                                     # bench's own mode set
@@ -265,6 +287,7 @@ def lint(regs) -> List[str]:
         errors.append(f"{where[0]}:{where[1]}: {msg}")
 
     kinds: Dict[str, Tuple[str, Tuple[str, int]]] = {}
+    in_package: set = set()
     for rel, line, kind, name in regs:
         where = (rel, line)
         if not _SNAKE_RE.match(name):
@@ -281,18 +304,33 @@ def lint(regs) -> List[str]:
                 err(where, f"{name!r} uses a non-base unit {suf!r}; "
                            f"use '_seconds' / '_bytes'")
         for unit in ("seconds", "bytes"):
-            if unit in base.split("_") and not base.endswith(unit):
+            if unit in base.split("_") and not base.endswith(unit) \
+                    and not _PER_UNIT_RE[unit].search(base):
                 err(where, f"{name!r}: unit '{unit}' must be the "
-                           f"suffix (before '_total' for counters)")
+                           f"suffix (before '_total' for counters), "
+                           f"or part of a trailing "
+                           f"'{unit}_per_<x>' ratio")
         seen = kinds.get(name)
         if seen is None:
             kinds[name] = (kind, where)
         elif seen[0] != kind:
             err(where, f"{name!r} registered as {kind} here but as "
                        f"{seen[0]} at {seen[1][0]}:{seen[1][1]}")
-    for name in sorted(REQUIRED_NAMES - set(kinds)):
-        errors.append(f"<scan>: required metric {name!r} is registered "
-                      f"nowhere under {SCAN}")
+        if rel.split(os.sep, 1)[0] == "paddle_tpu":
+            in_package.add(name)
+    # REQUIRED coverage (round 20): a contract name must have at least
+    # one registration site INSIDE the package — a bench/tools script
+    # re-registering the name must not mask the serving code renaming
+    # it away (the dashboards scrape the serving process, not a bench)
+    for name in sorted(REQUIRED_NAMES):
+        if name not in kinds:
+            errors.append(f"<scan>: required metric {name!r} is "
+                          f"registered nowhere under {SCAN}")
+        elif name not in in_package:
+            errors.append(
+                f"<scan>: required metric {name!r} has no registration "
+                f"site inside paddle_tpu/ — only bench/tools sites "
+                f"register it, so the serving contract is gone")
     return errors
 
 
@@ -347,18 +385,24 @@ def _to_findings(errors: List[str]) -> List[Finding]:
 
 
 def _selftest() -> List[Finding]:
-    # one injected defect per sub-contract: a camelCase gauge and a
-    # per-request label value must both be caught.  Only the findings
-    # that name the INJECTED defects count — the synthetic one-entry
-    # registration list also trips the required-families check, and
-    # counting that collateral would let a blinded snake_case/label
-    # checker pass the selftest
+    # one injected defect per sub-contract: a camelCase gauge, a
+    # per-request label value, and a required name whose only
+    # registration site sits OUTSIDE the package (the round-20
+    # coverage check) must all be caught.  Only the findings that name
+    # the INJECTED defects count — the synthetic registration lists
+    # also trip the other required-families errors, and counting that
+    # collateral would let a blinded checker pass the selftest
     errs = lint([("inj.py", 1, "gauge", "badName")])
     errs += lint_label_sites([("inj.py", 2, "engine", "str(req.req_id)")])
+    errs += lint([(os.path.join("tools", "inj_bench.py"), 1, "counter",
+                   "router_requests_total")])
     hits = [e for e in errs
-            if "is not snake_case" in e or "per-request identifier" in e]
-    if len(hits) < 2:
-        return []            # one of the two checkers went blind
+            if "is not snake_case" in e
+            or "per-request identifier" in e
+            or ("router_requests_total" in e
+                and "no registration site inside paddle_tpu/" in e)]
+    if len(hits) < 3:
+        return []            # one of the three checkers went blind
     return _to_findings(hits)
 
 
