@@ -330,6 +330,8 @@ class ContinuousBatchingEngine:
         else:
             self.tp = None
         self.tp_degree = self.tp.degree if self.tp is not None else 1
+        self.fsdp_degree = self.tp.fsdp_degree \
+            if self.tp is not None else 1
         if quant_collectives and self.tp is None:
             raise ValueError(
                 "quant_collectives=True but the mesh's tp axis "
@@ -686,6 +688,38 @@ class ContinuousBatchingEngine:
         self._m_tp_psum = self._m_tp_collective.labels(op="psum")
         self._m_tp_all_gather = \
             self._m_tp_collective.labels(op="all_gather")
+        # 2D serving mesh (round 21): per-axis shape of the most
+        # recently constructed engine's mesh — fsdp (weight storage),
+        # tp (compute), dp (replica); 1 = the axis is absent
+        self._m_mesh_shape = r.gauge(
+            "serving_mesh_shape",
+            "serving mesh degree per axis for the most recently "
+            "constructed engine (fsdp = weight-storage sharding, tp = "
+            "tensor parallel, dp = replica) — 1 means the axis is "
+            "absent", labels=("axis",))
+        mesh_sizes = dict(self.tp.mesh.shape) if self.tp is not None \
+            else {}
+        self._m_mesh_shape.labels(axis="fsdp").set(
+            self.fsdp_degree)
+        self._m_mesh_shape.labels(axis="tp").set(self.tp_degree)
+        self._m_mesh_shape.labels(axis="dp").set(
+            int(mesh_sizes.get("dp", 1)))
+        self._m_fsdp_gather = r.counter(
+            "spmd_allgather_bytes_total",
+            "per-chip bytes received by spmd param all-gathers, by "
+            "site: the 2D train step's per-step param gather "
+            "(train_params) and the sharded serving prologue's fsdp "
+            "gather (serving_params)", labels=("site",)
+        ).labels(site="serving_params")
+        # static per-dispatch payload of the prologue's fsdp param
+        # gather (0 without an fsdp axis) — counted per sharded
+        # dispatch next to the activation collectives
+        if self.tp is not None:
+            tree = self.weight_qtree if self.weight_qtree is not None \
+                else {k: t._value for k, t in model.state_dict().items()}
+            self._fsdp_gather_bytes = self.tp.fsdp_gather_bytes(tree)
+        else:
+            self._fsdp_gather_bytes = 0
         self._m_kv_quant_dtype = r.gauge(
             "serving_kv_quant_dtype",
             "KV-cache element width in bits of the most recently "
@@ -2019,6 +2053,8 @@ class ContinuousBatchingEngine:
             self._m_tp_all_gather.inc(by_op["all_gather"])
             if self.quant_collectives:
                 self._m_quant_all_gather.inc(by_op["all_gather"])
+        if self._fsdp_gather_bytes:
+            self._m_fsdp_gather.inc(self._fsdp_gather_bytes)
 
     def record_token_mismatches(self, n: int):
         """Feed the quant token-mismatch counter (callers: the paired

@@ -117,9 +117,10 @@ def _resolve_tp(model, mesh, sharding, tp: Optional[TPContext]
 
 def _embed(llama, tokens, tp: Optional[TPContext]) -> Tensor:
     """Embedding lookup shared by all three traced bodies: the module's
-    gather single-chip, the vocab-parallel masked lookup + exact psum
+    gather single-chip (and pure-fsdp, whose params are full after the
+    prologue gather), the vocab-parallel masked lookup + exact psum
     under tp.  ``tokens`` already carries the body's batch shape."""
-    if tp is None:
+    if tp is None or tp.axis is None:
         return llama.embed_tokens(Tensor._from_value(tokens))
     return Tensor._from_value(tp_embed(
         llama.embed_tokens.weight._value, tokens, tp.axis))
@@ -130,7 +131,7 @@ def _tp_psum(t: Tensor, tp: Optional[TPContext]) -> Tensor:
     row-sharded projection's partial sums over the tp axis otherwise.
     (The ONE place the per-layer collective lives — the spot a
     quantized all-reduce would drop into.)"""
-    if tp is None:
+    if tp is None or tp.axis is None:
         return t
     return Tensor._from_value(jax.lax.psum(t._value, tp.axis))
 
@@ -141,7 +142,7 @@ def _tp_logits(logits: Tensor, tp: Optional[TPContext],
     the on-device argmax sees the full vocab row.  ``q8`` swaps in the
     EQuARX-style int8 gather (``spmd.tp_gather_logits_q8``) — ~4× less
     interconnect payload, tolerance-gated instead of exact."""
-    if tp is None:
+    if tp is None or tp.axis is None:
         return logits
     if q8:
         return Tensor._from_value(
@@ -221,7 +222,10 @@ def _ensure_quant_specs(tp: Optional[TPContext], qtree) -> None:
     from .spmd import llama_param_specs
     missing = [k for k in qtree if k not in tp.specs]
     if missing:
-        tp.specs.update(llama_param_specs(missing, tp.layout))
+        tp.specs.update(llama_param_specs(
+            missing, tp.layout,
+            shapes={k: tuple(qtree[k].shape) for k in missing},
+            mesh=tp.mesh))
     for k, v in qtree.items():
         spec = tp.specs[k]
         if v.ndim == 1 and tuple(spec) and spec[0] is not None \
@@ -236,13 +240,22 @@ def _ensure_quant_specs(tp: Optional[TPContext], qtree) -> None:
 def _wrap_sharded(step, tp: TPContext, params_dict, n_layers: int,
                   n_repl: int, donate, quant_kv: bool = False):
     """Wrap a serving-step body as the explicit SPMD program: shard_map
-    over the tp axis (params by family spec — including int8 weights
+    over the mesh (params by family spec — including int8 weights
     and their scale vectors, the ``n_repl`` host operands replicated,
     per-layer KV pools head-sharded with their absmax tables when
     quantized) under a jit whose in/out shardings pin the placed
     layouts — donation of the pools carries through, so the cache
-    append stays an in-place HBM update on every chip."""
+    append stays an in-place HBM update on every chip.
+
+    2D mesh (round 21): when the context carries an fsdp axis, the
+    params enter in their fsdp×tp STORAGE placement (the same one the
+    2D train step produces — zero re-sharding) and a prologue
+    all-gathers each fsdp-sharded param back to its tp compute shard
+    before the unchanged body runs; pools and host operands never name
+    fsdp, so they replicate across it (and across any extra replica
+    axis) for free."""
     from ..core.jax_compat import shard_map_compat
+    from .spmd import fsdp_gather
     repl = PartitionSpec()
     pspecs = {k: tp.specs[k] for k in params_dict}
     pools = (tp.layout.kv_pool(),) * n_layers
@@ -250,6 +263,13 @@ def _wrap_sharded(step, tp: TPContext, params_dict, n_layers: int,
     in_specs = (pspecs,) + (repl,) * n_repl + (pools, pools,
                                                spools, spools)
     out_specs = (repl, pools, pools, spools, spools)
+    if tp.fsdp_axis is not None:
+        inner, faxis = step, tp.fsdp_axis
+
+        def step(params, *rest):                       # noqa: F811
+            params = {k: fsdp_gather(v, pspecs[k], faxis)
+                      for k, v in params.items()}
+            return inner(params, *rest)
     fn = shard_map_compat(step, tp.mesh, in_specs=in_specs,
                           out_specs=out_specs)
     return jax.jit(fn, donate_argnums=donate,

@@ -55,14 +55,45 @@ by sampling.  Speculative verification stays single-chip for now (the
 draft engine is unsharded); engines reject ``draft_model + mesh`` at
 construction.
 
-SNIPPETS.md [3] ``SpecLayout`` (fsdp×tp, MaxText-style) is the exemplar
-this table specializes: serving has no fsdp axis (weights are read-only
-— replicating them across an fsdp axis buys nothing per step), so every
-family collapses to its tp entry.
+2D mesh (round 21) — fsdp×tp everywhere: the MaxText-style fsdp axis
+of SNIPPETS.md [3] now composes with the tp table above instead of
+collapsing away.  ``SpecLayout(fsdp_axis=...)`` shards each family's
+NON-tp dimension over fsdp, so parameter *storage* is cut by
+fsdp·tp per chip (ZeRO-3, the stage arXiv:2004.13336 stops short of)
+while tp keeps sharding *compute*:
+
+====================  =============  ==================================
+family                1D tp spec     fsdp-composed spec
+====================  =============  ==================================
+embed_tokens.weight   P(tp, None)    P(tp, fsdp)        [V, h]
+q/k/v_proj.weight     P(None, tp)    P(fsdp, tp)        [h, H*D]
+o_proj.weight         P(tp, None)    P(tp, fsdp)        [H*D, h]
+gate/up_proj.weight   P(None, tp)    P(fsdp, tp)        [h, I]
+down_proj.weight      P(tp, None)    P(tp, fsdp)        [I, h]
+lm_head.weight        P(None, tp)    P(fsdp, tp)        [h, V]
+norms / unknown 1-D   P()            P(fsdp) when dim0 divides, else P()
+KV page pools         P(,,tp,)       unchanged (replicated over fsdp)
+====================  =============  ==================================
+
+Serving gathers the fsdp shards back per dispatch (ONE tiled
+all-gather per fsdp-sharded param, inside the shard_map body — the
+payload ``spmd_allgather_bytes_total{site="serving_params"}``
+accounts), then runs the unchanged Megatron-tp body; training keeps
+params / grads / optimizer state in the fsdp×tp placement end to end
+(gather for compute, reduce-scatter of grads back to the shard,
+sharded update).  Because BOTH steps store the same placement, a
+trained param tree serves with zero re-sharding: ``place_params`` is
+buffer-identity on already-placed arrays.  Specs never name a replica
+(dp) axis, so a 3D serving mesh ``(dp, fsdp, tp)`` replicates
+weights/pools across dp for throughput with no code change.  Dims an
+axis does not divide are PRUNED from the spec (storage optimization
+degrades, never errors); ``mesh_2d`` builds the canonical mesh.
 """
 from __future__ import annotations
 
 from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -70,9 +101,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingConfig", "SpecLayout", "TPContext",
            "resolve_mesh_axis", "llama_param_specs",
-           "validate_tp_serving", "tp_mesh", "tp_serving_context",
-           "tp_embed", "tp_gather_logits", "tp_gather_logits_q8",
-           "shard_arrays"]
+           "validate_tp_serving", "tp_mesh", "mesh_2d",
+           "tp_serving_context", "tp_embed", "tp_gather_logits",
+           "tp_gather_logits_q8", "shard_arrays", "spec_axes",
+           "prune_spec_axes", "gather_spec_axes", "fsdp_gather"]
 
 P = PartitionSpec
 
@@ -102,8 +134,10 @@ class ShardingConfig:
         if int(stage) not in (1, 2):
             raise ValueError(
                 f"ShardingConfig stage must be 1 (os) or 2 (os_g), got "
-                f"{stage!r}; stage 3 stores the params themselves sharded "
-                f"(GroupShardedStage3)")
+                f"{stage!r}; stage-3 (params themselves sharded) is not a "
+                f"stage knob here — pass a mesh with an 'fsdp' axis "
+                f"(spmd.mesh_2d) and the fsdp×tp TrainStep stores the "
+                f"params ZeRO-3-sharded as its natural layout")
         if loss_reduction not in ("mean", "sum"):
             raise ValueError(
                 f"loss_reduction must be 'mean' or 'sum', got "
@@ -167,50 +201,91 @@ def tp_mesh(tp: int, axis: str = "tp"):
     return ProcessMesh(shape=[tp], dim_names=[axis])
 
 
+def mesh_2d(fsdp: int, tp: int, replica: int = 1,
+            fsdp_axis: str = "fsdp", tp_axis: str = "tp",
+            replica_axis: str = "dp"):
+    """The canonical 2D ``(fsdp, tp)`` ProcessMesh over the first
+    ``replica*fsdp*tp`` devices — first-class instead of the ad-hoc
+    device reshapes tests/benches used to hand-roll.  ``replica > 1``
+    prepends a pure data-parallel axis (3D serving mesh: weights and
+    KV pools replicate across it because specs never name it; the 2D
+    train step treats it as extra batch parallelism)."""
+    from ..distributed.process_mesh import ProcessMesh
+    need = int(replica) * int(fsdp) * int(tp)
+    n = jax.device_count()
+    if need > n:
+        raise ValueError(
+            f"mesh_2d(replica={replica}, fsdp={fsdp}, tp={tp}) needs "
+            f"{need} devices but only {n} are visible; for CPU dryruns "
+            f"call paddle_tpu.testing.dryrun.force_cpu_devices first")
+    if replica > 1:
+        return ProcessMesh(shape=[replica, fsdp, tp],
+                           dim_names=[replica_axis, fsdp_axis, tp_axis])
+    return ProcessMesh(shape=[fsdp, tp], dim_names=[fsdp_axis, tp_axis])
+
+
 # ---------------------------------------------------------------------------
 # canonical per-weight-family specs
 # ---------------------------------------------------------------------------
 class SpecLayout:
-    """Canonical PartitionSpecs per llama weight family for
-    tensor-parallel serving (see the module docstring's table)."""
+    """Canonical PartitionSpecs per llama weight family (see the module
+    docstring's tables).  ``tp_axis`` shards compute (Megatron);
+    ``fsdp_axis`` (round 21, MaxText-style) additionally shards each
+    family's non-tp dimension for ZeRO-3 weight STORAGE.  Either axis
+    may be ``None`` — a pure-fsdp layout (tp_axis=None) stores sharded
+    weights but runs single-chip-math bodies after the gather."""
 
-    def __init__(self, tp_axis: str = "tp"):
+    def __init__(self, tp_axis: Optional[str] = "tp",
+                 fsdp_axis: Optional[str] = None):
         self.tp_axis = tp_axis
+        self.fsdp_axis = fsdp_axis
 
     def embeddings(self) -> PartitionSpec:
         """[V, h] vocab-row sharded: masked local lookup + one exact
-        psum (Megatron vocab-parallel embedding)."""
-        return P(self.tp_axis, None)
+        psum (Megatron vocab-parallel embedding); fsdp on the hidden
+        dim."""
+        return P(self.tp_axis, self.fsdp_axis)
 
     def qkv_projection(self) -> PartitionSpec:
         """[h, H*D] column (head) sharded: each chip projects only its
-        own query/kv heads."""
-        return P(None, self.tp_axis)
+        own query/kv heads; fsdp on the input dim."""
+        return P(self.fsdp_axis, self.tp_axis)
 
     def qkv_bias(self) -> PartitionSpec:
-        """[H*D] follows its projection's column shard."""
+        """[H*D] follows its projection's column shard (the one dim is
+        tp's, so no fsdp composition)."""
         return P(self.tp_axis)
 
     def attn_output(self) -> PartitionSpec:
-        """[H*D, h] row sharded — the per-layer psum boundary."""
-        return P(self.tp_axis, None)
+        """[H*D, h] row sharded — the per-layer psum boundary; fsdp on
+        the output dim."""
+        return P(self.tp_axis, self.fsdp_axis)
 
     def ffn_up(self) -> PartitionSpec:
         """gate/up [h, I] column sharded (SwiGLU is elementwise on the
-        shard)."""
-        return P(None, self.tp_axis)
+        shard); fsdp on the input dim."""
+        return P(self.fsdp_axis, self.tp_axis)
 
     def ffn_down(self) -> PartitionSpec:
-        """down [I, h] row sharded — the other per-layer psum."""
-        return P(self.tp_axis, None)
+        """down [I, h] row sharded — the other per-layer psum; fsdp on
+        the output dim."""
+        return P(self.tp_axis, self.fsdp_axis)
 
     def lm_head(self) -> PartitionSpec:
         """[h, V] vocab-column sharded: local [*, V/tp] logits, one
-        exact all-gather before the on-device argmax."""
-        return P(None, self.tp_axis)
+        exact all-gather before the on-device argmax; fsdp on the
+        input dim."""
+        return P(self.fsdp_axis, self.tp_axis)
 
     def replicated(self) -> PartitionSpec:
         return P()
+
+    def fsdp_default(self) -> PartitionSpec:
+        """Unknown / 1-D families (norm weights, generic Linear params)
+        under an fsdp axis: shard dim0 for the storage win — pruned
+        back to replicated when dim0 does not divide (see
+        :func:`prune_spec_axes`)."""
+        return P(self.fsdp_axis) if self.fsdp_axis else P()
 
     def kv_pool(self) -> PartitionSpec:
         """[phys_pages, block_size, Hkv, D] sharded over kv heads: each
@@ -239,11 +314,23 @@ class SpecLayout:
 
 def llama_param_specs(keys: Iterable[str],
                       layout: Optional[SpecLayout] = None,
+                      shapes: Optional[Dict[str, Tuple[int, ...]]] = None,
+                      mesh: Optional[Mesh] = None,
                       ) -> Dict[str, PartitionSpec]:
     """Classify llama state-dict keys into the canonical family specs.
 
-    Unknown families (norm weights, scalars) stay replicated — correct
-    for anything whose math runs identically on every chip.
+    Unknown families (norm weights, scalars) stay replicated under a
+    pure-tp layout — correct for anything whose math runs identically
+    on every chip; under an fsdp layout they take ``fsdp_default()``
+    (dim0 storage shard) instead.
+
+    ``shapes`` + ``mesh`` (required whenever ``layout.fsdp_axis`` is
+    set) prune every spec against the actual dims: an axis that does
+    not divide a dim is dropped from that dim's entry
+    (:func:`prune_spec_axes`) — fsdp is a storage optimization that
+    degrades instead of erroring, and BOTH the train step and the
+    serving context run the same pruning so the placements agree
+    (the zero-re-sharding contract).
 
     Serving-PTQ trees (``quantization.functional.quantize_param_tree``)
     interleave per-channel scale vectors under ``<param>::scale`` keys;
@@ -281,8 +368,79 @@ def llama_param_specs(keys: Iterable[str],
         elif "lm_head" in k:
             specs[k] = layout.lm_head()
         else:
-            specs[k] = layout.replicated()
+            specs[k] = layout.fsdp_default()
+    if shapes is not None and mesh is not None:
+        specs = {k: prune_spec_axes(s, shapes[k], mesh)
+                 if k in shapes else s for k, s in specs.items()}
     return specs
+
+
+# ---------------------------------------------------------------------------
+# spec algebra (shared by the 2D train step and the serving prologue)
+# ---------------------------------------------------------------------------
+def _entry_names(entry) -> Tuple[str, ...]:
+    """A PartitionSpec entry's axis names: None -> (), 'x' -> ('x',),
+    ('x', 'y') -> ('x', 'y')."""
+    if entry is None:
+        return ()
+    if isinstance(entry, tuple):
+        return tuple(entry)
+    return (entry,)
+
+
+def spec_axes(spec: PartitionSpec) -> Tuple[str, ...]:
+    """Every mesh axis a spec names, in dim order."""
+    out = []
+    for entry in spec:
+        out.extend(_entry_names(entry))
+    return tuple(out)
+
+
+def prune_spec_axes(spec: PartitionSpec, shape: Tuple[int, ...],
+                    mesh: Mesh) -> PartitionSpec:
+    """Drop axis names a dim cannot honor: any name whose (cumulative)
+    degree does not divide the dim size, and any spec entry past the
+    array's rank.  The survivors are exactly the shardings
+    ``NamedSharding(mesh, spec)`` can place, so train and serve agree
+    on the SAME pruned placement by construction."""
+    entries = []
+    for dim, entry in enumerate(spec):
+        if dim >= len(shape):
+            break
+        keep, part = [], 1
+        for name in _entry_names(entry):
+            size = mesh.shape.get(name, 1) if hasattr(mesh.shape, "get") \
+                else dict(mesh.shape).get(name, 1)
+            if size > 1 and shape[dim] % (part * size) == 0:
+                keep.append(name)
+                part *= size
+        entries.append(tuple(keep) if len(keep) > 1
+                       else (keep[0] if keep else None))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def gather_spec_axes(x, spec: PartitionSpec,
+                     axes: Optional[Sequence[str]] = None):
+    """Inside a shard_map body: all-gather ``x`` (tiled, in axis-major
+    order) along every dim whose spec entry names one of ``axes``
+    (None = every named axis), reconstructing the full value from the
+    placed shard.  The inverse of the per-dim sharding the spec
+    declares — ONE tiled all-gather per (dim, axis) pair.  A tuple
+    entry splits its dim major-to-minor, so the gather runs minor
+    first (reversed) to land every block at its global offset."""
+    for dim, entry in enumerate(spec):
+        for name in reversed(_entry_names(entry)):
+            if axes is None or name in axes:
+                x = jax.lax.all_gather(x, name, axis=dim, tiled=True)
+    return x
+
+
+def fsdp_gather(x, spec: PartitionSpec, fsdp_axis: str):
+    """The serving prologue's param gather: undo only the fsdp STORAGE
+    shard, leaving the tp compute shard in place."""
+    return gather_spec_axes(x, spec, (fsdp_axis,))
 
 
 def shard_arrays(arrays: Dict[str, jnp.ndarray], mesh: Mesh,
@@ -327,15 +485,30 @@ class TPContext:
     sharded parameters (placed lazily on first use; params are
     read-only in serving, so they never cross the host link again)."""
 
-    def __init__(self, mesh: Mesh, axis: str, degree: int,
-                 layout: SpecLayout, specs: Dict[str, PartitionSpec]):
+    def __init__(self, mesh: Mesh, axis: Optional[str], degree: int,
+                 layout: SpecLayout, specs: Dict[str, PartitionSpec],
+                 fsdp_axis: Optional[str] = None, fsdp_degree: int = 1):
         self.mesh = mesh
-        self.axis = axis
-        self.degree = degree
+        self.axis = axis                  # tp axis (None: pure fsdp)
+        self.degree = degree              # tp degree (compute shard)
+        self.fsdp_axis = fsdp_axis if fsdp_degree > 1 else None
+        self.fsdp_degree = fsdp_degree if fsdp_degree > 1 else 1
         self.layout = layout
         self.specs = specs
         self._placed: Optional[Dict[str, jnp.ndarray]] = None
         self._placed_src: Dict[str, jnp.ndarray] = {}
+        self._fsdp_bytes: Optional[int] = None
+
+    def _place_one(self, k, v):
+        """device_put UNLESS the array already carries exactly this
+        sharding — then keep the buffer itself.  This is the
+        train-to-serve zero-re-sharding contract: the 2D TrainStep's
+        outputs are placed with the SAME mesh/specs, so serving them
+        is pointer identity, not a host (or even device) copy."""
+        sh = NamedSharding(self.mesh, self.specs[k])
+        if isinstance(v, jax.Array) and getattr(v, "sharding", None) == sh:
+            return v
+        return jax.device_put(v, sh)
 
     def place_params(self, arrays: Dict[str, jnp.ndarray]
                      ) -> Dict[str, jnp.ndarray]:
@@ -345,18 +518,49 @@ class TPContext:
         a HELD reference (a bare id() could be fooled by address reuse
         after the old array is freed) and only the changed params are
         re-placed.  Steady-state serving pays an `is` comparison per
-        param, never a transfer."""
+        param, never a transfer; an array that ALREADY carries its
+        target sharding (the 2D train step's placed output) is adopted
+        by identity, never copied."""
         if self._placed is None:
-            self._placed = shard_arrays(
-                arrays, self.mesh, {k: self.specs[k] for k in arrays})
+            self._placed = {k: self._place_one(k, v)
+                            for k, v in arrays.items()}
             self._placed_src = dict(arrays)
             return self._placed
         for k, v in arrays.items():
             if self._placed_src.get(k) is not v:
-                self._placed[k] = jax.device_put(
-                    v, NamedSharding(self.mesh, self.specs[k]))
+                self._placed[k] = self._place_one(k, v)
                 self._placed_src[k] = v
         return self._placed
+
+    def fsdp_gather_bytes(self, arrays: Dict[str, jnp.ndarray]) -> int:
+        """Per-chip bytes RECEIVED by the serving prologue's fsdp param
+        all-gathers in one sharded dispatch (0 without an fsdp axis):
+        for each fsdp-sharded param, the chip holds 1/(tp_part*fsdp)
+        and receives the other (fsdp-1) fsdp shards of its tp slice.
+        Static per engine — cached on first call (the accounting behind
+        ``spmd_allgather_bytes_total{site=...}``)."""
+        if self.fsdp_axis is None:
+            return 0
+        if self._fsdp_bytes is not None:
+            return self._fsdp_bytes
+        sizes = dict(self.mesh.shape)
+        total = 0
+        for k, v in arrays.items():
+            spec = self.specs.get(k)
+            if spec is None:
+                continue
+            names = spec_axes(spec)
+            if self.fsdp_axis not in names:
+                continue
+            part = 1
+            for n in names:
+                part *= sizes.get(n, 1)
+            fdeg = sizes.get(self.fsdp_axis, 1)
+            nbytes = int(np.prod(v.shape)) * v.dtype.itemsize \
+                if v.shape else v.dtype.itemsize
+            total += nbytes // part * (fdeg - 1)
+        self._fsdp_bytes = total
+        return total
 
     def collective_bytes(self, cfg, n_tokens: int,
                          n_gather_rows: int,
@@ -372,6 +576,10 @@ class TPContext:
         the 4-byte per-shard scale — the payload the quantized
         collective actually moves (reported under
         ``serving_quant_collective_bytes_total`` too)."""
+        if self.degree <= 1:
+            # pure-fsdp serving: the body runs single-chip math after
+            # the param gather, so there are no activation collectives
+            return {"psum": 0, "all_gather": 0}
         item = 2 if cfg.dtype == "bfloat16" else 4
         shard = n_gather_rows * (cfg.vocab_size // self.degree)
         return {
@@ -395,23 +603,47 @@ class TPContext:
 
     def __repr__(self):
         return (f"TPContext(axis={self.axis!r}, degree={self.degree}, "
+                f"fsdp_axis={self.fsdp_axis!r}, "
+                f"fsdp_degree={self.fsdp_degree}, "
                 f"mesh={tuple(self.mesh.shape.items())})")
 
 
 def tp_serving_context(model, mesh, sharding: Optional[ShardingConfig]
                        = None) -> Optional[TPContext]:
     """Resolve engine-construction arguments into a :class:`TPContext`
-    (or None when the axis degenerates to 1 — run the single-chip
-    step).  Validates every divisibility constraint up front."""
+    (or None when every sharding axis degenerates to 1 — run the
+    single-chip step).  Validates every tp divisibility constraint up
+    front; an ``fsdp`` mesh axis (round 21) composes weight-storage
+    sharding on top (specs pruned per param shape), and any OTHER mesh
+    axis — e.g. a ``dp`` replica axis — is simply never named by a
+    spec, so weights and pools replicate across it."""
     cfg = sharding or ShardingConfig(axis="tp")
-    jmesh, axis, deg = resolve_mesh_axis(
-        mesh, cfg.axis, cfg.degree, candidates=("tp", "model", "mp"))
-    if deg <= 1:
+    from ..distributed.process_mesh import as_jax_mesh
+    jmesh = as_jax_mesh(mesh) if mesh is not None else None
+    fsdp_axis = "fsdp" if jmesh is not None \
+        and "fsdp" in jmesh.axis_names else None
+    fsdp_deg = jmesh.shape["fsdp"] if fsdp_axis else 1
+    try:
+        jmesh, axis, deg = resolve_mesh_axis(
+            mesh, cfg.axis, cfg.degree, candidates=("tp", "model", "mp"))
+    except ValueError:
+        # no tp axis at all — a pure-fsdp (or fsdp×dp) mesh is still a
+        # sharded-storage serving context; anything else re-raises
+        if fsdp_deg <= 1:
+            raise
+        axis, deg = None, 1
+    if deg <= 1 and fsdp_deg <= 1:
         return None
-    validate_tp_serving(model.config, deg)
-    layout = SpecLayout(tp_axis=axis)
-    specs = llama_param_specs(model.state_dict().keys(), layout)
-    return TPContext(jmesh, axis, deg, layout, specs)
+    if deg > 1:
+        validate_tp_serving(model.config, deg)
+    layout = SpecLayout(tp_axis=axis if deg > 1 else None,
+                        fsdp_axis=fsdp_axis if fsdp_deg > 1 else None)
+    sd = model.state_dict()
+    shapes = {k: tuple(t._value.shape) for k, t in sd.items()}
+    specs = llama_param_specs(sd.keys(), layout, shapes=shapes,
+                              mesh=jmesh)
+    return TPContext(jmesh, axis if deg > 1 else None, deg, layout,
+                     specs, fsdp_axis=fsdp_axis, fsdp_degree=fsdp_deg)
 
 
 # ---------------------------------------------------------------------------

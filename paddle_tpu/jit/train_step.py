@@ -46,7 +46,9 @@ from ..ops import random as _random
 # the mesh/axis/spec machinery is shared with the serving steps — one
 # SPMD module (jit/spmd.py) is the single source of both; ShardingConfig
 # is re-exported here for the existing import sites
-from .spmd import ShardingConfig, resolve_mesh_axis
+from .spmd import (ShardingConfig, SpecLayout, _entry_names,
+                   gather_spec_axes, llama_param_specs,
+                   resolve_mesh_axis, spec_axes)
 
 __all__ = ["TrainStep", "ShardingConfig"]
 
@@ -116,6 +118,21 @@ class TrainStep:
 
     # -- sharded setup -------------------------------------------------------
     def _setup_sharded(self, mesh, cfg: ShardingConfig, sd):
+        # 2D (fsdp×tp) mesh (round 21): params/grads/optimizer state
+        # live fsdp×tp-sharded end to end — ZeRO-3 as the storage
+        # layout, composed with the serving tp placement
+        if mesh is not None:
+            from ..distributed.process_mesh import as_jax_mesh
+            probe = as_jax_mesh(mesh)
+            total = 1
+            for a in probe.axis_names:
+                total *= probe.shape[a]
+            # any mesh that names an fsdp axis and has >1 chip takes
+            # the 2D path — including fsdp=1 x tp>1, where tp alone is
+            # the storage axis (a degenerate-but-valid grid corner)
+            if "fsdp" in probe.axis_names and total > 1:
+                self._setup_sharded_2d(probe, cfg, sd)
+                return
         jmesh, axis, deg = resolve_mesh_axis(
             mesh, cfg.axis, cfg.degree,
             candidates=("dp", "sharding", "data"))
@@ -125,8 +142,10 @@ class TrainStep:
                  and jmesh.shape[a] > 1]
         if other:
             raise NotImplementedError(
-                f"sharded weight update composes only with pure data "
-                f"parallelism for now; mesh has extra axes {other}")
+                f"the 1D sharded weight update composes only with pure "
+                f"data parallelism; mesh has extra axes {other} — for "
+                f"fsdp×tp weight sharding name the storage axis 'fsdp' "
+                f"(spmd.mesh_2d) and the 2D path takes over")
         if not getattr(self.optimizer, "shardable_update", True):
             raise ValueError(
                 f"{type(self.optimizer).__name__}'s update rule is not "
@@ -134,6 +153,7 @@ class TrainStep:
                 f"per shard) — use the replicated TrainStep; its state is "
                 f"small anyway")
         self._sharded = True
+        self._mode = "1d"
         self._jmesh = jmesh
         self._axis = axis
         self._deg = deg
@@ -169,6 +189,101 @@ class TrainStep:
         for k in self._trainable:
             self._refresh_state(k, sd[k])
 
+    def _setup_sharded_2d(self, jmesh, cfg: ShardingConfig, sd):
+        """fsdp×tp weight sharding (round 21): every trainable param is
+        STORED in its composed family placement (``spmd.SpecLayout``
+        with an fsdp axis — ZeRO-3 subsumed as the storage layout, no
+        stage knob), optimizer state and grads inherit it, and the
+        traced step gathers for compute / reduce-scatters back.  Extra
+        mesh axes (a ``dp`` replica axis) are pure batch parallelism:
+        the batch shards over EVERY axis and grads reduce over the
+        axes a spec does not name."""
+        if not getattr(self.optimizer, "shardable_update", True):
+            raise ValueError(
+                f"{type(self.optimizer).__name__}'s update rule is not "
+                f"elementwise (cross-element reductions would be computed "
+                f"per shard) — use the replicated TrainStep; its state is "
+                f"small anyway")
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._sharded = True
+        self._mode = "2d"
+        self._jmesh = jmesh
+        self._shard_cfg = cfg
+        sizes = dict(jmesh.shape)
+        self._axes = tuple(jmesh.axis_names)
+        self._deg = 1
+        for a in self._axes:
+            self._deg *= sizes[a]
+        tp_live = sizes.get("tp", 1) > 1
+        self._fsdp_deg = sizes["fsdp"]
+        self._tp_deg = sizes.get("tp", 1)
+        self._repl = NamedSharding(jmesh, PartitionSpec())
+        self._row_sh = None              # 1D-path artifact, unused here
+        layout = SpecLayout(tp_axis="tp" if tp_live else None,
+                            fsdp_axis="fsdp")
+        shapes = {k: tuple(sd[k]._value.shape) for k in self._trainable}
+        specs = llama_param_specs(self._trainable, layout,
+                                  shapes=shapes, mesh=jmesh)
+        # shardability: a named spec AND param-shaped (elementwise)
+        # optimizer state — a non-param-shaped leaf forces the whole
+        # param back to replicated, same contract as the 1D path
+        self._shardable: Dict[str, bool] = {}
+        self._param_specs: Dict[str, Any] = {}
+        self._param_sh: Dict[str, Any] = {}
+        self._state_shardings: Dict[str, Dict[str, Any]] = {}
+        for k in self._trainable:
+            p = sd[k]
+            spec = specs[k]
+            ok = bool(spec_axes(spec))
+            if ok:
+                abstract = jax.eval_shape(
+                    self._make_state_init(p, k),
+                    jax.ShapeDtypeStruct(shapes[k], p._value.dtype))
+                for leaf in jax.tree_util.tree_leaves(abstract):
+                    if leaf.ndim >= 1 and tuple(leaf.shape) != shapes[k]:
+                        import warnings
+                        warnings.warn(
+                            f"param {k!r}: optimizer state leaf of shape "
+                            f"{leaf.shape} is not parameter-shaped; its "
+                            f"param stays replicated", stacklevel=3)
+                        ok = False
+                        break
+            if not ok:
+                spec = PartitionSpec()
+            self._shardable[k] = ok
+            self._param_specs[k] = spec
+            self._param_sh[k] = NamedSharding(jmesh, spec)
+        self._opt_states = {}
+        for k in self._trainable:
+            self._refresh_state(k, sd[k])
+        # observability: the storage-sharding degree this process
+        # trains at, plus the static per-dispatch fsdp/tp param-gather
+        # payload (counted per step in __call__)
+        from ..observability import default_registry
+        r = default_registry()
+        r.gauge(
+            "train_fsdp_degree",
+            "fsdp (weight-storage sharding) degree of the most "
+            "recently constructed 2D TrainStep in this process "
+            "(1 = params replicated)").set(self._fsdp_deg)
+        self._m_gather_bytes = r.counter(
+            "spmd_allgather_bytes_total",
+            "per-chip bytes received by spmd param all-gathers, by "
+            "site: the 2D train step's per-step param gather "
+            "(train_params) and the sharded serving prologue's fsdp "
+            "gather (serving_params)", labels=("site",)
+        ).labels(site="train_params")
+        self._gather_bytes_per_step = 0
+        for k in self._trainable:
+            part = 1
+            for name in spec_axes(self._param_specs[k]):
+                part *= sizes.get(name, 1)
+            if part > 1:
+                v = sd[k]._value
+                nbytes = int(np.prod(shapes[k])) * v.dtype.itemsize
+                self._gather_bytes_per_step += \
+                    nbytes - nbytes // part
+
     def _make_state_init(self, p, k):
         opt = self.optimizer
         name = getattr(p, "name", k)
@@ -185,7 +300,8 @@ class TrainStep:
     def _leaf_sharding(self, k, p, leaf_shape):
         if self._shardable[k] and len(leaf_shape) >= 1 \
                 and tuple(leaf_shape) == tuple(p._value.shape):
-            return self._row_sh
+            return self._param_sh[k] if getattr(self, "_mode", "1d") \
+                == "2d" else self._row_sh
         return self._repl
 
     def _refresh_state(self, k, p):
@@ -226,6 +342,23 @@ class TrainStep:
         call, so jit never reshards a donated argument (donation aliases
         from the very first step)."""
         for k in self._trainable + self._frozen:
+            v = sd[k]._value
+            if not (isinstance(v, jax.Array) and v.sharding == self._repl):
+                sd[k]._value = jax.device_put(jnp.asarray(v), self._repl)
+
+    def _place_params_2d(self, sd):
+        """2D path: trainable params placed in their fsdp×tp STORAGE
+        sharding (the replicated tensor never exists past the first
+        placement — ZeRO-3), frozen buffers replicated.  Arrays already
+        carrying their sharding (the step's own outputs, or a serving
+        tree handed back) are left untouched, so steady state pays an
+        equality probe, never a transfer."""
+        for k in self._trainable:
+            v = sd[k]._value
+            sh = self._param_sh[k]
+            if not (isinstance(v, jax.Array) and v.sharding == sh):
+                sd[k]._value = jax.device_put(jnp.asarray(v), sh)
+        for k in self._frozen:
             v = sd[k]._value
             if not (isinstance(v, jax.Array) and v.sharding == self._repl):
                 sd[k]._value = jax.device_put(jnp.asarray(v), self._repl)
@@ -464,6 +597,141 @@ class TrainStep:
         self._step_fn = jax.jit(fn, donate_argnums=(0, 2),
                                 in_shardings=in_sh, out_shardings=out_sh)
 
+    def _build_sharded_2d(self, batch_vals):
+        """The fsdp×tp traced body (round 21).  Params enter (and
+        leave) in their composed STORAGE placement; per step each param
+        is all-gathered over every axis its spec names (the ZeRO-3
+        gather — under a 2D mesh the tp axis too acts as a storage
+        axis for training, since compute here is batch-parallel over
+        ALL chips), grads reduce-scatter straight back into the
+        placement (one ``psum_scatter`` per sharded dim, a plain
+        ``psum`` over the axes the spec does not name), and the
+        elementwise update runs on the local shard with local state —
+        no trailing param all-gather, the output IS the placement the
+        serving steps consume.  Donation (params + opt states) and the
+        compile-count contract are unchanged from the 1D path."""
+        from ..core.jax_compat import shard_map_compat
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        opt = self.optimizer
+        trainable = self._trainable
+        clip_norm = self.clip_norm
+        mesh, axes = self._jmesh, self._axes
+        sizes = dict(mesh.shape)
+        live_axes = tuple(a for a in axes if sizes[a] > 1)
+        total = self._deg
+        mean_combine = self._shard_cfg.loss_reduction == "mean"
+        specs = self._param_specs
+
+        def linear_index():
+            idx = jnp.asarray(0, jnp.int32)
+            for a in axes:
+                idx = idx * sizes[a] + jax.lax.axis_index(a)
+            return idx
+
+        def sync_grads(grads):
+            """Every grad leaves reduced over ALL mesh axes and
+            scattered into its param's placement: psum_scatter along
+            each spec-named dim (major-to-minor within a dim), psum
+            over the remaining axes."""
+            out = {}
+            for k in trainable:
+                g = grads[k]
+                remaining = [a for a in live_axes]
+                for dim, entry in enumerate(specs[k]):
+                    for name in _entry_names(entry):
+                        g = jax.lax.psum_scatter(
+                            g, name, scatter_dimension=dim, tiled=True)
+                        remaining.remove(name)
+                if remaining:
+                    g = jax.lax.psum(g, tuple(remaining))
+                if mean_combine:
+                    g = g / total
+                out[k] = g
+            return out
+
+        def step(params, frozen_vals, opt_states, lr, key, *batch):
+            self.compile_count += 1
+            # the ZeRO-3 compute gather: full value per spec-named axis
+            full = {k: gather_spec_axes(params[k], specs[k])
+                    for k in trainable}
+            # distinct dropout stream per chip — the linear (…,fsdp,tp)
+            # index matches the 1D dp path's replica order, so an
+            # fsdp×tp run draws the same per-shard streams as dp at
+            # equal total degree (the parity gate relies on it)
+            loss_fn = self._make_loss_fn(
+                frozen_vals, batch, jax.random.fold_in(key,
+                                                       linear_index()))
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(full)
+
+            grads = sync_grads(grads)
+
+            if clip_norm is not None:
+                # global grad norm from the PLACED shards: per group of
+                # params sharing a spec-axis set, sum local squares and
+                # psum over exactly those axes (replicated contributions
+                # count once; sharded ones sum to the full square norm)
+                groups: Dict[tuple, Any] = {}
+                for k in trainable:
+                    ax = tuple(sorted(set(spec_axes(specs[k]))))
+                    sq = jnp.sum(jnp.square(
+                        grads[k].astype(jnp.float32)))
+                    groups[ax] = groups.get(
+                        ax, jnp.asarray(0.0, jnp.float32)) + sq
+                tot = jnp.asarray(0.0, jnp.float32)
+                for ax, sq in groups.items():
+                    tot = tot + (jax.lax.psum(sq, ax) if ax else sq)
+                gnorm = jnp.sqrt(tot)
+                scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                grads = {k: (g * scale).astype(g.dtype)
+                         for k, g in grads.items()}
+
+            hyper = {"lr": lr}
+            new_params = {}
+            new_states = {}
+            for k in trainable:
+                # params, grads and state are ALL in the placement —
+                # the elementwise update needs no slicing and no
+                # trailing gather (arXiv:2004.13336 generalized to 2D)
+                np_, nst = opt._update_rule(params[k], grads[k],
+                                            opt_states[k], hyper)
+                new_params[k] = np_
+                new_states[k] = nst
+            loss = jax.lax.pmean(loss, live_axes) if mean_combine \
+                else jax.lax.psum(loss, live_axes)
+            new_bufs = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, live_axes), new_bufs)
+            return loss, new_params, new_states, new_bufs
+
+        P = PartitionSpec
+        repl_spec = P()
+        param_specs = {k: specs[k] for k in trainable}
+        state_specs = {
+            k: {n: sh.spec
+                for n, sh in self._state_shardings[k].items()}
+            for k in trainable}
+        batch_specs = tuple(P(axes) if np.ndim(b) >= 1 else P()
+                            for b in batch_vals)
+        in_specs = (param_specs, repl_spec, state_specs, repl_spec,
+                    repl_spec) + batch_specs
+        out_specs = (repl_spec, param_specs, state_specs, repl_spec)
+        fn = shard_map_compat(step, mesh, in_specs=in_specs,
+                              out_specs=out_specs)
+
+        def to_sh(spec_tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+        in_sh = (to_sh(param_specs), self._repl, to_sh(state_specs),
+                 self._repl, self._repl) + tuple(to_sh(s)
+                                                 for s in batch_specs)
+        out_sh = (self._repl, to_sh(param_specs), to_sh(state_specs),
+                  self._repl)
+        self._step_fn = jax.jit(fn, donate_argnums=(0, 2),
+                                in_shardings=in_sh, out_shardings=out_sh)
+
     # -- checkpoint plumbing --------------------------------------------------
     # The CheckpointManager snapshots these LIVE (possibly ZeRO-sharded)
     # state arrays shard-wise at a step boundary; restore reshards them
@@ -512,7 +780,9 @@ class TrainStep:
     # -- common driver --------------------------------------------------------
     def _ensure_built(self, batch_vals):
         if self._step_fn is None:
-            if self._sharded:
+            if self._sharded and getattr(self, "_mode", "1d") == "2d":
+                self._build_sharded_2d(batch_vals)
+            elif self._sharded:
                 self._build_sharded(batch_vals)
             else:
                 self._build()
@@ -528,10 +798,13 @@ class TrainStep:
                     # cryptic mid-jit divisibility error
                     raise ValueError(
                         f"sharded TrainStep: batch dim0={b.shape[0]} "
-                        f"is not divisible by the dp degree "
+                        f"is not divisible by the mesh degree "
                         f"{self._deg}; use drop_last=True (Engine.fit "
                         f"does) or pad the tail batch")
-            self._place_replicated(sd)
+            if getattr(self, "_mode", "1d") == "2d":
+                self._place_params_2d(sd)
+            else:
+                self._place_replicated(sd)
             for k in self._trainable:
                 self._refresh_state(k, sd[k])
         params = {k: sd[k]._value for k in self._trainable}
@@ -606,6 +879,9 @@ class TrainStep:
         # valid after the donated buffers die
         for k, nst in new_states.items():
             self._opt_states[k].update(nst)
+        if getattr(self, "_mode", None) == "2d":
+            # static per-dispatch param-gather payload (per chip)
+            self._m_gather_bytes.inc(self._gather_bytes_per_step)
         if isinstance(self.optimizer._learning_rate, object) and \
                 hasattr(self.optimizer._learning_rate, "step"):
             pass  # caller drives the scheduler

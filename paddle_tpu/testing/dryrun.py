@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["force_cpu_devices"]
+__all__ = ["force_cpu_devices", "cpu_mesh_2d"]
 
 
 def force_cpu_devices(n_devices: int = 8) -> None:
@@ -88,3 +88,17 @@ def force_cpu_devices(n_devices: int = 8) -> None:
     assert jax.devices()[0].platform == "cpu", "CPU forcing failed"
     assert jax.device_count() >= n_devices, (
         f"only {jax.device_count()} CPU devices, wanted {n_devices}")
+
+
+def cpu_mesh_2d(fsdp: int, tp: int, replica: int = 1):
+    """First-class 2D dryrun mesh (round 21): force enough virtual CPU
+    devices for an ``fsdp x tp`` (optionally ``dp x fsdp x tp``) mesh
+    and return the :func:`paddle_tpu.jit.spmd.mesh_2d` ProcessMesh over
+    them.  The one-liner behind the 2D tests and ``tools/
+    bench_spmd2d.py`` — replaces ad-hoc ``force_cpu_devices(N)`` +
+    hand-built ``ProcessMesh`` pairs, and never shrinks an
+    already-forced larger device count (safe under the conftest-forced
+    8-device mesh)."""
+    force_cpu_devices(max(replica * fsdp * tp, 1))
+    from ..jit.spmd import mesh_2d
+    return mesh_2d(fsdp, tp, replica=replica)

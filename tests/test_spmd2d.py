@@ -1,0 +1,293 @@
+"""2D fsdp x tp mesh — ZeRO-3 weight storage composed with tensor
+parallel, train-to-serve (round-21 tentpole, jit/spmd.py).
+
+The contract gated here:
+
+- ``SpecLayout(fsdp_axis=...)`` composes the fsdp axis onto the
+  NON-tp dimension of every weight family, and ``prune_spec_axes``
+  drops exactly the axis names whose cumulative degree does not divide
+  the dim (storage degrades, never errors) — identically on the train
+  and serve side, which is what makes the placements agree by
+  construction;
+- the 2D fused train step stores params/grads/optimizer state in the
+  composed placement (per-chip param+opt bytes ~ 1/(fsdp*tp)),
+  compiles exactly once, and its loss trajectory is parity-exact with
+  the 1D dp step at equal total degree;
+- the serving engine adopts the train step's placed tree BY BUFFER
+  IDENTITY (zero re-sharding) and serves tokens byte-identical to the
+  single-chip engine — including the pure-fsdp (tp=1) corner.
+
+Budget note: the tier-1 suite runs AT the 870s timeout — everything
+that compiles a step or builds an engine is @slow; the unmarked tests
+are pure host-side spec/mesh arithmetic (<1s total).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing.dryrun import cpu_mesh_2d, force_cpu_devices
+
+force_cpu_devices(8)     # no-op under conftest; the documented entry
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.jit.spmd import (  # noqa: E402
+    SpecLayout, TPContext, gather_spec_axes, llama_param_specs, mesh_2d,
+    prune_spec_axes, spec_axes, tp_serving_context)
+
+STEPS = 6
+TOL = 1e-5
+
+
+# ---------------------------------------------------------------------------
+# tier-1: spec composition / pruning / mesh helpers (no compiles)
+# ---------------------------------------------------------------------------
+def test_spec_layout_fsdp_composes_on_non_tp_dim():
+    lay = SpecLayout(tp_axis="tp", fsdp_axis="fsdp")
+    assert lay.embeddings() == P("tp", "fsdp")
+    assert lay.qkv_projection() == P("fsdp", "tp")
+    assert lay.attn_output() == P("tp", "fsdp")
+    assert lay.ffn_up() == P("fsdp", "tp")
+    assert lay.ffn_down() == P("tp", "fsdp")
+    assert lay.lm_head() == P("fsdp", "tp")
+    assert lay.fsdp_default() == P("fsdp")
+    # pure-fsdp layout: tp axis gone, storage axis everywhere
+    pf = SpecLayout(tp_axis=None, fsdp_axis="fsdp")
+    assert spec_axes(pf.qkv_projection()) == ("fsdp",)
+    # 1D layouts are untouched (defaults parity with r20)
+    assert SpecLayout().qkv_projection() == P(None, "tp")
+
+
+def test_prune_spec_axes_divisibility():
+    mesh = mesh_2d(2, 2)
+    import paddle_tpu.distributed.process_mesh as pm
+    jmesh = pm.as_jax_mesh(mesh)
+    # both axes divide: spec survives whole
+    assert prune_spec_axes(P("fsdp", "tp"), (64, 32), jmesh) \
+        == P("fsdp", "tp")
+    # dim0 not divisible by fsdp=2: the fsdp name drops, tp stays
+    assert prune_spec_axes(P("fsdp", "tp"), (63, 32), jmesh) \
+        == P(None, "tp")
+    # trailing Nones are popped (canonical form)
+    assert prune_spec_axes(P("fsdp", "tp"), (64, 31), jmesh) \
+        == P("fsdp")
+    # tuple entry prunes minor names first
+    assert prune_spec_axes(P(("fsdp", "tp"),), (2,), jmesh) == P("fsdp")
+    # rank overflow truncates instead of erroring
+    assert prune_spec_axes(P("fsdp", "tp"), (64,), jmesh) == P("fsdp")
+
+
+def test_llama_param_specs_prune_with_shapes_and_mesh():
+    mesh = cpu_mesh_2d(2, 2)
+    import paddle_tpu.distributed.process_mesh as pm
+    jmesh = pm.as_jax_mesh(mesh)
+    lay = SpecLayout(tp_axis="tp", fsdp_axis="fsdp")
+    keys = ["llama.layers.0.self_attn.q_proj.weight",
+            "llama.layers.0.input_layernorm.weight"]
+    shapes = {keys[0]: (64, 64), keys[1]: (63,)}
+    specs = llama_param_specs(keys, lay, shapes=shapes, mesh=jmesh)
+    assert specs[keys[0]] == P("fsdp", "tp")
+    # norm vector of odd length: fsdp pruned away -> replicated
+    assert specs[keys[1]] == P()
+
+
+def test_mesh_2d_shapes_and_validation():
+    m = mesh_2d(2, 2)
+    assert tuple(m.shape) == (2, 2)
+    assert tuple(m.dim_names) == ("fsdp", "tp")
+    m3 = mesh_2d(2, 2, replica=2)
+    assert tuple(m3.dim_names) == ("dp", "fsdp", "tp")
+    with pytest.raises(ValueError, match="device"):
+        mesh_2d(64, 64)
+
+
+def test_tp_context_fsdp_gather_bytes_accounting():
+    import paddle_tpu.distributed.process_mesh as pm
+    jmesh = pm.as_jax_mesh(cpu_mesh_2d(2, 2))
+    specs = {"w": P("fsdp", "tp"), "norm": P()}
+    lay = SpecLayout(tp_axis="tp", fsdp_axis="fsdp")
+    ctx = TPContext(jmesh, "tp", 2, lay, specs,
+                    fsdp_axis="fsdp", fsdp_degree=2)
+    arrays = {"w": np.zeros((8, 8), np.float32),
+              "norm": np.zeros((8,), np.float32)}
+    # w: 256B total, sharded 1/(2*2)=64B per chip, receives the other
+    # fsdp shard of its tp slice: 128B - 64B = 64B; norm: replicated, 0
+    assert ctx.fsdp_gather_bytes(arrays) == 64
+    # cached (static per engine)
+    assert ctx.fsdp_gather_bytes({}) == 64
+
+
+def test_serving_context_2d_degrees():
+    mesh = cpu_mesh_2d(2, 2)
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            vocab_size=64, intermediate_size=64)
+    model = LlamaForCausalLM(cfg)
+    tp = tp_serving_context(model, mesh, None)
+    assert tp.degree == 2 and tp.fsdp_degree == 2
+    assert tp.fsdp_axis == "fsdp"
+    # pure-fsdp mesh: tp axis degenerates, context still sharded
+    tpf = tp_serving_context(model, mesh_2d(4, 1), None)
+    assert tpf.degree == 1 and tpf.fsdp_degree == 4
+    assert tpf.axis is None
+    # fully degenerate mesh: no context at all (defaults parity)
+    assert tp_serving_context(model, mesh_2d(1, 1), None) is None
+
+
+# ---------------------------------------------------------------------------
+# slow lane: end-to-end train parity / placed-tree identity / serving
+# ---------------------------------------------------------------------------
+def _model_and_step(mesh=None, stage=None):
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.jit.spmd import ShardingConfig
+    from paddle_tpu.models import (LlamaForCausalLM,
+                                   LlamaPretrainingCriterion,
+                                   llama_tiny_config)
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    kw = {}
+    if stage is not None:
+        from paddle_tpu.distributed.process_mesh import ProcessMesh
+        kw = dict(mesh=ProcessMesh(shape=[4], dim_names=["dp"]),
+                  sharding=ShardingConfig(stage=stage))
+    elif mesh is not None:
+        kw = dict(mesh=mesh, sharding=ShardingConfig(axis="fsdp"))
+    step = TrainStep(model, lambda lg, lb: crit(lg, lb), opt,
+                     clip_norm=1.0, **kw)
+    return model, step, cfg
+
+
+def _losses(step, cfg, steps=STEPS):
+    rng = np.random.RandomState(0)
+    batches = [(rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+                rng.randint(0, cfg.vocab_size, (8, 16)).astype(np.int64))
+               for _ in range(3)]
+    paddle.seed(1234)
+    out = []
+    for i in range(steps):
+        ids, labels = batches[i % len(batches)]
+        loss = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+        out.append(float(np.asarray(loss._value)))
+    return out
+
+
+def _per_chip_bytes(model, step):
+    def one(v):
+        shard = v.sharding.shard_shape(v.shape) \
+            if hasattr(v, "sharding") else v.shape
+        return int(np.prod(shard)) * v.dtype.itemsize if shard \
+            else v.dtype.itemsize
+    total = sum(one(t._value) for t in model.state_dict().values())
+    for st in step._opt_states.values():
+        total += sum(one(v) for v in st.values() if hasattr(v, "shape"))
+    return total
+
+
+@pytest.mark.slow
+def test_2d_train_parity_vs_dp4_and_per_chip_bytes():
+    """fsdp2 x tp2 train: losses parity-exact with the 1D dp=4 stage-2
+    step AND the plain replicated step, one compile, per-chip
+    param+opt bytes ~1/4 of replicated."""
+    model_r, step_r, cfg = _model_and_step()
+    ref = _losses(step_r, cfg)
+
+    model_d, step_d, _ = _model_and_step(stage=2)
+    dp4 = _losses(step_d, cfg)
+
+    mesh = cpu_mesh_2d(2, 2)
+    model_2, step_2, _ = _model_and_step(mesh=mesh)
+    two_d = _losses(step_2, cfg)
+
+    assert step_2.compile_count == 1
+    assert max(abs(a - b) for a, b in zip(two_d, ref)) <= TOL
+    assert max(abs(a - b) for a, b in zip(two_d, dp4)) <= TOL
+    ratio = (_per_chip_bytes(model_2, step_2)
+             / _per_chip_bytes(model_r, step_r))
+    # composed specs shard every projection 1/4; small norm vectors
+    # stay replicated, so allow modest slack above the ideal 0.25
+    assert ratio <= 0.35, ratio
+
+
+@pytest.mark.slow
+def test_train_to_serve_placed_tree_identity_and_token_parity():
+    """The engine serves from the 2D train step's placed params with
+    ZERO host copies: every param adopted by buffer identity, tokens
+    byte-identical to the single-chip engine on the same weights."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    mesh = cpu_mesh_2d(2, 2)
+    model, step, cfg = _model_and_step(mesh=mesh)
+    _losses(step, cfg, steps=3)
+    model.eval()
+
+    placed = {k: t._value for k, t in model.state_dict().items()}
+    eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                   num_blocks=64, block_size=4,
+                                   mesh=mesh, mixed_step=True,
+                                   prefill_chunk_size=4)
+    prompts = [np.array([5, 7, 11], np.int64),
+               np.array([2, 3, 4, 5, 6], np.int64)]
+    rids = [eng.add_request(p, 6) for p in prompts]
+    eng.run_to_completion()
+    toks = [eng.result(r) for r in rids]
+
+    assert eng.fsdp_degree == 2 and eng.tp_degree == 2
+    for k, v in placed.items():
+        assert eng.tp._placed[k] is v, f"{k} was re-placed (host copy)"
+
+    # single-chip reference on the SAME trained weights
+    host = {k: np.asarray(v) for k, v in placed.items()}
+    paddle.seed(0)
+    from paddle_tpu.models import LlamaForCausalLM
+    model1 = LlamaForCausalLM(cfg)
+    import jax.numpy as jnp
+    for k, t in model1.state_dict().items():
+        t._value = jnp.asarray(host[k])
+    model1.eval()
+    eng1 = ContinuousBatchingEngine(model1, max_batch_size=4,
+                                    num_blocks=64, block_size=4,
+                                    mixed_step=True, prefill_chunk_size=4)
+    rids1 = [eng1.add_request(p, 6) for p in prompts]
+    eng1.run_to_completion()
+    assert [eng1.result(r) for r in rids1] == toks
+
+
+@pytest.mark.slow
+def test_pure_fsdp_serving_parity():
+    """fsdp=4, tp=1: weights stored 1/4 per chip, the prologue gather
+    reconstructs them, and the math stays single-chip — tokens
+    byte-identical to the unsharded engine."""
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models import (LlamaForCausalLM, llama_tiny_config)
+    paddle.seed(0)
+    cfg = llama_tiny_config(hidden_size=64, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            vocab_size=128, intermediate_size=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def run(mesh):
+        eng = ContinuousBatchingEngine(model, max_batch_size=4,
+                                       num_blocks=64, block_size=4,
+                                       mesh=mesh, mixed_step=True,
+                                       prefill_chunk_size=4)
+        rid = eng.add_request(np.array([7, 9, 2], np.int64), 6)
+        eng.run_to_completion()
+        return eng, eng.result(rid)
+
+    e1, t1 = run(None)
+    e4, t4 = run(cpu_mesh_2d(4, 1))
+    assert t4 == t1
+    assert e4.fsdp_degree == 4 and e4.tp_degree == 1
+    assert e4._fsdp_gather_bytes > 0
+    # fsdp-sharded storage really is 1/4 on the projections
+    w = e4.tp._placed["llama.layers.0.self_attn.q_proj.weight"]
+    assert np.prod(w.sharding.shard_shape(w.shape)) * 4 \
+        == np.prod(w.shape)

@@ -48,6 +48,9 @@ TINY = dict(num_hidden_layers=1, hidden_size=32, num_attention_heads=2,
 NUM_BLOCKS, BLOCK_SIZE = 8, 4
 BT_WIDTH, MAX_SPANS, SPAN_Q = 4, 2, 4
 MIXED_T, DECODE_SLOTS, PREFILL_C = 8, 2, 8
+# round 21: the 2D fsdp x tp mesh the extra artifacts lower under —
+# every TINY dim divides by 2, so the composed specs survive pruning
+MESH_FSDP, MESH_TP = 2, 2
 
 
 @dataclass
@@ -175,12 +178,17 @@ def _avals_of(lowered) -> List[Tuple[str, Tuple[int, ...]]]:
 
 
 def build_artifacts() -> Dict[str, Artifact]:
-    """Build + compile the four step artifacts once per process (tiny
-    1-layer model, CPU platform — deterministic anywhere)."""
+    """Build + compile the step artifacts once per process (tiny
+    1-layer model, CPU platform — deterministic anywhere): the four
+    1D lowerings plus the round-21 fsdp x tp pair (2D mixed step and
+    2D train step)."""
     if _ARTIFACTS:
         return _ARTIFACTS
     from paddle_tpu.testing.dryrun import force_cpu_devices
-    force_cpu_devices(1)
+    # 4 virtual devices: the 1D artifacts still lower single-chip
+    # (their HLO is device-count independent), and the round-21
+    # fsdp x tp artifacts get their (2,2) mesh
+    force_cpu_devices(MESH_FSDP * MESH_TP)
     import paddle_tpu as paddle
 
     # seed for deterministic artifacts, but restore the ambient RNG
@@ -272,6 +280,45 @@ def _build_artifacts_seeded() -> Dict[str, Artifact]:
     n_params = len(net.state_dict())
     art("train_step", step.lower(x, y), n_pool=0, psig=None,
         expect_i32=None, packed_len=None, min_aliases=n_params)
+
+    # round 21: the same contracts under a 2D fsdp x tp mesh — the
+    # r18 artifacts above only pin the 1D lowerings, and 2D
+    # in/out_shardings are exactly where donation aliasing and the
+    # one-packed-operand rule can silently regress (a resharding
+    # inserted between a donated operand and its output kills the
+    # alias; an fsdp gather staged OUTSIDE the shard_map would surface
+    # as a new host operand)
+    from paddle_tpu.jit.spmd import ShardingConfig, mesh_2d
+    mesh2d = mesh_2d(MESH_FSDP, MESH_TP)
+    mixed2d = MixedStep(model, caches(), bt_width=BT_WIDTH,
+                        max_spans=MAX_SPANS, span_q=SPAN_Q,
+                        use_pallas=False, mesh=mesh2d)
+    # the sharded module's entry layout is PER-SHARD: the pool's kv
+    # heads arrive already divided by tp (fsdp never names the pools)
+    shard_shape = list(probe.shape)
+    shard_shape[2] //= MESH_TP
+    pool_sig_2d = "f32[" + ",".join(str(d) for d in shard_shape) + "]"
+    art(f"mixed_step_2d@T{MIXED_T}", mixed2d.aot_lower(MIXED_T),
+        n_pool=2 * L, psig=pool_sig_2d, expect_i32=1,
+        packed_len=packed_len, min_aliases=2 * L)
+
+    model2d = LlamaForCausalLM(cfg)
+    opt2d = paddle.optimizer.SGD(0.1,
+                                 parameters=model2d.parameters())
+
+    def lm_loss(logits, labels):
+        import paddle_tpu.nn.functional as F
+        return F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]),
+            labels.reshape([-1]))
+
+    step2d = TrainStep(model2d, lm_loss, opt2d, mesh=mesh2d,
+                       sharding=ShardingConfig(axis="fsdp"))
+    ids2d = paddle.to_tensor(
+        np.zeros((MESH_FSDP * MESH_TP, 8), np.int64))
+    art("train_step_2d", step2d.lower(ids2d, ids2d), n_pool=0,
+        psig=None, expect_i32=None, packed_len=None,
+        min_aliases=len(model2d.state_dict()))
     return _ARTIFACTS
 
 

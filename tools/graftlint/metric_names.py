@@ -125,6 +125,10 @@ REQUIRED_NAMES = frozenset({
     "serving_step_mfu",
     "serving_hbm_bytes_per_token",
     "serving_model_flops_per_token",
+    # 2D fsdp x tp mesh, train-to-serve (round-21; BENCH_SPMD_r21.json)
+    "train_fsdp_degree",
+    "serving_mesh_shape",
+    "spmd_allgather_bytes_total",
 })
 
 # ---------------------------------------------------------------------------
@@ -154,6 +158,11 @@ LABEL_DOMAINS = {
     # capacity-plane advisory actions (round 20)
     "action": frozenset({"scale_up", "scale_down", "rebalance",
                          "steady"}),
+    # 2D mesh axes (round 21): serving_mesh_shape{axis}
+    "axis": frozenset({"fsdp", "tp", "dp"}),
+    # spmd param all-gather sites (round 21):
+    # spmd_allgather_bytes_total{site}
+    "site": frozenset({"train_params", "serving_params"}),
     "engine": DYNAMIC,              # engine ids: bounded by pool size
     "metric": DYNAMIC,              # bench line names: bounded by the
                                     # bench's own mode set
