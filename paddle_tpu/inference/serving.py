@@ -332,6 +332,19 @@ class ContinuousBatchingEngine:
         self.tp_degree = self.tp.degree if self.tp is not None else 1
         self.fsdp_degree = self.tp.fsdp_degree \
             if self.tp is not None else 1
+        self.cp_degree = self.tp.cp_degree if self.tp is not None else 1
+        # ---- context-parallel serving (round 22) --------------------
+        # a 'cp' mesh axis stripes every pool's slot dim: validated
+        # HERE with actionable messages (block_size divisibility, no
+        # int8 pools, no legacy dense prefill, no spec-decode), never
+        # as a shard_map shape failure deep in tracing
+        if self.cp_degree > 1:
+            from ..jit.spmd import validate_cp_serving
+            validate_cp_serving(
+                self.cp_degree, block_size,
+                quantized_kv=(kv_dtype == "int8"),
+                dense_prefill=(not mixed_step and not prefill_buckets),
+                spec_decode=draft_model is not None)
         if quant_collectives and self.tp is None:
             raise ValueError(
                 "quant_collectives=True but the mesh's tp axis "
@@ -704,6 +717,22 @@ class ContinuousBatchingEngine:
         self._m_mesh_shape.labels(axis="tp").set(self.tp_degree)
         self._m_mesh_shape.labels(axis="dp").set(
             int(mesh_sizes.get("dp", 1)))
+        self._m_mesh_shape.labels(axis="cp").set(self.cp_degree)
+        # context-parallel serving (round 22): pool-stripe degree and
+        # the stripe-merge collective payload
+        self._m_cp_degree = r.gauge(
+            "serving_cp_degree",
+            "context-parallel degree of the most recently constructed "
+            "engine (cp stripes every KV pool's slot dim — per-chip "
+            "pool HBM is 1/cp; 1 = pools not striped)")
+        self._m_cp_degree.set(self.cp_degree)
+        self._m_cp_collective = r.counter(
+            "serving_cp_collective_bytes_total",
+            "per-chip bytes received by the cross-chip online-softmax "
+            "stripe merge (one all_gather of the (o, m, l) partial "
+            "rows per layer per sharded dispatch)", labels=("op",))
+        self._m_cp_all_gather = \
+            self._m_cp_collective.labels(op="all_gather")
         self._m_fsdp_gather = r.counter(
             "spmd_allgather_bytes_total",
             "per-chip bytes received by spmd param all-gathers, by "
@@ -2053,6 +2082,8 @@ class ContinuousBatchingEngine:
             self._m_tp_all_gather.inc(by_op["all_gather"])
             if self.quant_collectives:
                 self._m_quant_all_gather.inc(by_op["all_gather"])
+        if by_op.get("cp_merge"):
+            self._m_cp_all_gather.inc(by_op["cp_merge"])
         if self._fsdp_gather_bytes:
             self._m_fsdp_gather.inc(self._fsdp_gather_bytes)
 

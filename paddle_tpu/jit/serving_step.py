@@ -150,6 +150,27 @@ def _tp_logits(logits: Tensor, tp: Optional[TPContext],
     return Tensor._from_value(tp_gather_logits(logits._value, tp.axis))
 
 
+def _cp_local_dest(dest_blocks, dest_offsets, bsl, cp_axis, sink):
+    """Translate GLOBAL per-token write destinations into this chip's
+    slot stripe (round 22, traced inside the shard_map body).
+
+    Under cp the pool's block_size dim is striped: chip ``r`` holds
+    slots ``[r*bsl, (r+1)*bsl)`` of every page, where ``bsl`` is the
+    LOCAL shard's slot count (``block_size/cp``).  A token whose global
+    in-page offset falls in this chip's stripe writes at the local
+    offset; every other chip routes that token to its OWN sink-page
+    stripe (the same garbage-absorbing page padding already uses), so
+    one scatter per chip writes each K/V row exactly once pool-wide.
+    """
+    r = jax.lax.axis_index(cp_axis)
+    lo = dest_offsets - r * bsl
+    owned = (lo >= 0) & (lo < bsl)
+    n = dest_offsets.shape[0]
+    blk = jnp.where(owned, dest_blocks, jnp.int32(sink))
+    off = jnp.where(owned, lo, jnp.arange(n, dtype=jnp.int32) % bsl)
+    return blk, off
+
+
 def _samp_knobs(samp):
     """Decode a packed per-row sampling operand ``[..., 4]`` int32 into
     ``(temps f32, top_ks i32, top_ps f32, seeds i32)``.  Temperature
@@ -687,6 +708,12 @@ class PrefillStep:
         quant_kv = self._quant_kv
         q8_gather = self._q8_gather
         pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        cp_axis = tp.cp_axis if tp is not None else None
+        cp_deg = tp.cp_degree if tp is not None else 1
+        if cp_deg > 1:
+            from ..ops.online_softmax import cross_chip_merge
+            from ..ops.paged_attention import (
+                chunk_prefill_attention_partial, write_ragged_kv)
 
         sampling = self.sampling
         if sampling:
@@ -724,14 +751,44 @@ class PrefillStep:
                         new_vss.append(vs)
                     else:
                         ks = vs = None
-                        kc, vc = write_chunk_kv(
-                            kv_[None], v._value, kc, vc, bt, start,
-                            n_valid, sink)
+                        if cp_deg > 1:
+                            # chunked prefill writes ONLY the owning
+                            # stripe (sequence-parallel scatter): the
+                            # global destination mirrors write_chunk_kv
+                            # at the GLOBAL block size, then the
+                            # stripe-local translation routes non-owned
+                            # rows to this chip's sink stripe
+                            bsl = kc.shape[1]
+                            gbs = bsl * cp_deg
+                            idx_c = jnp.arange(C, dtype=jnp.int32)
+                            pos_c = start.astype(jnp.int32) + idx_c
+                            blk_g = bt[0, pos_c // gbs]
+                            valid = idx_c < n_valid
+                            blk_g = jnp.where(valid, blk_g,
+                                              jnp.int32(sink))
+                            goff = jnp.where(valid, pos_c % gbs, 0)
+                            blk, off = _cp_local_dest(
+                                blk_g, goff, bsl, cp_axis, sink)
+                            kc, vc = write_ragged_kv(
+                                kv_, v._value[0], kc, vc, blk, off)
+                        else:
+                            kc, vc = write_chunk_kv(
+                                kv_[None], v._value, kc, vc, bt, start,
+                                n_valid, sink)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
-                    out = chunk_prefill_attention(
-                        qv[None], kc, vc, bt, start, scale,
-                        key_scale=ks, value_scale=vs)
+                    if cp_deg > 1:
+                        bsl = kc.shape[1]
+                        stripe = jax.lax.axis_index(cp_axis) * bsl
+                        o_p, m_p, l_p = chunk_prefill_attention_partial(
+                            qv[None], kc, vc, bt, start, scale,
+                            stripe, bsl * cp_deg)
+                        out = cross_chip_merge(
+                            o_p[0], m_p[0], l_p[0], cp_axis)[None]
+                    else:
+                        out = chunk_prefill_attention(
+                            qv[None], kc, vc, bt, start, scale,
+                            key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(1, C, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
@@ -923,6 +980,11 @@ class MixedStep:
                 "verifier would mix placements — drop mesh/sharding or "
                 "drop the draft")
         self._quant_kv = bool(getattr(caches[0], "quantized", False))
+        if self._tp is not None and self._tp.cp_degree > 1 \
+                and self._quant_kv:
+            from .spmd import validate_cp_serving
+            validate_cp_serving(self._tp.cp_degree,
+                                caches[0].block_size, quantized_kv=True)
         self._wq = weight_qparams
         self._q8_gather = bool(quant_collectives)
         _ensure_quant_specs(self._tp, weight_qparams)
@@ -967,16 +1029,40 @@ class MixedStep:
         quant_kv = self._quant_kv
         q8_gather = self._q8_gather
         pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        if use_pallas:
+        cp_axis = tp.cp_axis if tp is not None else None
+        cp_deg = tp.cp_degree if tp is not None else 1
+        sink = self.sink
+        if use_pallas and cp_deg <= 1:
             from ..ops.pallas_kernels import _ragged_paged_attention_pallas
 
-        def attn(q, kc, vc, bt, q_off, q_len, kv_len, ks=None, vs=None):
-            if use_pallas:
-                return _ragged_paged_attention_pallas(
+        if cp_deg > 1:
+            # context parallel (round 22): each chip attends over its
+            # LOCAL slot stripe of every page with the partial-softmax
+            # kernel variant, then the `(o, m, l)` triples merge across
+            # the cp axis (ops/online_softmax.cross_chip_merge — one
+            # all_gather of the three small rows).  XLA path only for
+            # now: the per-stripe Pallas launch is the TPU follow-up.
+            from ..ops.online_softmax import cross_chip_merge
+            from ..ops.paged_attention import _ragged_attention_xla_partial
+
+            def attn(q, kc, vc, bt, q_off, q_len, kv_len,
+                     ks=None, vs=None):
+                bsl = kc.shape[1]
+                stripe = jax.lax.axis_index(cp_axis) * bsl
+                o, m, l = _ragged_attention_xla_partial(
                     q, kc, vc, bt, q_off, q_len, kv_len, scale,
-                    span_q=span_q, key_scale=ks, value_scale=vs)
-            return _ragged_attention_xla(q, kc, vc, bt, q_off, q_len,
-                                         kv_len, scale, ks, vs)
+                    stripe, bsl * cp_deg)
+                return cross_chip_merge(o, m, l, cp_axis)
+        else:
+            def attn(q, kc, vc, bt, q_off, q_len, kv_len,
+                     ks=None, vs=None):
+                if use_pallas:
+                    return _ragged_paged_attention_pallas(
+                        q, kc, vc, bt, q_off, q_len, kv_len, scale,
+                        span_q=span_q, key_scale=ks, value_scale=vs)
+                return _ragged_attention_xla(q, kc, vc, bt, q_off,
+                                             q_len, kv_len, scale,
+                                             ks, vs)
 
         W = self.bt_width
         S = self.max_spans
@@ -1005,6 +1091,14 @@ class MixedStep:
             positions = tok_tab[1]
             dest_blocks = tok_tab[2]
             dest_offsets = tok_tab[3]
+            if cp_deg > 1:
+                # the host packs GLOBAL in-page offsets; each chip
+                # keeps only the rows its slot stripe owns (the rest
+                # go to its sink stripe) — the scatter itself and the
+                # packed-operand layout are unchanged
+                dest_blocks, dest_offsets = _cp_local_dest(
+                    dest_blocks, dest_offsets, kcs[0].shape[1],
+                    cp_axis, sink)
             bt = span_tab[:, :W]
             q_offsets = span_tab[:, W]
             q_lens = span_tab[:, W + 1]
@@ -1328,6 +1422,18 @@ class DecodeStep:
         quant_kv = self._quant_kv
         q8_gather = self._q8_gather
         pdtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        cp_axis = tp.cp_axis if tp is not None else None
+        cp_deg = tp.cp_degree if tp is not None else 1
+        if cp_deg > 1:
+            from ..ops.online_softmax import cross_chip_merge
+            from ..ops.paged_attention import (
+                _paged_attention_xla_partial, write_ragged_kv)
+            sink = self.caches[0].sink
+            if sink < 0:
+                raise ValueError(
+                    "context-parallel DecodeStep needs a sink page "
+                    "(PagedKVCache(sink_block=True)) to absorb the "
+                    "stripe writes the chip does not own")
 
         sampling = self.sampling
         if sampling:
@@ -1367,14 +1473,38 @@ class DecodeStep:
                         new_vss.append(vs)
                     else:
                         ks = vs = None
-                        kc, vc = write_decode_kv(
-                            kv_, v._value[:, 0], kc, vc,
-                            block_tables, seq_lens)
+                        if cp_deg > 1:
+                            # global destination (block table at the
+                            # GLOBAL block size), then stripe-local
+                            # translation + the plain ragged scatter
+                            bsl = kc.shape[1]
+                            gbs = bsl * cp_deg
+                            blk_g = jnp.take_along_axis(
+                                block_tables,
+                                (seq_lens // gbs)[:, None],
+                                axis=1)[:, 0]
+                            blk, off = _cp_local_dest(
+                                blk_g, seq_lens % gbs, bsl, cp_axis,
+                                sink)
+                            kc, vc = write_ragged_kv(
+                                kv_, v._value[:, 0], kc, vc, blk, off)
+                        else:
+                            kc, vc = write_decode_kv(
+                                kv_, v._value[:, 0], kc, vc,
+                                block_tables, seq_lens)
                     new_kcs.append(kc)
                     new_vcs.append(vc)
-                    out = attn_fn(qv, kc, vc, block_tables,
-                                  seq_lens + 1, scale,   # incl. new token
-                                  key_scale=ks, value_scale=vs)
+                    if cp_deg > 1:
+                        bsl = kc.shape[1]
+                        stripe = jax.lax.axis_index(cp_axis) * bsl
+                        o_p, m_p, l_p = _paged_attention_xla_partial(
+                            qv, kc, vc, block_tables, seq_lens + 1,
+                            scale, stripe, bsl * cp_deg)
+                        out = cross_chip_merge(o_p, m_p, l_p, cp_axis)
+                    else:
+                        out = attn_fn(qv, kc, vc, block_tables,
+                                      seq_lens + 1, scale,
+                                      key_scale=ks, value_scale=vs)
                     out = Tensor._from_value(out.reshape(S, 1, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)

@@ -101,7 +101,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingConfig", "SpecLayout", "TPContext",
            "resolve_mesh_axis", "llama_param_specs",
-           "validate_tp_serving", "tp_mesh", "mesh_2d",
+           "validate_tp_serving", "validate_cp_serving",
+           "tp_mesh", "mesh_2d", "cp_mesh",
            "tp_serving_context", "tp_embed", "tp_gather_logits",
            "tp_gather_logits_q8", "shard_arrays", "spec_axes",
            "prune_spec_axes", "gather_spec_axes", "fsdp_gather"]
@@ -224,6 +225,26 @@ def mesh_2d(fsdp: int, tp: int, replica: int = 1,
     return ProcessMesh(shape=[fsdp, tp], dim_names=[fsdp_axis, tp_axis])
 
 
+def cp_mesh(cp: int, tp: int = 1, cp_axis: str = "cp",
+            tp_axis: str = "tp"):
+    """The serving ``(cp, tp)`` ProcessMesh over the first ``cp*tp``
+    devices (round 22): ``cp`` stripes the KV pool's slot dimension so
+    per-chip pool HBM is 1/cp, ``tp`` shards heads as before.
+    ``tp=1`` gives the pure context-parallel mesh — weights replicate
+    (no spec names ``cp``), only the pools stripe."""
+    from ..distributed.process_mesh import ProcessMesh
+    need = int(cp) * int(tp)
+    n = jax.device_count()
+    if need > n:
+        raise ValueError(
+            f"cp_mesh(cp={cp}, tp={tp}) needs {need} devices but only "
+            f"{n} are visible; for CPU dryruns call "
+            f"paddle_tpu.testing.dryrun.force_cpu_devices first")
+    if tp > 1:
+        return ProcessMesh(shape=[cp, tp], dim_names=[cp_axis, tp_axis])
+    return ProcessMesh(shape=[cp], dim_names=[cp_axis])
+
+
 # ---------------------------------------------------------------------------
 # canonical per-weight-family specs
 # ---------------------------------------------------------------------------
@@ -236,9 +257,13 @@ class SpecLayout:
     weights but runs single-chip-math bodies after the gather."""
 
     def __init__(self, tp_axis: Optional[str] = "tp",
-                 fsdp_axis: Optional[str] = None):
+                 fsdp_axis: Optional[str] = None,
+                 cp_axis: Optional[str] = None):
         self.tp_axis = tp_axis
         self.fsdp_axis = fsdp_axis
+        # round 22: context-parallel axis — stripes ONLY the KV pool's
+        # slot dim (weights never name it, so they replicate across cp)
+        self.cp_axis = cp_axis
 
     def embeddings(self) -> PartitionSpec:
         """[V, h] vocab-row sharded: masked local lookup + one exact
@@ -288,10 +313,13 @@ class SpecLayout:
         return P(self.fsdp_axis) if self.fsdp_axis else P()
 
     def kv_pool(self) -> PartitionSpec:
-        """[phys_pages, block_size, Hkv, D] sharded over kv heads: each
-        chip's paged-attention launch sees only its head shard of every
-        page — per-chip pool HBM is exactly 1/tp."""
-        return P(None, None, self.tp_axis, None)
+        """[phys_pages, block_size, Hkv, D] sharded over kv heads (tp)
+        and — round 22 — striped over the block_size SLOT dim (cp):
+        each chip holds slots ``[r*bs/cp, (r+1)*bs/cp)`` of EVERY page
+        for its head shard, so per-chip pool HBM is exactly
+        1/(cp*tp).  Slot striping keeps the page table, refcounts, COW
+        and prefix keys chip-local and identical on every chip."""
+        return P(None, self.cp_axis, self.tp_axis, None)
 
     def kv_scale(self) -> PartitionSpec:
         """An int8 pool's [phys_pages, Hkv] absmax tables follow the
@@ -478,6 +506,46 @@ def validate_tp_serving(cfg, degree: int, pool_kv_heads: Optional[int]
             % (degree, ", ".join(problems)))
 
 
+def validate_cp_serving(cp_degree: int, block_size: int,
+                        quantized_kv: bool = False,
+                        dense_prefill: bool = False,
+                        spec_decode: bool = False) -> None:
+    """Every constraint context-parallel serving needs, checked at
+    ENGINE CONSTRUCTION with one actionable message (round 22,
+    mirroring :func:`validate_tp_serving`).  cp stripes the pool's
+    SLOT dim, so the page ``block_size`` must divide by cp; int8 KV,
+    legacy dense prefill and speculative decoding are rejected (their
+    pool/scatter layouts assume one chip holds a page's full slot
+    range)."""
+    if cp_degree <= 1:
+        return
+    if block_size % cp_degree:
+        raise ValueError(
+            f"context-parallel serving with cp={cp_degree} requires the "
+            f"KV page block_size to divide by cp (each chip owns "
+            f"block_size/cp slots of every page); got "
+            f"block_size={block_size}.  Pick a block_size that divides "
+            f"by cp, or lower cp.")
+    if quantized_kv:
+        raise ValueError(
+            f"context-parallel serving (cp={cp_degree}) does not "
+            f"support the int8 KV pool: the [phys_pages, Hkv] absmax "
+            f"tables are page-global and would diverge across slot "
+            f"stripes.  Serve with kv_dtype=None (fp32 pool) under cp.")
+    if dense_prefill:
+        raise ValueError(
+            f"context-parallel serving (cp={cp_degree}) requires the "
+            f"chunked/ragged prefill path; the legacy dense prefill "
+            f"writes whole pages per chip and cannot stripe.  Construct "
+            f"the engine with prefill_chunk_size set (paged prefill).")
+    if spec_decode:
+        raise ValueError(
+            f"context-parallel serving (cp={cp_degree}) does not "
+            f"support speculative decoding yet: the draft/verify steps "
+            f"bypass the striped scatter.  Disable spec-decode under "
+            f"cp.")
+
+
 class TPContext:
     """Resolved tensor-parallel serving context, shared by every
     serving step of one engine: the jax mesh, the axis name/degree, the
@@ -487,12 +555,15 @@ class TPContext:
 
     def __init__(self, mesh: Mesh, axis: Optional[str], degree: int,
                  layout: SpecLayout, specs: Dict[str, PartitionSpec],
-                 fsdp_axis: Optional[str] = None, fsdp_degree: int = 1):
+                 fsdp_axis: Optional[str] = None, fsdp_degree: int = 1,
+                 cp_axis: Optional[str] = None, cp_degree: int = 1):
         self.mesh = mesh
         self.axis = axis                  # tp axis (None: pure fsdp)
         self.degree = degree              # tp degree (compute shard)
         self.fsdp_axis = fsdp_axis if fsdp_degree > 1 else None
         self.fsdp_degree = fsdp_degree if fsdp_degree > 1 else 1
+        self.cp_axis = cp_axis if cp_degree > 1 else None
+        self.cp_degree = cp_degree if cp_degree > 1 else 1
         self.layout = layout
         self.specs = specs
         self._placed: Optional[Dict[str, jnp.ndarray]] = None
@@ -575,18 +646,33 @@ class TPContext:
         all-gather (``tp_gather_logits_q8``): one byte per logit plus
         the 4-byte per-shard scale — the payload the quantized
         collective actually moves (reported under
-        ``serving_quant_collective_bytes_total`` too)."""
+        ``serving_quant_collective_bytes_total`` too).
+
+        With a cp axis (round 22) the attention stripe merge adds one
+        ``all_gather`` of the ``(o, m, l)`` fp32 partial rows per layer
+        — per chip ``L · n_tokens · H_local · (D + 2) · 4`` payload
+        bytes received from each of the other ``cp - 1`` members —
+        reported under the separate ``"cp_merge"`` key (routed to
+        ``serving_cp_collective_bytes_total{op="all_gather"}``)."""
         if self.degree <= 1:
-            # pure-fsdp serving: the body runs single-chip math after
-            # the param gather, so there are no activation collectives
-            return {"psum": 0, "all_gather": 0}
-        item = 2 if cfg.dtype == "bfloat16" else 4
-        shard = n_gather_rows * (cfg.vocab_size // self.degree)
-        return {
-            "psum": (2 * cfg.num_hidden_layers + 1) * n_tokens
-            * cfg.hidden_size * item,
-            "all_gather": shard + 4 if quant_gather else shard * item,
-        }
+            # pure-fsdp / pure-cp serving: the body runs single-chip
+            # math (no tp activation collectives)
+            out = {"psum": 0, "all_gather": 0}
+        else:
+            item = 2 if cfg.dtype == "bfloat16" else 4
+            shard = n_gather_rows * (cfg.vocab_size // self.degree)
+            out = {
+                "psum": (2 * cfg.num_hidden_layers + 1) * n_tokens
+                * cfg.hidden_size * item,
+                "all_gather": shard + 4 if quant_gather else shard * item,
+            }
+        if self.cp_degree > 1:
+            h_local = cfg.num_attention_heads // self.degree
+            d = cfg.hidden_size // cfg.num_attention_heads
+            out["cp_merge"] = (cfg.num_hidden_layers * n_tokens
+                               * h_local * (d + 2) * 4
+                               * (self.cp_degree - 1))
+        return out
 
     def pool_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.layout.kv_pool())
@@ -605,6 +691,7 @@ class TPContext:
         return (f"TPContext(axis={self.axis!r}, degree={self.degree}, "
                 f"fsdp_axis={self.fsdp_axis!r}, "
                 f"fsdp_degree={self.fsdp_degree}, "
+                f"cp_axis={self.cp_axis!r}, cp_degree={self.cp_degree}, "
                 f"mesh={tuple(self.mesh.shape.items())})")
 
 
@@ -623,27 +710,35 @@ def tp_serving_context(model, mesh, sharding: Optional[ShardingConfig]
     fsdp_axis = "fsdp" if jmesh is not None \
         and "fsdp" in jmesh.axis_names else None
     fsdp_deg = jmesh.shape["fsdp"] if fsdp_axis else 1
+    cp_axis = "cp" if jmesh is not None \
+        and "cp" in jmesh.axis_names else None
+    cp_deg = jmesh.shape["cp"] if cp_axis else 1
     try:
         jmesh, axis, deg = resolve_mesh_axis(
             mesh, cfg.axis, cfg.degree, candidates=("tp", "model", "mp"))
     except ValueError:
         # no tp axis at all — a pure-fsdp (or fsdp×dp) mesh is still a
-        # sharded-storage serving context; anything else re-raises
-        if fsdp_deg <= 1:
+        # sharded-storage serving context, and a pure-cp mesh (round
+        # 22) a pool-striped one (size-1 axes degenerate below);
+        # anything else re-raises
+        if fsdp_axis is None and cp_axis is None:
             raise
         axis, deg = None, 1
-    if deg <= 1 and fsdp_deg <= 1:
+    if deg <= 1 and fsdp_deg <= 1 and cp_deg <= 1:
         return None
     if deg > 1:
         validate_tp_serving(model.config, deg)
     layout = SpecLayout(tp_axis=axis if deg > 1 else None,
-                        fsdp_axis=fsdp_axis if fsdp_deg > 1 else None)
+                        fsdp_axis=fsdp_axis if fsdp_deg > 1 else None,
+                        cp_axis=cp_axis if cp_deg > 1 else None)
     sd = model.state_dict()
     shapes = {k: tuple(t._value.shape) for k, t in sd.items()}
     specs = llama_param_specs(sd.keys(), layout, shapes=shapes,
                               mesh=jmesh)
     return TPContext(jmesh, axis if deg > 1 else None, deg, layout,
-                     specs, fsdp_axis=fsdp_axis, fsdp_degree=fsdp_deg)
+                     specs, fsdp_axis=fsdp_axis, fsdp_degree=fsdp_deg,
+                     cp_axis=cp_axis if cp_deg > 1 else None,
+                     cp_degree=cp_deg)
 
 
 # ---------------------------------------------------------------------------

@@ -36,11 +36,13 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 from ..core.tensor import Tensor
+from .online_softmax import merge_partials, online_softmax_update
 
 __all__ = ["PagedKVCache", "KVPageBuffer",
            "paged_attention", "write_kv_to_cache",
            "write_decode_kv", "write_prefill_kv", "write_chunk_kv",
            "write_ragged_kv", "chunk_prefill_attention",
+           "chunk_prefill_attention_partial",
            "ragged_paged_attention",
            "write_decode_kv_q8", "write_chunk_kv_q8",
            "write_ragged_kv_q8", "dequant_pages",
@@ -699,6 +701,133 @@ def _ragged_attention_xla(q, key_cache, value_cache, block_tables,
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# context-parallel (round 22) per-stripe partials: each chip's pool shard
+# holds slot sub-range [r*bsl, (r+1)*bsl) of EVERY page (the
+# P(None, cp, tp, None) dim-1 striping), so the local flattened kv index
+# j maps to GLOBAL position (j // bsl)*block_size + stripe_offset +
+# (j % bsl).  These variants run the same gather + fp32 masked softmax
+# as their full counterparts but over the local stripe only, returning
+# the NORMALIZED (o, m, l) rows the cross-chip merge
+# (ops/online_softmax.merge_partials) combines exactly.  XLA-only for
+# now: CPU dryruns and the parity/bench gates use these; a per-stripe
+# (m, l)-emitting Pallas variant is the TPU follow-up.  int8 pools are
+# rejected under cp at engine construction (per-chip absmax scales over
+# a replicated [phys, Hkv] table would diverge), so no scale operands.
+# ---------------------------------------------------------------------------
+def _stripe_cols(n_pages, bsl, stripe_offset, global_block_size):
+    """Global kv position of each local flattened stripe index."""
+    j = jnp.arange(n_pages * bsl, dtype=jnp.int32)
+    return ((j // bsl) * jnp.int32(global_block_size)
+            + stripe_offset.astype(jnp.int32) + (j % bsl))
+
+
+def _partial_softmax_rows(s, valid, v, contract):
+    """Masked partial softmax over the last score axis: returns the
+    normalized output plus the (m, l) merge rows; an all-masked row
+    yields (o=0, m=-inf, l=0) — the exact empty-stripe identity
+    ``merge_partials`` drops."""
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, np.float32(0.0))
+    p = jnp.where(valid, jnp.exp(s - m_safe[..., None]), np.float32(0.0))
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(contract, p, v)
+    return o / jnp.maximum(l, np.float32(1e-30))[..., None], m, l
+
+
+def _ragged_attention_xla_partial(q, key_cache, value_cache,
+                                  block_tables, q_offsets, q_lens,
+                                  kv_lens, scale, stripe_offset,
+                                  global_block_size):
+    """Per-stripe ragged attention partial (cp shard of
+    ``_ragged_attention_xla``): q [T, H, D] against the LOCAL pool
+    stripe [phys, bsl, Hkv, D]; returns fp32 ``(o [T,H,D], m [T,H],
+    l [T,H])`` for the cross-chip merge."""
+    T, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bsl = key_cache.shape[1]
+    W = block_tables.shape[1]
+    tok = jnp.arange(T, dtype=jnp.int32)
+    sid = jnp.clip(
+        jnp.searchsorted(q_offsets.astype(jnp.int32), tok, side="right")
+        - 1, 0, q_offsets.shape[0] - 1).astype(jnp.int32)
+    qpos = (kv_lens[sid] - q_lens[sid] + (tok - q_offsets[sid]))
+    qpos = jnp.maximum(qpos, 0)
+    bt = jnp.maximum(block_tables, 0)[sid]               # [T, W]
+    k = key_cache[bt].reshape(T, W * bsl, Hkv, D)
+    v = value_cache[bt].reshape(T, W * bsl, Hkv, D)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("thd,tlhd->thl",
+                   q.astype(jnp.float32) * jnp.float32(scale),
+                   k.astype(jnp.float32))
+    gcol = _stripe_cols(W, bsl, stripe_offset, global_block_size)
+    valid = gcol[None, None, :] <= qpos[:, None, None]
+    s = jnp.where(valid, s, -jnp.inf)
+    return _partial_softmax_rows(s, valid, v.astype(jnp.float32),
+                                 "thl,tlhd->thd")
+
+
+def _paged_attention_xla_partial(q, key_cache, value_cache,
+                                 block_tables, seq_lens, scale,
+                                 stripe_offset, global_block_size):
+    """Per-stripe decode attention partial (cp shard of
+    ``_paged_attention_xla``): q [B, H, D]; returns fp32
+    ``(o [B,H,D], m [B,H], l [B,H])``."""
+    B, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bsl = key_cache.shape[1]
+    W = block_tables.shape[1]
+    bt = jnp.maximum(jnp.asarray(block_tables, jnp.int32), 0)
+    k = key_cache[bt].reshape(B, W * bsl, Hkv, D)
+    v = value_cache[bt].reshape(B, W * bsl, Hkv, D)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,blhd->bhl",
+                   q.astype(jnp.float32) * jnp.float32(scale),
+                   k.astype(jnp.float32))
+    gcol = _stripe_cols(W, bsl, stripe_offset, global_block_size)
+    valid = gcol[None, None, :] < seq_lens[:, None, None]
+    s = jnp.where(valid, s, -jnp.inf)
+    return _partial_softmax_rows(s, valid, v.astype(jnp.float32),
+                                 "bhl,blhd->bhd")
+
+
+def chunk_prefill_attention_partial(q, key_cache, value_cache,
+                                    block_table_row, start, scale,
+                                    stripe_offset, global_block_size):
+    """Per-stripe chunked-prefill attention partial (cp shard of
+    ``chunk_prefill_attention``): q [1, C, H, D] at global positions
+    start..start+C-1; returns fp32 ``(o [1,C,H,D], m [1,C,H],
+    l [1,C,H])``.  The causal ``gcol <= qpos`` mask also covers
+    never-written pages (their global columns exceed every query
+    position), so the r10 poison-page invariant survives the gather."""
+    B, C, H, D = q.shape
+    Hkv = key_cache.shape[2]
+    bsl = key_cache.shape[1]
+    W = int(block_table_row.shape[1])
+    qf = q[0].astype(jnp.float32) * jnp.float32(scale)   # [C, H, D]
+    qpos = start.astype(jnp.int32) + jnp.arange(C, dtype=jnp.int32)
+    bt = jnp.maximum(block_table_row[0].astype(jnp.int32), 0)   # [W]
+    k = key_cache[bt].reshape(W * bsl, Hkv, D)
+    v = value_cache[bt].reshape(W * bsl, Hkv, D)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("qhd,khd->qhk", qf, k.astype(jnp.float32))
+    gcol = _stripe_cols(W, bsl, stripe_offset, global_block_size)
+    valid = gcol[None, None, :] <= qpos[:, None, None]
+    s = jnp.where(valid, s, -jnp.inf)
+    o, m, l = _partial_softmax_rows(s, valid, v.astype(jnp.float32),
+                                    "qhk,khd->qhd")
+    return o[None], m[None], l[None]
+
+
 def ragged_paged_attention(q, key_cache, value_cache, block_tables,
                            q_offsets, q_lens, kv_lens,
                            use_pallas: Optional[bool] = None,
@@ -864,7 +993,6 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
         jnp.int32(pages_per_seq))
 
     def page_math(p_idx, page, kbuf, vbuf, carry):
-        m, l, acc = carry
         if quantized:
             sk = jax.lax.bitcast_convert_type(ks_bits_ref[h, page],
                                               jnp.float32)
@@ -882,28 +1010,25 @@ def _paged_decode_kernel(# scalar prefetch (+2 bitcast scale tables
             s = q @ k.T                                # [groups, bs]
         base = p_idx * jnp.int32(block_size)
         cols = base + jax.lax.broadcasted_iota(jnp.int32, (g, block_size), 1)
-        s = jnp.where(cols < seq_len, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        p = jnp.where(cols < seq_len, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        if int8_mxu:
-            # p·V as int8×int8 as well: per-row p scales + the page's
-            # v scale fold into the [groups, D] product, so the page
-            # never materializes in fp32
-            p_codes, p_s = quantize_rows_symmetric(p)
-            pvi = jax.lax.dot_general(
-                p_codes, vbuf, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            pv = fold_int8_scores(pvi, p_s, sv)
-        else:
+        ok = cols < seq_len
+        s = jnp.where(ok, s, -jnp.inf)
+
+        def pv_of_p(p):
+            if int8_mxu:
+                # p·V as int8×int8 as well: per-row p scales + the
+                # page's v scale fold into the [groups, D] product, so
+                # the page never materializes in fp32
+                p_codes, p_s = quantize_rows_symmetric(p)
+                pvi = jax.lax.dot_general(
+                    p_codes, vbuf, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return fold_int8_scores(pvi, p_s, sv)
             v = vbuf.astype(jnp.float32)
             if quantized:
                 v = v * (sv / np.float32(_KV_BNT))
-            pv = p @ v
-        acc_new = acc * alpha + pv
-        return m_new, l_new, acc_new
+            return p @ v
+
+        return online_softmax_update(carry, s, ok, pv_of_p)
 
     if pipelined:
         def start_page(p_idx, slot):

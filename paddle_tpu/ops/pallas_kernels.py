@@ -38,6 +38,7 @@ from ..core.dispatch import apply_op
 from ..core.jax_compat import axis_size as _axis_size, shard_map_compat
 from ..core.tensor import Tensor
 from ._helpers import targ
+from .online_softmax import online_softmax_update
 
 
 def _on_tpu() -> bool:
@@ -1551,7 +1552,6 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
             """Online-softmax update for one resident page (shared by
             the pipelined and legacy loops; kbuf/vbuf are the page's
             VMEM values, int8 when quantized)."""
-            m, l, acc = carry
             if quantized:
                 sk = lax.bitcast_convert_type(ks_bits_ref[h, page],
                                               jnp.float32)
@@ -1572,28 +1572,27 @@ def _ragged_paged_kernel(# scalar prefetch (+2 bitcast scale tables
                 jnp.int32, (g, block_size), 1)
             ok = (cols <= qpos) & (cols < kv_len)
             sc = jnp.where(ok, sc, _F32_NEG_INF)
-            m_new = jnp.maximum(m, jnp.max(sc, axis=1, keepdims=True))
-            p = jnp.where(ok, jnp.exp(sc - m_new), _F32_0)
-            alpha = jnp.exp(m - m_new)
-            l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-            if int8_mxu:
-                # p·V runs int8×int8 too: the probability rows are
-                # quantized per row (max p per row is the scale) and
-                # the p/v scales fold into the [g, d] product — the
-                # page NEVER materializes in fp32 (measured ≤1% of
-                # value magnitude vs the declared 2% tolerance)
-                p_codes, p_s = quantize_rows_symmetric(p)
-                pvi = lax.dot_general(p_codes, vbuf, _DIMNUM_NN,
-                                      preferred_element_type=jnp.int32)
-                pv = fold_int8_scores(pvi, p_s, sv)
-            else:
+
+            def pv_of_p(p):
+                if int8_mxu:
+                    # p·V runs int8×int8 too: the probability rows are
+                    # quantized per row (max p per row is the scale)
+                    # and the p/v scales fold into the [g, d] product —
+                    # the page NEVER materializes in fp32 (measured
+                    # ≤1% of value magnitude vs the declared 2%
+                    # tolerance)
+                    p_codes, p_s = quantize_rows_symmetric(p)
+                    pvi = lax.dot_general(
+                        p_codes, vbuf, _DIMNUM_NN,
+                        preferred_element_type=jnp.int32)
+                    return fold_int8_scores(pvi, p_s, sv)
                 v = vbuf.astype(jnp.float32)
                 if quantized:
                     v = v * (sv / np.float32(127.0))
-                pv = lax.dot_general(p, v, _DIMNUM_NN,
-                                     preferred_element_type=jnp.float32)
-            acc_new = acc * alpha + pv
-            return m_new, l_new, acc_new
+                return lax.dot_general(p, v, _DIMNUM_NN,
+                                       preferred_element_type=jnp.float32)
+
+            return online_softmax_update(carry, sc, ok, pv_of_p)
 
         if pipelined:
             def start_page(p_idx, slot):

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["force_cpu_devices", "cpu_mesh_2d"]
+__all__ = ["force_cpu_devices", "cpu_mesh_2d", "cpu_mesh_cp"]
 
 
 def force_cpu_devices(n_devices: int = 8) -> None:
@@ -102,3 +102,14 @@ def cpu_mesh_2d(fsdp: int, tp: int, replica: int = 1):
     force_cpu_devices(max(replica * fsdp * tp, 1))
     from ..jit.spmd import mesh_2d
     return mesh_2d(fsdp, tp, replica=replica)
+
+
+def cpu_mesh_cp(cp: int, tp: int = 1):
+    """Context-parallel dryrun mesh (round 22): force enough virtual
+    CPU devices for a ``cp`` (optionally ``cp x tp``) mesh and return
+    the :func:`paddle_tpu.jit.spmd.cp_mesh` ProcessMesh over them —
+    the one-liner behind the cp tests and ``tools/bench_serving.py
+    --cp``."""
+    force_cpu_devices(max(cp * tp, 1))
+    from ..jit.spmd import cp_mesh
+    return cp_mesh(cp, tp=tp)

@@ -302,6 +302,26 @@ def _build_artifacts_seeded() -> Dict[str, Artifact]:
         n_pool=2 * L, psig=pool_sig_2d, expect_i32=1,
         packed_len=packed_len, min_aliases=2 * L)
 
+    # round 22: the same contracts under a cp=2 context-parallel mesh
+    # — the pools enter SLOT-striped (block_size/cp per chip), the
+    # stripe-merge all_gather must not break donation aliasing, and
+    # the packed int32 operand stays the ONE host transfer (the
+    # stripe-local destination translation is traced math, not a new
+    # operand)
+    from paddle_tpu.jit.spmd import cp_mesh
+    MESH_CP = 2
+    meshcp = cp_mesh(MESH_CP)
+    mixedcp = MixedStep(model, caches(), bt_width=BT_WIDTH,
+                        max_spans=MAX_SPANS, span_q=SPAN_Q,
+                        use_pallas=False, mesh=meshcp)
+    cp_shard_shape = list(probe.shape)
+    cp_shard_shape[1] //= MESH_CP
+    pool_sig_cp = "f32[" + ",".join(str(d) for d in cp_shard_shape) \
+        + "]"
+    art(f"mixed_step_cp@T{MIXED_T}", mixedcp.aot_lower(MIXED_T),
+        n_pool=2 * L, psig=pool_sig_cp, expect_i32=1,
+        packed_len=packed_len, min_aliases=2 * L)
+
     model2d = LlamaForCausalLM(cfg)
     opt2d = paddle.optimizer.SGD(0.1,
                                  parameters=model2d.parameters())

@@ -129,6 +129,9 @@ REQUIRED_NAMES = frozenset({
     "train_fsdp_degree",
     "serving_mesh_shape",
     "spmd_allgather_bytes_total",
+    # context-parallel serving (round-22; BENCH_CP_r22.json)
+    "serving_cp_degree",
+    "serving_cp_collective_bytes_total",
 })
 
 # ---------------------------------------------------------------------------
@@ -158,8 +161,8 @@ LABEL_DOMAINS = {
     # capacity-plane advisory actions (round 20)
     "action": frozenset({"scale_up", "scale_down", "rebalance",
                          "steady"}),
-    # 2D mesh axes (round 21): serving_mesh_shape{axis}
-    "axis": frozenset({"fsdp", "tp", "dp"}),
+    # mesh axes (round 21, + cp round 22): serving_mesh_shape{axis}
+    "axis": frozenset({"fsdp", "tp", "dp", "cp"}),
     # spmd param all-gather sites (round 21):
     # spmd_allgather_bytes_total{site}
     "site": frozenset({"train_params", "serving_params"}),
