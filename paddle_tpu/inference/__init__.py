@@ -191,10 +191,13 @@ from .serving import (ContinuousBatchingEngine,      # noqa: E402,F401
                       GenerationRequest)
 from .router import (ServingRouter, EngineHandle,    # noqa: E402,F401
                      RouterRequest, RouterQueueFull)
+from .fleet import (RemoteEngineClient, EngineServer,  # noqa: E402,F401
+                    EngineProcess, EngineRPCError, RetryPolicy)
 
 __all__ += ["ContinuousBatchingEngine", "GenerationRequest",
             "ServingRouter", "EngineHandle", "RouterRequest",
-            "RouterQueueFull"]
+            "RouterQueueFull", "RemoteEngineClient", "EngineServer",
+            "EngineProcess", "EngineRPCError", "RetryPolicy"]
 
 
 # ---------------------------------------------------------------------------

@@ -124,6 +124,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .fleet import EngineRPCError, RetryPolicy
 from .prefix_cache import _prefix_key
 
 __all__ = ["ServingRouter", "EngineHandle", "RouterRequest",
@@ -277,7 +278,8 @@ class EngineHandle:
     def __init__(self, engine, engine_id: Optional[int] = None,
                  health_url: Optional[str] = None,
                  probe: Optional[Callable[["EngineHandle"], bool]] = None,
-                 probe_timeout: float = 1.0):
+                 probe_timeout: float = 1.0,
+                 retry: Optional[RetryPolicy] = None):
         self.engine = engine
         if engine_id is None:
             engine_id = getattr(engine, "engine_id", None)
@@ -292,6 +294,13 @@ class EngineHandle:
         # engine that misses it just accrues probe_failures and drains
         # — requests resume elsewhere, nothing is lost)
         self.probe_timeout = float(probe_timeout)
+        # /healthz scraping shares the fleet RPC layer's capped-
+        # backoff-with-jitter policy: one slow/lost scrape retries
+        # inside the probe instead of burning a probe-failure count.
+        # The whole retried scrape stays bounded (attempts x timeout
+        # + backoff), so a dead endpoint still fails the probe fast.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay=0.02, max_delay=0.25)
         self.healthy = True
         self.probe_failures = 0
         self.routed_keys: "OrderedDict[bytes, None]" = OrderedDict()
@@ -304,9 +313,14 @@ class EngineHandle:
         """Fresh health/load stats: scraped from ``health_url``'s
         ``/healthz`` JSON body when remote, else read in-process."""
         if self.health_url:
-            with urllib.request.urlopen(
-                    self.health_url, timeout=self.probe_timeout) as resp:
-                return _json.loads(resp.read().decode("utf-8"))
+            def _scrape():
+                with urllib.request.urlopen(
+                        self.health_url,
+                        timeout=self.probe_timeout) as resp:
+                    return _json.loads(resp.read().decode("utf-8"))
+            # urllib.error.URLError is an OSError: the default
+            # retry_on covers timeouts, refused and reset connections
+            return self.retry.run(_scrape)
         return self.engine.health_payload()
 
     def refresh(self) -> Dict:
@@ -597,6 +611,15 @@ class ServingRouter:
         have one go missing."""
         self._probe_all()
         self._dispatch_pending()
+        # remote-engine fan-out: fire every step RPC BEFORE collecting
+        # any reply, so N server processes genuinely step concurrently
+        # (begin_step is an opportunistic send — failures surface in
+        # the per-handle step()/finish below and take the engine-lost
+        # path there)
+        for h in self.handles.values():
+            begin = getattr(h.engine, "begin_step", None)
+            if begin is not None and h.healthy and h.engine.has_work():
+                begin()
         for h in list(self.handles.values()):
             if not h.healthy:
                 continue
@@ -963,6 +986,12 @@ class ServingRouter:
                 _prompt, gen = h.engine.preempt_request(
                     vr.engine_req_id)
                 vbuf = None
+        except EngineRPCError:
+            # the victim's engine died under us: drain it (the victim
+            # — and anything else in flight there — requeues off the
+            # router's own record inside _lose_engine)
+            self._lose_engine(h)
+            return False
         except KeyError:
             return False
         self._inflight.pop(key, None)
@@ -1168,6 +1197,11 @@ class ServingRouter:
                                   max_new_tokens=rr.remaining_budget(),
                                   eos_token_id=rr.eos_token_id)
                     injected = True
+                except EngineRPCError:
+                    # dead remote engine: don't burn a second retry
+                    # cycle on the add_request fallback
+                    self._lose_engine(h)
+                    return False
                 except (ValueError, RuntimeError):
                     erid = None     # fall through to re-prefill resume
         if not injected:
@@ -1176,6 +1210,13 @@ class ServingRouter:
                     rr.resume_prompt(),
                     max_new_tokens=rr.remaining_budget(),
                     eos_token_id=rr.eos_token_id)
+            except EngineRPCError:
+                # a remote engine whose RPCs exhausted their retries is
+                # LOST, not "too small" — drain it (requeues anything
+                # in flight there) and try the next candidate; rr is
+                # not in _inflight yet so it stays pending either way
+                self._lose_engine(h)
+                return False
             except ValueError:
                 rr.rejected_engines.add(h.engine_id)
                 return False

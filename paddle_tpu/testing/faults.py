@@ -17,6 +17,11 @@ Spec syntax (';'-separated rules)::
                       no cleanup, no atexit, no finally blocks)
       delay           sleep ``ms`` milliseconds, then continue
       hang            sleep ``ms`` (default 3600000), for watchdog tests
+      drop            raise FaultDrop — the instrumented I/O "happened"
+                      but its bytes vanished (a lost datagram/frame);
+                      the RPC layer swallows it and lets the reply
+                      deadline discover the loss
+      econnreset      raise ConnectionResetError (peer RST mid-stream)
 
     keys:
       after=N         arm on the N-th hit of a matching site (1-based,
@@ -35,6 +40,21 @@ Examples::
 Sites are matched with fnmatch globs, so ``ckpt.*`` covers every
 checkpoint-write instant.  The harness is inert (one dict lookup) when
 no spec is installed.
+
+Network sites (round 23 — the fleet RPC layer, both sides of the
+wire; the injector is process-global, so a client-process spec and a
+server-subprocess spec never collide)::
+
+    rpc.send     just before a frame is written (client request or
+                 server response); ``drop`` makes that frame vanish
+    rpc.recv     a complete frame just arrived (client reply or server
+                 request); ``drop`` discards it unprocessed
+    rpc.accept   a connection was just accepted; ``econnreset`` closes
+                 it before any frame is read
+
+    PADDLE_TPU_FAULT_SPEC="drop:rpc.send:after=2:times=1"   # one lost rpc
+    PADDLE_TPU_FAULT_SPEC="econnreset:rpc.recv"             # flaky peer
+    PADDLE_TPU_FAULT_SPEC="hang:rpc.recv:ms=2000"           # stuck server
 """
 from __future__ import annotations
 
@@ -45,17 +65,25 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["FaultRule", "FaultInjector", "FaultError", "fault_point",
-           "configure", "active_spec", "reset", "ENV_VAR"]
+__all__ = ["FaultRule", "FaultInjector", "FaultError", "FaultDrop",
+           "fault_point", "configure", "active_spec", "reset", "ENV_VAR"]
 
 ENV_VAR = "PADDLE_TPU_FAULT_SPEC"
 
-_MODES = ("ioerror", "kill", "delay", "hang")
+_MODES = ("ioerror", "kill", "delay", "hang", "drop", "econnreset")
 
 
 class FaultError(OSError):
     """The injected I/O failure (an OSError so real retry/backoff code
     handles it like a transient disk error)."""
+
+
+class FaultDrop(Exception):
+    """The instrumented operation "happened" but its bytes vanished —
+    a lost frame/datagram.  Deliberately NOT an OSError: the RPC layer
+    catches it exactly at the fault point and continues silently, so
+    the loss is only discovered by the reply deadline (the realistic
+    packet-loss failure shape, not a synchronous error)."""
 
 
 class FaultRule:
@@ -133,6 +161,12 @@ class FaultInjector:
             if rule.mode == "ioerror":
                 raise FaultError(
                     f"injected I/O error at fault point {site!r}")
+            if rule.mode == "drop":
+                raise FaultDrop(
+                    f"injected byte loss at fault point {site!r}")
+            if rule.mode == "econnreset":
+                raise ConnectionResetError(
+                    f"injected connection reset at fault point {site!r}")
             if rule.mode == "kill":
                 # kill -9 the real process: the point is proving that
                 # NOTHING after this line (flush, rename, finally)

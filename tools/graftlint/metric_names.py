@@ -132,6 +132,11 @@ REQUIRED_NAMES = frozenset({
     # context-parallel serving (round-22; BENCH_CP_r22.json)
     "serving_cp_degree",
     "serving_cp_collective_bytes_total",
+    # multi-process serving fleet (round-23; BENCH_FLEET_r23.json)
+    "router_rpc_requests_total",
+    "router_rpc_retries_total",
+    "router_rpc_latency_seconds",
+    "fleet_engine_process_restarts_total",
 })
 
 # ---------------------------------------------------------------------------
@@ -148,7 +153,15 @@ LABEL_DOMAINS = {
                           "hit", "miss",
                           "attained", "missed", "no_target",
                           # prefix-cache eviction outcomes (round 19)
-                          "reclaimed", "skipped_pinned"}),
+                          "reclaimed", "skipped_pinned",
+                          # fleet RPC outcomes (round 23)
+                          "ok", "error"}),
+    # fleet RPC methods (round 23): the closed wire-protocol verb set
+    # (paddle_tpu.inference.fleet.RPC_METHODS)
+    "method": frozenset({"hello", "add_request", "step",
+                         "preempt_request", "extract_request",
+                         "inject_request", "health_payload",
+                         "ping", "shutdown"}),
     "reason": frozenset({"preempt", "engine_lost", "migrated"}),
     "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
     "op": frozenset({"psum", "all_gather"}),
