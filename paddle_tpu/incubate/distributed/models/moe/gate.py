@@ -14,10 +14,23 @@ from .....core.tensor import Tensor
 from .....core.dispatch import apply_op
 from .....nn.layer_base import Layer
 from .....nn import initializer as I
+from .....ops.moe_gate import topk_gate
+
+
+def _gshard_aux(probs, top_i, num_expert):
+    """mean_prob * fraction_routed per expert (GShard eq.), from the
+    top-1 assignment."""
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top_i[:, 0], num_expert), axis=0)
+    return jnp.sum(me * ce) * num_expert
 
 
 class NaiveGate(Layer):
-    """Top-k softmax gate (reference gate/naive_gate.py)."""
+    """Top-k softmax gate (reference gate/naive_gate.py).
+
+    All gates here route through ``ops.moe_gate.topk_gate`` — the same
+    softmax/top-k used by the Mixtral block and the fused serving
+    dispatch, so the implementations cannot drift apart."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2):
         super().__init__()
@@ -29,11 +42,8 @@ class NaiveGate(Layer):
     def forward(self, x):
         """Returns (combine_weights [N, k], expert_idx [N, k], aux_loss)."""
         def fn(v, w):
-            logits = v @ w
-            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-            top_w, top_i = jax.lax.top_k(probs, self.topk)
-            top_w = top_w / jnp.sum(top_w, -1, keepdims=True)
-            return top_w.astype(v.dtype), top_i.astype(jnp.int32)
+            top_w, top_i, _ = topk_gate(v @ w, self.topk)
+            return top_w.astype(v.dtype), top_i
         w, i = apply_op("naive_gate", fn, (x, self.weight))
         return w, i, None
 
@@ -48,22 +58,18 @@ class GShardGate(NaiveGate):
 
     def forward(self, x):
         def fn(v, w):
-            logits = v @ w
-            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-            top_w, top_i = jax.lax.top_k(probs, self.topk)
-            top_w = top_w / jnp.sum(top_w, -1, keepdims=True)
-            # aux loss: mean_prob * fraction_routed per expert (GShard eq.)
-            me = jnp.mean(probs, axis=0)
-            ce = jnp.mean(
-                jax.nn.one_hot(top_i[:, 0], self.num_expert), axis=0)
-            aux = jnp.sum(me * ce) * self.num_expert
-            return top_w.astype(v.dtype), top_i.astype(jnp.int32), aux
+            top_w, top_i, probs = topk_gate(v @ w, self.topk)
+            aux = _gshard_aux(probs, top_i, self.num_expert)
+            return top_w.astype(v.dtype), top_i, aux
         w, i, aux = apply_op("gshard_gate", fn, (x, self.weight))
         return w, i, aux
 
 
 class SwitchGate(NaiveGate):
-    """Switch (top-1) gate (reference gate/switch_gate.py)."""
+    """Switch (top-1) gate (reference gate/switch_gate.py).
+
+    No renormalization: the combine weight is the raw routing
+    probability of the selected expert."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=1,
                  switch_eps=0.1, capacity=(1.2, 2.4), group=None):
@@ -71,13 +77,8 @@ class SwitchGate(NaiveGate):
 
     def forward(self, x):
         def fn(v, w):
-            logits = v @ w
-            probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-            top_w, top_i = jax.lax.top_k(probs, 1)
-            me = jnp.mean(probs, axis=0)
-            ce = jnp.mean(
-                jax.nn.one_hot(top_i[:, 0], self.num_expert), axis=0)
-            aux = jnp.sum(me * ce) * self.num_expert
-            return top_w.astype(v.dtype), top_i.astype(jnp.int32), aux
+            top_w, top_i, probs = topk_gate(v @ w, 1, renormalize=False)
+            aux = _gshard_aux(probs, top_i, self.num_expert)
+            return top_w.astype(v.dtype), top_i, aux
         w, i, aux = apply_op("switch_gate", fn, (x, self.weight))
         return w, i, aux

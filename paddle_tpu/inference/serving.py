@@ -333,6 +333,7 @@ class ContinuousBatchingEngine:
         self.fsdp_degree = self.tp.fsdp_degree \
             if self.tp is not None else 1
         self.cp_degree = self.tp.cp_degree if self.tp is not None else 1
+        self.ep_degree = self.tp.ep_degree if self.tp is not None else 1
         # ---- context-parallel serving (round 22) --------------------
         # a 'cp' mesh axis stripes every pool's slot dim: validated
         # HERE with actionable messages (block_size divisibility, no
@@ -343,6 +344,19 @@ class ContinuousBatchingEngine:
             validate_cp_serving(
                 self.cp_degree, block_size,
                 quantized_kv=(kv_dtype == "int8"),
+                dense_prefill=(not mixed_step and not prefill_buckets),
+                spec_decode=draft_model is not None)
+        # ---- expert-parallel MoE serving (round 24) -----------------
+        # an 'ep' mesh axis shards the expert banks' E dim: validated
+        # HERE with actionable messages (expert-count divisibility, no
+        # legacy dense prefill, no spec-decode), never as a shard_map
+        # shape failure; the token budgets are re-checked after they
+        # resolve below (every budget must stripe evenly over ep)
+        if self.ep_degree > 1:
+            from ..jit.spmd import validate_ep_serving
+            validate_ep_serving(
+                getattr(model.config, "num_local_experts", 0),
+                self.ep_degree, mixed_step=bool(mixed_step),
                 dense_prefill=(not mixed_step and not prefill_buckets),
                 spec_decode=draft_model is not None)
         if quant_collectives and self.tp is None:
@@ -366,6 +380,13 @@ class ContinuousBatchingEngine:
         self.lazy_alloc = bool(lazy_alloc)
         cfg = model.config
         self.cfg = cfg
+        # MoE dispatch accounting (round 24): every real token in a
+        # mixed pack is routed to top_k experts in each MoE layer —
+        # static per pack, counted host-side next to the collectives
+        self._moe_layers = (cfg.num_hidden_layers
+                            if getattr(cfg, "num_local_experts", 0)
+                            else 0)
+        self._moe_topk = int(getattr(cfg, "num_experts_per_tok", 0))
         self.max_batch_size = max_batch_size
         self.block_size = block_size
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
@@ -469,6 +490,11 @@ class ContinuousBatchingEngine:
                         "(spec_k+1)): an all-decode step would not fit"
                         % (token_budgets, base_spans))
             self.token_budgets = budgets
+            if self.ep_degree > 1:
+                from ..jit.spmd import validate_ep_serving
+                validate_ep_serving(
+                    getattr(cfg, "num_local_experts", 0),
+                    self.ep_degree, budgets=budgets)
             self.mixed = MixedStep(model, self.caches, self.bt_width,
                                    max_spans=max_batch_size,
                                    # a verify span is spec_k+1 tokens —
@@ -718,6 +744,7 @@ class ContinuousBatchingEngine:
         self._m_mesh_shape.labels(axis="dp").set(
             int(mesh_sizes.get("dp", 1)))
         self._m_mesh_shape.labels(axis="cp").set(self.cp_degree)
+        self._m_mesh_shape.labels(axis="ep").set(self.ep_degree)
         # context-parallel serving (round 22): pool-stripe degree and
         # the stripe-merge collective payload
         self._m_cp_degree = r.gauge(
@@ -733,6 +760,35 @@ class ContinuousBatchingEngine:
             "rows per layer per sharded dispatch)", labels=("op",))
         self._m_cp_all_gather = \
             self._m_cp_collective.labels(op="all_gather")
+        # expert-parallel MoE serving (round 24): expert-bank shard
+        # degree and the dispatch/combine payloads of the fused step
+        self._m_ep_degree = r.gauge(
+            "serving_ep_degree",
+            "expert-parallel degree of the most recently constructed "
+            "engine (ep shards every MoE expert bank's E dim — "
+            "per-chip expert HBM is 1/ep; 1 = expert banks replicated)")
+        self._m_ep_degree.set(self.ep_degree)
+        self._m_moe_dispatch = r.counter(
+            "serving_moe_dispatch_tokens_total",
+            "token->expert assignments made by the fused MoE serving "
+            "dispatch (tokens x top_k x MoE layers), by fate — the "
+            "dispatch is DROPLESS (capacity == worst-case load), so "
+            "'dropped' stays 0 by construction and a nonzero value "
+            "means the capacity invariant broke", labels=("fate",))
+        self._m_moe_routed = self._m_moe_dispatch.labels(fate="routed")
+        # resolve the 'dropped' child eagerly so /metrics always shows
+        # the 0 that documents droplessness
+        self._m_moe_dropped = self._m_moe_dispatch.labels(fate="dropped")
+        self._m_ep_collective = r.counter(
+            "serving_ep_collective_bytes_total",
+            "per-chip bytes moved by the expert-parallel dispatch "
+            "(all_to_all = the send/return buffer pair per MoE layer, "
+            "all_gather = re-replicating the combined token stripes)",
+            labels=("op",))
+        self._m_ep_all_to_all = \
+            self._m_ep_collective.labels(op="all_to_all")
+        self._m_ep_all_gather = \
+            self._m_ep_collective.labels(op="all_gather")
         self._m_fsdp_gather = r.counter(
             "spmd_allgather_bytes_total",
             "per-chip bytes received by spmd param all-gathers, by "
@@ -1783,6 +1839,11 @@ class ContinuousBatchingEngine:
             self._m_mixed_tok_decode.inc(n_dec)
         if n_pre:
             self._m_mixed_tok_prefill.inc(n_pre)
+        if self._moe_layers:
+            # dropless dispatch: every real token lands on exactly
+            # top_k experts per MoE layer, none are dropped
+            self._m_moe_routed.inc(total * self._moe_topk
+                                   * self._moe_layers)
         if traced:
             # first trace of this budget: count it, keep the compile
             # warmup out of every latency histogram
@@ -2084,6 +2145,10 @@ class ContinuousBatchingEngine:
                 self._m_quant_all_gather.inc(by_op["all_gather"])
         if by_op.get("cp_merge"):
             self._m_cp_all_gather.inc(by_op["cp_merge"])
+        if by_op.get("ep_all_to_all"):
+            self._m_ep_all_to_all.inc(by_op["ep_all_to_all"])
+        if by_op.get("ep_all_gather"):
+            self._m_ep_all_gather.inc(by_op["ep_all_gather"])
         if self._fsdp_gather_bytes:
             self._m_fsdp_gather.inc(self._fsdp_gather_bytes)
 

@@ -115,6 +115,23 @@ def _resolve_tp(model, mesh, sharding, tp: Optional[TPContext]
     return tp_serving_context(model, mesh, sharding)
 
 
+def _inner_model(model):
+    """The decoder stack behind a CausalLM wrapper — ``model.llama``
+    (dense) or ``model.mixtral`` (MoE, round 24).  Both expose the same
+    ``embed_tokens / layers / norm`` surface, which is everything the
+    traced bodies touch; per-layer FFN dispatch branches on the LAYER
+    (``block_sparse_moe`` vs ``mlp``), not the wrapper."""
+    inner = getattr(model, "llama", None)
+    if inner is None:
+        inner = getattr(model, "mixtral", None)
+    if inner is None:
+        raise ValueError(
+            "serving steps need a LlamaForCausalLM-shaped model (an "
+            "inner .llama or .mixtral decoder stack); got %r"
+            % type(model).__name__)
+    return inner
+
+
 def _embed(llama, tokens, tp: Optional[TPContext]) -> Tensor:
     """Embedding lookup shared by all three traced bodies: the module's
     gather single-chip (and pure-fsdp, whose params are full after the
@@ -134,6 +151,39 @@ def _tp_psum(t: Tensor, tp: Optional[TPContext]) -> Tensor:
     if tp is None or tp.axis is None:
         return t
     return Tensor._from_value(jax.lax.psum(t._value, tp.axis))
+
+
+def _moe_ffn(blk, h2: Tensor, tp: Optional[TPContext]) -> Tensor:
+    """The fused dropless MoE FFN (round 24), traced into the step body
+    in place of ``layer.mlp``: shared top-k gate over the block's
+    tokens, GShard dense dispatch into per-expert buffers sized so no
+    assignment ever drops, grouped expert SwiGLU, weighted combine.
+    Under an ``ep`` mesh axis the dispatch/combine pair crosses the
+    axis as two ``all_to_all`` exchanges plus one token-stripe
+    ``all_gather`` (see ``ops.moe_gate.moe_ffn``).
+
+    No ``_tp_psum`` boundary here: the combine output is the FULL
+    activation (each assignment contributes exactly one expert's
+    output), already replicated across tp — the expert banks never
+    shard over tp."""
+    from ..ops.moe_gate import moe_ffn
+    ep_axis = tp.ep_axis if tp is not None else None
+    ep_deg = tp.ep_degree if tp is not None else 1
+    v = h2._value
+    flat = v.reshape(-1, v.shape[-1])
+    out = moe_ffn(flat, blk.gate.weight._value, blk.w_gate._value,
+                  blk.w_up._value, blk.w_down._value, top_k=blk.top_k,
+                  ep_axis=ep_axis, ep_degree=ep_deg)
+    return Tensor._from_value(out.reshape(v.shape))
+
+
+def _ffn(layer, h2: Tensor, tp: Optional[TPContext]) -> Tensor:
+    """Per-layer FFN dispatch shared by all three traced bodies: the
+    Megatron-sharded dense MLP (+ its psum boundary) for llama layers,
+    the fused MoE path for Mixtral layers."""
+    if hasattr(layer, "block_sparse_moe"):
+        return _moe_ffn(layer.block_sparse_moe, h2, tp)
+    return _tp_psum(layer.mlp(h2), tp)
 
 
 def _tp_logits(logits: Tensor, tp: Optional[TPContext],
@@ -240,10 +290,12 @@ def _ensure_quant_specs(tp: Optional[TPContext], qtree) -> None:
     weight's scale vector must itself split by tp."""
     if tp is None or qtree is None:
         return
-    from .spmd import llama_param_specs
+    from .spmd import llama_param_specs, mixtral_param_specs
     missing = [k for k in qtree if k not in tp.specs]
     if missing:
-        tp.specs.update(llama_param_specs(
+        specs_fn = mixtral_param_specs if any(
+            "block_sparse_moe" in k for k in qtree) else llama_param_specs
+        tp.specs.update(specs_fn(
             missing, tp.layout,
             shapes={k: tuple(qtree[k].shape) for k in missing},
             mesh=tp.mesh))
@@ -697,7 +749,7 @@ class PrefillStep:
                                           rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
-        llama = model.llama
+        llama = _inner_model(model)
         tp = self._tp
         deg = tp.degree if tp is not None else 1
         H = cfg.num_attention_heads // deg      # this chip's head shard
@@ -792,7 +844,7 @@ class PrefillStep:
                     out = Tensor._from_value(out.reshape(1, C, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + _tp_psum(layer.mlp(h2), tp)
+                    x = x + _ffn(layer, h2, tp)
                 x = llama.norm(x)
                 # only the last VALID position reaches the LM head:
                 # [1, 1, h] @ [h, V], never the [C, V] logits block
@@ -1014,7 +1066,7 @@ class MixedStep:
                                           rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
-        llama = model.llama
+        llama = _inner_model(model)
         tp = self._tp
         deg = tp.degree if tp is not None else 1
         # under tensor parallelism the traced body sees this chip's
@@ -1154,7 +1206,7 @@ class MixedStep:
                     out = Tensor._from_value(out.reshape(1, T, H * D))
                     x = x + _tp_psum(at.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + _tp_psum(layer.mlp(h2), tp)
+                    x = x + _ffn(layer, h2, tp)
                 x = llama.norm(x)
                 # only each span's sampled rows reach the LM head:
                 # one row per span normally ([max_spans, 1, h] @
@@ -1410,7 +1462,7 @@ class DecodeStep:
                                           rope_tables_for_positions)
         model = self.model
         cfg = self.cfg
-        llama = model.llama
+        llama = _inner_model(model)
         tp = self._tp
         deg = tp.degree if tp is not None else 1
         H = cfg.num_attention_heads // deg      # this chip's head shard
@@ -1508,7 +1560,7 @@ class DecodeStep:
                     out = Tensor._from_value(out.reshape(S, 1, H * D))
                     x = x + _tp_psum(attn.o_proj(out), tp)
                     h2 = layer.post_attention_layernorm(x)
-                    x = x + _tp_psum(layer.mlp(h2), tp)
+                    x = x + _ffn(layer, h2, tp)
                 x = llama.norm(x)
                 if model.lm_head is None:
                     from ..ops.linalg import matmul

@@ -88,6 +88,17 @@ buffer-identity on already-placed arrays.  Specs never name a replica
 weights/pools across dp for throughput with no code change.  Dims an
 axis does not divide are PRUNED from the spec (storage optimization
 degrades, never errors); ``mesh_2d`` builds the canonical mesh.
+
+Expert parallelism (round 24) — the ``ep`` axis shards ONLY the
+batched MoE expert banks' E dim (``w_gate/w_up/w_down [E, ., .]`` take
+``P(ep, None, None)``, classified by :func:`mixtral_param_specs`); the
+router, attention, norms and KV pools never name ``ep``, so they
+replicate across it.  The fused MoE FFN inside the serving steps pays
+two ``all_to_all`` exchanges (dispatch + combine, the reference's
+global_scatter/global_gather pair) plus one token-stripe ``all_gather``
+per MoE layer — accounted statically by
+:meth:`TPContext.collective_bytes` under the ``ep_all_to_all`` /
+``ep_all_gather`` keys.  Per-chip expert-weight HBM is exactly 1/ep.
 """
 from __future__ import annotations
 
@@ -101,8 +112,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["ShardingConfig", "SpecLayout", "TPContext",
            "resolve_mesh_axis", "llama_param_specs",
-           "validate_tp_serving", "validate_cp_serving",
-           "tp_mesh", "mesh_2d", "cp_mesh",
+           "mixtral_param_specs", "validate_tp_serving",
+           "validate_cp_serving", "validate_ep_serving",
+           "tp_mesh", "mesh_2d", "cp_mesh", "ep_mesh",
            "tp_serving_context", "tp_embed", "tp_gather_logits",
            "tp_gather_logits_q8", "shard_arrays", "spec_axes",
            "prune_spec_axes", "gather_spec_axes", "fsdp_gather"]
@@ -245,6 +257,26 @@ def cp_mesh(cp: int, tp: int = 1, cp_axis: str = "cp",
     return ProcessMesh(shape=[cp], dim_names=[cp_axis])
 
 
+def ep_mesh(ep: int, tp: int = 1, ep_axis: str = "ep",
+            tp_axis: str = "tp"):
+    """The serving ``(ep, tp)`` ProcessMesh over the first ``ep*tp``
+    devices (round 24): ``ep`` shards the MoE expert banks' E dim so
+    per-chip expert-weight HBM is 1/ep, ``tp`` shards heads/vocab as
+    before.  ``tp=1`` gives the pure expert-parallel mesh — everything
+    except the expert banks replicates (no other spec names ``ep``)."""
+    from ..distributed.process_mesh import ProcessMesh
+    need = int(ep) * int(tp)
+    n = jax.device_count()
+    if need > n:
+        raise ValueError(
+            f"ep_mesh(ep={ep}, tp={tp}) needs {need} devices but only "
+            f"{n} are visible; for CPU dryruns call "
+            f"paddle_tpu.testing.dryrun.force_cpu_devices first")
+    if tp > 1:
+        return ProcessMesh(shape=[ep, tp], dim_names=[ep_axis, tp_axis])
+    return ProcessMesh(shape=[ep], dim_names=[ep_axis])
+
+
 # ---------------------------------------------------------------------------
 # canonical per-weight-family specs
 # ---------------------------------------------------------------------------
@@ -258,12 +290,16 @@ class SpecLayout:
 
     def __init__(self, tp_axis: Optional[str] = "tp",
                  fsdp_axis: Optional[str] = None,
-                 cp_axis: Optional[str] = None):
+                 cp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
         self.tp_axis = tp_axis
         self.fsdp_axis = fsdp_axis
         # round 22: context-parallel axis — stripes ONLY the KV pool's
         # slot dim (weights never name it, so they replicate across cp)
         self.cp_axis = cp_axis
+        # round 24: expert-parallel axis — shards ONLY the batched MoE
+        # expert banks' E dim (router/attention/pools never name it)
+        self.ep_axis = ep_axis
 
     def embeddings(self) -> PartitionSpec:
         """[V, h] vocab-row sharded: masked local lookup + one exact
@@ -326,6 +362,19 @@ class SpecLayout:
         pool's kv-head shard (quantize/dequantize/rescale are all
         head-local math)."""
         return P(None, self.tp_axis)
+
+    def expert_bank(self) -> PartitionSpec:
+        """Batched MoE expert weights ``[E, in, out]``
+        (w_gate/w_up/w_down): the E dim shards over ep, so each chip
+        stores and runs only its own experts; the in/out dims stay
+        whole (the grouped einsums are per-expert dense matmuls)."""
+        return P(self.ep_axis, None, None)
+
+    def expert_bank_scale(self) -> PartitionSpec:
+        """An int8 expert bank's ``[E, 1, out]`` per-expert-per-channel
+        absmax tables follow the bank's E shard (dequant is
+        expert-local math)."""
+        return P(self.ep_axis, None, None)
 
     def col_weight_scale(self) -> PartitionSpec:
         """Per-output-channel scale vector of a COLUMN-sharded weight
@@ -397,6 +446,45 @@ def llama_param_specs(keys: Iterable[str],
             specs[k] = layout.lm_head()
         else:
             specs[k] = layout.fsdp_default()
+    if shapes is not None and mesh is not None:
+        specs = {k: prune_spec_axes(s, shapes[k], mesh)
+                 if k in shapes else s for k, s in specs.items()}
+    return specs
+
+
+_EXPERT_BANK_FAMILIES = ("w_gate", "w_up", "w_down")
+
+
+def mixtral_param_specs(keys: Iterable[str],
+                        layout: Optional[SpecLayout] = None,
+                        shapes: Optional[Dict[str, Tuple[int, ...]]]
+                        = None,
+                        mesh: Optional[Mesh] = None,
+                        ) -> Dict[str, PartitionSpec]:
+    """The MoE name classifier (round 24): batched expert banks
+    (``...block_sparse_moe.w_gate/w_up/w_down``, plus their PTQ
+    ``::scale`` tables) take :meth:`SpecLayout.expert_bank` —
+    ``P(ep, None, None)`` — the router (``...block_sparse_moe.gate.*``)
+    replicates (its logits drive a top-k whose ties must agree on every
+    chip), and every other key delegates to :func:`llama_param_specs`
+    (Mixtral's attention/embedding/lm_head ARE the llama families).
+    Pruning semantics match llama_param_specs exactly."""
+    from ..quantization.functional import WEIGHT_SCALE_SUFFIX
+    layout = layout or SpecLayout()
+    keys = list(keys)
+    specs: Dict[str, PartitionSpec] = {}
+    rest = []
+    for k in keys:
+        base = k[:-len(WEIGHT_SCALE_SUFFIX)] \
+            if k.endswith(WEIGHT_SCALE_SUFFIX) else k
+        if any(base.endswith(f) for f in _EXPERT_BANK_FAMILIES):
+            specs[k] = layout.expert_bank_scale() if base != k \
+                else layout.expert_bank()
+        elif "block_sparse_moe.gate." in base:
+            specs[k] = layout.replicated()
+        else:
+            rest.append(k)
+    specs.update(llama_param_specs(rest, layout))
     if shapes is not None and mesh is not None:
         specs = {k: prune_spec_axes(s, shapes[k], mesh)
                  if k in shapes else s for k, s in specs.items()}
@@ -546,6 +634,54 @@ def validate_cp_serving(cp_degree: int, block_size: int,
             f"cp.")
 
 
+def validate_ep_serving(num_experts: int, ep_degree: int,
+                        mixed_step: bool = True,
+                        dense_prefill: bool = False,
+                        spec_decode: bool = False,
+                        budgets: Sequence[int] = ()) -> None:
+    """Every constraint expert-parallel serving needs, checked at
+    ENGINE CONSTRUCTION with one actionable message (round 24,
+    mirroring :func:`validate_cp_serving`).  ep shards the expert
+    banks' E dim and stripes the fused dispatch over token budgets, so
+    both E and every compiled budget must divide by ep; the dispatch
+    lives only in the mixed ragged step, so dense prefill and
+    speculative decoding are rejected."""
+    if ep_degree <= 1:
+        return
+    if not num_experts:
+        raise ValueError(
+            f"expert-parallel serving with ep={ep_degree} needs an MoE "
+            f"model (num_local_experts on the config): a dense model "
+            f"has no expert banks for the ep axis to shard — drop the "
+            f"ep mesh axis or serve the Mixtral-family model.")
+    if num_experts % ep_degree:
+        raise ValueError(
+            f"expert-parallel serving with ep={ep_degree} requires the "
+            f"expert count to divide by ep (each chip owns E/ep "
+            f"experts); got num_local_experts={num_experts}.  Pick an "
+            f"ep that divides E, or lower ep.")
+    if not mixed_step or dense_prefill:
+        raise ValueError(
+            f"expert-parallel serving (ep={ep_degree}) requires the "
+            f"mixed ragged step: the token->expert all_to_all dispatch "
+            f"is fused into the ONE compiled mixed launch, and the "
+            f"legacy dense prefill/decode bodies have no ep stripe.  "
+            f"Construct the engine with mixed_step=True.")
+    if spec_decode:
+        raise ValueError(
+            f"expert-parallel serving (ep={ep_degree}) does not "
+            f"support speculative decoding yet: the draft/verify steps "
+            f"bypass the fused MoE dispatch.  Disable spec-decode "
+            f"under ep.")
+    bad = [b for b in budgets if int(b) % ep_degree]
+    if bad:
+        raise ValueError(
+            f"expert-parallel serving (ep={ep_degree}) stripes each "
+            f"compiled token budget over the ep axis, so every budget "
+            f"must divide by ep; violated: {bad}.  Adjust the mixed "
+            f"budget set (token_budgets) or lower ep.")
+
+
 class TPContext:
     """Resolved tensor-parallel serving context, shared by every
     serving step of one engine: the jax mesh, the axis name/degree, the
@@ -556,7 +692,8 @@ class TPContext:
     def __init__(self, mesh: Mesh, axis: Optional[str], degree: int,
                  layout: SpecLayout, specs: Dict[str, PartitionSpec],
                  fsdp_axis: Optional[str] = None, fsdp_degree: int = 1,
-                 cp_axis: Optional[str] = None, cp_degree: int = 1):
+                 cp_axis: Optional[str] = None, cp_degree: int = 1,
+                 ep_axis: Optional[str] = None, ep_degree: int = 1):
         self.mesh = mesh
         self.axis = axis                  # tp axis (None: pure fsdp)
         self.degree = degree              # tp degree (compute shard)
@@ -564,6 +701,8 @@ class TPContext:
         self.fsdp_degree = fsdp_degree if fsdp_degree > 1 else 1
         self.cp_axis = cp_axis if cp_degree > 1 else None
         self.cp_degree = cp_degree if cp_degree > 1 else 1
+        self.ep_axis = ep_axis if ep_degree > 1 else None
+        self.ep_degree = ep_degree if ep_degree > 1 else 1
         self.layout = layout
         self.specs = specs
         self._placed: Optional[Dict[str, jnp.ndarray]] = None
@@ -672,6 +811,22 @@ class TPContext:
             out["cp_merge"] = (cfg.num_hidden_layers * n_tokens
                                * h_local * (d + 2) * 4
                                * (self.cp_degree - 1))
+        if self.ep_degree > 1:
+            # round 24 MoE dispatch (per MoE layer): two all_to_all
+            # exchanges of the [E, Tl*k, D] send/return buffers — the
+            # chip keeps its own 1/ep slice, so (ep-1)/ep of each
+            # buffer crosses the link — plus one all_gather where the
+            # chip receives the other (ep-1) token stripes [Tl, D]
+            item = 2 if cfg.dtype == "bfloat16" else 4
+            ep = self.ep_degree
+            E = cfg.num_local_experts
+            k = cfg.num_experts_per_tok
+            L = cfg.num_hidden_layers
+            tl = n_tokens // ep
+            buf = E * (tl * k) * cfg.hidden_size * item
+            out["ep_all_to_all"] = 2 * L * buf * (ep - 1) // ep
+            out["ep_all_gather"] = (L * (ep - 1) * tl
+                                    * cfg.hidden_size * item)
         return out
 
     def pool_sharding(self) -> NamedSharding:
@@ -692,6 +847,7 @@ class TPContext:
                 f"fsdp_axis={self.fsdp_axis!r}, "
                 f"fsdp_degree={self.fsdp_degree}, "
                 f"cp_axis={self.cp_axis!r}, cp_degree={self.cp_degree}, "
+                f"ep_axis={self.ep_axis!r}, ep_degree={self.ep_degree}, "
                 f"mesh={tuple(self.mesh.shape.items())})")
 
 
@@ -713,32 +869,41 @@ def tp_serving_context(model, mesh, sharding: Optional[ShardingConfig]
     cp_axis = "cp" if jmesh is not None \
         and "cp" in jmesh.axis_names else None
     cp_deg = jmesh.shape["cp"] if cp_axis else 1
+    ep_axis = "ep" if jmesh is not None \
+        and "ep" in jmesh.axis_names else None
+    ep_deg = jmesh.shape["ep"] if ep_axis else 1
     try:
         jmesh, axis, deg = resolve_mesh_axis(
             mesh, cfg.axis, cfg.degree, candidates=("tp", "model", "mp"))
     except ValueError:
         # no tp axis at all — a pure-fsdp (or fsdp×dp) mesh is still a
-        # sharded-storage serving context, and a pure-cp mesh (round
-        # 22) a pool-striped one (size-1 axes degenerate below);
-        # anything else re-raises
-        if fsdp_axis is None and cp_axis is None:
+        # sharded-storage serving context, a pure-cp mesh (round 22) a
+        # pool-striped one, and a pure-ep mesh (round 24) an
+        # expert-sharded one (size-1 axes degenerate below); anything
+        # else re-raises
+        if fsdp_axis is None and cp_axis is None and ep_axis is None:
             raise
         axis, deg = None, 1
-    if deg <= 1 and fsdp_deg <= 1 and cp_deg <= 1:
+    if deg <= 1 and fsdp_deg <= 1 and cp_deg <= 1 and ep_deg <= 1:
         return None
     if deg > 1:
         validate_tp_serving(model.config, deg)
     layout = SpecLayout(tp_axis=axis if deg > 1 else None,
                         fsdp_axis=fsdp_axis if fsdp_deg > 1 else None,
-                        cp_axis=cp_axis if cp_deg > 1 else None)
+                        cp_axis=cp_axis if cp_deg > 1 else None,
+                        ep_axis=ep_axis if ep_deg > 1 else None)
     sd = model.state_dict()
     shapes = {k: tuple(t._value.shape) for k, t in sd.items()}
-    specs = llama_param_specs(sd.keys(), layout, shapes=shapes,
-                              mesh=jmesh)
+    specs_fn = mixtral_param_specs \
+        if getattr(model.config, "num_local_experts", 0) \
+        else llama_param_specs
+    specs = specs_fn(sd.keys(), layout, shapes=shapes, mesh=jmesh)
     return TPContext(jmesh, axis if deg > 1 else None, deg, layout,
                      specs, fsdp_axis=fsdp_axis, fsdp_degree=fsdp_deg,
                      cp_axis=cp_axis if cp_deg > 1 else None,
-                     cp_degree=cp_deg)
+                     cp_degree=cp_deg,
+                     ep_axis=ep_axis if ep_deg > 1 else None,
+                     ep_degree=ep_deg)
 
 
 # ---------------------------------------------------------------------------

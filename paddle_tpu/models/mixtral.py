@@ -28,8 +28,8 @@ from ..nn.layer_base import Layer
 from ..nn.layers import Linear, LayerList
 from ..nn import initializer as I
 from ..ops._helpers import targ
-from .llama import (LlamaConfig, LlamaAttention, RMSNorm, _attr,
-                    LlamaPretrainingCriterion)
+from .llama import (LlamaConfig, LlamaAttention, LlamaForCausalLM,
+                    RMSNorm, _attr, LlamaPretrainingCriterion)
 
 
 @dataclass
@@ -74,42 +74,24 @@ class MixtralSparseMoeBlock(Layer):
         E, k = self.num_experts, self.top_k
 
         def fn(v, gw, wg, wu, wd):
-            n = v.shape[0]
+            from ..ops.moe_gate import (topk_gate, assignment_slots,
+                                        dispatch_to_buffers,
+                                        grouped_expert_swiglu,
+                                        combine_from_buffers)
             logits = (v.astype(jnp.float32)
                       @ gw.astype(jnp.float32))          # [N, E]
-            probs = jax.nn.softmax(logits, axis=-1)
-            top_w, top_i = jax.lax.top_k(probs, k)       # [N, k]
-            top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+            top_w, top_i, probs = topk_gate(logits, k)   # [N, k]
 
             # capacity slot per assignment (running count per expert);
             # memory stays O(N*k*E) — the buffers themselves are built
             # with scatter/gather, never an [N,k,E,C] one-hot
-            oh = jax.nn.one_hot(top_i, E, dtype=jnp.float32)  # [N,k,E]
-            pos = jnp.cumsum(oh.reshape(-1, E), axis=0).reshape(
-                oh.shape) - 1.0
-            slot = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [N,k]
+            slot, oh = assignment_slots(top_i, E)
             keep = slot < capacity
-            slot_c = jnp.clip(slot, 0, capacity - 1)
-
-            # scatter tokens into [E, C, D] expert buffers
-            vf = v.astype(jnp.float32)
-            src = (vf[:, None, :] * keep[..., None]).reshape(n * k, -1)
-            zeros = jnp.zeros((E, capacity, vf.shape[1]), jnp.float32)
-            disp = zeros.at[top_i.reshape(-1),
-                            slot_c.reshape(-1)].add(src).astype(v.dtype)
-
+            disp = dispatch_to_buffers(v, top_i, slot, keep, E, capacity)
             # batched expert SwiGLU: all experts in three MXU einsums
-            g = jnp.einsum("ecd,edm->ecm", disp, wg)
-            u = jnp.einsum("ecd,edm->ecm", disp, wu)
-            h = jax.nn.silu(g.astype(jnp.float32)).astype(v.dtype) * u
-            eo = jnp.einsum("ecm,emd->ecd", h, wd)       # [E,C,D]
-
-            # gather each assignment's expert output and combine
-            picked = eo[top_i.reshape(-1),
-                        slot_c.reshape(-1)].reshape(n, k, -1)
-            w_eff = (top_w * keep).astype(jnp.float32)
-            out = jnp.sum(picked.astype(jnp.float32)
-                          * w_eff[..., None], axis=1).astype(v.dtype)
+            eo = grouped_expert_swiglu(disp, wg, wu, wd)  # [E,C,D]
+            out = combine_from_buffers(eo, top_i, slot, top_w,
+                                       keep).astype(v.dtype)
 
             # Mixtral load-balancing aux: E * sum_e f_e * P_e, with f_e
             # from the RAW assignment (pre-capacity) so router collapse
@@ -142,6 +124,15 @@ class MixtralDecoderLayer(Layer):
         return h + self.block_sparse_moe(
             self.post_attention_layernorm(h))
 
+    def forward_with_cache(self, x, cache, position_offset,
+                           attn_mask=None):
+        attn, new_cache = self.self_attn(
+            self.input_layernorm(x), attn_mask, cache=cache,
+            position_offset=position_offset)
+        h = x + attn
+        return h + self.block_sparse_moe(
+            self.post_attention_layernorm(h)), new_cache
+
 
 class MixtralModel(Layer):
     def __init__(self, config: MixtralConfig):
@@ -155,11 +146,21 @@ class MixtralModel(Layer):
                                  for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
         h = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            h = layer(h, attn_mask)
-        return self.norm(h)
+        if self.config.dtype == "bfloat16":
+            h = h.astype("bfloat16")
+        if caches is None:
+            for layer in self.layers:
+                h = layer(h, attn_mask)
+            return self.norm(h)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            h, c = layer.forward_with_cache(h, cache, position_offset,
+                                            attn_mask)
+            new_caches.append(c)
+        return self.norm(h), new_caches
 
 
 class MixtralForCausalLM(Layer):
@@ -172,9 +173,19 @@ class MixtralForCausalLM(Layer):
                                   I.Normal(0.0, config.initializer_range)),
                               bias_attr=False)
 
-    def forward(self, input_ids, attn_mask=None):
-        h = self.mixtral(input_ids, attn_mask)
-        return self.lm_head(h)
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        if caches is None:
+            h = self.mixtral(input_ids, attn_mask)
+            return self.lm_head(h)
+        h, caches = self.mixtral(input_ids, attn_mask, caches,
+                                 position_offset)
+        return self.lm_head(h), caches
+
+    # the eager decode loop is model-agnostic (self.forward + config
+    # only) — share the llama implementation verbatim so the MoE parity
+    # reference can never drift from the dense one
+    generate = LlamaForCausalLM.generate
 
     def router_aux_loss(self):
         """Sum of per-layer load-balancing losses from the LAST forward

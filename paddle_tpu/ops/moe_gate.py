@@ -1,0 +1,163 @@
+"""Shared MoE gating + expert dispatch primitives (raw-jnp level).
+
+The ONE top-k gate / dispatch implementation in the repo.  Callers:
+
+- ``models/mixtral.py`` eager block — GShard capacity buffers with
+  drops, plus the load-balancing aux term (computed by the caller so
+  the side state never enters a serving trace);
+- ``incubate/distributed/models/moe/gate.py`` — NaiveGate/GShardGate/
+  SwitchGate all route through :func:`topk_gate` (no second
+  softmax/top-k copy drifting out of sync);
+- ``jit/serving_step.py`` — :func:`moe_ffn` is the fused dropless MoE
+  FFN inside the compiled serving steps, optionally expert-parallel
+  over an ``ep`` mesh axis with ``jax.lax.all_to_all`` dispatch/combine
+  (the reference's global_scatter/global_gather pair, emitted inside
+  the ONE compiled launch).
+
+Everything here is pure jnp -> safe both under ``apply_op`` eager
+dispatch and inside jit/shard_map traced bodies.  No host transfers, no
+shape branches on traced values, no PRNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "topk_gate", "assignment_slots", "dispatch_to_buffers",
+    "grouped_expert_swiglu", "combine_from_buffers", "moe_ffn",
+]
+
+
+def topk_gate(logits, k, renormalize=True):
+    """Softmax + top-k routing from raw router logits ``[N, E]``.
+
+    Returns ``(top_w f32 [N,k], top_i int32 [N,k], probs f32 [N,E])``.
+    ``renormalize=True`` rescales the selected weights to sum to 1
+    (Mixtral convention); Switch-style gates pass ``False``.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, k)
+    if renormalize:
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    return top_w, top_i.astype(jnp.int32), probs
+
+
+def assignment_slots(top_i, num_experts):
+    """Per-assignment capacity slot: running count per expert over the
+    flattened ``[N*k]`` assignment order (GShard dense-dispatch
+    position, one-hot cumsum — never an ``[N,k,E,C]`` one-hot).
+
+    Returns ``(slot int32 [N,k], oh f32 [N,k,E])``; ``oh`` is handed
+    back so aux-loss callers don't recompute the one-hot.
+    """
+    oh = jax.nn.one_hot(top_i, num_experts, dtype=jnp.float32)
+    pos = jnp.cumsum(oh.reshape(-1, num_experts), axis=0).reshape(
+        oh.shape) - 1.0
+    slot = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)
+    return slot, oh
+
+
+def dispatch_to_buffers(x, top_i, slot, keep, num_experts, capacity):
+    """Scatter tokens into ``[E, C, D]`` expert buffers (f32 scatter-add,
+    cast back to ``x.dtype``).  ``keep=None`` means dropless (every
+    assignment has a slot); otherwise over-capacity rows scatter zeros.
+    """
+    n, k = top_i.shape
+    vf = x.astype(jnp.float32)
+    if keep is None:
+        src = jnp.broadcast_to(vf[:, None, :], (n, k, vf.shape[1]))
+    else:
+        src = vf[:, None, :] * keep[..., None]
+    src = src.reshape(n * k, -1)
+    slot_c = jnp.clip(slot, 0, capacity - 1)
+    zeros = jnp.zeros((num_experts, capacity, vf.shape[1]), jnp.float32)
+    return zeros.at[top_i.reshape(-1),
+                    slot_c.reshape(-1)].add(src).astype(x.dtype)
+
+
+def grouped_expert_swiglu(disp, wg, wu, wd):
+    """Batched expert SwiGLU: the whole bank in three MXU einsums.
+
+    ``disp [E, C, D]``, ``wg/wu [E, D, M]``, ``wd [E, M, D]`` ->
+    ``[E, C, D]``.  Row results are independent of buffer contents, so
+    capacity-buffer padding never perturbs real tokens.
+    """
+    g = jnp.einsum("ecd,edm->ecm", disp, wg)
+    u = jnp.einsum("ecd,edm->ecm", disp, wu)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(disp.dtype) * u
+    return jnp.einsum("ecm,emd->ecd", h, wd)
+
+
+def combine_from_buffers(eo, top_i, slot, top_w, keep=None):
+    """Gather each assignment's expert output and k-sum with routing
+    weights.  Returns f32 ``[N, D]`` (caller casts).  ``keep`` masks
+    dropped assignments (eager capacity path)."""
+    n, k = top_i.shape
+    capacity = eo.shape[1]
+    slot_c = jnp.clip(slot, 0, capacity - 1)
+    picked = eo[top_i.reshape(-1), slot_c.reshape(-1)].reshape(n, k, -1)
+    w_eff = top_w.astype(jnp.float32)
+    if keep is not None:
+        w_eff = (top_w * keep).astype(jnp.float32)
+    return jnp.sum(picked.astype(jnp.float32) * w_eff[..., None], axis=1)
+
+
+def moe_ffn(x, gate_w, wg, wu, wd, *, top_k, ep_axis=None, ep_degree=1):
+    """Dropless fused MoE FFN over a flat token block ``x [N, D]``.
+
+    ``gate_w [D, E_total]`` replicated; ``wg/wu/wd`` the LOCAL expert
+    shard ``[El, ., .]`` (``El = E_total/ep``; the full bank when
+    ``ep_degree == 1``).
+
+    Local path (``ep_degree <= 1``): dropless capacity ``N*top_k``
+    bounds the worst-case per-expert load, so no assignment is ever
+    dropped — the buffers are the GShard layout of the eager block with
+    the drop mask provably all-True.
+
+    ep path (inside shard_map over ``ep_axis``): chip ``r`` gates its
+    token stripe ``x[r*Tl:(r+1)*Tl]``, scatters into a per-expert send
+    buffer ``[E_total, Tl*k, D]``, ``all_to_all`` ships each expert
+    owner its slices, grouped SwiGLU runs on the local ``[El, ., .]``
+    shard, ``all_to_all`` ships outputs back, the weighted combine runs
+    on the token's home chip, and ``all_gather`` rebuilds the
+    replicated ``[N, D]`` activation.  Requires ``ep | N`` and
+    ``ep | E_total`` (validated at engine construction).
+    """
+    n, d = x.shape
+    e_local = wg.shape[0]
+    if ep_axis is None or ep_degree <= 1:
+        logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        top_w, top_i, _ = topk_gate(logits, top_k)
+        slot, _ = assignment_slots(top_i, e_local)
+        disp = dispatch_to_buffers(x, top_i, slot, None, e_local,
+                                   n * top_k)
+        eo = grouped_expert_swiglu(disp, wg, wu, wd)
+        return combine_from_buffers(eo, top_i, slot, top_w).astype(x.dtype)
+
+    e_total = e_local * ep_degree
+    tl = n // ep_degree                 # token stripe per chip
+    cl = tl * top_k                     # dropless send capacity
+    r = jax.lax.axis_index(ep_axis)
+    x_r = jax.lax.dynamic_slice_in_dim(x, r * tl, tl, axis=0)
+    logits = x_r.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    top_w, top_i, _ = topk_gate(logits, top_k)
+    slot, _ = assignment_slots(top_i, e_total)
+    disp = dispatch_to_buffers(x_r, top_i, slot, None, e_total, cl)
+    # dispatch: chip g receives [ep, El, Cl, D]; recv[r] = chip r's
+    # assignments destined to chip g's experts
+    recv = jax.lax.all_to_all(disp, ep_axis, split_axis=0,
+                              concat_axis=0, tiled=True)
+    work = jnp.swapaxes(recv.reshape(ep_degree, e_local, cl, d),
+                        0, 1).reshape(e_local, ep_degree * cl, d)
+    eo = grouped_expert_swiglu(work, wg, wu, wd)
+    back = jnp.swapaxes(eo.reshape(e_local, ep_degree, cl, d),
+                        0, 1).reshape(e_total, cl, d)
+    # combine: ship outputs back to each assignment's home chip; after
+    # the exchange chip r holds [E_total, Cl, D] aligned with its own
+    # (top_i, slot) tables
+    back = jax.lax.all_to_all(back, ep_axis, split_axis=0,
+                              concat_axis=0, tiled=True)
+    out_r = combine_from_buffers(back, top_i, slot,
+                                 top_w).astype(x.dtype)
+    return jax.lax.all_gather(out_r, ep_axis, axis=0, tiled=True)

@@ -49,6 +49,12 @@ WEIGHT_SCALE_SUFFIX = "::scale"
 _PTQ_FAMILIES = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
                  "up_proj", "down_proj", "lm_head")
 
+# 3-D batched MoE expert banks ([E, D, M] / [E, M, D]); quantized
+# per-expert-per-output-channel (absmax over the contraction dim).  The
+# router ("...block_sparse_moe.gate.weight") stays fp — routing logits
+# are tiny and drive a top-k whose ties must match the eager reference.
+_PTQ_EXPERT_FAMILIES = ("w_gate", "w_up", "w_down")
+
 
 def symmetric_bound(bits: int = 8) -> int:
     """Largest code magnitude: 127 for int8."""
@@ -135,12 +141,15 @@ def is_weight_scale_key(key: str) -> bool:
 
 
 def ptq_quantizable(key: str, value) -> bool:
-    """2-D projection weights only (see ``_PTQ_FAMILIES``)."""
-    if not key.endswith("weight") or is_weight_scale_key(key):
+    """2-D projection weights (``_PTQ_FAMILIES``) and 3-D batched expert
+    banks (``_PTQ_EXPERT_FAMILIES``)."""
+    if is_weight_scale_key(key):
         return False
-    if getattr(value, "ndim", 0) != 2:
-        return False
-    return any(f in key for f in _PTQ_FAMILIES)
+    if key.endswith("weight") and getattr(value, "ndim", 0) == 2:
+        return any(f in key for f in _PTQ_FAMILIES)
+    if getattr(value, "ndim", 0) == 3:
+        return any(key.endswith(f) for f in _PTQ_EXPERT_FAMILIES)
+    return False
 
 
 def quantize_param_tree(values: Dict[str, jnp.ndarray],
@@ -160,6 +169,15 @@ def quantize_param_tree(values: Dict[str, jnp.ndarray],
         v = jnp.asarray(v)
         if not ptq_quantizable(k, v):
             out[k] = v
+            continue
+        if v.ndim == 3:
+            # expert bank [E, in, out]: absmax over the contraction dim
+            # -> per-expert-per-output-channel scale [E, 1, out], stored
+            # full-rank so the spec layer can shard its E dim with P(ep)
+            scale = absmax_scale(v, axis=1, keepdims=True)
+            q = quantize_symmetric(v, scale, bits).astype(jnp.int8)
+            out[k] = q
+            out[k + WEIGHT_SCALE_SUFFIX] = scale           # [E, 1, out]
             continue
         scale = absmax_scale(v, axis=0, keepdims=True)     # [1, out]
         q = quantize_symmetric(v, scale, bits).astype(jnp.int8)
@@ -183,6 +201,9 @@ def dequantize_param_tree(params: Dict[str, jnp.ndarray], dtype,
         if s is None:
             out[k] = v
         else:
-            out[k] = dequantize_symmetric(v, s[None, :],
-                                          bits).astype(dtype)
+            # 1-D scales broadcast against [in, out]; full-rank scales
+            # (expert banks [E, 1, out]) broadcast as stored
+            if getattr(s, "ndim", 1) == 1:
+                s = s[None, :]
+            out[k] = dequantize_symmetric(v, s, bits).astype(dtype)
     return out

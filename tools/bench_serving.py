@@ -1612,6 +1612,285 @@ def main_cp(out_path, max_cp):
         sys.exit(1)
 
 
+# ---------------------------------------------------------------------------
+# --moe (round 24): expert-parallel MoE serving
+# ---------------------------------------------------------------------------
+def build_model_moe(on_tpu):
+    """The --moe model: tiny Mixtral (E=4, k=2) on CPU; a
+    Mixtral-8-expert line over the 1.1B dense geometry on TPU (every
+    sharded dim divides by the top ep degree 4)."""
+    from paddle_tpu.models.mixtral import (MixtralConfig,
+                                           MixtralForCausalLM,
+                                           mixtral_tiny_config)
+    if on_tpu:
+        cfg = MixtralConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=20, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", num_local_experts=8,
+            num_experts_per_tok=2)
+    else:
+        cfg = mixtral_tiny_config()
+    paddle.seed(0)
+    model = MixtralForCausalLM(cfg)
+    if cfg.dtype == "bfloat16":
+        model.bfloat16()
+    model.eval()
+    return cfg, model
+
+
+def _ep_mesh_for(ep):
+    if ep == 1:
+        return None
+    from paddle_tpu.jit.spmd import ep_mesh
+    return ep_mesh(ep)
+
+
+def _moe_expert_bytes_per_chip(model, eng):
+    """Per-chip bytes of the three expert-bank families, derived from
+    the engine's OWN specs (so a spec regression — an unsharded bank —
+    shows up as a broken shrink ratio, not a silently-passing
+    accounting)."""
+    total = 0
+    ep = eng.ep_degree
+    specs = eng.tp.specs if eng.tp is not None else {}
+    for k, t in model.state_dict().items():
+        if not any(k.endswith(f) for f in ("w_gate", "w_up", "w_down")):
+            continue
+        v = t._value
+        nbytes = v.size * v.dtype.itemsize
+        spec = specs.get(k)
+        sharded = spec is not None and "ep" in tuple(spec)
+        total += nbytes // ep if sharded else nbytes
+    return int(total)
+
+
+def _moe_router_drill(moe_model, dense_model, wl):
+    """The heterogeneous-pool drill: an ep=2 MoE engine, a single-chip
+    MoE engine and a dense llama engine behind one round-15 router; the
+    ep engine dies mid-flight and every in-flight request must requeue
+    and finish its FULL budget on a survivor (zero drops), with the
+    dead pool drained leak-free."""
+    from paddle_tpu.inference.router import ServingRouter
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    def eng(model, mesh=None):
+        return ContinuousBatchingEngine(
+            model, max_batch_size=2, num_blocks=wl["num_blocks"],
+            block_size=wl["block_size"], mixed_step=True,
+            prefill_chunk_size=wl["chunk"], mesh=mesh)
+
+    e_moe_ep = eng(moe_model, _ep_mesh_for(2))
+    pool = [e_moe_ep, eng(moe_model), eng(dense_model)]
+    router = ServingRouter(pool)
+    rng = np.random.RandomState(7)
+    vocab = min(moe_model.config.vocab_size,
+                dense_model.config.vocab_size)
+    prompts = [rng.randint(1, vocab, (n,)).astype(np.int64)
+               for n in (5, 7, 4, 6, 3, 8)]
+    rids = [router.submit(p, max_new_tokens=wl["budget"])
+            for p in prompts]
+    for _ in range(2):
+        router.step()
+    lost = sum(1 for k in router._inflight
+               if k[0] == e_moe_ep.engine_id)
+    router.mark_unhealthy(e_moe_ep.engine_id)
+    out = router.run_to_completion()
+    c = e_moe_ep.caches[0]
+    return {
+        "requests": len(rids),
+        "killed_in_flight": int(lost),
+        "requeues": int(sum(router.finished[r].requeues for r in rids)),
+        "zero_drops": bool(
+            sorted(out) == sorted(rids)
+            and all(len(out[r]) == wl["budget"] for r in rids)),
+        "kill_hit_live_work": bool(lost >= 1),
+        "dead_pool_drained": bool(len(c._free) == c.num_blocks),
+    }
+
+
+def main_moe(out_path, max_ep):
+    """--moe: expert-parallel MoE serving (round 24).  The ep mesh axis
+    shards every Mixtral expert bank's E dim; the fused MixedStep
+    gates, all_to_all-dispatches, runs the grouped expert SwiGLU and
+    combines inside the ONE compiled launch.  Gates: byte parity vs the
+    EAGER Mixtral generate on mixed+chunked and decode-only workloads
+    at every ep, per-chip expert-bank bytes EXACTLY 1/ep, compile count
+    still bounded by the budget set, the ep collective accounting
+    nonzero past ep=1, dropless dispatch (dropped fate stays 0), and
+    the heterogeneous dense+MoE router drill with zero drops."""
+    from paddle_tpu.testing.dryrun import force_cpu_devices
+    on_tpu = _tpu_available()
+    if not on_tpu:
+        force_cpu_devices(max(8, max_ep))
+    dev = jax.devices()[0]
+    ep_list = [e for e in (1, 2, 4) if e <= min(max_ep,
+                                                jax.device_count())]
+    cfg, model = build_model_moe(on_tpu)
+    vocab = cfg.vocab_size
+    rng = np.random.RandomState(11)
+
+    if on_tpu:
+        wl = dict(slots=8, block_size=16, num_blocks=1024, budget=8,
+                  chunk=256)
+        lengths = [20, 45, 130, 300, 600]
+        dec = dict(slots=8, occupancy=8, prompt_len=128, warm=4,
+                   steps=32, num_blocks=8 * (-(-(128 + 64) // 16) + 2),
+                   block_size=16)
+    else:
+        wl = dict(slots=4, block_size=4, num_blocks=96, budget=4,
+                  chunk=8)
+        lengths = [3, 5, 9, 12, 20]
+        dec = dict(slots=4, occupancy=4, prompt_len=12, warm=2,
+                   steps=32, num_blocks=64, block_size=4)
+    wl["prompts"] = [rng.randint(1, vocab, (n,)).astype(np.int64)
+                     for n in lengths]
+
+    # the parity reference is the EAGER Mixtral generate (satellite 1
+    # woke it for exactly this), not merely the ep=1 engine
+    eager_mixed = [_ref(model, p, wl["budget"]) for p in wl["prompts"]]
+    eager_dec = [_ref(model, p[:3], wl["budget"] * 2)
+                 for p in wl["prompts"][:wl["slots"]]]
+
+    curve = []
+    base_expert = None
+    for ep in ep_list:
+        mesh = _ep_mesh_for(ep)
+        mixed_toks, eng = _tp_workload_tokens(model, mesh, wl)
+        dec_toks = _cp_decode_tokens(model, mesh, wl)
+        expert_bytes = _moe_expert_bytes_per_chip(model, eng)
+        if base_expert is None:
+            base_expert = expert_bytes
+        d = bench_mixed_decode(model, dec["slots"], dec["occupancy"],
+                               dec["prompt_len"], dec["warm"],
+                               dec["steps"], dec["num_blocks"],
+                               dec["block_size"], wl["chunk"],
+                               mesh=mesh)
+        top = eng.token_budgets[-1]
+        coll = eng.mixed.collective_bytes(top)
+        row = {
+            "ep": ep,
+            "decode_tokens_per_sec": d["decode_tokens_per_sec"],
+            "decode_step_ms": d["decode_step_ms"],
+            "parity_mixed_vs_eager": bool(mixed_toks == eager_mixed),
+            "parity_decode_vs_eager": bool(dec_toks == eager_dec),
+            "expert_bank_bytes_per_chip": expert_bytes,
+            "expert_shard_ratio": round(
+                expert_bytes / max(base_expert, 1), 4),
+            "mixed_step_compile_count": eng.mixed.total_compiles,
+            "compile_bound": len(eng.token_budgets),
+            "ep_all_to_all_bytes_per_top_budget_step":
+                coll.get("ep_all_to_all", 0),
+            "ep_all_gather_bytes_per_top_budget_step":
+                coll.get("ep_all_gather", 0),
+        }
+        curve.append(row)
+        print("# ep=%d: %.1f decode tok/s, %.3f ms/step, experts/chip "
+              "%dB (%.3fx), parity m/d=%s/%s, a2a %dB/step, "
+              "compiles %d<=%d"
+              % (ep, row["decode_tokens_per_sec"],
+                 row["decode_step_ms"], expert_bytes,
+                 row["expert_shard_ratio"],
+                 row["parity_mixed_vs_eager"],
+                 row["parity_decode_vs_eager"],
+                 row["ep_all_to_all_bytes_per_top_budget_step"],
+                 row["mixed_step_compile_count"], row["compile_bound"]),
+              file=sys.stderr)
+
+    # dropless dispatch: the fate counter published by the engines
+    from paddle_tpu.observability import default_registry
+    disp = default_registry().get("serving_moe_dispatch_tokens_total")
+    routed = disp.labels(fate="routed").value if disp else 0
+    dropped = disp.labels(fate="dropped").value if disp else -1
+
+    _, dense_model = build_model(on_tpu)
+    drill = _moe_router_drill(model, dense_model, wl)
+
+    gates = {
+        "parity": all(r["parity_mixed_vs_eager"]
+                      and r["parity_decode_vs_eager"] for r in curve),
+        # exact byte comparison — the rounded ratio is display-only
+        "expert_bank_shard": all(
+            r["expert_bank_bytes_per_chip"] * r["ep"]
+            == curve[0]["expert_bank_bytes_per_chip"] for r in curve),
+        "compile_bound": all(
+            r["mixed_step_compile_count"] <= r["compile_bound"]
+            for r in curve),
+        "covers_ep2": any(r["ep"] >= 2 for r in curve),
+        "ep_collectives_accounted": all(
+            r["ep_all_to_all_bytes_per_top_budget_step"] > 0
+            and r["ep_all_gather_bytes_per_top_budget_step"] > 0
+            for r in curve if r["ep"] > 1),
+        "dropless_dispatch": bool(routed > 0 and dropped == 0),
+        "router_drill_zero_drops": bool(
+            drill["zero_drops"] and drill["kill_hit_live_work"]
+            and drill["dead_pool_drained"]),
+    }
+    ok = all(gates.values())
+    top_row = curve[-1]
+    shrink = (curve[0]["expert_bank_bytes_per_chip"]
+              / max(top_row["expert_bank_bytes_per_chip"], 1))
+    artifact = {
+        "metric": "serving_moe_expert_hbm_shrink",
+        "value": round(shrink, 2),
+        "passed": ok,
+        "gates": gates,
+        "cpu_dryrun": not on_tpu,
+        "note": ("CPU dryrun: virtual chips share the same cores, so "
+                 "the gate is byte parity vs the eager Mixtral on both "
+                 "workloads + per-chip expert bytes == 1/ep + compile "
+                 "bound + the dropless fate counter + the router "
+                 "drill; the tokens/s column is recorded for curve "
+                 "shape only" if not on_tpu else
+                 "TPU: tokens/s and expert HBM shrink are the gates"),
+        "scaling_curve": curve,
+        "moe_dispatch_tokens": {"routed": int(routed),
+                                "dropped": int(dropped)},
+        "router_drill": drill,
+        "dispatch_math": {
+            "per_layer": "topk_gate -> dropless scatter [E, tl*k, D] "
+                         "-> all_to_all(ep) -> grouped SwiGLU on E/ep "
+                         "banks -> all_to_all(ep) -> weighted combine "
+                         "-> all_gather(tokens)",
+            "ep_all_to_all_bytes":
+                "2 * L * E * (T/ep * k) * hidden * item * (ep-1)/ep",
+            "ep_all_gather_bytes": "L * (ep-1) * T/ep * hidden * item",
+        },
+        "config": {
+            # real count, not the dense analytic formula — the expert
+            # banks multiply the FFN params by E
+            "params_m": round(sum(
+                t._value.size for t in model.state_dict().values())
+                / 1e6, 2),
+            "layers": cfg.num_hidden_layers,
+            "hidden": cfg.hidden_size,
+            "heads": cfg.num_attention_heads,
+            "kv_heads": cfg.num_key_value_heads,
+            "experts": cfg.num_local_experts,
+            "top_k": cfg.num_experts_per_tok,
+            "slots": wl["slots"],
+            "block_size": wl["block_size"],
+            "num_blocks": wl["num_blocks"],
+            "chunk": wl["chunk"],
+            "dtype": cfg.dtype,
+        },
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "device_count": jax.device_count(),
+        "top_decode_tokens_per_sec": top_row["decode_tokens_per_sec"],
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "metric": artifact["metric"],
+        "value": artifact["value"],
+        "unit": "x_expert_hbm_per_chip",
+        "vs_baseline": artifact["value"] if ok else 0.0,
+    }), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
 def parity_gate_mixed(model, wl):
     """Decode-only byte parity: the fused mixed engine on a staggered
     3-request decode mix vs eager generate."""
@@ -2476,6 +2755,43 @@ def main():
         except Exception as e:                        # noqa: BLE001
             print(json.dumps({
                 "metric": "serving_cp_max_context_scale",
+                "value": 0.0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "error": repr(e)[:300],
+            }), flush=True)
+            sys.exit(1)
+        return
+    if "--moe" in sys.argv[1:]:
+        args = sys.argv[1:]
+        i = args.index("--moe")
+        max_ep = 4
+        if i + 1 < len(args):
+            nxt = args[i + 1]
+            if nxt.isdigit():
+                max_ep = int(args.pop(i + 1))
+            elif not nxt.endswith(".json"):
+                # a typo'd degree must fail loudly, not become the
+                # artifact path of a silent default-degree run
+                print("bench_serving: --moe expects a number (or a "
+                      ".json output path next), got %r" % nxt,
+                      file=sys.stderr)
+                sys.exit(2)
+        args.remove("--moe")
+        stray = [a for a in args if a.startswith("-")]
+        if stray:
+            print("bench_serving: --moe cannot combine with %s — run "
+                  "the modes separately" % ", ".join(stray),
+                  file=sys.stderr)
+            sys.exit(2)
+        out_path = args[0] if args else "BENCH_MOE_r24.json"
+        try:
+            main_moe(out_path, max_ep)
+        except SystemExit:
+            raise
+        except Exception as e:                        # noqa: BLE001
+            print(json.dumps({
+                "metric": "serving_moe_expert_hbm_shrink",
                 "value": 0.0,
                 "unit": "error",
                 "vs_baseline": 0.0,

@@ -322,6 +322,28 @@ def _build_artifacts_seeded() -> Dict[str, Artifact]:
         n_pool=2 * L, psig=pool_sig_cp, expect_i32=1,
         packed_len=packed_len, min_aliases=2 * L)
 
+    # round 24: the same contracts under an ep=2 expert-parallel mesh
+    # with a tiny Mixtral — the MoE dispatch's all_to_all pair and
+    # token all_gather must not break donation aliasing (the pools
+    # enter UNsharded: ep never names a pool dim), and the routing
+    # tables are traced math over the one packed operand, never a new
+    # host transfer
+    from paddle_tpu.models.mixtral import (MixtralForCausalLM,
+                                           mixtral_tiny_config)
+    from paddle_tpu.jit.spmd import ep_mesh
+    MESH_EP = 2
+    moe_cfg = mixtral_tiny_config(
+        **TINY, num_local_experts=2, num_experts_per_tok=1)
+    moe_model = MixtralForCausalLM(moe_cfg)
+    moe_model.eval()
+    meshep = ep_mesh(MESH_EP)
+    mixedep = MixedStep(moe_model, caches(), bt_width=BT_WIDTH,
+                        max_spans=MAX_SPANS, span_q=SPAN_Q,
+                        use_pallas=False, mesh=meshep)
+    art(f"mixed_step_ep@T{MIXED_T}", mixedep.aot_lower(MIXED_T),
+        n_pool=2 * L, psig=pool_sig, expect_i32=1,
+        packed_len=packed_len, min_aliases=2 * L)
+
     model2d = LlamaForCausalLM(cfg)
     opt2d = paddle.optimizer.SGD(0.1,
                                  parameters=model2d.parameters())

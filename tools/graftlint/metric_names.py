@@ -137,6 +137,10 @@ REQUIRED_NAMES = frozenset({
     "router_rpc_retries_total",
     "router_rpc_latency_seconds",
     "fleet_engine_process_restarts_total",
+    # expert-parallel MoE serving (round-24; BENCH_MOE_r24.json)
+    "serving_ep_degree",
+    "serving_moe_dispatch_tokens_total",
+    "serving_ep_collective_bytes_total",
 })
 
 # ---------------------------------------------------------------------------
@@ -164,18 +168,22 @@ LABEL_DOMAINS = {
                          "ping", "shutdown"}),
     "reason": frozenset({"preempt", "engine_lost", "migrated"}),
     "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
-    "op": frozenset({"psum", "all_gather"}),
+    "op": frozenset({"psum", "all_gather", "all_to_all"}),
     "q": frozenset({"p50", "p95", "p99"}),
     # page migration direction: out = extract (device→host), in =
     # inject (host→device)
     "direction": frozenset({"out", "in"}),
     # disaggregated-serving engine roles
     "role": frozenset({"prefill", "decode", "mixed"}),
+    # MoE dispatch-token fates (round 24): the serving dispatch is
+    # dropless, so 'dropped' exists to stay visibly zero
+    "fate": frozenset({"routed", "dropped"}),
     # capacity-plane advisory actions (round 20)
     "action": frozenset({"scale_up", "scale_down", "rebalance",
                          "steady"}),
-    # mesh axes (round 21, + cp round 22): serving_mesh_shape{axis}
-    "axis": frozenset({"fsdp", "tp", "dp", "cp"}),
+    # mesh axes (round 21, + cp round 22, + ep round 24):
+    # serving_mesh_shape{axis}
+    "axis": frozenset({"fsdp", "tp", "dp", "cp", "ep"}),
     # spmd param all-gather sites (round 21):
     # spmd_allgather_bytes_total{site}
     "site": frozenset({"train_params", "serving_params"}),
