@@ -66,6 +66,12 @@ class Engine:
         self._step_fn = None
         self._eval_fn = None
         self._history = None
+        # live mesh reshape (round 25): request_reshape() parks the
+        # target dp degree here; the fit loop actuates it at the next
+        # step boundary.  Plain attribute assignment — safe to set
+        # from a signal handler or a watcher thread, like _preempted.
+        self._reshape_to: Optional[int] = None
+        self.last_reshape: Optional[dict] = None
 
     # -- mesh construction (the "cluster + planner" stage) -------------------
     def _build_mesh(self):
@@ -279,6 +285,13 @@ class Engine:
                         print(f"[AutoParallel Engine] epoch {epoch} "
                               f"step {it}: "
                               f"loss {history['loss'][-1]:.5f}")
+                    if self._reshape_to is not None:
+                        # elastic mesh change (round 25): re-place the
+                        # live train state device-to-device instead of
+                        # the checkpoint round trip the r08 restart
+                        # path pays — same step boundary the
+                        # preemption path uses
+                        step, arrays = self._apply_reshape(step, arrays)
                     if mgr is not None and self._preempted:
                         # preemption notice: ONE synchronous checkpoint
                         # at this step boundary, then ask the elastic
@@ -310,6 +323,55 @@ class Engine:
                 mgr.wait()       # surface any background-write failure
         self._history = history
         return history
+
+    # -- live mesh reshape (round 25) -----------------------------------------
+    def request_reshape(self, dp_degree: int) -> None:
+        """Ask the running fit() loop to move training onto a
+        ``dp_degree`` x mp mesh at the next step boundary — a LIVE
+        reshape (params + sharded optimizer state redistributed
+        device-to-device, ``jit/redistribute.py``) instead of the r08
+        checkpoint-save / SystemExit / restore round trip.  Safe to
+        call from a signal handler or watcher thread; between fits it
+        simply pre-arms the next fit's first step."""
+        s = self._strategy.sharding
+        if not getattr(s, "enable", False):
+            raise ValueError(
+                "request_reshape needs Strategy.sharding.enable — an "
+                "unsharded step has no placement to move (restart with "
+                "a new dp_degree instead)")
+        dp = int(dp_degree)
+        if dp < 2:
+            raise ValueError(
+                "request_reshape needs dp_degree >= 2; got %d" % dp)
+        mp = max(1, int(self._strategy.mp_degree))
+        if dp * mp > jax.device_count():
+            raise ValueError(
+                "dp(%d) x mp(%d) exceeds the %d visible devices"
+                % (dp, mp, jax.device_count()))
+        self._reshape_to = dp
+
+    def _apply_reshape(self, step, arrays):
+        """Actuate a parked request_reshape at a step boundary:
+        redistribute the live train state onto the new mesh, swap the
+        engine's mesh so every later batch shards there, and re-place
+        the one already-prefetched batch.  Returns the new (step,
+        arrays)."""
+        from ...jit.redistribute import live_reshape
+        dp = self._reshape_to
+        self._reshape_to = None
+        mp = max(1, int(self._strategy.mp_degree))
+        mesh = ProcessMesh(shape=[dp, mp], dim_names=["dp", "mp"])
+        new_step, plan = live_reshape(step, mesh)
+        self._mesh = mesh
+        self._train_step = new_step
+        self._step_fn = new_step
+        self.last_reshape = plan.summary()
+        if arrays is not None:
+            # the lookahead batch was device_put on the OLD mesh;
+            # re-place it (one host round trip for one batch) so the
+            # first new-mesh step sees its expected input sharding
+            arrays = [self._shard_batch(np.asarray(a)) for a in arrays]
+        return new_step, arrays
 
     # -- fault tolerance ------------------------------------------------------
     def _install_sigterm(self, mgr):

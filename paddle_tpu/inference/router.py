@@ -457,11 +457,7 @@ class ServingRouter:
         # specialists), and a prefill+decode pool runs the
         # prefill→decode page-migration sweep each step.  All-"mixed"
         # pools (the default) see neither — r15 behavior untouched.
-        roles = [getattr(h.engine, "role", "mixed")
-                 for h in self.handles.values()]
-        self._role_pool = any(r != "mixed" for r in roles)
-        self._disagg = ("prefill" in roles
-                        and any(r != "prefill" for r in roles))
+        self._refresh_roles()
         self.pending: List[RouterRequest] = []
         # bounded completed-request record (a long-running admission
         # plane must not grow without bound): oldest completions are
@@ -559,8 +555,75 @@ class ServingRouter:
         self._latq_children = {
             (k, q): self._m_latency_q.labels(kind=k, q=q)
             for k in ("ttft", "tpot") for q in ("p50", "p95", "p99")}
+        self._m_pool = r.gauge(
+            "router_engine_pool_size",
+            "engines currently admitted to the router's pool (healthy "
+            "or not) — the elastic actuator's scale_up/scale_down is "
+            "what moves this")
         for h in self.handles.values():
             self._m_healthy.labels(engine=str(h.engine_id)).set(1)
+        self._m_pool.set(len(self.handles))
+
+    def _refresh_roles(self):
+        """Recompute the role-aware dispatch flags — in __init__ and on
+        every pool-membership change (an all-'mixed' pool must keep the
+        exact r15 ranking even after engines come and go)."""
+        roles = [getattr(h.engine, "role", "mixed")
+                 for h in self.handles.values()]
+        self._role_pool = any(r != "mixed" for r in roles)
+        self._disagg = ("prefill" in roles
+                        and any(r != "prefill" for r in roles))
+
+    # ---- elastic pool membership ----------------------------------------
+    def add_engine(self, engine) -> int:
+        """Admit one engine (or pre-built :class:`EngineHandle`) to the
+        live pool — the elastic actuator's scale_up.  The newcomer is
+        routable from the next ``step()``: it probes, ranks (its empty
+        slots make it the least-loaded target), and samples into the
+        capacity plane like any founding member.  Returns its
+        engine_id; a duplicate id raises ValueError."""
+        h = engine if isinstance(engine, EngineHandle) \
+            else EngineHandle(engine)
+        if h.engine_id in self.handles:
+            raise ValueError(
+                "duplicate engine_id %d in the pool — pass a distinct "
+                "engine_id= on the engine (or handle)" % h.engine_id)
+        self.handles[h.engine_id] = h
+        h.healthy = True
+        h.probe_failures = 0
+        self._refresh_roles()
+        self._m_healthy.labels(engine=str(h.engine_id)).set(1)
+        self._m_pool.set(len(self.handles))
+        return h.engine_id
+
+    def remove_engine(self, engine_id: int,
+                      reason: str = "scale_down") -> Dict[str, int]:
+        """Retire one engine from the pool — the elastic actuator's
+        scale_down.  Every in-flight request drains off it first
+        through the same extract-first requeue the failure path uses
+        (KV pages travel, the resume injects with zero re-prefill),
+        but with ``reason="scale_down"``: a planned retirement is not
+        an ``engine_lost``.  The handle then leaves the pool entirely
+        (a removed engine is gone, not parked-unhealthy).  Returns the
+        drain fate counts ``{"migrated": n, "re_prefilled": m}``.
+        Removing the last engine raises ValueError — a router must
+        keep at least one."""
+        if engine_id not in self.handles:
+            raise KeyError("engine %r is not in the pool" % (engine_id,))
+        if len(self.handles) <= 1:
+            raise ValueError(
+                "refusing to remove the last engine in the pool")
+        h = self.handles[engine_id]
+        fates = self._drain_engine(h, reason=reason)
+        del self.handles[engine_id]
+        if self.capacity is not None:
+            # its frozen windows must leave the rollup too, or the
+            # planner would keep averaging a ghost engine forever
+            self.capacity.drop_engine(engine_id)
+        self._refresh_roles()
+        self._m_healthy.labels(engine=str(engine_id)).set(0)
+        self._m_pool.set(len(self.handles))
+        return fates
 
     # ---- public API -----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
@@ -812,8 +875,19 @@ class ServingRouter:
             return
         h.healthy = False
         h.probe_failures = 0
-        h.routed_keys.clear()
         self._m_healthy.labels(engine=str(h.engine_id)).set(0)
+        self._drain_engine(h, reason="engine_lost")
+
+    def _drain_engine(self, h: EngineHandle,
+                      reason: str) -> Dict[str, int]:
+        """The one drain body (failure path AND planned scale_down):
+        pull every in-flight request off ``h`` extract-first and
+        requeue it with ``reason``.  Returns how each drained request
+        travels: ``"migrated"`` (its KV pages came with it — the
+        resume injects, zero re-prefill) vs ``"re_prefilled"``
+        (extraction unsupported/failed; the r15 recompute resume)."""
+        fates = {"migrated": 0, "re_prefilled": 0}
+        h.routed_keys.clear()
         for (eid, erid) in [k for k in self._inflight
                             if k[0] == h.engine_id]:
             rr = self._inflight.pop((eid, erid))
@@ -845,7 +919,10 @@ class ServingRouter:
                     gen = list((ereq or rr.engine_req).output_ids)
                 except Exception:                     # noqa: BLE001
                     gen = []
-            self._requeue(rr, gen, reason="engine_lost", buffer=vbuf)
+            fates["migrated" if vbuf is not None
+                  else "re_prefilled"] += 1
+            self._requeue(rr, gen, reason=reason, buffer=vbuf)
+        return fates
 
     # ---- requeue / preemption -------------------------------------------
     def _requeue(self, rr: RouterRequest, gen: List[int], reason: str,

@@ -519,6 +519,14 @@ class FleetCapacityMonitor:
         with self._lock:
             return list(self.engines.values())
 
+    def drop_engine(self, engine_id: int) -> None:
+        """Forget an engine retired from the pool (the router's
+        ``remove_engine`` calls this): its frozen windows leave the
+        rollup entirely — unlike a LOST engine, whose monitor stays
+        for diagnosis with ``healthy=False``."""
+        with self._lock:
+            self.engines.pop(int(engine_id), None)
+
     def observe_router(self, router, t: Optional[float] = None) -> str:
         """One router step's sampling + LIGHT planner tick.  Reads
         each healthy handle's ``last_payload`` (refreshed by the
@@ -655,6 +663,11 @@ class FleetCapacityMonitor:
             "transitions": list(self.planner.actions),
             "fleet": fleet,
             "engines": engines,
+            # round 25: the actuator's work order — concrete
+            # (source, target) engine pairs ranked by saturation
+            # spread, so a rebalance recommendation names exactly
+            # which engines shed to which (no re-derivation)
+            "rebalance_pairs": self.rebalance_pairs(),
             "bands": {
                 "high_watermark": self.config.high_watermark,
                 "high_clear": self.config.high_clear,
@@ -664,6 +677,36 @@ class FleetCapacityMonitor:
                 "min_dwell": self.config.min_dwell,
             },
         }
+
+    def rebalance_pairs(self) -> List[Dict]:
+        """Concrete rebalance work orders: the most-saturated healthy
+        engine paired with the least-saturated, second-most with
+        second-least, and so on — ranked by per-pair saturation
+        spread, keeping only pairs whose spread is positive.  The
+        elastic actuator moves pages/requests source -> target
+        verbatim; ``/healthz["capacity"]["rebalance_pairs"]`` carries
+        the same list."""
+        sats = []
+        for m in self._monitors():
+            if not m.healthy:
+                continue
+            s = m.w_saturation.ewma()
+            if s is not None:
+                sats.append((float(s), int(m.engine_id)))
+        if len(sats) < 2:
+            return []
+        sats.sort(key=lambda t: (-t[0], t[1]))
+        pairs = []
+        for i in range(len(sats) // 2):
+            hi_s, hi_id = sats[i]
+            lo_s, lo_id = sats[-1 - i]
+            spread = hi_s - lo_s
+            if spread <= 0.0:
+                break
+            pairs.append({"source_engine": hi_id,
+                          "target_engine": lo_id,
+                          "spread": round(spread, 6)})
+        return pairs
 
     def capacity_plan(self) -> Dict:
         """The committed plan, built lazily off the last tick's

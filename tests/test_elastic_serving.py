@@ -1,0 +1,359 @@
+"""ElasticController decision→action mapping on stub engines (tier 1).
+
+Host-only, no compiles: a migration-capable stub engine (extract /
+inject / host tier / geometry) behind the real ServingRouter + real
+capacity plane.  The slow-lane e2e drill on real engines lives in
+test_elastic_e2e.py; these tests pin the CONTROL behavior — which
+action fires, in what order things drain, what the fates and gauges
+say — in ~a second.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.elastic import ElasticController
+from paddle_tpu.inference.prefix_cache import HostPageTier
+from paddle_tpu.inference.router import ServingRouter
+from paddle_tpu.observability.capacity import (CapacityConfig,
+                                               FleetCapacityMonitor)
+
+GEO = (2, 4, 1, 8, "f32")
+
+
+class _Req:
+    def __init__(self, rid, prompt, budget, eos=None):
+        self.req_id = rid
+        self.prompt_ids = np.asarray(prompt, np.int64)
+        self.output_ids = []
+        self.max_new_tokens = budget
+        self.eos_token_id = eos
+        self.t_first_token = 0.0
+        self.truncated = False
+        self.slot = -1
+        self.state = "waiting"
+
+
+class _Buf:
+    """Stand-in for a host KVPageBuffer: geometry + token coverage."""
+
+    def __init__(self, geometry, n_tokens):
+        self._geo = tuple(geometry)
+        self.n_tokens = int(n_tokens)
+        self.nbytes = 16 * max(1, self.n_tokens)
+
+    def geometry(self):
+        return self._geo
+
+
+class _MigStubEngine:
+    """The capacity-test stub plus the r19/r23 migration protocol."""
+    block_size = 4
+
+    def __init__(self, engine_id, slots=2, geometry=GEO):
+        self.engine_id = engine_id
+        self.max_batch_size = slots
+        self.geometry_tuple = tuple(geometry) if geometry else None
+        self.waiting = []
+        self.running = []
+        self.slots_list = self.running      # _dispatch scans .slots
+        self.finished = {}
+        self.prefix_cache = None
+        self.host_tier = HostPageTier(capacity_bytes=1 << 20)
+        self.tokens = 0
+        self.injected = 0
+        self._next = 0
+
+    # _dispatch looks the injected live request up on engine.slots
+    @property
+    def slots(self):
+        return self.running
+
+    def add_request(self, prompt_ids, max_new_tokens=16,
+                    eos_token_id=None):
+        r = _Req(self._next, prompt_ids, max_new_tokens,
+                 eos=eos_token_id)
+        self._next += 1
+        self.waiting.append(r)
+        return r.req_id
+
+    def inject_request(self, prompt_ids, buffer, max_new_tokens=16,
+                       eos_token_id=None):
+        if buffer is None or buffer.geometry() != self.geometry_tuple:
+            raise ValueError("pool geometry mismatch")
+        if len(self.running) >= self.max_batch_size:
+            raise RuntimeError("no free slot")
+        r = _Req(self._next, prompt_ids, max_new_tokens,
+                 eos=eos_token_id)
+        self._next += 1
+        r.state = "running"
+        r.slot = len(self.running)
+        self.running.append(r)
+        self.injected += 1
+        return r.req_id
+
+    def migration_geometry(self):
+        return self.geometry_tuple
+
+    def extract_request(self, req_id):
+        for r in list(self.running):
+            if r.req_id == req_id:
+                self.running.remove(r)
+                r.slot = -1
+                buf = _Buf(self.geometry_tuple,
+                           len(r.prompt_ids) + len(r.output_ids) - 1) \
+                    if self.geometry_tuple else None
+                return r.prompt_ids, list(r.output_ids), buf
+        raise KeyError(req_id)
+
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    def step(self):
+        while self.waiting and len(self.running) < self.max_batch_size:
+            r = self.waiting.pop(0)
+            r.slot = len(self.running)
+            r.state = "running"
+            self.running.append(r)
+        done = []
+        for r in list(self.running):
+            r.output_ids.append(int(r.prompt_ids[-1]) + len(r.output_ids))
+            self.tokens += 1
+            if len(r.output_ids) >= r.max_new_tokens:
+                self.running.remove(r)
+                r.state = "finished"
+                self.finished[r.req_id] = r
+                done.append(r.req_id)
+        return done
+
+    def health_payload(self):
+        return {"engine_id": self.engine_id,
+                "occupancy": len(self.running),
+                "slots": self.max_batch_size,
+                "waiting": len(self.waiting),
+                "free_pages": 100, "total_pages": 100,
+                "chunk_queue_depth": 0,
+                "counters": {"tokens_generated": self.tokens,
+                             "requests_admitted": self._next}}
+
+
+def _pool(n=2, slots=1, capacity=None, **kw):
+    cfg = capacity or CapacityConfig(min_dwell=2, halflife_s=0.001,
+                                     sample_every=1)
+    engines = [_MigStubEngine(i, slots=slots) for i in range(n)]
+    return ServingRouter(engines, capacity=cfg, **kw), engines
+
+
+def _plan_stub(router, action, **extra):
+    """Pin the router's committed plan — decision→action tests drive
+    the actuator, not the (separately tested) planner."""
+    evals = router.capacity.planner.evaluations
+    plan = {"action": action, "evaluations": evals + 1}
+    plan.update(extra)
+    router.capacity_plan = lambda: plan
+    return plan
+
+
+def test_controller_requires_capacity_plane():
+    engines = [_MigStubEngine(0)]
+    router = ServingRouter(engines, capacity=None)
+    with pytest.raises(ValueError):
+        ElasticController(router)
+
+
+def test_steady_plan_is_a_no_op():
+    router, _ = _pool()
+    ctl = ElasticController(router, cooldown_steps=0)
+    _plan_stub(router, "steady")
+    assert ctl.step() is None
+    assert ctl.actions == []
+    assert len(router.handles) == 2
+
+
+def test_scale_up_admits_warms_and_sheds():
+    """Overload → real planner says scale_up → the controller admits
+    the standby engine, copies hot host-tier pages into it, and sheds
+    decode work off the hottest peer so pages migrate over."""
+    router, engines = _pool(n=2, slots=1)
+    # hot prefix families live on the (about to be hottest) peer
+    for i in range(4):
+        engines[0].host_tier.put(b"k%d" % i, _Buf(GEO, 4))
+    cold = _MigStubEngine(7, slots=4)
+    ctl = ElasticController(router, standby=[cold], cooldown_steps=2,
+                            warm_pages=3)
+    rng = np.random.RandomState(0)
+    for _ in range(8):
+        router.submit(rng.randint(1, 50, (8,)).astype(np.int64),
+                      max_new_tokens=8)
+    for _ in range(3):
+        router.step()
+        if router.capacity_plan()["action"] == "scale_up":
+            break
+    assert router.capacity_plan()["action"] == "scale_up"
+    assert ctl.step() == "scale_up"
+    assert set(router.handles) == {0, 1, 7}
+    # warmed: capped at warm_pages, keys identical, hottest first
+    assert len(cold.host_tier.entries) == 3
+    assert set(cold.host_tier.entries) <= set(engines[0].host_tier.entries)
+    _evals, action, detail = ctl.actions[-1]
+    assert action == "scale_up" and detail["engine"] == 7
+    assert detail["warmed_pages"] == 3
+    # cooldown: the very next calls are holds, no double-admit
+    assert ctl.step() is None and ctl.step() is None
+    # the pool drains to completion through the newcomer — zero drops
+    out = router.run_to_completion()
+    assert len(out) == 8
+    assert all(len(toks) == 8 for toks in out.values())
+    from paddle_tpu.observability import default_registry
+    snap = default_registry().snapshot()
+    series = snap["elastic_actions_total"]["series"]
+    acted = {s["labels"]["action"]: s["value"] for s in series}
+    assert acted.get("scale_up", 0) >= 1
+    pool = snap["router_engine_pool_size"]["series"][0]["value"]
+    assert pool == 3.0
+
+
+def test_scale_down_drains_with_migrated_fates():
+    """The victim's in-flight requests travel with their KV (fate=
+    migrated for every extractable request), the pool shrinks, and
+    every stream still completes its full budget — zero drops."""
+    router, engines = _pool(n=3, slots=2)
+    rids = [router.submit(np.arange(1, 9, dtype=np.int64) * (i + 1),
+                          max_new_tokens=6) for i in range(4)]
+    router.step()            # dispatch + first token everywhere
+    victims = {eid for eid, _ in router._inflight}
+    assert victims           # something is actually in flight
+    ctl = ElasticController(router, cooldown_steps=0, min_engines=1)
+    # pin the victim choice deterministic: drain engine 0
+    ctl._by_saturation = lambda descending: sorted(
+        h.engine_id for h in router.handles.values())
+    n_on_victim = sum(1 for (eid, _e) in router._inflight if eid == 0)
+    _plan_stub(router, "scale_down")
+    assert ctl.step() == "scale_down"
+    assert set(router.handles) == {1, 2}
+    assert len(router.handles) == 2
+    _evals, action, detail = ctl.actions[-1]
+    assert action == "scale_down" and detail["engine"] == 0
+    assert detail["fates"]["migrated"] == n_on_victim
+    assert detail["fates"]["re_prefilled"] == 0
+    # the drained engine parks in standby for the next scale_up
+    assert ctl.standby and ctl.standby[0] is engines[0]
+    out = router.run_to_completion()
+    assert sorted(out) == sorted(rids)
+    assert all(len(toks) == 6 for toks in out.values())
+    # the migrated resumes were INJECTED, not re-prefilled
+    assert sum(e.injected for e in engines[1:]) == n_on_victim
+    assert len(router.handles) == 2
+
+
+def test_scale_down_respects_min_engines():
+    router, _ = _pool(n=2)
+    ctl = ElasticController(router, cooldown_steps=0, min_engines=2)
+    _plan_stub(router, "scale_down")
+    assert ctl.step() is None
+    assert len(router.handles) == 2
+
+
+def test_scale_up_without_source_is_a_no_op():
+    router, _ = _pool(n=2)
+    ctl = ElasticController(router, cooldown_steps=0)
+    _plan_stub(router, "scale_up")
+    assert ctl.step() is None            # no standby, no spawn
+    assert len(router.handles) == 2
+    # max_engines also gates
+    ctl2 = ElasticController(router, standby=[_MigStubEngine(9)],
+                             cooldown_steps=0, max_engines=2)
+    assert ctl2.step() is None
+    assert len(router.handles) == 2
+
+
+def test_rebalance_moves_along_named_pairs():
+    """The plan's (source, target) pairs drive the sweep: running
+    decode work leaves the named source and the ranked dispatch lands
+    it — with its pages — on the engine with spare capacity."""
+    router, engines = _pool(n=2, slots=4)
+    for i in range(3):
+        router.submit(np.arange(1, 9, dtype=np.int64) + i,
+                      max_new_tokens=8)
+    # strand all work on engine 0: engine 1 sits out the dispatch
+    # step, then comes back healthy with spare capacity
+    router.mark_unhealthy(1)
+    router.step()
+    router.recover_engine(1)
+    router.step()
+    on_src = sum(1 for (eid, _e) in router._inflight if eid == 0)
+    assert on_src == 3
+    ctl = ElasticController(router, cooldown_steps=0,
+                            max_moves_per_action=2)
+    _plan_stub(router, "rebalance", rebalance_pairs=[
+        {"source_engine": 0, "target_engine": 1, "spread": 0.9}])
+    assert ctl.step() == "rebalance"
+    assert ctl.actions[-1][1] == "rebalance"
+    assert ctl.actions[-1][2]["moved"] == 2          # capped
+    moved_pending = [rr for rr in router.pending
+                     if rr.kv_buffer is not None]
+    assert len(moved_pending) == 2
+    router.step()            # dispatch INJECTS them (zero re-prefill);
+    # placement stays the ranked dispatch's call, and the drained-down
+    # source may win one back — but the spare-capacity target gets work
+    assert engines[0].injected + engines[1].injected == 2
+    assert engines[1].injected >= 1
+    out = router.run_to_completion()
+    assert len(out) == 3
+    assert all(len(toks) == 8 for toks in out.values())
+
+
+def test_rebalance_skips_unmovable_sources():
+    """No target with matching geometry/room ⇒ nothing moves and no
+    action is recorded (the plan recommendation alone is not an act)."""
+    router, engines = _pool(n=2, slots=2)
+    engines[1].geometry_tuple = (99,) + GEO[1:]       # incompatible
+    router.submit(np.arange(1, 9, dtype=np.int64), max_new_tokens=8)
+    router.step()
+    ctl = ElasticController(router, cooldown_steps=0)
+    _plan_stub(router, "rebalance", rebalance_pairs=[
+        {"source_engine": 0, "target_engine": 1, "spread": 0.5}])
+    assert ctl.step() is None
+    assert ctl.actions == []
+    router.run_to_completion()
+
+
+def test_one_actuation_per_planner_evaluation():
+    """The same committed evaluation never double-executes, even with
+    cooldown_steps=0; a NEW evaluation may act again."""
+    router, engines = _pool(n=2, slots=1)
+    ctl = ElasticController(router, standby=[_MigStubEngine(7),
+                                             _MigStubEngine(8)],
+                            cooldown_steps=0, max_engines=4)
+    plan = {"action": "scale_up",
+            "evaluations": router.capacity.planner.evaluations + 1}
+    router.capacity_plan = lambda: plan
+    assert ctl.step() == "scale_up"
+    assert ctl.step() is None                 # same evaluation: held
+    plan["evaluations"] += 1
+    assert ctl.step() == "scale_up"           # new evaluation: acts
+    assert set(router.handles) == {0, 1, 7, 8}
+
+
+def test_capacity_plan_names_rebalance_pairs():
+    """Satellite 2: the plan dict ranks concrete (source, target)
+    pairs by saturation spread — hottest paired with coolest."""
+    mon = FleetCapacityMonitor(CapacityConfig(min_dwell=1,
+                                              halflife_s=10.0,
+                                              sample_every=1))
+    t = 100.0
+    sats = {0: (4, 4), 1: (0, 4), 2: (2, 4), 3: (3, 4)}
+    for eid, (occ, slots) in sats.items():
+        m = mon.monitor_for(eid)
+        m.sample({"slots": slots, "occupancy": occ, "waiting": 0,
+                  "free_pages": 100, "total_pages": 100,
+                  "counters": {"tokens_generated": 0,
+                               "requests_admitted": 0}}, t)
+    pairs = mon.rebalance_pairs()
+    assert [ (p["source_engine"], p["target_engine"]) for p in pairs ] \
+        == [(0, 1), (3, 2)]
+    assert pairs[0]["spread"] > pairs[1]["spread"] > 0
+    # and the plan dict carries them
+    mon.planner.evaluate({"saturation": 0.5, "saturation_spread": 0.9,
+                          "pending": 0.0, "queue_growth_per_s": 0.0,
+                          "engines": 4})
+    plan = mon.capacity_plan()
+    assert plan["rebalance_pairs"] == pairs

@@ -141,6 +141,13 @@ REQUIRED_NAMES = frozenset({
     "serving_ep_degree",
     "serving_moe_dispatch_tokens_total",
     "serving_ep_collective_bytes_total",
+    # elastic actuation + live mesh reshape (round-25;
+    # BENCH_ELASTIC_r25.json)
+    "elastic_actions_total",
+    "elastic_drained_requests_total",
+    "elastic_warmup_restored_pages_total",
+    "redistribute_bytes_total",
+    "router_engine_pool_size",
 })
 
 # ---------------------------------------------------------------------------
@@ -166,8 +173,15 @@ LABEL_DOMAINS = {
                          "preempt_request", "extract_request",
                          "inject_request", "health_payload",
                          "ping", "shutdown"}),
-    "reason": frozenset({"preempt", "engine_lost", "migrated"}),
-    "kind": frozenset({"decode", "prefill", "ttft", "tpot"}),
+    "reason": frozenset({"preempt", "engine_lost", "migrated",
+                         # elastic pool retirement + the actuator's
+                         # saturation-spread sweep (round 25)
+                         "scale_down", "rebalance"}),
+    "kind": frozenset({"decode", "prefill", "ttft", "tpot",
+                       # redistribution traffic accounting (round 25):
+                       # bytes that crossed chips vs the naive
+                       # full-gather restore bill
+                       "moved", "full_gather_equiv"}),
     "op": frozenset({"psum", "all_gather", "all_to_all"}),
     "q": frozenset({"p50", "p95", "p99"}),
     # page migration direction: out = extract (device→host), in =
@@ -176,8 +190,10 @@ LABEL_DOMAINS = {
     # disaggregated-serving engine roles
     "role": frozenset({"prefill", "decode", "mixed"}),
     # MoE dispatch-token fates (round 24): the serving dispatch is
-    # dropless, so 'dropped' exists to stay visibly zero
-    "fate": frozenset({"routed", "dropped"}),
+    # dropless, so 'dropped' exists to stay visibly zero; round 25
+    # adds drain fates — how a scale_down victim's requests travelled
+    "fate": frozenset({"routed", "dropped",
+                       "migrated", "re_prefilled"}),
     # capacity-plane advisory actions (round 20)
     "action": frozenset({"scale_up", "scale_down", "rebalance",
                          "steady"}),
